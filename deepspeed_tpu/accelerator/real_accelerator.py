@@ -1,0 +1,56 @@
+"""Accelerator selection.
+
+Analogue of the reference's ``accelerator/real_accelerator.py:45-111``:
+``DS_ACCELERATOR`` env override, else auto-detect by probing the JAX backend.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .abstract_accelerator import DeepSpeedAccelerator
+
+_accelerator: Optional[DeepSpeedAccelerator] = None
+
+SUPPORTED = ("tpu", "cpu")
+
+
+def _detect_name() -> str:
+    override = os.environ.get("DS_ACCELERATOR")
+    if override:
+        if override not in SUPPORTED:
+            raise ValueError(f"DS_ACCELERATOR={override!r} not in {SUPPORTED}")
+        return override
+    try:
+        import jax
+
+        platforms = {d.platform for d in jax.local_devices()}
+    except Exception:
+        return "cpu"
+    if platforms - {"cpu"}:
+        return "tpu"  # any non-cpu XLA platform takes the TPU path
+    return "cpu"
+
+
+def get_accelerator() -> DeepSpeedAccelerator:
+    global _accelerator
+    if _accelerator is None:
+        name = _detect_name()
+        if name == "tpu":
+            from .tpu_accelerator import TPU_Accelerator
+
+            _accelerator = TPU_Accelerator()
+        else:
+            from .tpu_accelerator import CPU_Accelerator
+
+            _accelerator = CPU_Accelerator()
+    return _accelerator
+
+
+def set_accelerator(accel: DeepSpeedAccelerator) -> None:
+    global _accelerator
+    _accelerator = accel
+
+
+def is_current_accelerator_supported() -> bool:
+    return get_accelerator().name() in SUPPORTED

@@ -1,0 +1,5 @@
+from .comm import *  # noqa: F401,F403 - torch.distributed-shaped facade
+from .comm import (init_distributed, is_initialized, get_rank, get_world_size,
+                   get_local_rank, barrier, broadcast_object, all_reduce, all_gather,
+                   reduce_scatter, all_to_all, ppermute, axis_index, get_axis_size,
+                   ReduceOp, configure, log_summary)

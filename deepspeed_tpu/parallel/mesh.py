@@ -1,0 +1,235 @@
+"""Device-mesh topology management (the TPU-native process-group layer).
+
+Replaces the reference's process-group bookkeeping (``deepspeed/utils/groups.py``:
+DP group :353, model-parallel :64, expert-parallel/expert-data-parallel
+:113-207, node-local all-to-all :324, hpZ intra-node :428) and the pipeline
+topology (``runtime/pipe/topology.py:12`` ``ProcessTopology``/:244
+``PipeModelDataParallelTopology``).  Instead of building NCCL communicators per
+group, we build ONE ``jax.sharding.Mesh`` whose named axes play the role of all
+those groups; collectives are expressed per-axis inside pjit/shard_map programs
+and XLA routes them over ICI/DCN.
+
+Canonical axis order (outermost → innermost):
+
+    ('pipe', 'data', 'expert', 'seq', 'model')
+
+- DP world (batch sharding, ZeRO sharding) = data × expert  → spec ``('data','expert')``
+- expert parallelism shards the expert dimension over 'expert' only; expert
+  params replicate over 'data' (the reference's *expert-data-parallel* group,
+  groups.py:161).
+- 'model' is innermost so tensor-parallel collectives ride nearest-neighbor ICI.
+- 'pipe' is outermost: stage boundaries are the least bandwidth-hungry link.
+- multi-slice (DCN) jobs put the DCN dimension on 'pipe' or 'data' by choosing
+  sizes accordingly; XLA inserts hierarchical collectives automatically.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MESH_AXES = ("pipe", "data", "expert", "seq", "model")
+
+# Axes over which a ZeRO/FSDP-sharded non-expert parameter is partitioned.
+ZERO_AXES = ("data", "expert")
+# Batch (data-parallel) sharding axes.
+BATCH_AXES = ("data", "expert")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshLayout:
+    """Degrees of each parallelism dimension; the analogue of the reference's
+    ``PipeModelDataParallelTopology`` axis sizes (topology.py:244) plus the
+    expert/sequence axes from groups.py."""
+
+    dp: int = 1  # data-parallel degree EXCLUDING expert axis
+    tp: int = 1  # tensor/model parallel
+    pp: int = 1  # pipeline stages
+    ep: int = 1  # expert parallel
+    sp: int = 1  # sequence/context parallel
+
+    @property
+    def world_size(self) -> int:
+        return self.dp * self.tp * self.pp * self.ep * self.sp
+
+    @property
+    def dp_world_size(self) -> int:
+        """Total data-parallel degree as the reference counts it (dp×ep)."""
+        return self.dp * self.ep
+
+    def axis_sizes(self) -> Tuple[int, int, int, int, int]:
+        return (self.pp, self.dp, self.ep, self.sp, self.tp)
+
+    @staticmethod
+    def from_world(world_size: int, tp: int = 1, pp: int = 1, ep: int = 1, sp: int = 1,
+                   dp: Optional[int] = None) -> "MeshLayout":
+        denom = tp * pp * ep * sp
+        if dp is None:
+            if world_size % denom != 0:
+                raise ValueError(
+                    f"world size {world_size} not divisible by tp*pp*ep*sp={denom}")
+            dp = world_size // denom
+        layout = MeshLayout(dp=dp, tp=tp, pp=pp, ep=ep, sp=sp)
+        if layout.world_size != world_size:
+            raise ValueError(
+                f"mesh layout {layout} covers {layout.world_size} devices, have {world_size}")
+        return layout
+
+
+def build_mesh(layout: MeshLayout, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Construct the global Mesh for a layout.
+
+    Uses ``jax.experimental.mesh_utils`` for ICI-topology-aware device
+    assignment on real TPU slices; falls back to row-major reshape on the host
+    platform (simulated meshes) where physical topology doesn't exist.
+    """
+    if devices is None:
+        devices = jax.devices()
+    shape = layout.axis_sizes()
+    if layout.world_size != len(devices):
+        raise ValueError(f"layout needs {layout.world_size} devices, got {len(devices)}")
+    try:
+        from jax.experimental import mesh_utils
+
+        if devices[0].platform not in ("cpu",):
+            dev_array = mesh_utils.create_device_mesh(shape, devices=list(devices))
+        else:
+            raise ValueError  # host platform: no physical topology to optimize
+    except Exception:
+        dev_array = np.asarray(list(devices)).reshape(shape)
+    return Mesh(dev_array, MESH_AXES)
+
+
+# ---------------------------------------------------------------------------
+# Global mesh registry (the analogue of groups.py's cached process groups).
+# ---------------------------------------------------------------------------
+_GLOBAL_MESH: Optional[Mesh] = None
+_GLOBAL_LAYOUT: Optional[MeshLayout] = None
+
+
+def initialize_mesh(layout: Optional[MeshLayout] = None,
+                    devices: Optional[Sequence[jax.Device]] = None, **kwargs) -> Mesh:
+    global _GLOBAL_MESH, _GLOBAL_LAYOUT
+    if layout is None:
+        n = len(devices) if devices is not None else jax.device_count()
+        layout = MeshLayout.from_world(n, **kwargs)
+    _GLOBAL_LAYOUT = layout
+    _GLOBAL_MESH = build_mesh(layout, devices)
+    return _GLOBAL_MESH
+
+
+def get_mesh() -> Mesh:
+    if _GLOBAL_MESH is None:
+        initialize_mesh()
+    return _GLOBAL_MESH
+
+
+def get_layout() -> MeshLayout:
+    if _GLOBAL_LAYOUT is None:
+        initialize_mesh()
+    return _GLOBAL_LAYOUT
+
+
+def reset_mesh() -> None:
+    global _GLOBAL_MESH, _GLOBAL_LAYOUT
+    _GLOBAL_MESH = None
+    _GLOBAL_LAYOUT = None
+
+
+# ---------------------------------------------------------------------------
+# Spec helpers (the analogue of "which group does this tensor reduce over").
+# ---------------------------------------------------------------------------
+
+def batch_pspec(extra_leading: int = 0) -> P:
+    """PartitionSpec for a [batch, ...] array sharded over the DP world."""
+    return P(*([None] * extra_leading), BATCH_AXES)
+
+
+def replicated_pspec() -> P:
+    return P()
+
+
+def named(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, spec)
+
+
+def axis_size(mesh: Mesh, axis) -> int:
+    if isinstance(axis, (tuple, list)):
+        return int(np.prod([mesh.shape[a] for a in axis]))
+    return mesh.shape[axis]
+
+
+def dp_world_size(mesh: Optional[Mesh] = None) -> int:
+    mesh = mesh or get_mesh()
+    return axis_size(mesh, BATCH_AXES)
+
+
+# ---------------------------------------------------------------------------
+# Coordinate bookkeeping for checkpoint naming / launcher (ProcessTopology
+# parity, topology.py:12). Ranks here are *device* linear indices in mesh
+# order, not process ranks — one JAX process drives many devices.
+# ---------------------------------------------------------------------------
+
+class ProcessTopology:
+    """Named-axis cartesian rank mapping over arbitrary axes.
+
+    API parity with the reference ``ProcessTopology`` (topology.py:12):
+    ``get_rank(**coords)``, ``get_coord(rank)``, ``get_dim``, ``get_axis_list``.
+    """
+
+    def __init__(self, axes: Sequence[str], dims: Sequence[int]):
+        assert len(axes) == len(dims)
+        self.axes = tuple(axes)
+        self.dims = tuple(int(d) for d in dims)
+
+    def world_size(self) -> int:
+        return int(np.prod(self.dims)) if self.dims else 1
+
+    def get_dim(self, axis: str) -> int:
+        return self.dims[self.axes.index(axis)]
+
+    def get_rank(self, **coords) -> int:
+        missing = set(self.axes) - set(coords)
+        if missing:
+            raise ValueError(f"missing coords for axes {missing}")
+        rank = 0
+        for axis, dim in zip(self.axes, self.dims):
+            c = coords[axis]
+            if not 0 <= c < dim:
+                raise ValueError(f"coord {axis}={c} out of range [0,{dim})")
+            rank = rank * dim + c
+        return rank
+
+    def get_coord(self, rank: int):
+        coords = {}
+        for axis, dim in zip(reversed(self.axes), reversed(self.dims)):
+            coords[axis] = rank % dim
+            rank //= dim
+        import collections
+
+        Coord = collections.namedtuple("Coord", self.axes)
+        return Coord(**{a: coords[a] for a in self.axes})
+
+    def get_axis_list(self, axis: str, idx: int):
+        """All ranks whose coordinate on `axis` equals idx (a "group")."""
+        return [r for r in range(self.world_size()) if getattr(self.get_coord(r), axis) == idx]
+
+    def get_axis_comm_lists(self, axis: str):
+        """Lists of ranks that communicate along `axis` (vary axis, fix others)."""
+        others = [a for a in self.axes if a != axis]
+        groups = {}
+        for r in range(self.world_size()):
+            coord = self.get_coord(r)
+            key = tuple(getattr(coord, a) for a in others)
+            groups.setdefault(key, []).append(r)
+        return [sorted(v) for _, v in sorted(groups.items())]
+
+
+def topology_from_mesh(mesh: Optional[Mesh] = None) -> ProcessTopology:
+    mesh = mesh or get_mesh()
+    return ProcessTopology(axes=mesh.axis_names, dims=[mesh.shape[a] for a in mesh.axis_names])

@@ -1,0 +1,68 @@
+"""Config-model base utilities.
+
+Parity with the reference's ``runtime/config_utils.py:16`` — a pydantic base
+class providing: unknown-field tolerance with a warning, deprecated-field
+migration (``deprecated=True`` + ``new_param`` in json_schema_extra), and
+``"auto"`` value passthrough (reference :54; callers resolve "auto" later).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from pydantic import BaseModel, ConfigDict
+
+from ..utils.logging import logger
+
+AUTO_VALUE = "auto"
+
+
+class DeepSpeedConfigModel(BaseModel):
+    """Base for all subsystem configs (reference runtime/config_utils.py:16).
+
+    Usage of deprecated fields::
+
+        old_name: int = Field(0, json_schema_extra={"deprecated": True, "new_param": "new_name"})
+    """
+
+    model_config = ConfigDict(
+        validate_assignment=True,
+        use_enum_values=True,
+        populate_by_name=True,
+        extra="ignore",
+        protected_namespaces=(),
+        arbitrary_types_allowed=True,
+    )
+
+    def __init__(self, strict: bool = False, **data):
+        if not strict:  # drop "auto" so field defaults apply (reference :54)
+            data = {k: v for k, v in data.items() if not (v == AUTO_VALUE and k != "precision")}
+        super().__init__(**data)
+        self._migrate_deprecated(data)
+
+    def _migrate_deprecated(self, data: Dict[str, Any]) -> None:
+        for name, field in type(self).model_fields.items():
+            extra = field.json_schema_extra or {}
+            if not isinstance(extra, dict) or not extra.get("deprecated"):
+                continue
+            if name not in data:
+                continue
+            new_param = extra.get("new_param")
+            logger.warning(f"Config parameter {name} is deprecated" +
+                           (f"; use {new_param} instead" if new_param else ""))
+            if new_param and new_param not in data:
+                # copy the deprecated value onto its replacement
+                object.__setattr__(self, new_param, getattr(self, name))
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return getattr(self, key, default)
+
+    def __getitem__(self, key: str) -> Any:
+        return getattr(self, key)
+
+
+def get_scalar_param(param_dict: Dict[str, Any], param_name: str, param_default_value: Any) -> Any:
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_dict_param(param_dict: Dict[str, Any], param_name: str, param_default_value: Any) -> Any:
+    return param_dict.get(param_name, param_default_value)

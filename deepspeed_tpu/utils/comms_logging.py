@@ -1,0 +1,60 @@
+"""Comms logger (reference ``deepspeed/utils/comms_logging.py``).
+
+Records per-op message sizes and counts.  Under XLA, collectives are compiled
+into the program so call-site latency is not observable the way a NCCL call
+is; sizes/counts are exact (recorded at trace time), and bandwidth numbers
+come from the profiler when available.  ``log_all`` mirrors the reference's
+summary table (comm/comm.py:408).
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict
+
+from .logging import logger
+
+
+def convert_size(size_bytes: int) -> str:
+    import math
+
+    if size_bytes == 0:
+        return "0B"
+    names = ("B", "KB", "MB", "GB", "TB")
+    i = min(int(math.floor(math.log(size_bytes, 1024))), len(names) - 1)
+    return f"{round(size_bytes / 1024 ** i, 2)} {names[i]}"
+
+
+class CommsLogger:
+    def __init__(self, config=None):
+        self.enabled = getattr(config, "enabled", True)
+        self.verbose = getattr(config, "verbose", False)
+        self.prof_all = getattr(config, "prof_all", True)
+        self.prof_ops = list(getattr(config, "prof_ops", []) or [])
+        # op name -> msg size -> [count, total_bytes]
+        self.comms_dict: Dict[str, Dict[int, list]] = defaultdict(lambda: defaultdict(lambda: [0, 0]))
+
+    def _should_log(self, op_name: str) -> bool:
+        if not self.enabled:
+            return False
+        return self.prof_all or op_name in self.prof_ops
+
+    def append(self, op_name: str, msg_size: int) -> None:
+        if not self._should_log(op_name):
+            return
+        rec = self.comms_dict[op_name][msg_size]
+        rec[0] += 1
+        rec[1] += msg_size
+        if self.verbose:
+            logger.info(f"comm op: {op_name} | msg size: {convert_size(msg_size)}")
+
+    def log_all(self) -> None:
+        header = f"{'Comm. Op':<25}{'Message Size':<20}{'Count':<10}{'Total Traffic':<20}"
+        lines = [header]
+        for op_name, sizes in sorted(self.comms_dict.items()):
+            lines.append(op_name)
+            for size, (count, total) in sorted(sizes.items()):
+                lines.append(f"{'':<25}{convert_size(size):<20}{count:<10}{convert_size(total):<20}")
+        logger.info("\n".join(lines))
+
+    def reset(self) -> None:
+        self.comms_dict.clear()

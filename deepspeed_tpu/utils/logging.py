@@ -1,0 +1,78 @@
+"""Rank-aware logging utilities.
+
+TPU-native analogue of the reference's ``deepspeed/utils/logging.py`` (152 LoC):
+``logger`` singleton plus ``log_dist`` which only emits on the listed ranks.  On
+a JAX multi-host job "rank" is ``jax.process_index()``; in single-process
+simulated-mesh tests it is always 0.
+"""
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import sys
+
+LOG_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+@functools.lru_cache(None)
+def _create_logger(name: str = "deepspeed_tpu", level: int = logging.INFO) -> logging.Logger:
+    lg = logging.getLogger(name)
+    lg.setLevel(level)
+    lg.propagate = False
+    handler = logging.StreamHandler(stream=sys.stdout)
+    handler.setLevel(level)
+    formatter = logging.Formatter(
+        "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s", datefmt="%Y-%m-%d %H:%M:%S"
+    )
+    handler.setFormatter(formatter)
+    lg.addHandler(handler)
+    return lg
+
+
+_default_level = LOG_LEVELS.get(os.environ.get("DS_TPU_LOG_LEVEL", "info").lower(), logging.INFO)
+logger = _create_logger(level=_default_level)
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # pragma: no cover - jax always importable in this env
+        return 0
+
+
+def should_log_on(ranks=None) -> bool:
+    """True when the current process should emit for the given rank filter."""
+    if ranks is None:
+        return True
+    my_rank = _process_index()
+    return my_rank in ranks or (-1 in ranks)
+
+
+def log_dist(message: str, ranks=None, level: int = logging.INFO) -> None:
+    """Log ``message`` only on the listed process ranks (None / [-1] => all).
+
+    Mirrors the reference's ``log_dist`` (deepspeed/utils/logging.py:100-120)
+    but keyed on ``jax.process_index()`` instead of torch.distributed rank.
+    """
+    if should_log_on(ranks):
+        logger.log(level, f"[Rank {_process_index()}] {message}")
+
+
+def print_rank_0(message: str) -> None:
+    if _process_index() == 0:
+        logger.info(message)
+
+
+def warning_once(message: str, _seen=set()) -> None:  # noqa: B006 - intentional cache
+    if message not in _seen:
+        _seen.add(message)
+        logger.warning(message)

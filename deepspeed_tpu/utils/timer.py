@@ -1,0 +1,150 @@
+"""Wall-clock and throughput timers.
+
+TPU-native analogue of the reference's ``deepspeed/utils/timer.py``:
+``SynchronizedWallClockTimer`` (reference :33) uses CUDA events; on TPU the
+equivalent synchronization point is ``jax.block_until_ready`` on the arrays the
+timed region produced, so our timers accept an optional pytree to block on.
+``ThroughputTimer`` (reference :153) reports samples/sec the same way.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from .logging import logger
+
+try:
+    import jax
+except Exception:  # pragma: no cover
+    jax = None
+
+
+def _sync(tree: Any = None) -> None:
+    if jax is not None and tree is not None:
+        jax.block_until_ready(tree)
+
+
+class _Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self.started = False
+        self._start = 0.0
+        self._elapsed = 0.0
+        self.count = 0
+
+    def start(self, sync_tree: Any = None) -> None:
+        assert not self.started, f"timer {self.name} already started"
+        _sync(sync_tree)
+        self._start = time.perf_counter()
+        self.started = True
+
+    def stop(self, reset: bool = False, sync_tree: Any = None) -> None:
+        assert self.started, f"timer {self.name} not started"
+        _sync(sync_tree)
+        dt = time.perf_counter() - self._start
+        self._elapsed = dt if reset else self._elapsed + dt
+        self.count += 1
+        self.started = False
+
+    def elapsed(self, reset: bool = True) -> float:
+        """Elapsed seconds (includes any in-flight interval)."""
+        extra = (time.perf_counter() - self._start) if self.started else 0.0
+        total = self._elapsed + extra
+        if reset:
+            self._elapsed = 0.0
+            if self.started:
+                self._start = time.perf_counter()
+        return total
+
+    def mean(self) -> float:
+        return self._elapsed / max(self.count, 1)
+
+    def reset(self) -> None:
+        self.started = False
+        self._elapsed = 0.0
+        self.count = 0
+
+
+class SynchronizedWallClockTimer:
+    """Named-timer registry; ``log()`` prints "name: ms" lines like the reference."""
+
+    def __init__(self):
+        self.timers: Dict[str, _Timer] = {}
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def has(self, name: str) -> bool:
+        return name in self.timers
+
+    def log(self, names: Optional[List[str]] = None, normalizer: float = 1.0, reset: bool = True,
+            memory_breakdown: bool = False) -> None:
+        assert normalizer > 0.0
+        names = names if names is not None else list(self.timers)
+        string = "time (ms)"
+        for name in names:
+            if name in self.timers:
+                ms = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                string += f" | {name}: {ms:.2f}"
+        logger.info(string)
+
+    def get_mean(self, names: List[str], normalizer: float = 1.0) -> Dict[str, float]:
+        return {
+            name: self.timers[name].mean() * 1000.0 / normalizer
+            for name in names
+            if name in self.timers
+        }
+
+
+class ThroughputTimer:
+    """Samples/sec + tokens/sec tracker (reference ThroughputTimer, timer.py:153)."""
+
+    def __init__(self, batch_size: int, start_step: int = 2, steps_per_output: int = 50,
+                 monitor_memory: bool = False, logging_fn=None):
+        self.batch_size = max(batch_size, 1)
+        self.start_step = start_step
+        self.steps_per_output = steps_per_output
+        self.logging = logging_fn or logger.info
+        self.global_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.step_elapsed_time = 0.0
+        self._start = 0.0
+        self.started = False
+
+    def start(self) -> None:
+        self._start = time.perf_counter()
+        self.started = True
+
+    def stop(self, global_step: bool = True, report_speed: bool = True, sync_tree: Any = None) -> None:
+        if not self.started:
+            return
+        _sync(sync_tree)
+        self.started = False
+        if global_step:
+            self.global_step_count += 1
+        duration = time.perf_counter() - self._start
+        # skip warmup steps so compile time doesn't pollute the average
+        if self.global_step_count > self.start_step:
+            self.total_elapsed_time += duration
+            self.step_elapsed_time += duration
+            if report_speed and self.global_step_count % self.steps_per_output == 0:
+                self.logging(
+                    f"step={self.global_step_count}, "
+                    f"samples/sec (avg): {self.avg_samples_per_sec():.2f}, "
+                    f"samples/sec (window): {self._window_samples_per_sec():.2f}"
+                )
+                self.step_elapsed_time = 0.0
+
+    def _window_samples_per_sec(self) -> float:
+        steps = self.steps_per_output
+        if self.step_elapsed_time == 0.0:
+            return 0.0
+        return steps * self.batch_size / self.step_elapsed_time
+
+    def avg_samples_per_sec(self) -> float:
+        effective = self.global_step_count - self.start_step
+        if effective <= 0 or self.total_elapsed_time == 0.0:
+            return 0.0
+        return effective * self.batch_size / self.total_elapsed_time

@@ -1,0 +1,41 @@
+"""Test harness configuration.
+
+The reference simulates a cluster by forkserver-spawning N processes over
+NCCL/Gloo on localhost (tests/unit/common.py:92-199).  The TPU-native
+analogue: a *virtual 8-device mesh* on the XLA host platform via
+``--xla_force_host_platform_device_count=8`` — same process, real SPMD
+partitioning, real collectives (compiled), no hardware needed.  Real-TPU tests
+are marked ``tpu`` and skipped on the simulated mesh.
+"""
+import os
+
+# Must be set before jax initializes its backends.  Force-override: the outer
+# environment points JAX_PLATFORMS at the real TPU (and the container's
+# sitecustomize re-pins it programmatically), but unit tests always run on the
+# virtual 8-device host mesh (real-TPU tests opt in via the tpu marker).
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")  # sitecustomize sets "axon,cpu"
+
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "tpu: requires real TPU hardware")
+    config.addinivalue_line("markers", "sequential: must not run in parallel")
+    config.addinivalue_line("markers", "slow: long-running test")
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_mesh():
+    """Each test gets a clean global-mesh slate (analogue of destroying
+    process groups between DistributedTest cases)."""
+    yield
+    from deepspeed_tpu.parallel import mesh
+
+    mesh.reset_mesh()

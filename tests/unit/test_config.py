@@ -1,0 +1,98 @@
+"""Config system tests — parity with reference tests/unit/runtime/test_ds_config_*."""
+import json
+
+import pytest
+
+from deepspeed_tpu.runtime.config import (DeepSpeedConfig, DeepSpeedConfigError, ZeroConfig,
+                                          FP16Config, MeshConfig)
+
+
+def test_batch_triad_all_given():
+    cfg = DeepSpeedConfig({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 2,
+                           "gradient_accumulation_steps": 2}, dp_world_size=8)
+    assert cfg.train_batch_size == 32
+
+
+def test_batch_triad_infer_gas():
+    cfg = DeepSpeedConfig({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 2},
+                          dp_world_size=8)
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_triad_infer_micro():
+    cfg = DeepSpeedConfig({"train_batch_size": 32, "gradient_accumulation_steps": 2},
+                          dp_world_size=8)
+    assert cfg.train_micro_batch_size_per_gpu == 2
+
+
+def test_batch_triad_infer_train():
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 4}, dp_world_size=8)
+    assert cfg.train_batch_size == 32 and cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_triad_inconsistent_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 33, "train_micro_batch_size_per_gpu": 2,
+                         "gradient_accumulation_steps": 2}, dp_world_size=8)
+
+
+def test_batch_triad_none_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({}, dp_world_size=8)
+
+
+def test_zero_config_defaults_and_stage():
+    cfg = DeepSpeedConfig({"train_batch_size": 8,
+                           "zero_optimization": {"stage": 2, "overlap_comm": False}},
+                          dp_world_size=8)
+    assert cfg.zero_enabled and cfg.zero_optimization_stage == 2
+    assert cfg.zero_config.overlap_comm is False
+    assert cfg.zero_config.reduce_bucket_size == 500_000_000
+
+
+def test_zeropp_requires_stage3():
+    with pytest.raises(Exception):
+        ZeroConfig(stage=2, zero_quantized_weights=True)
+    z = ZeroConfig(stage=3, zero_quantized_weights=True, zero_hpz_partition_size=8)
+    assert z.zero_quantized_weights and z.zero_hpz_partition_size == 8
+
+
+def test_fp16_bf16_exclusive():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 8, "fp16": {"enabled": True},
+                         "bf16": {"enabled": True}}, dp_world_size=8)
+
+
+def test_precision_selection():
+    import jax.numpy as jnp
+
+    assert DeepSpeedConfig({"train_batch_size": 8, "bf16": {"enabled": True}},
+                           dp_world_size=8).precision == jnp.bfloat16
+    assert DeepSpeedConfig({"train_batch_size": 8, "fp16": {"enabled": True}},
+                           dp_world_size=8).precision == jnp.float16
+    assert DeepSpeedConfig({"train_batch_size": 8}, dp_world_size=8).precision == jnp.float32
+
+
+def test_auto_values_dropped():
+    cfg = FP16Config(enabled=True, loss_scale="auto")
+    assert cfg.loss_scale == 0.0  # "auto" falls back to default
+
+
+def test_config_from_json_file(tmp_path):
+    p = tmp_path / "ds_config.json"
+    p.write_text(json.dumps({"train_batch_size": 16, "mesh": {"tp": 2}}))
+    cfg = DeepSpeedConfig(str(p), dp_world_size=4)
+    assert cfg.train_batch_size == 16 and cfg.mesh.tp == 2
+
+
+def test_unknown_keys_tolerated():
+    cfg = DeepSpeedConfig({"train_batch_size": 8, "zero_optimization": {"bogus_key": 1}},
+                          dp_world_size=8)
+    assert cfg.zero_config.stage == 0
+
+
+def test_offload_config():
+    cfg = DeepSpeedConfig({"train_batch_size": 8, "zero_optimization": {
+        "stage": 3, "offload_optimizer": {"device": "cpu", "pin_memory": True}}},
+        dp_world_size=8)
+    assert cfg.zero_config.offload_optimizer.device == "cpu"
