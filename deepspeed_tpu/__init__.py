@@ -1,0 +1,74 @@
+"""deepspeed_tpu — a TPU-native distributed training & inference framework.
+
+Public API parity with the reference ``deepspeed/__init__.py``:
+``initialize()`` (:64), ``init_inference()`` (:269), ``init_distributed``
+(re-export :38), plus the comm facade at ``deepspeed_tpu.comm``.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+__version__ = "0.1.0"
+__git_branch__ = "main"
+
+from . import comm  # noqa: E402
+from .comm import init_distributed  # noqa: E402  (reference re-export)
+from .accelerator import get_accelerator  # noqa: E402
+from .runtime.config import DeepSpeedConfig  # noqa: E402
+from .runtime.engine import DeepSpeedEngine  # noqa: E402
+from .parallel import MeshLayout, initialize_mesh, get_mesh  # noqa: E402
+
+
+def initialize(args=None, model: Any = None, optimizer=None, model_parameters=None,
+               training_data=None, lr_scheduler=None, mpu=None, dist_init_required=None,
+               collate_fn=None, config=None, config_params=None, loss_fn=None,
+               init_fn=None, params=None, param_specs=None, mesh=None):
+    """Build the training engine (reference deepspeed/__init__.py:64).
+
+    Returns ``(engine, optimizer, training_dataloader, lr_scheduler)`` like the
+    reference.  The model contract is functional: pass ``loss_fn(params, batch,
+    rng)`` + ``init_fn(rng)`` (or a model adapter object exposing them — see
+    ``deepspeed_tpu.models``).
+    """
+    if dist_init_required is None or dist_init_required:
+        init_distributed()
+    cfg = config if config is not None else config_params
+    engine = DeepSpeedEngine(model=model, loss_fn=loss_fn, init_fn=init_fn, params=params,
+                             param_specs=param_specs, config=cfg, optimizer=optimizer,
+                             lr_scheduler=lr_scheduler, training_data=training_data,
+                             mesh=mesh)
+    return engine, engine.optimizer, engine.training_dataloader, engine.lr_schedule
+
+
+def init_inference(model: Any = None, config=None, **kwargs):
+    """Build the inference engine (reference deepspeed/__init__.py:269)."""
+    from .inference.engine import InferenceEngine
+    from .inference.config import DeepSpeedInferenceConfig
+
+    engine_kwargs = {k: kwargs.pop(k) for k in ("apply_fn", "params", "mesh")
+                     if k in kwargs}
+    if isinstance(config, DeepSpeedInferenceConfig):
+        ds_inference_config = config
+    else:
+        cfg = dict(config or {})
+        cfg.update(kwargs)
+        ds_inference_config = DeepSpeedInferenceConfig(**cfg)
+    return InferenceEngine(model, config=ds_inference_config, **engine_kwargs)
+
+
+def add_config_arguments(parser):
+    """argparse plumbing (reference deepspeed/__init__.py:246)."""
+    group = parser.add_argument_group("DeepSpeed-TPU", "DeepSpeed-TPU configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed-TPU (helper flag for config parity)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to the DeepSpeed-TPU json configuration file")
+    group.add_argument("--deepscale", default=False, action="store_true",
+                       help=argparse_suppress())
+    return parser
+
+
+def argparse_suppress():
+    import argparse
+
+    return argparse.SUPPRESS

@@ -55,6 +55,12 @@ class XLABackend(Backend):
             return lax.pmin(tensor, axis)
         if op in ("mean", "avg"):
             return lax.pmean(tensor, axis)
+        if op == "prod":
+            # XLA has no product collective; gather then reduce locally.
+            import jax.numpy as jnp
+
+            gathered = lax.all_gather(tensor, axis, axis=0, tiled=False)
+            return jnp.prod(gathered, axis=0)
         raise ValueError(f"unsupported reduce op {op}")
 
     def all_gather(self, tensor: Any, axis: AxisName, tiled: bool = True, gather_dim: int = 0):

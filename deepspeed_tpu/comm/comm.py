@@ -29,21 +29,22 @@ import numpy as np
 from .backend import XLABackend, AxisName
 from ..utils.logging import logger, log_dist
 
-SUM = "sum"
-MAX = "max"
-MIN = "min"
-AVG = "avg"
-
-_backend = XLABackend()
-_comms_logger = None  # lazily attached by configure()
-
-
 class ReduceOp:
     SUM = "sum"
     MAX = "max"
     MIN = "min"
     AVG = "avg"
     PRODUCT = "prod"
+
+
+# module-level aliases of the canonical ReduceOp vocabulary
+SUM = ReduceOp.SUM
+MAX = ReduceOp.MAX
+MIN = ReduceOp.MIN
+AVG = ReduceOp.AVG
+
+_backend = XLABackend()
+_comms_logger = None  # lazily attached by configure()
 
 
 def configure(comms_config=None) -> None:

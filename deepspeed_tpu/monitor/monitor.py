@@ -1,0 +1,111 @@
+"""Monitor backends (reference ``deepspeed/monitor/monitor.py:13,29``).
+
+``MonitorMaster`` fans out ``(name, value, step)`` events to TensorBoard /
+W&B / CSV writers on process 0.  TensorBoard uses torch's event writer (torch
+is baked into the image, CPU-only, which is all a writer needs); both external
+backends degrade to warnings when unavailable.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+from ..utils.logging import logger
+
+Event = Tuple[str, float, int]
+
+
+class Monitor:
+    def __init__(self, monitor_config):
+        self.monitor_config = monitor_config
+
+    def write_events(self, event_list: List[Event]) -> None:
+        raise NotImplementedError
+
+
+class TensorBoardMonitor(Monitor):
+    def __init__(self, tensorboard_config):
+        super().__init__(tensorboard_config)
+        self.summary_writer = None
+        if not tensorboard_config.enabled:
+            return
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            log_dir = os.path.join(tensorboard_config.output_path or "./runs",
+                                   tensorboard_config.job_name)
+            self.summary_writer = SummaryWriter(log_dir=log_dir)
+        except Exception as e:  # pragma: no cover
+            logger.warning(f"tensorboard writer unavailable: {e}")
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if self.summary_writer is None:
+            return
+        for name, value, step in event_list:
+            self.summary_writer.add_scalar(name, value, step)
+        self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, wandb_config):
+        super().__init__(wandb_config)
+        self.enabled = wandb_config.enabled
+        if self.enabled:
+            try:
+                import wandb
+
+                wandb.init(project=wandb_config.project, group=wandb_config.group,
+                           entity=wandb_config.team)
+                self._wandb = wandb
+            except Exception as e:  # pragma: no cover
+                logger.warning(f"wandb unavailable: {e}")
+                self.enabled = False
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if not self.enabled:
+            return
+        for name, value, step in event_list:
+            self._wandb.log({name: value}, step=step)
+
+
+class csvMonitor(Monitor):
+    def __init__(self, csv_config):
+        super().__init__(csv_config)
+        self.enabled = csv_config.enabled
+        self.log_dir = None
+        self.filenames: dict = {}
+        if self.enabled:
+            self.log_dir = os.path.join(csv_config.output_path or "./csv_logs",
+                                        csv_config.job_name)
+            os.makedirs(self.log_dir, exist_ok=True)
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if not self.enabled:
+            return
+        for name, value, step in event_list:
+            fname = os.path.join(self.log_dir, name.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a") as f:
+                if new:
+                    f.write("step,value\n")
+                f.write(f"{step},{value}\n")
+
+
+class MonitorMaster(Monitor):
+    """Rank-0 fan-out to all enabled writers (reference monitor.py:29)."""
+
+    def __init__(self, monitor_config):
+        super().__init__(monitor_config)
+        import jax
+
+        self._is_writer = jax.process_index() == 0
+        self.tb_monitor = TensorBoardMonitor(monitor_config.tensorboard) if self._is_writer else None
+        self.wandb_monitor = WandbMonitor(monitor_config.wandb) if self._is_writer else None
+        self.csv_monitor = csvMonitor(monitor_config.csv_monitor) if self._is_writer else None
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if not self._is_writer:
+            return
+        for mon in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
+            if mon is not None:
+                mon.write_events(event_list)

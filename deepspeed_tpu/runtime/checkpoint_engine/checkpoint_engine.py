@@ -1,0 +1,35 @@
+"""Checkpoint engine ABC (reference ``runtime/checkpoint_engine/checkpoint_engine.py:9``).
+
+Pluggable save/load/commit; implementations: orbax (default, sharding-aware,
+async-capable — the Nebula-analogue tiering comes from orbax's async
+checkpointing) and a plain msgpack engine for host-only state.
+"""
+from __future__ import annotations
+
+import abc
+from typing import Any, Optional
+
+
+class CheckpointEngine(abc.ABC):
+    def __init__(self, config_params=None):
+        self.config = config_params
+
+    @abc.abstractmethod
+    def save(self, state_dict: Any, path: str) -> None:
+        ...
+
+    @abc.abstractmethod
+    def load(self, path: str, target: Any = None, shardings: Any = None) -> Any:
+        ...
+
+    def create(self, tag: str) -> None:
+        """Start of a checkpoint under `tag` (reference create)."""
+
+    def commit(self, tag: str) -> bool:
+        """All files for `tag` saved; finalize (reference commit)."""
+        return True
+
+    def makedirs(self, path: str, exist_ok: bool = True) -> None:
+        import os
+
+        os.makedirs(path, exist_ok=exist_ok)

@@ -1,0 +1,153 @@
+"""Orbax-backed checkpointing (the default CheckpointEngine).
+
+Directory layout keeps the reference's shape (engine.py:2525-2592):
+
+    save_dir/
+      latest                  # text file with the newest tag (reference `latest`)
+      <tag>/
+        state/                # orbax sharded pytree: TrainState
+        client_state.json     # engine counters + user client_state
+        ds_config.json        # config snapshot for tag validation
+
+Because orbax stores *global* (logically unsharded) arrays with per-shard
+layout metadata, a checkpoint written on one (tp,pp,dp) layout restores onto
+any other — the reference needed a whole subsystem for this (universal
+checkpoint, ``deepspeed/checkpoint/``, reshape tools); here resharding is the
+restore path itself: we restore against abstract arrays carrying the *current*
+mesh's shardings.  ZeRO-3's "consolidated fp16 save" (engine.py:3287) is
+``save_16bit_model`` below: a gather-free orbax save of the compute params.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from .checkpoint_engine import CheckpointEngine
+from ...utils.logging import logger, log_dist
+
+
+def _ocp():
+    import orbax.checkpoint as ocp
+
+    return ocp
+
+
+class OrbaxCheckpointEngine(CheckpointEngine):
+    def save(self, state_dict: Any, path: str) -> None:
+        ocp = _ocp()
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(os.path.abspath(path), state_dict, force=True)
+
+    def load(self, path: str, target: Any = None, shardings: Any = None) -> Any:
+        ocp = _ocp()
+        path = os.path.abspath(path)
+        with ocp.StandardCheckpointer() as ckptr:
+            if target is not None:
+                abstract = jax.tree_util.tree_map(
+                    lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s)
+                    if hasattr(x, "shape") else x,
+                    target, shardings) if shardings is not None else jax.tree_util.tree_map(
+                        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(
+                            x, "sharding", None)) if hasattr(x, "shape") else x, target)
+                return ckptr.restore(path, abstract)
+            return ckptr.restore(path)
+
+
+LATEST_FILE = "latest"
+
+
+def _read_latest(save_dir: str) -> Optional[str]:
+    p = os.path.join(save_dir, LATEST_FILE)
+    if os.path.exists(p):
+        with open(p) as f:
+            return f.read().strip()
+    return None
+
+
+def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
+                           client_state: Optional[Dict] = None, save_latest: bool = True):
+    tag = tag or f"global_step{engine.global_steps}"
+    ckpt_dir = os.path.join(save_dir, str(tag))
+    os.makedirs(ckpt_dir, exist_ok=True)
+
+    ce = OrbaxCheckpointEngine()
+    ce.save(engine.state, os.path.join(ckpt_dir, "state"))
+
+    meta = {
+        "global_steps": engine.global_steps,
+        "skipped_steps": engine.skipped_steps,
+        "micro_steps": engine.micro_steps,
+        "param_count": engine.param_count,
+        "zero_stage": engine.zero_stage,
+        "mesh_shape": {k: int(v) for k, v in dict(engine.mesh.shape).items()},
+        "client_state": client_state or {},
+    }
+    if jax.process_index() == 0:
+        with open(os.path.join(ckpt_dir, "client_state.json"), "w") as f:
+            json.dump(meta, f, indent=2)
+        with open(os.path.join(ckpt_dir, "ds_config.json"), "w") as f:
+            json.dump(engine.config.to_dict(), f, indent=2, default=str)
+        if save_latest:
+            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+                f.write(str(tag))
+    log_dist(f"saved checkpoint {tag} -> {ckpt_dir}", ranks=[0])
+    return ckpt_dir
+
+
+def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
+                           load_optimizer_states: bool = True, load_module_only: bool = False):
+    tag = tag or _read_latest(load_dir)
+    if tag is None:
+        logger.warning(f"no `latest` file in {load_dir}; nothing loaded")
+        return None, {}
+    ckpt_dir = os.path.join(load_dir, str(tag))
+    if not os.path.isdir(ckpt_dir):
+        raise FileNotFoundError(f"checkpoint tag dir not found: {ckpt_dir}")
+
+    ce = OrbaxCheckpointEngine()
+    # Restore against the CURRENT state's shardings — this IS cross-topology
+    # resharding (saved on any mesh layout, restored onto this one).
+    restored = ce.load(os.path.join(ckpt_dir, "state"), target=engine.state)
+    if load_module_only or not load_optimizer_states:
+        restored = dataclasses_replace_state(engine.state, restored,
+                                             module_only=load_module_only,
+                                             opt=load_optimizer_states)
+    engine.state = restored
+
+    meta = {}
+    meta_path = os.path.join(ckpt_dir, "client_state.json")
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+        engine.global_steps = meta.get("global_steps", engine.global_steps)
+        engine.skipped_steps = meta.get("skipped_steps", engine.skipped_steps)
+        engine.micro_steps = meta.get("micro_steps", engine.micro_steps)
+    log_dist(f"loaded checkpoint {tag} from {ckpt_dir}", ranks=[0])
+    return ckpt_dir, meta.get("client_state", {})
+
+
+def dataclasses_replace_state(current, restored, module_only: bool, opt: bool):
+    """Keep current opt state / counters when only the module is wanted."""
+    import dataclasses
+
+    kw = {}
+    if module_only:
+        kw = dict(opt_state=current.opt_state, scaler=current.scaler, step=current.step,
+                  rng=current.rng)
+    elif not opt:
+        kw = dict(opt_state=current.opt_state)
+    return dataclasses.replace(restored, **kw)
+
+
+def save_16bit_model(engine, save_dir: str, filename: str = "pytree_model"):
+    """Consolidated compute-precision weights only (reference save_16bit_model,
+    engine.py:3354)."""
+    os.makedirs(save_dir, exist_ok=True)
+    ce = OrbaxCheckpointEngine()
+    path = os.path.join(save_dir, filename)
+    ce.save(engine.state.params, path)
+    return path

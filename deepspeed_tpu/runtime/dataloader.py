@@ -1,0 +1,95 @@
+"""Data loaders (reference ``runtime/dataloader.py``: DeepSpeedDataLoader,
+RepeatingLoader).
+
+Works over anything indexable (numpy arrays, torch datasets, lists of pytrees)
+or any iterable of batches.  Yields *global* micro-batches shaped
+``[micro_batch × dp_world, ...]`` as numpy; the engine shards them onto the
+mesh (jax.make_array_from_process_local_data on multihost).
+"""
+from __future__ import annotations
+
+from typing import Any, Iterator, Optional
+
+import numpy as np
+
+import jax
+
+
+class RepeatingLoader:
+    """Wrap an iterator to restart on StopIteration (reference dataloader.py)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)
+
+
+class DeepSpeedDataLoader:
+    """Batches an indexable dataset into [batch_size, ...] numpy pytrees.
+
+    Data-parallel sharding happens at the array level (each host materializes
+    its slice; the engine builds the global array), so there is no
+    DistributedSampler analogue — the batch IS global.
+    """
+
+    def __init__(self, dataset: Any, batch_size: int, mesh=None, shuffle: bool = True,
+                 seed: int = 0, drop_last: bool = True, collate_fn=None,
+                 data_sampler=None):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.mesh = mesh
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn
+        self.data_sampler = data_sampler
+        self.epoch = 0
+        self._len = None
+
+    def __len__(self):
+        if self._len is None:
+            n = len(self.dataset)
+            self._len = n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+        return self._len
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def _indices(self) -> np.ndarray:
+        n = len(self.dataset)
+        if self.data_sampler is not None:
+            return np.asarray(list(iter(self.data_sampler)))
+        idx = np.arange(n)
+        if self.shuffle:
+            np.random.RandomState(self.seed + self.epoch).shuffle(idx)
+        return idx
+
+    def _collate(self, items):
+        if self.collate_fn is not None:
+            return self.collate_fn(items)
+        first = items[0]
+        if isinstance(first, dict):
+            return {k: np.stack([np.asarray(it[k]) for it in items]) for k in first}
+        if isinstance(first, (tuple, list)):
+            return tuple(np.stack([np.asarray(it[j]) for it in items])
+                         for j in range(len(first)))
+        return np.stack([np.asarray(it) for it in items])
+
+    def __iter__(self) -> Iterator:
+        idx = self._indices()
+        nb = len(self)
+        for b in range(nb):
+            sel = idx[b * self.batch_size:(b + 1) * self.batch_size]
+            if len(sel) < self.batch_size and self.drop_last:
+                return
+            yield self._collate([self.dataset[int(i)] for i in sel])
+        self.epoch += 1

@@ -1,0 +1,113 @@
+"""Loss scaling (reference ``runtime/fp16/loss_scaler.py``: LossScaler /
+DynamicLossScaler).
+
+Functional re-design: scaler state is a small pytree carried in the TrainState
+and updated *inside* the jitted step with ``lax`` control flow — the reference's
+"check overflow → skip step → halve scale" becomes a ``jnp.where`` select on
+the updated vs. previous params (SURVEY §7 "dynamic loss scaling / overflow
+skip inside jit").
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    loss_scale: jnp.ndarray        # f32 scalar
+    good_steps: jnp.ndarray        # i32: consecutive overflow-free steps
+    hysteresis: jnp.ndarray        # i32: remaining tolerated overflows
+    # static config packed as arrays so the state stays a pytree of leaves
+    scale_window: jnp.ndarray      # i32
+    min_scale: jnp.ndarray         # f32
+    scale_factor: jnp.ndarray      # f32
+    init_hysteresis: jnp.ndarray   # i32
+    dynamic: jnp.ndarray           # bool
+
+
+def static_loss_scale_state(loss_scale: float) -> LossScaleState:
+    """Fixed scale (reference LossScaler)."""
+    return LossScaleState(
+        loss_scale=jnp.float32(loss_scale),
+        good_steps=jnp.int32(0),
+        hysteresis=jnp.int32(1),
+        scale_window=jnp.int32(1),
+        min_scale=jnp.float32(loss_scale),
+        scale_factor=jnp.float32(1.0),
+        init_hysteresis=jnp.int32(1),
+        dynamic=jnp.bool_(False),
+    )
+
+
+def dynamic_loss_scale_state(initial_scale_power: int = 16, loss_scale_window: int = 1000,
+                             min_loss_scale: float = 1.0, hysteresis: int = 2,
+                             scale_factor: float = 2.0) -> LossScaleState:
+    """Reference DynamicLossScaler defaults (loss_scaler.py)."""
+    return LossScaleState(
+        loss_scale=jnp.float32(2.0 ** initial_scale_power),
+        good_steps=jnp.int32(0),
+        hysteresis=jnp.int32(hysteresis),
+        scale_window=jnp.int32(loss_scale_window),
+        min_scale=jnp.float32(min_loss_scale),
+        scale_factor=jnp.float32(scale_factor),
+        init_hysteresis=jnp.int32(hysteresis),
+        dynamic=jnp.bool_(True),
+    )
+
+
+def no_loss_scale_state() -> LossScaleState:
+    return static_loss_scale_state(1.0)
+
+
+def scale_loss(loss, state: LossScaleState):
+    return loss * state.loss_scale.astype(loss.dtype)
+
+
+def unscale_grads(grads, state: LossScaleState):
+    inv = (1.0 / state.loss_scale).astype(jnp.float32)
+    return jax.tree_util.tree_map(lambda g: g.astype(jnp.float32) * inv, grads)
+
+
+def grads_finite(grads) -> jnp.ndarray:
+    """Global all-finite check (the reference's has_overflow, inverted).
+
+    Computed on already (or to-be) reduced grads; under pjit the reduction is
+    global so every shard agrees — the reference's cross-rank overflow
+    allreduce (stage_1_and_2.py ``has_overflow``) comes for free.
+    """
+    leaves = jax.tree_util.tree_leaves(grads)
+    if not leaves:
+        return jnp.bool_(True)
+    finite = [jnp.all(jnp.isfinite(leaf)) for leaf in leaves]
+    return jnp.stack(finite).all()
+
+
+def update_scale(state: LossScaleState, is_finite) -> LossScaleState:
+    """Post-step scale update (reference DynamicLossScaler.update_scale):
+
+    - overflow: consume hysteresis; once exhausted, scale /= factor (>= min),
+      reset the good-step counter
+    - no overflow for `scale_window` consecutive steps: scale *= factor,
+      reset counter and hysteresis
+    """
+    def on_finite(s: LossScaleState) -> LossScaleState:
+        good = s.good_steps + 1
+        grow = (good % s.scale_window) == 0
+        new_scale = jnp.where(grow, s.loss_scale * s.scale_factor, s.loss_scale)
+        return s._replace(loss_scale=new_scale, good_steps=good,
+                          hysteresis=jnp.where(grow, s.init_hysteresis, s.hysteresis))
+
+    def on_overflow(s: LossScaleState) -> LossScaleState:
+        hys = s.hysteresis - 1
+        drop = hys <= 0
+        new_scale = jnp.where(drop, jnp.maximum(s.loss_scale / s.scale_factor, s.min_scale),
+                              s.loss_scale)
+        return s._replace(loss_scale=new_scale, good_steps=jnp.int32(0),
+                          hysteresis=jnp.where(drop, s.init_hysteresis, hys))
+
+    updated = jax.lax.cond(jnp.asarray(is_finite), on_finite, on_overflow, state)
+    # static scaler: state never changes
+    return jax.tree_util.tree_map(
+        lambda new, old: jnp.where(state.dynamic, new, old), updated, state)

@@ -110,6 +110,7 @@ class ThroughputTimer:
         self.global_step_count = 0
         self.total_elapsed_time = 0.0
         self.step_elapsed_time = 0.0
+        self._window_steps = 0
         self._start = 0.0
         self.started = False
 
@@ -129,6 +130,7 @@ class ThroughputTimer:
         if self.global_step_count > self.start_step:
             self.total_elapsed_time += duration
             self.step_elapsed_time += duration
+            self._window_steps += 1
             if report_speed and self.global_step_count % self.steps_per_output == 0:
                 self.logging(
                     f"step={self.global_step_count}, "
@@ -136,12 +138,12 @@ class ThroughputTimer:
                     f"samples/sec (window): {self._window_samples_per_sec():.2f}"
                 )
                 self.step_elapsed_time = 0.0
+                self._window_steps = 0
 
     def _window_samples_per_sec(self) -> float:
-        steps = self.steps_per_output
-        if self.step_elapsed_time == 0.0:
+        if self.step_elapsed_time == 0.0 or self._window_steps == 0:
             return 0.0
-        return steps * self.batch_size / self.step_elapsed_time
+        return self._window_steps * self.batch_size / self.step_elapsed_time
 
     def avg_samples_per_sec(self) -> float:
         effective = self.global_step_count - self.start_step

@@ -1,0 +1,99 @@
+"""Tiny test models (analogue of reference tests/unit/simple_model.py:18-244).
+
+Pure-functional: each model is (init_fn, loss_fn, optional param_specs).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+class SimpleModel:
+    """MLP regression model: hidden -> hidden -> scalar head; MSE loss."""
+
+    def __init__(self, hidden_dim: int = 16, nlayers: int = 2, empty_grad: bool = False):
+        self.hidden_dim = hidden_dim
+        self.nlayers = nlayers
+        self.empty_grad = empty_grad
+
+    def init_fn(self, rng):
+        keys = jax.random.split(rng, self.nlayers + 1)
+        params = {}
+        for i in range(self.nlayers):
+            params[f"linear_{i}"] = {
+                "kernel": jax.random.normal(keys[i], (self.hidden_dim, self.hidden_dim),
+                                            jnp.float32) * 0.1,
+                "bias": jnp.zeros((self.hidden_dim,), jnp.float32),
+            }
+        params["head"] = {
+            "kernel": jax.random.normal(keys[-1], (self.hidden_dim, 1), jnp.float32) * 0.1,
+        }
+        if self.empty_grad:
+            params["unused"] = {"kernel": jnp.zeros((self.hidden_dim, self.hidden_dim))}
+        return params
+
+    def apply(self, params, x):
+        h = x
+        for i in range(self.nlayers):
+            layer = params[f"linear_{i}"]
+            h = jnp.tanh(h @ layer["kernel"] + layer["bias"])
+        return (h @ params["head"]["kernel"]).squeeze(-1)
+
+    def loss_fn(self, params, batch, rng):
+        x, y = batch["x"], batch["y"]
+        pred = self.apply(params, x)
+        loss = jnp.mean((pred - y.astype(pred.dtype)) ** 2)
+        return loss.astype(jnp.float32)
+
+
+class SimpleTPModel(SimpleModel):
+    """Same MLP with tensor-parallel specs over the 'model' axis
+    (column-parallel even layers, row-parallel odd layers)."""
+
+    @property
+    def param_specs(self):
+        specs = {}
+        for i in range(self.nlayers):
+            if i % 2 == 0:
+                specs[f"linear_{i}"] = {"kernel": P(None, "model"), "bias": P("model")}
+            else:
+                specs[f"linear_{i}"] = {"kernel": P("model", None), "bias": P()}
+        specs["head"] = {"kernel": P()}
+        return specs
+
+
+def random_dataset(n: int, hidden_dim: int, seed: int = 0):
+    rs = np.random.RandomState(seed)
+    xs = rs.randn(n, hidden_dim).astype(np.float32)
+    w = rs.randn(hidden_dim).astype(np.float32)
+    ys = xs @ w * 0.1
+    return [{"x": xs[i], "y": ys[i]} for i in range(n)]
+
+
+def random_batch(batch_size: int, hidden_dim: int, seed: int = 0) -> Dict[str, np.ndarray]:
+    x = np.random.RandomState(seed).randn(batch_size, hidden_dim).astype(np.float32)
+    # fixed teacher weights so successive batches share one target function
+    w = np.random.RandomState(1234).randn(hidden_dim).astype(np.float32)
+    return {"x": x, "y": (x @ w * 0.1).astype(np.float32)}
+
+
+def make_config(batch_size=16, micro=None, gas=None, stage=0, precision=None, **extra):
+    cfg = {"train_batch_size": batch_size,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+           "zero_optimization": {"stage": stage},
+           "steps_per_print": 100}
+    if micro is not None:
+        cfg["train_micro_batch_size_per_gpu"] = micro
+    if gas is not None:
+        cfg["gradient_accumulation_steps"] = gas
+    if precision == "bf16":
+        cfg["bf16"] = {"enabled": True}
+    elif precision == "fp16":
+        cfg["fp16"] = {"enabled": True}
+    cfg.update(extra)
+    return cfg

@@ -1,0 +1,108 @@
+"""Checkpoint tests — analogue of reference tests/unit/checkpoint/* (save/load,
+latest-tag, cross-stage/topology restore)."""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import deepspeed_tpu
+
+from .simple_model import SimpleModel, random_batch, make_config
+
+HID = 16
+
+
+def _engine(stage=0, precision=None, tp=1):
+    cfg = make_config(batch_size=16, stage=stage, precision=precision)
+    if tp > 1:
+        cfg["mesh"] = {"tp": tp}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=SimpleModel(HID), config=cfg)
+    return engine
+
+
+def _params_flat(engine):
+    return np.concatenate([np.asarray(l).ravel()
+                           for l in jax.tree_util.tree_leaves(engine.state.params)])
+
+
+@pytest.mark.parametrize("stage", [0, 2])
+def test_save_load_roundtrip(tmp_path, stage):
+    e1 = _engine(stage=stage)
+    for s in range(3):
+        e1.train_batch(batch=random_batch(16, HID, seed=s))
+    e1.save_checkpoint(str(tmp_path))
+    assert (tmp_path / "latest").read_text() == "global_step3"
+
+    e2 = _engine(stage=stage)
+    e2.load_checkpoint(str(tmp_path))
+    np.testing.assert_array_equal(_params_flat(e1), _params_flat(e2))
+    assert e2.global_steps == 3
+    # training continues identically from the restore
+    l1 = float(e1.train_batch(batch=random_batch(16, HID, seed=99)))
+    l2 = float(e2.train_batch(batch=random_batch(16, HID, seed=99)))
+    np.testing.assert_allclose(l1, l2, rtol=1e-6)
+
+
+def test_load_respects_tag(tmp_path):
+    e = _engine()
+    e.train_batch(batch=random_batch(16, HID))
+    e.save_checkpoint(str(tmp_path), tag="A")
+    pa = _params_flat(e)
+    e.train_batch(batch=random_batch(16, HID, seed=5))
+    e.save_checkpoint(str(tmp_path), tag="B")
+
+    e2 = _engine()
+    e2.load_checkpoint(str(tmp_path), tag="A")
+    np.testing.assert_array_equal(_params_flat(e2), pa)
+    assert (tmp_path / "latest").read_text() == "B"
+
+
+def test_cross_stage_restore(tmp_path):
+    """A stage-0 checkpoint restores into a stage-3 engine (resharding on
+    restore — the universal-checkpoint capability)."""
+    e0 = _engine(stage=0)
+    e0.train_batch(batch=random_batch(16, HID))
+    e0.save_checkpoint(str(tmp_path))
+
+    e3 = _engine(stage=3)
+    e3.load_checkpoint(str(tmp_path))
+    np.testing.assert_allclose(_params_flat(e0), _params_flat(e3), rtol=1e-6)
+    # restored params carry stage-3 (sharded) placement
+    leaf = e3.state.params["linear_0"]["kernel"]
+    assert leaf.sharding.shard_shape(leaf.shape)[0] == leaf.shape[0] // 8
+
+
+def test_cross_topology_restore(tmp_path):
+    """dp8 checkpoint restores onto a tp2×dp4 mesh."""
+    e1 = _engine(stage=1)
+    e1.train_batch(batch=random_batch(16, HID))
+    e1.save_checkpoint(str(tmp_path))
+
+    e2 = _engine(stage=1, tp=2)
+    e2.load_checkpoint(str(tmp_path))
+    np.testing.assert_allclose(_params_flat(e1), _params_flat(e2), rtol=1e-6)
+
+
+def test_load_missing_dir_warns(tmp_path):
+    e = _engine()
+    path, client = e.load_checkpoint(str(tmp_path / "nope"))
+    assert path is None and client == {}
+
+
+def test_client_state_roundtrip(tmp_path):
+    e = _engine()
+    e.train_batch(batch=random_batch(16, HID))
+    e.save_checkpoint(str(tmp_path), client_state={"epoch": 7})
+    e2 = _engine()
+    _, client = e2.load_checkpoint(str(tmp_path))
+    assert client == {"epoch": 7}
+
+
+def test_save_16bit_model(tmp_path):
+    from deepspeed_tpu.runtime.checkpoint_engine.orbax_engine import save_16bit_model
+
+    e = _engine(stage=3, precision="bf16")
+    e.train_batch(batch=random_batch(16, HID))
+    path = save_16bit_model(e, str(tmp_path))
+    assert os.path.isdir(path)

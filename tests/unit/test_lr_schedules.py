@@ -1,0 +1,77 @@
+"""LR schedule tests — analogue of reference tests/unit/runtime/test_lr_schedulers.py."""
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (get_lr_scheduler, warmup_lr, warmup_decay_lr,
+                                                one_cycle, lr_range_test, cosine_annealing,
+                                                VALID_LR_SCHEDULES)
+
+
+def test_warmup_linear_ramp_and_hold():
+    s = warmup_lr(warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=10,
+                  warmup_type="linear")
+    assert float(s(0)) == pytest.approx(0.01)
+    assert float(s(9)) == pytest.approx(0.1)
+    assert float(s(100)) == pytest.approx(0.1)
+
+
+def test_warmup_log_monotone():
+    s = warmup_lr(warmup_min_lr=1e-5, warmup_max_lr=0.1, warmup_num_steps=100)
+    vals = [float(s(t)) for t in range(0, 120, 10)]
+    assert all(b >= a - 1e-9 for a, b in zip(vals, vals[1:]))
+    assert vals[-1] == pytest.approx(0.1)
+
+
+def test_warmup_decay_reaches_zero():
+    s = warmup_decay_lr(total_num_steps=100, warmup_max_lr=0.1, warmup_num_steps=10,
+                        warmup_type="linear")
+    assert float(s(10)) == pytest.approx(0.1, rel=1e-3)
+    assert float(s(55)) == pytest.approx(0.05, rel=1e-2)
+    assert float(s(100)) == pytest.approx(0.0, abs=1e-8)
+
+
+def test_one_cycle_triangle():
+    s = one_cycle(cycle_min_lr=0.01, cycle_max_lr=0.1, cycle_first_step_size=10)
+    assert float(s(0)) == pytest.approx(0.01)
+    assert float(s(10)) == pytest.approx(0.1)
+    assert float(s(20)) == pytest.approx(0.01, rel=1e-3)
+    assert float(s(100)) == pytest.approx(0.01)
+
+
+def test_one_cycle_decay_tail():
+    s = one_cycle(cycle_min_lr=0.01, cycle_max_lr=0.1, cycle_first_step_size=10,
+                  decay_step_size=10, decay_lr_rate=1.0)
+    assert float(s(30)) < 0.01
+
+
+def test_lr_range_test_growth():
+    s = lr_range_test(lr_range_test_min_lr=0.001, lr_range_test_step_size=10,
+                      lr_range_test_step_rate=1.0)
+    assert float(s(0)) == pytest.approx(0.001)
+    assert float(s(10)) == pytest.approx(0.002)
+    staircase = lr_range_test(lr_range_test_min_lr=0.001, lr_range_test_step_size=10,
+                              lr_range_test_step_rate=1.0, lr_range_test_staircase=True)
+    assert float(staircase(9)) == pytest.approx(0.001)
+
+
+def test_cosine_annealing_floor():
+    s = cosine_annealing(total_num_steps=100, warmup_num_steps=10, warmup_max_lr=0.1,
+                         cosine_min_ratio=0.1)
+    assert float(s(100)) == pytest.approx(0.01, rel=1e-3)
+
+
+def test_registry_and_unknown():
+    for name in VALID_LR_SCHEDULES:
+        params = {"total_num_steps": 100} if "Decay" in name or "Cosine" in name else {}
+        sched = get_lr_scheduler(name, params)
+        assert np.isfinite(float(sched(5)))
+    with pytest.raises(ValueError):
+        get_lr_scheduler("Nope")
+
+
+def test_schedules_jittable():
+    import jax
+
+    s = warmup_decay_lr(total_num_steps=100, warmup_max_lr=0.1, warmup_num_steps=10)
+    jitted = jax.jit(s)
+    np.testing.assert_allclose(float(jitted(50)), float(s(50)), rtol=1e-6)
