@@ -1,0 +1,129 @@
+"""Headline benchmark: training throughput (model TFLOPs/sec/chip).
+
+Trains a Llama-architecture model sized for a single chip (bf16, remat,
+ZeRO-1 plan) and reports model-FLOPs throughput.  ``vs_baseline`` compares
+against the reference's best published per-device training throughput
+(204.49 TFLOPs/GPU, ZeRO-3 GPT-175B on A100-80G —
+/root/reference/docs/_posts/2022-07-26-deepspeed-azure.md:97).
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_TFLOPS_PER_DEVICE = 204.49
+
+
+def model_flops_per_token(cfg) -> float:
+    """6N (fwd+bwd matmul) + attention 12*L*d*S (score+AV, fwd+bwd)."""
+    n = cfg.param_count
+    attn = 12 * cfg.num_layers * cfg.hidden_size
+    return 6.0 * n, attn  # attn term multiplied by seq_len at use site
+
+
+def run(model_name: str, micro_batch: int, seq_len: int, steps: int, warmup: int,
+        zero_stage: int):
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM
+
+    on_tpu = jax.devices()[0].platform not in ("cpu",)
+    if not on_tpu:
+        # CPU smoke mode: shrink so the bench always completes
+        model = CausalLM("tiny", max_seq_len=seq_len)
+        micro_batch = min(micro_batch, 2)
+        steps, warmup = min(steps, 3), min(warmup, 1)
+    else:
+        model = CausalLM(model_name, max_seq_len=seq_len)
+
+    config = {
+        "train_micro_batch_size_per_gpu": micro_batch,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+        "zero_optimization": {"stage": zero_stage},
+        "bf16": {"enabled": True},
+        "steps_per_print": 10 ** 9,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(
+        0, model.config.vocab_size,
+        (engine.train_batch_size, seq_len)).astype(np.int32)}
+
+    # float() forces a device sync AND surfaces async errors that
+    # block_until_ready can miss on the tunneled backend
+    for _ in range(warmup):
+        loss_val = float(engine.train_batch(batch=batch))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(batch=batch)
+    loss_val = float(loss)
+    dt = time.perf_counter() - t0
+
+    n_dev = jax.device_count()
+    tokens = engine.train_batch_size * seq_len * steps
+    tok_per_sec_chip = tokens / dt / n_dev
+    base, attn_coeff = model_flops_per_token(model.config)
+    flops_per_token = base + attn_coeff * seq_len
+    tflops = tok_per_sec_chip * flops_per_token / 1e12
+    return {
+        "metric": "llama-train-throughput",
+        "value": round(tflops, 2),
+        "unit": "model TFLOPs/sec/chip",
+        "vs_baseline": round(tflops / BASELINE_TFLOPS_PER_DEVICE, 4),
+        "detail": {
+            "model": model_name if on_tpu else "tiny(cpu-smoke)",
+            "params": model.param_count,
+            "tokens_per_sec_per_chip": round(tok_per_sec_chip, 1),
+            "seq_len": seq_len,
+            "micro_batch": micro_batch,
+            "zero_stage": zero_stage,
+            "devices": n_dev,
+            "platform": jax.devices()[0].platform,
+            "loss": loss_val,
+        },
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="llama-374m")
+    ap.add_argument("--micro_batch", type=int, default=8)
+    ap.add_argument("--seq_len", type=int, default=2048)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--zero_stage", type=int, default=1)
+    args = ap.parse_args()
+
+    attempts = [(args.micro_batch, args.steps), (args.micro_batch // 2, args.steps),
+                (max(args.micro_batch // 4, 1), args.steps)]
+    last_err = None
+    for mb, steps in attempts:
+        if mb < 1:
+            continue
+        try:
+            result = run(args.model, mb, args.seq_len, steps, args.warmup,
+                         args.zero_stage)
+            print(json.dumps(result))
+            return
+        except Exception as e:  # OOM → retry smaller
+            last_err = e
+            if "RESOURCE_EXHAUSTED" not in str(e) and "Out of memory" not in str(e):
+                break
+    print(json.dumps({"metric": "llama-train-throughput", "value": 0.0,
+                      "unit": "model TFLOPs/sec/chip", "vs_baseline": 0.0,
+                      "error": str(last_err)[:500]}))
+    sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

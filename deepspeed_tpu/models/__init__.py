@@ -1,0 +1,62 @@
+"""Model zoo + the engine's model contract adapter.
+
+The engine's model contract is functional: ``loss_fn(params, batch, rng)``,
+``init_fn(rng) -> params``, optional ``param_specs`` (TP/SP shardings).
+``CausalLM`` packages the transformer family behind that contract — it plays
+the role of the reference's model-wrapping (``DeepSpeedEngine(module=...)``,
+engine.py:181) without inheriting from a module class.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .transformer import (CONFIGS, TransformerConfig, cross_entropy_loss, forward,
+                          get_config, init_params, param_specs)
+
+__all__ = ["CausalLM", "TransformerConfig", "CONFIGS", "get_config", "forward",
+           "init_params", "param_specs", "cross_entropy_loss"]
+
+
+class CausalLM:
+    """Causal-LM adapter: batch = {'input_ids': [B,S]} (labels default to the
+    next-token shift) or {'input_ids', 'labels'[, 'positions']}."""
+
+    def __init__(self, config="tiny", attn_impl: str = "xla", **overrides):
+        self.config = get_config(config, **overrides)
+        self.attn_impl = attn_impl
+        self.param_specs = param_specs(self.config)
+
+    def init_fn(self, rng):
+        return init_params(self.config, rng)
+
+    def _split(self, batch):
+        if isinstance(batch, dict):
+            tokens = batch["input_ids"]
+            labels = batch.get("labels")
+            positions = batch.get("positions")
+        else:
+            tokens, labels, positions = batch, None, None
+        if labels is None:
+            labels = jnp.concatenate(
+                [tokens[:, 1:], jnp.full_like(tokens[:, :1], -100)], axis=1)
+        return tokens, labels, positions
+
+    def apply_fn(self, params, tokens, positions=None, rng=None, deterministic=True):
+        return forward(self.config, params, tokens, positions=positions, rng=rng,
+                       attn_impl=self.attn_impl, deterministic=deterministic)
+
+    def _loss(self, params, batch, rng, deterministic):
+        tokens, labels, positions = self._split(batch)
+        logits = self.apply_fn(params, tokens, positions=positions, rng=rng,
+                               deterministic=deterministic)
+        return cross_entropy_loss(logits, labels)
+
+    def loss_fn(self, params, batch, rng):
+        return self._loss(params, batch, rng, deterministic=False)
+
+    def eval_fn(self, params, batch, rng):
+        return self._loss(params, batch, rng, deterministic=True)
+
+    @property
+    def param_count(self) -> int:
+        return self.config.param_count

@@ -1,0 +1,415 @@
+"""TPU-native causal transformer family (the framework's flagship models).
+
+Covers the architectures the reference trains/serves through its injection
+policies (``module_inject/containers``: GPT-2, GPT-J/NeoX, Bloom, OPT, Llama,
+Megatron — ``replace_policy.py:21-27``) with ONE configurable pure-JAX model:
+
+  - norm: RMSNorm (llama/neox) or LayerNorm (gpt2/opt/bloom)
+  - position: rotary (llama/gptj/neox), learned (gpt2/opt), or alibi (bloom)
+  - mlp: SwiGLU (llama) or GELU (gpt2/opt/bloom)
+  - attention: MHA or grouped-query (GQA, llama-2-70B-style)
+
+Design is TPU-first, not a port:
+  - ``lax.scan`` over stacked per-layer params — one compiled block regardless
+    of depth (compile time O(1) in layers; the MXU sees identical fused steps).
+  - tensor parallelism is *declared*: ``param_specs()`` returns Megatron-style
+    PartitionSpecs over the 'model' mesh axis (column-parallel QKV/up, row-
+    parallel out/down) and GSPMD inserts the all-reduces the reference does by
+    hand in ``module_inject/layers.py`` (LinearAllreduce/LinearLayer).
+  - sequence parallelism: activations are sharding-constrained over the 'seq'
+    axis; attention contracts over the full sequence so XLA gathers K/V over
+    ICI (ring-attention Pallas kernel in ops/pallas upgrades this path).
+  - activation checkpointing via ``jax.checkpoint`` around the scanned block
+    (reference runtime/activation_checkpointing/checkpointing.py:474).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import BATCH_AXES, constrain_spec
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: Optional[int] = None        # None => MHA
+    head_dim: Optional[int] = None            # None => hidden // heads
+    max_seq_len: int = 2048
+    norm: str = "rmsnorm"                     # rmsnorm | layernorm
+    activation: str = "swiglu"                # swiglu | gelu
+    position: str = "rope"                    # rope | learned | alibi
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    attn_bias: bool = False
+    mlp_bias: bool = False
+    dropout: float = 0.0
+    remat: bool = True                        # activation checkpointing
+    remat_policy: str = "nothing_saveable"    # nothing_saveable | dots_saveable
+    scan_layers: bool = True
+    dtype: Any = jnp.bfloat16                 # compute dtype hint (engine casts)
+    initializer_range: float = 0.02
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def dims_per_head(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def param_count(self) -> int:
+        d, f, v, L = self.hidden_size, self.intermediate_size, self.vocab_size, self.num_layers
+        hd, nh, nkv = self.dims_per_head, self.num_heads, self.kv_heads
+        attn = d * hd * nh + 2 * d * hd * nkv + hd * nh * d
+        mlp = 3 * d * f if self.activation == "swiglu" else 2 * d * f
+        norms = 2 * d * (2 if self.norm == "layernorm" else 1)
+        embed = v * d * (1 if self.tie_embeddings else 2)
+        pos = self.max_seq_len * d if self.position == "learned" else 0
+        return L * (attn + mlp + norms) + embed + pos + d
+
+
+# -- named configs (sizes from the public model cards; used by bench + tests) --
+CONFIGS: Dict[str, TransformerConfig] = {
+    "gpt2-125m": TransformerConfig(
+        vocab_size=50257, hidden_size=768, intermediate_size=3072, num_layers=12,
+        num_heads=12, max_seq_len=1024, norm="layernorm", activation="gelu",
+        position="learned", tie_embeddings=True, attn_bias=True, mlp_bias=True,
+        norm_eps=1e-5),
+    "gpt2-1.3b": TransformerConfig(
+        vocab_size=50257, hidden_size=2048, intermediate_size=8192, num_layers=24,
+        num_heads=16, max_seq_len=1024, norm="layernorm", activation="gelu",
+        position="learned", tie_embeddings=True, attn_bias=True, mlp_bias=True),
+    "llama2-7b": TransformerConfig(
+        vocab_size=32000, hidden_size=4096, intermediate_size=11008, num_layers=32,
+        num_heads=32, max_seq_len=4096),
+    "llama2-13b": TransformerConfig(
+        vocab_size=32000, hidden_size=5120, intermediate_size=13824, num_layers=40,
+        num_heads=40, max_seq_len=4096),
+    "llama2-70b": TransformerConfig(
+        vocab_size=32000, hidden_size=8192, intermediate_size=28672, num_layers=80,
+        num_heads=64, num_kv_heads=8, max_seq_len=4096),
+    "bloom-7b": TransformerConfig(
+        vocab_size=250880, hidden_size=4096, intermediate_size=16384, num_layers=30,
+        num_heads=32, max_seq_len=2048, norm="layernorm", activation="gelu",
+        position="alibi", attn_bias=True, mlp_bias=True, tie_embeddings=True),
+    "opt-1.3b": TransformerConfig(
+        vocab_size=50272, hidden_size=2048, intermediate_size=8192, num_layers=24,
+        num_heads=32, max_seq_len=2048, norm="layernorm", activation="gelu",
+        position="learned", attn_bias=True, mlp_bias=True, tie_embeddings=True),
+    # single-v5e-chip bench model (llama architecture, fits bf16+fp32 Adam)
+    "llama-374m": TransformerConfig(
+        vocab_size=32000, hidden_size=1024, intermediate_size=2816, num_layers=24,
+        num_heads=16, max_seq_len=2048),
+    # tiny variants for tests / dryruns
+    "tiny": TransformerConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, max_seq_len=128, remat=False),
+    "tiny-gpt2": TransformerConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, max_seq_len=128, norm="layernorm", activation="gelu",
+        position="learned", tie_embeddings=True, attn_bias=True, mlp_bias=True,
+        remat=False),
+    "tiny-gqa": TransformerConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=8, num_kv_heads=2, max_seq_len=128, remat=False),
+}
+
+
+def get_config(name_or_cfg, **overrides) -> TransformerConfig:
+    cfg = CONFIGS[name_or_cfg] if isinstance(name_or_cfg, str) else name_or_cfg
+    return dataclasses.replace(cfg, **overrides) if overrides else cfg
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+# ---------------------------------------------------------------------------
+
+def init_params(cfg: TransformerConfig, rng: jax.Array) -> Dict[str, Any]:
+    """Initialize fp32 params. Layer params are stacked on a leading [L] dim
+    so the forward can lax.scan over them."""
+    d, f = cfg.hidden_size, cfg.intermediate_size
+    hd, nh, nkv, L = cfg.dims_per_head, cfg.num_heads, cfg.kv_heads, cfg.num_layers
+    std = cfg.initializer_range
+    keys = jax.random.split(rng, 16)
+
+    def dense(key, shape, scale=std):
+        return (jax.random.normal(key, shape, jnp.float32) * scale)
+
+    layers: Dict[str, Any] = {
+        "attn_norm_scale": jnp.ones((L, d)),
+        "wq": dense(keys[0], (L, d, nh * hd)),
+        "wk": dense(keys[1], (L, d, nkv * hd)),
+        "wv": dense(keys[2], (L, d, nkv * hd)),
+        # residual-path projections scaled down by sqrt(2L) (GPT-2 init)
+        "wo": dense(keys[3], (L, nh * hd, d), std / math.sqrt(2 * L)),
+        "mlp_norm_scale": jnp.ones((L, d)),
+    }
+    if cfg.norm == "layernorm":
+        layers["attn_norm_bias"] = jnp.zeros((L, d))
+        layers["mlp_norm_bias"] = jnp.zeros((L, d))
+    if cfg.activation == "swiglu":
+        layers["w_gate"] = dense(keys[4], (L, d, f))
+        layers["w_up"] = dense(keys[5], (L, d, f))
+        layers["w_down"] = dense(keys[6], (L, f, d), std / math.sqrt(2 * L))
+    else:
+        layers["w_in"] = dense(keys[4], (L, d, f))
+        layers["w_down"] = dense(keys[6], (L, f, d), std / math.sqrt(2 * L))
+    if cfg.attn_bias:
+        layers["bq"] = jnp.zeros((L, nh * hd))
+        layers["bk"] = jnp.zeros((L, nkv * hd))
+        layers["bv"] = jnp.zeros((L, nkv * hd))
+        layers["bo"] = jnp.zeros((L, d))
+    if cfg.mlp_bias:
+        if cfg.activation == "swiglu":
+            layers["b_gate"] = jnp.zeros((L, f))
+            layers["b_up"] = jnp.zeros((L, f))
+        else:
+            layers["b_in"] = jnp.zeros((L, f))
+        layers["b_down"] = jnp.zeros((L, d))
+
+    params: Dict[str, Any] = {
+        "embed": dense(keys[7], (cfg.vocab_size, d)),
+        "layers": layers,
+        "final_norm_scale": jnp.ones((d,)),
+    }
+    if cfg.norm == "layernorm":
+        params["final_norm_bias"] = jnp.zeros((d,))
+    if cfg.position == "learned":
+        params["pos_embed"] = dense(keys[8], (cfg.max_seq_len, d))
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense(keys[9], (d, cfg.vocab_size))
+    return params
+
+
+def param_specs(cfg: TransformerConfig) -> Dict[str, Any]:
+    """Megatron-style TP PartitionSpecs over the 'model' axis (reference
+    module_inject/layers.py LinearLayer/LinearAllreduce; auto_tp.py infers the
+    same split).  Column-parallel: QKV, gate/up.  Row-parallel: out, down.
+    The ZeRO planner composes ('data','expert') on top of these."""
+    col = P(None, None, "model")     # [L, d, f_shard]
+    row = P(None, "model", None)     # [L, f_shard, d]
+    rep = P(None, None)
+    layers: Dict[str, Any] = {
+        "attn_norm_scale": rep, "mlp_norm_scale": rep,
+        "wq": col, "wk": col, "wv": col, "wo": row,
+    }
+    if cfg.norm == "layernorm":
+        layers["attn_norm_bias"] = rep
+        layers["mlp_norm_bias"] = rep
+    if cfg.activation == "swiglu":
+        layers.update(w_gate=col, w_up=col, w_down=row)
+    else:
+        layers.update(w_in=col, w_down=row)
+    if cfg.attn_bias:
+        layers.update(bq=P(None, "model"), bk=P(None, "model"), bv=P(None, "model"),
+                      bo=P(None, None))
+    if cfg.mlp_bias:
+        if cfg.activation == "swiglu":
+            layers.update(b_gate=P(None, "model"), b_up=P(None, "model"))
+        else:
+            layers["b_in"] = P(None, "model")
+        layers["b_down"] = P(None, None)
+
+    specs: Dict[str, Any] = {
+        "embed": P("model", None),   # vocab-parallel embedding
+        "layers": layers,
+        "final_norm_scale": P(),
+    }
+    if cfg.norm == "layernorm":
+        specs["final_norm_bias"] = P()
+    if cfg.position == "learned":
+        specs["pos_embed"] = P(None, None)
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, "model")
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _norm(cfg, x, scale, bias=None):
+    x32 = x.astype(jnp.float32)
+    if cfg.norm == "rmsnorm":
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        out = x32 * jax.lax.rsqrt(var + cfg.norm_eps) * scale
+    else:
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.var(x32, axis=-1, keepdims=True)
+        out = (x32 - mean) * jax.lax.rsqrt(var + cfg.norm_eps) * scale + bias
+    return out.astype(x.dtype)
+
+
+def _rope(q, k, positions, theta, head_dim):
+    half = head_dim // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,half]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+
+    def rot(x):  # x: [B,S,H,hd]
+        x1, x2 = x[..., :half], x[..., half:]
+        c = cos[:, :, None, :].astype(x.dtype)
+        s = sin[:, :, None, :].astype(x.dtype)
+        return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+    return rot(q), rot(k)
+
+
+def _alibi_slopes(num_heads: int) -> np.ndarray:
+    # standard ALiBi slope schedule (power-of-2 geometric)
+    closest = 2 ** math.floor(math.log2(num_heads))
+    base = 2.0 ** (-8.0 / closest)
+    slopes = [base ** (i + 1) for i in range(closest)]
+    if closest < num_heads:
+        extra_base = 2.0 ** (-4.0 / closest)
+        slopes += [extra_base ** (2 * i + 1) for i in range(num_heads - closest)]
+    return np.asarray(slopes, dtype=np.float32)
+
+
+def _attention(cfg: TransformerConfig, q, k, v, positions, attn_impl: str = "xla"):
+    """q:[B,S,Hq,hd] k,v:[B,S,Hkv,hd] -> [B,S,Hq,hd], causal."""
+    B, S, Hq, hd = q.shape
+    Hkv = k.shape[2]
+    if Hkv != Hq:  # GQA: repeat KV groups
+        rep = Hq // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    if attn_impl == "pallas":
+        from ..ops.pallas.flash_attention import flash_attention
+
+        bias = None
+        if cfg.position == "alibi":
+            bias = _alibi_bias(cfg, positions, Hq, S, q.dtype)
+        return flash_attention(q, k, v, causal=True, bias=bias,
+                               sm_scale=1.0 / math.sqrt(hd))
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(hd)
+    scores = scores.astype(jnp.float32)
+    if cfg.position == "alibi":
+        scores = scores + _alibi_bias(cfg, positions, Hq, S, jnp.float32)
+    causal = positions[:, None, :, None] >= positions[:, None, None, :]
+    scores = jnp.where(causal, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _alibi_bias(cfg, positions, num_heads, S, dtype):
+    slopes = jnp.asarray(_alibi_slopes(num_heads))
+    rel = (positions[:, None, :] - positions[:, :, None]).astype(jnp.float32)  # [B,q,k]
+    return (-jnp.abs(rel)[:, None, :, :] * slopes[None, :, None, None]).astype(dtype)
+
+
+def _block(cfg: TransformerConfig, lp: Dict[str, Any], x, positions, rng,
+           attn_impl: str, deterministic: bool):
+    B, S, d = x.shape
+    hd, nh, nkv = cfg.dims_per_head, cfg.num_heads, cfg.kv_heads
+
+    h = _norm(cfg, x, lp["attn_norm_scale"], lp.get("attn_norm_bias"))
+    q = h @ lp["wq"]
+    k = h @ lp["wk"]
+    v = h @ lp["wv"]
+    if cfg.attn_bias:
+        q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+    q = q.reshape(B, S, nh, hd)
+    k = k.reshape(B, S, nkv, hd)
+    v = v.reshape(B, S, nkv, hd)
+    if cfg.position == "rope":
+        q, k = _rope(q, k, positions, cfg.rope_theta, hd)
+    attn = _attention(cfg, q, k, v, positions, attn_impl)
+    attn = attn.reshape(B, S, nh * hd) @ lp["wo"]
+    if cfg.attn_bias:
+        attn = attn + lp["bo"]
+    if cfg.dropout and not deterministic:
+        rng, sub = jax.random.split(rng)
+        attn = attn * jax.random.bernoulli(sub, 1 - cfg.dropout, attn.shape) / (1 - cfg.dropout)
+    x = x + attn
+
+    h = _norm(cfg, x, lp["mlp_norm_scale"], lp.get("mlp_norm_bias"))
+    if cfg.activation == "swiglu":
+        g = h @ lp["w_gate"]
+        u = h @ lp["w_up"]
+        if cfg.mlp_bias:
+            g, u = g + lp["b_gate"], u + lp["b_up"]
+        m = jax.nn.silu(g) * u
+    else:
+        m = h @ lp["w_in"]
+        if cfg.mlp_bias:
+            m = m + lp["b_in"]
+        m = jax.nn.gelu(m)
+    m = m @ lp["w_down"]
+    if cfg.mlp_bias:
+        m = m + lp["b_down"]
+    if cfg.dropout and not deterministic:
+        rng, sub = jax.random.split(rng)
+        m = m * jax.random.bernoulli(sub, 1 - cfg.dropout, m.shape) / (1 - cfg.dropout)
+    return x + m
+
+
+def forward(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
+            positions: Optional[jax.Array] = None, rng: Optional[jax.Array] = None,
+            attn_impl: str = "xla", deterministic: bool = True,
+            seq_sharded: bool = True) -> jax.Array:
+    """tokens [B, S] int32 -> logits [B, S, V]."""
+    B, S = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    if cfg.position == "learned":
+        x = x + params["pos_embed"].astype(cfg.dtype)[positions]
+    # activations: batch over DP axes, sequence over 'seq' axis
+    act_spec = P(BATCH_AXES, "seq" if seq_sharded else None, None)
+    x = constrain_spec(x, act_spec)
+
+    block = lambda lp, x, sub: _block(cfg, lp, x, positions, sub, attn_impl, deterministic)  # noqa: E731
+    if cfg.remat:
+        policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
+        block = jax.checkpoint(block, policy=policy)
+
+    if cfg.scan_layers:
+        def body(carry, lp):
+            x, r = carry
+            r, sub = jax.random.split(r)
+            x = block(lp, x, sub)
+            x = constrain_spec(x, act_spec)
+            return (x, r), None
+
+        (x, _), _ = jax.lax.scan(body, (x, rng), params["layers"])
+    else:
+        for i in range(cfg.num_layers):
+            lp = jax.tree_util.tree_map(lambda a: a[i], params["layers"])
+            rng, sub = jax.random.split(rng)
+            x = block(lp, x, sub)
+
+    x = _norm(cfg, x, params["final_norm_scale"], params.get("final_norm_bias"))
+    if cfg.tie_embeddings:
+        logits = x @ params["embed"].astype(cfg.dtype).T
+    else:
+        logits = x @ params["lm_head"].astype(cfg.dtype)
+    return logits
+
+
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array,
+                       ignore_index: int = -100) -> jax.Array:
+    """Mean next-token NLL; positions with ``labels == ignore_index`` masked."""
+    mask = (labels != ignore_index)
+    safe = jnp.where(mask, labels, 0)
+    logz = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    gold = jnp.take_along_axis(logits.astype(jnp.float32), safe[..., None],
+                               axis=-1)[..., 0]
+    nll = (logz - gold) * mask
+    return jnp.sum(nll) / jnp.maximum(jnp.sum(mask), 1)

@@ -158,6 +158,14 @@ def named(mesh: Mesh, spec: P) -> NamedSharding:
     return NamedSharding(mesh, spec)
 
 
+def constrain_spec(x, spec: P):
+    """``with_sharding_constraint`` against the global mesh; no-op when no
+    mesh has been initialized (single-device eager tests)."""
+    if _GLOBAL_MESH is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, named(_GLOBAL_MESH, spec))
+
+
 def axis_size(mesh: Mesh, axis) -> int:
     if isinstance(axis, (tuple, list)):
         return int(np.prod([mesh.shape[a] for a in axis]))
