@@ -104,8 +104,10 @@ def main():
     ap.add_argument("--zero_stage", type=int, default=1)
     args = ap.parse_args()
 
-    attempts = [(args.micro_batch, args.steps), (args.micro_batch // 2, args.steps),
-                (max(args.micro_batch // 4, 1), args.steps)]
+    attempts = list(dict.fromkeys(
+        (mb, args.steps)
+        for mb in (args.micro_batch, args.micro_batch // 2, args.micro_batch // 4)
+        if mb >= 1))
     last_err = None
     for mb, steps in attempts:
         if mb < 1:

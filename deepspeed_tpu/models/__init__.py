@@ -21,7 +21,7 @@ class CausalLM:
     """Causal-LM adapter: batch = {'input_ids': [B,S]} (labels default to the
     next-token shift) or {'input_ids', 'labels'[, 'positions']}."""
 
-    def __init__(self, config="tiny", attn_impl: str = "xla", **overrides):
+    def __init__(self, config="tiny", attn_impl: str = "auto", **overrides):
         self.config = get_config(config, **overrides)
         self.attn_impl = attn_impl
         self.param_specs = param_specs(self.config)

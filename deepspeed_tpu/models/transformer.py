@@ -25,6 +25,7 @@ Design is TPU-first, not a port:
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Any, Dict, Optional, Tuple
 
@@ -74,11 +75,16 @@ class TransformerConfig:
         d, f, v, L = self.hidden_size, self.intermediate_size, self.vocab_size, self.num_layers
         hd, nh, nkv = self.dims_per_head, self.num_heads, self.kv_heads
         attn = d * hd * nh + 2 * d * hd * nkv + hd * nh * d
+        if self.attn_bias:
+            attn += nh * hd + 2 * nkv * hd + d
         mlp = 3 * d * f if self.activation == "swiglu" else 2 * d * f
+        if self.mlp_bias:
+            mlp += (2 * f if self.activation == "swiglu" else f) + d
         norms = 2 * d * (2 if self.norm == "layernorm" else 1)
         embed = v * d * (1 if self.tie_embeddings else 2)
         pos = self.max_seq_len * d if self.position == "learned" else 0
-        return L * (attn + mlp + norms) + embed + pos + d
+        final_norm = d * (2 if self.norm == "layernorm" else 1)
+        return L * (attn + mlp + norms) + embed + pos + final_norm
 
 
 # -- named configs (sizes from the public model cards; used by bench + tests) --
@@ -279,22 +285,58 @@ def _alibi_slopes(num_heads: int) -> np.ndarray:
     return np.asarray(slopes, dtype=np.float32)
 
 
-def _attention(cfg: TransformerConfig, q, k, v, positions, attn_impl: str = "xla"):
+def _attention(cfg: TransformerConfig, q, k, v, positions, attn_impl: str = "xla",
+               custom_positions: bool = False):
     """q:[B,S,Hq,hd] k,v:[B,S,Hkv,hd] -> [B,S,Hq,hd], causal."""
     B, S, Hq, hd = q.shape
     Hkv = k.shape[2]
+    if attn_impl == "auto":
+        # flash kernel wins where XLA's materialized [S,S] scores hurt;
+        # below that the fused-einsum path is faster on-chip (measured v5e)
+        attn_impl = "pallas" if S >= 4096 else "xla"
+    # The flash kernel masks by row/col index, so it requires default
+    # positions; custom position ids (packed sequences) use the XLA path.
+    if attn_impl == "pallas" and cfg.position != "alibi" and not custom_positions:
+        from ..ops.pallas.flash_attention import flash_attention
+        from ..parallel import mesh as mesh_mod
+
+        sm = 1.0 / math.sqrt(hd)
+        m = mesh_mod._GLOBAL_MESH
+        sharded = m is not None and any(s > 1 for s in m.shape.values())
+        if not sharded:
+            if S % 128 == 0:
+                # GQA handled in-kernel (KV-head index map), no repeat
+                return flash_attention(q, k, v, causal=True, sm_scale=sm)
+        else:
+            # pallas_call has no SPMD partitioning rule — run it per-shard
+            # via shard_map: batch over DP axes, heads over 'model'.  Dense
+            # flash needs the full sequence per shard (ring attention covers
+            # the seq-sharded case); 'seq'/'pipe' meshes fall back to XLA.
+            tp = m.shape["model"]
+            dp = mesh_mod.axis_size(m, BATCH_AXES)
+            ok = (S % 128 == 0 and m.shape["seq"] == 1 and m.shape["pipe"] == 1
+                  and Hq % tp == 0 and Hkv % tp == 0 and B % dp == 0)
+            if ok:
+                import inspect
+
+                try:
+                    from jax import shard_map
+                except ImportError:  # older jax
+                    from jax.experimental.shard_map import shard_map
+                kw = ("check_vma"
+                      if "check_vma" in inspect.signature(shard_map).parameters
+                      else "check_rep")
+
+                spec = P(BATCH_AXES, None, "model", None)
+                fa = shard_map(
+                    functools.partial(flash_attention, causal=True, sm_scale=sm),
+                    mesh=m, in_specs=(spec, spec, spec), out_specs=spec,
+                    **{kw: False})
+                return fa(q, k, v)
     if Hkv != Hq:  # GQA: repeat KV groups
         rep = Hq // Hkv
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
-    if attn_impl == "pallas":
-        from ..ops.pallas.flash_attention import flash_attention
-
-        bias = None
-        if cfg.position == "alibi":
-            bias = _alibi_bias(cfg, positions, Hq, S, q.dtype)
-        return flash_attention(q, k, v, causal=True, bias=bias,
-                               sm_scale=1.0 / math.sqrt(hd))
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(hd)
     scores = scores.astype(jnp.float32)
     if cfg.position == "alibi":
@@ -312,7 +354,7 @@ def _alibi_bias(cfg, positions, num_heads, S, dtype):
 
 
 def _block(cfg: TransformerConfig, lp: Dict[str, Any], x, positions, rng,
-           attn_impl: str, deterministic: bool):
+           attn_impl: str, deterministic: bool, custom_positions: bool = False):
     B, S, d = x.shape
     hd, nh, nkv = cfg.dims_per_head, cfg.num_heads, cfg.kv_heads
 
@@ -327,7 +369,7 @@ def _block(cfg: TransformerConfig, lp: Dict[str, Any], x, positions, rng,
     v = v.reshape(B, S, nkv, hd)
     if cfg.position == "rope":
         q, k = _rope(q, k, positions, cfg.rope_theta, hd)
-    attn = _attention(cfg, q, k, v, positions, attn_impl)
+    attn = _attention(cfg, q, k, v, positions, attn_impl, custom_positions)
     attn = attn.reshape(B, S, nh * hd) @ lp["wo"]
     if cfg.attn_bias:
         attn = attn + lp["bo"]
@@ -363,6 +405,7 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
             seq_sharded: bool = True) -> jax.Array:
     """tokens [B, S] int32 -> logits [B, S, V]."""
     B, S = tokens.shape
+    custom_positions = positions is not None
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
     if rng is None:
@@ -375,7 +418,8 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
     act_spec = P(BATCH_AXES, "seq" if seq_sharded else None, None)
     x = constrain_spec(x, act_spec)
 
-    block = lambda lp, x, sub: _block(cfg, lp, x, positions, sub, attn_impl, deterministic)  # noqa: E731
+    block = lambda lp, x, sub: _block(cfg, lp, x, positions, sub, attn_impl,  # noqa: E731
+                                      deterministic, custom_positions)
     if cfg.remat:
         policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
         block = jax.checkpoint(block, policy=policy)

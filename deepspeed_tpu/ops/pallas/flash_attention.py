@@ -1,0 +1,343 @@
+"""Flash attention for TPU (Pallas, MXU-tiled, online softmax).
+
+The TPU-native replacement for the reference's fused attention kernels
+(csrc/transformer/softmax_kernels.cu, csrc/transformer/inference/csrc/
+softmax.cu "softmax_context") and the block-sparse path
+(deepspeed/ops/sparse_attention/): one kernel covers dense causal attention
+with O(S) memory; block-sparse patterns reduce to the same kernel with block
+skipping (causal is the special case the trainer uses).
+
+Layout: q [B, Hq, S, hd], k/v [B, Hkv, S, hd] (grouped-query: Hq % Hkv == 0 —
+the kernel indexes the KV head directly, no materialized repeat).
+Forward saves the log-sum-exp rows; backward runs two kernels (dq sweep over
+KV blocks; dkv sweep over Q blocks) with the standard delta = rowsum(dO*O).
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+NEG_INF = -1e30
+
+
+def _interpret_default() -> bool:
+    return jax.devices()[0].platform == "cpu"
+
+
+def _pick_block(S: int, want: int) -> int:
+    """Largest power-of-two block <= want that divides S.  Ragged final
+    blocks are unsupported (the dkv backward would fold undefined padded
+    q rows into dk/dv — padded rows pass the `rows >= cols` causal test)."""
+    b = min(want, S)
+    while b > 8 and S % b:
+        b //= 2
+    if S % b:
+        raise NotImplementedError(
+            f"sequence length {S} has no power-of-two block divisor >= 8; "
+            "use the XLA attention path")
+    return b
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
+                sm_scale: float, causal: bool, block_q: int, block_k: int,
+                num_k: int):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    should_run = True
+    if causal:
+        should_run = ki * block_k <= qi * block_q + block_q - 1
+
+    @pl.when(should_run)
+    def _body():
+        q, k, v = q_ref[:], k_ref[:], v_ref[:]    # native dtype into the MXU
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * sm_scale                              # [bq, bk] fp32
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]                         # [bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)     # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)               # [bq, 1]
+        p = jnp.exp(s - m_new)                        # [bq, bk]
+        l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == num_k - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[:] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse = m_ref[:, :1] + jnp.log(l_safe)
+        lse_ref[:] = lse[:, 0][None, :]
+
+
+def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    B, Hq, S, hd = q.shape
+    Hkv = k.shape[1]
+    group = Hq // Hkv
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    num_q, num_k = pl.cdiv(S, block_q), pl.cdiv(S, block_k)
+    grid = (B, Hq, num_q, num_k)
+
+    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                               block_q=block_q, block_k=block_k, num_k=num_k)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, None, block_q, hd), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((None, None, block_k, hd),
+                         lambda b, h, qi, ki: (b, h // group, ki, 0)),
+            pl.BlockSpec((None, None, block_k, hd),
+                         lambda b, h, qi, ki: (b, h // group, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, block_q, hd), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((None, None, 1, block_q),
+                         lambda b, h, qi, ki: (b, h, 0, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((B, Hq, 1, S), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, hd), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   acc_ref, *, sm_scale, causal, block_q, block_k, num_k):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    should_run = True
+    if causal:
+        should_run = ki * block_k <= qi * block_q + block_q - 1
+
+    @pl.when(should_run)
+    def _body():
+        q, k, v, do = q_ref[:], k_ref[:], v_ref[:], do_ref[:]
+        lse = lse_ref[0, :][:, None]               # [bq, 1]
+        delta = delta_ref[0, :][:, None]           # [bq, 1]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)                          # [bq, bk]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale              # [bq, bk]
+        acc_ref[:] += jax.lax.dot_general(ds.astype(k.dtype), k,
+                                          (((1,), (0,)), ((), ())),
+                                          preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_k - 1)
+    def _finish():
+        dq_ref[:] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, sm_scale, causal,
+                    block_q, block_k, num_q):
+    ki, qi = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    should_run = True
+    if causal:
+        should_run = qi * block_q + block_q - 1 >= ki * block_k
+
+    @pl.when(should_run)
+    def _body():
+        q, k, v, do = q_ref[:], k_ref[:], v_ref[:], do_ref[:]
+        lse = lse_ref[0, :][:, None]
+        delta = delta_ref[0, :][:, None]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)                          # [bq, bk]
+        dv_acc[:] += jax.lax.dot_general(p.astype(do.dtype), do,
+                                         (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dk_acc[:] += jax.lax.dot_general(ds.astype(q.dtype), q,
+                                         (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_q - 1)
+    def _finish():
+        dk_ref[:] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    do = g
+    B, Hq, S, hd = q.shape
+    Hkv = k.shape[1]
+    group = Hq // Hkv
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    num_q, num_k = pl.cdiv(S, block_q), pl.cdiv(S, block_k)
+
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)[:, :, None, :]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_k=num_k),
+        grid=(B, Hq, num_q, num_k),
+        in_specs=[
+            pl.BlockSpec((None, None, block_q, hd), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((None, None, block_k, hd),
+                         lambda b, h, qi, ki: (b, h // group, ki, 0)),
+            pl.BlockSpec((None, None, block_k, hd),
+                         lambda b, h, qi, ki: (b, h // group, ki, 0)),
+            pl.BlockSpec((None, None, block_q, hd), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((None, None, 1, block_q),
+                         lambda b, h, qi, ki: (b, h, 0, qi)),
+            pl.BlockSpec((None, None, 1, block_q),
+                         lambda b, h, qi, ki: (b, h, 0, qi)),
+        ],
+        out_specs=pl.BlockSpec((None, None, block_q, hd),
+                               lambda b, h, qi, ki: (b, h, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, hd), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # dk/dv are accumulated per (kv-head, kv-block) over every q head in the
+    # group and every q block: fold the group into the grid's head dimension.
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_q=num_q),
+        grid=(B, Hq, num_k, num_q),
+        in_specs=[
+            pl.BlockSpec((None, None, block_q, hd), lambda b, h, ki, qi: (b, h, qi, 0)),
+            pl.BlockSpec((None, None, block_k, hd),
+                         lambda b, h, ki, qi: (b, h // group, ki, 0)),
+            pl.BlockSpec((None, None, block_k, hd),
+                         lambda b, h, ki, qi: (b, h // group, ki, 0)),
+            pl.BlockSpec((None, None, block_q, hd), lambda b, h, ki, qi: (b, h, qi, 0)),
+            pl.BlockSpec((None, None, 1, block_q),
+                         lambda b, h, ki, qi: (b, h, 0, qi)),
+            pl.BlockSpec((None, None, 1, block_q),
+                         lambda b, h, ki, qi: (b, h, 0, qi)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, block_k, hd), lambda b, h, ki, qi: (b, h, ki, 0)),
+            pl.BlockSpec((None, None, block_k, hd), lambda b, h, ki, qi: (b, h, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Hq, S, hd), q.dtype),
+            jax.ShapeDtypeStruct((B, Hq, S, hd), q.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, hd), jnp.float32),
+            pltpu.VMEM((block_k, hd), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    if group > 1:  # fold q-head groups back onto the kv heads
+        dk = dk.reshape(B, Hkv, group, S, hd).sum(axis=2).astype(k.dtype)
+        dv = dv.reshape(B, Hkv, group, S, hd).sum(axis=2).astype(v.dtype)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+_flash.defvjp(_flash_fwd, _bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = None,
+                    bias=None, block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    interpret: Optional[bool] = None):
+    """q [B,S,Hq,hd], k/v [B,S,Hkv,hd] -> [B,S,Hq,hd].
+
+    bias is not fused (alibi models use the XLA path); causal is.
+    """
+    if bias is not None:
+        raise NotImplementedError("bias is handled by the XLA attention path")
+    S = q.shape[1]
+    block_q = _pick_block(S, block_q)
+    block_k = _pick_block(S, block_k)
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = _interpret_default()
+    # [B,S,H,hd] -> [B,H,S,hd]
+    qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    out = _flash(qt, kt, vt, sm_scale, causal, block_q, block_k, interpret)
+    return jnp.swapaxes(out, 1, 2)
