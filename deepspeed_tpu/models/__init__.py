@@ -47,9 +47,13 @@ class CausalLM:
 
     def _loss(self, params, batch, rng, deterministic):
         tokens, labels, positions = self._split(batch)
-        logits = self.apply_fn(params, tokens, positions=positions, rng=rng,
-                               deterministic=deterministic)
-        return cross_entropy_loss(logits, labels)
+        logits, aux = forward(self.config, params, tokens, positions=positions,
+                              rng=rng, attn_impl=self.attn_impl,
+                              deterministic=deterministic, return_aux=True)
+        loss = cross_entropy_loss(logits, labels)
+        if self.config.num_experts > 1:
+            loss = loss + self.config.moe_aux_loss_coef * aux["moe_aux_loss"]
+        return loss
 
     def loss_fn(self, params, batch, rng):
         return self._loss(params, batch, rng, deterministic=False)

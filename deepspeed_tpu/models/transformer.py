@@ -56,6 +56,15 @@ class TransformerConfig:
     attn_bias: bool = False
     mlp_bias: bool = False
     dropout: float = 0.0
+    # MoE (reference deepspeed/moe/): num_experts > 1 makes every block's MLP
+    # an expert-parallel MoE layer (scan-over-layers keeps blocks uniform)
+    num_experts: int = 1
+    moe_top_k: int = 2
+    capacity_factor: float = 1.25
+    eval_capacity_factor: float = 2.0
+    moe_min_capacity: int = 8
+    moe_aux_loss_coef: float = 0.01
+    noisy_gate_policy: Optional[str] = None
     remat: bool = True                        # activation checkpointing
     remat_policy: str = "nothing_saveable"    # nothing_saveable | dots_saveable
     scan_layers: bool = True
@@ -80,6 +89,8 @@ class TransformerConfig:
         mlp = 3 * d * f if self.activation == "swiglu" else 2 * d * f
         if self.mlp_bias:
             mlp += (2 * f if self.activation == "swiglu" else f) + d
+        if self.num_experts > 1:
+            mlp = mlp * self.num_experts + d * self.num_experts  # experts + router
         norms = 2 * d * (2 if self.norm == "layernorm" else 1)
         embed = v * d * (1 if self.tie_embeddings else 2)
         pos = self.max_seq_len * d if self.position == "learned" else 0
@@ -131,6 +142,9 @@ CONFIGS: Dict[str, TransformerConfig] = {
     "tiny-gqa": TransformerConfig(
         vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
         num_heads=8, num_kv_heads=2, max_seq_len=128, remat=False),
+    "tiny-moe": TransformerConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, max_seq_len=128, num_experts=4, moe_top_k=2, remat=False),
 }
 
 
@@ -166,13 +180,18 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> Dict[str, Any]:
     if cfg.norm == "layernorm":
         layers["attn_norm_bias"] = jnp.zeros((L, d))
         layers["mlp_norm_bias"] = jnp.zeros((L, d))
+    E = cfg.num_experts
+    mlp_shape = (lambda *s: (L, E) + s) if E > 1 else (lambda *s: (L,) + s)
+    if E > 1:
+        assert not cfg.mlp_bias, "MoE experts do not support mlp_bias"
+        layers["router"] = dense(keys[10], (L, d, E))
     if cfg.activation == "swiglu":
-        layers["w_gate"] = dense(keys[4], (L, d, f))
-        layers["w_up"] = dense(keys[5], (L, d, f))
-        layers["w_down"] = dense(keys[6], (L, f, d), std / math.sqrt(2 * L))
+        layers["w_gate"] = dense(keys[4], mlp_shape(d, f))
+        layers["w_up"] = dense(keys[5], mlp_shape(d, f))
+        layers["w_down"] = dense(keys[6], mlp_shape(f, d), std / math.sqrt(2 * L))
     else:
-        layers["w_in"] = dense(keys[4], (L, d, f))
-        layers["w_down"] = dense(keys[6], (L, f, d), std / math.sqrt(2 * L))
+        layers["w_in"] = dense(keys[4], mlp_shape(d, f))
+        layers["w_down"] = dense(keys[6], mlp_shape(f, d), std / math.sqrt(2 * L))
     if cfg.attn_bias:
         layers["bq"] = jnp.zeros((L, nh * hd))
         layers["bk"] = jnp.zeros((L, nkv * hd))
@@ -215,10 +234,18 @@ def param_specs(cfg: TransformerConfig) -> Dict[str, Any]:
     if cfg.norm == "layernorm":
         layers["attn_norm_bias"] = rep
         layers["mlp_norm_bias"] = rep
-    if cfg.activation == "swiglu":
-        layers.update(w_gate=col, w_up=col, w_down=row)
+    if cfg.num_experts > 1:
+        # experts over the 'expert' axis, expert-internal TP over 'model'
+        # (the reference's expert-parallel groups, utils/groups.py:113)
+        mcol = P(None, "expert", None, "model")   # [L, E, d, f_shard]
+        mrow = P(None, "expert", "model", None)   # [L, E, f_shard, d]
+        layers["router"] = P(None, None, None)
     else:
-        layers.update(w_in=col, w_down=row)
+        mcol, mrow = col, row
+    if cfg.activation == "swiglu":
+        layers.update(w_gate=mcol, w_up=mcol, w_down=mrow)
+    else:
+        layers.update(w_in=mcol, w_down=mrow)
     if cfg.attn_bias:
         layers.update(bq=P(None, "model"), bk=P(None, "model"), bv=P(None, "model"),
                       bo=P(None, None))
@@ -379,31 +406,45 @@ def _block(cfg: TransformerConfig, lp: Dict[str, Any], x, positions, rng,
     x = x + attn
 
     h = _norm(cfg, x, lp["mlp_norm_scale"], lp.get("mlp_norm_bias"))
-    if cfg.activation == "swiglu":
+    aux = jnp.float32(0.0)
+    if cfg.num_experts > 1:
+        from ..moe.sharded_moe import MoEConfig, moe_ffn
+
+        rng, sub = jax.random.split(rng)
+        m, aux = moe_ffn(
+            h, lp["router"], lp,
+            MoEConfig(num_experts=cfg.num_experts, top_k=cfg.moe_top_k,
+                      capacity_factor=cfg.capacity_factor,
+                      eval_capacity_factor=cfg.eval_capacity_factor,
+                      min_capacity=cfg.moe_min_capacity,
+                      noisy_gate_policy=cfg.noisy_gate_policy),
+            activation=cfg.activation, deterministic=deterministic, rng=sub)
+    elif cfg.activation == "swiglu":
         g = h @ lp["w_gate"]
         u = h @ lp["w_up"]
         if cfg.mlp_bias:
             g, u = g + lp["b_gate"], u + lp["b_up"]
         m = jax.nn.silu(g) * u
+        m = m @ lp["w_down"]
     else:
         m = h @ lp["w_in"]
         if cfg.mlp_bias:
             m = m + lp["b_in"]
         m = jax.nn.gelu(m)
-    m = m @ lp["w_down"]
-    if cfg.mlp_bias:
+        m = m @ lp["w_down"]
+    if cfg.num_experts == 1 and cfg.mlp_bias:
         m = m + lp["b_down"]
     if cfg.dropout and not deterministic:
         rng, sub = jax.random.split(rng)
         m = m * jax.random.bernoulli(sub, 1 - cfg.dropout, m.shape) / (1 - cfg.dropout)
-    return x + m
+    return x + m, aux
 
 
 def forward(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
             positions: Optional[jax.Array] = None, rng: Optional[jax.Array] = None,
             attn_impl: str = "xla", deterministic: bool = True,
-            seq_sharded: bool = True) -> jax.Array:
-    """tokens [B, S] int32 -> logits [B, S, V]."""
+            seq_sharded: bool = True, return_aux: bool = False):
+    """tokens [B, S] int32 -> logits [B, S, V] (+ aux dict if return_aux)."""
     B, S = tokens.shape
     custom_positions = positions is not None
     if positions is None:
@@ -424,26 +465,31 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
         policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
         block = jax.checkpoint(block, policy=policy)
 
+    aux_total = jnp.float32(0.0)
     if cfg.scan_layers:
         def body(carry, lp):
-            x, r = carry
+            x, r, aux_sum = carry
             r, sub = jax.random.split(r)
-            x = block(lp, x, sub)
+            x, aux = block(lp, x, sub)
             x = constrain_spec(x, act_spec)
-            return (x, r), None
+            return (x, r, aux_sum + aux), None
 
-        (x, _), _ = jax.lax.scan(body, (x, rng), params["layers"])
+        (x, _, aux_total), _ = jax.lax.scan(body, (x, rng, aux_total),
+                                            params["layers"])
     else:
         for i in range(cfg.num_layers):
             lp = jax.tree_util.tree_map(lambda a: a[i], params["layers"])
             rng, sub = jax.random.split(rng)
-            x = block(lp, x, sub)
+            x, aux = block(lp, x, sub)
+            aux_total = aux_total + aux
 
     x = _norm(cfg, x, params["final_norm_scale"], params.get("final_norm_bias"))
     if cfg.tie_embeddings:
         logits = x @ params["embed"].astype(cfg.dtype).T
     else:
         logits = x @ params["lm_head"].astype(cfg.dtype)
+    if return_aux:
+        return logits, {"moe_aux_loss": aux_total}
     return logits
 
 

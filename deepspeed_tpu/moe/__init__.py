@@ -1,0 +1,5 @@
+"""MoE / expert parallelism (reference ``deepspeed/moe/``)."""
+from .sharded_moe import MoEConfig, moe_ffn, top_k_gating
+from .layer import MoE
+
+__all__ = ["MoE", "MoEConfig", "moe_ffn", "top_k_gating"]

@@ -1,0 +1,63 @@
+"""Standalone MoE layer (reference ``deepspeed/moe/layer.py:16`` ``MoE``).
+
+The reference wraps a user ``expert`` nn.Module; here the layer is a
+functional bundle: ``init(rng)`` creates router+expert params with their
+expert-parallel specs, ``apply(params, x, ...)`` runs gate→dispatch→experts→
+combine and returns ``(out, aux_loss)`` like the reference's
+``MOELayer.forward`` (sharded_moe.py:472).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .sharded_moe import MoEConfig, moe_ffn
+
+
+class MoE:
+    def __init__(self, hidden_size: int, intermediate_size: Optional[int] = None,
+                 num_experts: int = 8, k: int = 2, capacity_factor: float = 1.25,
+                 eval_capacity_factor: float = 2.0, min_capacity: int = 8,
+                 noisy_gate_policy: Optional[str] = None, drop_tokens: bool = True,
+                 activation: str = "swiglu"):
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size or 4 * hidden_size
+        self.activation = activation
+        self.config = MoEConfig(num_experts=num_experts, top_k=k,
+                                capacity_factor=capacity_factor,
+                                eval_capacity_factor=eval_capacity_factor,
+                                min_capacity=min_capacity,
+                                noisy_gate_policy=noisy_gate_policy,
+                                drop_tokens=drop_tokens)
+
+    def init(self, rng: jax.Array, scale: float = 0.02) -> Dict[str, Any]:
+        d, f, E = self.hidden_size, self.intermediate_size, self.config.num_experts
+        ks = jax.random.split(rng, 4)
+        params = {"router": jax.random.normal(ks[0], (d, E)) * scale}
+        if self.activation == "swiglu":
+            params["w_gate"] = jax.random.normal(ks[1], (E, d, f)) * scale
+            params["w_up"] = jax.random.normal(ks[2], (E, d, f)) * scale
+        else:
+            params["w_in"] = jax.random.normal(ks[1], (E, d, f)) * scale
+        params["w_down"] = jax.random.normal(ks[3], (E, f, d)) * scale
+        return params
+
+    def param_specs(self) -> Dict[str, Any]:
+        col = P("expert", None, "model")
+        row = P("expert", "model", None)
+        specs = {"router": P(None, None), "w_down": row}
+        if self.activation == "swiglu":
+            specs.update(w_gate=col, w_up=col)
+        else:
+            specs["w_in"] = col
+        return specs
+
+    def apply(self, params: Dict[str, Any], x: jnp.ndarray,
+              deterministic: bool = True,
+              rng: Optional[jax.Array] = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        return moe_ffn(x, params["router"], params, self.config,
+                       activation=self.activation, deterministic=deterministic,
+                       rng=rng)
