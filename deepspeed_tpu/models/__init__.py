@@ -41,15 +41,16 @@ class CausalLM:
                 [tokens[:, 1:], jnp.full_like(tokens[:, :1], -100)], axis=1)
         return tokens, labels, positions
 
-    def apply_fn(self, params, tokens, positions=None, rng=None, deterministic=True):
+    def apply_fn(self, params, tokens, positions=None, rng=None,
+                 deterministic=True, return_aux=False):
         return forward(self.config, params, tokens, positions=positions, rng=rng,
-                       attn_impl=self.attn_impl, deterministic=deterministic)
+                       attn_impl=self.attn_impl, deterministic=deterministic,
+                       return_aux=return_aux)
 
     def _loss(self, params, batch, rng, deterministic):
         tokens, labels, positions = self._split(batch)
-        logits, aux = forward(self.config, params, tokens, positions=positions,
-                              rng=rng, attn_impl=self.attn_impl,
-                              deterministic=deterministic, return_aux=True)
+        logits, aux = self.apply_fn(params, tokens, positions=positions, rng=rng,
+                                    deterministic=deterministic, return_aux=True)
         loss = cross_entropy_loss(logits, labels)
         if self.config.num_experts > 1:
             loss = loss + self.config.moe_aux_loss_coef * aux["moe_aux_loss"]

@@ -65,6 +65,10 @@ class TransformerConfig:
     moe_min_capacity: int = 8
     moe_aux_loss_coef: float = 0.01
     noisy_gate_policy: Optional[str] = None
+    # pipeline parallelism: layers split into stages over the 'pipe' mesh
+    # axis; microbatches default to the engine's gradient_accumulation_steps
+    pipeline_stages: int = 1
+    pipeline_microbatches: Optional[int] = None
     remat: bool = True                        # activation checkpointing
     remat_policy: str = "nothing_saveable"    # nothing_saveable | dots_saveable
     scan_layers: bool = True
@@ -216,6 +220,13 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> Dict[str, Any]:
         params["pos_embed"] = dense(keys[8], (cfg.max_seq_len, d))
     if not cfg.tie_embeddings:
         params["lm_head"] = dense(keys[9], (d, cfg.vocab_size))
+    if cfg.pipeline_stages > 1:
+        from ..runtime.pipe.spmd import stage_layer_count
+
+        lp = stage_layer_count(L, cfg.pipeline_stages)
+        params["layers"] = jax.tree_util.tree_map(
+            lambda a: a.reshape((cfg.pipeline_stages, lp) + a.shape[1:]),
+            params["layers"])
     return params
 
 
@@ -255,6 +266,10 @@ def param_specs(cfg: TransformerConfig) -> Dict[str, Any]:
         else:
             layers["b_in"] = P(None, "model")
         layers["b_down"] = P(None, None)
+
+    if cfg.pipeline_stages > 1:
+        # stage dim rides the 'pipe' axis; each shard holds its stage's layers
+        layers = {k: P("pipe", *v) for k, v in layers.items()}
 
     specs: Dict[str, Any] = {
         "embed": P("model", None),   # vocab-parallel embedding
@@ -459,18 +474,43 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
     act_spec = P(BATCH_AXES, "seq" if seq_sharded else None, None)
     x = constrain_spec(x, act_spec)
 
-    block = lambda lp, x, sub: _block(cfg, lp, x, positions, sub, attn_impl,  # noqa: E731
-                                      deterministic, custom_positions)
+    block = lambda lp, x, sub, pos: _block(cfg, lp, x, pos, sub, attn_impl,  # noqa: E731
+                                           deterministic, custom_positions)
     if cfg.remat:
         policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
         block = jax.checkpoint(block, policy=policy)
 
     aux_total = jnp.float32(0.0)
-    if cfg.scan_layers:
+    if cfg.pipeline_stages > 1:
+        from ..runtime.pipe.spmd import pipeline_apply
+
+        assert not custom_positions, "pipeline path requires default positions"
+        M = cfg.pipeline_microbatches or cfg.pipeline_stages
+        assert B % M == 0, f"batch {B} not divisible by {M} pipeline microbatches"
+        mb = B // M
+        pos_mb = positions[:mb]
+        xm = x.reshape((M, mb) + x.shape[1:])
+
+        def stage_fn(lp_stage, xs, srng):
+            def body(carry, lp):
+                xc, r, aux = carry
+                r, sub = jax.random.split(r)
+                xc, a = block(lp, xc, sub, pos_mb)
+                return (xc, r, aux + a), None
+
+            (xs, _, aux), _ = jax.lax.scan(
+                body, (xs, srng, jnp.float32(0.0)), lp_stage)
+            return xs, aux
+
+        y, aux_sum = pipeline_apply(stage_fn, params["layers"], xm, rng)
+        x = y.reshape((B,) + y.shape[2:])
+        x = constrain_spec(x, act_spec)
+        aux_total = aux_sum / M      # mean over microbatches, sum over layers
+    elif cfg.scan_layers:
         def body(carry, lp):
             x, r, aux_sum = carry
             r, sub = jax.random.split(r)
-            x, aux = block(lp, x, sub)
+            x, aux = block(lp, x, sub, positions)
             x = constrain_spec(x, act_spec)
             return (x, r, aux_sum + aux), None
 
@@ -480,7 +520,7 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
         for i in range(cfg.num_layers):
             lp = jax.tree_util.tree_map(lambda a: a[i], params["layers"])
             rng, sub = jax.random.split(rng)
-            x, aux = block(lp, x, sub)
+            x, aux = block(lp, x, sub, positions)
             aux_total = aux_total + aux
 
     x = _norm(cfg, x, params["final_norm_scale"], params.get("final_norm_bias"))

@@ -114,6 +114,19 @@ class DeepSpeedEngine:
         self.micro_batch_size = self.config.train_micro_batch_size_per_gpu
         self.train_batch_size = self.config.train_batch_size
 
+        pp = self.mesh.shape.get("pipe", 1)
+        if pp > 1 and model is not None and hasattr(model, "config"):
+            mcfg = model.config
+            stages = getattr(mcfg, "pipeline_stages", 1)
+            if stages != pp:
+                raise ValueError(
+                    f"mesh has pipe={pp} but model.config.pipeline_stages={stages}")
+            micro = getattr(mcfg, "pipeline_microbatches", None) or stages
+            if micro != self.gas:
+                raise ValueError(
+                    f"pipeline microbatches ({micro}) must equal "
+                    f"gradient_accumulation_steps ({self.gas})")
+
         # -- lr schedule --
         if lr_scheduler is not None:
             self.lr_schedule = lr_scheduler
@@ -240,34 +253,56 @@ class DeepSpeedEngine:
         prescale = self.config.prescale_gradients
         predivide = self.config.gradient_predivide_factor
 
+        pipeline = self.mesh.shape.get("pipe", 1) > 1
+
         def train_step(state: TrainState, batch):
             masters = state.master_params if use_master else state.params
+
+            def grad_of_batch(m_tree, one_batch, sub):
+                """Scaled-loss grad for one loss_fn call (shared by the
+                microbatch scan and the pipeline whole-window path)."""
+
+                def scaled_loss(m):
+                    p = _cast_tree(m, compute_dtype) if use_master else m
+                    out = loss_fn(p, one_batch, sub)
+                    loss, _ = out if isinstance(out, tuple) else (out, {})
+                    return scale_loss(loss, state.scaler), loss
+
+                grads, loss = jax.grad(scaled_loss, has_aux=True)(m_tree)
+                if prescale:
+                    grads = jax.tree_util.tree_map(lambda g: g / predivide, grads)
+                return grads, loss
 
             def micro_step(carry, microbatch):
                 acc, rng = carry
                 rng, sub = jax.random.split(rng)
-
-                def scaled_loss(m):
-                    p = _cast_tree(m, compute_dtype) if use_master else m
-                    out = loss_fn(p, microbatch, sub)
-                    loss, aux = out if isinstance(out, tuple) else (out, {})
-                    return scale_loss(loss, state.scaler), loss
-
-                grads, loss = jax.grad(scaled_loss, has_aux=True)(masters)
-                if prescale:
-                    grads = jax.tree_util.tree_map(lambda g: g / predivide, grads)
+                grads, loss = grad_of_batch(masters, microbatch, sub)
                 acc = jax.tree_util.tree_map(
                     lambda a, g: a + g.astype(jnp.float32), acc, grads)
                 return (acc, rng), loss
 
-            zeros = jax.tree_util.tree_map(
-                lambda x: jnp.zeros(x.shape, jnp.float32), masters)
-            (grads, new_rng), losses = jax.lax.scan(
-                micro_step, (zeros, state.rng), batch, length=gas)
+            if pipeline:
+                # pipeline engines consume the whole gas window in ONE call:
+                # the model splits it into microbatches internally and the
+                # SPMD pipeline overlaps them across stages (reference
+                # PipelineEngine.train_batch, pipe/engine.py:286)
+                flat = jax.tree_util.tree_map(
+                    lambda x: x.reshape((-1,) + x.shape[2:]), batch)
+                new_rng, sub = jax.random.split(state.rng)
+                grads, losses = grad_of_batch(masters, flat, sub)
+                grads = jax.tree_util.tree_map(
+                    lambda g: g.astype(jnp.float32), grads)
+                eff_gas = 1  # loss already averages over the gas window
+            else:
+                zeros = jax.tree_util.tree_map(
+                    lambda x: jnp.zeros(x.shape, jnp.float32), masters)
+                (grads, new_rng), losses = jax.lax.scan(
+                    micro_step, (zeros, state.rng), batch, length=gas)
+                eff_gas = gas
             # ZeRO-2/3: land the accumulated grads sharded — XLA lowers the DP
             # reduction into reduce-scatter against this constraint
             grads = constrain(grads, grad_specs)
-            inv = 1.0 / (state.scaler.loss_scale * gas)
+            inv = 1.0 / (state.scaler.loss_scale * eff_gas)
             if prescale:
                 inv = inv * predivide
             grads = jax.tree_util.tree_map(lambda g: g * inv, grads)

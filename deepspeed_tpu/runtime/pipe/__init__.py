@@ -1,0 +1,17 @@
+"""Pipeline parallelism (reference ``runtime/pipe/`` + ``deepspeed/pipe``).
+
+Production path: the SPMD shifted-buffer executor (:mod:`spmd`), driven from
+``TransformerConfig.pipeline_stages`` or a :class:`PipelineModule`.
+"""
+from .spmd import pipeline_apply, stage_layer_count
+from .module import LayerSpec, PipelineModule, TiedLayerSpec, partition_balanced
+from .schedule import (InferenceSchedule, PipeSchedule, TrainSchedule,
+                       ForwardPass, BackwardPass, LoadMicroBatch, OptimizerStep,
+                       RecvActivation, RecvGrad, ReduceGrads, ReduceTiedGrads,
+                       SendActivation, SendGrad)
+
+__all__ = ["pipeline_apply", "stage_layer_count", "LayerSpec", "PipelineModule",
+           "TiedLayerSpec", "partition_balanced", "PipeSchedule", "TrainSchedule",
+           "InferenceSchedule", "ForwardPass", "BackwardPass", "LoadMicroBatch",
+           "OptimizerStep", "RecvActivation", "RecvGrad", "ReduceGrads",
+           "ReduceTiedGrads", "SendActivation", "SendGrad"]

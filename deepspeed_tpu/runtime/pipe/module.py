@@ -1,0 +1,120 @@
+"""PipelineModule / LayerSpec (reference ``runtime/pipe/module.py:85``).
+
+The reference lazily builds per-stage torch modules from ``LayerSpec`` lists
+and partitions layers across stages by parameter count or uniformly
+(module.py: "parameters"/"uniform" balancing).  The TPU analogue keeps the
+same authoring surface — a list of layer thunks + a partitioner — but the
+product is a *stacked-parameter pytree* plus stage boundaries for the SPMD
+executor (spmd.py), not live modules.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class LayerSpec:
+    """Deferred layer construction (reference module.py:29)."""
+
+    def __init__(self, typename: Callable, *args, **kwargs):
+        self.typename = typename
+        self.args = args
+        self.kwargs = kwargs
+
+    def build(self):
+        return self.typename(*self.args, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerSpec({getattr(self.typename, '__name__', self.typename)})"
+
+
+class TiedLayerSpec(LayerSpec):
+    """Layers sharing parameters across stages (reference module.py:76) —
+    e.g. tied input/output embeddings.  The SPMD build shares tied params by
+    construction (one leaf in the pytree), so `key` only groups specs."""
+
+    def __init__(self, key: str, typename: Callable, *args,
+                 forward_fn: Optional[Callable] = None, **kwargs):
+        super().__init__(typename, *args, **kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+
+
+def partition_balanced(weights: Sequence[float], num_parts: int) -> List[int]:
+    """Split ``weights`` into ``num_parts`` contiguous chunks minimizing the
+    heaviest chunk (the reference's ds_utils.partition_balanced).  Returns
+    part boundaries of length num_parts+1.  O(n^2 * p) DP — layer counts are
+    small."""
+    n = len(weights)
+    if num_parts > n:
+        raise ValueError(f"cannot split {n} layers into {num_parts} stages")
+    prefix = np.concatenate([[0.0], np.cumsum(weights)])
+
+    # dp[p][i] = minimal max-chunk-weight splitting first i items into p parts
+    INF = float("inf")
+    dp = np.full((num_parts + 1, n + 1), INF)
+    cut = np.zeros((num_parts + 1, n + 1), dtype=int)
+    dp[0][0] = 0.0
+    for p in range(1, num_parts + 1):
+        for i in range(p, n + 1):
+            for j in range(p - 1, i):
+                cost = max(dp[p - 1][j], prefix[i] - prefix[j])
+                if cost < dp[p][i]:
+                    dp[p][i] = cost
+                    cut[p][i] = j
+    bounds = [n]
+    for p in range(num_parts, 0, -1):
+        bounds.append(cut[p][bounds[-1]])
+    return list(reversed(bounds))
+
+
+class PipelineModule:
+    """Authoring surface for layer-list pipelines.
+
+    For the transformer family the engine path is ``TransformerConfig.
+    pipeline_stages`` (uniform stages over identical blocks — scan-friendly).
+    PipelineModule covers the reference's general case: heterogeneous layer
+    lists, balanced partitioning, tied weights.
+    """
+
+    def __init__(self, layers: Sequence[LayerSpec], num_stages: int,
+                 partition_method: str = "parameters",
+                 loss_fn: Optional[Callable] = None):
+        self.layer_specs = list(layers)
+        self.num_stages = num_stages
+        self.partition_method = partition_method
+        self.loss_fn = loss_fn
+        self.parts = self._partition()
+
+    def _layer_weights(self) -> List[float]:
+        if self.partition_method == "uniform":
+            return [1.0] * len(self.layer_specs)
+        if self.partition_method == "parameters":
+            weights = []
+            for spec in self.layer_specs:
+                built = spec.build() if isinstance(spec, LayerSpec) else spec
+                count = getattr(built, "param_count", None)
+                weights.append(float(count) if count is not None else 1.0)
+            return weights
+        raise ValueError(f"unknown partition_method {self.partition_method}")
+
+    def _partition(self) -> List[int]:
+        return partition_balanced(self._layer_weights(), self.num_stages)
+
+    def stage_layers(self, stage_id: int) -> List[LayerSpec]:
+        lo, hi = self.parts[stage_id], self.parts[stage_id + 1]
+        return self.layer_specs[lo:hi]
+
+    def stage_of_layer(self, layer_idx: int) -> int:
+        for s in range(self.num_stages):
+            if self.parts[s] <= layer_idx < self.parts[s + 1]:
+                return s
+        raise IndexError(layer_idx)
+
+    def tied_keys(self) -> Dict[str, List[int]]:
+        tied: Dict[str, List[int]] = {}
+        for i, spec in enumerate(self.layer_specs):
+            if isinstance(spec, TiedLayerSpec):
+                tied.setdefault(spec.key, []).append(i)
+        return tied

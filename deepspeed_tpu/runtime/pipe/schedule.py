@@ -1,0 +1,229 @@
+"""Pipeline schedules (reference ``runtime/pipe/schedule.py``).
+
+The reference drives each rank through these instruction streams at runtime
+(``PipelineEngine._exec_schedule``, pipe/engine.py:1293).  In the TPU build
+the production executor is the SPMD shifted-buffer scan (spmd.py) — XLA owns
+the overlap — so these classes serve as the *planning and analysis* layer:
+they enumerate exactly which (stage, microbatch, phase) work units run at
+each tick, power the scheduling tests, and document the 1F1B semantics the
+SPMD program realizes.  API parity: ``PipeSchedule`` (:11), ``TrainSchedule``
+(:189) with its step→microbatch mapping (:258-298), ``InferenceSchedule``
+(:135), instruction classes (:327-487).
+"""
+from __future__ import annotations
+
+from typing import Iterator, List
+
+
+class PipeInstruction:
+    def __init__(self, **kwargs):
+        self.name = self.__class__.__name__
+        self.kwargs = kwargs
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def __repr__(self):
+        if self.kwargs:
+            args = ", ".join(f"{k}={v}" for k, v in self.kwargs.items())
+            return f"{self.name}({args})"
+        return self.name
+
+    def __eq__(self, other):
+        return (type(self) is type(other)) and self.kwargs == other.kwargs
+
+    def __hash__(self):
+        return hash((type(self), tuple(sorted(self.kwargs.items()))))
+
+
+class OptimizerStep(PipeInstruction):
+    pass
+
+
+class ReduceGrads(PipeInstruction):
+    pass
+
+
+class ReduceTiedGrads(PipeInstruction):
+    pass
+
+
+class LoadMicroBatch(PipeInstruction):
+    pass  # kwargs: buffer_id
+
+
+class ForwardPass(PipeInstruction):
+    pass  # kwargs: buffer_id
+
+
+class BackwardPass(PipeInstruction):
+    pass  # kwargs: buffer_id
+
+
+class SendActivation(PipeInstruction):
+    pass  # kwargs: buffer_id
+
+
+class RecvActivation(PipeInstruction):
+    pass  # kwargs: buffer_id
+
+
+class SendGrad(PipeInstruction):
+    pass  # kwargs: buffer_id
+
+
+class RecvGrad(PipeInstruction):
+    pass  # kwargs: buffer_id
+
+
+class PipeSchedule:
+    """Enumerates the instruction stream for one (stage, #microbatch) pair."""
+
+    def __init__(self, micro_batches: int, stages: int, stage_id: int):
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+        self.prev_stage = stage_id - 1
+        self.next_stage = stage_id + 1
+
+    def steps(self) -> Iterator[List[PipeInstruction]]:
+        raise NotImplementedError
+
+    @property
+    def num_micro_batches(self) -> int:
+        return self.micro_batches
+
+    @property
+    def is_first_stage(self) -> bool:
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self) -> bool:
+        return self.stage_id == self.stages - 1
+
+    def _valid_micro_batch(self, micro_batch_id: int) -> bool:
+        return 0 <= micro_batch_id < self.micro_batches
+
+    def _valid_stage(self, stage_id: int) -> bool:
+        return 0 <= stage_id < self.stages
+
+    def num_pipe_buffers(self) -> int:
+        return self.micro_batches
+
+    def __iter__(self):
+        return iter(self.steps())
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only wavefront (reference :135)."""
+
+    def steps(self):
+        total_steps = self.micro_batches + self.stages - 1
+        for step_id in range(total_steps):
+            micro_batch_id = step_id - self.stage_id
+            cmds: List[PipeInstruction] = []
+            if self._valid_micro_batch(micro_batch_id):
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(buffer_id=self._buffer_idx(micro_batch_id)))
+                else:
+                    cmds.append(RecvActivation(buffer_id=self._buffer_idx(micro_batch_id)))
+                cmds.append(ForwardPass(buffer_id=self._buffer_idx(micro_batch_id)))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(buffer_id=self._buffer_idx(micro_batch_id)))
+            yield cmds
+
+    def num_pipe_buffers(self) -> int:
+        return 2
+
+    def _buffer_idx(self, micro_batch_id: int) -> int:
+        return micro_batch_id % self.num_pipe_buffers()
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B (reference :189): steady-state alternates one forward with one
+    backward; early steps fill, late steps drain.  Total 2*(M + S - 1) ticks;
+    peak activation stash = num_pipe_buffers() microbatches."""
+
+    def steps(self):
+        prev_micro_batch_id = -1
+        total_steps = 2 * (self.micro_batches + self.stages - 1)
+        for step_id in range(total_steps):
+            micro_batch_id, is_forward = self._step_to_micro_batch(step_id)
+            cmds: List[PipeInstruction] = []
+
+            # exchange activations/grads with neighbors
+            if self._valid_micro_batch(prev_micro_batch_id):
+                if is_forward:
+                    if self._valid_stage(self.prev_stage):
+                        cmds.append(SendGrad(buffer_id=self._buffer_idx(prev_micro_batch_id)))
+                else:
+                    if self._valid_stage(self.next_stage):
+                        cmds.append(SendActivation(buffer_id=self._buffer_idx(prev_micro_batch_id)))
+            if self._valid_micro_batch(micro_batch_id):
+                if is_forward:
+                    if self._valid_stage(self.prev_stage):
+                        cmds.append(RecvActivation(buffer_id=self._buffer_idx(micro_batch_id)))
+                    else:
+                        cmds.append(LoadMicroBatch(buffer_id=self._buffer_idx(micro_batch_id)))
+                    cmds.append(ForwardPass(buffer_id=self._buffer_idx(micro_batch_id)))
+                else:
+                    if self._valid_stage(self.next_stage):
+                        cmds.append(RecvGrad(buffer_id=self._buffer_idx(micro_batch_id)))
+                    cmds.append(BackwardPass(buffer_id=self._buffer_idx(micro_batch_id)))
+
+            # final tick: reduce + step
+            if step_id == total_steps - 1:
+                cmds.append(ReduceTiedGrads())
+                cmds.append(ReduceGrads())
+                cmds.append(OptimizerStep())
+
+            prev_micro_batch_id = micro_batch_id
+            yield cmds
+
+    def _step_to_micro_batch(self, step_id: int):
+        """Reference :258-298: even ticks run forwards, odd ticks backwards,
+        offset by the stage id."""
+        if _is_even(step_id) and _is_even(self.stage_id):
+            micro_batch_id = self._even_step_forward_id(step_id)
+            is_forward = True
+        elif _is_odd(step_id) and _is_odd(self.stage_id):
+            micro_batch_id = self._odd_step_forward_id(step_id)
+            is_forward = True
+        elif _is_even(step_id) and _is_odd(self.stage_id):
+            micro_batch_id = self._even_step_backward_id(step_id)
+            is_forward = False
+        else:
+            micro_batch_id = self._odd_step_backward_id(step_id)
+            is_forward = False
+        return micro_batch_id, is_forward
+
+    def _even_step_forward_id(self, step_id):
+        base = step_id // 2
+        return base - self.stage_id // 2
+
+    def _odd_step_forward_id(self, step_id):
+        base = (step_id - 1) // 2
+        return base - self.stage_id // 2
+
+    def _even_step_backward_id(self, step_id):
+        base = step_id // 2
+        return base - self.stages + (self.stage_id + 1) // 2
+
+    def _odd_step_backward_id(self, step_id):
+        base = ((step_id - 1) // 2) - self.stages + 1
+        return base + self.stage_id // 2
+
+    def num_pipe_buffers(self) -> int:
+        buffers = min(self.stages - self.stage_id, self.micro_batches)
+        return max(2, buffers)
+
+    def _buffer_idx(self, micro_batch_id: int) -> int:
+        assert self._valid_micro_batch(micro_batch_id)
+        return micro_batch_id % self.num_pipe_buffers()
+
+
+def _is_even(x: int) -> bool:
+    return x % 2 == 0
+
+
+def _is_odd(x: int) -> bool:
+    return x % 2 != 0

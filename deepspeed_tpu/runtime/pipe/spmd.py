@@ -1,0 +1,72 @@
+"""SPMD pipeline executor (TPU-native redesign of ``runtime/pipe/engine.py``).
+
+The reference runs pipeline parallelism as a per-rank instruction stream
+(1F1B ``TrainSchedule``, schedule.py:189) with explicit p2p sends of
+activations between stage processes (pipe/engine.py:913-1104, p2p.py:50).
+Under a single SPMD program that structure collapses into a *shifted-buffer
+scan*:
+
+  - layer params are stacked [P, Lp, ...] and sharded over the 'pipe' mesh
+    axis — each pipe shard holds its stage's layers;
+  - the live state is one [P, mb, S, D] buffer, stage-sharded on dim 0;
+  - each scan step vmaps the stage body over P (every stage computes in
+    parallel on its current microbatch) then rolls the buffer one stage
+    forward — XLA lowers the roll of a pipe-sharded dim to a
+    ``collective_permute`` over ICI, the analogue of p2p.send/recv;
+  - microbatch t enters stage 0 at step t and exits stage P-1 at step
+    t+P-1; total steps M + P - 1, bubble (P-1)/(M+P-1) (GPipe fill/drain —
+    the 1F1B memory shape comes from per-microbatch remat instead of
+    activation stashes).
+
+Backward needs no schedule at all: AD of the scan replays the same wavefront
+in reverse, and the transposed collective_permute carries the activation
+grads the reference moves with SendGrad/RecvGrad.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray, jnp.ndarray], Tuple[jnp.ndarray, jnp.ndarray]],
+                   stage_params: Any, x_micro: jnp.ndarray,
+                   rng: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Run M microbatches through P pipeline stages.
+
+    stage_fn(stage_layer_params, x [mb,S,D], rng) -> (x, aux) — one stage's
+    layer stack, vmapped over the leading [P] dim of ``stage_params``.
+    x_micro: [M, mb, S, D] embedded microbatches.
+    Returns (y_micro [M, mb, S, D], aux_sum).
+    """
+    P_ = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+    M = x_micro.shape[0]
+    state = jnp.zeros((P_,) + x_micro.shape[1:], x_micro.dtype)
+    pad = jnp.zeros((P_ - 1,) + x_micro.shape[1:], x_micro.dtype)
+    xs = jnp.concatenate([x_micro, pad], axis=0)          # [M+P-1, mb, S, D]
+
+    def step(carry, inp):
+        state, t = carry
+        x_in, = inp
+        state = state.at[0].set(x_in)
+        rngs = jax.vmap(lambda s: jax.random.fold_in(
+            jax.random.fold_in(rng, t), s))(jnp.arange(P_))
+        state, aux = jax.vmap(stage_fn)(stage_params, state, rngs)
+        # during fill/drain a stage computes on zero padding; mask its aux
+        sid = jnp.arange(P_)
+        valid = (t >= sid) & (t < sid + M)
+        out = state[P_ - 1]
+        state = jnp.roll(state, 1, axis=0)                # stage s -> s+1
+        return (state, t + 1), (out, jnp.sum(aux * valid))
+
+    (_, _), (outs, auxs) = jax.lax.scan(step, (state, jnp.int32(0)), (xs,))
+    # microbatch t exits at scan step t + P - 1
+    return outs[P_ - 1:], jnp.sum(auxs)
+
+
+def stage_layer_count(num_layers: int, num_stages: int) -> int:
+    if num_layers % num_stages:
+        raise ValueError(
+            f"num_layers={num_layers} not divisible by pipeline stages={num_stages}")
+    return num_layers // num_stages

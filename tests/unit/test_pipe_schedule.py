@@ -1,0 +1,70 @@
+"""Schedule/module tests (reference tests/unit/runtime/pipe/test_pipe_schedule.py,
+test_topology.py)."""
+import pytest
+
+from deepspeed_tpu.runtime.pipe.schedule import (BackwardPass, ForwardPass,
+                                                 InferenceSchedule, LoadMicroBatch,
+                                                 OptimizerStep, TrainSchedule)
+from deepspeed_tpu.runtime.pipe.module import (LayerSpec, PipelineModule,
+                                               TiedLayerSpec, partition_balanced)
+
+
+def _flat(sched):
+    return [cmd for step in sched for cmd in step]
+
+
+@pytest.mark.parametrize("micro,stages", [(4, 2), (8, 4), (2, 2), (4, 4)])
+def test_train_schedule_runs_every_microbatch_once(micro, stages):
+    for stage in range(stages):
+        cmds = _flat(TrainSchedule(micro, stages, stage))
+        fwd = [c for c in cmds if isinstance(c, ForwardPass)]
+        bwd = [c for c in cmds if isinstance(c, BackwardPass)]
+        assert len(fwd) == micro
+        assert len(bwd) == micro
+        assert sum(isinstance(c, OptimizerStep) for c in cmds) == 1
+
+
+def test_train_schedule_forward_precedes_backward_per_buffer():
+    sched = TrainSchedule(micro_batches=4, stages=2, stage_id=0)
+    seen_fwd = set()
+    for step in sched:
+        for cmd in step:
+            if isinstance(cmd, ForwardPass):
+                seen_fwd.add(cmd.buffer_id)
+            if isinstance(cmd, BackwardPass):
+                assert cmd.buffer_id in seen_fwd
+
+
+def test_first_stage_loads_microbatches():
+    cmds = _flat(TrainSchedule(micro_batches=4, stages=2, stage_id=0))
+    assert sum(isinstance(c, LoadMicroBatch) for c in cmds) == 4
+    cmds1 = _flat(TrainSchedule(micro_batches=4, stages=2, stage_id=1))
+    assert sum(isinstance(c, LoadMicroBatch) for c in cmds1) == 0
+
+
+def test_inference_schedule_wavefront():
+    for stage in range(3):
+        cmds = _flat(InferenceSchedule(micro_batches=5, stages=3, stage_id=stage))
+        assert sum(isinstance(c, ForwardPass) for c in cmds) == 5
+        assert not any(isinstance(c, BackwardPass) for c in cmds)
+
+
+def test_partition_balanced_uniform():
+    assert partition_balanced([1, 1, 1, 1], 2) == [0, 2, 4]
+    bounds = partition_balanced([4, 1, 1, 1, 1], 2)
+    # heaviest chunk minimized: [4] | [1,1,1,1]
+    assert bounds == [0, 1, 5]
+
+
+def test_pipeline_module_partitions_and_tied():
+    class Lin:
+        def __init__(self, n):
+            self.param_count = n
+
+    layers = [TiedLayerSpec("embed", Lin, 10), LayerSpec(Lin, 1),
+              LayerSpec(Lin, 1), TiedLayerSpec("embed", Lin, 10)]
+    pm = PipelineModule(layers, num_stages=2, partition_method="parameters")
+    assert pm.parts[0] == 0 and pm.parts[-1] == 4
+    assert pm.tied_keys() == {"embed": [0, 3]}
+    assert pm.stage_of_layer(0) == 0
+    assert pm.stage_of_layer(3) == 1
