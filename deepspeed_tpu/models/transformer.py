@@ -332,6 +332,36 @@ def _attention(cfg: TransformerConfig, q, k, v, positions, attn_impl: str = "xla
     """q:[B,S,Hq,hd] k,v:[B,S,Hkv,hd] -> [B,S,Hq,hd], causal."""
     B, S, Hq, hd = q.shape
     Hkv = k.shape[2]
+    # Sequence-parallel mesh: ring attention keeps queries resident and
+    # rotates K/V over the 'seq' axis (ppermute over ICI) instead of letting
+    # GSPMD all-gather the full sequence.  Checked BEFORE "auto" resolves so
+    # any seq-sharded mesh routes through the ring by default.
+    if attn_impl in ("auto", "ring", "pallas") and cfg.position != "alibi" \
+            and not custom_positions:
+        from ..parallel import mesh as mesh_mod
+
+        m = mesh_mod._GLOBAL_MESH
+        if m is not None and m.shape["seq"] > 1:
+            sp = m.shape["seq"]
+            tp = m.shape["model"]
+            dp = mesh_mod.axis_size(m, BATCH_AXES)
+            failed = [c for c, ok in [
+                (f"S={S} % sp={sp}", S % sp == 0),
+                (f"Hq={Hq} % tp={tp}", Hq % tp == 0),
+                (f"Hkv={Hkv} % tp={tp}", Hkv % tp == 0),
+                (f"B={B} % dp={dp}", B % dp == 0)] if not ok]
+            if not failed:
+                from ..ops.ring_attention import ring_attention_sharded
+
+                return ring_attention_sharded(
+                    q, k, v, m, BATCH_AXES, causal=True,
+                    sm_scale=1.0 / math.sqrt(hd))
+            if attn_impl == "ring":
+                raise ValueError(
+                    f"ring attention requested but unsatisfiable: {failed}")
+    elif attn_impl == "ring":
+        raise ValueError("ring attention requires a mesh with seq > 1, "
+                         "default positions, and non-alibi attention")
     if attn_impl == "auto":
         # flash kernel wins where XLA's materialized [S,S] scores hurt;
         # below that the fused-einsum path is faster on-chip (measured v5e)
@@ -359,21 +389,10 @@ def _attention(cfg: TransformerConfig, q, k, v, positions, attn_impl: str = "xla
             ok = (S % 128 == 0 and m.shape["seq"] == 1 and m.shape["pipe"] == 1
                   and Hq % tp == 0 and Hkv % tp == 0 and B % dp == 0)
             if ok:
-                import inspect
-
-                try:
-                    from jax import shard_map
-                except ImportError:  # older jax
-                    from jax.experimental.shard_map import shard_map
-                kw = ("check_vma"
-                      if "check_vma" in inspect.signature(shard_map).parameters
-                      else "check_rep")
-
                 spec = P(BATCH_AXES, None, "model", None)
-                fa = shard_map(
+                fa = mesh_mod.shard_map_compat(
                     functools.partial(flash_attention, causal=True, sm_scale=sm),
-                    mesh=m, in_specs=(spec, spec, spec), out_specs=spec,
-                    **{kw: False})
+                    m, in_specs=(spec, spec, spec), out_specs=spec)
                 return fa(q, k, v)
     if Hkv != Hq:  # GQA: repeat KV groups
         rep = Hq // Hkv

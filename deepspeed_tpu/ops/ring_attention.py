@@ -1,0 +1,98 @@
+"""Ring attention — sequence/context parallelism over the 'seq' mesh axis.
+
+The reference snapshot has NO sequence parallelism (SURVEY §5: predates
+DeepSpeed-Ulysses; its long-sequence story is block-sparse attention).  The
+TPU build treats SP as a first-class mesh axis: queries stay resident on
+their shard while K/V blocks rotate around the ring via ``lax.ppermute``
+(nearest-neighbor ICI hops), and per-block attention results merge with a
+running log-sum-exp — attention over sequences N× longer than one chip's
+score memory would allow, with compute overlapping the rotation.
+
+Per ring step the block scores are [B, H, S/N, S/N] — the S² term shrinks
+quadratically with the ring size; K/V residency is O(S/N) per step (AD
+keeps the rotated copies, so backward holds O(S) K/V per device — the
+score memory, not K/V, is the long-context bottleneck this removes).
+
+Causal masking uses absolute block offsets; fully-future blocks contribute
+-1e30 rows whose merge weight underflows to zero — uniform SPMD control
+flow, no per-device branching.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _block_attn(q, k, v, q_off, k_off, sm_scale, causal):
+    """q [B,Sq,H,hd], k/v [B,Sk,H,hd] -> (o [B,Sq,H,hd], lse [B,H,Sq])."""
+    B, Sq, H, hd = q.shape
+    Sk = k.shape[1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    if causal:
+        rows = q_off + jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0)
+        cols = k_off + jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1)
+        s = jnp.where((rows >= cols)[None, None], s, -1e30)
+    lse = jax.nn.logsumexp(s, axis=-1)                     # [B,H,Sq]
+    p = jnp.exp(s - lse[..., None]).astype(q.dtype)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return o, lse
+
+
+def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
+                   sm_scale: Optional[float] = None):
+    """Runs INSIDE shard_map: q/k/v are the local sequence shards
+    [B, S_local, H, hd]; returns the local output shard."""
+    B, Sl, Hq, hd = q.shape
+    Hkv = k.shape[2]
+    if Hkv != Hq:
+        k = jnp.repeat(k, Hq // Hkv, axis=2)
+        v = jnp.repeat(v, Hq // Hkv, axis=2)
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(hd)
+    n = jax.lax.psum(1, axis_name)
+    me = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # fp32 accumulator: the running rescale-and-add compounds rounding error
+    # across ring steps if carried in bf16; cast once at the end
+    o0 = jnp.zeros(q.shape, jnp.float32)
+    lse0 = jnp.full((B, Hq, Sl), -jnp.inf, jnp.float32)
+
+    def step(carry, r):
+        o, lse, k_cur, v_cur = carry
+        src = (me - r) % n                       # whose K/V block we hold
+        o_b, lse_b = _block_attn(q, k_cur, v_cur, me * Sl, src * Sl,
+                                 sm_scale, causal)
+        new_lse = jnp.logaddexp(lse, lse_b)
+        w_old = jnp.exp(lse - new_lse)           # [B,H,Sq]
+        w_new = jnp.exp(lse_b - new_lse)
+        o = (o * jnp.swapaxes(w_old, 1, 2)[..., None]
+             + o_b.astype(jnp.float32) * jnp.swapaxes(w_new, 1, 2)[..., None])
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (o, new_lse, k_cur, v_cur), None
+
+    (o, _, _, _), _ = jax.lax.scan(step, (o0, lse0, k, v), jnp.arange(n))
+    return o.astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh, batch_axes, causal: bool = True,
+                           sm_scale: Optional[float] = None,
+                           seq_axis: str = "seq", head_axis: str = "model"):
+    """shard_map wrapper: q/k/v are global [B, S, H, hd] arrays; batch rides
+    ``batch_axes``, sequence is split over ``seq_axis``, heads over
+    ``head_axis``."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import shard_map_compat
+
+    spec = P(batch_axes, seq_axis, head_axis, None)
+    fn = shard_map_compat(
+        functools.partial(ring_attention, axis_name=seq_axis, causal=causal,
+                          sm_scale=sm_scale),
+        mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)

@@ -158,6 +158,22 @@ def named(mesh: Mesh, spec: P) -> NamedSharding:
     return NamedSharding(mesh, spec)
 
 
+def shard_map_compat(fn, mesh: Mesh, in_specs, out_specs):
+    """shard_map across jax versions (jax.shard_map vs experimental;
+    check_vma vs check_rep), with replication checking off — manual regions
+    here wrap collectives/pallas calls the checker can't analyze."""
+    import inspect
+
+    try:
+        from jax import shard_map as _sm
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map as _sm
+    kw = ("check_vma" if "check_vma" in inspect.signature(_sm).parameters
+          else "check_rep")
+    return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               **{kw: False})
+
+
 def constrain_spec(x, spec: P):
     """``with_sharding_constraint`` against the global mesh; no-op when no
     mesh has been initialized (single-device eager tests)."""
