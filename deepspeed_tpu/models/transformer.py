@@ -359,6 +359,10 @@ def _attention(cfg: TransformerConfig, q, k, v, positions, attn_impl: str = "xla
             if attn_impl == "ring":
                 raise ValueError(
                     f"ring attention requested but unsatisfiable: {failed}")
+        elif attn_impl == "ring":
+            raise ValueError(
+                "ring attention requires an initialized mesh with a 'seq' "
+                f"axis > 1 (mesh={'none' if m is None else dict(m.shape)})")
     elif attn_impl == "ring":
         raise ValueError("ring attention requires a mesh with seq > 1, "
                          "default positions, and non-alibi attention")

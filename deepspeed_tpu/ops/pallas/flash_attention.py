@@ -184,10 +184,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *, sm_scale, causal,
-                    block_q, block_k, num_q):
-    ki, qi = pl.program_id(2), pl.program_id(3)
+                    block_q, block_k, num_q, group):
+    # Grid head axis is the KV head; the innermost axis walks every
+    # (q-head-in-group, q-block) pair so dk/dv accumulate in VMEM at
+    # [B, Hkv, S, hd] — no group-times-larger HBM intermediate.
+    ki, j = pl.program_id(2), pl.program_id(3)
+    qi = j % num_q
 
-    @pl.when(qi == 0)
+    @pl.when(j == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -221,7 +225,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                          (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
 
-    @pl.when(qi == num_q - 1)
+    @pl.when(j == num_q * group - 1)
     def _finish():
         dk_ref[:] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[:] = dv_acc[:].astype(dv_ref.dtype)
@@ -263,31 +267,35 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
-    # dk/dv are accumulated per (kv-head, kv-block) over every q head in the
-    # group and every q block: fold the group into the grid's head dimension.
+    # dk/dv accumulate per (kv-head, kv-block); the inner grid axis sweeps
+    # all group*num_q (q-head, q-block) pairs so the group reduction happens
+    # in the VMEM accumulator, not in an [B, Hq, S, hd] HBM intermediate.
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, block_k=block_k, num_q=num_q),
-        grid=(B, Hq, num_k, num_q),
+                          block_q=block_q, block_k=block_k, num_q=num_q,
+                          group=group),
+        grid=(B, Hkv, num_k, num_q * group),
         in_specs=[
-            pl.BlockSpec((None, None, block_q, hd), lambda b, h, ki, qi: (b, h, qi, 0)),
+            pl.BlockSpec((None, None, block_q, hd),
+                         lambda b, h, ki, j: (b, h * group + j // num_q, j % num_q, 0)),
             pl.BlockSpec((None, None, block_k, hd),
-                         lambda b, h, ki, qi: (b, h // group, ki, 0)),
+                         lambda b, h, ki, j: (b, h, ki, 0)),
             pl.BlockSpec((None, None, block_k, hd),
-                         lambda b, h, ki, qi: (b, h // group, ki, 0)),
-            pl.BlockSpec((None, None, block_q, hd), lambda b, h, ki, qi: (b, h, qi, 0)),
+                         lambda b, h, ki, j: (b, h, ki, 0)),
+            pl.BlockSpec((None, None, block_q, hd),
+                         lambda b, h, ki, j: (b, h * group + j // num_q, j % num_q, 0)),
             pl.BlockSpec((None, None, 1, block_q),
-                         lambda b, h, ki, qi: (b, h, 0, qi)),
+                         lambda b, h, ki, j: (b, h * group + j // num_q, 0, j % num_q)),
             pl.BlockSpec((None, None, 1, block_q),
-                         lambda b, h, ki, qi: (b, h, 0, qi)),
+                         lambda b, h, ki, j: (b, h * group + j // num_q, 0, j % num_q)),
         ],
         out_specs=[
-            pl.BlockSpec((None, None, block_k, hd), lambda b, h, ki, qi: (b, h, ki, 0)),
-            pl.BlockSpec((None, None, block_k, hd), lambda b, h, ki, qi: (b, h, ki, 0)),
+            pl.BlockSpec((None, None, block_k, hd), lambda b, h, ki, j: (b, h, ki, 0)),
+            pl.BlockSpec((None, None, block_k, hd), lambda b, h, ki, j: (b, h, ki, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, Hq, S, hd), q.dtype),
-            jax.ShapeDtypeStruct((B, Hq, S, hd), q.dtype),
+            jax.ShapeDtypeStruct((B, Hkv, S, hd), k.dtype),
+            jax.ShapeDtypeStruct((B, Hkv, S, hd), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, hd), jnp.float32),
@@ -295,10 +303,6 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
         ],
         interpret=interpret,
     )(q, k, v, do, lse, delta)
-
-    if group > 1:  # fold q-head groups back onto the kv heads
-        dk = dk.reshape(B, Hkv, group, S, hd).sum(axis=2).astype(k.dtype)
-        dv = dv.reshape(B, Hkv, group, S, hd).sum(axis=2).astype(v.dtype)
     return dq, dk, dv
 
 

@@ -28,18 +28,24 @@ import jax.numpy as jnp
 
 
 def _block_attn(q, k, v, q_off, k_off, sm_scale, causal):
-    """q [B,Sq,H,hd], k/v [B,Sk,H,hd] -> (o [B,Sq,H,hd], lse [B,H,Sq])."""
-    B, Sq, H, hd = q.shape
-    Sk = k.shape[1]
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    """q [B,Sq,Hq,hd], k/v [B,Sk,Hkv,hd] -> (o [B,Sq,Hq,hd], lse [B,Hq,Sq]).
+
+    Grouped-query attention stays grouped: q folds to [B,Sq,Hkv,G,hd] and the
+    einsums contract against the Hkv-head K/V directly — no materialized
+    repeat, so the ring carries (and rotates) only the true KV bytes."""
+    B, Sq, Hq, hd = q.shape
+    Sk, Hkv = k.shape[1], k.shape[2]
+    G = Hq // Hkv
+    qg = q.reshape(B, Sq, Hkv, G, hd)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k).astype(jnp.float32) * sm_scale
     if causal:
         rows = q_off + jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0)
         cols = k_off + jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1)
-        s = jnp.where((rows >= cols)[None, None], s, -1e30)
-    lse = jax.nn.logsumexp(s, axis=-1)                     # [B,H,Sq]
+        s = jnp.where((rows >= cols)[None, None, None], s, -1e30)
+    lse = jax.nn.logsumexp(s, axis=-1)                     # [B,Hkv,G,Sq]
     p = jnp.exp(s - lse[..., None]).astype(q.dtype)
-    o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
-    return o, lse
+    o = jnp.einsum("bhgqk,bkhd->bqhgd", p, v).reshape(B, Sq, Hq, hd)
+    return o, lse.reshape(B, Hq, Sq)
 
 
 def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
@@ -47,36 +53,40 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
     """Runs INSIDE shard_map: q/k/v are the local sequence shards
     [B, S_local, H, hd]; returns the local output shard."""
     B, Sl, Hq, hd = q.shape
-    Hkv = k.shape[2]
-    if Hkv != Hq:
-        k = jnp.repeat(k, Hq // Hkv, axis=2)
-        v = jnp.repeat(v, Hq // Hkv, axis=2)
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(hd)
     n = jax.lax.psum(1, axis_name)
     me = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    # fp32 accumulator: the running rescale-and-add compounds rounding error
-    # across ring steps if carried in bf16; cast once at the end
-    o0 = jnp.zeros(q.shape, jnp.float32)
-    lse0 = jnp.full((B, Hq, Sl), -jnp.inf, jnp.float32)
-
-    def step(carry, r):
-        o, lse, k_cur, v_cur = carry
-        src = (me - r) % n                       # whose K/V block we hold
-        o_b, lse_b = _block_attn(q, k_cur, v_cur, me * Sl, src * Sl,
-                                 sm_scale, causal)
+    def merge(o, lse, o_b, lse_b):
         new_lse = jnp.logaddexp(lse, lse_b)
         w_old = jnp.exp(lse - new_lse)           # [B,H,Sq]
         w_new = jnp.exp(lse_b - new_lse)
         o = (o * jnp.swapaxes(w_old, 1, 2)[..., None]
              + o_b.astype(jnp.float32) * jnp.swapaxes(w_new, 1, 2)[..., None])
+        return o, new_lse
+
+    # Step 0 (the local K/V block) runs outside the scan so the ring does
+    # exactly n-1 rotations — the carried K/V after the last compute is
+    # never permuted just to be discarded.
+    o_b, lse_b = _block_attn(q, k, v, me * Sl, me * Sl, sm_scale, causal)
+    # fp32 accumulator: the running rescale-and-add compounds rounding error
+    # across ring steps if carried in bf16; cast once at the end
+    o0 = o_b.astype(jnp.float32)
+    lse0 = lse_b
+
+    def step(carry, r):
+        o, lse, k_cur, v_cur = carry
         k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
         v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (o, new_lse, k_cur, v_cur), None
+        src = (me - r) % n                       # whose K/V block we hold
+        o_b, lse_b = _block_attn(q, k_cur, v_cur, me * Sl, src * Sl,
+                                 sm_scale, causal)
+        o, lse = merge(o, lse, o_b, lse_b)
+        return (o, lse, k_cur, v_cur), None
 
-    (o, _, _, _), _ = jax.lax.scan(step, (o0, lse0, k, v), jnp.arange(n))
+    (o, _, _, _), _ = jax.lax.scan(step, (o0, lse0, k, v), jnp.arange(1, n))
     return o.astype(q.dtype)
 
 

@@ -198,8 +198,50 @@ class DeepSpeedEngine:
         opt_state = jax.tree_util.tree_map(
             lambda x: jax.device_put(x, replicated)
             if hasattr(x, "shape") and not hasattr(x.sharding, "spec") else x, opt_state)
+
+        # -- ZeRO-Offload: optimizer state (and fp32 masters) live in host
+        #    RAM between steps (reference stage_1_and_2.py:1041-1124 CPU
+        #    offload).  TPU-native form: the SAME dp-sharded layout, placed in
+        #    pinned host memory via sharding memory kinds; XLA streams shards
+        #    over PCIe into the jitted step and lands the updated state back
+        #    on the host (out_shardings below), so HBM never holds optimizer
+        #    state at rest.
+        self.offload_active = False
+        zc = self.config.zero_config
+        dev = zc.offload_optimizer.device if zc.offload_optimizer else "none"
+        want_offload = getattr(dev, "value", dev) == "cpu"
+        if want_offload:
+            if jax.devices()[0].platform == "cpu":
+                # Host and "device" memory are the same RAM on the CPU
+                # backend (and XLA cannot compile placement annotations on a
+                # forced multi-device host mesh) — the placement would be a
+                # no-op; the code path is still exercised minus memory kinds.
+                logger.warning(
+                    "offload_optimizer.device=cpu: CPU backend — host memory "
+                    "IS device memory; offload placement skipped")
+            else:
+                to_host = lambda x: jax.device_put(  # noqa: E731
+                    x, x.sharding.with_memory_kind("pinned_host"))
+                opt_state = jax.tree_util.tree_map(to_host, opt_state)
+                if master is not None:
+                    master = jax.tree_util.tree_map(to_host, master)
+                self.offload_active = True
         self.state = TrainState(step=step0, params=params0, master_params=master,
                                 opt_state=opt_state, scaler=scaler, rng=seed_rng)
+        # Out-shardings pin every state leaf back to where it started (host
+        # for offloaded leaves); metrics come back replicated on device.
+        # The matching device-kind shardings stream the offloaded leaves INTO
+        # the step (XLA refuses compute on host-placed operands).
+        if self.offload_active:
+            self._train_out_shardings = (
+                jax.tree_util.tree_map(lambda x: x.sharding, self.state), replicated)
+            to_dev = lambda x: x.sharding.with_memory_kind("device")  # noqa: E731
+            self._offload_dev_shardings = (
+                jax.tree_util.tree_map(to_dev, master) if master is not None else None,
+                jax.tree_util.tree_map(to_dev, opt_state))
+        else:
+            self._train_out_shardings = None
+            self._offload_dev_shardings = None
 
         # -- bookkeeping --
         self.global_steps = 0
@@ -210,6 +252,11 @@ class DeepSpeedEngine:
                                           steps_per_output=self.config.steps_per_print)
         self._compiled_train_step = None
         self._compiled_eval_step = None
+        self._compiled_micro_grad = None
+        self._compiled_apply_step = None
+        self._accum_grads = None
+        self._accum_count = 0
+        self._last_grad_norm: Optional[float] = None
         self._data_iterator = None
         self.training_dataloader = self._build_dataloader(training_data)
         self.monitor = self._build_monitor()
@@ -241,42 +288,98 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # The jitted step
     # ------------------------------------------------------------------
-    def _make_train_step(self):
-        gas = self.gas
+    def _make_scaled_grad(self):
+        """grad_fn(masters, scaler, batch, sub) -> (scaled grads, loss) —
+        shared by the fused train_step scan and the per-microbatch loop."""
         use_master = self.use_master_weights
         compute_dtype = self.compute_dtype
         loss_fn = self.loss_fn
+        prescale = self.config.prescale_gradients
+        predivide = self.config.gradient_predivide_factor
+
+        def grad_of_batch(m_tree, scaler, one_batch, sub):
+            def scaled(m):
+                p = _cast_tree(m, compute_dtype) if use_master else m
+                out = loss_fn(p, one_batch, sub)
+                loss, _ = out if isinstance(out, tuple) else (out, {})
+                return scale_loss(loss, scaler), loss
+
+            grads, loss = jax.grad(scaled, has_aux=True)(m_tree)
+            if prescale:
+                grads = jax.tree_util.tree_map(lambda g: g / predivide, grads)
+            return grads, loss
+
+        return grad_of_batch
+
+    def _make_update_body(self):
+        """update(state, masters, opt_in, grads, eff_gas) -> (new_state,
+        metrics): unscale, overflow-skip, optimizer update, scaler update,
+        master->compute cast.  The single source of truth for step semantics
+        (used by both the fused step and the fwd/bwd/step loop)."""
+        use_master = self.use_master_weights
+        compute_dtype = self.compute_dtype
         optimizer = self.optimizer
-        grad_specs = self._grad_shardings
         param_shardings = self._param_shardings
         fp16 = self.fp16_enabled
         prescale = self.config.prescale_gradients
         predivide = self.config.gradient_predivide_factor
 
+        def apply_update(state: TrainState, masters, opt_in, grads, eff_gas):
+            inv = 1.0 / (state.scaler.loss_scale * eff_gas)
+            if prescale:
+                inv = inv * predivide
+            grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+            finite = grads_finite(grads) if fp16 else jnp.bool_(True)
+            grad_norm = optax.global_norm(grads)
+            updates, new_opt = optimizer.update(grads, opt_in, masters)
+            new_masters = optax.apply_updates(masters, updates)
+            # overflow => skip (reference DynamicLossScaler step-skip semantics)
+            new_masters = _tree_select(finite, new_masters, masters)
+            new_opt = _tree_select(finite, new_opt, opt_in)
+            new_scaler = update_scale(state.scaler, finite)
+            if use_master:
+                new_params = constrain(_cast_tree(new_masters, compute_dtype),
+                                       param_shardings)
+                new_master_out = new_masters
+            else:
+                new_params = new_masters
+                new_master_out = None
+            new_state = TrainState(step=state.step + 1, params=new_params,
+                                   master_params=new_master_out, opt_state=new_opt,
+                                   scaler=new_scaler, rng=state.rng)
+            metrics = {"grad_norm": grad_norm,
+                       "loss_scale": state.scaler.loss_scale,
+                       "step_applied": finite}
+            return new_state, metrics
+
+        return apply_update
+
+    def _stream_in(self, state: TrainState):
+        """(masters, opt_in) for the step, moved device-side when offloaded."""
+        masters = state.master_params if self.use_master_weights else state.params
+        opt_in = state.opt_state
+        if self._offload_dev_shardings is not None:
+            m_sh, o_sh = self._offload_dev_shardings
+            if self.use_master_weights and m_sh is not None:
+                masters = jax.device_put(masters, m_sh)
+            opt_in = jax.device_put(opt_in, o_sh)
+        return masters, opt_in
+
+    def _make_train_step(self):
+        gas = self.gas
+        grad_specs = self._grad_shardings
         pipeline = self.mesh.shape.get("pipe", 1) > 1
+        grad_of_batch = self._make_scaled_grad()
+        apply_update = self._make_update_body()
+        stream_in = self._stream_in
 
         def train_step(state: TrainState, batch):
-            masters = state.master_params if use_master else state.params
-
-            def grad_of_batch(m_tree, one_batch, sub):
-                """Scaled-loss grad for one loss_fn call (shared by the
-                microbatch scan and the pipeline whole-window path)."""
-
-                def scaled_loss(m):
-                    p = _cast_tree(m, compute_dtype) if use_master else m
-                    out = loss_fn(p, one_batch, sub)
-                    loss, _ = out if isinstance(out, tuple) else (out, {})
-                    return scale_loss(loss, state.scaler), loss
-
-                grads, loss = jax.grad(scaled_loss, has_aux=True)(m_tree)
-                if prescale:
-                    grads = jax.tree_util.tree_map(lambda g: g / predivide, grads)
-                return grads, loss
+            masters, opt_in = stream_in(state)
 
             def micro_step(carry, microbatch):
                 acc, rng = carry
                 rng, sub = jax.random.split(rng)
-                grads, loss = grad_of_batch(masters, microbatch, sub)
+                grads, loss = grad_of_batch(masters, state.scaler, microbatch, sub)
                 acc = jax.tree_util.tree_map(
                     lambda a, g: a + g.astype(jnp.float32), acc, grads)
                 return (acc, rng), loss
@@ -289,7 +392,7 @@ class DeepSpeedEngine:
                 flat = jax.tree_util.tree_map(
                     lambda x: x.reshape((-1,) + x.shape[2:]), batch)
                 new_rng, sub = jax.random.split(state.rng)
-                grads, losses = grad_of_batch(masters, flat, sub)
+                grads, losses = grad_of_batch(masters, state.scaler, flat, sub)
                 grads = jax.tree_util.tree_map(
                     lambda g: g.astype(jnp.float32), grads)
                 eff_gas = 1  # loss already averages over the gas window
@@ -302,39 +405,14 @@ class DeepSpeedEngine:
             # ZeRO-2/3: land the accumulated grads sharded — XLA lowers the DP
             # reduction into reduce-scatter against this constraint
             grads = constrain(grads, grad_specs)
-            inv = 1.0 / (state.scaler.loss_scale * eff_gas)
-            if prescale:
-                inv = inv * predivide
-            grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
-
-            finite = grads_finite(grads) if fp16 else jnp.bool_(True)
-            grad_norm = optax.global_norm(grads)
-
-            updates, new_opt = optimizer.update(grads, state.opt_state, masters)
-            new_masters = optax.apply_updates(masters, updates)
-            # overflow => skip (reference DynamicLossScaler step-skip semantics)
-            new_masters = _tree_select(finite, new_masters, masters)
-            new_opt = _tree_select(finite, new_opt, state.opt_state)
-            new_scaler = update_scale(state.scaler, finite)
-
-            if use_master:
-                new_params = constrain(_cast_tree(new_masters, compute_dtype),
-                                       param_shardings)
-                new_master_out = new_masters
-            else:
-                new_params = new_masters
-                new_master_out = None
-            new_state = TrainState(step=state.step + 1, params=new_params,
-                                   master_params=new_master_out, opt_state=new_opt,
-                                   scaler=new_scaler, rng=new_rng)
-            metrics = {
-                "loss": jnp.mean(losses),
-                "grad_norm": grad_norm,
-                "loss_scale": state.scaler.loss_scale,
-                "step_applied": finite,
-            }
+            new_state, metrics = apply_update(state, masters, opt_in, grads, eff_gas)
+            new_state = dataclasses.replace(new_state, rng=new_rng)
+            metrics["loss"] = jnp.mean(losses)
             return new_state, metrics
 
+        if self._train_out_shardings is not None:
+            return jax.jit(train_step, donate_argnums=(0,),
+                           out_shardings=self._train_out_shardings)
         return jax.jit(train_step, donate_argnums=(0,))
 
     def _make_eval_step(self):
@@ -408,6 +486,7 @@ class DeepSpeedEngine:
         self.state, metrics = self._compiled_train_step(self.state, global_batch)
         self.global_steps += 1
         self.micro_steps += self.gas
+        self._last_grad_norm = float(metrics["grad_norm"])
         if self.fp16_enabled and not bool(metrics["step_applied"]):
             self.skipped_steps += 1
             log_dist(f"step {self.global_steps}: grad overflow, step skipped; "
@@ -430,28 +509,104 @@ class DeepSpeedEngine:
         return jax.tree_util.tree_map(
             lambda x: jax.device_put(np.asarray(x), sharding), batch)
 
-    # --- loop-shape parity shims (reference forward/backward/step) ---
+    # ------------------------------------------------------------------
+    # Reference-shaped training loop: loss = engine.forward(batch);
+    # engine.backward(loss); engine.step().  (reference engine.py:1708,
+    # 1849, 2050.)  forward runs one fused fwd+bwd per micro-batch (same
+    # total compute as train_batch — JAX has no standalone autograd tape to
+    # replay later), backward banks the gradients, step applies the
+    # optimizer update at the gradient-accumulation boundary.
+    # ------------------------------------------------------------------
+    def _make_micro_grad_step(self):
+        grad_specs = self._grad_shardings
+        grad_of_batch = self._make_scaled_grad()
+        stream_in = self._stream_in
+
+        def micro_grad(state: TrainState, batch, accum):
+            masters, _ = stream_in(state)
+            rng, sub = jax.random.split(state.rng)
+            grads, loss = grad_of_batch(masters, state.scaler, batch, sub)
+            accum = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(jnp.float32), accum, grads)
+            accum = constrain(accum, grad_specs)
+            return loss, accum, rng
+
+        return jax.jit(micro_grad, donate_argnums=(2,))
+
+    def _make_apply_step(self):
+        gas = self.gas
+        apply_update = self._make_update_body()
+        stream_in = self._stream_in
+
+        def apply_step(state: TrainState, grads):
+            masters, opt_in = stream_in(state)
+            return apply_update(state, masters, opt_in, grads, gas)
+
+        if self._train_out_shardings is not None:
+            state_sh, rep = self._train_out_shardings
+            return jax.jit(apply_step, donate_argnums=(0,),
+                           out_shardings=(state_sh, rep))
+        return jax.jit(apply_step, donate_argnums=(0,))
+
+    def _zero_grad_buffer(self):
+        masters = (self.state.master_params if self.use_master_weights
+                   else self.state.params)
+        zeros = jax.jit(
+            lambda m: jax.tree_util.tree_map(
+                lambda x: jnp.zeros(x.shape, jnp.float32), m),
+            out_shardings=self._grad_shardings)(masters)
+        return zeros
+
     def forward(self, batch):
-        """Buffer a micro-batch; loss is computed at the gas boundary."""
-        if not hasattr(self, "_pending"):
-            self._pending = []
-        self._pending.append(batch)
-        return None
+        """Compute the micro-batch loss (gradients computed alongside and
+        held for the matching backward())."""
+        if self.mesh.shape.get("pipe", 1) > 1:
+            raise RuntimeError("pipeline engines train with train_batch(); "
+                               "per-microbatch forward/backward is not exposed "
+                               "(reference PipelineEngine restriction)")
+        if self._compiled_micro_grad is None:
+            self._compiled_micro_grad = self._make_micro_grad_step()
+        if self._accum_grads is None:
+            self._accum_grads = self._zero_grad_buffer()
+            self._accum_count = 0
+        micro = self._shard_batch_eval(batch)
+        loss, self._accum_grads, rng = self._compiled_micro_grad(
+            self.state, micro, self._accum_grads)
+        self.state = dataclasses.replace(self.state, rng=rng)
+        self._backward_pending = True
+        return loss
 
     def backward(self, loss=None):
+        """Bank the gradients computed by the matching forward()."""
+        assert getattr(self, "_backward_pending", False), \
+            "backward() without a preceding forward()"
+        self._backward_pending = False
+        self._accum_count += 1
+        self.micro_steps += 1
         return loss
 
     def step(self):
-        """Consume buffered micro-batches when a full gas window is present."""
-        assert getattr(self, "_pending", None), "no micro-batches buffered; call forward()"
-        assert len(self._pending) == self.gas, (
-            f"buffered {len(self._pending)} micro-batches, need gas={self.gas}")
-        batch = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *self._pending)
-        self._pending = []
-        return self.train_batch(batch=batch)
+        """Apply the optimizer update at the gradient-accumulation boundary;
+        a mid-window step() is a no-op (reference skips until boundary)."""
+        assert not getattr(self, "_backward_pending", False), \
+            "step() with a forward() missing its backward()"
+        if self._accum_count == 0:
+            raise RuntimeError("step() with no accumulated gradients")
+        if self._accum_count < self.gas:
+            return None
+        if self._compiled_apply_step is None:
+            self._compiled_apply_step = self._make_apply_step()
+        self.state, metrics = self._compiled_apply_step(self.state, self._accum_grads)
+        self._accum_grads = None
+        self._accum_count = 0
+        self.global_steps += 1
+        self._last_grad_norm = float(metrics["grad_norm"])
+        if self.fp16_enabled and not bool(metrics["step_applied"]):
+            self.skipped_steps += 1
+        return metrics
 
     def is_gradient_accumulation_boundary(self) -> bool:
-        return len(getattr(self, "_pending", [])) == 0
+        return getattr(self, "_accum_count", 0) == 0
 
     # ------------------------------------------------------------------
     def _emit_monitor_events(self, metrics):
@@ -477,7 +632,9 @@ class DeepSpeedEngine:
         return float(self.state.scaler.loss_scale)
 
     def get_global_grad_norm(self) -> Optional[float]:
-        return None  # populated from last metrics if needed
+        """Global gradient norm of the most recent optimizer step (None until
+        the first step completes)."""
+        return self._last_grad_norm
 
     @property
     def module(self):

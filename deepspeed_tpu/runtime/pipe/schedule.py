@@ -122,10 +122,11 @@ class InferenceSchedule(PipeSchedule):
             micro_batch_id = step_id - self.stage_id
             cmds: List[PipeInstruction] = []
             if self._valid_micro_batch(micro_batch_id):
-                if self.is_first_stage:
-                    cmds.append(LoadMicroBatch(buffer_id=self._buffer_idx(micro_batch_id)))
-                else:
+                if not self.is_first_stage:
                     cmds.append(RecvActivation(buffer_id=self._buffer_idx(micro_batch_id)))
+                if self.is_first_stage or self.is_last_stage:
+                    # first stage loads inputs; last stage loads labels
+                    cmds.append(LoadMicroBatch(buffer_id=self._buffer_idx(micro_batch_id)))
                 cmds.append(ForwardPass(buffer_id=self._buffer_idx(micro_batch_id)))
                 if not self.is_last_stage:
                     cmds.append(SendActivation(buffer_id=self._buffer_idx(micro_batch_id)))
@@ -139,18 +140,48 @@ class InferenceSchedule(PipeSchedule):
 
 
 class TrainSchedule(PipeSchedule):
-    """1F1B (reference :189): steady-state alternates one forward with one
-    backward; early steps fill, late steps drain.  Total 2*(M + S - 1) ticks;
-    peak activation stash = num_pipe_buffers() microbatches."""
+    """Synchronous 1F1B on a global wavefront clock.
+
+    Derivation (original, replaces the reference's four parity-case helpers
+    with one closed form).  Put all S stages on one shared clock where every
+    tick is either a forward slot or a backward slot for a given stage:
+
+    * Forward of microbatch ``m`` enters stage 0 at tick ``2m`` and ripples
+      down one stage per tick, so on stage ``s`` it fires at
+
+          t_fwd(m, s) = s + 2m
+
+    * The loss for microbatch ``m`` is ready when the last stage finishes its
+      forward, and the backward wave ripples back *up* one stage per tick:
+
+          t_bwd(m, s) = (2S - 1 - s) + 2m
+
+      (on the last stage this is t_fwd + 1: backward immediately follows
+      forward — the 1F1B steady state).
+
+    Because ``t_fwd - s`` is even and ``t_bwd + s`` is odd, each tick is
+    unambiguously a forward or a backward slot for a stage — stage ``s`` runs
+    forwards on ticks with the same parity as ``s`` and backwards on the
+    opposite parity, alternating 1F/1B once full.  The last backward
+    (m = M-1, s = 0) lands on tick 2(M + S - 1) - 1, giving the familiar
+    2(M + S - 1) total ticks.
+
+    Activation-stash bound: forward ``m + B`` on stage ``s`` overwrites
+    buffer ``m % B``; safety requires t_bwd(m, s) < t_fwd(m + B, s), i.e.
+    B >= S - s — deeper stages retire activations sooner, so the stash
+    shrinks linearly toward the last stage.
+    """
 
     def steps(self):
         prev_micro_batch_id = -1
         total_steps = 2 * (self.micro_batches + self.stages - 1)
         for step_id in range(total_steps):
-            micro_batch_id, is_forward = self._step_to_micro_batch(step_id)
+            micro_batch_id, is_forward = self._work_at_tick(step_id)
             cmds: List[PipeInstruction] = []
 
-            # exchange activations/grads with neighbors
+            # Ship the previous tick's product to the neighbor that needs it
+            # this tick: a finished forward feeds the next stage, a finished
+            # backward feeds grads to the previous stage.
             if self._valid_micro_batch(prev_micro_batch_id):
                 if is_forward:
                     if self._valid_stage(self.prev_stage):
@@ -159,16 +190,18 @@ class TrainSchedule(PipeSchedule):
                     if self._valid_stage(self.next_stage):
                         cmds.append(SendActivation(buffer_id=self._buffer_idx(prev_micro_batch_id)))
             if self._valid_micro_batch(micro_batch_id):
+                buf = self._buffer_idx(micro_batch_id)
                 if is_forward:
                     if self._valid_stage(self.prev_stage):
-                        cmds.append(RecvActivation(buffer_id=self._buffer_idx(micro_batch_id)))
-                    else:
-                        cmds.append(LoadMicroBatch(buffer_id=self._buffer_idx(micro_batch_id)))
-                    cmds.append(ForwardPass(buffer_id=self._buffer_idx(micro_batch_id)))
+                        cmds.append(RecvActivation(buffer_id=buf))
+                    if self.is_first_stage or self.is_last_stage:
+                        # First stage loads inputs; last stage loads labels.
+                        cmds.append(LoadMicroBatch(buffer_id=buf))
+                    cmds.append(ForwardPass(buffer_id=buf))
                 else:
                     if self._valid_stage(self.next_stage):
-                        cmds.append(RecvGrad(buffer_id=self._buffer_idx(micro_batch_id)))
-                    cmds.append(BackwardPass(buffer_id=self._buffer_idx(micro_batch_id)))
+                        cmds.append(RecvGrad(buffer_id=buf))
+                    cmds.append(BackwardPass(buffer_id=buf))
 
             # final tick: reduce + step
             if step_id == total_steps - 1:
@@ -179,51 +212,18 @@ class TrainSchedule(PipeSchedule):
             prev_micro_batch_id = micro_batch_id
             yield cmds
 
-    def _step_to_micro_batch(self, step_id: int):
-        """Reference :258-298: even ticks run forwards, odd ticks backwards,
-        offset by the stage id."""
-        if _is_even(step_id) and _is_even(self.stage_id):
-            micro_batch_id = self._even_step_forward_id(step_id)
-            is_forward = True
-        elif _is_odd(step_id) and _is_odd(self.stage_id):
-            micro_batch_id = self._odd_step_forward_id(step_id)
-            is_forward = True
-        elif _is_even(step_id) and _is_odd(self.stage_id):
-            micro_batch_id = self._even_step_backward_id(step_id)
-            is_forward = False
-        else:
-            micro_batch_id = self._odd_step_backward_id(step_id)
-            is_forward = False
-        return micro_batch_id, is_forward
-
-    def _even_step_forward_id(self, step_id):
-        base = step_id // 2
-        return base - self.stage_id // 2
-
-    def _odd_step_forward_id(self, step_id):
-        base = (step_id - 1) // 2
-        return base - self.stage_id // 2
-
-    def _even_step_backward_id(self, step_id):
-        base = step_id // 2
-        return base - self.stages + (self.stage_id + 1) // 2
-
-    def _odd_step_backward_id(self, step_id):
-        base = ((step_id - 1) // 2) - self.stages + 1
-        return base + self.stage_id // 2
+    def _work_at_tick(self, tick: int):
+        """Invert the wavefront formulas: which (microbatch, phase) does this
+        stage run at ``tick``?  The returned microbatch may be out of range
+        (fill/drain bubbles); callers filter with ``_valid_micro_batch``."""
+        if (tick - self.stage_id) % 2 == 0:
+            return (tick - self.stage_id) // 2, True
+        return (tick - (2 * self.stages - 1 - self.stage_id)) // 2, False
 
     def num_pipe_buffers(self) -> int:
-        buffers = min(self.stages - self.stage_id, self.micro_batches)
-        return max(2, buffers)
+        # B >= S - s from the stash bound above; >=2 for send/compute overlap.
+        return max(2, min(self.stages - self.stage_id, self.micro_batches))
 
     def _buffer_idx(self, micro_batch_id: int) -> int:
         assert self._valid_micro_batch(micro_batch_id)
         return micro_batch_id % self.num_pipe_buffers()
-
-
-def _is_even(x: int) -> bool:
-    return x % 2 == 0
-
-
-def _is_odd(x: int) -> bool:
-    return x % 2 != 0

