@@ -1,0 +1,5 @@
+from .quantizer import (DEFAULT_BLOCK, dequantize_blockwise, quantize_blockwise,
+                        quantized_all_gather, quantized_reduce_scatter)
+
+__all__ = ["DEFAULT_BLOCK", "quantize_blockwise", "dequantize_blockwise",
+           "quantized_all_gather", "quantized_reduce_scatter"]

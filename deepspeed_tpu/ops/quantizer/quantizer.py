@@ -1,0 +1,169 @@
+"""Blockwise quantization ops — the ZeRO++ communication primitives.
+
+TPU-native equivalent of the reference quantization kernel family
+(csrc/quantization/{quantize.cu,dequantize.cu,swizzled_quantize.cu,
+quant_reduce.cu}; python binding deepspeed/ops/quantizer/).  Those CUDA
+kernels exist to compress ZeRO-3's two big collectives:
+
+  qwZ — int8-quantized weight all-gather (partition_parameters.py:1067-1087)
+  qgZ — quantized hierarchical gradient reduce  (coalesced_collectives.py:31)
+
+Here the quant/dequant math is expressed as XLA ops (reshape + reduce +
+round — XLA fuses the whole block pipeline into the surrounding collective
+program; a hand-rolled Pallas kernel would only re-derive the same fusion),
+and the collectives are `lax` collectives inside shard_map manual regions,
+so the wire payload really is int8/int4.
+
+Symmetric per-block scaling: block of K consecutive elements shares one
+fp32 scale = amax/qmax.  int4 packs two lanes per int8 byte (the TPU has no
+s4 all-to-all; the reference's swizzled layout solves a GPU-memory-coalescing
+problem the XLA layout engine handles for us).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DEFAULT_BLOCK = 256
+
+
+def _qmax(bits: int) -> int:
+    if bits == 8:
+        return 127
+    if bits == 4:
+        return 7
+    raise ValueError(f"bits must be 4 or 8, got {bits}")
+
+
+def quantize_blockwise(x: jax.Array, block: int = DEFAULT_BLOCK,
+                       bits: int = 8) -> Tuple[jax.Array, jax.Array]:
+    """x (any shape) -> (q int8 [nblocks, block(/2 for int4)], scales fp32
+    [nblocks]).  Blocks run over the flattened array; the tail block is
+    zero-padded (padding quantizes to 0 exactly)."""
+    qmax = _qmax(bits)
+    flat = x.reshape(-1).astype(jnp.float32)
+    pad = (-flat.size) % block
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    rows = flat.reshape(-1, block)
+    amax = jnp.max(jnp.abs(rows), axis=1)
+    scale = jnp.where(amax == 0, 1.0, amax / qmax)
+    q = jnp.clip(jnp.round(rows / scale[:, None]), -qmax, qmax).astype(jnp.int8)
+    if bits == 4:
+        lo = q[:, 0::2] & 0xF
+        hi = q[:, 1::2] << 4
+        q = (lo | hi).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_blockwise(q: jax.Array, scale: jax.Array, shape, dtype,
+                         block: int = DEFAULT_BLOCK, bits: int = 8) -> jax.Array:
+    """Inverse of :func:`quantize_blockwise`."""
+    if bits == 4:
+        # sign-extend each nibble: shift to the top of the byte, shift back
+        lo = (q.astype(jnp.int8) << 4) >> 4
+        hi = q.astype(jnp.int8) >> 4
+        rows = jnp.stack([lo, hi], axis=-1).reshape(q.shape[0], -1)
+    else:
+        rows = q
+    x = rows.astype(jnp.float32) * scale[:, None]
+    size = int(np.prod(shape)) if shape else 1
+    return x.reshape(-1)[:size].reshape(shape).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# Quantized collectives (run INSIDE shard_map manual regions)
+# ---------------------------------------------------------------------------
+
+def quantized_all_gather(x_shard: jax.Array, axis_name, gather_dim: int = 0,
+                         block: int = DEFAULT_BLOCK, bits: int = 8,
+                         out_dtype=None, grad_bits: int = None) -> jax.Array:
+    """qwZ: all-gather a parameter shard with an int8/int4 wire format.
+
+    Forward: quantize the local shard -> all_gather(q, scales) -> dequantize
+    the full tensor (bytes on the wire: 1/2 (int8) or 1/4 (int4) of bf16).
+    ``bits=None`` skips weight quantization (plain all-gather at out_dtype).
+    Backward: the exact adjoint of all-gather — a reduce-scatter of the
+    output cotangent; fp32 by default, or the qgZ quantized reduction when
+    ``grad_bits`` is set.  ``axis_name`` may be a tuple of mesh axes (their
+    shards concatenate major-to-minor in tuple order, matching GSPMD's
+    dim-spec ordering).
+    """
+    out_dtype = out_dtype or x_shard.dtype
+    grad_dtype = x_shard.dtype
+
+    @jax.custom_vjp
+    def gather(x):
+        if bits is None:
+            xs = jax.lax.all_gather(x.astype(out_dtype), axis_name)
+            parts = [xs[i] for i in range(xs.shape[0])]
+        else:
+            q, s = quantize_blockwise(x, block=block, bits=bits)
+            qg = jax.lax.all_gather(q, axis_name)    # [n, nblk, block/pack]
+            sg = jax.lax.all_gather(s, axis_name)    # [n, nblk]
+            parts = [dequantize_blockwise(qg[i], sg[i], x.shape, out_dtype,
+                                          block=block, bits=bits)
+                     for i in range(qg.shape[0])]
+        return jnp.concatenate(parts, axis=gather_dim)
+
+    def gather_fwd(x):
+        return gather(x), None
+
+    def gather_bwd(_, dy):
+        if grad_bits is None:
+            dx = jax.lax.psum_scatter(dy, axis_name,
+                                      scatter_dimension=gather_dim, tiled=True)
+        else:
+            name = (axis_name if not isinstance(axis_name, (tuple, list))
+                    or len(axis_name) > 1 else axis_name[0])
+            dx = quantized_reduce_scatter(dy, name, scatter_dim=gather_dim,
+                                          block=block, bits=grad_bits)
+        return (dx.astype(grad_dtype),)
+
+    gather.defvjp(gather_fwd, gather_bwd)
+    return gather(x_shard)
+
+
+def quantized_reduce_scatter(grads: jax.Array, axis_name, scatter_dim: int = 0,
+                             block: int = DEFAULT_BLOCK, bits: int = 8) -> jax.Array:
+    """qgZ single-hop: reduce local gradients across ``axis_name`` with a
+    quantized wire format, landing each device's shard.
+
+    all_to_all exchanges quantized chunks (each device receives every peer's
+    version of ITS chunk), then the sum runs locally in fp32 — one quantize
+    per hop, exactly the reference's quantized-reduction semantics
+    (csrc/quantization/quant_reduce.cu).  The hierarchical ICI/DCN two-hop
+    composes this op over two mesh axes (see zero/zeropp.py).
+    """
+    n = jax.lax.psum(1, axis_name)
+    # split the scatter dim into n chunks: chunk i belongs to device i
+    moved = jnp.moveaxis(grads, scatter_dim, 0)
+    lead = moved.shape[0]
+    assert lead % n == 0, f"dim {scatter_dim} ({lead}) not divisible by {n}"
+    chunks = moved.reshape(n, lead // n, *moved.shape[1:])
+    per_chunk = int(np.prod(chunks.shape[1:]))
+    # pad each chunk to a block multiple so no scale block straddles a chunk
+    # boundary (C-order flattening then groups rows evenly into the n chunks)
+    pad = (-per_chunk) % block
+    flat = chunks.reshape(n, per_chunk)
+    if pad:
+        flat = jnp.pad(flat, ((0, 0), (0, pad)))
+    q, s = quantize_blockwise(flat, block=block, bits=bits)
+    nblk = q.shape[0] // n
+    q = q.reshape(n, nblk, q.shape[-1])
+    s = s.reshape(n, nblk)
+    # all_to_all over the chunk axis: device i receives every peer's chunk i
+    qx = jax.lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0)
+    sx = jax.lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0)
+    # dequantize each peer's contribution and sum in fp32
+    contribs = [dequantize_blockwise(qx[i], sx[i], (per_chunk + pad,),
+                                     jnp.float32, block=block, bits=bits)
+                for i in range(qx.shape[0])]
+    total = functools.reduce(jnp.add, contribs)[:per_chunk]
+    out = jnp.moveaxis(
+        total.reshape(lead // n, *moved.shape[1:]), 0, scatter_dim)
+    return out.astype(grads.dtype)

@@ -340,10 +340,6 @@ class DeepSpeedConfig:
             bad.append("zero_optimization.mics_shard_size (MiCS)")
         if zc.zero_hpz_partition_size > 1:
             bad.append("zero_optimization.zero_hpz_partition_size (ZeRO++ hpZ)")
-        if zc.zero_quantized_weights:
-            bad.append("zero_optimization.zero_quantized_weights (ZeRO++ qwZ)")
-        if zc.zero_quantized_gradients:
-            bad.append("zero_optimization.zero_quantized_gradients (ZeRO++ qgZ)")
         if self.flops_profiler.enabled:
             bad.append("flops_profiler.enabled")
         ac = self.activation_checkpointing

@@ -1,0 +1,121 @@
+"""ZeRO++ — quantized ZeRO-3 collectives (qwZ / qgZ).
+
+Reference mechanisms: int8 quantized weight all-gather
+(runtime/zero/partition_parameters.py:1067-1158 + csrc/quantization/
+swizzled_quantize.cu) and quantized hierarchical gradient reduce
+(runtime/comm/coalesced_collectives.py:31 + quant_reduce.cu), claimed 4x
+communication reduction vs plain ZeRO-3 (docs/_posts/2023-06-22-zeropp.md).
+
+TPU-native redesign.  Under GSPMD, stage-3's param all-gather and grad
+reduce-scatter are *implicit* (XLA inserts them against sharding
+constraints) — implicit collectives can't change wire format.  ZeRO++ makes
+exactly those two collectives explicit, per parameter leaf, as a manual
+shard_map region that gathers over the ZeRO axes only (tensor/sequence
+shards pass through the region untouched):
+
+  forward : quantize shard (int8 blockwise) -> all_gather -> dequantize
+            = qwZ, 2x fewer bytes than bf16 (4x vs fp32)
+  backward: custom VJP reduce-scatters the param cotangent; with qgZ the
+            reduce runs through the int8/int4 all-to-all quantized-reduction
+            (ops/quantizer/quantized_reduce_scatter)
+
+Persistent (small, replicated) params keep the plain cast path — same as
+the reference, which never quantizes persistent params.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from jax.sharding import PartitionSpec as P
+
+from ...ops.quantizer import DEFAULT_BLOCK, quantized_all_gather
+
+
+def _entry_axes(entry) -> Tuple[str, ...]:
+    if entry is None:
+        return ()
+    if isinstance(entry, str):
+        return (entry,)
+    return tuple(entry)
+
+
+def _zero_axes_in_spec(spec: P, zero_axes) -> Tuple[Optional[int], Tuple[str, ...]]:
+    """(dim, axes) of the ZeRO-sharded dimension of this spec (None if the
+    leaf is not ZeRO-sharded)."""
+    for dim, entry in enumerate(spec):
+        axes = tuple(a for a in _entry_axes(entry) if a in zero_axes)
+        if axes:
+            return dim, axes
+    return None, ()
+
+
+def _quantized_gather_leaf(x, axis_names: Tuple[str, ...], gather_dim: int,
+                           compute_dtype, weight_bits: Optional[int],
+                           grad_bits: Optional[int], block: int):
+    """Runs inside the manual region.  x: local master shard (fp32); the
+    wire-format + VJP logic is the shared op in ops/quantizer."""
+    return quantized_all_gather(x, axis_names, gather_dim=gather_dim,
+                                block=block, bits=weight_bits,
+                                out_dtype=compute_dtype, grad_bits=grad_bits)
+
+
+def _strip_axes(spec: P, drop) -> P:
+    entries = []
+    for e in spec:
+        axes = tuple(a for a in _entry_axes(e) if a not in drop)
+        entries.append(axes if len(axes) > 1 else (axes[0] if axes else None))
+    while entries and entries[-1] is None:
+        entries.pop()
+    return P(*entries)
+
+
+def make_zeropp_cast(master_specs: Any, param_specs: Any, mesh, compute_dtype,
+                     zero_axes, weight_bits: Optional[int],
+                     grad_bits: Optional[int],
+                     block: int = DEFAULT_BLOCK):
+    """cast_fn(masters) -> compute params, with explicit quantized
+    collectives on every ZeRO-sharded leaf.  Drop-in for the engine's
+    ``_cast_tree(masters, compute_dtype)``.
+
+    Fully-manual shard_map per leaf: in_specs carry the leaf's complete
+    sharding (TP axes included — their shards pass through untouched), the
+    region gathers over the ZeRO axes only, and out_specs keep the TP axes.
+    (The partial-manual ``axis_names`` mode would be the natural fit but
+    crashes XLA's SPMD partitioner in this jax/XLA version.)"""
+    from ...parallel.mesh import shard_map_compat
+
+    def leaf_fn(master_spec: P, param_spec: P):
+        dim, axes = _zero_axes_in_spec(param_spec, zero_axes)
+        if dim is None:
+            return None  # persistent/unsharded: plain cast
+        region = functools.partial(
+            _quantized_gather_leaf, axis_names=axes, gather_dim=dim,
+            compute_dtype=compute_dtype, weight_bits=weight_bits,
+            grad_bits=grad_bits, block=block)
+        return shard_map_compat(region, mesh, in_specs=(master_spec,),
+                                out_specs=_strip_axes(master_spec, zero_axes))
+
+    gathers = jax.tree_util.tree_map(
+        leaf_fn, master_specs, param_specs,
+        is_leaf=lambda x: isinstance(x, P))
+    num_quantized = sum(
+        g is not None for g in jax.tree_util.tree_leaves(
+            gathers, is_leaf=lambda x: x is None or callable(x)))
+
+    def cast(masters):
+        def apply(g, m):
+            if g is None:
+                return m.astype(compute_dtype) if jnp.issubdtype(
+                    m.dtype, jnp.floating) else m
+            return g(m)
+
+        return jax.tree_util.tree_map(
+            apply, gathers, masters,
+            is_leaf=lambda x: x is None or callable(x))
+
+    cast.num_quantized_leaves = num_quantized
+    return cast

@@ -11,16 +11,20 @@ import os
 
 # Must be set before jax initializes its backends.  Force-override: the outer
 # environment points JAX_PLATFORMS at the real TPU (and the container's
-# sitecustomize re-pins it programmatically), but unit tests always run on the
-# virtual 8-device host mesh (real-TPU tests opt in via the tpu marker).
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# sitecustomize re-pins it programmatically), but unit tests run on the
+# virtual 8-device host mesh by default.  Real-TPU tests (tpu marker) run in
+# a SEPARATE pytest process:  DS_TPU_REAL_TESTS=1 pytest -m tpu tests/
+_REAL_TPU = os.environ.get("DS_TPU_REAL_TESTS") == "1"
+if not _REAL_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")  # sitecustomize sets "axon,cpu"
+if not _REAL_TPU:
+    jax.config.update("jax_platforms", "cpu")  # sitecustomize sets "axon,cpu"
 
 import pytest  # noqa: E402
 
@@ -33,14 +37,20 @@ def pytest_configure(config):
 
 def pytest_collection_modifyitems(config, items):
     # The unit suite pins itself to the virtual CPU mesh above; tpu-marked
-    # tests need real hardware and run via `pytest -m tpu tests/tpu/` in a
-    # separate process (jax backends can't be re-picked once initialized).
+    # tests need real hardware: DS_TPU_REAL_TESTS=1 pytest -m tpu tests/
+    # (a separate process — jax backends can't be re-picked once initialized).
     if jax.devices()[0].platform == "cpu":
-        skip_tpu = pytest.mark.skip(reason="requires real TPU (suite runs on "
-                                    "the virtual CPU mesh)")
+        skip_tpu = pytest.mark.skip(reason="requires real TPU: run "
+                                    "DS_TPU_REAL_TESTS=1 pytest -m tpu tests/")
         for item in items:
             if "tpu" in item.keywords:
                 item.add_marker(skip_tpu)
+    else:
+        skip_cpu = pytest.mark.skip(reason="virtual-mesh test (needs 8 "
+                                    "devices); run without DS_TPU_REAL_TESTS")
+        for item in items:
+            if "tpu" not in item.keywords:
+                item.add_marker(skip_cpu)
 
 
 @pytest.fixture(autouse=True)

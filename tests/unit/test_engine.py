@@ -145,10 +145,19 @@ def test_forward_backward_step():
         loss = engine.forward(mb)
         assert np.isfinite(float(loss))
         engine.backward(loss)
-        # mid-window step is a no-op until the gas boundary
-        assert engine.step() is None or i == 1
+        # boundary goes true exactly when the banked window is full
+        # (reference is_gradient_accumulation_boundary semantics)
+        assert engine.is_gradient_accumulation_boundary() == (i == 1)
+        if engine.is_gradient_accumulation_boundary():
+            metrics = engine.step()
+            assert np.isfinite(float(metrics["loss"]))
     assert engine.global_steps == 1
     assert engine.get_global_grad_norm() is not None
+    # over-running the window is an error, not silent mis-normalization
+    engine.backward(engine.forward(random_batch(8, HID, seed=9)))
+    engine.backward(engine.forward(random_batch(8, HID, seed=10)))
+    with pytest.raises(RuntimeError, match="beyond the accumulation window"):
+        engine.forward(random_batch(8, HID, seed=11))
 
 
 def test_forward_backward_step_matches_train_batch():

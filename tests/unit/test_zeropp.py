@@ -1,0 +1,94 @@
+"""ZeRO++ tests (reference tests/unit/runtime/zero/test_zeropp.py — hpZ/qwZ/
+qgZ convergence methodology, plus a wire-format assertion the reference
+can't make because its collectives live outside the compiled graph)."""
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.parallel import mesh as mesh_mod
+
+from .simple_model import SimpleModel, random_batch, make_config
+
+HID = 16
+
+
+def _engine(qw=False, qg=False, tp=1):
+    cfg = make_config(batch_size=16, stage=3, precision="bf16")
+    cfg["zero_optimization"]["zero_quantized_weights"] = qw
+    cfg["zero_optimization"]["zero_quantized_gradients"] = qg
+    # tiny test params must not fall under the persistent-param threshold,
+    # else every leaf stays replicated and the quantized path never engages
+    cfg["zero_optimization"]["stage3_param_persistence_threshold"] = 0
+    if tp > 1:
+        cfg["mesh"] = {"tp": tp}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=SimpleModel(HID), config=cfg)
+    return engine
+
+
+def _train(engine, steps=4, seed=0):
+    return [float(engine.train_batch(batch=random_batch(16, HID, seed + s)))
+            for s in range(steps)]
+
+
+def test_qwz_loss_tracks_unquantized():
+    base = _train(_engine())
+    mesh_mod.reset_mesh()
+    quant = _train(_engine(qw=True))
+    assert np.isfinite(quant).all()
+    # int8 blockwise weight quantization: losses track within quant noise
+    np.testing.assert_allclose(quant, base, rtol=0.05, atol=0.02)
+
+
+def test_qwz_qgz_trains_and_converges():
+    engine = _engine(qw=True, qg=True)
+    losses = _train(engine, steps=8)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    assert engine.get_global_grad_norm() is not None
+
+
+def test_qwz_gather_rides_int8_on_the_wire():
+    """The comm-volume claim, asserted structurally: the compiled train step
+    must all-gather s8 (int8) for the big params, not bf16/f32."""
+    engine = _engine(qw=True)
+    engine._compiled_train_step = engine._make_train_step()
+    batch = engine._collect_global_batch(
+        {"x": np.zeros((16, HID), np.float32), "y": np.zeros((16, 1), np.float32)})
+    lowered = engine._compiled_train_step.lower(engine.state, batch)
+    hlo = lowered.compile().as_text()
+    assert "all-gather" in hlo
+    s8_gathers = [l for l in hlo.splitlines()
+                  if "all-gather" in l and "s8" in l]
+    assert s8_gathers, "no int8 all-gather found in compiled HLO"
+
+
+def test_qgz_reduce_rides_int8_on_the_wire():
+    engine = _engine(qw=False, qg=True)
+    engine._compiled_train_step = engine._make_train_step()
+    batch = engine._collect_global_batch(
+        {"x": np.zeros((16, HID), np.float32), "y": np.zeros((16, 1), np.float32)})
+    hlo = engine._compiled_train_step.lower(engine.state, batch).compile().as_text()
+    s8_a2a = [l for l in hlo.splitlines() if "all-to-all" in l and "s8" in l]
+    assert s8_a2a, "no int8 all-to-all (quantized grad reduce) in compiled HLO"
+
+
+def test_zeropp_with_tensor_parallel():
+    """qwZ leaves TP axes to GSPMD (partial-manual shard_map): dp4 x tp2."""
+    from .simple_model import SimpleTPModel
+
+    cfg = make_config(batch_size=16, stage=3, precision="bf16")
+    cfg["zero_optimization"]["zero_quantized_weights"] = True
+    cfg["zero_optimization"]["stage3_param_persistence_threshold"] = 0
+    cfg["mesh"] = {"tp": 2}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=SimpleTPModel(HID), config=cfg)
+    losses = _train(engine, steps=3)
+    assert np.isfinite(losses).all()
+
+
+def test_zeropp_requires_mixed_precision():
+    cfg = make_config(batch_size=16, stage=3)  # fp32
+    cfg["zero_optimization"]["zero_quantized_weights"] = True
+    with pytest.raises(ValueError, match="bf16 or"):
+        deepspeed_tpu.initialize(model=SimpleModel(HID), config=cfg)
