@@ -133,15 +133,61 @@ def run(model_name: str, micro_batch: int, seq_len: int, steps: int, warmup: int
     }
 
 
+def run_inference(model_name: str, batch: int, prompt_len: int, new_tokens: int):
+    """Decode throughput (tokens/s/chip) with the jitted KV-cache loop.
+    vs_baseline compares against the reference's published ZeRO-Inference
+    number (OPT-30B CPU-offload, 43 tokens/s on one V100 —
+    docs/_posts/2022-09-10-zero-inference.md:52) — loosely comparable only;
+    reported for the record, the training metric stays the headline."""
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM
+
+    on_tpu = jax.devices()[0].platform not in ("cpu",)
+    if not on_tpu:
+        model_name, batch, prompt_len, new_tokens = "tiny", 2, 16, 8
+    model = CausalLM(model_name, max_seq_len=max(2048, prompt_len + new_tokens))
+    params = model.init_fn(jax.random.PRNGKey(0))
+    engine = deepspeed_tpu.init_inference(model=model, params=params)
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, model.config.vocab_size,
+                          (batch, prompt_len)).astype(np.int32)
+    out = engine.generate(prompt, max_new_tokens=new_tokens)  # compile
+    np.asarray(out)
+    t0 = time.perf_counter()
+    out = engine.generate(prompt, max_new_tokens=new_tokens)
+    np.asarray(out)
+    dt = time.perf_counter() - t0
+    tps = batch * new_tokens / dt
+    return {
+        "metric": "llama-decode-throughput",
+        "value": round(tps, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(tps / 43.0, 3),
+        "detail": {"model": model_name, "batch": batch, "prompt_len": prompt_len,
+                   "new_tokens": new_tokens, "params": model.param_count,
+                   "platform": jax.devices()[0].platform},
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", default="train", choices=["train", "inference"])
     ap.add_argument("--model", default="llama-740m")
     ap.add_argument("--micro_batch", type=int, default=8)
     ap.add_argument("--seq_len", type=int, default=2048)
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--zero_stage", type=int, default=1)
+    ap.add_argument("--prompt_len", type=int, default=128)
+    ap.add_argument("--new_tokens", type=int, default=128)
     args = ap.parse_args()
+
+    if args.mode == "inference":
+        print(json.dumps(run_inference(args.model, args.micro_batch,
+                                       args.prompt_len, args.new_tokens)))
+        return
 
     attempts = list(dict.fromkeys(
         (mb, args.steps)

@@ -50,6 +50,8 @@ class InferenceEngine:
     def __init__(self, model: Any = None, config: Optional[DeepSpeedInferenceConfig] = None,
                  apply_fn: Optional[Callable] = None, params: Any = None, mesh=None):
         self._config = config or DeepSpeedInferenceConfig()
+        self._model = model if hasattr(model, "apply_cached") else None
+        self._gen_cache: dict = {}
         if model is not None:
             apply_fn = apply_fn or getattr(model, "apply_fn", None) or getattr(
                 model, "apply", None)
@@ -86,13 +88,101 @@ class InferenceEngine:
 
     __call__ = forward
 
-    def generate(self, input_ids, max_new_tokens: int = 32, eos_token_id: Optional[int] = None,
-                 greedy: bool = True, rng: Optional[jax.Array] = None, temperature: float = 1.0):
-        """Greedy/sampled autoregressive generation by full-recompute forward.
+    # ------------------------------------------------------------------
+    # Generation.  Reference: InferenceEngine._generate (engine.py:621) over
+    # the KV-cache workspace (csrc/transformer/inference/inference_context.h).
+    # TPU redesign: static-shape prefill + a lax.scan decode loop, so one
+    # generate() call compiles exactly two programs (per prompt-length
+    # bucket) instead of retracing a growing sequence every token.
+    # ------------------------------------------------------------------
 
-        The KV-cached decode loop (reference softmax_context kernels with the
-        inference_context workspace) arrives with models/ generation support;
-        this path is correct for any logits-returning apply_fn."""
+    @staticmethod
+    def _bucket(n: int) -> int:
+        """Prompt-length bucket (next power of two ≥ 16) to bound recompiles."""
+        b = 16
+        while b < n:
+            b *= 2
+        return b
+
+    def _generate_program(self, model, B, S_pad, max_new, greedy):
+        cfg = model.config
+
+        def prog(params, tokens, input_mask, positions, rng, eos_id, temperature):
+            cache = model.init_cache(B, S_pad + max_new, dtype=cfg.dtype)
+            logits, cache = model.apply_cached(params, tokens, cache, positions,
+                                               input_mask)
+            lengths = input_mask.sum(-1).astype(jnp.int32)           # [B]
+            last = jnp.take_along_axis(
+                logits, (lengths - 1)[:, None, None], axis=1)[:, 0]  # [B,V]
+
+            def sample(lg, key):
+                if greedy:
+                    return jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                return jax.random.categorical(
+                    key, lg.astype(jnp.float32) / temperature, axis=-1
+                ).astype(jnp.int32)
+
+            def step(carry, _):
+                cache, lg, pos, done, key = carry
+                key, sub = jax.random.split(key)
+                tok = sample(lg, sub)
+                tok = jnp.where(done, jnp.maximum(eos_id, 0), tok)
+                done = done | (tok == eos_id)
+                lg2, cache = model.apply_cached(
+                    params, tok[:, None], cache, pos[:, None], ~done[:, None])
+                return (cache, lg2[:, 0], pos + 1, done, key), tok
+
+            done0 = jnp.zeros((B,), jnp.bool_)
+            (_, _, _, _, _), toks = jax.lax.scan(
+                step, (cache, last, lengths, done0, rng), None, length=max_new)
+            return toks.T  # [B, max_new]
+
+        return jax.jit(prog, static_argnames=())
+
+    def generate(self, input_ids, max_new_tokens: int = 32, eos_token_id: Optional[int] = None,
+                 greedy: bool = True, rng: Optional[jax.Array] = None, temperature: float = 1.0,
+                 attention_mask=None, model=None):
+        """KV-cached autoregressive generation under jit.
+
+        Prompts may be right-padded ragged rows (pass ``attention_mask``); pad
+        slots are written to the cache but masked from attention.  Returns the
+        original ids with ``max_new_tokens`` generated tokens appended (rows
+        that hit ``eos_token_id`` repeat it).
+        """
+        model = model or self._model
+        if model is None or not hasattr(model, "apply_cached"):
+            return self._generate_uncached(input_ids, max_new_tokens, eos_token_id,
+                                           greedy, rng, temperature)
+        ids = np.asarray(input_ids)
+        if ids.ndim == 1:
+            ids = ids[None, :]
+        B, S = ids.shape
+        mask = (np.ones_like(ids, dtype=bool) if attention_mask is None
+                else np.asarray(attention_mask, dtype=bool))
+        S_pad = self._bucket(S)
+        toks = np.zeros((B, S_pad), ids.dtype)
+        toks[:, :S] = ids
+        mpad = np.zeros((B, S_pad), bool)
+        mpad[:, :S] = mask
+        # positions: cumulative index of real tokens (pads repeat the last)
+        pos = np.maximum(np.cumsum(mpad, axis=1) - 1, 0).astype(np.int32)
+
+        key = (B, S_pad, max_new_tokens, greedy)
+        if key not in self._gen_cache:
+            self._gen_cache[key] = self._generate_program(
+                model, B, S_pad, max_new_tokens, greedy)
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        eos = jnp.int32(-1 if eos_token_id is None else eos_token_id)
+        new = self._gen_cache[key](
+            self.params, jnp.asarray(toks), jnp.asarray(mpad), jnp.asarray(pos),
+            rng, eos, jnp.float32(temperature))
+        return jnp.concatenate([jnp.asarray(ids), new], axis=1)
+
+    def _generate_uncached(self, input_ids, max_new_tokens: int = 32,
+                           eos_token_id: Optional[int] = None, greedy: bool = True,
+                           rng: Optional[jax.Array] = None, temperature: float = 1.0):
+        """Full-recompute fallback for arbitrary logits-returning apply_fns
+        (and the parity reference for the cached path in tests)."""
         ids = jnp.asarray(input_ids)
         if ids.ndim == 1:
             ids = ids[None, :]

@@ -10,11 +10,13 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from .transformer import (CONFIGS, TransformerConfig, cross_entropy_loss, forward,
-                          get_config, init_params, param_specs)
+from .transformer import (CONFIGS, TransformerConfig, cache_specs,
+                          cross_entropy_loss, forward, forward_cached,
+                          get_config, init_cache, init_params, param_specs)
 
 __all__ = ["CausalLM", "TransformerConfig", "CONFIGS", "get_config", "forward",
-           "init_params", "param_specs", "cross_entropy_loss"]
+           "forward_cached", "init_cache", "cache_specs", "init_params",
+           "param_specs", "cross_entropy_loss"]
 
 
 class CausalLM:
@@ -61,6 +63,18 @@ class CausalLM:
 
     def eval_fn(self, params, batch, rng):
         return self._loss(params, batch, rng, deterministic=True)
+
+    # -- KV-cached decode contract (used by InferenceEngine.generate and the
+    #    hybrid engine): static-shape cache + single-program prefill/decode --
+    def init_cache(self, batch_size, max_len, dtype=None):
+        return init_cache(self.config, batch_size, max_len, dtype)
+
+    def cache_specs(self):
+        return cache_specs(self.config)
+
+    def apply_cached(self, params, tokens, cache, positions, input_mask):
+        return forward_cached(self.config, params, tokens, cache, positions,
+                              input_mask)
 
     @property
     def param_count(self) -> int:

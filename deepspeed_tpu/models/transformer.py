@@ -583,6 +583,183 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
     return logits
 
 
+# ---------------------------------------------------------------------------
+# KV-cached decode path (reference: the inference_context KV workspace,
+# csrc/transformer/inference/includes/inference_context.h, and the
+# softmax_context attention kernels, ops/transformer/inference/ds_attention.py).
+# TPU redesign: the cache is a pytree of static-shape ring buffers threaded
+# through lax.scan over layers, so prefill and every decode step are each ONE
+# compiled XLA program — the per-token retrace/recompile of a growing-sequence
+# forward disappears.  Ragged (right-padded) prompts are handled with an
+# explicit validity bitmap instead of compaction: pad slots are written but
+# never attended, which keeps every write a static dynamic_update_slice.
+# ---------------------------------------------------------------------------
+
+def init_cache(cfg: TransformerConfig, batch_size: int, max_len: int,
+               dtype=None) -> Dict[str, Any]:
+    """Allocate a static-shape KV cache for ``batch_size`` rows of up to
+    ``max_len`` total tokens (prompt + generated).
+
+    Layout: ``k``/``v`` are ``[L, B, T, Hkv, hd]`` (stacked over layers so the
+    layer scan consumes/produces them as xs/ys); ``valid`` marks attended
+    slots, ``pos`` stores each slot's position id (alibi needs relative
+    positions), ``next_slot`` is the global write cursor (identical across
+    rows because pad tokens occupy slots too).
+    """
+    dtype = dtype or cfg.dtype
+    L, B, T = cfg.num_layers, batch_size, max_len
+    kv = (L, B, T, cfg.kv_heads, cfg.dims_per_head)
+    return {
+        "k": jnp.zeros(kv, dtype),
+        "v": jnp.zeros(kv, dtype),
+        "valid": jnp.zeros((B, T), jnp.bool_),
+        "pos": jnp.zeros((B, T), jnp.int32),
+        "next_slot": jnp.int32(0),
+    }
+
+
+def cache_specs(cfg: TransformerConfig) -> Dict[str, P]:
+    """Shardings for the cache: batch over DP axes, KV heads over 'model'."""
+    kv = P(None, BATCH_AXES, None, "model", None)
+    return {"k": kv, "v": kv, "valid": P(BATCH_AXES, None),
+            "pos": P(BATCH_AXES, None), "next_slot": P()}
+
+
+def _attention_cached(cfg, q, ck, cv, q_pos, q_slot, valid, kpos):
+    """q:[B,S,Hq,hd] against the full cache ck/cv:[B,T,Hkv,hd].
+
+    GQA contracts grouped query heads against the Hkv cache directly (no
+    materialized repeat).  Mask: a key slot is attendable iff it holds a real
+    token (``valid``) and was written at or before the query's slot (slot
+    order == time order, so this is exactly causality even for ragged rows).
+    """
+    B, S, Hq, hd = q.shape
+    T, Hkv = ck.shape[1], ck.shape[2]
+    G = Hq // Hkv
+    qg = q.reshape(B, S, Hkv, G, hd)
+    scores = jnp.einsum("bskgd,btkd->bkgst", qg, ck).astype(jnp.float32)
+    scores = scores / math.sqrt(hd)
+    if cfg.position == "alibi":
+        slopes = jnp.asarray(_alibi_slopes(Hq)).reshape(Hkv, G)
+        rel = (q_pos[:, :, None] - kpos[:, None, :]).astype(jnp.float32)  # [B,S,T]
+        scores = scores - (jnp.abs(rel)[:, None, None, :, :]
+                           * slopes[None, :, :, None, None])
+    slot_t = jnp.arange(T, dtype=jnp.int32)
+    ok = valid[:, None, :] & (slot_t[None, None, :] <= q_slot[None, :, None])
+    scores = jnp.where(ok[:, None, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs, cv)
+    return out.reshape(B, S, Hq, hd)
+
+
+def _block_cached(cfg, lp, x, ck, cv, q_pos, q_slot, valid, kpos, next_slot,
+                  rng):
+    """One transformer block with cache read/write.  ck/cv are this layer's
+    [B,T,Hkv,hd] buffers; returns (x, updated ck, cv)."""
+    B, S, _ = x.shape
+    hd, nh, nkv = cfg.dims_per_head, cfg.num_heads, cfg.kv_heads
+
+    h = _norm(cfg, x, lp["attn_norm_scale"], lp.get("attn_norm_bias"))
+    q = h @ lp["wq"]
+    k = h @ lp["wk"]
+    v = h @ lp["wv"]
+    if cfg.attn_bias:
+        q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+    q = q.reshape(B, S, nh, hd)
+    k = k.reshape(B, S, nkv, hd)
+    v = v.reshape(B, S, nkv, hd)
+    if cfg.position == "rope":
+        q, k = _rope(q, k, q_pos, cfg.rope_theta, hd)
+    ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, next_slot, 0, 0))
+    cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, next_slot, 0, 0))
+    ck = constrain_spec(ck, P(BATCH_AXES, None, "model", None))
+    cv = constrain_spec(cv, P(BATCH_AXES, None, "model", None))
+    attn = _attention_cached(cfg, q, ck, cv, q_pos, q_slot, valid, kpos)
+    attn = attn.reshape(B, S, nh * hd) @ lp["wo"]
+    if cfg.attn_bias:
+        attn = attn + lp["bo"]
+    x = x + attn
+
+    h = _norm(cfg, x, lp["mlp_norm_scale"], lp.get("mlp_norm_bias"))
+    if cfg.num_experts > 1:
+        from ..moe.sharded_moe import MoEConfig, moe_ffn
+
+        m, _ = moe_ffn(
+            h, lp["router"], lp,
+            MoEConfig(num_experts=cfg.num_experts, top_k=cfg.moe_top_k,
+                      capacity_factor=cfg.capacity_factor,
+                      eval_capacity_factor=cfg.eval_capacity_factor,
+                      min_capacity=cfg.moe_min_capacity,
+                      noisy_gate_policy=cfg.noisy_gate_policy),
+            activation=cfg.activation, deterministic=True, rng=rng)
+    elif cfg.activation == "swiglu":
+        g = h @ lp["w_gate"]
+        u = h @ lp["w_up"]
+        if cfg.mlp_bias:
+            g, u = g + lp["b_gate"], u + lp["b_up"]
+        m = jax.nn.silu(g) * u
+        m = m @ lp["w_down"]
+    else:
+        m = h @ lp["w_in"]
+        if cfg.mlp_bias:
+            m = m + lp["b_in"]
+        m = jax.nn.gelu(m)
+        m = m @ lp["w_down"]
+    if cfg.num_experts == 1 and cfg.mlp_bias:
+        m = m + lp["b_down"]
+    return x + m, ck, cv
+
+
+def forward_cached(cfg: TransformerConfig, params: Dict[str, Any],
+                   tokens: jax.Array, cache: Dict[str, Any],
+                   positions: jax.Array, input_mask: jax.Array):
+    """Run ``tokens [B,S]`` (prefill chunk or a single decode token) against
+    the cache, appending their K/V at slots ``next_slot..next_slot+S-1``.
+
+    ``positions [B,S]``: absolute position ids (pad rows repeat the previous
+    position — they're masked out anyway).  ``input_mask [B,S]``: True for
+    real tokens; False slots are written but never attended.
+
+    Returns ``(logits [B,S,V], new_cache)``.  Both prefill and decode are this
+    ONE function under two static shapes, so a whole generation run compiles
+    exactly twice.
+    """
+    assert cfg.pipeline_stages == 1, "cached decode requires pipeline_stages=1"
+    B, S = tokens.shape
+    next_slot = cache["next_slot"]
+
+    valid = jax.lax.dynamic_update_slice(cache["valid"], input_mask, (0, next_slot))
+    kpos = jax.lax.dynamic_update_slice(cache["pos"], positions.astype(jnp.int32),
+                                        (0, next_slot))
+    q_slot = next_slot + jnp.arange(S, dtype=jnp.int32)
+
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    if cfg.position == "learned":
+        x = x + params["pos_embed"].astype(cfg.dtype)[positions]
+    x = constrain_spec(x, P(BATCH_AXES, None, None))
+
+    rng = jax.random.PRNGKey(0)
+
+    def body(x, layer):
+        lp, ck, cv = layer
+        x, ck, cv = _block_cached(cfg, lp, x, ck, cv, positions, q_slot, valid,
+                                  kpos, next_slot, rng)
+        x = constrain_spec(x, P(BATCH_AXES, None, None))
+        return x, (ck, cv)
+
+    x, (ck_all, cv_all) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"]))
+
+    x = _norm(cfg, x, params["final_norm_scale"], params.get("final_norm_bias"))
+    if cfg.tie_embeddings:
+        logits = x @ params["embed"].astype(cfg.dtype).T
+    else:
+        logits = x @ params["lm_head"].astype(cfg.dtype)
+    new_cache = {"k": ck_all, "v": cv_all, "valid": valid, "pos": kpos,
+                 "next_slot": next_slot + S}
+    return logits, new_cache
+
+
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array,
                        ignore_index: int = -100) -> jax.Array:
     """Mean next-token NLL; positions with ``labels == ignore_index`` masked."""
