@@ -56,7 +56,7 @@ def measure_matmul_peak() -> float:
 
 
 def run(model_name: str, micro_batch: int, seq_len: int, steps: int, warmup: int,
-        zero_stage: int):
+        zero_stage: int, remat_policy: str = None, remat: bool = None):
     import jax
     import jax.numpy as jnp
 
@@ -72,7 +72,12 @@ def run(model_name: str, micro_batch: int, seq_len: int, steps: int, warmup: int
         micro_batch = min(micro_batch, 2)
         steps, warmup = min(steps, 3), min(warmup, 1)
     else:
-        model = CausalLM(model_name, max_seq_len=seq_len)
+        overrides = {"max_seq_len": seq_len}
+        if remat_policy is not None:
+            overrides["remat_policy"] = remat_policy
+        if remat is not None:
+            overrides["remat"] = remat
+        model = CausalLM(model_name, **overrides)
 
     config = {
         "train_micro_batch_size_per_gpu": micro_batch,
@@ -104,10 +109,15 @@ def run(model_name: str, micro_batch: int, seq_len: int, steps: int, warmup: int
     base, attn_coeff = model_flops_per_token(model.config)
     flops_per_token = base + attn_coeff * seq_len
     tflops = tok_per_sec_chip * flops_per_token / 1e12
-    # executed flops: full-layer remat recomputes the forward once in the
-    # backward (+2N/token); attention recompute included via the same ratio
-    remat_mult = (8.0 / 6.0) if model.config.remat else 1.0
-    executed_tflops = tflops * remat_mult
+    # executed flops: FULL-layer remat recomputes the forward once in the
+    # backward (+2N/token).  Partial policies (dots/save_attn) recompute an
+    # unmodeled subset — report executed==None rather than a wrong number.
+    if model.config.remat and model.config.remat_policy == "nothing_saveable":
+        executed_tflops = tflops * 8.0 / 6.0
+    elif not model.config.remat:
+        executed_tflops = tflops
+    else:
+        executed_tflops = None
     return {
         "metric": "llama-train-throughput",
         "value": round(tflops, 2),
@@ -125,10 +135,11 @@ def run(model_name: str, micro_batch: int, seq_len: int, steps: int, warmup: int
             "loss": loss_val,
             "flops_convention": "6N+12LdS per token; no causal 1/2 factor; "
                                 "remat recompute NOT counted in headline",
-            "executed_tflops": round(executed_tflops, 2),
+            "executed_tflops": round(executed_tflops, 2)
+            if executed_tflops is not None else None,
             "measured_matmul_peak_tflops": round(peak, 1) if peak == peak else None,
             "mfu_vs_measured_peak": round(executed_tflops / peak, 3)
-            if peak == peak else None,
+            if (peak == peak and executed_tflops is not None) else None,
         },
     }
 
@@ -180,6 +191,9 @@ def main():
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--zero_stage", type=int, default=1)
+    ap.add_argument("--remat_policy", default=None,
+                    choices=["nothing_saveable", "dots_saveable", "save_attn"])
+    ap.add_argument("--no_remat", action="store_true")
     ap.add_argument("--prompt_len", type=int, default=128)
     ap.add_argument("--new_tokens", type=int, default=128)
     args = ap.parse_args()
@@ -199,7 +213,8 @@ def main():
             continue
         try:
             result = run(args.model, mb, args.seq_len, steps, args.warmup,
-                         args.zero_stage)
+                         args.zero_stage, remat_policy=args.remat_policy,
+                         remat=False if args.no_remat else None)
             print(json.dumps(result))
             return
         except Exception as e:  # OOM → retry smaller

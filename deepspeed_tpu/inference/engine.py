@@ -151,6 +151,11 @@ class InferenceEngine:
         """
         model = model or self._model
         if model is None or not hasattr(model, "apply_cached"):
+            if attention_mask is not None:
+                raise NotImplementedError(
+                    "attention_mask requires a KV-cache-capable model "
+                    "(apply_cached); the full-recompute fallback would "
+                    "silently attend to pad tokens")
             return self._generate_uncached(input_ids, max_new_tokens, eos_token_id,
                                            greedy, rng, temperature)
         ids = np.asarray(input_ids)
@@ -167,7 +172,7 @@ class InferenceEngine:
         # positions: cumulative index of real tokens (pads repeat the last)
         pos = np.maximum(np.cumsum(mpad, axis=1) - 1, 0).astype(np.int32)
 
-        key = (B, S_pad, max_new_tokens, greedy)
+        key = (id(model), B, S_pad, max_new_tokens, greedy)
         if key not in self._gen_cache:
             self._gen_cache[key] = self._generate_program(
                 model, B, S_pad, max_new_tokens, greedy)

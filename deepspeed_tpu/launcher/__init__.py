@@ -1,0 +1,9 @@
+"""Multi-host launcher (reference ``deepspeed/launcher/``).
+
+``python -m deepspeed_tpu.launcher [opts] script.py ...`` — see runner.py.
+"""
+from .runner import (fetch_hostfile, parse_resource_filter, encode_world_info,
+                     decode_world_info, main)
+
+__all__ = ["fetch_hostfile", "parse_resource_filter", "encode_world_info",
+           "decode_world_info", "main"]

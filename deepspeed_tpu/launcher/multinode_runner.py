@@ -1,0 +1,88 @@
+"""Multi-host fan-out backends (reference ``launcher/multinode_runner.py:51``).
+
+TPU-first: one ssh per host, each running ONE controller process that owns the
+host's chips — there is no per-rank nsenter/numactl business because device
+binding is the TPU runtime's job, and no MPI/pdsh dependency: a poll loop over
+one ssh subprocess per host covers the pod case, and ``LocalRunner`` covers
+same-host multi-process testing.
+"""
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+from typing import Dict, List, Optional
+
+from ..utils.logging import logger
+from .runner import wait_all_or_fail
+
+
+class MultiNodeRunner:
+    def __init__(self, args, active, base_env: Dict[str, str],
+                 pool: Optional[Dict[str, int]] = None):
+        self.args = args
+        self.active = active              # host -> slot list
+        self.hosts = list(active)
+        self.base_env = base_env
+        self.pool = pool or {}            # host -> total slots (pre-filter)
+
+    def env_for(self, host: str) -> Dict[str, str]:
+        env = dict(self.base_env)
+        env["PROCESS_ID"] = str(self.hosts.index(host))
+        # Only constrain chip visibility when a slot filter actually narrowed
+        # this host — hostfile ``slots=N`` alone is informational, and
+        # exporting it would silently hide chips on hosts with default slots.
+        slots = self.active[host]
+        total = self.pool.get(host)
+        if total is not None and slots != list(range(total)):
+            env["TPU_VISIBLE_CHIPS"] = ",".join(map(str, slots))
+        return env
+
+    def launch(self, user_cmd: List[str]) -> int:
+        raise NotImplementedError
+
+
+class SSHRunner(MultiNodeRunner):
+    """ssh-per-host fan-out; first failure (or ^C) terminates the job."""
+
+    def _ssh_cmd(self, host: str, user_cmd: List[str]) -> List[str]:
+        env = self.env_for(host)
+        exports = " ".join(f"export {k}={shlex.quote(v)};" for k, v in env.items())
+        remote = f"{exports} cd {shlex.quote(os.getcwd())}; {shlex.join(user_cmd)}"
+        ssh = ["ssh", "-o", "StrictHostKeyChecking=no", "-o", "BatchMode=yes"]
+        if self.args.ssh_port:
+            ssh += ["-p", str(self.args.ssh_port)]
+        if self.args.launcher_args:
+            ssh += shlex.split(self.args.launcher_args)
+        return ssh + [host, remote]
+
+    def launch(self, user_cmd: List[str]) -> int:
+        procs: List[subprocess.Popen] = []
+        for i, host in enumerate(self.hosts):
+            cmd = self._ssh_cmd(host, user_cmd)
+            logger.info("launcher[%s/%d]: %s", host, len(self.hosts),
+                        shlex.join(cmd[:6]) + " ...")
+            procs.append(subprocess.Popen(cmd))
+        rc = wait_all_or_fail(
+            procs,
+            on_fail=lambda i, rc: logger.error(
+                "launcher: host %s failed first with rc=%d", self.hosts[i], rc))
+        if rc == 130:
+            logger.info("launcher: interrupted; all hosts terminated")
+        return rc
+
+
+class LocalRunner(MultiNodeRunner):
+    """All 'hosts' are this machine: plain subprocesses (CI / laptops)."""
+
+    def launch(self, user_cmd: List[str]) -> int:
+        procs = []
+        port = self.base_env["COORDINATOR_ADDRESS"].rsplit(":", 1)[-1]
+        for host in self.hosts:
+            env = dict(os.environ)
+            env.update(self.env_for(host))
+            # every process is on THIS machine, so the coordinator must be
+            # loopback regardless of what --master_addr said
+            env["COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+            procs.append(subprocess.Popen(user_cmd, env=env))
+        return wait_all_or_fail(procs)

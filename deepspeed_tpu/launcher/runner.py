@@ -1,0 +1,308 @@
+"""`deepspeed_tpu` launcher CLI (reference ``launcher/runner.py:382``).
+
+TPU-first redesign: the unit of launch is a **host process**, not a GPU rank.
+Each host runs ONE controller process that drives all of its local TPU chips
+(JAX single-controller-per-host model); the launcher's job is host discovery,
+filtering, and fan-out — it does not manage per-chip ranks the way the
+reference manages ``LOCAL_RANK`` per GPU (``launcher/launch.py:132``).
+
+Resource discovery order:
+  1. ``--hostfile`` (lines of ``hostname slots=N``; N = TPU chips, informational)
+  2. single localhost fallback
+
+Fan-out:
+  - 1 host, rank 0 == us  -> exec locally (no ssh)
+  - multiple hosts        -> ssh per host (pdsh-style thread fan-out), each
+                             remote command exports the coordinator env
+                             (COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID)
+                             consumed by ``deepspeed_tpu.comm.init_distributed``
+  - ``--simulate N``      -> N local processes on a virtual CPU platform
+                             (debug SPMD code without a pod)
+
+``--include`` / ``--exclude`` use the reference's filter syntax
+(``runner.py:249``): ``host1@host2`` selects hosts, ``host1:0,2@host2:0-3``
+selects chip slots (slot selection narrows the advertised chip count; chip
+*visibility* is delegated to the TPU runtime via TPU_VISIBLE_CHIPS).
+"""
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import shlex
+import subprocess
+import sys
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from ..utils.logging import logger
+
+DEFAULT_COORD_PORT = 8476
+
+
+def parse_args(args=None):
+    p = argparse.ArgumentParser(
+        prog="deepspeed_tpu",
+        description="deepspeed_tpu multi-host launcher",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    p.add_argument("-H", "--hostfile", default="/job/hostfile",
+                   help="hostfile: lines of 'hostname slots=N'")
+    p.add_argument("-i", "--include", default="",
+                   help="hosts/slots to include, e.g. 'h1@h2' or 'h1:0,1@h2:0-3'")
+    p.add_argument("-e", "--exclude", default="",
+                   help="hosts/slots to exclude (mutually exclusive with -i per host)")
+    p.add_argument("--num_nodes", type=int, default=-1,
+                   help="cap the number of hosts used (first N of the pool)")
+    p.add_argument("--num_chips", "--num_gpus", dest="num_chips", type=int,
+                   default=-1, help="cap advertised chips per host")
+    p.add_argument("--master_addr", default="",
+                   help="coordinator address; default = first host in the pool")
+    p.add_argument("--master_port", type=int, default=DEFAULT_COORD_PORT,
+                   help="coordinator port")
+    p.add_argument("--launcher", default="ssh", choices=["ssh", "local"],
+                   help="multinode backend ('local' requires all hosts == localhost)")
+    p.add_argument("--launcher_args", default="",
+                   help="extra args passed to ssh (e.g. '-p 2222')")
+    p.add_argument("--ssh_port", type=int, default=None)
+    p.add_argument("--module", action="store_true",
+                   help="run user_script as 'python -m <module>'")
+    p.add_argument("--no_python", action="store_true",
+                   help="exec user_script directly (no python interpreter)")
+    p.add_argument("--simulate", type=int, default=0, metavar="N",
+                   help="run N local processes on a virtual CPU platform "
+                        "(SPMD debugging without a pod)")
+    p.add_argument("--save_pid", action="store_true",
+                   help="write launcher pid to /tmp/ds_tpu_launcher.pid")
+    p.add_argument("--force_multi", action="store_true",
+                   help="use the multinode path even for a single local host")
+    p.add_argument("user_script", help="training script (or module with --module)")
+    p.add_argument("user_args", nargs=argparse.REMAINDER)
+    return p.parse_args(args)
+
+
+def fetch_hostfile(path: str) -> "OrderedDict[str, int]":
+    """Parse ``hostname slots=N`` lines; missing file -> empty pool."""
+    if not os.path.isfile(path):
+        return OrderedDict()
+    pool: "OrderedDict[str, int]" = OrderedDict()
+    with open(path) as f:
+        for ln, line in enumerate(f, 1):
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            host = parts[0]
+            slots = 1
+            for tok in parts[1:]:
+                if tok.startswith("slots="):
+                    try:
+                        slots = int(tok.split("=", 1)[1])
+                    except ValueError:
+                        raise ValueError(f"{path}:{ln}: bad slots in {line!r}")
+                else:
+                    raise ValueError(
+                        f"{path}:{ln}: unrecognized token {tok!r} "
+                        f"(expected 'slots=N')")
+            if host in pool:
+                raise ValueError(f"{path}:{ln}: duplicate host {host!r}")
+            pool[host] = slots
+    return pool
+
+
+def _expand_slots(spec: str, nslots: int) -> List[int]:
+    """'0,2' | '0-3' | '1,3-5' -> sorted slot indices, validated."""
+    out = set()
+    for piece in spec.split(","):
+        piece = piece.strip()
+        if not piece:
+            continue
+        if "-" in piece:
+            lo, hi = piece.split("-", 1)
+            out.update(range(int(lo), int(hi) + 1))
+        else:
+            out.add(int(piece))
+    bad = [s for s in out if s < 0 or s >= nslots]
+    if bad:
+        raise ValueError(f"slot(s) {sorted(bad)} out of range [0,{nslots})")
+    return sorted(out)
+
+
+def parse_resource_filter(pool: "OrderedDict[str, int]", include: str = "",
+                          exclude: str = "") -> "OrderedDict[str, List[int]]":
+    """Apply the '@'-separated host[:slots] filter grammar to the pool.
+
+    Returns host -> selected slot indices.  A host may appear in include or
+    exclude, not both; slot-less exclude drops the whole host.
+    """
+    full: "OrderedDict[str, List[int]]" = OrderedDict(
+        (h, list(range(n))) for h, n in pool.items())
+    if include and exclude:
+        inc_hosts = {t.split(":")[0] for t in include.split("@") if t}
+        exc_hosts = {t.split(":")[0] for t in exclude.split("@") if t}
+        both = inc_hosts & exc_hosts
+        if both:
+            raise ValueError(f"host(s) {sorted(both)} in both -i and -e")
+
+    def _parse(filter_str):
+        sel: "OrderedDict[str, Optional[List[int]]]" = OrderedDict()
+        for term in filter_str.split("@"):
+            term = term.strip()
+            if not term:
+                continue
+            if ":" in term:
+                host, slots = term.split(":", 1)
+                host = host.strip()
+                if host not in full:
+                    raise ValueError(f"filter host {host!r} not in resource pool")
+                sel[host] = _expand_slots(slots, pool[host])
+            else:
+                if term not in full:
+                    raise ValueError(f"filter host {term!r} not in resource pool")
+                sel[term] = None  # whole host
+        return sel
+
+    if include:
+        inc = _parse(include)
+        out: "OrderedDict[str, List[int]]" = OrderedDict()
+        for h, slots in inc.items():
+            out[h] = slots if slots is not None else full[h]
+        return out
+    if exclude:
+        exc = _parse(exclude)
+        out = OrderedDict()
+        for h, slots in full.items():
+            if h in exc:
+                dropped = exc[h]
+                if dropped is None:
+                    continue  # whole host excluded
+                keep = [s for s in slots if s not in dropped]
+                if keep:
+                    out[h] = keep
+            else:
+                out[h] = slots
+        return out
+    return full
+
+
+def encode_world_info(active: "OrderedDict[str, List[int]]") -> str:
+    return base64.urlsafe_b64encode(
+        json.dumps(active).encode()).decode()
+
+
+def decode_world_info(blob: str) -> "OrderedDict[str, List[int]]":
+    return OrderedDict(json.loads(base64.urlsafe_b64decode(blob.encode())))
+
+
+def _build_user_cmd(args) -> List[str]:
+    if args.no_python:
+        cmd = [args.user_script]
+    elif args.module:
+        cmd = [sys.executable, "-u", "-m", args.user_script]
+    else:
+        cmd = [sys.executable, "-u", args.user_script]
+    return cmd + list(args.user_args)
+
+
+def _run_local_single(args, active) -> int:
+    env = dict(os.environ)
+    env.pop("COORDINATOR_ADDRESS", None)  # single-process mode
+    cmd = _build_user_cmd(args)
+    logger.info("launcher: single-host local exec: %s", shlex.join(cmd))
+    return subprocess.call(cmd, env=env)
+
+
+def wait_all_or_fail(procs, poll_s: float = 0.2, on_fail=None) -> int:
+    """Wait on a set of processes; on the FIRST nonzero exit, terminate the
+    survivors and return that exit code (a sequential ``wait`` loop would hang
+    on an earlier-indexed process blocked in rendezvous while a later one has
+    already died).  KeyboardInterrupt terminates everything and returns 130.
+    ``on_fail(idx, rc)`` is called for the root-cause process only — never for
+    the SIGTERM-ed survivors."""
+    import time
+
+    def _reap_all():
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            p.wait()
+
+    try:
+        while True:
+            rcs = [p.poll() for p in procs]
+            failed = [(i, rc) for i, rc in enumerate(rcs) if rc not in (None, 0)]
+            if failed:
+                _reap_all()
+                idx, rc = failed[0]
+                if on_fail is not None:
+                    on_fail(idx, rc)
+                return rc
+            if all(rc is not None for rc in rcs):
+                return 0
+            time.sleep(poll_s)
+    except KeyboardInterrupt:
+        _reap_all()
+        return 130
+
+
+def _run_simulate(args, n: int) -> int:
+    """N local processes, virtual CPU devices, loopback coordinator."""
+    procs = []
+    for pid in range(n):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "COORDINATOR_ADDRESS": f"127.0.0.1:{args.master_port}",
+            "NUM_PROCESSES": str(n),
+            "PROCESS_ID": str(pid),
+            "TPU_VISIBLE_CHIPS": "",
+        })
+        procs.append(subprocess.Popen(_build_user_cmd(args), env=env))
+    return wait_all_or_fail(procs)
+
+
+def main(args=None) -> int:
+    args = parse_args(args)
+    if args.save_pid:
+        with open("/tmp/ds_tpu_launcher.pid", "w") as f:
+            f.write(str(os.getpid()))
+
+    if args.simulate > 0:
+        return _run_simulate(args, args.simulate)
+
+    pool = fetch_hostfile(args.hostfile)
+    if not pool:
+        if args.include or args.exclude or args.num_nodes > 0:
+            raise ValueError(
+                "host filters given but no hostfile found at "
+                f"{args.hostfile!r} (single-host fallback has no pool)")
+        pool = OrderedDict([("localhost", args.num_chips if args.num_chips > 0 else 1)])
+    active = parse_resource_filter(pool, args.include, args.exclude)
+    if args.num_nodes > 0:
+        active = OrderedDict(list(active.items())[:args.num_nodes])
+    if args.num_chips > 0:
+        active = OrderedDict((h, s[:args.num_chips]) for h, s in active.items())
+    if not active:
+        raise ValueError("resource filters selected zero hosts")
+
+    hosts = list(active)
+    multi = len(hosts) > 1 or args.force_multi
+    if not multi and hosts[0] in ("localhost", "127.0.0.1"):
+        return _run_local_single(args, active)
+
+    from .multinode_runner import LocalRunner, SSHRunner
+
+    master = args.master_addr or hosts[0]
+    base_env = {
+        "COORDINATOR_ADDRESS": f"{master}:{args.master_port}",
+        "NUM_PROCESSES": str(len(hosts)),
+        "DS_TPU_WORLD_INFO": encode_world_info(active),
+    }
+    cls = SSHRunner if args.launcher == "ssh" else LocalRunner
+    runner = cls(args, active, base_env, pool=pool)
+    return runner.launch(_build_user_cmd(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -433,6 +433,39 @@ def _alibi_bias(cfg, positions, num_heads, S, dtype):
     return (-jnp.abs(rel)[:, None, :, :] * slopes[None, :, None, None]).astype(dtype)
 
 
+def _mlp(cfg: TransformerConfig, lp: Dict[str, Any], h, rng, deterministic):
+    """Post-norm MLP/MoE body shared by the training block and the KV-cached
+    decode block: returns (output, moe_aux_loss)."""
+    aux = jnp.float32(0.0)
+    if cfg.num_experts > 1:
+        from ..moe.sharded_moe import MoEConfig, moe_ffn
+
+        m, aux = moe_ffn(
+            h, lp["router"], lp,
+            MoEConfig(num_experts=cfg.num_experts, top_k=cfg.moe_top_k,
+                      capacity_factor=cfg.capacity_factor,
+                      eval_capacity_factor=cfg.eval_capacity_factor,
+                      min_capacity=cfg.moe_min_capacity,
+                      noisy_gate_policy=cfg.noisy_gate_policy),
+            activation=cfg.activation, deterministic=deterministic, rng=rng)
+    elif cfg.activation == "swiglu":
+        g = h @ lp["w_gate"]
+        u = h @ lp["w_up"]
+        if cfg.mlp_bias:
+            g, u = g + lp["b_gate"], u + lp["b_up"]
+        m = jax.nn.silu(g) * u
+        m = m @ lp["w_down"]
+    else:
+        m = h @ lp["w_in"]
+        if cfg.mlp_bias:
+            m = m + lp["b_in"]
+        m = jax.nn.gelu(m)
+        m = m @ lp["w_down"]
+    if cfg.num_experts == 1 and cfg.mlp_bias:
+        m = m + lp["b_down"]
+    return m, aux
+
+
 def _block(cfg: TransformerConfig, lp: Dict[str, Any], x, positions, rng,
            attn_impl: str, deterministic: bool, custom_positions: bool = False):
     B, S, d = x.shape
@@ -465,34 +498,8 @@ def _block(cfg: TransformerConfig, lp: Dict[str, Any], x, positions, rng,
     x = x + attn
 
     h = _norm(cfg, x, lp["mlp_norm_scale"], lp.get("mlp_norm_bias"))
-    aux = jnp.float32(0.0)
-    if cfg.num_experts > 1:
-        from ..moe.sharded_moe import MoEConfig, moe_ffn
-
-        rng, sub = jax.random.split(rng)
-        m, aux = moe_ffn(
-            h, lp["router"], lp,
-            MoEConfig(num_experts=cfg.num_experts, top_k=cfg.moe_top_k,
-                      capacity_factor=cfg.capacity_factor,
-                      eval_capacity_factor=cfg.eval_capacity_factor,
-                      min_capacity=cfg.moe_min_capacity,
-                      noisy_gate_policy=cfg.noisy_gate_policy),
-            activation=cfg.activation, deterministic=deterministic, rng=sub)
-    elif cfg.activation == "swiglu":
-        g = h @ lp["w_gate"]
-        u = h @ lp["w_up"]
-        if cfg.mlp_bias:
-            g, u = g + lp["b_gate"], u + lp["b_up"]
-        m = jax.nn.silu(g) * u
-        m = m @ lp["w_down"]
-    else:
-        m = h @ lp["w_in"]
-        if cfg.mlp_bias:
-            m = m + lp["b_in"]
-        m = jax.nn.gelu(m)
-        m = m @ lp["w_down"]
-    if cfg.num_experts == 1 and cfg.mlp_bias:
-        m = m + lp["b_down"]
+    rng, sub = jax.random.split(rng)
+    m, aux = _mlp(cfg, lp, h, sub, deterministic)
     if cfg.dropout and not deterministic:
         rng, sub = jax.random.split(rng)
         m = m * jax.random.bernoulli(sub, 1 - cfg.dropout, m.shape) / (1 - cfg.dropout)
@@ -681,32 +688,7 @@ def _block_cached(cfg, lp, x, ck, cv, q_pos, q_slot, valid, kpos, next_slot,
     x = x + attn
 
     h = _norm(cfg, x, lp["mlp_norm_scale"], lp.get("mlp_norm_bias"))
-    if cfg.num_experts > 1:
-        from ..moe.sharded_moe import MoEConfig, moe_ffn
-
-        m, _ = moe_ffn(
-            h, lp["router"], lp,
-            MoEConfig(num_experts=cfg.num_experts, top_k=cfg.moe_top_k,
-                      capacity_factor=cfg.capacity_factor,
-                      eval_capacity_factor=cfg.eval_capacity_factor,
-                      min_capacity=cfg.moe_min_capacity,
-                      noisy_gate_policy=cfg.noisy_gate_policy),
-            activation=cfg.activation, deterministic=True, rng=rng)
-    elif cfg.activation == "swiglu":
-        g = h @ lp["w_gate"]
-        u = h @ lp["w_up"]
-        if cfg.mlp_bias:
-            g, u = g + lp["b_gate"], u + lp["b_up"]
-        m = jax.nn.silu(g) * u
-        m = m @ lp["w_down"]
-    else:
-        m = h @ lp["w_in"]
-        if cfg.mlp_bias:
-            m = m + lp["b_in"]
-        m = jax.nn.gelu(m)
-        m = m @ lp["w_down"]
-    if cfg.num_experts == 1 and cfg.mlp_bias:
-        m = m + lp["b_down"]
+    m, _ = _mlp(cfg, lp, h, rng, deterministic=True)
     return x + m, ck, cv
 
 

@@ -42,6 +42,20 @@ logger = _create_logger(level=_default_level)
 
 def _process_index() -> int:
     try:
+        # Private-API probe, guarded separately so a jax-internal rename only
+        # disables the pre-init fast path, not rank reporting itself.
+        from jax._src import xla_bridge
+        inited = bool(xla_bridge._backends)
+    except Exception:
+        inited = True
+    if not inited:
+        # Backend not initialized yet.  jax.process_index() would force
+        # backend init, which PERMANENTLY breaks a later
+        # jax.distributed.initialize() in this process — so answer from
+        # the launcher's env contract instead of touching jax.
+        return int(os.environ.get("PROCESS_ID",
+                                  os.environ.get("RANK", "0")) or 0)
+    try:
         import jax
 
         return jax.process_index()
