@@ -240,6 +240,10 @@ def broadcast_object(obj: Any, src_process: int = 0) -> Any:
     return multihost_utils.broadcast_one_to_all(obj)
 
 
-def log_summary() -> None:
+def log_summary(show_bandwidth: bool = False, print_log: bool = True):
+    """Print (and return) the comms table; ``show_bandwidth`` re-times each
+    (op, size) as a standalone microbench for algbw/busbw columns (the TPU
+    analogue of the reference's latency-derived columns, comm.py:408)."""
     if _comms_logger is not None:
-        _comms_logger.log_all()
+        return _comms_logger.log_all(print_log=print_log,
+                                     show_bandwidth=show_bandwidth)

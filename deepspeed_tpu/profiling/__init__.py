@@ -1,0 +1,5 @@
+"""Profiling (reference ``deepspeed/profiling/``): XLA-cost-analysis flops
+profiler; wall-clock breakdown lives in utils/timer.py."""
+from .flops_profiler import FlopsProfiler, get_model_profile
+
+__all__ = ["FlopsProfiler", "get_model_profile"]

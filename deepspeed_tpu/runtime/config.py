@@ -340,8 +340,6 @@ class DeepSpeedConfig:
             bad.append("zero_optimization.mics_shard_size (MiCS)")
         if zc.zero_hpz_partition_size > 1:
             bad.append("zero_optimization.zero_hpz_partition_size (ZeRO++ hpZ)")
-        if self.flops_profiler.enabled:
-            bad.append("flops_profiler.enabled")
         ac = self.activation_checkpointing
         for knob in ("cpu_checkpointing", "contiguous_memory_optimization",
                      "synchronize_checkpoint_boundary", "profile"):

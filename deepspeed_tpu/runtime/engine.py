@@ -78,6 +78,7 @@ class DeepSpeedEngine:
                  lr_scheduler: Optional[Callable] = None,
                  training_data: Any = None, mesh=None, dont_change_device: bool = False):
         # -- model contract resolution --
+        self.model = model
         if model is not None and loss_fn is None:
             # `model` may be an adapter object exposing (init_fn, loss_fn, param_specs)
             loss_fn = getattr(model, "loss_fn", None)
@@ -288,6 +289,16 @@ class DeepSpeedEngine:
         self._data_iterator = None
         self.training_dataloader = self._build_dataloader(training_data)
         self.monitor = self._build_monitor()
+        self.flops_profiler = None
+        if self.config.flops_profiler.enabled:
+            from ..profiling.flops_profiler import FlopsProfiler
+
+            self.flops_profiler = FlopsProfiler(engine=self,
+                                                config=self.config.flops_profiler)
+            if self.config.flops_profiler.profile_step <= 1:
+                log_dist("flops_profiler: profile_step=1 measures the first "
+                         "call, which INCLUDES jit compilation — set "
+                         "profile_step>=2 for steady-state latency", ranks=[0])
         self.param_count = sum(
             int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(shapes))
         log_dist(
@@ -543,8 +554,28 @@ class DeepSpeedEngine:
         global_batch = self._collect_global_batch(batch)
         if self._compiled_train_step is None:
             self._compiled_train_step = self._make_train_step()
+        profiling = (self.flops_profiler is not None
+                     and self.global_steps + 1 ==
+                     self.config.flops_profiler.profile_step)
+        if profiling:
+            import jax
+
+            jax.block_until_ready(self.state.params)
+            self.flops_profiler.start_profile()
         self.tput_timer.start()
         self.state, metrics = self._compiled_train_step(self.state, global_batch)
+        if profiling:
+            from ..profiling.flops_profiler import cost_analysis_of
+
+            float(metrics["loss"])  # scalar read = real device sync (axon-safe)
+            self.flops_profiler.stop_profile()
+            self.flops_profiler.attach_cost(cost_analysis_of(
+                self._compiled_train_step, self.state, global_batch))
+            fp = self.config.flops_profiler
+            self.flops_profiler.print_model_profile(
+                profile_step=fp.profile_step, module_depth=fp.module_depth,
+                top_modules=fp.top_modules, detailed=fp.detailed,
+                output_file=fp.output_file)
         self.global_steps += 1
         self.micro_steps += self.gas
         self._last_grad_norm = float(metrics["grad_norm"])

@@ -47,14 +47,45 @@ class CommsLogger:
         if self.verbose:
             logger.info(f"comm op: {op_name} | msg size: {convert_size(msg_size)}")
 
-    def log_all(self) -> None:
-        header = f"{'Comm. Op':<25}{'Message Size':<20}{'Count':<10}{'Total Traffic':<20}"
+    def log_all(self, print_log: bool = True, show_bandwidth: bool = False) -> str:
+        """Summary table; ``show_bandwidth`` re-times each (op, size) as a
+        standalone collective microbench (the reference logs call-site
+        latency, but XLA compiles collectives into the step so they have no
+        observable call-site — measuring the op in isolation is the honest
+        TPU equivalent and gives the same algbw/busbw columns)."""
+        header = (f"{'Comm. Op':<25}{'Message Size':<20}{'Count':<10}"
+                  f"{'Total Traffic':<20}")
+        if show_bandwidth:
+            header += f"{'algbw GB/s':<14}{'busbw GB/s':<14}"
         lines = [header]
         for op_name, sizes in sorted(self.comms_dict.items()):
             lines.append(op_name)
             for size, (count, total) in sorted(sizes.items()):
-                lines.append(f"{'':<25}{convert_size(size):<20}{count:<10}{convert_size(total):<20}")
-        logger.info("\n".join(lines))
+                row = (f"{'':<25}{convert_size(size):<20}{count:<10}"
+                       f"{convert_size(total):<20}")
+                if show_bandwidth:
+                    row += self._bandwidth_cols(op_name, size)
+                lines.append(row)
+        if print_log:
+            logger.info("\n".join(lines))
+        return "\n".join(lines)
+
+    def _bandwidth_cols(self, op_name: str, size: int) -> str:
+        try:
+            from ..comm.benchmark import BUSBW_FACTOR, run_op
+
+            key = op_name if op_name in BUSBW_FACTOR else {
+                "all_reduce_coalesced": "all_reduce",
+                "reduce": "all_reduce",
+                "reduce_scatter_tensor": "reduce_scatter",
+                "all_gather_into_tensor": "all_gather",
+            }.get(op_name)
+            if key is None or size <= 0:
+                return f"{'-':<14}{'-':<14}"
+            r = run_op(key, size, trials=5, warmups=2)
+            return f"{r['algbw_gbps']:<14.2f}{r['busbw_gbps']:<14.2f}"
+        except Exception:
+            return f"{'-':<14}{'-':<14}"
 
     def reset(self) -> None:
         self.comms_dict.clear()

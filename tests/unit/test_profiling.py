@@ -1,0 +1,117 @@
+"""Flops profiler (XLA cost analysis) + collective microbench + comms-logger
+bandwidth columns (reference profiling/flops_profiler tests model:
+tests/unit/profiling/flops_profiler/test_flops_profiler.py)."""
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import CausalLM
+from deepspeed_tpu.profiling import FlopsProfiler, get_model_profile
+from deepspeed_tpu.profiling.flops_profiler import cost_analysis_of
+
+
+def _analytic_fwd_flops(cfg, batch, seq):
+    # 2N matmul flops per token forward (+ attention, small at seq=64)
+    return 2.0 * cfg.param_count * batch * seq
+
+
+def test_get_model_profile_numbers():
+    model = CausalLM("tiny")
+    flops, macs, params = get_model_profile(
+        model, batch_size=2, seq_len=64, as_string=False, print_profile=False,
+        warm_up=-1)
+    assert params == model.param_count
+    assert macs == flops / 2
+    analytic = _analytic_fwd_flops(model.config, 2, 64)
+    # compiled flops should be within 3x of the analytic dense count
+    # (embeddings/softmax/attention add, fusion removes)
+    assert 0.3 * analytic < flops < 5 * analytic, (flops, analytic)
+
+
+def test_get_model_profile_strings():
+    model = CausalLM("tiny")
+    flops, macs, params = get_model_profile(
+        model, batch_size=1, seq_len=32, as_string=True, print_profile=False,
+        warm_up=-1)
+    assert "FLOPS" in flops and "MACs" in macs
+
+
+def test_cost_analysis_of_matmul():
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda a, b: a @ b)
+    a = jnp.ones((128, 256), jnp.float32)
+    b = jnp.ones((256, 64), jnp.float32)
+    ca = cost_analysis_of(f, a, b)
+    # 2*M*K*N flops
+    assert abs(ca["flops"] - 2 * 128 * 256 * 64) / (2 * 128 * 256 * 64) < 0.1
+
+
+def test_engine_profiler_prints_and_reports(tmp_path):
+    report = tmp_path / "flops.txt"
+    model = CausalLM("tiny")
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0},
+        "bf16": {"enabled": True},
+        "flops_profiler": {"enabled": True, "profile_step": 2,
+                           "output_file": str(report)},
+    })
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(
+        0, model.config.vocab_size,
+        (engine.train_batch_size, 32)).astype(np.int32)}
+    for _ in range(3):
+        engine.train_batch(batch=batch)
+    out = report.read_text()
+    assert "Flops Profiler" in out
+    assert "flops per step" in out
+    prof = engine.flops_profiler
+    assert prof.get_total_flops() > 0
+    assert prof.get_total_params() == model.param_count
+    assert prof.get_total_duration() > 0
+    # train step (fwd+bwd+opt) must cost more than a bare forward
+    fwd, _, _ = get_model_profile(model, batch_size=2, seq_len=32,
+                                  as_string=False, print_profile=False,
+                                  warm_up=-1)
+    assert prof.get_total_flops() > 2 * fwd
+
+
+def test_flops_profiler_config_no_longer_rejected():
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    cfg = DeepSpeedConfig({"train_batch_size": 2,
+                           "flops_profiler": {"enabled": True}})
+    assert cfg.flops_profiler.enabled
+
+
+@pytest.mark.parametrize("op", ["all_reduce", "all_gather", "reduce_scatter",
+                                "all_to_all", "broadcast", "ppermute"])
+def test_comm_benchmark_ops(op):
+    from deepspeed_tpu.comm.benchmark import run_op
+
+    r = run_op(op, 1 << 16, trials=2, warmups=1)
+    assert r["n_devices"] >= 1
+    assert r["algbw_gbps"] > 0
+    assert r["busbw_gbps"] > 0
+    assert r["size_bytes"] >= (1 << 16) * 0.5
+
+
+def test_comms_logger_bandwidth_columns():
+    from deepspeed_tpu.utils.comms_logging import CommsLogger
+
+    cl = CommsLogger()
+    cl.append("all_reduce", 1 << 16)
+    cl.append("all_reduce", 1 << 16)
+    cl.append("weird_op", 123)
+    table = cl.log_all(print_log=False, show_bandwidth=True)
+    lines = [ln for ln in table.splitlines() if "KB" in ln or "B" in ln]
+    assert any("all_reduce" in ln for ln in table.splitlines())
+    # measured bandwidth for the known op, dashes for the unknown one
+    ar_row = [ln for ln in lines if "64.0 KB" in ln][0]
+    bw_cols = ar_row.split("KB")[-1].split()
+    assert len(bw_cols) == 2 and all(float(c) > 0 for c in bw_cols), ar_row
+    weird_row = [ln for ln in lines if "123" in ln or "123.0" in ln]
+    assert weird_row and "-" in weird_row[0]
