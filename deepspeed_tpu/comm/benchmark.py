@@ -25,7 +25,6 @@ from __future__ import annotations
 
 import argparse
 import time
-from functools import partial
 from typing import Callable, Dict
 
 BUSBW_FACTOR: Dict[str, Callable[[int], float]] = {

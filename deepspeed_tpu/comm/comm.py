@@ -244,6 +244,7 @@ def log_summary(show_bandwidth: bool = False, print_log: bool = True):
     """Print (and return) the comms table; ``show_bandwidth`` re-times each
     (op, size) as a standalone microbench for algbw/busbw columns (the TPU
     analogue of the reference's latency-derived columns, comm.py:408)."""
-    if _comms_logger is not None:
-        return _comms_logger.log_all(print_log=print_log,
-                                     show_bandwidth=show_bandwidth)
+    if _comms_logger is None:
+        return ""
+    return _comms_logger.log_all(print_log=print_log,
+                                 show_bandwidth=show_bandwidth)

@@ -24,6 +24,7 @@ Canonical axis order (outermost → innermost):
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import math
 from typing import Optional, Sequence, Tuple
@@ -174,10 +175,27 @@ def shard_map_compat(fn, mesh: Mesh, in_specs, out_specs):
                **{kw: False})
 
 
+_IN_MANUAL_REGION = False
+
+
+@contextlib.contextmanager
+def manual_region():
+    """Trace-time flag: model code traced inside a fully-manual shard_map
+    region must skip sharding constraints (all mesh axes are manual there,
+    and with_sharding_constraint on a manual axis is an error)."""
+    global _IN_MANUAL_REGION
+    prev, _IN_MANUAL_REGION = _IN_MANUAL_REGION, True
+    try:
+        yield
+    finally:
+        _IN_MANUAL_REGION = prev
+
+
 def constrain_spec(x, spec: P):
     """``with_sharding_constraint`` against the global mesh; no-op when no
-    mesh has been initialized (single-device eager tests)."""
-    if _GLOBAL_MESH is None:
+    mesh has been initialized (single-device eager tests) or while tracing
+    inside a manual shard_map region."""
+    if _GLOBAL_MESH is None or _IN_MANUAL_REGION:
         return x
     return jax.lax.with_sharding_constraint(x, named(_GLOBAL_MESH, spec))
 

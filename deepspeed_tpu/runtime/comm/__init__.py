@@ -1,0 +1,7 @@
+"""Compressed communication backends (reference ``deepspeed/runtime/comm/``)."""
+from .compressed import (compressed_mean, ef_compress, ef_decode,
+                         init_error_tree, make_compressed_grad_fn, pack_signs,
+                         unpack_signs)
+
+__all__ = ["compressed_mean", "ef_compress", "ef_decode", "init_error_tree",
+           "make_compressed_grad_fn", "pack_signs", "unpack_signs"]

@@ -59,6 +59,7 @@ class TrainState:
     opt_state: Any
     scaler: LossScaleState
     rng: jnp.ndarray
+    comm_error: Any = None            # 1-bit error-feedback buffers (per-worker)
 
 
 def _cast_tree(tree, dtype):
@@ -140,6 +141,7 @@ class DeepSpeedEngine:
             self.lr_schedule = constant_lr(lr)
 
         # -- optimizer --
+        self._compression = None
         if optimizer is not None:
             self.optimizer = optimizer
         else:
@@ -148,6 +150,22 @@ class DeepSpeedEngine:
             opt_params = dict(opt_cfg.params) if opt_cfg else {}
             self.optimizer = create_optimizer(opt_type, opt_params, self.lr_schedule,
                                               self.config.gradient_clipping)
+            if opt_type.lower().replace("_", "") in ("onebitadam", "onebitlamb",
+                                                     "zerooneadam"):
+                # 1-bit path: error-feedback sign-compressed grad exchange
+                # after freeze_step warmup (reference fp16/onebit/adam.py:308)
+                self._compression = {
+                    "freeze_step": int(opt_params.get("freeze_step", 100))}
+                for ax in ("model", "seq", "pipe", "expert"):
+                    if self.mesh.shape.get(ax, 1) > 1:
+                        raise ValueError(
+                            f"1-bit optimizers need a pure-DP mesh ({ax} "
+                            f"axis has size {self.mesh.shape[ax]})")
+                if self.zero_stage > 1:
+                    raise ValueError(
+                        "1-bit optimizers compose with ZeRO stage <= 1 only "
+                        "(stages 2/3 shard gradients; the reference has the "
+                        "same restriction)")
 
         # -- sharded initialization (the zero.Init analogue: params are BORN
         #    sharded; nothing ever materializes replicated, reference
@@ -254,8 +272,17 @@ class DeepSpeedEngine:
                 if master is not None:
                     master = jax.tree_util.tree_map(to_host, master)
                 self.offload_active = True
+        comm_error = None
+        if self._compression is not None:
+            from .comm.compressed import init_error_tree
+
+            template = master if self.use_master_weights else params0
+            comm_error = jax.device_put(
+                init_error_tree(template, self.mesh),
+                NamedSharding(self.mesh, P(BATCH_AXES)))
         self.state = TrainState(step=step0, params=params0, master_params=master,
-                                opt_state=opt_state, scaler=scaler, rng=seed_rng)
+                                opt_state=opt_state, scaler=scaler, rng=seed_rng,
+                                comm_error=comm_error)
         # Out-shardings pin every state leaf back to where it started (host
         # for offloaded leaves); metrics come back replicated on device.
         # The matching device-kind shardings stream the offloaded leaves INTO
@@ -433,6 +460,39 @@ class DeepSpeedEngine:
         compute_tree = self._make_compute_tree()
         apply_update = self._make_update_body()
         stream_in = self._stream_in
+
+        compression = self._compression
+        if compression is not None:
+            from .comm.compressed import make_compressed_grad_fn
+
+            template = (self.state.master_params if self.use_master_weights
+                        else self.state.params)
+            comp_grad = make_compressed_grad_fn(
+                grad_of_batch, self.mesh, gas, compression["freeze_step"],
+                template)
+
+            def train_step(state: TrainState, batch):
+                masters, opt_in = stream_in(state)
+                work = compute_tree(masters)
+                new_rng, region_rng = jax.random.split(state.rng)
+                grads, losses, new_error = comp_grad(
+                    work, state.scaler, batch, region_rng, state.comm_error,
+                    state.step)
+                new_state, metrics = apply_update(state, masters, opt_in,
+                                                  grads, gas)
+                # overflow => the step was skipped; the error buffer must not
+                # absorb the inf/NaN residual or EF poisons every later step
+                new_error = _tree_select(metrics["step_applied"], new_error,
+                                         state.comm_error)
+                new_state = dataclasses.replace(new_state, rng=new_rng,
+                                                comm_error=new_error)
+                metrics["loss"] = jnp.mean(losses)
+                return new_state, metrics
+
+            if self._train_out_shardings is not None:
+                return jax.jit(train_step, donate_argnums=(0,),
+                               out_shardings=self._train_out_shardings)
+            return jax.jit(train_step, donate_argnums=(0,))
 
         def train_step(state: TrainState, batch):
             masters, opt_in = stream_in(state)
@@ -658,6 +718,10 @@ class DeepSpeedEngine:
             raise RuntimeError("pipeline engines train with train_batch(); "
                                "per-microbatch forward/backward is not exposed "
                                "(reference PipelineEngine restriction)")
+        if self._compression is not None:
+            raise NotImplementedError(
+                "1-bit optimizers run through train_batch() (the compressed "
+                "exchange spans the whole accumulation window)")
         if self._compiled_micro_grad is None:
             self._compiled_micro_grad = self._make_micro_grad_step()
         if self._accum_grads is None:

@@ -51,12 +51,11 @@ def _base_transform(name: str, params: Dict[str, Any]) -> optax.GradientTransfor
                 chain.insert(0, optax.add_decayed_weights(weight_decay))
         return optax.chain(*chain)
     if name in (ONEBIT_ADAM_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER):
-        # Error-feedback sign-compressed DP communication only pays across DCN
-        # (slices); the local optimizer math is Adam.  The compressed-comm leg
-        # lives in runtime/comm/compressed.py and is engaged by the engine when
-        # the mesh has a DCN axis; here we supply the Adam math.
-        logger.warning(f"{name}: using Adam math; compressed DP comm engages on "
-                       "multi-slice meshes only")
+        # Adam math on the compressed-averaged gradient; the error-feedback
+        # sign-compressed DP exchange itself lives in runtime/comm/compressed.py
+        # and is wired in by the engine (freeze_step warmup included).
+        params = {k: v for k, v in params.items()
+                  if k not in ("freeze_step", "cuda_aware", "comm_backend_name")}
         return _base_transform(ADAM_OPTIMIZER, params)
     if name in (LAMB_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER):
         return optax.chain(

@@ -1,0 +1,144 @@
+"""1-bit (error-feedback sign-compressed) gradient exchange
+(reference runtime/comm/nccl.py:54 compressed_allreduce +
+runtime/fp16/onebit/adam.py; tests model tests/unit/comm/test_coalesced_collectives.py
+and tests/unit/runtime/half_precision/onebit/test_onebit.py)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.parallel import mesh as mesh_mod
+from deepspeed_tpu.parallel.mesh import MeshLayout, initialize_mesh
+from deepspeed_tpu.runtime.comm.compressed import (ef_compress, ef_decode,
+                                                   pack_signs, unpack_signs)
+
+from .simple_model import SimpleModel, random_batch
+
+HID = 64
+
+
+@pytest.fixture(autouse=True)
+def _fresh_mesh():
+    mesh_mod.reset_mesh()
+    yield
+    mesh_mod.reset_mesh()
+
+
+def test_pack_unpack_roundtrip():
+    rng = np.random.default_rng(0)
+    signs = rng.random(512) > 0.5
+    out = unpack_signs(pack_signs(jnp.asarray(signs)))
+    np.testing.assert_array_equal(np.asarray(out), np.where(signs, 1.0, -1.0))
+
+
+def test_ef_compress_error_feedback_telescopes():
+    """decode(message) + error == corrected  (nothing is lost, only deferred)."""
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal(1024), jnp.float32)
+    err0 = jnp.zeros_like(x)
+    packed, scales, err1 = ef_compress(x, err0, block=256)
+    decoded = ef_decode(packed, scales, block=256)
+    np.testing.assert_allclose(np.asarray(decoded + err1), np.asarray(x),
+                               rtol=1e-5, atol=1e-5)
+    # second round: error is carried, not dropped
+    y = jnp.asarray(rng.standard_normal(1024), jnp.float32)
+    packed2, scales2, err2 = ef_compress(y, err1, block=256)
+    np.testing.assert_allclose(
+        np.asarray(ef_decode(packed2, scales2, 256) + err2),
+        np.asarray(y + err1), rtol=1e-5, atol=1e-5)
+
+
+def _make_engine(opt_type, freeze_step=2, steps=None, lr=1e-3, stage=1):
+    initialize_mesh(MeshLayout(dp=8))
+    model = SimpleModel(HID)
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": opt_type,
+                      "params": {"lr": lr, "freeze_step": freeze_step}},
+        "zero_optimization": {"stage": stage},
+        "bf16": {"enabled": True},
+    }
+    if opt_type in ("adam", "adamw"):
+        config["optimizer"]["params"].pop("freeze_step")
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    return engine
+
+
+def _train(engine, steps=8, seed=0, fixed_batch=False):
+    return [float(engine.train_batch(
+        batch=random_batch(engine.train_batch_size, HID,
+                           seed if fixed_batch else seed + s)))
+        for s in range(steps)]
+
+
+def test_onebit_adam_trains_and_tracks_adam():
+    ref = _train(_make_engine("adam"), steps=12, fixed_batch=True)
+    mesh_mod.reset_mesh()
+    ob = _train(_make_engine("onebitadam", freeze_step=3), steps=12,
+                fixed_batch=True)
+    assert np.isfinite(ob).all()
+    # warmup steps are exact full-precision parity
+    np.testing.assert_allclose(ob[:3], ref[:3], rtol=2e-2)
+    # compressed phase keeps optimizing (fixed batch => loss must drop)
+    assert ob[-1] < ob[3]
+    # and lands within distance of uncompressed Adam on the same trajectory
+    assert ob[-1] < 4 * ref[-1] + 0.05
+
+
+def test_onebit_warmup_is_exact_fullprecision():
+    ref = _train(_make_engine("adam"), steps=4)
+    mesh_mod.reset_mesh()
+    ob = _train(_make_engine("onebitadam", freeze_step=100), steps=4)
+    np.testing.assert_allclose(ob, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_onebit_wire_format_is_uint8():
+    """The compiled train step must contain a u8 all-gather — the compressed
+    sign tensor really is the wire format (same structural check style as
+    test_zeropp)."""
+    engine = _make_engine("onebitadam", freeze_step=1)
+    batch = random_batch(engine.train_batch_size, HID, 0)
+    engine.train_batch(batch=batch)  # compile + run
+    hlo = engine._compiled_train_step.lower(
+        engine.state, engine._collect_global_batch(batch)).compile().as_text()
+    assert "u8[" in hlo and "all-gather" in hlo, "no uint8 all-gather in HLO"
+
+
+def test_onebit_error_state_becomes_nonzero():
+    engine = _make_engine("onebitadam", freeze_step=1)
+    for s in range(3):
+        engine.train_batch(batch=random_batch(engine.train_batch_size, HID, s))
+    err_norm = sum(float(jnp.abs(e).sum())
+                   for e in jax.tree_util.tree_leaves(engine.state.comm_error))
+    assert err_norm > 0.0  # compression residual is being carried
+
+
+def test_onebit_rejects_zero23():
+    with pytest.raises(ValueError, match="ZeRO stage"):
+        _make_engine("onebitadam", stage=2)
+
+
+def test_onebit_rejects_model_parallel():
+    model = SimpleModel(HID)
+    with pytest.raises(ValueError, match="pure-DP"):
+        deepspeed_tpu.initialize(model=model, config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "onebitadam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1},
+            "bf16": {"enabled": True},
+            "mesh": {"tp": 2},
+        })
+
+
+def test_onebit_forward_backward_loop_raises():
+    engine = _make_engine("onebitadam")
+    with pytest.raises(NotImplementedError, match="train_batch"):
+        engine.forward(random_batch(engine.train_batch_size, HID, 0))
+
+
+def test_onebit_lamb_trains():
+    losses = _train(_make_engine("onebitlamb", freeze_step=2, lr=5e-3), steps=6,
+                    fixed_batch=True)
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
