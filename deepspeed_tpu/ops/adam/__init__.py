@@ -1,0 +1,4 @@
+"""Host optimizer kernels (reference ``deepspeed/ops/adam/``)."""
+from .cpu_adam import DeepSpeedCPUAdam
+
+__all__ = ["DeepSpeedCPUAdam"]

@@ -1,0 +1,111 @@
+"""DeepSpeedCPUAdam — host-side SIMD Adam for offloaded optimizer states.
+
+Reference: ``deepspeed/ops/adam/cpu_adam.py`` (class ``DeepSpeedCPUAdam``)
+backed by ``csrc/adam/cpu_adam_impl.cpp``.  The TPU build's native kernel
+(ops/csrc/cpu_adam.cpp, OpenMP+SIMD) updates fp32 masters and both moments
+in one fused pass over host RAM, optionally emitting the bf16 device view in
+the same sweep — the host leg of ZeRO-Offload while the chip runs the next
+forward.
+
+Torch-free API: state tensors are numpy arrays (optionally memory-mapped
+from NVMe by runtime/swap_tensor); ``step_flat`` is the single-buffer hot
+path, ``step`` walks a pytree of parameter leaves.
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..op_builder import CPUAdamBuilder
+
+_U16 = ctypes.POINTER(ctypes.c_uint16)
+
+
+def _fptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+class DeepSpeedCPUAdam:
+    """Adam/AdamW over host numpy buffers via the native kernel."""
+
+    def __init__(self, lr: float = 1e-3, betas: Tuple[float, float] = (0.9, 0.999),
+                 eps: float = 1e-8, weight_decay: float = 0.0,
+                 adamw_mode: bool = True, bias_correction: bool = True):
+        self.lr = lr
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adamw_mode = adamw_mode
+        self.bias_correction = bias_correction
+        self.step_count = 0
+        self._lib = CPUAdamBuilder().load()
+
+    # -- flat-buffer hot path -------------------------------------------
+    def step_flat(self, params: np.ndarray, grads: np.ndarray,
+                  exp_avg: np.ndarray, exp_avg_sq: np.ndarray,
+                  step: Optional[int] = None,
+                  bf16_out: Optional[np.ndarray] = None,
+                  lr: Optional[float] = None) -> None:
+        """In-place Adam step on contiguous fp32 buffers of equal length."""
+        for name, a in (("params", params), ("grads", grads),
+                        ("exp_avg", exp_avg), ("exp_avg_sq", exp_avg_sq)):
+            if a.dtype != np.float32 or not a.flags["C_CONTIGUOUS"]:
+                raise TypeError(f"{name} must be contiguous float32")
+        n = params.size
+        if not (grads.size == exp_avg.size == exp_avg_sq.size == n):
+            raise ValueError("buffer sizes differ")
+        out = None
+        if bf16_out is not None:
+            if bf16_out.dtype != np.uint16 or bf16_out.size != n:
+                raise TypeError("bf16_out must be uint16 of the same size")
+            out = bf16_out.ctypes.data_as(_U16)
+        self._lib.cpu_adam_step(
+            _fptr(params), _fptr(grads), _fptr(exp_avg), _fptr(exp_avg_sq),
+            n, np.float32(lr if lr is not None else self.lr),
+            np.float32(self.betas[0]), np.float32(self.betas[1]),
+            np.float32(self.eps), np.float32(self.weight_decay),
+            int(self.adamw_mode), int(self.bias_correction),
+            int(step if step is not None else self.step_count), out)
+
+    # -- pytree API ------------------------------------------------------
+    def init_state(self, params: Any) -> Dict[str, Any]:
+        import jax
+
+        zeros = jax.tree_util.tree_map(
+            lambda p: np.zeros(np.shape(p), np.float32), params)
+        return {"exp_avg": zeros,
+                "exp_avg_sq": jax.tree_util.tree_map(np.copy, zeros)}
+
+    def step(self, params: Any, grads: Any, state: Dict[str, Any],
+             lr: Optional[float] = None) -> Any:
+        """In-place update of a pytree of fp32 numpy leaves; returns params."""
+        import jax
+
+        self.step_count += 1
+        flat_p = jax.tree_util.tree_leaves(params)
+        flat_g = jax.tree_util.tree_leaves(grads)
+        flat_m = jax.tree_util.tree_leaves(state["exp_avg"])
+        flat_v = jax.tree_util.tree_leaves(state["exp_avg_sq"])
+        for p, g, m, v in zip(flat_p, flat_g, flat_m, flat_v):
+            for name, a in (("param", p), ("exp_avg", m), ("exp_avg_sq", v)):
+                if not a.flags["C_CONTIGUOUS"]:
+                    # reshape(-1) would copy and the in-place update would be
+                    # silently discarded — refuse instead
+                    raise TypeError(f"{name} leaf must be C-contiguous for "
+                                    "the in-place native step")
+            self.step_flat(p.reshape(-1), np.ascontiguousarray(
+                np.asarray(g, np.float32).reshape(-1)), m.reshape(-1),
+                v.reshape(-1), step=self.step_count, lr=lr)
+        return params
+
+    def l2_norm(self, tree: Any) -> float:
+        import jax
+
+        sq = 0.0
+        for leaf in jax.tree_util.tree_leaves(tree):
+            flat = np.ascontiguousarray(np.asarray(leaf, np.float32).reshape(-1))
+            n = self._lib.cpu_l2_norm(_fptr(flat), flat.size)
+            sq += n * n
+        return float(np.sqrt(sq))

@@ -1,0 +1,188 @@
+"""Native op build system (reference ``op_builder/builder.py``).
+
+JIT-compiles the C++ sources in ``ops/csrc/`` into shared libraries with the
+system toolchain on first use and binds them via ctypes (this image has no
+pybind11; the ops export a C ABI).  Mirrors the reference's contract:
+
+  builder = CPUAdamBuilder()
+  builder.is_compatible()   -> toolchain + CPU feature probe
+  builder.is_built()        -> cached .so exists
+  builder.load()            -> ctypes.CDLL with typed signatures (compiles
+                               on demand, like the reference's JIT path)
+
+``ALL_OPS`` is the registry ``ds_report`` walks (env_report.py).
+Build artifacts live under ``ops/csrc/build/`` (env override
+``DS_TPU_OPS_BUILD_DIR``).
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import shutil
+import subprocess
+from typing import Dict, List, Optional, Type
+
+from ...utils.logging import logger
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "csrc")
+
+
+def _build_dir() -> str:
+    d = os.environ.get("DS_TPU_OPS_BUILD_DIR") or os.path.join(_CSRC, "build")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class OpBuilder:
+    NAME = "base"
+    SOURCES: List[str] = []
+
+    def __init__(self):
+        self._lib: Optional[ctypes.CDLL] = None
+
+    # -- probes ----------------------------------------------------------
+    def compiler(self) -> Optional[str]:
+        return shutil.which("g++")
+
+    def extra_flags(self) -> List[str]:
+        return []
+
+    def is_compatible(self) -> bool:
+        return self.compiler() is not None
+
+    def _source_paths(self) -> List[str]:
+        return [os.path.join(_CSRC, s) for s in self.SOURCES]
+
+    def _so_path(self) -> str:
+        # content-hash the sources + flags so edits trigger rebuilds
+        h = hashlib.sha1()
+        for p in self._source_paths():
+            with open(p, "rb") as f:
+                h.update(f.read())
+        h.update(" ".join(self.extra_flags()).encode())
+        return os.path.join(_build_dir(), f"{self.NAME}_{h.hexdigest()[:12]}.so")
+
+    def is_built(self) -> bool:
+        return os.path.exists(self._so_path())
+
+    # -- build + load ----------------------------------------------------
+    def build(self) -> str:
+        so = self._so_path()
+        if os.path.exists(so):
+            return so
+        cxx = self.compiler()
+        if cxx is None:
+            raise RuntimeError(f"{self.NAME}: no C++ compiler on PATH")
+        cmd = [cxx, "-O3", "-shared", "-fPIC", "-std=c++17",
+               *self.extra_flags(), *self._source_paths(), "-o", so + ".tmp"]
+        logger.info("building native op %s: %s", self.NAME, " ".join(cmd))
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"{self.NAME} build failed (rc={proc.returncode}):\n"
+                f"{proc.stderr[-4000:]}")
+        os.replace(so + ".tmp", so)
+        return so
+
+    def bind(self, lib: ctypes.CDLL) -> None:
+        """Subclasses declare argtypes/restype here."""
+
+    def load(self) -> ctypes.CDLL:
+        if self._lib is None:
+            lib = ctypes.CDLL(self.build())
+            self.bind(lib)
+            self._lib = lib
+        return self._lib
+
+
+def _march_native_ok() -> bool:
+    """Cached probe: does -march=native compile here?"""
+    global _MARCH_OK
+    if _MARCH_OK is None:
+        try:
+            src = os.path.join(_build_dir(), "_probe.cpp")
+            with open(src, "w") as f:
+                f.write("int main(){return 0;}\n")
+            rc = subprocess.run(
+                ["g++", "-march=native", src, "-o", src + ".out"],
+                capture_output=True).returncode
+            _MARCH_OK = rc == 0
+        except Exception:
+            _MARCH_OK = False
+    return _MARCH_OK
+
+
+_MARCH_OK: Optional[bool] = None
+
+_F = ctypes.POINTER(ctypes.c_float)
+_U16 = ctypes.POINTER(ctypes.c_uint16)
+
+
+class CPUAdamBuilder(OpBuilder):
+    """Reference ``op_builder/cpu_adam.py`` (csrc/adam/cpu_adam.cpp)."""
+
+    NAME = "cpu_adam"
+    SOURCES = ["cpu_adam.cpp"]
+
+    def extra_flags(self):
+        flags = ["-fopenmp"]
+        if _march_native_ok():
+            flags.append("-march=native")
+        return flags
+
+    def bind(self, lib):
+        lib.cpu_adam_step.argtypes = [
+            _F, _F, _F, _F, ctypes.c_int64, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, _U16]
+        lib.cpu_adam_step.restype = None
+        lib.cpu_adagrad_step.argtypes = [
+            _F, _F, _F, ctypes.c_int64, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, _U16]
+        lib.cpu_adagrad_step.restype = None
+        lib.cpu_l2_norm.argtypes = [_F, ctypes.c_int64]
+        lib.cpu_l2_norm.restype = ctypes.c_double
+
+
+class CPUAdagradBuilder(CPUAdamBuilder):
+    """Reference ``op_builder/cpu_adagrad.py`` — same translation unit."""
+
+    NAME = "cpu_adagrad"
+
+
+class AsyncIOBuilder(OpBuilder):
+    """Reference ``op_builder/async_io.py`` (csrc/aio/)."""
+
+    NAME = "async_io"
+    SOURCES = ["aio.cpp"]
+
+    def extra_flags(self):
+        return ["-pthread"]
+
+    def bind(self, lib):
+        lib.ds_aio_submit_write.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
+                                            ctypes.c_int64, ctypes.c_int]
+        lib.ds_aio_submit_write.restype = ctypes.c_int64
+        lib.ds_aio_submit_read.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
+                                           ctypes.c_int64, ctypes.c_int]
+        lib.ds_aio_submit_read.restype = ctypes.c_int64
+        lib.ds_aio_wait.argtypes = [ctypes.c_int64]
+        lib.ds_aio_wait.restype = ctypes.c_int
+        lib.ds_aio_write.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
+                                     ctypes.c_int64, ctypes.c_int]
+        lib.ds_aio_write.restype = ctypes.c_int
+        lib.ds_aio_read.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
+                                    ctypes.c_int64, ctypes.c_int]
+        lib.ds_aio_read.restype = ctypes.c_int
+
+
+ALL_OPS: Dict[str, Type[OpBuilder]] = {
+    CPUAdamBuilder.NAME: CPUAdamBuilder,
+    CPUAdagradBuilder.NAME: CPUAdagradBuilder,
+    AsyncIOBuilder.NAME: AsyncIOBuilder,
+}
+
+__all__ = ["OpBuilder", "CPUAdamBuilder", "CPUAdagradBuilder",
+           "AsyncIOBuilder", "ALL_OPS"]

@@ -118,34 +118,24 @@ def error_tree_specs(params: Any) -> Any:
     return jax.tree_util.tree_map(lambda _: P(BATCH_AXES), params)
 
 
-def make_compressed_grad_fn(grad_of_batch, mesh, gas: int, freeze_step: int,
+def make_compressed_grad_fn(accumulate, mesh, gas: int, freeze_step: int,
                             param_template: Any, block: int = DEFAULT_BLOCK):
     """Build the manual-region gradient function for the 1-bit path.
 
-    Returns ``fn(work_params, scaler, batch_window, rng, error, step)``
+    ``accumulate`` is ``engine.make_grad_accumulator(grad_of_batch, gas)`` —
+    the shared microbatch scan.  Returns
+    ``fn(work_params, scaler, batch_window, rng, error, step)``
     -> (mean_grads, losses, new_error); ``batch_window`` is [gas, B_global,...].
     Requires a pure-DP mesh (engine validates).
     """
-    from ...parallel.mesh import (BATCH_AXES, axis_size, manual_region,
-                                  shard_map_compat)
+    from ...parallel.mesh import manual_region, shard_map_compat
+    from ...parallel.mesh import BATCH_AXES
 
-    w = axis_size(mesh, BATCH_AXES)
     pads = jax.tree_util.tree_map(lambda x: _pad_len(x.size, block),
                                   param_template)
 
     def region(work, scaler, window, rng, error, step):
-        def micro(carry, microbatch):
-            acc, r = carry
-            r, sub = jax.random.split(r)
-            grads, loss = grad_of_batch(work, scaler, microbatch, sub)
-            acc = jax.tree_util.tree_map(
-                lambda a, g: a + g.astype(jnp.float32), acc, grads)
-            return (acc, r), loss
-
-        zeros = jax.tree_util.tree_map(
-            lambda x: jnp.zeros(x.shape, jnp.float32), work)
-        (local_grads, _), losses = lax.scan(micro, (zeros, rng), window,
-                                            length=gas)
+        local_grads, losses, _ = accumulate(work, scaler, window, rng)
 
         def full_precision():
             g = jax.tree_util.tree_map(

@@ -333,9 +333,10 @@ class DeepSpeedConfig:
             bad.append("zero_optimization.offload_param.device="
                        f"{zc.offload_param.device} (param offload)")
         if zc.offload_optimizer is not None and \
-                zc.offload_optimizer.device == OffloadDeviceEnum.nvme:
+                zc.offload_optimizer.device == OffloadDeviceEnum.nvme and \
+                not zc.offload_optimizer.nvme_path:
             bad.append("zero_optimization.offload_optimizer.device=nvme "
-                       "(NVMe optimizer swap)")
+                       "requires nvme_path")
         if zc.mics_shard_size != -1 or zc.mics_hierarchical_params_gather:
             bad.append("zero_optimization.mics_shard_size (MiCS)")
         if zc.zero_hpz_partition_size > 1:

@@ -62,6 +62,31 @@ class TrainState:
     comm_error: Any = None            # 1-bit error-feedback buffers (per-worker)
 
 
+def make_grad_accumulator(grad_of_batch, gas: int):
+    """Shared microbatch scan: fp32-accumulate ``gas`` microbatch gradients.
+
+    run(work, scaler, window, rng) -> (summed grads, losses [gas], new_rng).
+    Single source of truth for the accumulation loop (fused train step,
+    NVMe grad-only step, and the 1-bit compressed region all use it)."""
+
+    def run(work, scaler, window, rng):
+        def micro(carry, microbatch):
+            acc, r = carry
+            r, sub = jax.random.split(r)
+            grads, loss = grad_of_batch(work, scaler, microbatch, sub)
+            acc = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(jnp.float32), acc, grads)
+            return (acc, r), loss
+
+        zeros = jax.tree_util.tree_map(
+            lambda x: jnp.zeros(x.shape, jnp.float32), work)
+        (grads, new_rng), losses = jax.lax.scan(micro, (zeros, rng), window,
+                                                length=gas)
+        return grads, losses, new_rng
+
+    return run
+
+
 def _cast_tree(tree, dtype):
     return jax.tree_util.tree_map(
         lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
@@ -224,7 +249,21 @@ class DeepSpeedEngine:
             # fp32 mode: params ARE the masters; keep one copy
             master = None
 
-        opt_state = jax.jit(self.optimizer.init)(master if master is not None else params0)
+        # -- ZeRO-Infinity: optimizer state (fp32 masters + Adam moments)
+        #    lives on NVMe; the device holds ONLY bf16 compute params and the
+        #    host applies the native SIMD Adam between steps (reference
+        #    runtime/swap_tensor/partitioned_optimizer_swapper.py +
+        #    csrc/adam/cpu_adam.cpp).
+        self._nvme_swapper = None
+        zc0 = self.config.zero_config
+        nvme_dev = zc0.offload_optimizer.device if zc0.offload_optimizer else None
+        if getattr(nvme_dev, "value", nvme_dev) == "nvme":
+            self._init_nvme_offload(master, params0)
+            master = None
+            opt_state = ()
+        else:
+            opt_state = jax.jit(self.optimizer.init)(
+                master if master is not None else params0)
 
         if self.fp16_enabled:
             f16 = self.config.fp16
@@ -306,6 +345,7 @@ class DeepSpeedEngine:
         self.tput_timer = ThroughputTimer(batch_size=self.train_batch_size,
                                           steps_per_output=self.config.steps_per_print)
         self._compiled_train_step = None
+        self._compiled_grad_step = None
         self._compiled_eval_step = None
         self._compiled_micro_grad = None
         self._compiled_apply_step = None
@@ -452,6 +492,106 @@ class DeepSpeedEngine:
             opt_in = jax.device_put(opt_in, o_sh)
         return masters, opt_in
 
+    def _init_nvme_offload(self, master, params0):
+        """Move fp32 masters + (to-be-created) Adam moments to NVMe files;
+        the host steps them with the native SIMD kernel (ZeRO-Infinity)."""
+        if master is None:
+            raise ValueError("NVMe optimizer offload requires bf16/fp16 "
+                             "compute (fp32 params have no separate masters "
+                             "to offload)")
+        if self.fp16_enabled:
+            raise NotImplementedError(
+                "NVMe offload currently pairs with bf16 (fp16 dynamic loss "
+                "scaling would need host-side overflow handling)")
+        opt_cfg = self.config.optimizer
+        opt_type = (opt_cfg.type if opt_cfg else "adamw").lower()
+        if opt_type not in ("adam", "adamw"):
+            raise NotImplementedError(
+                f"NVMe offload runs the native CPU Adam kernel; optimizer "
+                f"{opt_type!r} is not supported on the host path")
+        from .swap_tensor import SwappedAdamOptimizer
+
+        zc = self.config.zero_config.offload_optimizer
+        p = dict(opt_cfg.params) if opt_cfg else {}
+        flat, treedef = jax.tree_util.tree_flatten_with_path(master)
+        names = [jax.tree_util.keystr(path) for path, _ in flat]
+        with jax.transfer_guard("allow"):
+            masters_np = {n: np.asarray(x, np.float32)
+                          for n, (_, x) in zip(names, flat)}
+        self._nvme_names = names
+        self._nvme_treedef = treedef
+        self._nvme_swapper = SwappedAdamOptimizer(
+            masters_np, zc.nvme_path,
+            aio_threads=max(self.config.aio.thread_count,
+                            self.config.aio.queue_depth // 2, 1),
+            pipeline=bool(zc.pipeline_read or zc.pipeline_write),
+            lr=p.get("lr", 1e-3), betas=tuple(p.get("betas", (0.9, 0.999))),
+            eps=p.get("eps", 1e-8), weight_decay=p.get("weight_decay", 0.0),
+            adamw_mode=(opt_type == "adamw"))
+        log_dist(f"ZeRO-Infinity: optimizer state on NVMe at {zc.nvme_path} "
+                 f"({self._nvme_swapper.state_bytes() / 1e9:.2f} GB)", ranks=[0])
+
+    def _make_grad_only_step(self):
+        gas = self.gas
+        accumulate = make_grad_accumulator(self._make_scaled_grad(), gas)
+        prescale = self.config.prescale_gradients
+        predivide = self.config.gradient_predivide_factor
+        clip = self.config.gradient_clipping
+
+        def grad_step(state: TrainState, batch):
+            work = state.params  # bf16 — masters live on NVMe
+            grads, losses, new_rng = accumulate(work, state.scaler, batch,
+                                                state.rng)
+            # mirror apply_update's normalization: gas mean, predivide
+            # compensation (grad_of_batch pre-divided), then global clipping —
+            # the host Adam kernel must see exactly what the optax chain would
+            scale = (predivide if prescale else 1.0) / gas
+            grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+            gnorm = optax.global_norm(grads)
+            if clip and clip > 0:
+                factor = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                grads = jax.tree_util.tree_map(lambda g: g * factor, grads)
+            return grads, jnp.mean(losses), gnorm, new_rng
+
+        return jax.jit(grad_step)
+
+    def _train_batch_nvme(self, global_batch):
+        """device grads -> host NVMe Adam -> bf16 params back to device."""
+        if self._compiled_grad_step is None:
+            self._compiled_grad_step = self._make_grad_only_step()
+        self.tput_timer.start()
+        grads, loss, grad_norm, new_rng = self._compiled_grad_step(
+            self.state, global_batch)
+        flat_grads = jax.tree_util.tree_leaves(grads)
+        with jax.transfer_guard("allow"):
+            grads_np = {n: np.asarray(g, np.float32)
+                        for n, g in zip(self._nvme_names, flat_grads)}
+        lr = float(self.lr_schedule(self.global_steps)) \
+            if callable(self.lr_schedule) else float(self.lr_schedule)
+        bf16 = self._nvme_swapper.step(grads_np, lr=lr)
+        import ml_dtypes
+
+        leaves = []
+        shard_leaves = jax.tree_util.tree_leaves(self._param_shardings)
+        for n, sh in zip(self._nvme_names, shard_leaves):
+            leaves.append(jax.device_put(bf16[n].view(ml_dtypes.bfloat16), sh))
+        new_params = jax.tree_util.tree_unflatten(self._nvme_treedef, leaves)
+        self.state = dataclasses.replace(
+            self.state, params=new_params, step=self.state.step + 1,
+            rng=new_rng)
+        self.global_steps += 1
+        self.micro_steps += self.gas
+        self._last_grad_norm = float(grad_norm)
+        loss_val = loss
+        self.tput_timer.stop(sync_tree=loss_val)
+        metrics = {"loss": loss_val, "grad_norm": grad_norm,
+                   "loss_scale": jnp.float32(1.0),
+                   "step_applied": jnp.bool_(True)}
+        self._emit_monitor_events(metrics)
+        if self.global_steps % self.config.steps_per_print == 0:
+            self._report_progress(metrics)
+        return loss_val
+
     def _make_train_step(self):
         gas = self.gas
         grad_specs = self._grad_shardings
@@ -468,8 +608,8 @@ class DeepSpeedEngine:
             template = (self.state.master_params if self.use_master_weights
                         else self.state.params)
             comp_grad = make_compressed_grad_fn(
-                grad_of_batch, self.mesh, gas, compression["freeze_step"],
-                template)
+                make_grad_accumulator(grad_of_batch, gas), self.mesh, gas,
+                compression["freeze_step"], template)
 
             def train_step(state: TrainState, batch):
                 masters, opt_in = stream_in(state)
@@ -494,17 +634,11 @@ class DeepSpeedEngine:
                                out_shardings=self._train_out_shardings)
             return jax.jit(train_step, donate_argnums=(0,))
 
+        accumulate = make_grad_accumulator(grad_of_batch, gas)
+
         def train_step(state: TrainState, batch):
             masters, opt_in = stream_in(state)
             work = compute_tree(masters)  # bf16 cast hoisted out of the scan
-
-            def micro_step(carry, microbatch):
-                acc, rng = carry
-                rng, sub = jax.random.split(rng)
-                grads, loss = grad_of_batch(work, state.scaler, microbatch, sub)
-                acc = jax.tree_util.tree_map(
-                    lambda a, g: a + g.astype(jnp.float32), acc, grads)
-                return (acc, rng), loss
 
             if pipeline:
                 # pipeline engines consume the whole gas window in ONE call:
@@ -529,10 +663,8 @@ class DeepSpeedEngine:
                     lambda g: g.astype(jnp.float32), grads)
                 eff_gas = 1
             else:
-                zeros = jax.tree_util.tree_map(
-                    lambda x: jnp.zeros(x.shape, jnp.float32), masters)
-                (grads, new_rng), losses = jax.lax.scan(
-                    micro_step, (zeros, state.rng), batch, length=gas)
+                grads, losses, new_rng = accumulate(work, state.scaler, batch,
+                                                    state.rng)
                 eff_gas = gas
             # ZeRO-2/3: land the accumulated grads sharded — XLA lowers the DP
             # reduction into reduce-scatter against this constraint
@@ -612,6 +744,8 @@ class DeepSpeedEngine:
                 data_iter = self._data_iterator
             batch = data_iter
         global_batch = self._collect_global_batch(batch)
+        if self._nvme_swapper is not None:
+            return self._train_batch_nvme(global_batch)
         if self._compiled_train_step is None:
             self._compiled_train_step = self._make_train_step()
         profiling = (self.flops_profiler is not None
@@ -829,12 +963,23 @@ class DeepSpeedEngine:
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True):
         from .checkpoint_engine.orbax_engine import save_engine_checkpoint
 
+        if self._nvme_swapper is not None:
+            raise NotImplementedError(
+                "checkpointing with NVMe optimizer offload is not wired up "
+                "yet — the Adam state lives in swap files, and saving only "
+                "the device params would silently lose it on resume")
         return save_engine_checkpoint(self, save_dir, tag=tag, client_state=client_state,
                                       save_latest=save_latest)
 
     def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
                         load_lr_scheduler_states=True, load_module_only=False):
         from .checkpoint_engine.orbax_engine import load_engine_checkpoint
+
+        if self._nvme_swapper is not None:
+            raise NotImplementedError(
+                "checkpointing with NVMe optimizer offload is not wired up "
+                "yet — restoring device params alone would desync the NVMe "
+                "masters/moments")
 
         return load_engine_checkpoint(self, load_dir, tag=tag,
                                       load_optimizer_states=load_optimizer_states,

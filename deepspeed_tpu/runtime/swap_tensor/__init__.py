@@ -1,0 +1,4 @@
+"""Tensor swapping to NVMe (reference ``deepspeed/runtime/swap_tensor/``)."""
+from .partitioned_optimizer_swapper import SwappedAdamOptimizer, TensorSwapper
+
+__all__ = ["SwappedAdamOptimizer", "TensorSwapper"]
