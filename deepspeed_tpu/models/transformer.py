@@ -71,6 +71,11 @@ class TransformerConfig:
     pipeline_microbatches: Optional[int] = None
     remat: bool = True                        # activation checkpointing
     remat_policy: str = "nothing_saveable"    # nothing_saveable | dots_saveable
+    # random-LTD (data efficiency): non-deterministic passes run each layer on
+    # a random `random_ltd_keep`-token subset; dropped tokens ride the
+    # residual stream (runtime/data_pipeline/data_routing/random_ltd.py)
+    random_ltd: bool = False
+    random_ltd_keep: int = 0
     scan_layers: bool = True
     dtype: Any = jnp.bfloat16                 # compute dtype hint (engine casts)
     initializer_range: float = 0.02
@@ -536,6 +541,16 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
         else:
             policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
         block = jax.checkpoint(block, policy=policy)
+    if cfg.random_ltd and cfg.random_ltd_keep > 0:
+        # token drop wraps OUTSIDE remat so only the kept-subset compute is
+        # rematerialized; the gather/scatter bookkeeping is cheap and saved
+        from ..runtime.data_pipeline.data_routing.random_ltd import \
+            random_ltd_block
+
+        inner_block = block
+        block = lambda lp, x, sub, pos: random_ltd_block(  # noqa: E731
+            inner_block, cfg, lp, x, pos, sub, cfg.random_ltd_keep,
+            deterministic)
 
     aux_total = jnp.float32(0.0)
     if cfg.pipeline_stages > 1:

@@ -55,6 +55,8 @@ class OpBuilder:
     def _source_paths(self) -> List[str]:
         return [os.path.join(_CSRC, s) for s in self.SOURCES]
 
+    SO_NAME: Optional[str] = None  # builders sharing a translation unit share it
+
     def _so_path(self) -> str:
         # content-hash the sources + flags so edits trigger rebuilds
         h = hashlib.sha1()
@@ -62,7 +64,8 @@ class OpBuilder:
             with open(p, "rb") as f:
                 h.update(f.read())
         h.update(" ".join(self.extra_flags()).encode())
-        return os.path.join(_build_dir(), f"{self.NAME}_{h.hexdigest()[:12]}.so")
+        return os.path.join(_build_dir(),
+                            f"{self.SO_NAME or self.NAME}_{h.hexdigest()[:12]}.so")
 
     def is_built(self) -> bool:
         return os.path.exists(self._so_path())
@@ -75,15 +78,19 @@ class OpBuilder:
         cxx = self.compiler()
         if cxx is None:
             raise RuntimeError(f"{self.NAME}: no C++ compiler on PATH")
+        # per-process temp name: concurrent first-use builds (pytest workers,
+        # multi-process launch) must not clobber each other's half-written
+        # object before the atomic publish
+        tmp = f"{so}.{os.getpid()}.tmp"
         cmd = [cxx, "-O3", "-shared", "-fPIC", "-std=c++17",
-               *self.extra_flags(), *self._source_paths(), "-o", so + ".tmp"]
+               *self.extra_flags(), *self._source_paths(), "-o", tmp]
         logger.info("building native op %s: %s", self.NAME, " ".join(cmd))
         proc = subprocess.run(cmd, capture_output=True, text=True)
         if proc.returncode != 0:
             raise RuntimeError(
                 f"{self.NAME} build failed (rc={proc.returncode}):\n"
                 f"{proc.stderr[-4000:]}")
-        os.replace(so + ".tmp", so)
+        os.replace(tmp, so)
         return so
 
     def bind(self, lib: ctypes.CDLL) -> None:
@@ -147,9 +154,11 @@ class CPUAdamBuilder(OpBuilder):
 
 
 class CPUAdagradBuilder(CPUAdamBuilder):
-    """Reference ``op_builder/cpu_adagrad.py`` — same translation unit."""
+    """Reference ``op_builder/cpu_adagrad.py`` — same translation unit, so it
+    shares cpu_adam's cached .so instead of compiling a duplicate."""
 
     NAME = "cpu_adagrad"
+    SO_NAME = "cpu_adam"
 
 
 class AsyncIOBuilder(OpBuilder):

@@ -228,6 +228,36 @@ class PipelineConfig(DeepSpeedConfigModel):
     partition_method: str = "parameters"
 
 
+class CurriculumLearningLegacyConfig(DeepSpeedConfigModel):
+    """Top-level ``curriculum_learning`` block (reference legacy curriculum,
+    runtime/config.py ``curriculum_enabled_legacy``): the engine truncates
+    the batch sequence to the scheduled difficulty."""
+
+    enabled: bool = False
+    curriculum_type: str = "seqlen"
+    min_difficulty: int = 8
+    max_difficulty: int = 1024
+    schedule_type: str = "fixed_linear"
+    schedule_config: Dict[str, Any] = Field(default_factory=dict)
+
+
+class RandomLTDConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    min_value: int = 128
+    max_value: int = 2048
+    random_ltd_schedule: Dict[str, Any] = Field(default_factory=dict)
+
+
+class DataRoutingConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    random_ltd: RandomLTDConfig = Field(default_factory=RandomLTDConfig)
+
+
+class DataEfficiencyConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    data_routing: DataRoutingConfig = Field(default_factory=DataRoutingConfig)
+
+
 class AIOConfig(DeepSpeedConfigModel):
     block_size: int = 1048576
     queue_depth: int = 8
@@ -303,6 +333,10 @@ class DeepSpeedConfig:
         self.data_types = DataTypeConfig(**config.get("data_types", {}))
         self.pipeline = PipelineConfig(**config.get("pipeline", {}))
         self.aio = AIOConfig(**config.get("aio", {}))
+        self.curriculum_learning = CurriculumLearningLegacyConfig(
+            **config.get("curriculum_learning", {}))
+        self.data_efficiency = DataEfficiencyConfig(
+            **config.get("data_efficiency", {}))
         self.elasticity = ElasticityConfig(**config.get("elasticity", {}))
 
         self.gradient_accumulation_steps: Optional[int] = config.get(

@@ -1,0 +1,9 @@
+"""Data efficiency suite (reference ``deepspeed/runtime/data_pipeline/``):
+curriculum learning, curriculum-aware sampling, memmap indexed datasets,
+random layerwise token dropping."""
+from .curriculum_scheduler import CurriculumScheduler
+from .data_sampler import CurriculumBatchSampler
+from .indexed_dataset import MMapIndexedDataset, MMapIndexedDatasetBuilder
+
+__all__ = ["CurriculumScheduler", "CurriculumBatchSampler",
+           "MMapIndexedDataset", "MMapIndexedDatasetBuilder"]

@@ -1,0 +1,98 @@
+"""Random layerwise token dropping (random-LTD).
+
+Reference: ``runtime/data_pipeline/data_routing/random_ltd.py`` (the
+``RandomLayerTokenDrop`` wrapper) + ``scheduler.py`` (RandomLTDScheduler),
+from the Data Efficiency suite: during training each wrapped layer processes
+only a random subset of tokens; dropped tokens bypass the layer through the
+residual stream, cutting per-layer attention/MLP cost while the kept-token
+count anneals up to the full sequence over training.
+
+TPU-first: the subset size is STATIC per compiled program (shapes must be
+static under jit), so the engine buckets the scheduler's value and caches one
+compiled step per bucket.  Token selection is an argsort of per-token uniform
+noise (a shuffle), sorted ascending to preserve temporal order for rotary
+positions and causal masking within the subset — the same order-preserving
+gather the reference does with torch.sort(indices).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def select_tokens(rng: jax.Array, B: int, S: int, keep: int) -> jax.Array:
+    """[B, keep] sorted random token indices (no replacement)."""
+    noise = jax.random.uniform(rng, (B, S))
+    idx = jnp.argsort(noise, axis=1)[:, :keep]
+    return jnp.sort(idx, axis=1)
+
+
+def gather_tokens(x: jax.Array, idx: jax.Array) -> jax.Array:
+    """x [B, S, ...] -> [B, keep, ...] along axis 1."""
+    expand = idx.reshape(idx.shape + (1,) * (x.ndim - 2))
+    return jnp.take_along_axis(x, jnp.broadcast_to(
+        expand, idx.shape + x.shape[2:]), axis=1)
+
+
+def scatter_tokens(x_full: jax.Array, x_sub: jax.Array, idx: jax.Array
+                   ) -> jax.Array:
+    """Write the processed subset back; untouched rows keep x_full (the
+    residual bypass)."""
+    B = x_full.shape[0]
+    return x_full.at[jnp.arange(B)[:, None], idx].set(x_sub)
+
+
+def random_ltd_block(block_fn, cfg, lp, x, positions, rng, keep: int,
+                     deterministic: bool) -> Tuple[jax.Array, Any]:
+    """Wrap one transformer block with token dropping.
+
+    ``block_fn(lp, x_sub, rng, pos_sub) -> (out_sub, aux)``; inactive (full
+    pass-through) when deterministic or keep >= S.
+    """
+    B, S, _ = x.shape
+    if deterministic or keep >= S or keep <= 0:
+        return block_fn(lp, x, rng, positions)
+    rng, sel = jax.random.split(rng)
+    idx = select_tokens(sel, B, S, keep)
+    x_sub = gather_tokens(x, idx)
+    pos_sub = jnp.take_along_axis(positions, idx, axis=1)
+    out_sub, aux = block_fn(lp, x_sub, rng, pos_sub)
+    return scatter_tokens(x, out_sub, idx), aux
+
+
+class RandomLTDScheduler:
+    """Anneals the kept-token count (reference scheduler.py API:
+    ``update_seq``/``get_current_seq``; fixed_linear schedule)."""
+
+    def __init__(self, config: Dict[str, Any]):
+        sched = config.get("random_ltd_schedule", {})
+        self.min_value = int(config.get("min_value", sched.get("min_value", 128)))
+        self.max_value = int(config.get("max_value", sched.get("max_value", 2048)))
+        self.schedule_type = sched.get("schedule_type", "fixed_linear")
+        if self.schedule_type != "fixed_linear":
+            raise ValueError(f"random_ltd schedule {self.schedule_type!r} "
+                             "not supported (fixed_linear only)")
+        sc = sched.get("schedule_config", {})
+        self.seq_per_step = int(sc.get("seq_per_step", 16))
+        self.require_steps = int(sc.get("require_steps", 1000))
+        self.current_seq = self.min_value
+
+    def get_current_seq(self) -> int:
+        return self.current_seq
+
+    def update_seq(self, global_steps: int) -> int:
+        frac = min(1.0, global_steps / self.require_steps)
+        raw = self.min_value + frac * (self.max_value - self.min_value)
+        # quantize to seq_per_step: this bounds the number of compiled
+        # programs (each distinct keep-count is a distinct static shape)
+        q = (int(raw) // self.seq_per_step) * self.seq_per_step
+        self.current_seq = max(self.min_value, min(q, self.max_value))
+        return self.current_seq
+
+    def state_dict(self):
+        return {"current_seq": self.current_seq}
+
+    def load_state_dict(self, state):
+        self.current_seq = state["current_seq"]

@@ -1,0 +1,76 @@
+"""Curriculum-aware batch sampling (reference
+``runtime/data_pipeline/data_sampling/data_sampler.py:36``
+``DeepSpeedDataSampler``).
+
+TPU-first shape: the sampler yields *index batches* whose difficulty metric
+(default: document length) is within the curriculum's current difficulty.
+Buckets are precomputed with one argsort; each ``set_difficulty`` narrows or
+widens the eligible prefix, so stepping the curriculum costs O(1).  Shuffling
+is deterministic per (seed, epoch) like the reference, and state round-trips
+for checkpoint/resume (``state_dict``/``load_state_dict``).
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from .curriculum_scheduler import CurriculumScheduler
+
+
+class CurriculumBatchSampler:
+    def __init__(self, sizes: Sequence[int], batch_size: int,
+                 curriculum: Optional[CurriculumScheduler] = None,
+                 seed: int = 1234, drop_last: bool = True):
+        self.sizes = np.asarray(sizes)
+        self.batch_size = batch_size
+        self.curriculum = curriculum
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        self.consumed_batches = 0
+        # ascending difficulty; eligible set is always a prefix of this order
+        self._order = np.argsort(self.sizes, kind="stable")
+        self._sorted_sizes = self.sizes[self._order]
+
+    def _eligible(self) -> np.ndarray:
+        if self.curriculum is None:
+            return self._order
+        diff = self.curriculum.get_current_difficulty()
+        cutoff = int(np.searchsorted(self._sorted_sizes, diff, side="right"))
+        if cutoff < self.batch_size and not self.drop_last:
+            cutoff = min(self.batch_size, len(self._order))
+        return self._order[:cutoff]
+
+    def __iter__(self) -> Iterator[List[int]]:
+        rng = np.random.default_rng(self.seed + self.epoch)
+        while True:
+            if self.curriculum is not None:
+                self.curriculum.update_difficulty(self.consumed_batches)
+            pool = self._eligible()
+            if len(pool) < self.batch_size and self.drop_last:
+                raise ValueError(
+                    f"curriculum difficulty "
+                    f"{self.curriculum.get_current_difficulty() if self.curriculum else '-'} "
+                    f"admits only {len(pool)} samples < batch {self.batch_size}")
+            batch = rng.choice(pool, size=self.batch_size,
+                               replace=len(pool) < self.batch_size)
+            self.consumed_batches += 1
+            yield [int(i) for i in batch]
+            if self.consumed_batches % max(len(self.sizes) // self.batch_size, 1) == 0:
+                self.epoch += 1
+                rng = np.random.default_rng(self.seed + self.epoch)
+
+    # -- checkpoint/resume (reference state_dict contract) ---------------
+    def state_dict(self):
+        return {"epoch": self.epoch, "consumed_batches": self.consumed_batches,
+                "seed": self.seed,
+                "curriculum": (self.curriculum.get_state()
+                               if self.curriculum else None)}
+
+    def load_state_dict(self, state):
+        self.epoch = state["epoch"]
+        self.consumed_batches = state["consumed_batches"]
+        self.seed = state["seed"]
+        if self.curriculum is not None and state.get("curriculum"):
+            self.curriculum.set_state(state["curriculum"])

@@ -356,6 +356,37 @@ class DeepSpeedEngine:
         self._data_iterator = None
         self.training_dataloader = self._build_dataloader(training_data)
         self.monitor = self._build_monitor()
+        # -- data efficiency ------------------------------------------------
+        self.curriculum_scheduler = None
+        cl = self.config.curriculum_learning
+        if cl.enabled:
+            from .data_pipeline.curriculum_scheduler import CurriculumScheduler
+
+            if cl.curriculum_type != "seqlen":
+                raise NotImplementedError(
+                    f"curriculum_type {cl.curriculum_type!r}: only 'seqlen' "
+                    "(sequence truncation) is implemented")
+            self.curriculum_scheduler = CurriculumScheduler({
+                "curriculum_type": cl.curriculum_type,
+                "min_difficulty": cl.min_difficulty,
+                "max_difficulty": cl.max_difficulty,
+                "schedule_type": cl.schedule_type,
+                "schedule_config": cl.schedule_config,
+            })
+        self._random_ltd = None
+        self._ltd_keep = None
+        self._ltd_cache = {}
+        rltd = self.config.data_efficiency.data_routing.random_ltd
+        if rltd.enabled:
+            from .data_pipeline.data_routing.random_ltd import RandomLTDScheduler
+
+            if self.model is None or not hasattr(self.model, "config") \
+                    or not hasattr(self.model.config, "random_ltd"):
+                raise ValueError("random_ltd requires a CausalLM-style model "
+                                 "(TransformerConfig with random_ltd fields)")
+            self._random_ltd = RandomLTDScheduler(
+                {"min_value": rltd.min_value, "max_value": rltd.max_value,
+                 "random_ltd_schedule": rltd.random_ltd_schedule})
         self.flops_profiler = None
         if self.config.flops_profiler.enabled:
             from ..profiling.flops_profiler import FlopsProfiler
@@ -492,6 +523,22 @@ class DeepSpeedEngine:
             opt_in = jax.device_put(opt_in, o_sh)
         return masters, opt_in
 
+    def _swap_ltd_variant(self, keep: int) -> None:
+        """Re-point loss_fn at a model variant with the new static keep-count
+        and swap in (or rebuild) the matching compiled step."""
+        self._ltd_keep = keep
+        active = keep < self.model.config.max_seq_len
+        variant = type(self.model)(
+            self.model.config, attn_impl=getattr(self.model, "attn_impl", "auto"),
+            random_ltd=active, random_ltd_keep=int(keep) if active else 0)
+        self.loss_fn = variant.loss_fn
+        self._compiled_train_step = self._ltd_cache.get(keep)
+        # every compiled program that closed over the old loss_fn is stale
+        self._compiled_grad_step = None
+        self._compiled_micro_grad = None
+        log_dist(f"random-LTD: keep={keep} tokens/layer "
+                 f"({'active' if active else 'full sequence'})", ranks=[0])
+
     def _init_nvme_offload(self, master, params0):
         """Move fp32 masters + (to-be-created) Adam moments to NVMe files;
         the host steps them with the native SIMD kernel (ZeRO-Infinity)."""
@@ -527,7 +574,7 @@ class DeepSpeedEngine:
             pipeline=bool(zc.pipeline_read or zc.pipeline_write),
             lr=p.get("lr", 1e-3), betas=tuple(p.get("betas", (0.9, 0.999))),
             eps=p.get("eps", 1e-8), weight_decay=p.get("weight_decay", 0.0),
-            adamw_mode=(opt_type == "adamw"))
+            adamw_mode=bool(p.get("adam_w_mode", opt_type == "adamw")))
         log_dist(f"ZeRO-Infinity: optimizer state on NVMe at {zc.nvme_path} "
                  f"({self._nvme_swapper.state_bytes() / 1e9:.2f} GB)", ranks=[0])
 
@@ -744,16 +791,33 @@ class DeepSpeedEngine:
                 data_iter = self._data_iterator
             batch = data_iter
         global_batch = self._collect_global_batch(batch)
+        if self.curriculum_scheduler is not None:
+            # legacy seqlen curriculum: truncate the window's sequence dim;
+            # jit caches one program per distinct difficulty automatically
+            diff = self.curriculum_scheduler.update_difficulty(
+                self.global_steps + 1)
+            ref = (global_batch["input_ids"] if isinstance(global_batch, dict)
+                   and "input_ids" in global_batch
+                   else jax.tree_util.tree_leaves(global_batch)[0])
+            S = ref.shape[-1]
+            # truncate only leaves whose trailing axis IS the sequence axis
+            global_batch = jax.tree_util.tree_map(
+                lambda x: x[..., :diff]
+                if x.ndim >= 3 and x.shape[-1] == S else x, global_batch)
+        if self._random_ltd is not None:
+            keep = self._random_ltd.update_seq(self.global_steps)
+            if keep != self._ltd_keep:
+                self._swap_ltd_variant(keep)
         if self._nvme_swapper is not None:
             return self._train_batch_nvme(global_batch)
         if self._compiled_train_step is None:
             self._compiled_train_step = self._make_train_step()
+            if self._random_ltd is not None:
+                self._ltd_cache[self._ltd_keep] = self._compiled_train_step
         profiling = (self.flops_profiler is not None
                      and self.global_steps + 1 ==
                      self.config.flops_profiler.profile_step)
         if profiling:
-            import jax
-
             jax.block_until_ready(self.state.params)
             self.flops_profiler.start_profile()
         self.tput_timer.start()

@@ -98,6 +98,12 @@ class SwappedAdamOptimizer:
         self.names: List[str] = list(masters)
         self.pipeline = pipeline
         self.step_count = 0
+        # per-leaf persistent host buffers (master, m, v, bf16): leaf shapes
+        # never change, and reallocating multi-GB state every step is pure
+        # allocator churn.  Per-leaf sets are pipeline-safe: overlap is only
+        # ever between DIFFERENT leaves, and step() drains all writebacks
+        # before returning.
+        self._buffers: Dict[str, tuple] = {}
         total = 0
         for name, m in masters.items():
             m32 = np.ascontiguousarray(np.asarray(m, np.float32))
@@ -119,9 +125,19 @@ class SwappedAdamOptimizer:
         out: Dict[str, np.ndarray] = {}
         pending_w: List[Tuple[int, Any]] = []  # (handle, keepalive buffers)
 
+        def leaf_buffers(name):
+            if name not in self._buffers:
+                shape = self.swapper._shapes[f"{name}.master"]
+                self._buffers[name] = (
+                    np.empty(shape, np.float32), np.empty(shape, np.float32),
+                    np.empty(shape, np.float32),
+                    np.empty(int(np.prod(shape)), np.uint16))
+            return self._buffers[name]
+
         def read_leaf(name):
-            hs = [self.swapper.submit_read(f) for f in self._leaf_files(name)]
-            return hs
+            bufs = leaf_buffers(name)
+            return [self.swapper.submit_read(f, out=b)
+                    for f, b in zip(self._leaf_files(name), bufs[:3])]
 
         def wait_leaf(hs):
             return [self.swapper.wait(h) or buf for h, buf in hs]
@@ -136,7 +152,7 @@ class SwappedAdamOptimizer:
             master, m, v = wait_leaf(hs)
             g = np.ascontiguousarray(
                 np.asarray(grads[name], np.float32).reshape(-1))
-            bf16 = np.empty(master.size, np.uint16)
+            bf16 = leaf_buffers(name)[3]
             self.adam.step_flat(master.reshape(-1), g, m.reshape(-1),
                                 v.reshape(-1), step=self.step_count,
                                 bf16_out=bf16, lr=lr)

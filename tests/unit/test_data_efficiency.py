@@ -1,0 +1,234 @@
+"""Data efficiency suite: curriculum scheduler + sampler, indexed dataset,
+random-LTD (reference tests/unit/runtime/test_data_efficiency.py)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models import CausalLM
+from deepspeed_tpu.runtime.data_pipeline import (CurriculumBatchSampler,
+                                                 CurriculumScheduler,
+                                                 MMapIndexedDataset,
+                                                 MMapIndexedDatasetBuilder)
+from deepspeed_tpu.runtime.data_pipeline.data_routing import (
+    RandomLTDScheduler, gather_tokens, random_ltd_block, scatter_tokens,
+    select_tokens)
+
+
+# -- curriculum scheduler ---------------------------------------------------
+
+def _linear_sched(mind=8, maxd=64, total=100, step=8):
+    return CurriculumScheduler({
+        "curriculum_type": "seqlen", "min_difficulty": mind,
+        "max_difficulty": maxd, "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": total,
+                            "difficulty_step": step}})
+
+
+def test_curriculum_linear_monotone_and_quantized():
+    s = _linear_sched()
+    vals = [s.update_difficulty(t) for t in range(0, 140, 10)]
+    assert vals[0] == 8 and vals[-1] == 64
+    assert all(b >= a for a, b in zip(vals, vals[1:]))
+    assert all(v % 8 == 0 for v in vals)
+
+
+def test_curriculum_root_slower_start():
+    lin = _linear_sched()
+    root = CurriculumScheduler({
+        "curriculum_type": "seqlen", "min_difficulty": 8,
+        "max_difficulty": 64, "schedule_type": "fixed_root",
+        "schedule_config": {"total_curriculum_step": 100,
+                            "difficulty_step": 8, "root_degree": 2}})
+    # root schedule reaches difficulty FASTER early on (sqrt ramp)
+    assert root.get_difficulty(10) >= lin.get_difficulty(10)
+
+
+def test_curriculum_discrete():
+    s = CurriculumScheduler({
+        "curriculum_type": "seqlen", "min_difficulty": 16,
+        "max_difficulty": 128, "schedule_type": "fixed_discrete",
+        "schedule_config": {"difficulty": [16, 32, 128],
+                            "max_step": [5, 10]}})
+    assert s.get_difficulty(3) == 16
+    assert s.get_difficulty(7) == 32
+    assert s.get_difficulty(50) == 128
+
+
+def test_curriculum_missing_keys_raise():
+    with pytest.raises(ValueError, match="total_curriculum_step"):
+        CurriculumScheduler({
+            "curriculum_type": "seqlen", "min_difficulty": 8,
+            "max_difficulty": 64, "schedule_type": "fixed_linear",
+            "schedule_config": {}})
+
+
+# -- sampler ----------------------------------------------------------------
+
+def test_sampler_respects_difficulty():
+    sizes = np.arange(1, 101)  # docs of length 1..100
+    cur = _linear_sched(mind=10, maxd=100, total=50, step=10)
+    sampler = CurriculumBatchSampler(sizes, batch_size=4, curriculum=cur)
+    it = iter(sampler)
+    first = next(it)
+    assert all(sizes[i] <= 10 for i in first)
+    for _ in range(60):
+        batch = next(it)
+    assert all(sizes[i] <= 100 for i in batch)
+    assert max(sizes[i] for i in batch) > 10  # difficulty actually grew
+
+
+def test_sampler_state_roundtrip():
+    sizes = np.arange(1, 51)
+    cur = _linear_sched(mind=10, maxd=50, total=20, step=10)
+    s1 = CurriculumBatchSampler(sizes, 4, curriculum=cur, seed=7)
+    it = iter(s1)
+    for _ in range(5):
+        next(it)
+    state = s1.state_dict()
+    cur2 = _linear_sched(mind=10, maxd=50, total=20, step=10)
+    s2 = CurriculumBatchSampler(sizes, 4, curriculum=cur2, seed=0)
+    s2.load_state_dict(state)
+    assert s2.consumed_batches == 5
+    assert s2.curriculum.get_current_difficulty() == \
+        s1.curriculum.get_current_difficulty()
+
+
+# -- indexed dataset --------------------------------------------------------
+
+def test_indexed_dataset_roundtrip(tmp_path):
+    prefix = str(tmp_path / "corpus")
+    b = MMapIndexedDatasetBuilder(prefix + ".bin", dtype=np.int32)
+    docs = [np.arange(n, dtype=np.int32) for n in (5, 1, 17)]
+    for d in docs:
+        b.add_item(d)
+    b.finalize()
+    ds = MMapIndexedDataset(prefix)
+    assert len(ds) == 3
+    np.testing.assert_array_equal(ds.sizes, [5, 1, 17])
+    for i, d in enumerate(docs):
+        np.testing.assert_array_equal(ds[i], d)
+    np.testing.assert_array_equal(ds[-1], docs[-1])
+    np.testing.assert_array_equal(ds.get(2, offset=3, length=4),
+                                  np.arange(3, 7, dtype=np.int32))
+
+
+def test_indexed_dataset_merge_and_mismatch(tmp_path):
+    p1, p2 = str(tmp_path / "a"), str(tmp_path / "b")
+    for p, vals in ((p1, [1, 2]), (p2, [3])):
+        b = MMapIndexedDatasetBuilder(p + ".bin", dtype=np.int64)
+        for v in vals:
+            b.add_item(np.full(v, v, np.int64))
+        b.finalize()
+    m = MMapIndexedDatasetBuilder(str(tmp_path / "m") + ".bin", np.int64)
+    m.merge_file_(p1)
+    m.merge_file_(p2)
+    m.finalize()
+    ds = MMapIndexedDataset(str(tmp_path / "m"))
+    assert len(ds) == 3 and list(ds.sizes) == [1, 2, 3]
+    # truncated bin must be detected
+    with open(p1 + ".bin", "ab") as f:
+        f.write(b"xx")
+    with pytest.raises(ValueError, match="mismatched"):
+        MMapIndexedDataset(p1)
+
+
+# -- random-LTD -------------------------------------------------------------
+
+def test_select_gather_scatter_roundtrip():
+    rng = jax.random.PRNGKey(0)
+    x = jnp.arange(2 * 8 * 3, dtype=jnp.float32).reshape(2, 8, 3)
+    idx = select_tokens(rng, 2, 8, 5)
+    assert idx.shape == (2, 5)
+    assert bool((idx[:, 1:] > idx[:, :-1]).all())  # sorted, no dup
+    sub = gather_tokens(x, idx)
+    assert sub.shape == (2, 5, 3)
+    back = scatter_tokens(x, sub * 0 + 99.0, idx)
+    # exactly keep-count rows were replaced per batch
+    assert int((back[0] == 99.0).all(axis=-1).sum()) == 5
+    # untouched rows identical
+    mask = ~(back[0] == 99.0).all(axis=-1)
+    np.testing.assert_array_equal(np.asarray(back[0][mask]),
+                                  np.asarray(x[0][mask]))
+
+
+def test_random_ltd_block_passthrough_when_deterministic():
+    calls = []
+
+    def blk(lp, x, rng, pos):
+        calls.append(x.shape)
+        return x + 1, jnp.float32(0)
+
+    x = jnp.zeros((2, 8, 4))
+    pos = jnp.broadcast_to(jnp.arange(8), (2, 8))
+    out, _ = random_ltd_block(blk, None, None, x, pos, jax.random.PRNGKey(0),
+                              keep=4, deterministic=True)
+    assert calls[-1] == (2, 8, 4)  # full sequence
+    out, _ = random_ltd_block(blk, None, None, x, pos, jax.random.PRNGKey(0),
+                              keep=4, deterministic=False)
+    assert calls[-1] == (2, 4, 4)  # subset
+    # dropped tokens bypassed: out has 4 rows ==1 and 4 rows ==0 per batch
+    ones = int((np.asarray(out[0]) == 1).all(axis=-1).sum())
+    assert ones == 4
+
+
+def test_ltd_scheduler_anneals_and_quantizes():
+    s = RandomLTDScheduler({"min_value": 16, "max_value": 128,
+                            "random_ltd_schedule": {
+                                "schedule_type": "fixed_linear",
+                                "schedule_config": {"seq_per_step": 16,
+                                                    "require_steps": 100}}})
+    assert s.update_seq(0) == 16
+    mid = s.update_seq(50)
+    assert 16 < mid < 128 and mid % 16 == 0
+    assert s.update_seq(200) == 128
+
+
+# -- engine integration -----------------------------------------------------
+
+def test_engine_curriculum_truncates_and_trains():
+    model = CausalLM("tiny", max_seq_len=64)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True},
+        "curriculum_learning": {
+            "enabled": True, "min_difficulty": 8, "max_difficulty": 64,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 4,
+                                "difficulty_step": 8}},
+    })
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(
+        0, model.config.vocab_size,
+        (engine.train_batch_size, 64)).astype(np.int32)}
+    losses = [float(engine.train_batch(batch=batch)) for _ in range(6)]
+    assert np.isfinite(losses).all()
+    assert engine.curriculum_scheduler.get_current_difficulty() == 64
+
+
+def test_engine_random_ltd_trains_and_anneals():
+    model = CausalLM("tiny", max_seq_len=64)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True},
+        "data_efficiency": {"enabled": True, "data_routing": {
+            "enabled": True,
+            "random_ltd": {"enabled": True, "min_value": 16, "max_value": 64,
+                           "random_ltd_schedule": {
+                               "schedule_type": "fixed_linear",
+                               "schedule_config": {"seq_per_step": 16,
+                                                   "require_steps": 4}}}}},
+    })
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(
+        0, model.config.vocab_size,
+        (engine.train_batch_size, 64)).astype(np.int32)}
+    losses = [float(engine.train_batch(batch=batch)) for _ in range(6)]
+    assert np.isfinite(losses).all()
+    # annealed to full sequence -> ltd inactive variant engaged
+    assert engine._random_ltd.get_current_seq() == 64
+    assert len(engine._ltd_cache) >= 2  # at least two keep-buckets compiled
