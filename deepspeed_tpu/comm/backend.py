@@ -15,6 +15,8 @@ from __future__ import annotations
 
 from typing import Any, Optional, Sequence, Union
 
+from ..parallel.mesh import BATCH_AXES
+
 AxisName = Union[str, Sequence[str]]
 
 
@@ -44,7 +46,7 @@ class XLABackend(Backend):
         super().__init__(name="xla")
 
     # Each op returns the result (functional, jax-style) instead of mutating.
-    def all_reduce(self, tensor: Any, op: str = "sum", axis: AxisName = ("data", "expert")):
+    def all_reduce(self, tensor: Any, op: str = "sum", axis: AxisName = BATCH_AXES):
         import jax.lax as lax
 
         if op == "sum":

@@ -27,6 +27,7 @@ from typing import Any, Optional, Sequence, Union
 import numpy as np
 
 from .backend import XLABackend, AxisName
+from ..parallel.mesh import BATCH_AXES
 from ..utils.logging import logger, log_dist
 
 class ReduceOp:
@@ -90,7 +91,7 @@ def timed_op(fn):
 # ---------------------------------------------------------------------------
 
 @timed_op
-def all_reduce(tensor, op: str = SUM, axis: AxisName = ("data", "expert")):
+def all_reduce(tensor, op: str = SUM, axis: AxisName = BATCH_AXES):
     return _backend.all_reduce(tensor, op, axis)
 
 

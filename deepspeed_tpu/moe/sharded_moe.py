@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..parallel.mesh import constrain_spec
+from ..parallel.mesh import DATA_AXES, constrain_spec
 
 
 @dataclasses.dataclass(frozen=True)
@@ -121,7 +121,7 @@ def moe_ffn(x: jnp.ndarray, router_w: jnp.ndarray, expert_params: Dict[str, Any]
     # [G,S,E,C] x [G,S,D] -> [G,E,C,D]; G rides the data axis, E the expert
     # axis — this resharding IS the all-to-all
     expert_in = jnp.einsum("gsec,gsd->gecd", dispatch.astype(x.dtype), x)
-    expert_in = constrain_spec(expert_in, P("data", "expert", None, None))
+    expert_in = constrain_spec(expert_in, P(DATA_AXES, "expert", None, None))
 
     if activation == "swiglu":
         g = jnp.einsum("gecd,edf->gecf", expert_in,
@@ -134,7 +134,7 @@ def moe_ffn(x: jnp.ndarray, router_w: jnp.ndarray, expert_params: Dict[str, Any]
                                    expert_params["w_in"].astype(x.dtype)))
     expert_out = jnp.einsum("gecf,efd->gecd", h,
                             expert_params["w_down"].astype(x.dtype))
-    expert_out = constrain_spec(expert_out, P("data", "expert", None, None))
+    expert_out = constrain_spec(expert_out, P(DATA_AXES, "expert", None, None))
 
     out = jnp.einsum("gsec,gecd->gsd", combine.astype(x.dtype), expert_out)
     return out, aux.astype(jnp.float32)

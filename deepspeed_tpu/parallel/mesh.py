@@ -11,9 +11,13 @@ and XLA routes them over ICI/DCN.
 
 Canonical axis order (outermost → innermost):
 
-    ('pipe', 'data', 'expert', 'seq', 'model')
+    ('pipe', 'data_outer', 'data', 'expert', 'seq', 'model')
 
-- DP world (batch sharding, ZeRO sharding) = data × expert  → spec ``('data','expert')``
+- DP world (batch sharding) = data_outer × data × expert → spec ``BATCH_AXES``.
+  ZeRO sharding uses only the *inner* axes ``ZERO_AXES = ('data','expert')``;
+  'data_outer' is 1 except under MiCS (``mics_shard_size``), where ZeRO shards
+  live in inner-axis groups and replicate across 'data_outer' replica groups
+  (reference ``runtime/zero/mics.py``).
 - expert parallelism shards the expert dimension over 'expert' only; expert
   params replicate over 'data' (the reference's *expert-data-parallel* group,
   groups.py:161).
@@ -34,12 +38,14 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-MESH_AXES = ("pipe", "data", "expert", "seq", "model")
+MESH_AXES = ("pipe", "data_outer", "data", "expert", "seq", "model")
 
 # Axes over which a ZeRO/FSDP-sharded non-expert parameter is partitioned.
 ZERO_AXES = ("data", "expert")
+# Pure data-parallel axes (batch sharding excluding the expert dimension).
+DATA_AXES = ("data_outer", "data")
 # Batch (data-parallel) sharding axes.
-BATCH_AXES = ("data", "expert")
+BATCH_AXES = DATA_AXES + ("expert",)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -53,29 +59,35 @@ class MeshLayout:
     pp: int = 1  # pipeline stages
     ep: int = 1  # expert parallel
     sp: int = 1  # sequence/context parallel
+    # MiCS (reference runtime/zero/mics.py): ZeRO shards live on the inner
+    # ZERO_AXES ('data','expert') and replicate across 'data_outer', so the
+    # shard group size is dp×ep and the number of replica groups is dp_outer.
+    # Batch/grad reduction spans all of BATCH_AXES; ZERO_AXES stays inner-only.
+    dp_outer: int = 1
 
     @property
     def world_size(self) -> int:
-        return self.dp * self.tp * self.pp * self.ep * self.sp
+        return self.dp * self.dp_outer * self.tp * self.pp * self.ep * self.sp
 
     @property
     def dp_world_size(self) -> int:
         """Total data-parallel degree as the reference counts it (dp×ep)."""
-        return self.dp * self.ep
+        return self.dp * self.dp_outer * self.ep
 
-    def axis_sizes(self) -> Tuple[int, int, int, int, int]:
-        return (self.pp, self.dp, self.ep, self.sp, self.tp)
+    def axis_sizes(self) -> Tuple[int, int, int, int, int, int]:
+        return (self.pp, self.dp_outer, self.dp, self.ep, self.sp, self.tp)
 
     @staticmethod
     def from_world(world_size: int, tp: int = 1, pp: int = 1, ep: int = 1, sp: int = 1,
-                   dp: Optional[int] = None) -> "MeshLayout":
-        denom = tp * pp * ep * sp
+                   dp: Optional[int] = None, dp_outer: int = 1) -> "MeshLayout":
+        denom = tp * pp * ep * sp * dp_outer
         if dp is None:
             if world_size % denom != 0:
                 raise ValueError(
-                    f"world size {world_size} not divisible by tp*pp*ep*sp={denom}")
+                    f"world size {world_size} not divisible by "
+                    f"tp*pp*ep*sp*dp_outer={denom}")
             dp = world_size // denom
-        layout = MeshLayout(dp=dp, tp=tp, pp=pp, ep=ep, sp=sp)
+        layout = MeshLayout(dp=dp, tp=tp, pp=pp, ep=ep, sp=sp, dp_outer=dp_outer)
         if layout.world_size != world_size:
             raise ValueError(
                 f"mesh layout {layout} covers {layout.world_size} devices, have {world_size}")

@@ -102,6 +102,15 @@ class ZeroConfig(DeepSpeedConfigModel):
         if self.zero_quantized_weights or self.zero_quantized_gradients:
             if self.stage != 3:
                 raise ValueError("ZeRO++ quantized collectives require stage 3")
+        if self.mics_shard_size == 0 or self.mics_shard_size < -1:
+            raise ValueError(
+                f"mics_shard_size={self.mics_shard_size} invalid: must be -1 "
+                "(disabled) or a positive shard-group size")
+        if self.mics_shard_size > 0 and self.stage != 3:
+            raise ValueError("mics_shard_size (MiCS) requires ZeRO stage 3")
+        if self.mics_hierarchical_params_gather and self.mics_shard_size <= 0:
+            raise ValueError(
+                "mics_hierarchical_params_gather requires mics_shard_size > 0")
         return self
 
 
@@ -371,8 +380,6 @@ class DeepSpeedConfig:
                 not zc.offload_optimizer.nvme_path:
             bad.append("zero_optimization.offload_optimizer.device=nvme "
                        "requires nvme_path")
-        if zc.mics_shard_size != -1 or zc.mics_hierarchical_params_gather:
-            bad.append("zero_optimization.mics_shard_size (MiCS)")
         if zc.zero_hpz_partition_size > 1:
             bad.append("zero_optimization.zero_hpz_partition_size (ZeRO++ hpZ)")
         ac = self.activation_checkpointing

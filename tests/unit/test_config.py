@@ -110,7 +110,6 @@ def test_no_knob_is_silently_inert():
         {"zero_optimization": {"stage": 3, "offload_param": {"device": "cpu"}}},
         {"zero_optimization": {"stage": 3,
                                "offload_optimizer": {"device": "nvme"}}},
-        {"zero_optimization": {"stage": 3, "mics_shard_size": 2}},
         {"activation_checkpointing": {"cpu_checkpointing": True}},
         {"activation_checkpointing": {"profile": True}},
         {"elasticity": {"enabled": True}},
