@@ -1,0 +1,20 @@
+"""Elastic training (reference ``deepspeed/elasticity/``): batch-size plans
+that stay valid across device-count changes, plus a preemption-aware agent."""
+from .elasticity import (  # noqa: F401
+    DEEPSPEED_ELASTICITY_CONFIG,
+    ElasticityError,
+    ElasticityConfigError,
+    ElasticityIncompatibleWorldSize,
+    ElasticPlan,
+    compute_elastic_config,
+    elasticity_enabled,
+    ensure_immutable_elastic_config,
+    pick_micro_batch,
+    plan_elastic_batch,
+    valid_device_counts,
+)
+from .elastic_agent import (  # noqa: F401
+    ElasticAgent,
+    PreemptionGuard,
+    resolve_plan_for_current_world,
+)

@@ -1,0 +1,128 @@
+"""Elastic run loop: survive preemption / slice-shape changes via checkpoints.
+
+Parity target: reference ``deepspeed/elasticity/elastic_agent.py:28``
+(``DSElasticAgent`` — a torch-elastic agent that restarts worker groups when
+membership changes).  TPUs have no in-job membership change: a slice is
+immutable while allocated, and "elasticity" means the JOB is stopped
+(preemption, resize) and restarted on a possibly different slice.  So the TPU
+agent is checkpoint-centric rather than rendezvous-centric:
+
+- a signal handler converts SIGTERM (the TPU preemption notice) into a
+  save-and-exit at the next step boundary;
+- on start, the agent resolves the elastic plan for the CURRENT device count
+  (``compute_elastic_config``) and restores the latest checkpoint — the orbax
+  checkpoint layer already reshards across topologies, so a job that left on
+  32 chips resumes on 8 with the same effective batch size.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import Callable, Optional
+
+from .elasticity import ElasticPlan, compute_elastic_config
+from ..utils.logging import log_dist, logger
+
+
+class PreemptionGuard:
+    """Latches termination signals so training can exit at a step boundary.
+
+    Usage::
+
+        guard = PreemptionGuard.install()
+        while training:
+            engine.train_batch(...)
+            if guard.should_stop:
+                engine.save_checkpoint(ckpt_dir)
+                break
+    """
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self._signals = signals
+        self._previous = {}
+        self.should_stop = False
+        self.received: Optional[int] = None
+
+    def _handler(self, signum, frame):
+        self.should_stop = True
+        self.received = signum
+        logger.warning(f"preemption signal {signum} latched; will checkpoint "
+                       "and exit at the next step boundary")
+
+    @classmethod
+    def install(cls, signals=(signal.SIGTERM, signal.SIGINT)) -> "PreemptionGuard":
+        guard = cls(signals)
+        for s in signals:
+            guard._previous[s] = signal.signal(s, guard._handler)
+        return guard
+
+    def uninstall(self) -> None:
+        for s, prev in self._previous.items():
+            signal.signal(s, prev)
+        self._previous = {}
+
+
+class ElasticAgent:
+    """Drives an elastic training session across restarts.
+
+    ``train_step_fn(engine, step) -> loss`` supplies one training step; the
+    agent owns plan resolution, checkpoint restore on entry, periodic +
+    preemption checkpointing, and the stop decision.
+    """
+
+    def __init__(self, engine, ckpt_dir: str, ckpt_every: int = 0,
+                 tag: str = "elastic"):
+        self.engine = engine
+        self.ckpt_dir = ckpt_dir
+        self.ckpt_every = ckpt_every
+        self.tag = tag
+        self.guard = PreemptionGuard.install()
+        self.resumed_step = 0
+
+    def restore_if_present(self) -> int:
+        """Load the newest checkpoint (any prior topology); returns the step
+        training should resume from."""
+        if os.path.isdir(self.ckpt_dir) and os.listdir(self.ckpt_dir):
+            try:
+                self.engine.load_checkpoint(self.ckpt_dir)
+                self.resumed_step = int(self.engine.global_steps)
+                log_dist(f"elastic resume from step {self.resumed_step} "
+                         f"on {self.engine.dp_world} DP devices", ranks=[0])
+            except FileNotFoundError:
+                pass
+        return self.resumed_step
+
+    def run(self, train_step_fn: Callable, total_steps: int) -> int:
+        """Run to ``total_steps`` or preemption; returns the last global step
+        completed.  Exit code contract: the wrapper script should relaunch
+        while the returned step < total_steps."""
+        start = self.restore_if_present()
+        saved_at = -1
+        for step in range(start, total_steps):
+            train_step_fn(self.engine, step)
+            at_interval = self.ckpt_every and (step + 1) % self.ckpt_every == 0
+            if at_interval or self.guard.should_stop:
+                self.engine.save_checkpoint(self.ckpt_dir, tag=self.tag)
+                saved_at = step + 1
+            if self.guard.should_stop:
+                log_dist(f"elastic exit at step {step + 1} "
+                         f"(signal {self.guard.received})", ranks=[0])
+                return step + 1
+        if saved_at != total_steps:
+            self.engine.save_checkpoint(self.ckpt_dir, tag=self.tag)
+        return total_steps
+
+
+def resolve_plan_for_current_world(config, dp_world_size: int,
+                                   node_size: int = 1,
+                                   model_parallel_size: int = 1) -> ElasticPlan:
+    """Helper the runtime config calls: elastic plan bound to this restart's
+    world size."""
+    plan = compute_elastic_config(config, dp_world_size, node_size,
+                                  model_parallel_size)
+    log_dist(
+        f"elasticity: batch={plan.train_batch_size} micro="
+        f"{plan.micro_batch_per_device} gas={plan.gradient_accumulation_steps} "
+        f"valid device counts={list(plan.valid_device_counts)}", ranks=[0])
+    return plan

@@ -285,6 +285,8 @@ class ElasticityConfig(DeepSpeedConfigModel):
     version: float = 0.1
     ignore_non_elastic_batch_info: bool = False
     prefer_larger_batch: bool = True
+    num_gpus_per_node: int = Field(1, ge=1)
+    model_parallel_size: int = Field(1, ge=1)
 
 
 class DeepSpeedConfigError(Exception):
@@ -387,8 +389,6 @@ class DeepSpeedConfig:
                      "synchronize_checkpoint_boundary", "profile"):
             if getattr(ac, knob):
                 bad.append(f"activation_checkpointing.{knob}")
-        if self.elasticity.enabled:
-            bad.append("elasticity.enabled")
 
         if bad:
             raise NotImplementedError(
@@ -397,6 +397,9 @@ class DeepSpeedConfig:
 
     # -- batch triad (reference runtime/config.py `_batch_assertion` et al.) --
     def resolve_batch_triad(self, dp_world_size: int) -> None:
+        if self.elasticity.enabled:
+            self._resolve_elastic_triad(dp_world_size)
+            return
         tb, mb, gas = (self.train_batch_size, self.train_micro_batch_size_per_gpu,
                        self.gradient_accumulation_steps)
         if tb is not None and mb is not None and gas is not None:
@@ -423,6 +426,33 @@ class DeepSpeedConfig:
                 f"micro_batch({mb}) * gas({gas}) * dp_world({dp_world_size})")
         self.train_batch_size, self.train_micro_batch_size_per_gpu = tb, mb
         self.gradient_accumulation_steps = gas
+
+    def _resolve_elastic_triad(self, dp_world_size: int) -> None:
+        """Elastic mode: the batch triad comes from the elastic plan, not the
+        user's knobs (reference elasticity handling in runtime/config.py —
+        explicit batch settings conflict unless ignore_non_elastic_batch_info)."""
+        from ..elasticity import (ensure_immutable_elastic_config,
+                                  resolve_plan_for_current_world)
+        if getattr(self, "elastic_plan", None) is not None:
+            return  # already resolved (engine re-calls resolve_batch_triad)
+        ec = self.elasticity
+        user_set = [k for k, v in (
+            ("train_batch_size", self.train_batch_size),
+            ("train_micro_batch_size_per_gpu", self.train_micro_batch_size_per_gpu),
+            ("gradient_accumulation_steps", self.gradient_accumulation_steps),
+        ) if v is not None]
+        if user_set and not ec.ignore_non_elastic_batch_info:
+            raise DeepSpeedConfigError(
+                f"elasticity is enabled but {user_set} are also set; elastic "
+                "training derives the batch triad from the plan — remove them "
+                "or set elasticity.ignore_non_elastic_batch_info")
+        ensure_immutable_elastic_config(ec.model_dump())
+        plan = resolve_plan_for_current_world(
+            ec, dp_world_size, node_size=ec.num_gpus_per_node,
+            model_parallel_size=ec.model_parallel_size)
+        (self.train_batch_size, self.train_micro_batch_size_per_gpu,
+         self.gradient_accumulation_steps) = plan.as_triad()
+        self.elastic_plan = plan
 
     # -- convenience accessors used by the engine --
     @property

@@ -112,7 +112,6 @@ def test_no_knob_is_silently_inert():
                                "offload_optimizer": {"device": "nvme"}}},
         {"activation_checkpointing": {"cpu_checkpointing": True}},
         {"activation_checkpointing": {"profile": True}},
-        {"elasticity": {"enabled": True}},
     ]
     for setting in inert_settings:
         with pytest.raises(NotImplementedError):

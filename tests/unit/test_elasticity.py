@@ -1,0 +1,179 @@
+"""Elasticity — batch plans valid across device counts + preemption agent
+(reference deepspeed/elasticity/elasticity.py:27-233, elastic_agent.py:28)."""
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.elasticity import (
+    DEEPSPEED_ELASTICITY_CONFIG,
+    ElasticAgent,
+    ElasticityConfigError,
+    ElasticityIncompatibleWorldSize,
+    PreemptionGuard,
+    compute_elastic_config,
+    ensure_immutable_elastic_config,
+    pick_micro_batch,
+    plan_elastic_batch,
+    valid_device_counts,
+)
+from deepspeed_tpu.parallel import mesh as mesh_mod
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, ElasticityConfig
+
+from .simple_model import SimpleModel, random_batch
+
+
+def test_valid_device_counts():
+    # batch 24, micro {2,4}: slots 12 or 6 → divisors {1,2,3,4,6,12}∪{1,2,3,6}
+    assert valid_device_counts(24, [2, 4]) == [1, 2, 3, 4, 6, 12]
+    # range filter
+    assert valid_device_counts(24, [2, 4], min_devices=3, max_devices=6) == [3, 4, 6]
+    # micro-batch that doesn't divide contributes nothing
+    assert valid_device_counts(10, [3]) == []
+
+
+def test_plan_elastic_batch_maximizes_compatibility():
+    batch, counts = plan_elastic_batch([2, 4, 6], 2000)
+    # every count must actually work
+    assert counts == valid_device_counts(batch, [2, 4, 6])
+    assert batch <= 2000
+    # the plan must beat a naive choice on compatibility
+    naive = valid_device_counts(2000, [2, 4, 6])
+    assert len(counts) >= len(naive)
+
+
+def test_plan_prefers_larger_on_ties():
+    b_large, _ = plan_elastic_batch([2], 16, prefer_larger=True)
+    b_small, _ = plan_elastic_batch([2], 16, prefer_larger=False)
+    assert b_large >= b_small
+
+
+def test_plan_rejects_impossible():
+    with pytest.raises(ElasticityConfigError):
+        plan_elastic_batch([32], 16)
+    with pytest.raises(ElasticityConfigError):
+        plan_elastic_batch([], 16)
+
+
+def test_pick_micro_batch():
+    assert pick_micro_batch(48, [2, 4, 6], dp_world_size=4) == 6  # 12 slots
+    assert pick_micro_batch(48, [2, 4, 6], dp_world_size=4,
+                            prefer_larger=False) == 2
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        pick_micro_batch(48, [5], dp_world_size=4)
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        pick_micro_batch(48, [2], dp_world_size=5)
+
+
+def test_compute_elastic_config_binds_world():
+    ec = ElasticityConfig(enabled=True, max_train_batch_size=2000,
+                          micro_batch_sizes=[2, 4, 6], min_gpus=1, max_gpus=64)
+    plan = compute_elastic_config(ec, dp_world_size=8)
+    assert plan.train_batch_size % (plan.micro_batch_per_device * 8) == 0
+    assert plan.gradient_accumulation_steps == plan.train_batch_size // (
+        plan.micro_batch_per_device * 8)
+    assert 8 in plan.valid_device_counts
+    # unbound (scheduler-side) plan
+    unbound = compute_elastic_config(ec, dp_world_size=0)
+    assert unbound.train_batch_size == plan.train_batch_size
+
+
+def test_compute_elastic_config_node_granularity():
+    ec = ElasticityConfig(enabled=True, max_train_batch_size=1024,
+                          micro_batch_sizes=[2, 4], min_gpus=8, max_gpus=64,
+                          version=0.2, num_gpus_per_node=8)
+    plan = compute_elastic_config(ec, dp_world_size=16, node_size=8)
+    assert all(c % 8 == 0 for c in plan.valid_device_counts)
+    assert 16 in plan.valid_device_counts
+
+
+def test_immutable_config_guard(monkeypatch):
+    cfg = {"max_train_batch_size": 2000, "micro_batch_sizes": [2, 4]}
+    monkeypatch.setenv(DEEPSPEED_ELASTICITY_CONFIG, json.dumps(cfg))
+    ensure_immutable_elastic_config(dict(cfg))  # matching → fine
+    with pytest.raises(ElasticityConfigError, match="mismatch"):
+        ensure_immutable_elastic_config(
+            {"max_train_batch_size": 1000, "micro_batch_sizes": [2, 4]})
+
+
+def test_config_triad_from_elastic_plan():
+    cfg = DeepSpeedConfig({
+        "elasticity": {"enabled": True, "max_train_batch_size": 512,
+                       "micro_batch_sizes": [2, 4], "max_gpus": 64},
+    }, dp_world_size=8)
+    assert cfg.train_batch_size == cfg.train_micro_batch_size_per_gpu * \
+        cfg.gradient_accumulation_steps * 8
+    assert cfg.train_micro_batch_size_per_gpu in (2, 4)
+
+
+def test_config_rejects_conflicting_batch_knobs():
+    with pytest.raises(Exception, match="elastic"):
+        DeepSpeedConfig({
+            "train_batch_size": 64,
+            "elasticity": {"enabled": True, "max_train_batch_size": 512,
+                           "micro_batch_sizes": [2, 4]},
+        }, dp_world_size=8)
+
+
+def test_engine_trains_elastic(tmp_path):
+    mesh_mod.reset_mesh()
+    engine, _, _, _ = deepspeed_tpu.initialize(model=SimpleModel(32), config={
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "elasticity": {"enabled": True, "max_train_batch_size": 64,
+                       "micro_batch_sizes": [2, 4], "min_gpus": 8,
+                       "max_gpus": 64},
+        "bf16": {"enabled": True},
+    })
+    loss = float(engine.train_batch(
+        batch=random_batch(engine.train_batch_size, 32, 0)))
+    assert np.isfinite(loss)
+    mesh_mod.reset_mesh()
+
+
+def test_preemption_guard_latches():
+    guard = PreemptionGuard.install(signals=(signal.SIGUSR1,))
+    try:
+        assert not guard.should_stop
+        os.kill(os.getpid(), signal.SIGUSR1)
+        assert guard.should_stop
+        assert guard.received == signal.SIGUSR1
+    finally:
+        guard.uninstall()
+
+
+def test_elastic_agent_checkpoints_on_preemption(tmp_path):
+    mesh_mod.reset_mesh()
+    engine, _, _, _ = deepspeed_tpu.initialize(model=SimpleModel(32), config={
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "bf16": {"enabled": True},
+    })
+    agent = ElasticAgent(engine, str(tmp_path / "ckpt"))
+    try:
+        def step(eng, i):
+            eng.train_batch(batch=random_batch(eng.train_batch_size, 32, i))
+            if i == 1:  # simulate the preemption notice mid-run
+                agent.guard._handler(signal.SIGTERM, None)
+        stopped_at = agent.run(step, total_steps=10)
+        assert stopped_at == 2  # exited at the boundary after the signal
+        assert os.path.isdir(str(tmp_path / "ckpt"))
+    finally:
+        agent.guard.uninstall()
+
+    # relaunch on a "new slice": fresh engine resumes from the checkpoint
+    mesh_mod.reset_mesh()
+    engine2, _, _, _ = deepspeed_tpu.initialize(model=SimpleModel(32), config={
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "bf16": {"enabled": True},
+    })
+    agent2 = ElasticAgent(engine2, str(tmp_path / "ckpt"))
+    try:
+        resumed = agent2.restore_if_present()
+        assert resumed >= 1
+    finally:
+        agent2.guard.uninstall()
+    mesh_mod.reset_mesh()
