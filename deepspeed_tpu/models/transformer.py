@@ -32,6 +32,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.mesh import BATCH_AXES, constrain_spec
@@ -454,14 +455,14 @@ def _mlp(cfg: TransformerConfig, lp: Dict[str, Any], h, rng, deterministic):
                       noisy_gate_policy=cfg.noisy_gate_policy),
             activation=cfg.activation, deterministic=deterministic, rng=rng)
     elif cfg.activation == "swiglu":
-        g = h @ lp["w_gate"]
-        u = h @ lp["w_up"]
+        g = checkpoint_name(h @ lp["w_gate"], "mlp_gate")
+        u = checkpoint_name(h @ lp["w_up"], "mlp_up")
         if cfg.mlp_bias:
             g, u = g + lp["b_gate"], u + lp["b_up"]
         m = jax.nn.silu(g) * u
         m = m @ lp["w_down"]
     else:
-        m = h @ lp["w_in"]
+        m = checkpoint_name(h @ lp["w_in"], "mlp_up")
         if cfg.mlp_bias:
             m = m + lp["b_in"]
         m = jax.nn.gelu(m)
@@ -487,12 +488,16 @@ def _block(cfg: TransformerConfig, lp: Dict[str, Any], x, positions, rng,
     v = v.reshape(B, S, nkv, hd)
     if cfg.position == "rope":
         q, k = _rope(q, k, positions, cfg.rope_theta, hd)
+    # named so "save_matmuls" can pin the projection outputs (post-rope, so
+    # the attention backward starts from exactly these tensors)
+    q = checkpoint_name(q, "q_proj")
+    k = checkpoint_name(k, "k_proj")
+    v = checkpoint_name(v, "v_proj")
     attn = _attention(cfg, q, k, v, positions, attn_impl, custom_positions)
     # named checkpoint: the "save_attn" remat policy stashes this one tensor
     # per layer ([B,S,H*hd] bf16) so the backward skips recomputing the whole
     # attention (the costliest part of the recompute) while the rest of the
     # layer still rematerializes
-    from jax.ad_checkpoint import checkpoint_name
     attn = checkpoint_name(attn, "attn_out")
     attn = attn.reshape(B, S, nh * hd) @ lp["wo"]
     if cfg.attn_bias:
@@ -538,6 +543,14 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
             # bytes/layer) and rematerialize everything else: the backward
             # re-runs the cheap matmul/norm chain but not attention
             policy = jax.checkpoint_policies.save_only_these_names("attn_out")
+        elif cfg.remat_policy == "save_matmuls":
+            # pin every big projection output (q/k/v post-rope, gate/up, attn)
+            # so the backward recompute is norms/elementwise only — recompute
+            # cost drops from +2N to ~0 at ~6 saved [B,S,·] tensors per layer
+            # (vs dots_saveable, which would also pin the [S,S] score matrices
+            # and OOM)
+            policy = jax.checkpoint_policies.save_only_these_names(
+                "attn_out", "q_proj", "k_proj", "v_proj", "mlp_gate", "mlp_up")
         else:
             policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
         block = jax.checkpoint(block, policy=policy)
