@@ -23,9 +23,19 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 256
-DEFAULT_BLOCK_K = 256
+# 1024-blocks win on v5e for hd=128-class shapes (measured, best-of-3, causal
+# B8 H14 S2048: fwd 9.75→5.22ms, fwd+bwd 24.7→14.8ms vs 256-blocks): larger
+# tiles amortize the VPU softmax and block-boundary overhead even though the
+# causal skip gets coarser.  _pick_block shrinks them for short sequences.
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
 NEG_INF = -1e30
+
+# The first three grid axes are independent in every kernel here; only the
+# INNERMOST axis carries accumulator state (the K sweep in _fwd/_bwd_dq, the
+# Q-and-group sweep in _bwd_dkv) and must stay 'arbitrary'.
+_DIM_SEMANTICS = ("parallel", "parallel", "parallel", "arbitrary")
+_COMPILER_PARAMS = pltpu.CompilerParams(dimension_semantics=_DIM_SEMANTICS)
 
 
 def _interpret_default() -> bool:
@@ -134,6 +144,7 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
+        compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
     )(q, k, v)
     return out, lse
@@ -264,6 +275,7 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
                                lambda b, h, qi, ki: (b, h, qi, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, hd), jnp.float32)],
+        compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
@@ -301,6 +313,7 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
             pltpu.VMEM((block_k, hd), jnp.float32),
             pltpu.VMEM((block_k, hd), jnp.float32),
         ],
+        compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
     )(q, k, v, do, lse, delta)
     return dq, dk, dv
