@@ -1,0 +1,70 @@
+"""Pruning mask builders (sparse / row / head / channel).
+
+Parity target: reference ``compression/basic_layer.py`` pruning paths
+(``LinearLayer_Compress`` sparse_pruning_method l1/topk, row/channel pruning,
+head pruning on attention output projections) and ``helper.py`` mask utils.
+Masks are pure functions of the weights — recomputed under jit (cheap: a
+sort/threshold per tensor) rather than stored as buffers, so they stay
+correct under ZeRO sharding and need no extra checkpoint state.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _keep_threshold(scores: jnp.ndarray, dense_ratio: float) -> jnp.ndarray:
+    """Score cutoff keeping the top ``dense_ratio`` fraction."""
+    k = jnp.maximum(1, jnp.int32(round(scores.size * dense_ratio)))
+    flat = scores.reshape(-1)
+    sorted_scores = jnp.sort(flat)[::-1]
+    return sorted_scores[k - 1]
+
+
+def sparse_mask(w: jnp.ndarray, dense_ratio: float,
+                method: str = "l1") -> jnp.ndarray:
+    """Unstructured mask keeping the largest-|w| ``dense_ratio`` fraction
+    (reference SPARSE_PRUNING_METHOD l1; 'topk' uses the same magnitude
+    criterion with an exact per-tensor threshold)."""
+    scores = jnp.abs(w.astype(jnp.float32))
+    thr = _keep_threshold(scores, dense_ratio)
+    return (scores >= thr).astype(w.dtype)
+
+
+def row_mask(w: jnp.ndarray, dense_ratio: float, axis: int = 0) -> jnp.ndarray:
+    """Structured mask keeping whole rows (output channels along ``axis``)
+    with the largest L1 norms (reference ROW_PRUNING)."""
+    scores = jnp.sum(jnp.abs(w.astype(jnp.float32)),
+                     axis=tuple(i for i in range(w.ndim) if i != axis % w.ndim))
+    thr = _keep_threshold(scores, dense_ratio)
+    keep = scores >= thr
+    shape = [1] * w.ndim
+    shape[axis % w.ndim] = w.shape[axis % w.ndim]
+    return keep.reshape(shape).astype(w.dtype)
+
+
+def channel_mask(w: jnp.ndarray, dense_ratio: float,
+                 axis: int = -1) -> jnp.ndarray:
+    """Structured mask over input channels (reference CHANNEL_PRUNING)."""
+    return row_mask(w, dense_ratio, axis=axis)
+
+
+def head_mask(wo: jnp.ndarray, num_heads: int, dense_ratio: float) -> jnp.ndarray:
+    """Mask whole attention heads on the output projection ``wo``
+    [num_heads*head_dim, hidden] by per-head L1 norm (reference HEAD_PRUNING,
+    applied to the attention output matrix)."""
+    in_dim = wo.shape[-2]
+    head_dim = in_dim // num_heads
+    per_head = jnp.sum(jnp.abs(wo.astype(jnp.float32)).reshape(
+        wo.shape[:-2] + (num_heads, head_dim, wo.shape[-1])), axis=(-2, -1))
+    thr = _keep_threshold(per_head, dense_ratio)
+    keep = (per_head >= thr)[..., :, None, None]
+    keep = jnp.broadcast_to(
+        keep, wo.shape[:-2] + (num_heads, head_dim, wo.shape[-1]))
+    return keep.reshape(wo.shape).astype(wo.dtype)
+
+
+def apply_mask(w: jnp.ndarray, mask: Optional[jnp.ndarray]) -> jnp.ndarray:
+    return w if mask is None else w * mask

@@ -1,0 +1,115 @@
+"""Quantization-aware training primitives (straight-through estimators).
+
+Parity target: reference ``compression/basic_layer.py`` quantization
+(``LinearLayer_Compress.forward`` weight/activation fake-quant,
+``Quantizer``/``helper.py``) and the MoQ quantize-while-training idea
+(``quantize.py``).  The reference implements fake-quant as torch autograd
+Functions; here each quantizer is a pure function with a ``custom_vjp``
+identity gradient, so it composes with jit/remat/pjit and runs fused on the
+VPU — no kernel needed (the int math stays in registers; XLA fuses the
+round-trip into the consuming matmul's prologue).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.custom_vjp
+def _ste_round_clip(x, lo, hi):
+    return jnp.clip(jnp.round(x), lo, hi)
+
+
+def _ste_round_clip_fwd(x, lo, hi):
+    return jnp.clip(jnp.round(x), lo, hi), (x, lo, hi)
+
+
+def _ste_round_clip_bwd(res, g):
+    # saturating straight-through: pass within the (closed) range, zero
+    # outside — a plain clip would halve the gradient at exact-boundary ties
+    x, lo, hi = res
+    inside = jnp.logical_and(x >= lo, x <= hi)
+    return (jnp.where(inside, g, 0.0), None, None)
+
+
+_ste_round_clip.defvjp(_ste_round_clip_fwd, _ste_round_clip_bwd)
+
+
+def quantize_ste(w: jnp.ndarray, bits: int, symmetric: bool = True,
+                 per_channel: bool = False, axis: int = -1) -> jnp.ndarray:
+    """Fake-quantize ``w`` to ``bits`` with a straight-through gradient.
+
+    symmetric: scale = max|w| / qmax, zero-point 0 (reference
+    ``WEIGHT_QUANTIZE_SYMMETRIC``); asymmetric: affine [min, max] mapping.
+    per_channel reduces statistics over all axes EXCEPT ``axis`` (the output
+    channel), matching per-row scales in the reference's weight groups.
+    """
+    if bits >= 16:
+        return w
+    compute = w.dtype
+    w32 = w.astype(jnp.float32)
+    reduce_axes = None
+    if per_channel:
+        reduce_axes = tuple(i for i in range(w.ndim) if i != axis % w.ndim)
+    # range statistics are gradient-stopped: a pure straight-through
+    # estimator passes dL/dq unchanged, without range-derivative terms
+    if symmetric:
+        qmax = 2.0 ** (bits - 1) - 1.0
+        amax = jnp.max(jnp.abs(w32), axis=reduce_axes, keepdims=True)
+        scale = jax.lax.stop_gradient(jnp.maximum(amax, 1e-8) / qmax)
+        q = _ste_round_clip(w32 / scale, -qmax - 1.0, qmax)
+        return (q * scale).astype(compute)
+    qmax = 2.0 ** bits - 1.0
+    lo = jax.lax.stop_gradient(
+        jnp.min(w32, axis=reduce_axes, keepdims=True))
+    hi = jax.lax.stop_gradient(
+        jnp.max(w32, axis=reduce_axes, keepdims=True))
+    scale = jnp.maximum(hi - lo, 1e-8) / qmax
+    q = _ste_round_clip((w32 - lo) / scale, 0.0, qmax)
+    return (q * scale + lo).astype(compute)
+
+
+def activation_fake_quant(x: jnp.ndarray, bits: int, symmetric: bool = False,
+                          static_range: Optional[float] = None) -> jnp.ndarray:
+    """Activation fake-quant (reference ACTIVATION_QUANTIZATION): dynamic
+    range by default (per-tensor min/max each call), or a fixed symmetric
+    ``static_range`` (reference 'static' calibration)."""
+    if bits >= 16:
+        return x
+    if static_range is not None:
+        compute = x.dtype
+        qmax = 2.0 ** (bits - 1) - 1.0
+        scale = static_range / qmax
+        q = _ste_round_clip(x.astype(jnp.float32) / scale, -qmax - 1.0, qmax)
+        return (q * scale).astype(compute)
+    return quantize_ste(x, bits, symmetric=symmetric, per_channel=False)
+
+
+def bit_schedule(step: jnp.ndarray, start_bits: int, target_bits: int,
+                 offset: int, period: int) -> jnp.ndarray:
+    """MoQ-style bit annealing (reference WEIGHT_QUANTIZE_START_BITS →
+    TARGET_BITS every ``quantization_period`` steps after ``offset``):
+    returns the integer bit-width for ``step`` as a traced value."""
+    if start_bits <= target_bits or period <= 0:
+        return jnp.int32(target_bits)
+    drops = jnp.maximum(step - offset, 0) // period
+    return jnp.maximum(jnp.int32(start_bits) - drops.astype(jnp.int32),
+                       jnp.int32(target_bits))
+
+
+def quantize_ste_scheduled(w, step, start_bits: int, target_bits: int,
+                           offset: int, period: int, symmetric: bool = True,
+                           per_channel: bool = False):
+    """Fake-quant with the annealed bit-width.  Bits are traced, so the
+    switch compiles to a select over the (few) candidate widths."""
+    if start_bits <= target_bits:
+        return quantize_ste(w, target_bits, symmetric, per_channel)
+    bits_now = bit_schedule(step, start_bits, target_bits, offset, period)
+    out = quantize_ste(w, target_bits, symmetric, per_channel)
+    for b in range(target_bits + 1, start_bits + 1):
+        out = jnp.where(bits_now == b,
+                        quantize_ste(w, b, symmetric, per_channel), out)
+    return out

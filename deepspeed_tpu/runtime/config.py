@@ -289,6 +289,30 @@ class ElasticityConfig(DeepSpeedConfigModel):
     model_parallel_size: int = Field(1, ge=1)
 
 
+class DataTypesConfig(DeepSpeedConfigModel):
+    """``data_types`` block (reference runtime/config.py:867): gradient
+    accumulation precision.  None/fp32 = exact fp32 accumulation; bf16 halves
+    the live gradient buffer."""
+
+    grad_accum_dtype: Optional[str] = None
+
+    @model_validator(mode="after")
+    def _validate(self):
+        if self.grad_accum_dtype not in (None, "fp32", "float32", "bf16",
+                                         "bfloat16"):
+            raise ValueError(
+                f"data_types.grad_accum_dtype={self.grad_accum_dtype!r} "
+                "must be fp32 or bf16")
+        return self
+
+    def jnp_dtype(self):
+        import jax.numpy as jnp
+
+        if self.grad_accum_dtype in ("bf16", "bfloat16"):
+            return jnp.bfloat16
+        return jnp.float32
+
+
 class DeepSpeedConfigError(Exception):
     pass
 
@@ -349,6 +373,7 @@ class DeepSpeedConfig:
         self.data_efficiency = DataEfficiencyConfig(
             **config.get("data_efficiency", {}))
         self.elasticity = ElasticityConfig(**config.get("elasticity", {}))
+        self.data_types = DataTypesConfig(**config.get("data_types", {}))
 
         self.gradient_accumulation_steps: Optional[int] = config.get(
             C.GRADIENT_ACCUMULATION_STEPS)

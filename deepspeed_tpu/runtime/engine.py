@@ -62,12 +62,17 @@ class TrainState:
     comm_error: Any = None            # 1-bit error-feedback buffers (per-worker)
 
 
-def make_grad_accumulator(grad_of_batch, gas: int):
-    """Shared microbatch scan: fp32-accumulate ``gas`` microbatch gradients.
+def make_grad_accumulator(grad_of_batch, gas: int, accum_dtype=None):
+    """Shared microbatch scan: accumulate ``gas`` microbatch gradients.
 
     run(work, scaler, window, rng) -> (summed grads, losses [gas], new_rng).
     Single source of truth for the accumulation loop (fused train step,
-    NVMe grad-only step, and the 1-bit compressed region all use it)."""
+    NVMe grad-only step, and the 1-bit compressed region all use it).
+    ``accum_dtype`` is the accumulator precision (reference config
+    ``data_types.grad_accum_dtype``, runtime/config.py:867): fp32 by default;
+    bf16 halves the live gradient buffer at a small accumulation-rounding
+    cost (most relevant for large ``gas``)."""
+    accum_dtype = accum_dtype or jnp.float32
 
     def run(work, scaler, window, rng):
         def micro(carry, microbatch):
@@ -75,11 +80,11 @@ def make_grad_accumulator(grad_of_batch, gas: int):
             r, sub = jax.random.split(r)
             grads, loss = grad_of_batch(work, scaler, microbatch, sub)
             acc = jax.tree_util.tree_map(
-                lambda a, g: a + g.astype(jnp.float32), acc, grads)
+                lambda a, g: a + g.astype(accum_dtype), acc, grads)
             return (acc, r), loss
 
         zeros = jax.tree_util.tree_map(
-            lambda x: jnp.zeros(x.shape, jnp.float32), work)
+            lambda x: jnp.zeros(x.shape, accum_dtype), work)
         (grads, new_rng), losses = jax.lax.scan(micro, (zeros, rng), window,
                                                 length=gas)
         return grads, losses, new_rng
@@ -196,6 +201,13 @@ class DeepSpeedEngine:
                     f"pipeline microbatches ({micro}) must equal "
                     f"gradient_accumulation_steps ({self.gas})")
 
+        # -- compression (QAT / pruning transform on the compute tree) --
+        from ..compression import build_param_transform
+
+        model_heads = getattr(getattr(model, "config", None), "num_heads", None)
+        self._compression_transform = build_param_transform(
+            self.config._param_dict, num_heads=model_heads)
+
         # -- lr schedule --
         if lr_scheduler is not None:
             self.lr_schedule = lr_scheduler
@@ -300,6 +312,11 @@ class DeepSpeedEngine:
         zc0 = self.config.zero_config
         nvme_dev = zc0.offload_optimizer.device if zc0.offload_optimizer else None
         if getattr(nvme_dev, "value", nvme_dev) == "nvme":
+            if self._compression_transform is not None:
+                raise NotImplementedError(
+                    "compression_training with NVMe optimizer offload is not "
+                    "supported: the grad-only step differentiates the raw "
+                    "params and would silently skip the QAT/pruning transform")
             self._init_nvme_offload(master, params0)
             master = None
             opt_state = ()
@@ -500,16 +517,31 @@ class DeepSpeedEngine:
         return grad_of_batch
 
     def _make_compute_tree(self):
-        """tree_fn(masters) -> the tree grad_of_batch differentiates: the
-        bf16/fp16 compute params (cast hoisted out of the microbatch scan),
-        or the masters themselves under ZeRO++ / fp32 compute."""
+        """tree_fn(masters, step=None) -> the tree grad_of_batch
+        differentiates: the bf16/fp16 compute params (cast hoisted out of the
+        microbatch scan), or the masters themselves under ZeRO++ / fp32
+        compute.  When compression_training is configured the QAT/pruning
+        transform applies here, on the compute-precision view, gated by the
+        traced step (reference init_compression wraps the matched modules;
+        see deepspeed_tpu/compression/compress.py)."""
         use_master = self.use_master_weights
         compute_dtype = self.compute_dtype
         param_shardings = self._param_shardings
+        compress = getattr(self, "_compression_transform", None)
         if not use_master or self._compute_cast is not None:
-            return lambda masters: masters
-        return lambda masters: constrain(
-            _cast_tree(masters, compute_dtype), param_shardings)
+            if compress is not None:
+                raise NotImplementedError(
+                    "compression_training with fp32 compute / ZeRO++ "
+                    "quantized gather is not supported yet")
+            return lambda masters, step=None: masters
+
+        def tree_fn(masters, step=None):
+            work = constrain(_cast_tree(masters, compute_dtype), param_shardings)
+            if compress is not None and step is not None:
+                work = constrain(compress(work, step), param_shardings)
+            return work
+
+        return tree_fn
 
     def _make_update_body(self):
         """update(state, masters, opt_in, grads, eff_gas) -> (new_state,
@@ -622,7 +654,8 @@ class DeepSpeedEngine:
 
     def _make_grad_only_step(self):
         gas = self.gas
-        accumulate = make_grad_accumulator(self._make_scaled_grad(), gas)
+        accumulate = make_grad_accumulator(self._make_scaled_grad(), gas,
+                                           self.config.data_types.jnp_dtype())
         prescale = self.config.prescale_gradients
         predivide = self.config.gradient_predivide_factor
         clip = self.config.gradient_clipping
@@ -697,12 +730,14 @@ class DeepSpeedEngine:
             template = (self.state.master_params if self.use_master_weights
                         else self.state.params)
             comp_grad = make_compressed_grad_fn(
-                make_grad_accumulator(grad_of_batch, gas), self.mesh, gas,
+                make_grad_accumulator(grad_of_batch, gas,
+                                      self.config.data_types.jnp_dtype()),
+                self.mesh, gas,
                 compression["freeze_step"], template)
 
             def train_step(state: TrainState, batch):
                 masters, opt_in = stream_in(state)
-                work = compute_tree(masters)
+                work = compute_tree(masters, state.step)
                 new_rng, region_rng = jax.random.split(state.rng)
                 grads, losses, new_error = comp_grad(
                     work, state.scaler, batch, region_rng, state.comm_error,
@@ -723,11 +758,12 @@ class DeepSpeedEngine:
                                out_shardings=self._train_out_shardings)
             return jax.jit(train_step, donate_argnums=(0,))
 
-        accumulate = make_grad_accumulator(grad_of_batch, gas)
+        accumulate = make_grad_accumulator(grad_of_batch, gas,
+                                           self.config.data_types.jnp_dtype())
 
         def train_step(state: TrainState, batch):
             masters, opt_in = stream_in(state)
-            work = compute_tree(masters)  # bf16 cast hoisted out of the scan
+            work = compute_tree(masters, state.step)  # bf16 cast hoisted out of the scan
 
             if pipeline:
                 # pipeline engines consume the whole gas window in ONE call:
@@ -770,11 +806,14 @@ class DeepSpeedEngine:
 
     def _make_eval_step(self):
         eval_fn = self._eval_fn
-        compute_dtype = self.compute_dtype
-        use_master = self.use_master_weights
+        compress = self._compression_transform
 
         def eval_step(state: TrainState, batch):
             p = state.params
+            if compress is not None:
+                # evaluate the same quantized/pruned view training optimizes,
+                # or validation metrics overstate the compressed model
+                p = compress(p, state.step)
             out = eval_fn(p, batch, state.rng)
             loss, aux = out if isinstance(out, tuple) else (out, {})
             return loss, aux
@@ -915,13 +954,15 @@ class DeepSpeedEngine:
         compute_tree = self._make_compute_tree()
         stream_in = self._stream_in
 
+        accum_dtype = self.config.data_types.jnp_dtype()
+
         def micro_grad(state: TrainState, batch, accum):
             masters, _ = stream_in(state)
             rng, sub = jax.random.split(state.rng)
-            grads, loss = grad_of_batch(compute_tree(masters), state.scaler,
+            grads, loss = grad_of_batch(compute_tree(masters, state.step), state.scaler,
                                         batch, sub)
             accum = jax.tree_util.tree_map(
-                lambda a, g: a + g.astype(jnp.float32), accum, grads)
+                lambda a, g: a + g.astype(accum_dtype), accum, grads)
             accum = constrain(accum, grad_specs)
             return loss, accum, rng
 
@@ -945,9 +986,10 @@ class DeepSpeedEngine:
     def _zero_grad_buffer(self):
         masters = (self.state.master_params if self.use_master_weights
                    else self.state.params)
+        accum_dtype = self.config.data_types.jnp_dtype()
         zeros = jax.jit(
             lambda m: jax.tree_util.tree_map(
-                lambda x: jnp.zeros(x.shape, jnp.float32), m),
+                lambda x: jnp.zeros(x.shape, accum_dtype), m),
             out_shardings=self._grad_shardings)(masters)
         return zeros
 

@@ -41,9 +41,13 @@ def _base_transform(name: str, params: Dict[str, Any]) -> optax.GradientTransfor
     eps = params.get("eps", 1e-8)
     weight_decay = params.get("weight_decay", 0.0)
 
+    # first-moment storage dtype (optax mu_dtype): "bfloat16" halves Adam's m
+    # buffer — the reference's memory-lean optimizer-state options analogue
+    mu_dtype = params.get("mu_dtype")
+
     if name in (ADAM_OPTIMIZER, ADAMW_OPTIMIZER):
         adam_w_mode = params.get("adam_w_mode", name == ADAMW_OPTIMIZER)
-        chain = [optax.scale_by_adam(b1=b1, b2=b2, eps=eps)]
+        chain = [optax.scale_by_adam(b1=b1, b2=b2, eps=eps, mu_dtype=mu_dtype)]
         if weight_decay:
             if adam_w_mode:
                 chain.append(optax.add_decayed_weights(weight_decay))
@@ -59,7 +63,7 @@ def _base_transform(name: str, params: Dict[str, Any]) -> optax.GradientTransfor
         return _base_transform(ADAM_OPTIMIZER, params)
     if name in (LAMB_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER):
         return optax.chain(
-            optax.scale_by_adam(b1=b1, b2=b2, eps=eps),
+            optax.scale_by_adam(b1=b1, b2=b2, eps=eps, mu_dtype=mu_dtype),
             optax.add_decayed_weights(weight_decay) if weight_decay else optax.identity(),
             optax.scale_by_trust_ratio(),
         )

@@ -1,0 +1,205 @@
+"""Compression suite — QAT fake-quant, pruning masks, layer reduction,
+redundancy clean, engine integration (reference deepspeed/compression/)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.compression import (
+    activation_fake_quant,
+    bit_schedule,
+    build_param_transform,
+    head_mask,
+    parse_compression_config,
+    quantize_ste,
+    redundancy_clean,
+    row_mask,
+    sparse_mask,
+    student_initialization,
+)
+from deepspeed_tpu.parallel import mesh as mesh_mod
+
+from .simple_model import SimpleModel, random_batch
+
+HID = 32
+
+
+@pytest.fixture(autouse=True)
+def _fresh_mesh():
+    mesh_mod.reset_mesh()
+    yield
+    mesh_mod.reset_mesh()
+
+
+# ---------------------------------------------------------------- quantize --
+
+def test_quantize_ste_levels():
+    w = jnp.linspace(-1.0, 1.0, 257, dtype=jnp.float32)
+    q = quantize_ste(w, bits=4)
+    # 4-bit symmetric: at most 16 distinct levels
+    assert len(np.unique(np.asarray(q))) <= 16
+    np.testing.assert_allclose(np.asarray(q), np.asarray(w), atol=0.08)
+    # 16+ bits: identity
+    assert jnp.all(quantize_ste(w, bits=16) == w)
+
+
+def test_quantize_ste_gradient_is_straight_through():
+    w = jnp.array([-0.7, -0.2, 0.3, 0.9], jnp.float32)
+    g = jax.grad(lambda x: jnp.sum(quantize_ste(x, 8) * 2.0))(w)
+    np.testing.assert_allclose(np.asarray(g), 2.0, atol=1e-5)
+
+
+def test_quantize_asymmetric_range():
+    w = jnp.asarray(np.random.default_rng(0).uniform(0.5, 1.5, (64,)),
+                    jnp.float32)
+    q = quantize_ste(w, bits=4, symmetric=False)
+    np.testing.assert_allclose(np.asarray(q), np.asarray(w), atol=0.07)
+
+
+def test_activation_fake_quant_dynamic_and_static():
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(8, 16)), jnp.float32)
+    xq = activation_fake_quant(x, bits=8)
+    np.testing.assert_allclose(np.asarray(xq), np.asarray(x), atol=0.05)
+    xs = activation_fake_quant(x, bits=8, static_range=4.0)
+    np.testing.assert_allclose(np.asarray(xs), np.asarray(x), atol=0.05)
+
+
+def test_bit_schedule_anneals():
+    steps = jnp.asarray([0, 99, 100, 199, 200, 10_000])
+    bits = [int(bit_schedule(s, start_bits=8, target_bits=4, offset=0,
+                             period=100)) for s in steps]
+    assert bits[0] == 8 and bits[2] == 7 and bits[-1] == 4
+
+
+# ------------------------------------------------------------------ prune --
+
+def test_sparse_mask_ratio():
+    w = jnp.asarray(np.random.default_rng(2).normal(size=(64, 64)), jnp.float32)
+    m = sparse_mask(w, dense_ratio=0.25)
+    assert abs(float(jnp.mean(m)) - 0.25) < 0.02
+    # kept entries are the largest-magnitude ones
+    kept = np.abs(np.asarray(w))[np.asarray(m) > 0]
+    dropped = np.abs(np.asarray(w))[np.asarray(m) == 0]
+    assert kept.min() >= dropped.max() - 1e-6
+
+
+def test_row_mask_structure():
+    w = jnp.asarray(np.random.default_rng(3).normal(size=(16, 8)), jnp.float32)
+    m = np.asarray(row_mask(w, dense_ratio=0.5, axis=0))
+    assert m.shape == (16, 1)
+    assert m.sum() == 8
+
+
+def test_head_mask_structure():
+    nh, hd, d = 4, 8, 16
+    wo = jnp.asarray(np.random.default_rng(4).normal(size=(nh * hd, d)),
+                     jnp.float32)
+    m = np.asarray(head_mask(wo, num_heads=nh, dense_ratio=0.5))
+    per_head = m.reshape(nh, hd, d)
+    # each head entirely kept or entirely dropped
+    for h in range(nh):
+        assert per_head[h].min() == per_head[h].max()
+    assert sum(per_head[h].max() for h in range(nh)) == 2
+
+
+# ------------------------------------------------------------- transforms --
+
+WQ_CONFIG = {"compression_training": {"weight_quantization": {
+    "shared_parameters": {"enabled": True, "quantization_type": "symmetric",
+                          "schedule_offset": 0},
+    "different_groups": {"g1": {
+        "params": {"start_bits": 8, "target_bits": 8},
+        "modules": ["*"]}},
+}}}
+
+
+def test_parse_and_transform():
+    techniques = parse_compression_config(WQ_CONFIG)
+    assert len(techniques) == 1 and techniques[0].kind == "weight_quantization"
+    transform = build_param_transform(WQ_CONFIG)
+    params = {"layers": {"w": jnp.asarray(
+        np.random.default_rng(5).normal(size=(4, 8, 8)), jnp.float32)}}
+    out = transform(params, jnp.int32(10))
+    diff = np.abs(np.asarray(out["layers"]["w"] - params["layers"]["w"]))
+    assert 0 < diff.max() < 0.05  # quantized, but close
+
+
+def test_transform_respects_schedule_offset():
+    cfg = {"compression_training": {"sparse_pruning": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 100,
+                              "method": "l1"},
+        "different_groups": {"g": {"params": {"dense_ratio": 0.5},
+                                   "modules": ["*"]}},
+    }}}
+    transform = build_param_transform(cfg)
+    w = jnp.asarray(np.random.default_rng(6).normal(size=(8, 8)), jnp.float32)
+    before = transform({"w": w}, jnp.int32(5))["w"]
+    after = transform({"w": w}, jnp.int32(200))["w"]
+    assert jnp.all(before == w)              # offset not reached
+    assert float(jnp.mean(after == 0.0)) > 0.4   # pruned after offset
+
+
+def test_unknown_technique_rejected():
+    with pytest.raises(ValueError, match="unknown"):
+        parse_compression_config(
+            {"compression_training": {"bogus_technique": {}}})
+
+
+# ------------------------------------------------- engine integration -----
+
+def test_engine_trains_with_qat():
+    engine, _, _, _ = deepspeed_tpu.initialize(model=SimpleModel(HID), config={
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "bf16": {"enabled": True},
+        **WQ_CONFIG,
+    })
+    assert engine._compression_transform is not None
+    losses = [float(engine.train_batch(
+        batch=random_batch(engine.train_batch_size, HID, s)))
+        for s in range(4)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+# --------------------------------------------- layer reduction / cleanup --
+
+def _fake_llama_params(L=4, d=8, F=16, nh=2):
+    rng = np.random.default_rng(7)
+    mk = lambda *s: jnp.asarray(rng.normal(size=s), jnp.float32)  # noqa: E731
+    return {"embed": mk(32, d),
+            "layers": {"wq": mk(L, d, d), "wk": mk(L, d, d), "wv": mk(L, d, d),
+                       "wo": mk(L, d, d),
+                       "w_gate": mk(L, d, F), "w_up": mk(L, d, F),
+                       "w_down": mk(L, F, d)}}
+
+
+def test_student_initialization():
+    params = _fake_llama_params(L=4)
+    cfg = {"compression_training": {"layer_reduction": {
+        "enabled": True, "teacher_layer": [0, 2]}}}
+    student = student_initialization(params, cfg)
+    assert student["layers"]["wq"].shape[0] == 2
+    np.testing.assert_array_equal(np.asarray(student["layers"]["wq"][1]),
+                                  np.asarray(params["layers"]["wq"][2]))
+
+
+def test_redundancy_clean_rows_and_heads():
+    params = _fake_llama_params(L=4, d=8, F=16, nh=2)
+    cfg = {"compression_training": {
+        "row_pruning": {"shared_parameters": {"enabled": True},
+                        "different_groups": {"g": {
+                            "params": {"dense_ratio": 0.5},
+                            "modules": ["w_gate", "w_up", "w_down"]}}},
+        "head_pruning": {"shared_parameters": {"enabled": True, "num_heads": 2},
+                         "different_groups": {"g": {
+                             "params": {"dense_ratio": 0.5},
+                             "modules": ["wo"]}}},
+    }}
+    new_params, dims = redundancy_clean(params, cfg, num_heads=2)
+    assert dims == {"intermediate_size": 8, "num_heads": 1}
+    assert new_params["layers"]["w_gate"].shape == (4, 8, 8)
+    assert new_params["layers"]["w_down"].shape == (4, 8, 8)
+    assert new_params["layers"]["wo"].shape == (4, 4, 8)
+    assert new_params["layers"]["wq"].shape == (4, 8, 4)
