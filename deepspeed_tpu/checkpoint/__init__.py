@@ -1,0 +1,15 @@
+"""Checkpoint tooling (reference ``deepspeed/checkpoint/`` +
+``deepspeed/utils/zero_to_fp32.py``): offline fp32 consolidation, inspection,
+and restore-compatibility validation.  Topology reshape itself is the normal
+orbax restore path (see runtime/checkpoint_engine/orbax_engine.py)."""
+from .universal import (  # noqa: F401
+    CHECKPOINT_VERSION,
+    checkpoint_info,
+    inspect_checkpoint,
+    validate_checkpoint,
+)
+from .zero_to_fp32 import (  # noqa: F401
+    convert_zero_checkpoint_to_fp32_state_dict,
+    get_fp32_state_dict_from_zero_checkpoint,
+    load_state_dict_from_zero_checkpoint,
+)

@@ -1,0 +1,123 @@
+"""Universal-checkpoint utilities: inspection + version/compat metadata.
+
+Parity target: reference ``deepspeed/checkpoint/`` (``ds_to_universal.py``,
+``universal_checkpoint.py``, ``reshape_utils.py``, ``deepspeed_checkpoint.py``
+— the subsystem that converts rank-sharded ZeRO files into a
+topology-independent form and reshapes them onto a new (tp, pp, dp)).
+
+The TPU build does not need the conversion HALF of that subsystem: orbax
+stores logically-global arrays, so every checkpoint IS already "universal" and
+restore-onto-a-new-mesh is the ordinary load path (tested by
+``test_checkpoint.py::test_cross_topology_restore``).  What remains useful —
+and what the reference also ships — is tooling AROUND the format:
+
+  - :func:`inspect_checkpoint` — enumerate tensors/shapes/dtypes/bytes
+    without devices (reference ``inspect_checkpoint.py``).
+  - :func:`checkpoint_info` / :func:`validate_checkpoint` — read the
+    version + topology metadata and decide up front whether a restore can
+    work, instead of failing mid-load (reference ``CheckpointValidation``/
+    version gates in ``deepspeed_checkpoint.py``).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+# Checkpoint format version, written into client_state.json on save.
+# Bump on layout changes; validate_checkpoint gates restores by major version.
+CHECKPOINT_VERSION = "1.0"
+
+
+def _tag_dir(checkpoint_dir: str, tag: Optional[str]) -> Tuple[str, str]:
+    from ..runtime.checkpoint_engine.orbax_engine import _read_latest
+
+    tag = tag or _read_latest(checkpoint_dir)
+    if tag is None:
+        raise FileNotFoundError(f"no 'latest' file in {checkpoint_dir}")
+    d = os.path.join(checkpoint_dir, str(tag))
+    if not os.path.isdir(d):
+        raise FileNotFoundError(f"checkpoint tag dir not found: {d}")
+    return str(tag), d
+
+
+def checkpoint_info(checkpoint_dir: str, tag: Optional[str] = None) -> Dict[str, Any]:
+    """The checkpoint's saved metadata (counters, mesh shape, config, version)."""
+    tag, d = _tag_dir(checkpoint_dir, tag)
+    info: Dict[str, Any] = {"tag": tag, "path": d}
+    meta_path = os.path.join(d, "client_state.json")
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            info.update(json.load(f))
+    cfg_path = os.path.join(d, "ds_config.json")
+    if os.path.exists(cfg_path):
+        with open(cfg_path) as f:
+            info["ds_config"] = json.load(f)
+    return info
+
+
+def inspect_checkpoint(checkpoint_dir: str, tag: Optional[str] = None
+                       ) -> List[Dict[str, Any]]:
+    """Per-tensor [{name, shape, dtype, bytes}] without restoring to devices."""
+    from ..runtime.checkpoint_engine.orbax_engine import OrbaxCheckpointEngine
+    from .zero_to_fp32 import _flatten
+
+    tag, d = _tag_dir(checkpoint_dir, tag)
+    restored = OrbaxCheckpointEngine().load(os.path.join(d, "state"))
+    rows = []
+    for name, arr in sorted(_flatten(restored).items()):
+        arr = np.asarray(arr)
+        rows.append({"name": name, "shape": tuple(arr.shape),
+                     "dtype": str(arr.dtype), "bytes": int(arr.nbytes)})
+    return rows
+
+
+def validate_checkpoint(checkpoint_dir: str, tag: Optional[str] = None,
+                        param_count: Optional[int] = None) -> Dict[str, Any]:
+    """Fail-fast compatibility gate before a restore.
+
+    Checks (mirrors the reference's tag-validation + version gates):
+      - the tag dir and orbax state exist;
+      - the saved format major version matches this build's;
+      - optional: the saved param_count matches the caller's model.
+    Returns the info dict on success, raises ValueError on mismatch.
+    """
+    info = checkpoint_info(checkpoint_dir, tag)
+    state_dir = os.path.join(info["path"], "state")
+    if not os.path.isdir(state_dir):
+        raise ValueError(f"checkpoint {info['tag']} has no orbax state dir")
+    version = str(info.get("checkpoint_version", CHECKPOINT_VERSION))
+    if version.split(".")[0] != CHECKPOINT_VERSION.split(".")[0]:
+        raise ValueError(
+            f"checkpoint format version {version} is incompatible with this "
+            f"build ({CHECKPOINT_VERSION}); re-save with a matching release")
+    if param_count is not None and info.get("param_count") not in (None, param_count):
+        raise ValueError(
+            f"checkpoint was saved from a {info['param_count']:,}-param model "
+            f"but the current model has {param_count:,} params")
+    return info
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(description="Inspect a deepspeed_tpu checkpoint")
+    ap.add_argument("checkpoint_dir")
+    ap.add_argument("--tag", default=None)
+    args = ap.parse_args(argv)
+    info = checkpoint_info(args.checkpoint_dir, args.tag)
+    mesh = info.get("mesh_shape", {})
+    print(f"tag={info['tag']} step={info.get('global_steps')} "
+          f"params={info.get('param_count'):,} mesh={mesh}")
+    total = 0
+    for row in inspect_checkpoint(args.checkpoint_dir, args.tag):
+        total += row["bytes"]
+        print(f"  {row['name']:60s} {str(row['shape']):24s} "
+              f"{row['dtype']:10s} {row['bytes'] / 1e6:9.2f} MB")
+    print(f"total {total / 1e9:.2f} GB")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,155 @@
+"""Offline consolidation of a training checkpoint into fp32 weights.
+
+Parity target: reference ``deepspeed/utils/zero_to_fp32.py`` (
+``get_fp32_state_dict_from_zero_checkpoint``,
+``convert_zero_checkpoint_to_fp32_state_dict``,
+``load_state_dict_from_zero_checkpoint`` and the script entry point).  The
+reference reassembles flat fp32 shard files per rank; here orbax already
+stores every array as a single logical (global) array, so "consolidation" is
+simply: open the checkpoint WITHOUT a device mesh, take the fp32 masters
+(fall back to params when training was pure fp32), and write them out.
+
+Two output formats:
+  - ``.npz``   — flat { 'a/b/c': np.ndarray } archive (numpy-native).
+  - ``.pt``    — torch.save of the same flat dict as torch tensors, so the
+                 result drops into ``torch.load``-consuming pipelines exactly
+                 like the reference's ``pytorch_model.bin``.
+
+CLI (mirrors the reference script, which is copied next to every save):
+
+    python -m deepspeed_tpu.checkpoint.zero_to_fp32 <ckpt_dir> <out_file> \
+        [--tag TAG] [--format {npz,pt}]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..runtime.checkpoint_engine.orbax_engine import (LATEST_FILE,
+                                                      OrbaxCheckpointEngine,
+                                                      _read_latest)
+from ..utils.logging import logger
+
+
+def _flatten(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
+    """Nested pytree -> { 'a/b/c': array } (stable, path-joined keys)."""
+    out: Dict[str, np.ndarray] = {}
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            out.update(_flatten(tree[k], f"{prefix}{k}/"))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            out.update(_flatten(v, f"{prefix}{i}/"))
+    elif tree is None:
+        pass
+    else:
+        out[prefix[:-1]] = np.asarray(tree)
+    return out
+
+
+def _resolve_tag(checkpoint_dir: str, tag: Optional[str]) -> str:
+    tag = tag or _read_latest(checkpoint_dir)
+    if tag is None:
+        raise FileNotFoundError(
+            f"no '{LATEST_FILE}' file in {checkpoint_dir} and no --tag given")
+    ckpt = os.path.join(checkpoint_dir, str(tag))
+    if not os.path.isdir(ckpt):
+        raise FileNotFoundError(f"checkpoint tag dir not found: {ckpt}")
+    return str(tag)
+
+
+def get_fp32_state_dict_from_zero_checkpoint(
+        checkpoint_dir: str, tag: Optional[str] = None) -> Dict[str, np.ndarray]:
+    """Flat { name: fp32 np.ndarray } from a saved engine checkpoint.
+
+    Works on any host with access to the files — no mesh, no engine, no
+    devices needed (restore happens onto host numpy), matching the
+    reference's "run on a CPU box" contract.
+    """
+    tag = _resolve_tag(checkpoint_dir, tag)
+    state_path = os.path.join(checkpoint_dir, tag, "state")
+    restored = OrbaxCheckpointEngine().load(state_path)
+    # TrainState was saved as a pytree; orbax returns a dict-of-... with the
+    # dataclass fields as keys.
+    if isinstance(restored, dict):
+        masters = restored.get("master_params") or restored.get("params")
+    else:
+        masters = getattr(restored, "master_params", None) or restored.params
+    flat = _flatten(masters)
+    return {k: np.asarray(v, dtype=np.float32) for k, v in flat.items()}
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(
+        checkpoint_dir: str, output_file: str, tag: Optional[str] = None,
+        fmt: Optional[str] = None) -> str:
+    """Write the consolidated fp32 weights to ``output_file`` (.npz or .pt)."""
+    sd = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+    fmt = fmt or ("pt" if output_file.endswith((".pt", ".bin")) else "npz")
+    nbytes = sum(v.nbytes for v in sd.values())
+    if fmt == "pt":
+        import torch
+
+        torch.save({k: torch.from_numpy(v.copy()) for k, v in sd.items()},
+                   output_file)
+    else:
+        np.savez(output_file, **sd)
+    logger.info(f"wrote {len(sd)} fp32 tensors ({nbytes / 1e9:.2f} GB) "
+                f"-> {output_file}")
+    return output_file
+
+
+def load_state_dict_from_zero_checkpoint(params_template: Any,
+                                         checkpoint_dir: str,
+                                         tag: Optional[str] = None) -> Any:
+    """Return a pytree shaped like ``params_template`` filled with the
+    checkpoint's fp32 weights (reference: mutates the torch module; here we
+    return the new functional params)."""
+    import jax
+
+    flat = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+    leaves_with_paths = jax.tree_util.tree_flatten_with_path(params_template)[0]
+
+    def key_str(path):
+        parts = []
+        for p in path:
+            if hasattr(p, "key"):
+                parts.append(str(p.key))
+            elif hasattr(p, "idx"):
+                parts.append(str(p.idx))
+            else:
+                parts.append(str(p))
+        return "/".join(parts)
+
+    out = {}
+    for path, leaf in leaves_with_paths:
+        k = key_str(path)
+        if k not in flat:
+            raise KeyError(f"checkpoint has no tensor for param '{k}' "
+                           f"(available: {sorted(flat)[:5]}...)")
+        if tuple(flat[k].shape) != tuple(leaf.shape):
+            raise ValueError(f"shape mismatch for '{k}': checkpoint "
+                             f"{flat[k].shape} vs template {leaf.shape}")
+        out[path] = flat[k].astype(leaf.dtype)
+    treedef = jax.tree_util.tree_structure(params_template)
+    return jax.tree_util.tree_unflatten(
+        treedef, [out[p] for p, _ in leaves_with_paths])
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Consolidate a deepspeed_tpu checkpoint into fp32 weights")
+    ap.add_argument("checkpoint_dir")
+    ap.add_argument("output_file")
+    ap.add_argument("--tag", default=None)
+    ap.add_argument("--format", dest="fmt", choices=["npz", "pt"], default=None)
+    args = ap.parse_args(argv)
+    convert_zero_checkpoint_to_fp32_state_dict(
+        args.checkpoint_dir, args.output_file, tag=args.tag, fmt=args.fmt)
+
+
+if __name__ == "__main__":
+    main()

@@ -77,7 +77,10 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     ce = OrbaxCheckpointEngine()
     ce.save(engine.state, os.path.join(ckpt_dir, "state"))
 
+    from ...checkpoint.universal import CHECKPOINT_VERSION
+
     meta = {
+        "checkpoint_version": CHECKPOINT_VERSION,
         "global_steps": engine.global_steps,
         "skipped_steps": engine.skipped_steps,
         "micro_steps": engine.micro_steps,

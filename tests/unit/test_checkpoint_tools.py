@@ -1,0 +1,115 @@
+"""Checkpoint tooling — zero_to_fp32 consolidation, inspection, validation
+(reference deepspeed/utils/zero_to_fp32.py + deepspeed/checkpoint/)."""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.checkpoint import (
+    checkpoint_info,
+    convert_zero_checkpoint_to_fp32_state_dict,
+    get_fp32_state_dict_from_zero_checkpoint,
+    inspect_checkpoint,
+    load_state_dict_from_zero_checkpoint,
+    validate_checkpoint,
+)
+from deepspeed_tpu.parallel import mesh as mesh_mod
+
+from .simple_model import SimpleModel, random_batch
+
+HID = 32
+
+
+@pytest.fixture(autouse=True)
+def _fresh_mesh():
+    mesh_mod.reset_mesh()
+    yield
+    mesh_mod.reset_mesh()
+
+
+@pytest.fixture()
+def saved_ckpt(tmp_path):
+    """Train a few steps at ZeRO-3 and save, returning (dir, engine)."""
+    engine, _, _, _ = deepspeed_tpu.initialize(model=SimpleModel(HID), config={
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 3},
+        "bf16": {"enabled": True},
+    })
+    for s in range(2):
+        engine.train_batch(batch=random_batch(engine.train_batch_size, HID, s))
+    engine.save_checkpoint(str(tmp_path / "ckpt"))
+    return str(tmp_path / "ckpt"), engine
+
+
+def test_fp32_state_dict_matches_masters(saved_ckpt):
+    ckpt_dir, engine = saved_ckpt
+    sd = get_fp32_state_dict_from_zero_checkpoint(ckpt_dir)
+    assert sd and all(v.dtype == np.float32 for v in sd.values())
+    # consolidated values must equal the live fp32 masters
+    masters = engine.state.master_params
+    leaves = jax.tree_util.tree_flatten_with_path(masters)[0]
+    assert len(sd) == len(leaves)
+    for path, leaf in leaves:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in path)
+        np.testing.assert_allclose(sd[key], np.asarray(leaf), rtol=1e-6)
+
+
+def test_convert_npz_and_pt(saved_ckpt, tmp_path):
+    ckpt_dir, _ = saved_ckpt
+    npz = convert_zero_checkpoint_to_fp32_state_dict(
+        ckpt_dir, str(tmp_path / "model.npz"))
+    loaded = np.load(npz)
+    assert len(loaded.files) > 0
+
+    pt = convert_zero_checkpoint_to_fp32_state_dict(
+        ckpt_dir, str(tmp_path / "model.pt"))
+    import torch
+
+    t = torch.load(pt, weights_only=True)
+    assert all(isinstance(v, torch.Tensor) for v in t.values())
+    np.testing.assert_allclose(
+        t[sorted(t)[0]].numpy(), loaded[sorted(loaded.files)[0]], rtol=1e-6)
+
+
+def test_load_into_template(saved_ckpt):
+    ckpt_dir, engine = saved_ckpt
+    template = engine.state.master_params
+    params = load_state_dict_from_zero_checkpoint(template, ckpt_dir)
+    for a, b in zip(jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(template)):
+        assert a.shape == b.shape
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_inspect_and_info(saved_ckpt):
+    ckpt_dir, engine = saved_ckpt
+    info = checkpoint_info(ckpt_dir)
+    assert info["global_steps"] == 2
+    assert info["param_count"] == engine.param_count
+    assert info["checkpoint_version"] == "1.0"
+    rows = inspect_checkpoint(ckpt_dir)
+    assert any("master_params" in r["name"] for r in rows)
+    assert all(r["bytes"] > 0 for r in rows)
+
+
+def test_validate_checkpoint(saved_ckpt):
+    ckpt_dir, engine = saved_ckpt
+    validate_checkpoint(ckpt_dir, param_count=engine.param_count)
+    with pytest.raises(ValueError, match="param"):
+        validate_checkpoint(ckpt_dir, param_count=engine.param_count + 1)
+    with pytest.raises(FileNotFoundError):
+        validate_checkpoint(ckpt_dir, tag="no_such_tag")
+
+
+def test_cli_entrypoint(saved_ckpt, tmp_path):
+    ckpt_dir, _ = saved_ckpt
+    from deepspeed_tpu.checkpoint import zero_to_fp32
+
+    out = str(tmp_path / "cli.npz")
+    zero_to_fp32.main([ckpt_dir, out])
+    assert os.path.exists(out)
