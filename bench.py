@@ -64,7 +64,8 @@ def measure_matmul_peak() -> float:
 
 
 def run(model_name: str, micro_batch: int, seq_len: int, steps: int, warmup: int,
-        zero_stage: int, remat_policy: str = None, remat: bool = None):
+        zero_stage: int, remat_policy: str = None, remat: bool = None,
+        mu_dtype: str = None, grad_accum_dtype: str = None):
     import jax
     import jax.numpy as jnp
 
@@ -87,14 +88,19 @@ def run(model_name: str, micro_batch: int, seq_len: int, steps: int, warmup: int
             overrides["remat"] = remat
         model = CausalLM(model_name, **overrides)
 
+    opt_params = {"lr": 1e-4}
+    if mu_dtype:
+        opt_params["mu_dtype"] = mu_dtype
     config = {
         "train_micro_batch_size_per_gpu": micro_batch,
         "gradient_accumulation_steps": 1,
-        "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+        "optimizer": {"type": "adamw", "params": opt_params},
         "zero_optimization": {"stage": zero_stage},
         "bf16": {"enabled": True},
         "steps_per_print": 10 ** 9,
     }
+    if grad_accum_dtype:
+        config["data_types"] = {"grad_accum_dtype": grad_accum_dtype}
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
     rng = np.random.default_rng(0)
     batch = {"input_ids": rng.integers(
@@ -197,15 +203,19 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", default="train", choices=["train", "inference"])
     ap.add_argument("--model", default="llama-740m")
-    ap.add_argument("--micro_batch", type=int, default=8)
+    ap.add_argument("--micro_batch", type=int, default=12)
     ap.add_argument("--seq_len", type=int, default=2048)
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--zero_stage", type=int, default=1)
     ap.add_argument("--remat_policy", default=None,
                     choices=["nothing_saveable", "dots_saveable", "save_attn",
-                             "save_matmuls"])
+                             "save_qkv", "save_matmuls"])
     ap.add_argument("--no_remat", action="store_true")
+    ap.add_argument("--mu_dtype", default="bfloat16",
+                    choices=["bfloat16", "float32"])
+    ap.add_argument("--grad_accum_dtype", default="bf16",
+                    choices=["bf16", "fp32"])
     ap.add_argument("--prompt_len", type=int, default=128)
     ap.add_argument("--new_tokens", type=int, default=128)
     args = ap.parse_args()
@@ -226,7 +236,9 @@ def main():
         try:
             result = run(args.model, mb, args.seq_len, steps, args.warmup,
                          args.zero_stage, remat_policy=args.remat_policy,
-                         remat=False if args.no_remat else None)
+                         remat=False if args.no_remat else None,
+                         mu_dtype=args.mu_dtype,
+                         grad_accum_dtype=args.grad_accum_dtype)
             print(json.dumps(result))
             return
         except Exception as e:  # OOM → retry smaller

@@ -543,6 +543,11 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
             # bytes/layer) and rematerialize everything else: the backward
             # re-runs the cheap matmul/norm chain but not attention
             policy = jax.checkpoint_policies.save_only_these_names("attn_out")
+        elif cfg.remat_policy == "save_qkv":
+            # attention fully pinned (projections + residuals): backward
+            # never re-runs the S² kernel; only the MLP rematerializes
+            policy = jax.checkpoint_policies.save_only_these_names(
+                "attn_out", "attn_lse", "q_proj", "k_proj", "v_proj")
         elif cfg.remat_policy == "save_matmuls":
             # pin every big projection output (q/k/v post-rope, gate/up, attn)
             # so the backward recompute is norms/elementwise only — recompute
@@ -550,7 +555,8 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
             # (vs dots_saveable, which would also pin the [S,S] score matrices
             # and OOM)
             policy = jax.checkpoint_policies.save_only_these_names(
-                "attn_out", "q_proj", "k_proj", "v_proj", "mlp_gate", "mlp_up")
+                "attn_out", "attn_lse", "q_proj", "k_proj", "v_proj",
+                "mlp_gate", "mlp_up")
         else:
             policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
         block = jax.checkpoint(block, policy=policy)

@@ -323,18 +323,33 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
 # Public API
 # ---------------------------------------------------------------------------
 
+# The custom VJP is defined on a function whose PRIMAL OUTPUTS are (out, lse)
+# — exactly the non-input residuals the backward needs.  The model names both
+# with checkpoint_name, so a remat policy that pins q/k/v + attn_out +
+# attn_lse lets the backward run WITHOUT re-executing the forward kernel
+# (with out/lse hidden inside the vjp, remat must re-run the S² forward to
+# regenerate residuals no matter what the policy saves).
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    out, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
-    return out
+    return _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
 
 
 def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    from jax.ad_checkpoint import checkpoint_name
+
     out, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
+    # names INSIDE the vjp-fwd so remat policies can pin the residuals
+    # themselves ("attn_lse" + the model-level "attn_out"/q/k/v names)
+    lse = checkpoint_name(lse, "attn_lse")
+    return (out, lse), (q, k, v, out, lse)
 
 
-_flash.defvjp(_flash_fwd, _bwd)
+def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
+    do, _ = g  # lse is consumed only by checkpoint_name: zero cotangent
+    return _bwd(sm_scale, causal, block_q, block_k, interpret, res, do)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = None,
@@ -356,5 +371,5 @@ def flash_attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = No
         interpret = _interpret_default()
     # [B,S,H,hd] -> [B,H,S,hd]
     qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
-    out = _flash(qt, kt, vt, sm_scale, causal, block_q, block_k, interpret)
+    out, _ = _flash(qt, kt, vt, sm_scale, causal, block_q, block_k, interpret)
     return jnp.swapaxes(out, 1, 2)
