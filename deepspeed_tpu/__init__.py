@@ -41,12 +41,30 @@ def initialize(args=None, model: Any = None, optimizer=None, model_parameters=No
 
 
 def init_inference(model: Any = None, config=None, **kwargs):
-    """Build the inference engine (reference deepspeed/__init__.py:269)."""
+    """Build the inference engine (reference deepspeed/__init__.py:269).
+
+    ``model`` may be a native model adapter, an HF checkpoint directory, or a
+    live ``transformers`` module — HF sources are converted through the
+    module_inject policies (reference replace_module checkpoint loading)."""
     from .inference.engine import InferenceEngine
     from .inference.config import DeepSpeedInferenceConfig
 
     engine_kwargs = {k: kwargs.pop(k) for k in ("apply_fn", "params", "mesh")
                      if k in kwargs}
+    is_hf = isinstance(model, str) or (
+        model is not None and hasattr(model, "state_dict")
+        and not hasattr(model, "apply_fn"))
+    if is_hf:
+        from .models import CausalLM
+
+        cfg_probe = config if isinstance(config, DeepSpeedInferenceConfig) \
+            else DeepSpeedInferenceConfig(
+                **{**dict(config or {}),
+                   **{k: v for k, v in kwargs.items()
+                      if k in DeepSpeedInferenceConfig.model_fields}})
+        dtype = cfg_probe.jnp_dtype
+        model, params = CausalLM.from_hf(model, dtype=dtype)
+        engine_kwargs.setdefault("params", params)
     if isinstance(config, DeepSpeedInferenceConfig):
         ds_inference_config = config
     else:

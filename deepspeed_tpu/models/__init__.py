@@ -28,6 +28,29 @@ class CausalLM:
         self.attn_impl = attn_impl
         self.param_specs = param_specs(self.config)
 
+    @classmethod
+    def from_hf(cls, model_or_path, dtype=None, attn_impl: str = "auto",
+                **overrides):
+        """(model, params) from an HF checkpoint — a ``from_pretrained``
+        directory, a live transformers module, or (config, state_dict)
+        (module_inject policies; reference replace_module checkpoint load)."""
+        from ..module_inject import load_hf_checkpoint
+
+        cfg, params = load_hf_checkpoint(model_or_path, dtype=dtype)
+        import dataclasses
+
+        if dtype is not None:
+            # compute dtype must track the param dtype or the decode scan
+            # carries mix precisions
+            overrides = {"dtype": dtype, **overrides}
+        if overrides:
+            cfg = dataclasses.replace(cfg, **overrides)
+        model = cls.__new__(cls)
+        model.config = cfg
+        model.attn_impl = attn_impl
+        model.param_specs = param_specs(cfg)
+        return model, params
+
     def init_fn(self, rng):
         return init_params(self.config, rng)
 

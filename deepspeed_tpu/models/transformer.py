@@ -49,7 +49,7 @@ class TransformerConfig:
     head_dim: Optional[int] = None            # None => hidden // heads
     max_seq_len: int = 2048
     norm: str = "rmsnorm"                     # rmsnorm | layernorm
-    activation: str = "swiglu"                # swiglu | gelu
+    activation: str = "swiglu"                # swiglu | gelu | relu
     position: str = "rope"                    # rope | learned | alibi
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
@@ -465,7 +465,7 @@ def _mlp(cfg: TransformerConfig, lp: Dict[str, Any], h, rng, deterministic):
         m = checkpoint_name(h @ lp["w_in"], "mlp_up")
         if cfg.mlp_bias:
             m = m + lp["b_in"]
-        m = jax.nn.gelu(m)
+        m = jax.nn.relu(m) if cfg.activation == "relu" else jax.nn.gelu(m)
         m = m @ lp["w_down"]
     if cfg.num_experts == 1 and cfg.mlp_bias:
         m = m + lp["b_down"]

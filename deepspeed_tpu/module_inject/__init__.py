@@ -1,0 +1,11 @@
+"""Module injection (reference ``deepspeed/module_inject/``): HF checkpoint
+→ native-model conversion policies.  The reference swaps torch layers for
+fused-kernel modules; here the native functional transformer IS the
+optimized implementation, so 'injection' reduces to the weight name map +
+TP PartitionSpecs."""
+from .load import (  # noqa: F401
+    config_from_hf,
+    hf_state_dict_to_params,
+    load_hf_checkpoint,
+)
+from .policies import POLICIES, ArchPolicy, detect_arch  # noqa: F401

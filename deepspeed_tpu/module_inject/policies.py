@@ -1,0 +1,136 @@
+"""Per-architecture weight-mapping policies (HF checkpoint → native params).
+
+Parity target: reference ``module_inject/replace_policy.py`` +
+``containers/`` (HFGPT2LayerPolicy, LLAMALayerPolicy, HFOPTLayerPolicy, …
+— ``replace_policy.py:21-27``).  The reference's policies locate attention/
+MLP submodules inside a live torch module so kernels can be injected; here a
+policy is a pure NAME MAP: for each native param slot, where the tensor lives
+in the HF state dict and how it must be transformed (transpose for
+``nn.Linear`` [out,in] storage, identity for GPT-2 ``Conv1D`` [in,out],
+split for fused QKV).  Conversion then builds the stacked [L, ...] scan
+layout directly — no torch module is ever constructed.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ArchPolicy:
+    """Name templates: ``{i}`` is the layer index.  Values are
+    (hf_name, transform) where transform is applied to the numpy tensor."""
+    name: str
+    # top-level: native key -> (hf key, transform)
+    top: Dict[str, Tuple[str, Optional[Callable]]]
+    # per-layer: native layer key -> (hf key template, transform)
+    layer: Dict[str, Tuple[str, Optional[Callable]]]
+    # fused qkv: hf key template -> (split spec) or None
+    fused_qkv: Optional[str] = None
+    fused_qkv_bias: Optional[str] = None
+    tie_embeddings: bool = False
+    pos_embed_offset: int = 0     # OPT stores positions with a +2 offset
+
+
+def _t(x: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(x.T)
+
+
+LLAMA = ArchPolicy(
+    name="llama",
+    top={
+        "embed": ("model.embed_tokens.weight", None),
+        "final_norm_scale": ("model.norm.weight", None),
+        "lm_head": ("lm_head.weight", _t),
+    },
+    layer={
+        "attn_norm_scale": ("model.layers.{i}.input_layernorm.weight", None),
+        "wq": ("model.layers.{i}.self_attn.q_proj.weight", _t),
+        "wk": ("model.layers.{i}.self_attn.k_proj.weight", _t),
+        "wv": ("model.layers.{i}.self_attn.v_proj.weight", _t),
+        "wo": ("model.layers.{i}.self_attn.o_proj.weight", _t),
+        "mlp_norm_scale": (
+            "model.layers.{i}.post_attention_layernorm.weight", None),
+        "w_gate": ("model.layers.{i}.mlp.gate_proj.weight", _t),
+        "w_up": ("model.layers.{i}.mlp.up_proj.weight", _t),
+        "w_down": ("model.layers.{i}.mlp.down_proj.weight", _t),
+    },
+)
+
+GPT2 = ArchPolicy(
+    name="gpt2",
+    top={
+        "embed": ("transformer.wte.weight", None),
+        "pos_embed": ("transformer.wpe.weight", None),
+        "final_norm_scale": ("transformer.ln_f.weight", None),
+        "final_norm_bias": ("transformer.ln_f.bias", None),
+    },
+    layer={
+        "attn_norm_scale": ("transformer.h.{i}.ln_1.weight", None),
+        "attn_norm_bias": ("transformer.h.{i}.ln_1.bias", None),
+        # Conv1D stores [in, out] — native layout already
+        "wo": ("transformer.h.{i}.attn.c_proj.weight", None),
+        "bo": ("transformer.h.{i}.attn.c_proj.bias", None),
+        "mlp_norm_scale": ("transformer.h.{i}.ln_2.weight", None),
+        "mlp_norm_bias": ("transformer.h.{i}.ln_2.bias", None),
+        "w_in": ("transformer.h.{i}.mlp.c_fc.weight", None),
+        "b_in": ("transformer.h.{i}.mlp.c_fc.bias", None),
+        "w_down": ("transformer.h.{i}.mlp.c_proj.weight", None),
+        "b_down": ("transformer.h.{i}.mlp.c_proj.bias", None),
+    },
+    fused_qkv="transformer.h.{i}.attn.c_attn.weight",
+    fused_qkv_bias="transformer.h.{i}.attn.c_attn.bias",
+    tie_embeddings=True,
+)
+
+OPT = ArchPolicy(
+    name="opt",
+    top={
+        "embed": ("model.decoder.embed_tokens.weight", None),
+        "pos_embed": ("model.decoder.embed_positions.weight", None),
+        "final_norm_scale": ("model.decoder.final_layer_norm.weight", None),
+        "final_norm_bias": ("model.decoder.final_layer_norm.bias", None),
+    },
+    layer={
+        "attn_norm_scale": (
+            "model.decoder.layers.{i}.self_attn_layer_norm.weight", None),
+        "attn_norm_bias": (
+            "model.decoder.layers.{i}.self_attn_layer_norm.bias", None),
+        "wq": ("model.decoder.layers.{i}.self_attn.q_proj.weight", _t),
+        "bq": ("model.decoder.layers.{i}.self_attn.q_proj.bias", None),
+        "wk": ("model.decoder.layers.{i}.self_attn.k_proj.weight", _t),
+        "bk": ("model.decoder.layers.{i}.self_attn.k_proj.bias", None),
+        "wv": ("model.decoder.layers.{i}.self_attn.v_proj.weight", _t),
+        "bv": ("model.decoder.layers.{i}.self_attn.v_proj.bias", None),
+        "wo": ("model.decoder.layers.{i}.self_attn.out_proj.weight", _t),
+        "bo": ("model.decoder.layers.{i}.self_attn.out_proj.bias", None),
+        "mlp_norm_scale": (
+            "model.decoder.layers.{i}.final_layer_norm.weight", None),
+        "mlp_norm_bias": (
+            "model.decoder.layers.{i}.final_layer_norm.bias", None),
+        "w_in": ("model.decoder.layers.{i}.fc1.weight", _t),
+        "b_in": ("model.decoder.layers.{i}.fc1.bias", None),
+        "w_down": ("model.decoder.layers.{i}.fc2.weight", _t),
+        "b_down": ("model.decoder.layers.{i}.fc2.bias", None),
+    },
+    tie_embeddings=True,
+    pos_embed_offset=2,   # OPTLearnedPositionalEmbedding adds 2 to positions
+)
+
+
+POLICIES: Dict[str, ArchPolicy] = {"llama": LLAMA, "gpt2": GPT2, "opt": OPT,
+                                   "mistral": LLAMA}
+
+
+def detect_arch(hf_config) -> str:
+    """Map an HF config (object or dict) to a policy name (reference
+    ``replace_policy`` auto-selection by module class)."""
+    mt = getattr(hf_config, "model_type", None) or (
+        hf_config.get("model_type") if isinstance(hf_config, dict) else None)
+    if mt in POLICIES:
+        return mt
+    raise NotImplementedError(
+        f"no injection policy for model_type={mt!r} "
+        f"(supported: {sorted(POLICIES)})")
