@@ -1,0 +1,8 @@
+"""Autotuning (reference ``deepspeed/autotuning/``): config-space search with
+compile-time memory pruning + timed trials."""
+from .autotuner import (  # noqa: F401
+    Autotuner,
+    AutotuningConfig,
+    TrialRecord,
+    autotune,
+)

@@ -1,0 +1,251 @@
+"""Autotuner: search the (zero stage × micro-batch × remat) config space.
+
+Parity target: reference ``autotuning/autotuner.py:42`` (tuning spaces per
+ZeRO stage, micro-batch sweep with an OOM-probe ceiling, gridsearch/random/
+model-based tuners, fast-mode early exit, results records + best-config
+emission — ``tune():404``, ``tune_space():523``).
+
+TPU-native redesign: the reference launches a subprocess experiment per
+candidate and watches for OOM.  XLA makes half of that unnecessary — a
+candidate's memory footprint is known at COMPILE time: we ``jit.lower().
+compile()`` the engine's train step and read ``memory_analysis()`` to reject
+over-budget configs WITHOUT running them (the reference burns a full job
+launch to learn the same bit).  Survivors get short timed trials on the real
+chip; records and the best config are written like the reference's
+``autotuning_results``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.logging import log_dist, logger
+
+DEFAULT_HBM_BYTES = 16 * 1024 ** 3       # v5e chip
+MEMORY_SAFETY_MARGIN = 0.92              # leave headroom for runtime buffers
+
+
+@dataclasses.dataclass
+class TrialRecord:
+    config_overrides: Dict[str, Any]
+    status: str                 # ok | compile_oom | compile_error | run_error
+    metric_val: float = 0.0     # samples/sec (throughput) or -sec (latency)
+    memory_bytes: int = 0
+    compile_sec: float = 0.0
+    error: str = ""
+
+    def as_dict(self):
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class AutotuningConfig:
+    """``autotuning`` block (reference constants.py:41-70)."""
+    enabled: bool = False
+    metric: str = "throughput"            # throughput | latency
+    results_dir: str = "autotuning_results"
+    overwrite: bool = True
+    fast: bool = True                     # stop a sweep on first regression
+    tuner_type: str = "gridsearch"        # gridsearch | random
+    max_trials: int = 50
+    start_profile_step: int = 2
+    end_profile_step: int = 6
+    mbs_candidates: Optional[Sequence[int]] = None
+    zero_stages: Optional[Sequence[int]] = None
+    remat_policies: Optional[Sequence[str]] = None
+    hbm_bytes: int = DEFAULT_HBM_BYTES
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict]) -> "AutotuningConfig":
+        d = dict(d or {})
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"unknown autotuning keys: {sorted(unknown)}")
+        return cls(**d)
+
+
+class Autotuner:
+    """Grid/random search with compile-time memory pruning.
+
+    ``make_engine(overrides) -> engine`` builds a fresh engine for a
+    candidate; ``make_batch(engine) -> batch`` supplies a training batch.
+    """
+
+    def __init__(self, make_engine: Callable[[Dict[str, Any]], Any],
+                 make_batch: Callable[[Any], Any],
+                 config: Optional[AutotuningConfig] = None):
+        self.make_engine = make_engine
+        self.make_batch = make_batch
+        self.config = config or AutotuningConfig(enabled=True)
+        self.records: List[TrialRecord] = []
+
+    # -- candidate space (reference _generate_experiments / tune_space) --
+    def sweeps(self) -> List[List[Dict[str, Any]]]:
+        """One sweep per (stage, remat): micro-batches ascending, so fast
+        mode can cut a sweep at the first regression/OOM (reference
+        tune_space's prev_best early exit)."""
+        c = self.config
+        stages = list(c.zero_stages if c.zero_stages is not None else (0, 1, 2, 3))
+        mbs = sorted(c.mbs_candidates if c.mbs_candidates is not None
+                     else (1, 2, 4, 8, 16, 32))
+        remats = list(c.remat_policies if c.remat_policies is not None else (None,))
+        out = []
+        for stage, remat in itertools.product(stages, remats):
+            sweep = []
+            for mb in mbs:
+                ov: Dict[str, Any] = {
+                    "zero_optimization": {"stage": stage},
+                    "train_micro_batch_size_per_gpu": mb,
+                }
+                if remat is not None:
+                    ov["_remat_policy"] = remat
+                sweep.append(ov)
+            out.append(sweep)
+        if self.config.tuner_type == "random":
+            rng = np.random.default_rng(0)
+            rng.shuffle(out)
+        return out
+
+    # -- one trial --
+    def _measure(self, overrides: Dict[str, Any]) -> TrialRecord:
+        rec = TrialRecord(config_overrides=overrides, status="ok")
+        try:
+            engine = self.make_engine(dict(overrides))
+            batch = self.make_batch(engine)
+            t0 = time.perf_counter()
+            step = engine.compile_train_step(batch)
+            rec.compile_sec = time.perf_counter() - t0
+            mem = step.memory_analysis() if hasattr(step, "memory_analysis") else None
+            if mem is not None:
+                rec.memory_bytes = int(
+                    getattr(mem, "temp_size_in_bytes", 0)
+                    + getattr(mem, "argument_size_in_bytes", 0)
+                    + getattr(mem, "output_size_in_bytes", 0)
+                    - getattr(mem, "alias_size_in_bytes", 0))
+                if rec.memory_bytes > self.config.hbm_bytes * MEMORY_SAFETY_MARGIN:
+                    rec.status = "compile_oom"
+                    rec.error = (f"predicted {rec.memory_bytes / 1e9:.2f} GB > "
+                                 f"budget {self.config.hbm_bytes / 1e9:.2f} GB")
+                    return rec
+            # timed steps (start/end_profile_step warmup convention)
+            warm = self.config.start_profile_step
+            steps = max(1, self.config.end_profile_step - warm)
+            for _ in range(warm):
+                loss = engine.train_batch(batch=batch)
+            float(loss) if warm else None
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                loss = engine.train_batch(batch=batch)
+            float(loss)
+            dt = (time.perf_counter() - t0) / steps
+            samples = engine.train_batch_size
+            rec.metric_val = (samples / dt if self.config.metric == "throughput"
+                              else -dt)
+        except Exception as e:  # noqa: BLE001 — a failed trial is a record
+            msg = str(e)
+            low = msg.lower()
+            rec.status = ("compile_oom" if "resource_exhausted" in low
+                          or "out of memory" in low else "run_error")
+            rec.error = msg[:300]
+        return rec
+
+    def tune(self) -> Tuple[Optional[Dict[str, Any]], List[TrialRecord]]:
+        """Run the search; returns (best_overrides, records) and writes
+        ``results_dir/`` like the reference (per-trial records + best)."""
+        if not self.config.enabled:
+            raise ValueError("autotuning is not enabled in the config")
+        best: Optional[TrialRecord] = None
+        trials = 0
+        for sweep in self.sweeps():
+            prev_val = -float("inf")
+            for overrides in sweep:
+                if trials >= self.config.max_trials:
+                    break
+                rec = self._measure(overrides)
+                trials += 1
+                self.records.append(rec)
+                log_dist(f"autotuning trial {overrides}: {rec.status} "
+                         f"metric={rec.metric_val:.2f} "
+                         f"mem={rec.memory_bytes / 1e9:.2f}GB", ranks=[0])
+                if rec.status == "ok" and (best is None
+                                           or rec.metric_val > best.metric_val):
+                    best = rec
+                if self.config.fast:
+                    if rec.status == "compile_oom":
+                        break   # larger micro-batches in this sweep also OOM
+                    if rec.status == "ok" and rec.metric_val < prev_val:
+                        break   # past this sweep's throughput peak
+                    if rec.status == "ok":
+                        prev_val = rec.metric_val
+            if trials >= self.config.max_trials:
+                break
+        self._write_results(best)
+        return (best.config_overrides if best else None), self.records
+
+    def _write_results(self, best: Optional[TrialRecord]) -> None:
+        d = self.config.results_dir
+        if os.path.isdir(d) and os.listdir(d) and not self.config.overwrite:
+            raise FileExistsError(
+                f"results_dir {d} exists and autotuning.overwrite is false")
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "records.json"), "w") as f:
+            json.dump([r.as_dict() for r in self.records], f, indent=2)
+        if best is not None:
+            with open(os.path.join(d, "best_config.json"), "w") as f:
+                json.dump({"overrides": best.config_overrides,
+                           "metric": self.config.metric,
+                           "metric_val": best.metric_val}, f, indent=2)
+        logger.info(f"autotuning: {len(self.records)} trials -> {d}")
+
+
+def autotune(model_factory: Callable[[], Any], base_config: Dict[str, Any],
+             batch_factory: Callable[[Any], Any],
+             autotuning_config: Optional[Dict] = None):
+    """Convenience entry (reference ``deepspeed --autotuning run``): search
+    around ``base_config`` and return (best_full_config, records)."""
+    import deepspeed_tpu
+    from ..parallel import mesh as mesh_mod
+
+    at_cfg = AutotuningConfig.from_dict(
+        autotuning_config or base_config.get("autotuning"))
+
+    def make_engine(overrides):
+        mesh_mod.reset_mesh()
+        cfg = json.loads(json.dumps({k: v for k, v in base_config.items()
+                                     if k != "autotuning"}))
+        remat = overrides.pop("_remat_policy", None)
+        for k, v in overrides.items():
+            if isinstance(v, dict):
+                cfg.setdefault(k, {}).update(v)
+            else:
+                cfg[k] = v
+        model = model_factory()
+        if remat is not None and hasattr(model, "config"):
+            model.config = dataclasses.replace(model.config,
+                                               remat_policy=remat)
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+        return engine
+
+    tuner = Autotuner(make_engine, batch_factory, at_cfg)
+    best, records = tuner.tune()
+    full = None
+    if best is not None:
+        full = json.loads(json.dumps({k: v for k, v in base_config.items()
+                                      if k != "autotuning"}))
+        for k, v in best.items():
+            if isinstance(v, dict):
+                full.setdefault(k, {}).update(v)
+            else:
+                # "_remat_policy" rides along: it is a MODEL override the
+                # caller must apply (TransformerConfig.remat_policy), not an
+                # engine-config key — dropping it would return a config that
+                # does not reproduce the measured winner
+                full[k] = v
+    return full, records

@@ -141,7 +141,7 @@ class InferenceEngine:
 
     def generate(self, input_ids, max_new_tokens: int = 32, eos_token_id: Optional[int] = None,
                  greedy: bool = True, rng: Optional[jax.Array] = None, temperature: float = 1.0,
-                 attention_mask=None, model=None):
+                 attention_mask=None, model=None, params=None):
         """KV-cached autoregressive generation under jit.
 
         Prompts may be right-padded ragged rows (pass ``attention_mask``); pad
@@ -157,7 +157,7 @@ class InferenceEngine:
                     "(apply_cached); the full-recompute fallback would "
                     "silently attend to pad tokens")
             return self._generate_uncached(input_ids, max_new_tokens, eos_token_id,
-                                           greedy, rng, temperature)
+                                           greedy, rng, temperature, params=params)
         ids = np.asarray(input_ids)
         if ids.ndim == 1:
             ids = ids[None, :]
@@ -179,13 +179,15 @@ class InferenceEngine:
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         eos = jnp.int32(-1 if eos_token_id is None else eos_token_id)
         new = self._gen_cache[key](
-            self.params, jnp.asarray(toks), jnp.asarray(mpad), jnp.asarray(pos),
+            self.params if params is None else params,
+            jnp.asarray(toks), jnp.asarray(mpad), jnp.asarray(pos),
             rng, eos, jnp.float32(temperature))
         return jnp.concatenate([jnp.asarray(ids), new], axis=1)
 
     def _generate_uncached(self, input_ids, max_new_tokens: int = 32,
                            eos_token_id: Optional[int] = None, greedy: bool = True,
-                           rng: Optional[jax.Array] = None, temperature: float = 1.0):
+                           rng: Optional[jax.Array] = None, temperature: float = 1.0,
+                           params=None):
         """Full-recompute fallback for arbitrary logits-returning apply_fns
         (and the parity reference for the cached path in tests)."""
         ids = jnp.asarray(input_ids)
@@ -193,7 +195,10 @@ class InferenceEngine:
             ids = ids[None, :]
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         for _ in range(max_new_tokens):
-            logits = self.forward(ids)
+            if params is not None:
+                logits = self._forward(params, ids)
+            else:
+                logits = self.forward(ids)
             logits = logits[0] if isinstance(logits, tuple) else logits
             next_logits = logits[:, -1, :]
             if greedy:

@@ -111,6 +111,15 @@ class ZeroConfig(DeepSpeedConfigModel):
         if self.mics_hierarchical_params_gather and self.mics_shard_size <= 0:
             raise ValueError(
                 "mics_hierarchical_params_gather requires mics_shard_size > 0")
+        if self.zero_hpz_partition_size > 1 and self.stage != 3:
+            raise ValueError(
+                "zero_hpz_partition_size (ZeRO++ hpZ) requires stage 3")
+        if self.zero_hpz_partition_size > 1 and (
+                self.zero_quantized_weights or self.zero_quantized_gradients):
+            raise ValueError(
+                "zero_hpz_partition_size cannot combine with qwZ/qgZ yet: the "
+                "quantized-collective region assumes master and param specs "
+                "shard identically, which hpZ's secondary partition breaks")
         return self
 
 
@@ -407,8 +416,6 @@ class DeepSpeedConfig:
                 not zc.offload_optimizer.nvme_path:
             bad.append("zero_optimization.offload_optimizer.device=nvme "
                        "requires nvme_path")
-        if zc.zero_hpz_partition_size > 1:
-            bad.append("zero_optimization.zero_hpz_partition_size (ZeRO++ hpZ)")
         ac = self.activation_checkpointing
         for knob in ("cpu_checkpointing", "contiguous_memory_optimization",
                      "synchronize_checkpoint_boundary", "profile"):

@@ -42,7 +42,7 @@ from .fp16.loss_scaler import (LossScaleState, dynamic_loss_scale_state,
                                grads_finite, update_scale)
 from .zero.planner import plan_sharding, named_shardings, constrain, ZeroShardingPlan
 from ..parallel.mesh import (MeshLayout, initialize_mesh, batch_pspec, dp_world_size,
-                             BATCH_AXES)
+                             BATCH_AXES, ZERO_AXES)
 from ..utils.logging import logger, log_dist
 from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 from .. import comm as dist
@@ -129,6 +129,16 @@ class DeepSpeedEngine:
         self.config = config if isinstance(config, DeepSpeedConfig) else DeepSpeedConfig(config)
         mc = self.config.mesh
         mics = self.config.zero_config.mics_shard_size
+        hpz = self.config.zero_config.zero_hpz_partition_size
+        if mics > 0 and hpz > 1:
+            raise ValueError(
+                "mics_shard_size and zero_hpz_partition_size both factorize "
+                "the data axis — enable one or the other")
+        if hpz > 1:
+            # hpZ reuses the MiCS mesh factorization (inner group = secondary
+            # partition); the planner diverges: masters/grads stay on the FULL
+            # group, only the compute view shards inner-only
+            mics = hpz
         if mesh is None:
             dp_outer = 1
             if mics > 0:
@@ -258,7 +268,10 @@ class DeepSpeedEngine:
             init_thunk = init_fn
         self.plan: ZeroShardingPlan = plan_sharding(
             shapes, self.zero_stage, mesh, tp_specs=param_specs,
-            persistence_threshold=self.config.zero_config.stage3_param_persistence_threshold)
+            persistence_threshold=self.config.zero_config.stage3_param_persistence_threshold,
+            # hpZ: masters/opt/grads on the full group, compute view inner-only
+            zero_axes=(BATCH_AXES if hpz > 1 else ZERO_AXES),
+            param_zero_axes=(ZERO_AXES if hpz > 1 else None))
         self._param_shardings = named_shardings(mesh, self.plan.param_specs)
         self._master_shardings = named_shardings(mesh, self.plan.master_specs)
         self._grad_shardings = named_shardings(mesh, self.plan.grad_specs)
@@ -270,7 +283,6 @@ class DeepSpeedEngine:
             if not self.use_master_weights:
                 raise ValueError("ZeRO++ quantized collectives require bf16 or "
                                  "fp16 compute (fp32 has no cast step to hook)")
-            from ..parallel.mesh import ZERO_AXES
             from .zero.zeropp import make_zeropp_cast
 
             # qgZ runs int8 (not the reference's int4) by default: one ICI hop
@@ -947,6 +959,22 @@ class DeepSpeedEngine:
     # total compute as train_batch — JAX has no standalone autograd tape to
     # replay later), backward banks the gradients, step applies the
     # optimizer update at the gradient-accumulation boundary.
+    # ------------------------------------------------------------------
+    def compile_train_step(self, batch):
+        """AOT-compile the fused train step for ``batch``'s shapes and return
+        the ``jax.stages.Compiled`` — its ``memory_analysis()`` /
+        ``cost_analysis()`` let tooling (autotuner, flops profiler) judge a
+        config without executing a step.  The jit cache is shared, so the
+        subsequent ``train_batch`` call does not recompile."""
+        global_batch = self._collect_global_batch(batch)
+        if self._nvme_swapper is not None:
+            raise NotImplementedError(
+                "compile_train_step does not cover the NVMe grad-only path")
+        if self._compiled_train_step is None:
+            self._compiled_train_step = self._make_train_step()
+        return self._compiled_train_step.lower(self.state,
+                                               global_batch).compile()
+
     # ------------------------------------------------------------------
     def _make_micro_grad_step(self):
         grad_specs = self._grad_shardings

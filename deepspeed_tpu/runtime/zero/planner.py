@@ -99,34 +99,46 @@ def _leaf_size(shape) -> int:
 
 def plan_sharding(param_shapes: Any, stage: int, mesh: Mesh, tp_specs: Optional[Any] = None,
                   persistence_threshold: int = 0,
-                  zero_axes: Tuple[str, ...] = ZERO_AXES) -> ZeroShardingPlan:
+                  zero_axes: Tuple[str, ...] = ZERO_AXES,
+                  param_zero_axes: Optional[Tuple[str, ...]] = None) -> ZeroShardingPlan:
     """Build the ZeRO sharding plan for a pytree of parameter ShapeDtypeStructs.
 
     tp_specs: optional pytree of PartitionSpec with the model's tensor/sequence
     parallel sharding (e.g. from flax ``nn.with_partitioning`` metadata); ZeRO
     axes are composed on top.
+
+    param_zero_axes: axes for the COMPUTE params when they differ from the
+    master/grad axes — the ZeRO++ hpZ secondary partition (reference
+    partition_parameters.py:1019 ``zero_hpz_partition_size``): masters/opt/
+    grads stay sharded over the full group while the bf16 forward view shards
+    only within the inner (intra-node) group, so per-layer all-gathers ride
+    the cheap links and the extra memory is params/hpz per device.
     """
     if tp_specs is None:
         tp_specs = jax.tree_util.tree_map(lambda _: P(), param_shapes)
+    param_zero_axes = param_zero_axes if param_zero_axes is not None else zero_axes
 
-    def spec_for(shaped, base, threshold):
+    def spec_for(shaped, base, threshold, axes):
         shape = tuple(shaped.shape)
         if threshold and _leaf_size(shape) < threshold:
             return base if base is not None else P()
-        return _compose_spec(shape, base, mesh, zero_axes)
+        return _compose_spec(shape, base, mesh, axes)
 
     # stage >= 1: master/opt sharded; no size threshold (opt state is the
     # memory hog the stage exists to shard)
-    master = (jax.tree_util.tree_map(lambda s, b: spec_for(s, b, 0), param_shapes, tp_specs)
-              if stage >= 1 else tp_specs)
+    master = (jax.tree_util.tree_map(
+        lambda s, b: spec_for(s, b, 0, zero_axes), param_shapes, tp_specs)
+        if stage >= 1 else tp_specs)
     # stage >= 3: compute params sharded, small params persist replicated
     params = (jax.tree_util.tree_map(
-        lambda s, b: spec_for(s, b, persistence_threshold), param_shapes, tp_specs)
+        lambda s, b: spec_for(s, b, persistence_threshold, param_zero_axes),
+        param_shapes, tp_specs)
         if stage >= 3 else tp_specs)
     # stage >= 2: grads land sharded (XLA lowers the DP reduction to
     # reduce-scatter + the step's gather); stage 3 grads match param sharding
+    # — except under hpZ, where the primary (full) partition owns grads/opt
     if stage >= 3:
-        grads = params
+        grads = params if param_zero_axes == zero_axes else master
     elif stage == 2:
         grads = master
     else:

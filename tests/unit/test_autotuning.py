@@ -1,0 +1,101 @@
+"""Autotuning — compile-time memory pruning + timed trials
+(reference deepspeed/autotuning/autotuner.py:42)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.autotuning import (Autotuner, AutotuningConfig, autotune)
+from deepspeed_tpu.parallel import mesh as mesh_mod
+
+from .simple_model import SimpleModel, random_batch
+
+HID = 32
+
+
+@pytest.fixture(autouse=True)
+def _fresh_mesh():
+    mesh_mod.reset_mesh()
+    yield
+    mesh_mod.reset_mesh()
+
+
+def _base_config(results_dir):
+    return {
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "bf16": {"enabled": True},
+        "autotuning": {"enabled": True, "max_trials": 4,
+                       "mbs_candidates": [2, 4], "zero_stages": [0, 2],
+                       "start_profile_step": 1, "end_profile_step": 3,
+                       "results_dir": results_dir},
+    }
+
+
+def test_autotune_end_to_end(tmp_path):
+    rd = str(tmp_path / "results")
+    best, records = autotune(
+        model_factory=lambda: SimpleModel(HID),
+        base_config=_base_config(rd),
+        batch_factory=lambda e: random_batch(e.train_batch_size, HID, 0),
+    )
+    assert best is not None
+    assert len(records) == 4
+    ok = [r for r in records if r.status == "ok"]
+    assert ok, [r.error for r in records]
+    # every successful trial recorded a compile-time memory estimate
+    assert all(r.memory_bytes > 0 for r in ok)
+    # best config merges overrides into the base config
+    assert best["zero_optimization"]["stage"] in (0, 2)
+    assert best["train_micro_batch_size_per_gpu"] in (2, 4)
+    assert "autotuning" not in best
+    # results written like the reference
+    recs = json.load(open(os.path.join(rd, "records.json")))
+    assert len(recs) == 4
+    bc = json.load(open(os.path.join(rd, "best_config.json")))
+    assert bc["metric"] == "throughput" and bc["metric_val"] > 0
+
+
+def test_memory_budget_prunes(tmp_path):
+    """An absurdly small HBM budget must reject every candidate at compile
+    time — no trial may execute."""
+    cfg = AutotuningConfig(enabled=True, max_trials=2, mbs_candidates=[2],
+                           zero_stages=[0], hbm_bytes=1024,
+                           results_dir=str(tmp_path / "r"))
+
+    def make_engine(overrides):
+        mesh_mod.reset_mesh()
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=SimpleModel(HID), config={
+                "train_micro_batch_size_per_gpu":
+                    overrides["train_micro_batch_size_per_gpu"],
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+                "zero_optimization": overrides["zero_optimization"],
+                "bf16": {"enabled": True}})
+        return engine
+
+    tuner = Autotuner(make_engine,
+                      lambda e: random_batch(e.train_batch_size, HID, 0), cfg)
+    best, records = tuner.tune()
+    assert best is None
+    assert all(r.status == "compile_oom" for r in records)
+
+
+def test_unknown_autotuning_key_rejected():
+    with pytest.raises(ValueError, match="unknown"):
+        AutotuningConfig.from_dict({"enabled": True, "bogus": 1})
+
+
+def test_compile_train_step_exposes_analysis():
+    engine, _, _, _ = deepspeed_tpu.initialize(model=SimpleModel(HID), config={
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "bf16": {"enabled": True}})
+    batch = random_batch(engine.train_batch_size, HID, 0)
+    compiled = engine.compile_train_step(batch)
+    mem = compiled.memory_analysis()
+    assert mem is not None
+    # training afterwards reuses the jit cache and works
+    loss = float(engine.train_batch(batch=batch))
+    assert np.isfinite(loss)

@@ -1,0 +1,85 @@
+"""ZeRO++ hpZ — secondary (intra-group) parameter partition
+(reference partition_parameters.py:1019, zero_hpz_partition_size)."""
+import numpy as np
+import pytest
+
+import jax
+from pydantic import ValidationError
+
+import deepspeed_tpu
+from deepspeed_tpu.parallel import mesh as mesh_mod
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+from .simple_model import SimpleModel, random_batch
+
+HID = 32
+
+
+@pytest.fixture(autouse=True)
+def _fresh_mesh():
+    mesh_mod.reset_mesh()
+    yield
+    mesh_mod.reset_mesh()
+
+
+def _engine(hpz=1, stage=3):
+    engine, _, _, _ = deepspeed_tpu.initialize(model=SimpleModel(HID), config={
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": stage, "zero_hpz_partition_size": hpz},
+        "bf16": {"enabled": True},
+    })
+    return engine
+
+
+def _axes(entry):
+    return (entry,) if isinstance(entry, str) else tuple(entry or ())
+
+
+def test_hpz_mesh_and_shardings():
+    engine = _engine(hpz=4)
+    assert engine.mesh.shape["data"] == 4
+    assert engine.mesh.shape["data_outer"] == 2
+    # masters (primary partition) shard over the FULL group incl. data_outer
+    master_axes = set()
+    for sh in jax.tree_util.tree_leaves(engine._master_shardings):
+        for e in sh.spec:
+            master_axes.update(_axes(e))
+    assert "data_outer" in master_axes
+    # compute params (secondary partition) shard inner-only
+    for sh in jax.tree_util.tree_leaves(engine._param_shardings):
+        for e in sh.spec:
+            assert "data_outer" not in _axes(e)
+
+
+def test_hpz_trains_and_matches_plain_stage3():
+    plain = _engine(hpz=1)
+    l0 = [float(plain.train_batch(batch=random_batch(
+        plain.train_batch_size, HID, s))) for s in range(3)]
+    mesh_mod.reset_mesh()
+    hpz = _engine(hpz=4)
+    l1 = [float(hpz.train_batch(batch=random_batch(
+        hpz.train_batch_size, HID, s))) for s in range(3)]
+    assert np.isfinite(l1).all()
+    np.testing.assert_allclose(l1, l0, rtol=2e-2)
+
+
+def test_hpz_requires_stage3():
+    with pytest.raises(ValidationError, match="stage 3"):
+        DeepSpeedConfig({"train_batch_size": 8, "zero_optimization": {
+            "stage": 2, "zero_hpz_partition_size": 4}}, dp_world_size=8)
+
+
+def test_hpz_conflicts_with_mics():
+    with pytest.raises(ValueError, match="one or the other"):
+        _engine_conflict()
+
+
+def _engine_conflict():
+    return deepspeed_tpu.initialize(model=SimpleModel(HID), config={
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 3, "zero_hpz_partition_size": 4,
+                              "mics_shard_size": 4},
+        "bf16": {"enabled": True},
+    })
