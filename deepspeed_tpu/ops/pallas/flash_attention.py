@@ -60,9 +60,12 @@ def _pick_block(S: int, want: int) -> int:
 # Forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
-                sm_scale: float, causal: bool, block_q: int, block_k: int,
-                num_k: int):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale: float, causal: bool,
+                block_q: int, block_k: int, num_k: int, masked: bool = False):
+    if masked:
+        mask_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
     qi, ki = pl.program_id(2), pl.program_id(3)
 
     @pl.when(ki == 0)
@@ -74,6 +77,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
     should_run = True
     if causal:
         should_run = ki * block_k <= qi * block_q + block_q - 1
+    if masked:
+        live = mask_ref[qi, ki] != 0
+        should_run = jnp.logical_and(should_run, live) if causal else live
 
     @pl.when(should_run)
     def _body():
@@ -109,7 +115,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         lse_ref[:] = lse[:, 0][None, :]
 
 
-def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+def _mask_array(block_mask):
+    """Hashable tuple-of-tuples (custom_vjp static arg) -> int32 array."""
+    import numpy as _np
+
+    return jnp.asarray(_np.asarray(block_mask, _np.int32))
+
+
+def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+         block_mask=None):
     B, Hq, S, hd = q.shape
     Hkv = k.shape[1]
     group = Hq // Hkv
@@ -117,19 +131,26 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     block_k = min(block_k, S)
     num_q, num_k = pl.cdiv(S, block_q), pl.cdiv(S, block_k)
     grid = (B, Hq, num_q, num_k)
+    masked = block_mask is not None
 
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
-                               block_q=block_q, block_k=block_k, num_k=num_k)
-    out, lse = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
+                               block_q=block_q, block_k=block_k, num_k=num_k,
+                               masked=masked)
+    in_specs = [
             pl.BlockSpec((None, None, block_q, hd), lambda b, h, qi, ki: (b, h, qi, 0)),
             pl.BlockSpec((None, None, block_k, hd),
                          lambda b, h, qi, ki: (b, h // group, ki, 0)),
             pl.BlockSpec((None, None, block_k, hd),
                          lambda b, h, qi, ki: (b, h // group, ki, 0)),
-        ],
+    ]
+    operands = [q, k, v]
+    if masked:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        operands.append(_mask_array(block_mask))
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((None, None, block_q, hd), lambda b, h, qi, ki: (b, h, qi, 0)),
             pl.BlockSpec((None, None, 1, block_q),
@@ -146,7 +167,7 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
         ],
         compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
-    )(q, k, v)
+    )(*operands)
     return out, lse
 
 
@@ -154,8 +175,13 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
 # Backward
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   acc_ref, *, sm_scale, causal, block_q, block_k, num_k):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                   sm_scale, causal, block_q, block_k, num_k,
+                   masked: bool = False):
+    if masked:
+        mask_ref, dq_ref, acc_ref = rest
+    else:
+        dq_ref, acc_ref = rest
     qi, ki = pl.program_id(2), pl.program_id(3)
 
     @pl.when(ki == 0)
@@ -165,6 +191,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     should_run = True
     if causal:
         should_run = ki * block_k <= qi * block_q + block_q - 1
+    if masked:
+        live = mask_ref[qi, ki] != 0
+        should_run = jnp.logical_and(should_run, live) if causal else live
 
     @pl.when(should_run)
     def _body():
@@ -193,12 +222,16 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[:] = acc_ref[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *, sm_scale, causal,
-                    block_q, block_k, num_q, group):
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                    sm_scale, causal, block_q, block_k, num_q, group,
+                    masked: bool = False):
     # Grid head axis is the KV head; the innermost axis walks every
     # (q-head-in-group, q-block) pair so dk/dv accumulate in VMEM at
     # [B, Hkv, S, hd] — no group-times-larger HBM intermediate.
+    if masked:
+        mask_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
+    else:
+        dk_ref, dv_ref, dk_acc, dv_acc = rest
     ki, j = pl.program_id(2), pl.program_id(3)
     qi = j % num_q
 
@@ -210,6 +243,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     should_run = True
     if causal:
         should_run = qi * block_q + block_q - 1 >= ki * block_k
+    if masked:
+        live = mask_ref[qi, ki] != 0
+        should_run = jnp.logical_and(should_run, live) if causal else live
 
     @pl.when(should_run)
     def _body():
@@ -242,7 +278,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[:] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
+def _bwd(sm_scale, causal, block_q, block_k, interpret, res, g,
+         block_mask=None):
     q, k, v, out, lse = res
     do = g
     B, Hq, S, hd = q.shape
@@ -251,13 +288,17 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
     block_q = min(block_q, S)
     block_k = min(block_k, S)
     num_q, num_k = pl.cdiv(S, block_q), pl.cdiv(S, block_k)
+    masked = block_mask is not None
+    mask_ops = [_mask_array(block_mask)] if masked else []
+    mask_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] if masked else []
 
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)[:, :, None, :]
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, block_k=block_k, num_k=num_k),
+                          block_q=block_q, block_k=block_k, num_k=num_k,
+                          masked=masked),
         grid=(B, Hq, num_q, num_k),
         in_specs=[
             pl.BlockSpec((None, None, block_q, hd), lambda b, h, qi, ki: (b, h, qi, 0)),
@@ -270,14 +311,14 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
                          lambda b, h, qi, ki: (b, h, 0, qi)),
             pl.BlockSpec((None, None, 1, block_q),
                          lambda b, h, qi, ki: (b, h, 0, qi)),
-        ],
+        ] + mask_specs,
         out_specs=pl.BlockSpec((None, None, block_q, hd),
                                lambda b, h, qi, ki: (b, h, qi, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, hd), jnp.float32)],
         compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, *mask_ops)
 
     # dk/dv accumulate per (kv-head, kv-block); the inner grid axis sweeps
     # all group*num_q (q-head, q-block) pairs so the group reduction happens
@@ -285,7 +326,7 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_q=num_q,
-                          group=group),
+                          group=group, masked=masked),
         grid=(B, Hkv, num_k, num_q * group),
         in_specs=[
             pl.BlockSpec((None, None, block_q, hd),
@@ -300,7 +341,7 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
                          lambda b, h, ki, j: (b, h * group + j // num_q, 0, j % num_q)),
             pl.BlockSpec((None, None, 1, block_q),
                          lambda b, h, ki, j: (b, h * group + j // num_q, 0, j % num_q)),
-        ],
+        ] + mask_specs,
         out_specs=[
             pl.BlockSpec((None, None, block_k, hd), lambda b, h, ki, j: (b, h, ki, 0)),
             pl.BlockSpec((None, None, block_k, hd), lambda b, h, ki, j: (b, h, ki, 0)),
@@ -315,7 +356,7 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
         ],
         compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, *mask_ops)
     return dq, dk, dv
 
 
@@ -329,24 +370,30 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
 # attn_lse lets the backward run WITHOUT re-executing the forward kernel
 # (with out/lse hidden inside the vjp, remat must re-run the S² forward to
 # regenerate residuals no matter what the policy saves).
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    return _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+           block_mask=None):
+    return _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+                block_mask)
 
 
-def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+               block_mask=None):
     from jax.ad_checkpoint import checkpoint_name
 
-    out, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    out, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+                    block_mask)
     # names INSIDE the vjp-fwd so remat policies can pin the residuals
     # themselves ("attn_lse" + the model-level "attn_out"/q/k/v names)
     lse = checkpoint_name(lse, "attn_lse")
     return (out, lse), (q, k, v, out, lse)
 
 
-def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
+def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, block_mask,
+               res, g):
     do, _ = g  # lse is consumed only by checkpoint_name: zero cotangent
-    return _bwd(sm_scale, causal, block_q, block_k, interpret, res, do)
+    return _bwd(sm_scale, causal, block_q, block_k, interpret, res, do,
+                block_mask)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -355,10 +402,14 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = None,
                     bias=None, block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
-                    interpret: Optional[bool] = None):
+                    interpret: Optional[bool] = None,
+                    block_mask=None):
     """q [B,S,Hq,hd], k/v [B,S,Hkv,hd] -> [B,S,Hq,hd].
 
     bias is not fused (alibi models use the XLA path); causal is.
+    ``block_mask`` (optional bool [S/block_q, S/block_k]) skips dead blocks in
+    forward AND backward — the block-sparse attention path
+    (ops/sparse_attention builds the patterns).
     """
     if bias is not None:
         raise NotImplementedError("bias is handled by the XLA attention path")
@@ -369,7 +420,19 @@ def flash_attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = No
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     if interpret is None:
         interpret = _interpret_default()
+    if block_mask is not None:
+        import numpy as _np
+
+        bm = _np.asarray(block_mask)
+        want = (S // block_q, S // block_k)
+        if bm.shape != want:
+            raise ValueError(
+                f"block_mask shape {bm.shape} does not match the block grid "
+                f"{want} (S={S}, block_q={block_q}, block_k={block_k})")
+        # hashable static arg for the custom_vjp/jit caches
+        block_mask = tuple(tuple(int(x) for x in row) for row in bm)
     # [B,S,H,hd] -> [B,H,S,hd]
     qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
-    out, _ = _flash(qt, kt, vt, sm_scale, causal, block_q, block_k, interpret)
+    out, _ = _flash(qt, kt, vt, sm_scale, causal, block_q, block_k, interpret,
+                    block_mask)
     return jnp.swapaxes(out, 1, 2)

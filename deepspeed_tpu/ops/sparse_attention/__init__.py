@@ -1,0 +1,70 @@
+"""Block-sparse attention (reference ``deepspeed/ops/sparse_attention/``).
+
+The reference implements block-sparse attention with Triton matmul/softmax
+kernels driven by a layout tensor (``sparse_self_attention.py``,
+``matmul.py``, ``softmax.py``).  Here the SAME flash kernel that serves dense
+causal attention skips dead blocks from a pattern mask — forward and backward
+(ops/pallas/flash_attention.py ``block_mask``).
+
+Cost model caveat (measured on v5e): the skip eliminates dead blocks'
+COMPUTE, but the pipelined BlockSpec fetches still stream their K/V bytes
+from HBM, so wall-clock improves by less than the density ratio (e.g. 23%
+density ≈ 0.86x the all-live time at S=4096).  Long-sequence wins come from
+the S² compute reduction; a gather-based fetch skip is the follow-up if
+bandwidth-bound shapes matter.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .sparsity_config import (  # noqa: F401
+    BigBirdSparsityConfig,
+    BSLongformerSparsityConfig,
+    DenseSparsityConfig,
+    FixedSparsityConfig,
+    LocalSlidingWindowSparsityConfig,
+    SparsityConfig,
+    VariableSparsityConfig,
+)
+
+
+class SparseSelfAttention:
+    """Functional analogue of reference ``SparseSelfAttention`` (sparse_self_
+    attention.py): holds a sparsity config, applies block-sparse attention.
+
+    Call with q/k/v shaped [B, S, H, hd] (the model family's layout)."""
+
+    def __init__(self, sparsity_config: SparsityConfig,
+                 max_seq_length: int = 2048):
+        self.sparsity_config = sparsity_config
+        self.max_seq_length = max_seq_length
+        self._layout_cache: dict = {}
+
+    def layout(self, seq_len: int):
+        if seq_len > self.max_seq_length:
+            raise ValueError(
+                f"seq_len {seq_len} exceeds max_seq_length "
+                f"{self.max_seq_length}")
+        if seq_len not in self._layout_cache:
+            self._layout_cache[seq_len] = \
+                self.sparsity_config.make_layout(seq_len)
+        return self._layout_cache[seq_len]
+
+    def __call__(self, q, k, v, sm_scale: Optional[float] = None,
+                 interpret: Optional[bool] = None):
+        from ..pallas.flash_attention import flash_attention
+
+        S = q.shape[1]
+        blk = self.sparsity_config.block
+        causal = self.sparsity_config.attention == "unidirectional"
+        return flash_attention(
+            q, k, v, causal=causal, sm_scale=sm_scale,
+            block_q=blk, block_k=blk, interpret=interpret,
+            block_mask=self.layout(S))
+
+    def density(self, seq_len: int) -> float:
+        """Fraction of live blocks — the COMPUTE cost vs dense.  Wall-clock
+        improves by less (dead blocks' K/V bytes still stream from HBM — see
+        the module docstring's cost-model caveat)."""
+        m = self.layout(seq_len)
+        return float(m.sum()) / m.size

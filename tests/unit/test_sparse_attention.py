@@ -1,0 +1,121 @@
+"""Block-sparse attention — pattern builders + kernel block-skip parity
+(reference deepspeed/ops/sparse_attention/)."""
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.sparse_attention import (
+    BigBirdSparsityConfig,
+    BSLongformerSparsityConfig,
+    DenseSparsityConfig,
+    FixedSparsityConfig,
+    LocalSlidingWindowSparsityConfig,
+    SparseSelfAttention,
+    VariableSparsityConfig,
+)
+
+B, H, S, HD = 2, 2, 256, 64
+BLK = 64
+
+
+def _qkv(seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (B, S, H, HD), jnp.float32)
+    k = jax.random.normal(ks[1], (B, S, H, HD), jnp.float32)
+    v = jax.random.normal(ks[2], (B, S, H, HD), jnp.float32)
+    return q, k, v
+
+
+def _dense_reference(q, k, v, layout, block, causal):
+    """Elementwise-masked XLA attention with the same block pattern."""
+    n = S // block
+    elem = np.kron(np.asarray(layout), np.ones((block, block), bool))
+    if causal:
+        elem &= np.tril(np.ones((S, S), bool))
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(HD)
+    s = jnp.where(jnp.asarray(elem)[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+PATTERNS = [
+    DenseSparsityConfig(block=BLK),
+    FixedSparsityConfig(block=BLK, num_local_blocks=2, num_global_blocks=1),
+    BigBirdSparsityConfig(block=BLK, num_sliding_window_blocks=1,
+                          num_random_blocks=1, num_global_blocks=1),
+    BSLongformerSparsityConfig(block=BLK, num_sliding_window_blocks=3,
+                               global_block_indices=(0,)),
+    LocalSlidingWindowSparsityConfig(block=BLK, num_sliding_window_blocks=3),
+    VariableSparsityConfig(block=BLK, local_window_blocks=(1, 2),
+                           global_block_indices=(0,)),
+]
+
+
+@pytest.mark.parametrize("cfg", PATTERNS, ids=lambda c: type(c).__name__)
+def test_pattern_parity_forward(cfg):
+    q, k, v = _qkv()
+    sa = SparseSelfAttention(cfg)
+    out = sa(q, k, v, interpret=True)
+    ref = _dense_reference(q, k, v, sa.layout(S), BLK,
+                           causal=cfg.attention == "unidirectional")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-3, rtol=1e-3)
+
+
+def test_gradient_parity():
+    cfg = BSLongformerSparsityConfig(block=BLK, num_sliding_window_blocks=3)
+    q, k, v = _qkv(1)
+    sa = SparseSelfAttention(cfg)
+
+    def loss_sparse(q, k, v):
+        return (sa(q, k, v, interpret=True).astype(jnp.float32) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (_dense_reference(q, k, v, sa.layout(S), BLK, True)
+                .astype(jnp.float32) ** 2).sum()
+
+    gs = jax.grad(loss_sparse, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gs, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-3, rtol=5e-3)
+
+
+def test_density_below_one():
+    sa = SparseSelfAttention(
+        LocalSlidingWindowSparsityConfig(block=BLK,
+                                         num_sliding_window_blocks=3))
+    assert sa.density(S) < 0.8  # sliding window is genuinely sparse
+    dense = SparseSelfAttention(DenseSparsityConfig(block=BLK,
+                                                    attention="bidirectional"))
+    assert dense.density(S) == 1.0
+
+
+def test_layout_structure():
+    cfg = BSLongformerSparsityConfig(block=BLK, num_sliding_window_blocks=3,
+                                     global_block_indices=(0,))
+    m = cfg.make_layout(S)
+    n = S // BLK
+    assert m.shape == (n, n) and m.dtype == bool
+    assert m[:, 0].all()            # global column
+    assert np.diag(m).all()         # diagonal always live
+    # causal: upper triangle dead except where diagonal forces it
+    assert not np.triu(m, 1).any()
+
+
+def test_bad_seq_len_raises():
+    with pytest.raises(ValueError, match="multiple"):
+        FixedSparsityConfig(block=100).make_layout(S)
+
+
+def test_block_mask_shape_validation():
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+    q, k, v = _qkv()
+    with pytest.raises(ValueError, match="block grid"):
+        flash_attention(q, k, v, block_q=64, block_k=64, interpret=True,
+                        block_mask=np.ones((2, 2), bool))
