@@ -55,27 +55,30 @@ class CausalLM:
         return init_params(self.config, rng)
 
     def _split(self, batch):
+        pld_theta = None
         if isinstance(batch, dict):
             tokens = batch["input_ids"]
             labels = batch.get("labels")
             positions = batch.get("positions")
+            pld_theta = batch.get("pld_theta")
         else:
             tokens, labels, positions = batch, None, None
         if labels is None:
             labels = jnp.concatenate(
                 [tokens[:, 1:], jnp.full_like(tokens[:, :1], -100)], axis=1)
-        return tokens, labels, positions
+        return tokens, labels, positions, pld_theta
 
     def apply_fn(self, params, tokens, positions=None, rng=None,
-                 deterministic=True, return_aux=False):
+                 deterministic=True, return_aux=False, pld_theta=None):
         return forward(self.config, params, tokens, positions=positions, rng=rng,
                        attn_impl=self.attn_impl, deterministic=deterministic,
-                       return_aux=return_aux)
+                       return_aux=return_aux, pld_theta=pld_theta)
 
     def _loss(self, params, batch, rng, deterministic):
-        tokens, labels, positions = self._split(batch)
+        tokens, labels, positions, pld_theta = self._split(batch)
         logits, aux = self.apply_fn(params, tokens, positions=positions, rng=rng,
-                                    deterministic=deterministic, return_aux=True)
+                                    deterministic=deterministic, return_aux=True,
+                                    pld_theta=None if deterministic else pld_theta)
         loss = cross_entropy_loss(logits, labels)
         if self.config.num_experts > 1:
             loss = loss + self.config.moe_aux_loss_coef * aux["moe_aux_loss"]

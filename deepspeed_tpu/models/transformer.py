@@ -519,7 +519,8 @@ def _block(cfg: TransformerConfig, lp: Dict[str, Any], x, positions, rng,
 def forward(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
             positions: Optional[jax.Array] = None, rng: Optional[jax.Array] = None,
             attn_impl: str = "xla", deterministic: bool = True,
-            seq_sharded: bool = True, return_aux: bool = False):
+            seq_sharded: bool = True, return_aux: bool = False,
+            pld_theta: Optional[jax.Array] = None):
     """tokens [B, S] int32 -> logits [B, S, V] (+ aux dict if return_aux)."""
     B, S = tokens.shape
     custom_positions = positions is not None
@@ -572,6 +573,10 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
             deterministic)
 
     aux_total = jnp.float32(0.0)
+    if pld_theta is not None and (cfg.pipeline_stages > 1 or not cfg.scan_layers):
+        raise NotImplementedError(
+            "progressive layer drop requires the scanned-layers path "
+            "(scan_layers=True, pipeline_stages=1)")
     if cfg.pipeline_stages > 1:
         from ..runtime.pipe.spmd import pipeline_apply
 
@@ -598,15 +603,37 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
         x = constrain_spec(x, act_spec)
         aux_total = aux_sum / M      # mean over microbatches, sum over layers
     elif cfg.scan_layers:
-        def body(carry, lp):
-            x, r, aux_sum = carry
-            r, sub = jax.random.split(r)
-            x, aux = block(lp, x, sub, positions)
-            x = constrain_spec(x, act_spec)
-            return (x, r, aux_sum + aux), None
+        if pld_theta is not None:
+            # progressive layer drop (runtime/progressive_layer_drop.py):
+            # per-layer keep decisions ride the scan as a second xs — a
+            # dropped layer is the residual identity and contributes no aux
+            from ..runtime.progressive_layer_drop import pld_keep_mask
 
-        (x, _, aux_total), _ = jax.lax.scan(body, (x, rng, aux_total),
-                                            params["layers"])
+            rng, sub = jax.random.split(rng)
+            keep = pld_keep_mask(sub, cfg.num_layers, pld_theta)
+
+            def body(carry, xs):
+                lp, keep_i = xs
+                x, r, aux_sum = carry
+                r, sub = jax.random.split(r)
+                x_new, aux = block(lp, x, sub, positions)
+                x = jnp.where(keep_i, x_new, x)
+                aux = jnp.where(keep_i, aux, 0.0)
+                x = constrain_spec(x, act_spec)
+                return (x, r, aux_sum + aux), None
+
+            (x, _, aux_total), _ = jax.lax.scan(
+                body, (x, rng, aux_total), (params["layers"], keep))
+        else:
+            def body(carry, lp):
+                x, r, aux_sum = carry
+                r, sub = jax.random.split(r)
+                x, aux = block(lp, x, sub, positions)
+                x = constrain_spec(x, act_spec)
+                return (x, r, aux_sum + aux), None
+
+            (x, _, aux_total), _ = jax.lax.scan(body, (x, rng, aux_total),
+                                                params["layers"])
     else:
         for i in range(cfg.num_layers):
             lp = jax.tree_util.tree_map(lambda a: a[i], params["layers"])

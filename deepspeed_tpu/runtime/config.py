@@ -246,6 +246,26 @@ class PipelineConfig(DeepSpeedConfigModel):
     partition_method: str = "parameters"
 
 
+class ProgressiveLayerDropConfig(DeepSpeedConfigModel):
+    """``progressive_layer_drop`` block (reference runtime/config.py PLD
+    keys; runtime/progressive_layer_drop.py)."""
+
+    enabled: bool = False
+    theta: float = Field(0.5, gt=0.0, le=1.0)
+    gamma: float = Field(0.001, ge=0.0)
+
+
+class EigenvalueConfig(DeepSpeedConfigModel):
+    """``eigenvalue`` block (reference runtime/eigenvalue.py knobs; device/
+    layer-name knobs are meaningless on the pytree design and not accepted)."""
+
+    enabled: bool = False
+    verbose: bool = False
+    max_iter: int = Field(100, ge=1)
+    tol: float = Field(1e-2, gt=0.0)
+    stability: float = Field(1e-6, ge=0.0)
+
+
 class CurriculumLearningLegacyConfig(DeepSpeedConfigModel):
     """Top-level ``curriculum_learning`` block (reference legacy curriculum,
     runtime/config.py ``curriculum_enabled_legacy``): the engine truncates
@@ -383,6 +403,9 @@ class DeepSpeedConfig:
             **config.get("data_efficiency", {}))
         self.elasticity = ElasticityConfig(**config.get("elasticity", {}))
         self.data_types = DataTypesConfig(**config.get("data_types", {}))
+        self.progressive_layer_drop = ProgressiveLayerDropConfig(
+            **config.get("progressive_layer_drop", {}))
+        self.eigenvalue = EigenvalueConfig(**config.get("eigenvalue", {}))
 
         self.gradient_accumulation_steps: Optional[int] = config.get(
             C.GRADIENT_ACCUMULATION_STEPS)

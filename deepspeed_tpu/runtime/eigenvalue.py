@@ -1,0 +1,122 @@
+"""Hessian eigenvalue estimation (reference ``runtime/eigenvalue.py:12``).
+
+The reference power-iterates with torch double-backward per block and feeds
+the per-layer values into MoQ's quantization scheduling.  JAX makes the core
+primitive free: the Hessian-vector product is ``jvp`` of ``grad`` (forward-
+over-reverse), one jittable function — no retain_graph bookkeeping, no
+per-layer module walking.  Per-layer values fall out of the pytree structure:
+the power iteration runs on the whole param tree and per-leaf Rayleigh
+quotients are reported for layer-wise consumers.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import log_dist
+
+
+def _tree_dot(a, b) -> jnp.ndarray:
+    leaves = jax.tree_util.tree_map(
+        lambda x, y: jnp.sum(x.astype(jnp.float32) * y.astype(jnp.float32)),
+        a, b)
+    return functools.reduce(jnp.add, jax.tree_util.tree_leaves(leaves))
+
+
+def _tree_norm(a) -> jnp.ndarray:
+    return jnp.sqrt(_tree_dot(a, a))
+
+
+def _normalize(a):
+    n = _tree_norm(a) + 1e-12
+    # divide in fp32, return in each leaf's dtype (tangent-dtype contract)
+    return jax.tree_util.tree_map(
+        lambda x: (x.astype(jnp.float32) / n).astype(x.dtype), a)
+
+
+def hvp(loss_fn: Callable, params: Any, batch: Any, rng, v: Any) -> Any:
+    """Hessian-vector product at ``params`` along ``v`` (fwd-over-rev)."""
+    grad_fn = jax.grad(lambda p: loss_fn(p, batch, rng))
+    _, hv = jax.jvp(grad_fn, (params,), (v,))
+    return hv
+
+
+class Eigenvalue:
+    """Power-iteration largest-eigenvalue estimator (reference :12).
+
+    Config parity: ``eigenvalue`` block keys max_iter / tol / stability /
+    verbose (device/layer knobs are meaningless here — the pytree IS the
+    layer decomposition).
+    """
+
+    def __init__(self, verbose: bool = False, max_iter: int = 100,
+                 tol: float = 1e-2, stability: float = 1e-6):
+        self.verbose = verbose
+        self.max_iter = max_iter
+        self.tol = tol
+        self.stability = stability
+        self._hvp_cache: Dict[int, Callable] = {}
+
+    def _jitted_hvp(self, loss_fn: Callable) -> Callable:
+        """One compiled HVP per loss_fn — periodic (MoQ-style) callers must
+        not pay a retrace per invocation."""
+        key = id(loss_fn)
+        if key not in self._hvp_cache:
+            self._hvp_cache[key] = jax.jit(
+                lambda p, b, r, vv: hvp(loss_fn, p, b, r, vv))
+        return self._hvp_cache[key]
+
+    def compute_eigenvalue(self, loss_fn: Callable, params: Any, batch: Any,
+                           rng: Optional[jax.Array] = None
+                           ) -> Tuple[float, Dict[str, float]]:
+        """(lambda_max, per-leaf Rayleigh quotients).
+
+        The per-leaf dict maps '/'-joined param paths to vᵀHv restricted to
+        that leaf — the layer-wise signal the reference feeds MoQ.
+        """
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        # tangents must match the primal dtype leaf-wise (bf16 params -> bf16
+        # v); the Rayleigh/norm reductions still accumulate in fp32.
+        # fold_in by leaf INDEX: deterministic across processes/runs (str-hash
+        # is salted per interpreter) and distinct for same-shaped leaves
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        v = _normalize(jax.tree_util.tree_unflatten(treedef, [
+            jax.random.normal(jax.random.fold_in(rng, i), x.shape,
+                              jnp.float32).astype(x.dtype)
+            for i, x in enumerate(leaves)]))
+
+        hvp_fn = self._jitted_hvp(loss_fn)
+        eig_prev = jnp.float32(0.0)
+        eig = jnp.float32(0.0)
+        for i in range(self.max_iter):
+            hv = hvp_fn(params, batch, rng, v)
+            eig = _tree_dot(v, hv)                       # Rayleigh quotient
+            norm = _tree_norm(hv)
+            if float(norm) < self.stability:
+                break
+            v = jax.tree_util.tree_map(
+                lambda x: (x.astype(jnp.float32) / (norm + 1e-12))
+                .astype(x.dtype), hv)
+            if i > 0 and abs(float(eig - eig_prev)) <= \
+                    self.tol * max(abs(float(eig)), 1e-12):
+                break
+            eig_prev = eig
+        if self.verbose:
+            log_dist(f"eigenvalue: lambda_max≈{float(eig):.4e} "
+                     f"after {i + 1} iters", ranks=[0])
+
+        hv = hvp_fn(params, batch, rng, v)
+        per_leaf: Dict[str, float] = {}
+        flat_v = jax.tree_util.tree_flatten_with_path(v)[0]
+        flat_h = jax.tree_util.tree_leaves(hv)
+        for (path, vl), hl in zip(flat_v, flat_h):
+            name = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                            for p in path)
+            per_leaf[name] = float(jnp.sum(
+                vl.astype(jnp.float32) * hl.astype(jnp.float32)))
+        return float(eig), per_leaf

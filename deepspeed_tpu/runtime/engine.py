@@ -427,6 +427,16 @@ class DeepSpeedEngine:
         self._data_iterator = None
         self.training_dataloader = self._build_dataloader(training_data)
         self.monitor = self._build_monitor()
+        # -- progressive layer drop (reference engine.progressive_layer_drop;
+        #    the schedule lives here, the model consumes batch['pld_theta']) --
+        self.progressive_layer_drop = None
+        pld_cfg = self.config.progressive_layer_drop
+        if pld_cfg.enabled:
+            from .progressive_layer_drop import ProgressiveLayerDrop
+
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=pld_cfg.theta, gamma=pld_cfg.gamma)
+
         # -- data efficiency ------------------------------------------------
         self.curriculum_scheduler = None
         cl = self.config.curriculum_learning
@@ -884,6 +894,7 @@ class DeepSpeedEngine:
                 data_iter = self._data_iterator
             batch = data_iter
         global_batch = self._collect_global_batch(batch)
+        global_batch = self._inject_pld_theta(global_batch, shape=(self.gas,))
         if self.curriculum_scheduler is not None:
             # legacy seqlen curriculum: truncate the window's sequence dim;
             # jit caches one program per distinct difficulty automatically
@@ -960,6 +971,44 @@ class DeepSpeedEngine:
     # replay later), backward banks the gradients, step applies the
     # optimizer update at the gradient-accumulation boundary.
     # ------------------------------------------------------------------
+    def _inject_pld_theta(self, batch, shape=()):
+        """Add the scheduled PLD theta as a batch leaf (replicated global
+        array, so multi-controller jit inputs stay consistent).  ``shape`` is
+        ``(gas,)`` for the accumulation window (the scan slices it to the
+        scalar the model reads) and ``()`` for a single micro-batch."""
+        if self.progressive_layer_drop is None:
+            return batch
+        if not isinstance(batch, dict):
+            raise ValueError(
+                "progressive_layer_drop needs dict batches ({'input_ids': ...})"
+                " so the theta schedule can ride along as 'pld_theta'")
+        theta = self.progressive_layer_drop.update_state(self.global_steps)
+        arr = jax.device_put(np.full(shape, theta, np.float32),
+                             NamedSharding(self.mesh, P()))
+        return {**batch, "pld_theta": arr}
+
+    # ------------------------------------------------------------------
+    def compute_eigenvalue(self, batch, rng=None):
+        """Largest Hessian eigenvalue + per-leaf Rayleigh quotients at the
+        current weights (reference engine eigenvalue integration; the values
+        feed MoQ-style quantization scheduling)."""
+        from .eigenvalue import Eigenvalue
+
+        ec = self.config.eigenvalue
+        est = getattr(self, "_eigenvalue_estimator", None)
+        if est is None:
+            est = Eigenvalue(verbose=ec.verbose, max_iter=ec.max_iter,
+                             tol=ec.tol, stability=ec.stability)
+            self._eigenvalue_estimator = est  # caches the jitted HVP too
+        # the compute-precision view: the loss mixes params with
+        # cfg.dtype activations, so fp32 masters would change dtypes
+        # mid-scan — differentiate what training differentiates
+        params = self.state.params
+        micro = self._shard_batch_eval(batch)
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        return est.compute_eigenvalue(self.loss_fn, params, micro, rng)
+
+    # ------------------------------------------------------------------
     def compile_train_step(self, batch):
         """AOT-compile the fused train step for ``batch``'s shapes and return
         the ``jax.stages.Compiled`` — its ``memory_analysis()`` /
@@ -967,6 +1016,7 @@ class DeepSpeedEngine:
         config without executing a step.  The jit cache is shared, so the
         subsequent ``train_batch`` call does not recompile."""
         global_batch = self._collect_global_batch(batch)
+        global_batch = self._inject_pld_theta(global_batch, shape=(self.gas,))
         if self._nvme_swapper is not None:
             raise NotImplementedError(
                 "compile_train_step does not cover the NVMe grad-only path")
@@ -1042,6 +1092,7 @@ class DeepSpeedEngine:
                 f"forward() beyond the accumulation window: {self._accum_count} "
                 f"micro-batches already banked with gas={self.gas}; call step()")
         micro = self._shard_batch_eval(batch)
+        micro = self._inject_pld_theta(micro, shape=())
         if self._accum_count == 0:
             self.tput_timer.start()
         loss, self._accum_grads, rng = self._compiled_micro_grad(

@@ -1,0 +1,57 @@
+"""Progressive Layer Drop (reference ``runtime/progressive_layer_drop.py``).
+
+The schedule is the reference's: theta(t) = (1 - theta_bar)·exp(-gamma·t) +
+theta_bar — keep probability decays from 1.0 toward ``theta`` as training
+progresses, so early training sees the full network and later steps train a
+stochastically shallower one (arXiv:2010.13369).
+
+Like the reference, the engine owns the SCHEDULE and the model applies the
+drop: the reference exposes ``get_state()['pld_theta']`` for the client
+model's forward; here ``pld_keep_mask`` turns (theta, rng) into per-layer
+keep decisions the scan-based transformer folds in (depth-scaled: layer i
+(1-based) of L keeps with probability 1 - (i/L)·(1-theta), so the first
+layer keeps with ~1 - (1-theta)/L and the last with theta — deeper layers
+drop more, per the paper's schedule).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import log_dist
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+        log_dist(f"Enabled progressive layer dropping (theta = {self.theta})",
+                 ranks=[0])
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> float:
+        self.current_theta = float(
+            (1.0 - self.theta) * np.exp(-self.gamma * global_step) + self.theta)
+        return self.current_theta
+
+
+def pld_theta_at(step, theta: float, gamma: float):
+    """Traced schedule for use inside a jitted step."""
+    return (1.0 - theta) * jnp.exp(-gamma * step.astype(jnp.float32)) + theta
+
+
+def pld_keep_mask(rng, num_layers: int, theta):
+    """Per-layer keep decisions [L] bool: layer i keeps with probability
+    1 - (i+1)/L · (1 - theta) (paper's depth-scaled schedule; the first
+    layers almost never drop, the last drops with ~(1-theta))."""
+    depth = (jnp.arange(num_layers, dtype=jnp.float32) + 1.0) / num_layers
+    p_keep = 1.0 - depth * (1.0 - theta)
+    return jax.random.uniform(rng, (num_layers,)) < p_keep
