@@ -13,7 +13,6 @@ from __future__ import annotations
 import functools
 from typing import Any, Callable, Dict, Optional, Tuple
 
-import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -40,8 +39,14 @@ def _normalize(a):
 
 
 def hvp(loss_fn: Callable, params: Any, batch: Any, rng, v: Any) -> Any:
-    """Hessian-vector product at ``params`` along ``v`` (fwd-over-rev)."""
-    grad_fn = jax.grad(lambda p: loss_fn(p, batch, rng))
+    """Hessian-vector product at ``params`` along ``v`` (fwd-over-rev).
+
+    Honors the engine's loss contract ``loss | (loss, aux_dict)``."""
+    def scalar_loss(p):
+        out = loss_fn(p, batch, rng)
+        return out[0] if isinstance(out, tuple) else out
+
+    grad_fn = jax.grad(scalar_loss)
     _, hv = jax.jvp(grad_fn, (params,), (v,))
     return hv
 
