@@ -20,9 +20,12 @@ there.  Params smaller than ``stage3_param_persistence_threshold`` stay
 replicated in stage 3 — exactly the reference's persistent-param optimization
 (parameter_offload.py:347) but with zero bookkeeping.
 
-The prefetch window (`stage3_max_live_parameters`, `stage3_prefetch_bucket_size`)
-maps to XLA's collective scheduler; we expose the knobs and translate them to
-compiler scheduling options in the engine rather than a Python-side coordinator.
+The prefetch-window knobs (`stage3_max_live_parameters`,
+`stage3_prefetch_bucket_size`, `stage3_max_reuse_distance`) are accepted for
+schema parity and validated, but NOT translated further: XLA's latency-hiding
+scheduler owns all-gather placement and double-buffering under jit, and it
+makes those decisions from the compiled program's live ranges — the quantities
+the reference's Python-side coordinator approximated with these knobs.
 """
 from __future__ import annotations
 

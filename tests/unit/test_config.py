@@ -53,8 +53,15 @@ def test_zero_config_defaults_and_stage():
 def test_zeropp_requires_stage3():
     with pytest.raises(Exception):
         ZeroConfig(stage=2, zero_quantized_weights=True)
-    z = ZeroConfig(stage=3, zero_quantized_weights=True, zero_hpz_partition_size=8)
-    assert z.zero_quantized_weights and z.zero_hpz_partition_size == 8
+    z = ZeroConfig(stage=3, zero_quantized_weights=True)
+    assert z.zero_quantized_weights
+    z = ZeroConfig(stage=3, zero_hpz_partition_size=8)
+    assert z.zero_hpz_partition_size == 8
+    # hpZ diverges master/param shardings; the qwZ gather region assumes
+    # they match, so the combination is rejected until it is taught hpZ
+    with pytest.raises(Exception, match="hpz"):
+        ZeroConfig(stage=3, zero_quantized_weights=True,
+                   zero_hpz_partition_size=8)
 
 
 def test_fp16_bf16_exclusive():
