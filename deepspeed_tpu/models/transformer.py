@@ -77,6 +77,11 @@ class TransformerConfig:
     # residual stream (runtime/data_pipeline/data_routing/random_ltd.py)
     random_ltd: bool = False
     random_ltd_keep: int = 0
+    # activation fake-quant (compression_training.activation_quantization —
+    # reference basic_layer.py QuantAct): applied to the post-norm inputs of
+    # attention and the MLP, dynamic range, straight-through gradient
+    act_quant_bits: int = 0
+    act_quant_symmetric: bool = False
     scan_layers: bool = True
     dtype: Any = jnp.bfloat16                 # compute dtype hint (engine casts)
     initializer_range: float = 0.02
@@ -439,6 +444,17 @@ def _alibi_bias(cfg, positions, num_heads, S, dtype):
     return (-jnp.abs(rel)[:, None, :, :] * slopes[None, :, None, None]).astype(dtype)
 
 
+def _maybe_act_quant(cfg: TransformerConfig, h):
+    """Activation fake-quant at the post-norm matmul inputs (one shared site
+    for all four block variants — keep behavior in sync here)."""
+    if not cfg.act_quant_bits:
+        return h
+    from ..compression.quantize import activation_fake_quant
+
+    return activation_fake_quant(h, cfg.act_quant_bits,
+                                 symmetric=cfg.act_quant_symmetric)
+
+
 def _mlp(cfg: TransformerConfig, lp: Dict[str, Any], h, rng, deterministic):
     """Post-norm MLP/MoE body shared by the training block and the KV-cached
     decode block: returns (output, moe_aux_loss)."""
@@ -478,6 +494,7 @@ def _block(cfg: TransformerConfig, lp: Dict[str, Any], x, positions, rng,
     hd, nh, nkv = cfg.dims_per_head, cfg.num_heads, cfg.kv_heads
 
     h = _norm(cfg, x, lp["attn_norm_scale"], lp.get("attn_norm_bias"))
+    h = _maybe_act_quant(cfg, h)
     q = h @ lp["wq"]
     k = h @ lp["wk"]
     v = h @ lp["wv"]
@@ -508,6 +525,7 @@ def _block(cfg: TransformerConfig, lp: Dict[str, Any], x, positions, rng,
     x = x + attn
 
     h = _norm(cfg, x, lp["mlp_norm_scale"], lp.get("mlp_norm_bias"))
+    h = _maybe_act_quant(cfg, h)
     rng, sub = jax.random.split(rng)
     m, aux = _mlp(cfg, lp, h, sub, deterministic)
     if cfg.dropout and not deterministic:
@@ -728,6 +746,7 @@ def _block_cached(cfg, lp, x, ck, cv, q_pos, q_slot, valid, kpos, next_slot,
     hd, nh, nkv = cfg.dims_per_head, cfg.num_heads, cfg.kv_heads
 
     h = _norm(cfg, x, lp["attn_norm_scale"], lp.get("attn_norm_bias"))
+    h = _maybe_act_quant(cfg, h)
     q = h @ lp["wq"]
     k = h @ lp["wk"]
     v = h @ lp["wv"]
@@ -749,6 +768,7 @@ def _block_cached(cfg, lp, x, ck, cv, q_pos, q_slot, valid, kpos, next_slot,
     x = x + attn
 
     h = _norm(cfg, x, lp["mlp_norm_scale"], lp.get("mlp_norm_bias"))
+    h = _maybe_act_quant(cfg, h)
     m, _ = _mlp(cfg, lp, h, rng, deterministic=True)
     return x + m, ck, cv
 

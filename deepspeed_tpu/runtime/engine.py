@@ -212,11 +212,48 @@ class DeepSpeedEngine:
                     f"gradient_accumulation_steps ({self.gas})")
 
         # -- compression (QAT / pruning transform on the compute tree) --
-        from ..compression import build_param_transform
+        from ..compression import build_param_transform, parse_compression_config
 
         model_heads = getattr(getattr(model, "config", None), "num_heads", None)
         self._compression_transform = build_param_transform(
             self.config._param_dict, num_heads=model_heads)
+        # activation quantization is a FORWARD concern, not a param
+        # transform: push it into the model config (the transformer applies
+        # fake-quant at the post-norm attention/MLP inputs)
+        aq = [t for t in parse_compression_config(self.config._param_dict)
+              if t.kind == "activation_quantization"]
+        if aq:
+            mcfg = getattr(model, "config", None)
+            if mcfg is None or not hasattr(mcfg, "act_quant_bits"):
+                raise NotImplementedError(
+                    "activation_quantization needs a model whose config "
+                    "supports act_quant_bits (deepspeed_tpu.models.CausalLM)")
+            t = aq[0]
+            # the wiring is MODEL-WIDE (one bits value at every block's
+            # attention/MLP inputs): reject config shapes it cannot honor
+            # instead of silently approximating them
+            all_bits = {int(g.params.get("bits", 8)) for g in t.groups} or {8}
+            if len(all_bits) > 1 or any(
+                    set(g.modules) not in ({"*"}, set()) for g in t.groups):
+                raise NotImplementedError(
+                    "activation_quantization is applied model-wide: use ONE "
+                    "group with modules=['*'] and a single bits value")
+            if int(t.shared.get("schedule_offset", 0)) != 0:
+                raise NotImplementedError(
+                    "activation_quantization.schedule_offset is not "
+                    "supported (fake-quant engages from step 0)")
+            if t.shared.get("range_calibration", "dynamic") != "dynamic":
+                raise NotImplementedError(
+                    "activation_quantization static range calibration is not "
+                    "wired from the config (dynamic per-tensor only)")
+            bits = all_bits.pop()
+            sym = t.shared.get("quantization_type",
+                               "asymmetric") == "symmetric"
+            model.config = dataclasses.replace(
+                mcfg, act_quant_bits=bits, act_quant_symmetric=sym)
+            log_dist(f"activation quantization: {bits}-bit "
+                     f"{'symmetric' if sym else 'asymmetric'} at the "
+                     "attention/MLP inputs", ranks=[0])
 
         # -- lr schedule --
         if lr_scheduler is not None:

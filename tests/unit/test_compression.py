@@ -163,6 +163,41 @@ def test_engine_trains_with_qat():
     assert np.isfinite(losses).all() and losses[-1] < losses[0]
 
 
+def test_engine_wires_activation_quantization():
+    from deepspeed_tpu.models import CausalLM
+
+    model = CausalLM("tiny", max_seq_len=64)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "bf16": {"enabled": True},
+        "compression_training": {"activation_quantization": {
+            "shared_parameters": {"enabled": True,
+                                  "quantization_type": "asymmetric"},
+            "different_groups": {"g": {"params": {"bits": 8},
+                                       "modules": ["*"]}}}},
+    })
+    assert model.config.act_quant_bits == 8
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(
+        0, model.config.vocab_size,
+        (engine.train_batch_size, 16)).astype(np.int32)}
+    losses = [float(engine.train_batch(batch=dict(batch))) for _ in range(4)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+def test_activation_quantization_needs_capable_model():
+    with pytest.raises(NotImplementedError, match="act_quant_bits"):
+        deepspeed_tpu.initialize(model=SimpleModel(HID), config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+            "bf16": {"enabled": True},
+            "compression_training": {"activation_quantization": {
+                "shared_parameters": {"enabled": True},
+                "different_groups": {"g": {"params": {"bits": 8}}}}},
+        })
+
+
 # --------------------------------------------- layer reduction / cleanup --
 
 def _fake_llama_params(L=4, d=8, F=16, nh=2):
