@@ -65,7 +65,7 @@ def measure_matmul_peak() -> float:
 
 def run(model_name: str, micro_batch: int, seq_len: int, steps: int, warmup: int,
         zero_stage: int, remat_policy: str = None, remat: bool = None,
-        mu_dtype: str = None, grad_accum_dtype: str = None):
+        mu_dtype: str = None, grad_accum_dtype: str = None, gas: int = 1):
     import jax
     import jax.numpy as jnp
 
@@ -93,7 +93,7 @@ def run(model_name: str, micro_batch: int, seq_len: int, steps: int, warmup: int
         opt_params["mu_dtype"] = mu_dtype
     config = {
         "train_micro_batch_size_per_gpu": micro_batch,
-        "gradient_accumulation_steps": 1,
+        "gradient_accumulation_steps": gas,
         "optimizer": {"type": "adamw", "params": opt_params},
         "zero_optimization": {"stage": zero_stage},
         "bf16": {"enabled": True},
@@ -208,6 +208,7 @@ def main():
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--zero_stage", type=int, default=1)
+    ap.add_argument("--gas", type=int, default=1)
     ap.add_argument("--remat_policy", default=None,
                     choices=["nothing_saveable", "dots_saveable", "save_attn",
                              "save_qkv", "save_matmuls"])
@@ -238,7 +239,7 @@ def main():
                          args.zero_stage, remat_policy=args.remat_policy,
                          remat=False if args.no_remat else None,
                          mu_dtype=args.mu_dtype,
-                         grad_accum_dtype=args.grad_accum_dtype)
+                         grad_accum_dtype=args.grad_accum_dtype, gas=args.gas)
             print(json.dumps(result))
             return
         except Exception as e:  # OOM → retry smaller
