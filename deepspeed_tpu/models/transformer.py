@@ -49,9 +49,20 @@ class TransformerConfig:
     head_dim: Optional[int] = None            # None => hidden // heads
     max_seq_len: int = 2048
     norm: str = "rmsnorm"                     # rmsnorm | layernorm
-    activation: str = "swiglu"                # swiglu | gelu | relu
+    activation: str = "swiglu"                # swiglu | gelu | gelu_exact | relu
     position: str = "rope"                    # rope | learned | alibi
     rope_theta: float = 10000.0
+    # partial rotary (GPT-J/NeoX): apply rope to the first rotary_dim dims
+    rotary_dim: Optional[int] = None          # None => full head_dim
+    # GPT-J convention rotates (x0,x1),(x2,x3) pairs; llama/neox rotate the
+    # half-split (x[:half], x[half:])
+    rope_interleaved: bool = False
+    # parallel residual (GPT-J/NeoX): x + attn(norm(x)) + mlp(norm'(x));
+    # shared_layernorm (GPT-J) feeds the MLP the SAME normed activations as
+    # attention (one LN per block, no mlp_norm params)
+    parallel_residual: bool = False
+    shared_layernorm: bool = False
+    lm_head_bias: bool = False                # GPT-J ties a bias to lm_head
     norm_eps: float = 1e-5
     tie_embeddings: bool = False
     attn_bias: bool = False
@@ -106,8 +117,11 @@ class TransformerConfig:
             mlp += (2 * f if self.activation == "swiglu" else f) + d
         if self.num_experts > 1:
             mlp = mlp * self.num_experts + d * self.num_experts  # experts + router
-        norms = 2 * d * (2 if self.norm == "layernorm" else 1)
+        n_norms = 1 if self.shared_layernorm else 2
+        norms = n_norms * d * (2 if self.norm == "layernorm" else 1)
         embed = v * d * (1 if self.tie_embeddings else 2)
+        if self.lm_head_bias and not self.tie_embeddings:
+            embed += v
         pos = self.max_seq_len * d if self.position == "learned" else 0
         final_norm = d * (2 if self.norm == "layernorm" else 1)
         return L * (attn + mlp + norms) + embed + pos + final_norm
@@ -201,11 +215,13 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> Dict[str, Any]:
         "wv": dense(keys[2], (L, d, nkv * hd)),
         # residual-path projections scaled down by sqrt(2L) (GPT-2 init)
         "wo": dense(keys[3], (L, nh * hd, d), std / math.sqrt(2 * L)),
-        "mlp_norm_scale": jnp.ones((L, d)),
     }
+    if not cfg.shared_layernorm:   # GPT-J shares the attention LN
+        layers["mlp_norm_scale"] = jnp.ones((L, d))
     if cfg.norm == "layernorm":
         layers["attn_norm_bias"] = jnp.zeros((L, d))
-        layers["mlp_norm_bias"] = jnp.zeros((L, d))
+        if not cfg.shared_layernorm:
+            layers["mlp_norm_bias"] = jnp.zeros((L, d))
     E = cfg.num_experts
     mlp_shape = (lambda *s: (L, E) + s) if E > 1 else (lambda *s: (L,) + s)
     if E > 1:
@@ -242,6 +258,8 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> Dict[str, Any]:
         params["pos_embed"] = dense(keys[8], (cfg.max_seq_len, d))
     if not cfg.tie_embeddings:
         params["lm_head"] = dense(keys[9], (d, cfg.vocab_size))
+        if cfg.lm_head_bias:
+            params["lm_head_bias"] = jnp.zeros((cfg.vocab_size,))
     if cfg.pipeline_stages > 1:
         from ..runtime.pipe.spmd import stage_layer_count
 
@@ -261,12 +279,15 @@ def param_specs(cfg: TransformerConfig) -> Dict[str, Any]:
     row = P(None, "model", None)     # [L, f_shard, d]
     rep = P(None, None)
     layers: Dict[str, Any] = {
-        "attn_norm_scale": rep, "mlp_norm_scale": rep,
+        "attn_norm_scale": rep,
         "wq": col, "wk": col, "wv": col, "wo": row,
     }
+    if not cfg.shared_layernorm:
+        layers["mlp_norm_scale"] = rep
     if cfg.norm == "layernorm":
         layers["attn_norm_bias"] = rep
-        layers["mlp_norm_bias"] = rep
+        if not cfg.shared_layernorm:
+            layers["mlp_norm_bias"] = rep
     if cfg.num_experts > 1:
         # experts over the 'expert' axis, expert-internal TP over 'model'
         # (the reference's expert-parallel groups, utils/groups.py:113)
@@ -304,6 +325,8 @@ def param_specs(cfg: TransformerConfig) -> Dict[str, Any]:
         specs["pos_embed"] = P(None, None)
     if not cfg.tie_embeddings:
         specs["lm_head"] = P(None, "model")
+        if cfg.lm_head_bias:
+            specs["lm_head_bias"] = P("model")
     return specs
 
 
@@ -323,17 +346,30 @@ def _norm(cfg, x, scale, bias=None):
     return out.astype(x.dtype)
 
 
-def _rope(q, k, positions, theta, head_dim):
-    half = head_dim // 2
+def _rope(q, k, positions, theta, head_dim, rotary_dim=None,
+          interleaved=False):
+    """Rotary embedding: full or partial (``rotary_dim`` — GPT-J/NeoX), in
+    either the half-split (llama/neox) or interleaved pair (GPT-J
+    rotate_every_two) convention."""
+    rd = head_dim if rotary_dim is None else rotary_dim
+    half = rd // 2
     freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
     angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,half]
     cos, sin = jnp.cos(angles), jnp.sin(angles)
 
     def rot(x):  # x: [B,S,H,hd]
-        x1, x2 = x[..., :half], x[..., half:]
+        x_rot, x_pass = x[..., :rd], x[..., rd:]
         c = cos[:, :, None, :].astype(x.dtype)
         s = sin[:, :, None, :].astype(x.dtype)
-        return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+        if interleaved:
+            x1, x2 = x_rot[..., 0::2], x_rot[..., 1::2]
+            r1, r2 = x1 * c - x2 * s, x2 * c + x1 * s
+            out = jnp.stack([r1, r2], axis=-1).reshape(x_rot.shape)
+        else:
+            x1, x2 = x_rot[..., :half], x_rot[..., half:]
+            out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+        return out if rd == x.shape[-1] else jnp.concatenate(
+            [out, x_pass], axis=-1)
 
     return rot(q), rot(k)
 
@@ -481,7 +517,12 @@ def _mlp(cfg: TransformerConfig, lp: Dict[str, Any], h, rng, deterministic):
         m = checkpoint_name(h @ lp["w_in"], "mlp_up")
         if cfg.mlp_bias:
             m = m + lp["b_in"]
-        m = jax.nn.relu(m) if cfg.activation == "relu" else jax.nn.gelu(m)
+        if cfg.activation == "relu":
+            m = jax.nn.relu(m)
+        elif cfg.activation == "gelu_exact":   # HF 'gelu' (erf)
+            m = jax.nn.gelu(m, approximate=False)
+        else:
+            m = jax.nn.gelu(m)
         m = m @ lp["w_down"]
     if cfg.num_experts == 1 and cfg.mlp_bias:
         m = m + lp["b_down"]
@@ -504,7 +545,9 @@ def _block(cfg: TransformerConfig, lp: Dict[str, Any], x, positions, rng,
     k = k.reshape(B, S, nkv, hd)
     v = v.reshape(B, S, nkv, hd)
     if cfg.position == "rope":
-        q, k = _rope(q, k, positions, cfg.rope_theta, hd)
+        q, k = _rope(q, k, positions, cfg.rope_theta, hd,
+                     rotary_dim=cfg.rotary_dim,
+                     interleaved=cfg.rope_interleaved)
     # named so "save_matmuls" can pin the projection outputs (post-rope, so
     # the attention backward starts from exactly these tensors)
     q = checkpoint_name(q, "q_proj")
@@ -522,8 +565,20 @@ def _block(cfg: TransformerConfig, lp: Dict[str, Any], x, positions, rng,
     if cfg.dropout and not deterministic:
         rng, sub = jax.random.split(rng)
         attn = attn * jax.random.bernoulli(sub, 1 - cfg.dropout, attn.shape) / (1 - cfg.dropout)
-    x = x + attn
 
+    if cfg.parallel_residual:
+        # GPT-J/NeoX: attention and MLP both branch off x; one shared LN
+        # (GPT-J) or a second LN of the ORIGINAL x (NeoX)
+        h2 = h if cfg.shared_layernorm else _maybe_act_quant(cfg, _norm(
+            cfg, x, lp["mlp_norm_scale"], lp.get("mlp_norm_bias")))
+        rng, sub = jax.random.split(rng)
+        m, aux = _mlp(cfg, lp, h2, sub, deterministic)
+        if cfg.dropout and not deterministic:
+            rng, sub = jax.random.split(rng)
+            m = m * jax.random.bernoulli(sub, 1 - cfg.dropout, m.shape) / (1 - cfg.dropout)
+        return x + attn + m, aux
+
+    x = x + attn
     h = _norm(cfg, x, lp["mlp_norm_scale"], lp.get("mlp_norm_bias"))
     h = _maybe_act_quant(cfg, h)
     rng, sub = jax.random.split(rng)
@@ -664,6 +719,8 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
         logits = x @ params["embed"].astype(cfg.dtype).T
     else:
         logits = x @ params["lm_head"].astype(cfg.dtype)
+        if "lm_head_bias" in params:   # GPT-J ties a bias to the LM head
+            logits = logits + params["lm_head_bias"].astype(cfg.dtype)
     if return_aux:
         return logits, {"moe_aux_loss": aux_total}
     return logits
@@ -756,7 +813,9 @@ def _block_cached(cfg, lp, x, ck, cv, q_pos, q_slot, valid, kpos, next_slot,
     k = k.reshape(B, S, nkv, hd)
     v = v.reshape(B, S, nkv, hd)
     if cfg.position == "rope":
-        q, k = _rope(q, k, q_pos, cfg.rope_theta, hd)
+        q, k = _rope(q, k, q_pos, cfg.rope_theta, hd,
+                     rotary_dim=cfg.rotary_dim,
+                     interleaved=cfg.rope_interleaved)
     ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, next_slot, 0, 0))
     cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, next_slot, 0, 0))
     ck = constrain_spec(ck, P(BATCH_AXES, None, "model", None))
@@ -765,8 +824,14 @@ def _block_cached(cfg, lp, x, ck, cv, q_pos, q_slot, valid, kpos, next_slot,
     attn = attn.reshape(B, S, nh * hd) @ lp["wo"]
     if cfg.attn_bias:
         attn = attn + lp["bo"]
-    x = x + attn
 
+    if cfg.parallel_residual:
+        h2 = h if cfg.shared_layernorm else _maybe_act_quant(cfg, _norm(
+            cfg, x, lp["mlp_norm_scale"], lp.get("mlp_norm_bias")))
+        m, _ = _mlp(cfg, lp, h2, rng, deterministic=True)
+        return x + attn + m, ck, cv
+
+    x = x + attn
     h = _norm(cfg, x, lp["mlp_norm_scale"], lp.get("mlp_norm_bias"))
     h = _maybe_act_quant(cfg, h)
     m, _ = _mlp(cfg, lp, h, rng, deterministic=True)
@@ -818,6 +883,8 @@ def forward_cached(cfg: TransformerConfig, params: Dict[str, Any],
         logits = x @ params["embed"].astype(cfg.dtype).T
     else:
         logits = x @ params["lm_head"].astype(cfg.dtype)
+        if "lm_head_bias" in params:   # GPT-J ties a bias to the LM head
+            logits = logits + params["lm_head_bias"].astype(cfg.dtype)
     new_cache = {"k": ck_all, "v": cv_all, "valid": valid, "pos": kpos,
                  "next_slot": next_slot + S}
     return logits, new_cache

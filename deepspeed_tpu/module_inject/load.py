@@ -39,6 +39,16 @@ def _torch():
     return torch
 
 
+def _hf_activation(name: str) -> str:
+    """HF activation names → native: HF 'gelu' is the EXACT erf GELU;
+    'gelu_new'/'gelu_pytorch_tanh' are the tanh approximation."""
+    table = {"gelu": "gelu_exact", "gelu_new": "gelu",
+             "gelu_pytorch_tanh": "gelu", "relu": "relu"}
+    if name not in table:
+        raise NotImplementedError(f"HF activation {name!r} is not supported")
+    return table[name]
+
+
 def config_from_hf(hf_config) -> TransformerConfig:
     """Translate an HF config object/dict into a TransformerConfig."""
     get = (hf_config.get if isinstance(hf_config, dict)
@@ -56,6 +66,33 @@ def config_from_hf(hf_config) -> TransformerConfig:
             rope_theta=float(get("rope_theta", 10000.0)),
             norm_eps=float(get("rms_norm_eps", 1e-5)),
             tie_embeddings=bool(get("tie_word_embeddings", False)))
+    if arch == "gptj":
+        return TransformerConfig(
+            vocab_size=get("vocab_size"), hidden_size=get("n_embd"),
+            intermediate_size=get("n_inner") or 4 * get("n_embd"),
+            num_layers=get("n_layer"), num_heads=get("n_head"),
+            max_seq_len=get("n_positions", 2048), norm="layernorm",
+            activation="gelu", position="rope",
+            rotary_dim=get("rotary_dim") or None, rope_interleaved=True,
+            parallel_residual=True, shared_layernorm=True,
+            lm_head_bias=True, mlp_bias=True,
+            norm_eps=float(get("layer_norm_epsilon", 1e-5)))
+    if arch == "gpt_neox":
+        hd = get("hidden_size") // get("num_attention_heads")
+        return TransformerConfig(
+            vocab_size=get("vocab_size"), hidden_size=get("hidden_size"),
+            intermediate_size=get("intermediate_size"),
+            num_layers=get("num_hidden_layers"),
+            num_heads=get("num_attention_heads"),
+            max_seq_len=get("max_position_embeddings", 2048),
+            norm="layernorm",
+            activation=_hf_activation(get("hidden_act", "gelu")),
+            position="rope",
+            rotary_dim=int(hd * float(get("rotary_pct", 1.0))),
+            rope_theta=float(get("rotary_emb_base", 10000.0)),
+            parallel_residual=bool(get("use_parallel_residual", True)),
+            attn_bias=bool(get("attention_bias", True)), mlp_bias=True,
+            norm_eps=float(get("layer_norm_eps", 1e-5)))
     if arch == "gpt2":
         return TransformerConfig(
             vocab_size=get("vocab_size"), hidden_size=get("n_embd"),
@@ -83,17 +120,32 @@ def config_from_hf(hf_config) -> TransformerConfig:
             num_heads=get("num_attention_heads"),
             max_seq_len=get("max_position_embeddings", 2048),
             norm="layernorm",
-            activation={"relu": "relu", "gelu": "gelu"}[
-                get("activation_function", "relu")],
+            activation=_hf_activation(get("activation_function", "relu")),
             position="learned",
             tie_embeddings=True, attn_bias=True, mlp_bias=True)
     raise NotImplementedError(arch)
 
 
-def _split_fused_qkv(w: np.ndarray, cfg: TransformerConfig):
-    """GPT-2 style fused c_attn: last dim is [q | k | v]."""
-    d = cfg.num_heads * cfg.dims_per_head
-    dkv = cfg.kv_heads * cfg.dims_per_head
+def _split_fused_qkv(w: np.ndarray, cfg: TransformerConfig, arch: str):
+    """Split a fused qkv tensor into NATIVE-layout (..., in, out) pieces.
+
+    GPT-2 Conv1D c_attn: [d, 3d] with [q | k | v] on the last dim.
+    NeoX query_key_value: nn.Linear [3d, d] (weight) or [3d] (bias) with a
+    PER-HEAD interleave [h0_q, h0_k, h0_v, h1_q, ...] on the first dim.
+    """
+    hd, nh = cfg.dims_per_head, cfg.num_heads
+    if arch == "gpt_neox":
+        if w.ndim == 2:                       # [H*3*hd, d]
+            grouped = w.reshape(nh, 3, hd, w.shape[-1])
+            q, k, v = (np.ascontiguousarray(
+                grouped[:, i].reshape(nh * hd, -1).T) for i in range(3))
+        else:                                 # bias [H*3*hd]
+            grouped = w.reshape(nh, 3, hd)
+            q, k, v = (np.ascontiguousarray(
+                grouped[:, i].reshape(nh * hd)) for i in range(3))
+        return q, k, v
+    d = nh * hd
+    dkv = cfg.kv_heads * hd
     q, k, v = np.split(w, [d, d + dkv], axis=-1)
     return q, k, v
 
@@ -123,6 +175,11 @@ def hf_state_dict_to_params(state_dict: Dict[str, Any],
     for native, (hf_name, tf) in policy.top.items():
         if native == "lm_head" and cfg.tie_embeddings:
             continue  # HF omits the tied weight — never fetch it
+        if native == "lm_head_bias" and hf_name not in sd:
+            # optional in some exports — keep the tree consistent with
+            # cfg.lm_head_bias (param_specs/init_params contain the key)
+            params[native] = jnp.zeros((cfg.vocab_size,), host_dtype)
+            continue
         w = fetch(hf_name)
         if tf is not None:
             w = tf(w)
@@ -130,7 +187,13 @@ def hf_state_dict_to_params(state_dict: Dict[str, Any],
             w = w[policy.pos_embed_offset:]
         params[native] = jnp.asarray(w)
 
+    attn_bias_keys = ("bq", "bk", "bv", "bo")
+    mlp_bias_keys = ("b_in", "b_gate", "b_up", "b_down")
     for native, (tmpl, tf) in policy.layer.items():
+        if native in attn_bias_keys and not cfg.attn_bias:
+            continue   # e.g. NeoX attention_bias=False exports omit them
+        if native in mlp_bias_keys and not cfg.mlp_bias:
+            continue
         stack = []
         for i in range(L):
             w = fetch(tmpl.format(i=i))
@@ -140,13 +203,15 @@ def hf_state_dict_to_params(state_dict: Dict[str, Any],
     if policy.fused_qkv is not None:
         for part, names in (("weight", ("wq", "wk", "wv")),
                             ("bias", ("bq", "bk", "bv"))):
+            if part == "bias" and not cfg.attn_bias:
+                continue
             tmpl = (policy.fused_qkv if part == "weight"
                     else policy.fused_qkv_bias)
             if tmpl is None:
                 continue
             qs, ks, vs = [], [], []
             for i in range(L):
-                q, k, v = _split_fused_qkv(fetch(tmpl.format(i=i)), cfg)
+                q, k, v = _split_fused_qkv(fetch(tmpl.format(i=i)), cfg, arch)
                 qs.append(q), ks.append(k), vs.append(v)
             for name, stack in zip(names, (qs, ks, vs)):
                 params["layers"][name] = jnp.asarray(np.stack(stack))

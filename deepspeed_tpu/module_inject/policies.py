@@ -120,8 +120,62 @@ OPT = ArchPolicy(
 )
 
 
+GPTJ = ArchPolicy(
+    name="gptj",
+    top={
+        "embed": ("transformer.wte.weight", None),
+        "final_norm_scale": ("transformer.ln_f.weight", None),
+        "final_norm_bias": ("transformer.ln_f.bias", None),
+        "lm_head": ("lm_head.weight", _t),
+        "lm_head_bias": ("lm_head.bias", None),
+    },
+    layer={
+        # GPT-J: ONE LayerNorm per block (parallel residual, shared LN)
+        "attn_norm_scale": ("transformer.h.{i}.ln_1.weight", None),
+        "attn_norm_bias": ("transformer.h.{i}.ln_1.bias", None),
+        "wq": ("transformer.h.{i}.attn.q_proj.weight", _t),
+        "wk": ("transformer.h.{i}.attn.k_proj.weight", _t),
+        "wv": ("transformer.h.{i}.attn.v_proj.weight", _t),
+        "wo": ("transformer.h.{i}.attn.out_proj.weight", _t),
+        "w_in": ("transformer.h.{i}.mlp.fc_in.weight", _t),
+        "b_in": ("transformer.h.{i}.mlp.fc_in.bias", None),
+        "w_down": ("transformer.h.{i}.mlp.fc_out.weight", _t),
+        "b_down": ("transformer.h.{i}.mlp.fc_out.bias", None),
+    },
+)
+
+NEOX = ArchPolicy(
+    name="gpt_neox",
+    top={
+        "embed": ("gpt_neox.embed_in.weight", None),
+        "final_norm_scale": ("gpt_neox.final_layer_norm.weight", None),
+        "final_norm_bias": ("gpt_neox.final_layer_norm.bias", None),
+        "lm_head": ("embed_out.weight", _t),
+    },
+    layer={
+        "attn_norm_scale": ("gpt_neox.layers.{i}.input_layernorm.weight", None),
+        "attn_norm_bias": ("gpt_neox.layers.{i}.input_layernorm.bias", None),
+        "mlp_norm_scale": (
+            "gpt_neox.layers.{i}.post_attention_layernorm.weight", None),
+        "mlp_norm_bias": (
+            "gpt_neox.layers.{i}.post_attention_layernorm.bias", None),
+        "wo": ("gpt_neox.layers.{i}.attention.dense.weight", _t),
+        "bo": ("gpt_neox.layers.{i}.attention.dense.bias", None),
+        "w_in": ("gpt_neox.layers.{i}.mlp.dense_h_to_4h.weight", _t),
+        "b_in": ("gpt_neox.layers.{i}.mlp.dense_h_to_4h.bias", None),
+        "w_down": ("gpt_neox.layers.{i}.mlp.dense_4h_to_h.weight", _t),
+        "b_down": ("gpt_neox.layers.{i}.mlp.dense_4h_to_h.bias", None),
+    },
+    # NeoX fuses qkv PER HEAD: weight [H*3*hd, d] laid out
+    # [h0_q, h0_k, h0_v, h1_q, ...] — split handled arch-specifically
+    fused_qkv="gpt_neox.layers.{i}.attention.query_key_value.weight",
+    fused_qkv_bias="gpt_neox.layers.{i}.attention.query_key_value.bias",
+)
+
+
 POLICIES: Dict[str, ArchPolicy] = {"llama": LLAMA, "gpt2": GPT2, "opt": OPT,
-                                   "mistral": LLAMA}
+                                   "mistral": LLAMA, "gptj": GPTJ,
+                                   "gpt_neox": NEOX}
 
 
 def detect_arch(hf_config) -> str:

@@ -61,6 +61,43 @@ def test_gpt2_parity():
     _logit_parity(transformers.GPT2LMHeadModel(hf_cfg))
 
 
+def test_gptj_parity():
+    hf_cfg = transformers.GPTJConfig(
+        vocab_size=128, n_embd=32, n_layer=2, n_head=4, n_positions=64,
+        rotary_dim=4, attn_pdrop=0.0, embd_pdrop=0.0, resid_pdrop=0.0)
+    _logit_parity(transformers.GPTJForCausalLM(hf_cfg))
+
+
+def test_neox_parity():
+    hf_cfg = transformers.GPTNeoXConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, rotary_pct=0.5,
+        use_parallel_residual=True, attention_dropout=0.0,
+        hidden_dropout=0.0)
+    _logit_parity(transformers.GPTNeoXForCausalLM(hf_cfg))
+
+
+def test_neox_sequential_residual_parity():
+    hf_cfg = transformers.GPTNeoXConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, rotary_pct=1.0,
+        use_parallel_residual=False, attention_dropout=0.0,
+        hidden_dropout=0.0)
+    _logit_parity(transformers.GPTNeoXForCausalLM(hf_cfg))
+
+
+def test_neox_no_attention_bias_parity():
+    hf_cfg = transformers.GPTNeoXConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, rotary_pct=0.5,
+        use_parallel_residual=True, attention_bias=False,
+        attention_dropout=0.0, hidden_dropout=0.0)
+    _logit_parity(transformers.GPTNeoXForCausalLM(hf_cfg))
+
+
 def test_opt_parity():
     hf_cfg = transformers.OPTConfig(
         vocab_size=128, hidden_size=32, ffn_dim=64, num_hidden_layers=2,
