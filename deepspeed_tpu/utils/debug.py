@@ -1,0 +1,92 @@
+"""Determinism & replica-consistency debugging (SURVEY §5 aux subsystem:
+the reference ships cross-rank desync checks via ``deepspeed/utils/debug.py``
++ distributed norm checks; the TPU build plans its own).
+
+Under single-controller GSPMD one program updates all shards, so classic
+replica divergence cannot happen inside a step — the risks that remain are
+(a) HOST-side divergence in multi-controller jobs (different processes
+feeding different data/rng into what should be identical replicated state)
+and (b) silent nondeterminism across reruns.  Both reduce to fingerprinting:
+
+- :func:`checksum_tree` — stable per-leaf fingerprints of any pytree.
+- :func:`assert_replicas_consistent` — multi-controller guard: every process
+  contributes its fingerprint of process-local (addressable) replicated
+  state; mismatch across processes raises before training silently forks.
+- :func:`assert_deterministic` — rerun a function twice and require
+  bitwise-equal outputs (catches e.g. nondeterministic reductions escaping
+  into the training step).
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Callable, Dict
+
+import numpy as np
+
+import jax
+
+from .logging import log_dist
+
+
+def _leaf_fingerprint(x) -> str:
+    """Fingerprint of the PROCESS-LOCAL data: globally-sharded arrays (not
+    fully addressable) hash their addressable shards, so this never tries to
+    fetch remote shards in a multi-controller job."""
+    h = hashlib.sha256()
+    shards = getattr(x, "addressable_shards", None)
+    if shards is not None and not getattr(x, "is_fully_addressable", True):
+        for s in sorted(shards, key=lambda s: s.index):
+            arr = np.asarray(s.data)
+            h.update(str(s.index).encode())
+            h.update(arr.tobytes())
+        h.update(str(x.dtype).encode() + str(x.shape).encode())
+        return h.hexdigest()[:16]
+    arr = np.asarray(jax.device_get(x))
+    h.update(arr.tobytes() + str(arr.dtype).encode() + str(arr.shape).encode())
+    return h.hexdigest()[:16]
+
+
+def checksum_tree(tree: Any) -> Dict[str, str]:
+    """{'path': sha256-16} per leaf — a stable state fingerprint."""
+    out: Dict[str, str] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        name = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                        for p in path)
+        out[name] = _leaf_fingerprint(leaf)
+    return out
+
+
+def assert_replicas_consistent(tree: Any, name: str = "state") -> Dict[str, str]:
+    """Multi-controller desync guard: all processes must hold identical
+    fingerprints for ``tree``'s addressable data.  Single-process: a no-op
+    beyond computing the checksum.  Returns the local checksums."""
+    local = checksum_tree(tree)
+    if jax.process_count() > 1:
+        from ..comm.comm import broadcast_object
+
+        # broadcast numerically: multihost broadcast handles array pytrees,
+        # not strings — each 16-hex fingerprint IS a uint64
+        keys = sorted(local)
+        digest = np.asarray([int(local[k], 16) for k in keys], np.uint64)
+        reference = np.asarray(broadcast_object(digest, src_process=0))
+        diverged = [k for k, a, b in zip(keys, digest, reference) if a != b]
+        if diverged:
+            raise RuntimeError(
+                f"replica divergence in {name} on process "
+                f"{jax.process_index()}: {len(diverged)} leaves differ from "
+                f"process 0 (first: {diverged[:5]})")
+    log_dist(f"{name}: {len(local)} leaves consistent", ranks=[0])
+    return local
+
+
+def assert_deterministic(fn: Callable, *args, what: str = "fn") -> Any:
+    """Run ``fn`` twice with identical inputs; raise unless outputs are
+    bitwise equal.  Returns the (first) output."""
+    out1, out2 = fn(*args), fn(*args)
+    c1, c2 = checksum_tree(out1), checksum_tree(out2)
+    diff = sorted(k for k in c1 if c1[k] != c2.get(k))
+    if diff:
+        raise RuntimeError(
+            f"{what} is nondeterministic: {len(diff)} output leaves changed "
+            f"between identical calls (first: {diff[:5]})")
+    return out1
