@@ -12,6 +12,7 @@ __version__ = "0.1.0"
 __git_branch__ = "main"
 
 from . import comm  # noqa: E402
+from . import zero  # noqa: E402  (reference zero.Init / GatheredParameters)
 from .comm import init_distributed  # noqa: E402  (reference re-export)
 from .accelerator import get_accelerator  # noqa: E402
 from .runtime.config import DeepSpeedConfig  # noqa: E402

@@ -1,0 +1,94 @@
+"""``deepspeed_tpu.zero`` — user-facing ZeRO API parity.
+
+Reference surface (``deepspeed/zero``): ``zero.Init`` (construct a model with
+params partitioned at birth, ``partition_parameters.py:681``) and
+``zero.GatheredParameters`` (temporarily materialize full params inside the
+context, ``:1894``).
+
+On TPU both are fundamentally simpler:
+
+- params are ALWAYS born sharded — the engine jits ``init_fn`` with sharded
+  ``out_shardings`` (engine.py), so no ``__init__`` patching is needed.
+  ``Init`` therefore exists as an (honest) no-op context manager that keeps
+  reference training scripts running unchanged.
+- a jax.Array is logically global no matter how it is sharded; "gathering"
+  means fetching the full value to host or re-placing it replicated.
+  ``GatheredParameters`` yields the full values (host numpy by default —
+  safe for models bigger than one chip's HBM) without mutating the training
+  state, and writes nothing back (modifier_rank semantics are not supported:
+  mutate the functional state explicitly instead).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Iterable, Optional
+
+import numpy as np
+
+import jax
+
+from .utils.logging import logger
+
+
+@contextlib.contextmanager
+def Init(module=None, data_parallel_group=None, mem_efficient_linear=True,
+         remote_device=None, pin_memory=False, config_dict_or_path=None,
+         config=None, enabled=True, dtype=None, mpu=None):
+    """Reference ``zero.Init`` context (partition_parameters.py:681).
+
+    Accepted for script parity; sharded-at-birth initialization is the
+    engine's default behavior on TPU (params come out of ``jit(init_fn,
+    out_shardings=plan)`` already partitioned), so there is nothing to
+    enable here.  All arguments are accepted and ignored.
+    """
+    if enabled:
+        logger.info("zero.Init: params are born sharded on TPU — context "
+                    "accepted for parity, nothing to do")
+    yield
+
+
+class GatheredParameters:
+    """Materialize full parameter values inside a context (reference
+    partition_parameters.py:1894).
+
+    ``params`` is a pytree (or list of arrays).  Inside the context,
+    ``.values`` holds the full (unsharded) data — host numpy arrays by
+    default, or device-replicated jax arrays with ``to_device=True``.
+    Unlike the reference, exiting the context never writes back
+    (``modifier_rank`` is rejected): functional state is updated by
+    returning new params, not by mutation.
+    """
+
+    def __init__(self, params: Any, modifier_rank: Optional[int] = None,
+                 fwd_module=None, enabled: bool = True,
+                 to_device: bool = False):
+        if modifier_rank is not None:
+            raise NotImplementedError(
+                "GatheredParameters(modifier_rank=...) write-back is not "
+                "supported: update the functional param tree explicitly")
+        self._params = params
+        self._enabled = enabled
+        self._to_device = to_device
+        self.values: Any = None
+
+    def __enter__(self):
+        if not self._enabled:
+            self.values = self._params
+            return self
+        if self._to_device:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from .parallel.mesh import get_mesh
+
+            mesh = get_mesh()
+            rep = NamedSharding(mesh, P())
+            self.values = jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, rep), self._params)
+        else:
+            self.values = jax.tree_util.tree_map(
+                lambda x: np.asarray(jax.device_get(x)), self._params)
+        return self
+
+    def __exit__(self, *exc):
+        self.values = None
+        return False
