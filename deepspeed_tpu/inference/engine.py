@@ -104,7 +104,8 @@ class InferenceEngine:
             b *= 2
         return b
 
-    def _generate_program(self, model, B, S_pad, max_new, greedy):
+    def _generate_program(self, model, B, S_pad, max_new, greedy,
+                          top_k=0, top_p=1.0):
         cfg = model.config
 
         def prog(params, tokens, input_mask, positions, rng, eos_id, temperature):
@@ -118,9 +119,29 @@ class InferenceEngine:
             def sample(lg, key):
                 if greedy:
                     return jnp.argmax(lg, axis=-1).astype(jnp.int32)
-                return jax.random.categorical(
-                    key, lg.astype(jnp.float32) / temperature, axis=-1
-                ).astype(jnp.int32)
+                lg = lg.astype(jnp.float32) / temperature
+                if top_p < 1.0:
+                    # ONE descending sort serves both filters (a per-token
+                    # full-vocab sort inside the decode scan is the cost)
+                    sorted_lg = jnp.sort(lg, axis=-1)[:, ::-1]
+                    if top_k:
+                        kth = sorted_lg[:, top_k - 1][:, None]
+                        lg = jnp.where(lg < kth, -jnp.inf, lg)
+                        sorted_lg = jnp.where(
+                            jnp.arange(sorted_lg.shape[-1])[None] < top_k,
+                            sorted_lg, -jnp.inf)
+                    probs = jax.nn.softmax(sorted_lg, axis=-1)
+                    cum = jnp.cumsum(probs, axis=-1)
+                    # keep the smallest prefix with mass >= top_p
+                    cutoff_idx = jnp.sum(cum < top_p, axis=-1)      # [B]
+                    cutoff = jnp.take_along_axis(
+                        sorted_lg, cutoff_idx[:, None], axis=-1)    # [B,1]
+                    lg = jnp.where(lg < cutoff, -jnp.inf, lg)
+                elif top_k:
+                    # partial selection, no full sort
+                    kth = jax.lax.top_k(lg, top_k)[0][:, -1][:, None]
+                    lg = jnp.where(lg < kth, -jnp.inf, lg)
+                return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
 
             def step(carry, _):
                 cache, lg, pos, done, key = carry
@@ -141,6 +162,7 @@ class InferenceEngine:
 
     def generate(self, input_ids, max_new_tokens: int = 32, eos_token_id: Optional[int] = None,
                  greedy: bool = True, rng: Optional[jax.Array] = None, temperature: float = 1.0,
+                 top_k: int = 0, top_p: float = 1.0,
                  attention_mask=None, model=None, params=None):
         """KV-cached autoregressive generation under jit.
 
@@ -156,6 +178,11 @@ class InferenceEngine:
                     "attention_mask requires a KV-cache-capable model "
                     "(apply_cached); the full-recompute fallback would "
                     "silently attend to pad tokens")
+            if top_k or top_p < 1.0:
+                raise NotImplementedError(
+                    "top_k/top_p require a KV-cache-capable model "
+                    "(apply_cached); the fallback would silently sample the "
+                    "full distribution")
             return self._generate_uncached(input_ids, max_new_tokens, eos_token_id,
                                            greedy, rng, temperature, params=params)
         ids = np.asarray(input_ids)
@@ -172,10 +199,11 @@ class InferenceEngine:
         # positions: cumulative index of real tokens (pads repeat the last)
         pos = np.maximum(np.cumsum(mpad, axis=1) - 1, 0).astype(np.int32)
 
-        key = (id(model), B, S_pad, max_new_tokens, greedy)
+        key = (id(model), B, S_pad, max_new_tokens, greedy, top_k, top_p)
         if key not in self._gen_cache:
             self._gen_cache[key] = self._generate_program(
-                model, B, S_pad, max_new_tokens, greedy)
+                model, B, S_pad, max_new_tokens, greedy,
+                top_k=top_k, top_p=top_p)
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         eos = jnp.int32(-1 if eos_token_id is None else eos_token_id)
         new = self._gen_cache[key](
