@@ -444,6 +444,9 @@ class DeepSpeedConfig:
                      "synchronize_checkpoint_boundary", "profile"):
             if getattr(ac, knob):
                 bad.append(f"activation_checkpointing.{knob}")
+        if ac.number_checkpoints is not None:
+            bad.append("activation_checkpointing.number_checkpoints "
+                       "(contiguous-buffer partitioning)")
 
         if bad:
             raise NotImplementedError(

@@ -211,6 +211,14 @@ class DeepSpeedEngine:
                     f"pipeline microbatches ({micro}) must equal "
                     f"gradient_accumulation_steps ({self.gas})")
 
+        if self.config.activation_checkpointing.partition_activations:
+            # satisfied structurally: saved remat residuals carry the model's
+            # sharding constraints, so GSPMD already partitions them over the
+            # model/seq axes (the Megatron partition_activations behavior)
+            log_dist("activation_checkpointing.partition_activations: saved "
+                     "residuals follow the activation shardings (structural "
+                     "under GSPMD)", ranks=[0])
+
         # -- compression (QAT / pruning transform on the compute tree) --
         from ..compression import build_param_transform, parse_compression_config
 

@@ -119,6 +119,7 @@ def test_no_knob_is_silently_inert():
                                "offload_optimizer": {"device": "nvme"}}},
         {"activation_checkpointing": {"cpu_checkpointing": True}},
         {"activation_checkpointing": {"profile": True}},
+        {"activation_checkpointing": {"number_checkpoints": 4}},
     ]
     for setting in inert_settings:
         with pytest.raises(NotImplementedError):
