@@ -23,12 +23,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# 1024-blocks win on v5e for hd=128-class shapes (measured, best-of-3, causal
-# B8 H14 S2048: fwd 9.75→5.22ms, fwd+bwd 24.7→14.8ms vs 256-blocks): larger
-# tiles amortize the VPU softmax and block-boundary overhead even though the
-# causal skip gets coarser.  _pick_block shrinks them for short sequences.
+# Measured on v5e for hd=128-class shapes (best-of-3, causal B8 H14 S2048):
+# 1024-tiles beat 256 by 1.9x fwd / 1.7x bwd; the FORWARD gains another ~25%
+# with a full-row K block (bk=2048: the online-softmax carry disappears),
+# while backward is fastest at 1024 — so fwd defaults to bk=2048 and the
+# wrapper caps the bwd tiles at 1024.  _pick_block shrinks for short S.
 DEFAULT_BLOCK_Q = 1024
-DEFAULT_BLOCK_K = 1024
+DEFAULT_BLOCK_K = 2048
 NEG_INF = -1e30
 
 # The first three grid axes are independent in every kernel here; only the
@@ -370,15 +371,18 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, res, g,
 # attn_lse lets the backward run WITHOUT re-executing the forward kernel
 # (with out/lse hidden inside the vjp, remat must re-run the S² forward to
 # regenerate residuals no matter what the policy saves).
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+# Forward and backward take SEPARATE tile sizes: the fwd prefers a full-row K
+# block (no online-softmax carry — measured ~25% faster at S=2048), while the
+# bwd kernels are fastest (and compile reliably) at 1024.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
 def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret,
-           block_mask=None):
+           bwd_block_q, bwd_block_k, block_mask=None):
     return _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
                 block_mask)
 
 
 def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
-               block_mask=None):
+               bwd_block_q, bwd_block_k, block_mask=None):
     from jax.ad_checkpoint import checkpoint_name
 
     out, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
@@ -389,11 +393,11 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
     return (out, lse), (q, k, v, out, lse)
 
 
-def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, block_mask,
-               res, g):
+def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, bwd_block_q,
+               bwd_block_k, block_mask, res, g):
     do, _ = g  # lse is consumed only by checkpoint_name: zero cotangent
-    return _bwd(sm_scale, causal, block_q, block_k, interpret, res, do,
-                block_mask)
+    return _bwd(sm_scale, causal, bwd_block_q, bwd_block_k, interpret, res,
+                do, block_mask)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -402,6 +406,8 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = None,
                     bias=None, block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
+                    bwd_block_q: Optional[int] = None,
+                    bwd_block_k: Optional[int] = None,
                     interpret: Optional[bool] = None,
                     block_mask=None):
     """q [B,S,Hq,hd], k/v [B,S,Hkv,hd] -> [B,S,Hq,hd].
@@ -410,17 +416,22 @@ def flash_attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = No
     ``block_mask`` (optional bool [S/block_q, S/block_k]) skips dead blocks in
     forward AND backward — the block-sparse attention path
     (ops/sparse_attention builds the patterns).
+    Backward tiles default to min(fwd tile, 1024): the fwd wins with a
+    full-row K block while the bwd kernels prefer (and compile reliably at)
+    1024.  A ``block_mask`` forces bwd tiles == fwd tiles (the mask grid must
+    match every kernel).
     """
     if bias is not None:
         raise NotImplementedError("bias is handled by the XLA attention path")
     S = q.shape[1]
-    block_q = _pick_block(S, block_q)
-    block_k = _pick_block(S, block_k)
-    if sm_scale is None:
-        sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    if interpret is None:
-        interpret = _interpret_default()
     if block_mask is not None:
+        # masked path: ONE tile size for every kernel (the mask grid must
+        # match fwd, dq, and dkv), capped at 1024 — the bwd kernels do not
+        # compile reliably above that, so the fwd's full-row preference is
+        # forfeited here rather than handed to the backward
+        block_q = _pick_block(S, min(block_q, 1024))
+        block_k = _pick_block(S, min(block_k, 1024))
+        bwd_block_q, bwd_block_k = block_q, block_k
         import numpy as _np
 
         bm = _np.asarray(block_mask)
@@ -431,8 +442,17 @@ def flash_attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = No
                 f"{want} (S={S}, block_q={block_q}, block_k={block_k})")
         # hashable static arg for the custom_vjp/jit caches
         block_mask = tuple(tuple(int(x) for x in row) for row in bm)
+    else:
+        block_q = _pick_block(S, block_q)
+        block_k = _pick_block(S, block_k)
+        bwd_block_q = _pick_block(S, bwd_block_q or min(block_q, 1024))
+        bwd_block_k = _pick_block(S, bwd_block_k or min(block_k, 1024))
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = _interpret_default()
     # [B,S,H,hd] -> [B,H,S,hd]
     qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
     out, _ = _flash(qt, kt, vt, sm_scale, causal, block_q, block_k, interpret,
-                    block_mask)
+                    bwd_block_q, bwd_block_k, block_mask)
     return jnp.swapaxes(out, 1, 2)
