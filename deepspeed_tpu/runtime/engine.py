@@ -1201,6 +1201,10 @@ class DeepSpeedEngine:
         if self.fp16_enabled:
             events.append(("Train/Samples/loss_scale",
                            float(metrics["loss_scale"]), self.global_steps))
+        if self.progressive_layer_drop is not None:
+            events.append(("Train/Samples/pld_theta",
+                           self.progressive_layer_drop.get_theta(),
+                           self.global_steps))
         self.monitor.write_events(events)
 
     def _report_progress(self, metrics):
