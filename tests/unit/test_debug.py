@@ -76,3 +76,14 @@ def test_train_step_is_deterministic():
 def test_replica_consistency_single_process():
     out = assert_replicas_consistent({"w": jnp.ones((4,))}, name="test")
     assert out == checksum_tree({"w": jnp.ones((4,))})
+
+
+def test_see_memory_usage():
+    from deepspeed_tpu.utils import memory_status, see_memory_usage
+
+    assert see_memory_usage("quiet") is None          # gated like the reference
+    out = see_memory_usage("loud", force=True)
+    assert out is not None and out["host_peak_rss_gb"] > 0
+    st = memory_status("step")
+    assert st is not None and st["host_peak_rss_gb"] > 0
+    assert memory_status("other rank", print_rank=7) is None
