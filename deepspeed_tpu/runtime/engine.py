@@ -92,6 +92,32 @@ def make_grad_accumulator(grad_of_batch, gas: int, accum_dtype=None):
     return run
 
 
+def _xla_options() -> Optional[Dict[str, str]]:
+    """Extra XLA compiler options for the train/eval step jits.
+
+    ``DS_TPU_XLA_OPTIONS="k=v,k2=v2"`` — escape hatch for per-job compiler
+    tuning (e.g. scheduler or fusion knobs) without code changes; the
+    reference exposes the same class of knob via op-builder build flags.
+    """
+    raw = os.environ.get("DS_TPU_XLA_OPTIONS", "").strip()
+    if not raw:
+        return None
+    opts = {}
+    for item in raw.split(","):
+        if "=" in item:
+            k, v = item.split("=", 1)
+            opts[k.strip()] = v.strip()
+    return opts or None
+
+
+def _jit_step(fn, **kw):
+    """jax.jit wrapper applying the DS_TPU_XLA_OPTIONS passthrough."""
+    opts = _xla_options()
+    if opts:
+        kw["compiler_options"] = opts
+    return jax.jit(fn, **kw)
+
+
 def _cast_tree(tree, dtype):
     return jax.tree_util.tree_map(
         lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
@@ -742,7 +768,7 @@ class DeepSpeedEngine:
                 grads = jax.tree_util.tree_map(lambda g: g * factor, grads)
             return grads, jnp.mean(losses), gnorm, new_rng
 
-        return jax.jit(grad_step)
+        return _jit_step(grad_step)
 
     def _train_batch_nvme(self, global_batch):
         """device grads -> host NVMe Adam -> bf16 params back to device."""
@@ -821,12 +847,18 @@ class DeepSpeedEngine:
                 return new_state, metrics
 
             if self._train_out_shardings is not None:
-                return jax.jit(train_step, donate_argnums=(0,),
-                               out_shardings=self._train_out_shardings)
-            return jax.jit(train_step, donate_argnums=(0,))
+                return _jit_step(train_step, donate_argnums=(0,),
+                                 out_shardings=self._train_out_shardings)
+            return _jit_step(train_step, donate_argnums=(0,))
 
         accumulate = make_grad_accumulator(grad_of_batch, gas,
                                            self.config.data_types.jnp_dtype())
+
+        # landing dtype for the per-step gradients (config
+        # data_types.grad_accum_dtype, reference runtime/config.py:867):
+        # fp32 by default; bf16 halves the live grad buffer also in the
+        # gas=1 / pipeline fast paths, not just the accumulation scan
+        accum_dtype = self.config.data_types.jnp_dtype() or jnp.float32
 
         def train_step(state: TrainState, batch):
             masters, opt_in = stream_in(state)
@@ -842,7 +874,7 @@ class DeepSpeedEngine:
                 new_rng, sub = jax.random.split(state.rng)
                 grads, losses = grad_of_batch(work, state.scaler, flat, sub)
                 grads = jax.tree_util.tree_map(
-                    lambda g: g.astype(jnp.float32), grads)
+                    lambda g: g.astype(accum_dtype), grads)
                 eff_gas = 1  # loss already averages over the gas window
             elif gas == 1:
                 # no accumulation window: skip the scan and the fp32 zero
@@ -852,7 +884,7 @@ class DeepSpeedEngine:
                     work, state.scaler,
                     jax.tree_util.tree_map(lambda x: x[0], batch), sub)
                 grads = jax.tree_util.tree_map(
-                    lambda g: g.astype(jnp.float32), grads)
+                    lambda g: g.astype(accum_dtype), grads)
                 eff_gas = 1
             else:
                 grads, losses, new_rng = accumulate(work, state.scaler, batch,
@@ -867,9 +899,9 @@ class DeepSpeedEngine:
             return new_state, metrics
 
         if self._train_out_shardings is not None:
-            return jax.jit(train_step, donate_argnums=(0,),
-                           out_shardings=self._train_out_shardings)
-        return jax.jit(train_step, donate_argnums=(0,))
+            return _jit_step(train_step, donate_argnums=(0,),
+                             out_shardings=self._train_out_shardings)
+        return _jit_step(train_step, donate_argnums=(0,))
 
     def _make_eval_step(self):
         eval_fn = self._eval_fn
@@ -885,7 +917,7 @@ class DeepSpeedEngine:
             loss, aux = out if isinstance(out, tuple) else (out, {})
             return loss, aux
 
-        return jax.jit(eval_step)
+        return _jit_step(eval_step)
 
     # ------------------------------------------------------------------
     # Public API (reference engine.forward/backward/step + train_batch)

@@ -34,6 +34,62 @@ SUPPORTED = [ADAM_OPTIMIZER, ADAMW_OPTIMIZER, LAMB_OPTIMIZER, ONEBIT_ADAM_OPTIMI
              ADAGRAD_OPTIMIZER, LION_OPTIMIZER, RMSPROP_OPTIMIZER]
 
 
+def _scale_by_adam_ds(b1: float, b2: float, eps: float,
+                      mu_dtype=None, nu_dtype=None) -> optax.GradientTransformation:
+    """Adam moment update with independently storable m/nu dtypes.
+
+    optax.scale_by_adam only exposes ``mu_dtype``; the second moment always
+    lands in the parameter dtype (fp32 masters ⇒ 4 bytes/param).  Storing nu
+    in bf16 halves that buffer — on a 16G chip that is the difference between
+    fitting a ~740M-param Adam run with saved-activation remat or not.  The
+    moment math itself stays fp32 (bf16 is only the at-rest format), matching
+    the reference's memory-lean optimizer-state options
+    (reference runtime/bf16_optimizer.py's fp32-master + low-precision-state
+    split; ZeroOneAdam/1-bit state compression is the extreme of the same idea).
+
+    Numerics caveat for ``nu_dtype=bfloat16``: with b2=0.999 the per-step nu
+    increment is ~0.001·(g²−nu), below bf16's half-ulp (~0.002·nu) once nu
+    approaches steady state — late-training nu can freeze at a stale value,
+    inflating the denominator as gradients decay.  Treat bf16 nu as a
+    memory-pressure escape hatch (or lower b2), not a free win; mu (b1=0.9,
+    increments ~0.1·|g−mu|) is far less affected.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def init(params):
+        mu = jax.tree_util.tree_map(
+            lambda p: jnp.zeros_like(p, dtype=mu_dtype or p.dtype), params)
+        nu = jax.tree_util.tree_map(
+            lambda p: jnp.zeros_like(p, dtype=nu_dtype or p.dtype), params)
+        return optax.ScaleByAdamState(count=jnp.zeros([], jnp.int32), mu=mu, nu=nu)
+
+    def update(updates, state, params=None):
+        del params
+        count = state.count + 1
+        cf = count.astype(jnp.float32)
+        bc1 = 1.0 - b1 ** cf
+        bc2 = 1.0 - b2 ** cf
+
+        def upd(g, m, n):
+            g32 = g.astype(jnp.float32)
+            m32 = b1 * m.astype(jnp.float32) + (1.0 - b1) * g32
+            n32 = b2 * n.astype(jnp.float32) + (1.0 - b2) * jnp.square(g32)
+            out = (m32 / bc1) / (jnp.sqrt(n32 / bc2) + eps)
+            return (out, m32.astype(m.dtype), n32.astype(n.dtype))
+
+        flat = jax.tree_util.tree_map(upd, updates, state.mu, state.nu)
+        outs = jax.tree_util.tree_map(lambda t: t[0], flat,
+                                      is_leaf=lambda t: isinstance(t, tuple))
+        mus = jax.tree_util.tree_map(lambda t: t[1], flat,
+                                     is_leaf=lambda t: isinstance(t, tuple))
+        nus = jax.tree_util.tree_map(lambda t: t[2], flat,
+                                     is_leaf=lambda t: isinstance(t, tuple))
+        return outs, optax.ScaleByAdamState(count=count, mu=mus, nu=nus)
+
+    return optax.GradientTransformation(init, update)
+
+
 def _base_transform(name: str, params: Dict[str, Any]) -> optax.GradientTransformation:
     name = name.lower()
     betas = params.get("betas", (0.9, 0.999))
@@ -41,13 +97,20 @@ def _base_transform(name: str, params: Dict[str, Any]) -> optax.GradientTransfor
     eps = params.get("eps", 1e-8)
     weight_decay = params.get("weight_decay", 0.0)
 
-    # first-moment storage dtype (optax mu_dtype): "bfloat16" halves Adam's m
-    # buffer — the reference's memory-lean optimizer-state options analogue
+    # moment storage dtypes: "bfloat16" halves Adam's m (mu_dtype) and/or v
+    # (nu_dtype) buffers — the reference's memory-lean optimizer-state options
     mu_dtype = params.get("mu_dtype")
+    nu_dtype = params.get("nu_dtype")
+
+    def _adam_core():
+        if nu_dtype is not None:
+            return _scale_by_adam_ds(b1, b2, eps, mu_dtype=mu_dtype,
+                                     nu_dtype=nu_dtype)
+        return optax.scale_by_adam(b1=b1, b2=b2, eps=eps, mu_dtype=mu_dtype)
 
     if name in (ADAM_OPTIMIZER, ADAMW_OPTIMIZER):
         adam_w_mode = params.get("adam_w_mode", name == ADAMW_OPTIMIZER)
-        chain = [optax.scale_by_adam(b1=b1, b2=b2, eps=eps, mu_dtype=mu_dtype)]
+        chain = [_adam_core()]
         if weight_decay:
             if adam_w_mode:
                 chain.append(optax.add_decayed_weights(weight_decay))
@@ -63,7 +126,7 @@ def _base_transform(name: str, params: Dict[str, Any]) -> optax.GradientTransfor
         return _base_transform(ADAM_OPTIMIZER, params)
     if name in (LAMB_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER):
         return optax.chain(
-            optax.scale_by_adam(b1=b1, b2=b2, eps=eps, mu_dtype=mu_dtype),
+            _adam_core(),
             optax.add_decayed_weights(weight_decay) if weight_decay else optax.identity(),
             optax.scale_by_trust_ratio(),
         )
