@@ -18,6 +18,7 @@ from .accelerator import get_accelerator  # noqa: E402
 from .runtime.config import DeepSpeedConfig  # noqa: E402
 from .runtime.engine import DeepSpeedEngine  # noqa: E402
 from .parallel import MeshLayout, initialize_mesh, get_mesh  # noqa: E402
+from .utils.init_on_device import OnDevice  # noqa: E402  (reference utils/init_on_device.py)
 
 
 def initialize(args=None, model: Any = None, optimizer=None, model_parameters=None,

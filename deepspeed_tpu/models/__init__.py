@@ -52,7 +52,9 @@ class CausalLM:
         return model, params
 
     def init_fn(self, rng):
-        return init_params(self.config, rng)
+        from ..utils.init_on_device import on_device_init
+
+        return on_device_init(lambda r: init_params(self.config, r))(rng)
 
     def _split(self, batch):
         pld_theta = None

@@ -3,14 +3,29 @@
 The reference lazily builds per-stage torch modules from ``LayerSpec`` lists
 and partitions layers across stages by parameter count or uniformly
 (module.py: "parameters"/"uniform" balancing).  The TPU analogue keeps the
-same authoring surface — a list of layer thunks + a partitioner — but the
-product is a *stacked-parameter pytree* plus stage boundaries for the SPMD
-executor (spmd.py), not live modules.
+same authoring surface — a list of layer thunks + a partitioner — and is a
+full *model* the engine can train (``deepspeed_tpu.initialize(model=pm)``):
+
+  - layer contract: ``spec.build()`` returns an object with
+    ``init(rng) -> params`` and ``apply(params, x) -> x`` (or
+    ``(x, aux)``); bare callables with no ``init`` are parameterless.
+  - ``num_stages == 1``: layers compose sequentially under one jit —
+    heterogeneous structures, tied weights, everything goes.
+  - ``num_stages > 1``: executes on the SPMD shifted-buffer scan
+    (spmd.pipeline_apply) over the mesh's 'pipe' axis.  SPMD pipelining
+    vmaps ONE stage program over all stages, so the stages must be
+    structurally identical (same layer count, same param treedef/shapes) —
+    the partitioner checks this and says so.  Embedding/head-style
+    first/last asymmetry belongs outside the pipelined body (the
+    transformer family does exactly that: models/transformer.py embeds
+    before ``pipeline_apply`` and projects after).
 """
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+import jax
 import numpy as np
 
 
@@ -80,11 +95,17 @@ class PipelineModule:
 
     def __init__(self, layers: Sequence[LayerSpec], num_stages: int,
                  partition_method: str = "parameters",
-                 loss_fn: Optional[Callable] = None):
+                 loss_fn: Optional[Callable] = None,
+                 microbatches: Optional[int] = None):
         self.layer_specs = list(layers)
         self.num_stages = num_stages
         self.partition_method = partition_method
-        self.loss_fn = loss_fn
+        # the training loss head, loss_fn(outputs, batch) -> scalar.  (Named
+        # loss_fn in the ctor for reference parity — PipelineModule(…,
+        # loss_fn=…) — but stored apart from the engine-facing
+        # ``self.loss_fn`` method, which wraps it with the pipeline run.)
+        self.loss_head = loss_fn
+        self._microbatches = microbatches
         self.parts = self._partition()
 
     def _layer_weights(self) -> List[float]:
@@ -118,3 +139,187 @@ class PipelineModule:
             if isinstance(spec, TiedLayerSpec):
                 tied.setdefault(spec.key, []).append(i)
         return tied
+
+    # ------------------------------------------------------------------
+    # Execution path: the engine model contract (init_fn / loss_fn /
+    # param_specs / config), reference PipelineEngine.train_batch
+    # (runtime/pipe/engine.py:286) collapsed onto the SPMD executor.
+    # ------------------------------------------------------------------
+
+    @property
+    def config(self):
+        micro = self._microbatches or self.num_stages
+        return _PipeModuleConfig(pipeline_stages=self.num_stages,
+                                 pipeline_microbatches=micro)
+
+    def _built(self) -> List[Any]:
+        if not hasattr(self, "_built_layers"):
+            self._built_layers = [
+                spec.build() if isinstance(spec, LayerSpec) else spec
+                for spec in self.layer_specs]
+        return self._built_layers
+
+    def _uniform_stage_shape(self, inits) -> None:
+        """num_stages>1 precondition: every stage identical in structure."""
+        import jax
+
+        counts = {self.parts[s + 1] - self.parts[s]
+                  for s in range(self.num_stages)}
+        if self.tied_keys() and self.num_stages > 1:
+            raise ValueError(
+                "TiedLayerSpec is not supported on the SPMD pipeline path: "
+                "one stage program is vmapped over all stages, so "
+                "cross-stage parameter sharing has no home.  Keep tied "
+                "embeddings/heads outside the pipelined body (see "
+                "models/transformer.py) or use num_stages=1.")
+        if len(counts) != 1:
+            raise ValueError(
+                f"SPMD pipelining needs structurally identical stages; "
+                f"partition {self.parts} gives unequal layer counts "
+                f"{sorted(counts)}.  Use partition_method='uniform' with a "
+                f"layer count divisible by num_stages.")
+        lp = counts.pop()
+        ref = inits[:lp]
+        for s in range(1, self.num_stages):
+            seg = inits[s * lp:(s + 1) * lp]
+            same = (jax.tree_util.tree_structure(seg)
+                    == jax.tree_util.tree_structure(ref)) and all(
+                a.shape == b.shape and a.dtype == b.dtype
+                for a, b in zip(jax.tree_util.tree_leaves(seg),
+                                jax.tree_util.tree_leaves(ref)))
+            if not same:
+                raise ValueError(
+                    f"SPMD pipelining needs structurally identical stages; "
+                    f"stage {s} differs from stage 0 in param "
+                    f"treedef/shapes.")
+
+    def init_fn(self, rng):
+        from ...utils.init_on_device import on_device_init
+
+        return on_device_init(self._init_impl)(rng)
+
+    def _init_impl(self, rng):
+        import jax
+        import jax.numpy as jnp
+
+        layers = self._built()
+        keys = jax.random.split(rng, len(layers))
+        tied_params: Dict[str, Any] = {}
+        inits: List[Any] = []
+        for i, (spec, layer, k) in enumerate(
+                zip(self.layer_specs, layers, keys)):
+            if isinstance(spec, TiedLayerSpec):
+                if spec.key not in tied_params:
+                    tied_params[spec.key] = layer.init(k)
+                inits.append(_TiedRef(spec.key))
+            elif hasattr(layer, "init"):
+                inits.append(layer.init(k))
+            else:
+                inits.append({})                  # parameterless callable
+        if self.num_stages > 1:
+            self._uniform_stage_shape(inits)
+            lp = len(layers) // self.num_stages
+            # stack per-stage trees leaf-wise: [P, ...] rides the 'pipe' axis
+            per_stage = [
+                jax.tree_util.tree_map(
+                    lambda *xs: jnp.stack(xs),
+                    *[_stage_tree(inits[s * lp + j]) for s in range(self.num_stages)])
+                for j in range(lp)]
+            return {"stages": per_stage}
+        return {"layers": inits, "tied": tied_params}
+
+    @property
+    def param_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        if self.num_stages <= 1:
+            return None                           # planner default (replicated)
+        shapes = jax.eval_shape(self._init_impl, jax.random.PRNGKey(0))
+        # stage dim of every stacked leaf rides 'pipe'; inner dims replicated
+        return jax.tree_util.tree_map(
+            lambda x: P(*("pipe",) + (None,) * (x.ndim - 1)), shapes)
+
+    def loss_fn(self, params, batch, rng=None):
+        import jax
+        import jax.numpy as jnp
+
+        if self.loss_head is None:
+            raise ValueError("PipelineModule needs loss_fn=(outputs, batch) "
+                             "-> scalar to train")
+        layers = self._built()
+        x = batch["inputs"] if isinstance(batch, dict) else batch
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        aux_total = jnp.float32(0.0)
+        if self.num_stages > 1:
+            from .spmd import pipeline_apply
+
+            M = self._microbatches or self.num_stages
+            B = x.shape[0]
+            if B % M:
+                raise ValueError(
+                    f"batch {B} not divisible by {M} pipeline microbatches")
+            lp = len(layers) // self.num_stages
+            stage_layers = layers[:lp]            # stages are uniform
+
+            def stage_fn(lp_params, xs, srng):
+                # layers are applied deterministically (the layer contract
+                # carries no rng); srng is pipeline_apply plumbing only
+                del srng
+                aux = jnp.float32(0.0)
+                for j, layer in enumerate(stage_layers):
+                    xs, a = _apply(layer, lp_params[j], xs)
+                    aux = aux + a
+                return xs, aux
+
+            xm = x.reshape((M, B // M) + x.shape[1:])
+            y, aux_sum = pipeline_apply(stage_fn, params["stages"], xm, rng)
+            out = y.reshape((B,) + y.shape[2:])
+            aux_total = aux_sum / M
+        else:
+            tied = params.get("tied", {})
+            out = x
+            for spec, layer, p in zip(self.layer_specs, layers,
+                                      params["layers"]):
+                if isinstance(p, _TiedRef):
+                    p = tied[p.key]
+                    fwd = getattr(spec, "forward_fn", None)
+                    if fwd is not None:
+                        # tied reuse with its own forward (reference
+                        # TiedLayerSpec(forward_fn=...): e.g. the embedding
+                        # weights applied transposed as the output head)
+                        out = fwd(p, out)
+                        continue
+                out, a = _apply(layer, p, out)
+                aux_total = aux_total + a
+        return self.loss_head(out, batch) + aux_total
+
+
+@dataclasses.dataclass(frozen=True)
+class _PipeModuleConfig:
+    pipeline_stages: int
+    pipeline_microbatches: int
+
+
+@jax.tree_util.register_static
+@dataclasses.dataclass(frozen=True)
+class _TiedRef:
+    """Placeholder leaf pointing a tied layer at its shared parameters."""
+    key: str
+
+
+def _stage_tree(t):
+    if isinstance(t, _TiedRef):  # unreachable (tied rejected for pipe>1)
+        raise ValueError("tied params cannot be stage-stacked")
+    return t
+
+
+def _apply(layer, p, x):
+    """Layer call normalizer: returns (x, aux)."""
+    import jax.numpy as jnp
+
+    fn = getattr(layer, "apply", layer)
+    out = fn(p, x) if (hasattr(layer, "apply") or hasattr(layer, "init")) \
+        else fn(x)
+    if isinstance(out, tuple):
+        return out[0], jnp.float32(out[1])
+    return out, jnp.float32(0.0)

@@ -1,0 +1,97 @@
+"""``OnDevice`` — construct model parameters on a chosen device, or on no
+device at all (reference ``deepspeed/utils/init_on_device.py:81``).
+
+The reference patches ``torch.Tensor`` constructors so ``with
+OnDevice(dtype=..., device="meta")`` builds million-dollar models as empty
+meta tensors.  The JAX analogue needs no constructor patching: abstract
+construction IS a first-class transform (``jax.eval_shape``), and concrete
+placement is ``jax.default_device``.  ``OnDevice`` packages both behind the
+reference's context-manager surface; model ``init_fn``s that honor it (the
+whole ``deepspeed_tpu.models`` family, ``PipelineModule``) consult
+:func:`current_on_device`.
+
+    with deepspeed_tpu.OnDevice(dtype=jnp.bfloat16, device="meta"):
+        shapes = model.init_fn(rng)       # ShapeDtypeStructs — zero bytes
+
+    with deepspeed_tpu.OnDevice(dtype=jnp.bfloat16, device="cpu"):
+        params = model.init_fn(rng)       # host RAM, not HBM
+
+Engine note: ``deepspeed_tpu.initialize`` already materializes params
+*born sharded* via ``jit(init, out_shardings=...)`` (the ``zero.Init``
+redesign), so OnDevice is for user-side inspection/staging flows — sizing a
+model without devices, or staging weights in host RAM before a sharded
+device_put.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, Optional
+
+_STATE = threading.local()
+
+
+def current_on_device() -> Optional["OnDevice"]:
+    """The innermost active OnDevice context (None outside any)."""
+    return getattr(_STATE, "ctx", None)
+
+
+class OnDevice(contextlib.AbstractContextManager):
+    def __init__(self, dtype: Any = None, device: str = "meta",
+                 enabled: bool = True):
+        self.dtype = dtype
+        self.device = device
+        self.enabled = enabled
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = current_on_device()
+        _STATE.ctx = self if self.enabled else self._prev
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.ctx = self._prev
+        return False
+
+    # -- application -------------------------------------------------------
+
+    def apply_init(self, init_fn: Callable, *args) -> Any:
+        """Run ``init_fn(*args)`` under this context's placement rules."""
+        import jax
+        import jax.numpy as jnp
+
+        def cast(tree):
+            if self.dtype is None:
+                return tree
+            return jax.tree_util.tree_map(
+                lambda x: x.astype(self.dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
+        if self.device == "meta":
+            shapes = jax.eval_shape(init_fn, *args)
+            if self.dtype is None:
+                return shapes
+            return jax.tree_util.tree_map(
+                lambda s: jax.ShapeDtypeStruct(
+                    s.shape, self.dtype
+                    if jnp.issubdtype(s.dtype, jnp.floating) else s.dtype),
+                shapes)
+        devices = [d for d in jax.devices() if d.platform == self.device] \
+            or jax.devices(self.device)
+        with jax.default_device(devices[0]):
+            return cast(init_fn(*args))
+
+
+def on_device_init(init_fn: Callable) -> Callable:
+    """Wrap an ``init_fn(rng) -> params`` so it honors an active OnDevice
+    context — how the model family opts in."""
+    import functools
+
+    @functools.wraps(init_fn)
+    def wrapped(*args):
+        ctx = current_on_device()
+        if ctx is None:
+            return init_fn(*args)
+        return ctx.apply_init(init_fn, *args)
+
+    return wrapped

@@ -106,3 +106,41 @@ def test_save_16bit_model(tmp_path):
     e.train_batch(batch=random_batch(16, HID))
     path = save_16bit_model(e, str(tmp_path))
     assert os.path.isdir(path)
+
+
+def test_moe_expert_cross_ep_restore(tmp_path):
+    """An ep2 MoE checkpoint restores onto an ep4 mesh with identical expert
+    weights (reference saves per-expert files so EP degree can change,
+    engine.py:2976 — orbax global arrays make the reshard implicit)."""
+    from deepspeed_tpu.models import CausalLM
+    from deepspeed_tpu.parallel import mesh as mesh_mod
+    from deepspeed_tpu.parallel.mesh import MeshLayout, initialize_mesh
+
+    def moe_engine(ep):
+        mesh_mod.reset_mesh()
+        mesh = initialize_mesh(MeshLayout(dp=8 // ep, ep=ep))
+        model = CausalLM("tiny-moe")
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1},
+            "bf16": {"enabled": True},
+        }, mesh=mesh)
+        return engine
+
+    e1 = moe_engine(ep=2)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(
+        0, 256, (e1.train_batch_size, 32)).astype(np.int32)}
+    e1.train_batch(batch=batch)
+    ref = _params_flat(e1)
+    e1.save_checkpoint(str(tmp_path))
+
+    e2 = moe_engine(ep=4)
+    e2.load_checkpoint(str(tmp_path))
+    np.testing.assert_allclose(ref, _params_flat(e2), rtol=1e-6)
+    # expert leaves land sharded over the new, wider expert axis
+    experts = jax.tree_util.tree_leaves(e2.state.params["layers"]["w_gate"])
+    shard = experts[0].sharding.shard_shape(experts[0].shape)
+    assert shard[1] == experts[0].shape[1] // 4
+    mesh_mod.reset_mesh()

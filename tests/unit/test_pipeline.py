@@ -116,3 +116,106 @@ def test_mismatched_pipeline_config_rejected():
               "optimizer": {"type": "adamw", "params": {"lr": 1e-3}}}
     with pytest.raises(ValueError, match="microbatches"):
         deepspeed_tpu.initialize(model=model, config=config, mesh=mesh)
+
+
+class _Dense:
+    """Minimal layer satisfying the PipelineModule layer contract."""
+
+    def __init__(self, dim, param_count=None):
+        self.dim = dim
+        self.param_count = param_count if param_count is not None else dim * dim
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        return {"w": jax.random.normal(k1, (self.dim, self.dim)) * 0.05,
+                "b": jnp.zeros((self.dim,))}
+
+    def apply(self, p, x):
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def _mse_head(out, batch):
+    return jnp.mean(jnp.square(out - batch["targets"]))
+
+
+def test_pipeline_module_sequential_trains():
+    """num_stages=1: heterogeneous layer list + tied weights compose and train."""
+    import deepspeed_tpu
+    from deepspeed_tpu.parallel import mesh as mesh_mod
+    from deepspeed_tpu.runtime.pipe.module import (LayerSpec, PipelineModule,
+                                                   TiedLayerSpec)
+
+    mesh_mod.reset_mesh()
+    pm = PipelineModule(
+        [TiedLayerSpec("emb", _Dense, 8),
+         LayerSpec(_Dense, 8),
+         lambda x: x * 1.0,                       # parameterless callable
+         TiedLayerSpec("emb", _Dense, 8)],
+        num_stages=1, loss_fn=_mse_head)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=pm, config={
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 0},
+    })
+    rng = np.random.default_rng(0)
+    batch = {"inputs": rng.normal(size=(engine.train_batch_size, 8)).astype(np.float32),
+             "targets": rng.normal(size=(engine.train_batch_size, 8)).astype(np.float32)}
+    losses = [float(engine.train_batch(batch=batch)) for _ in range(6)]
+    assert losses[-1] < losses[0]
+    # tied params really are shared: exactly one "emb" leaf in the tree
+    assert "emb" in engine.state.params["tied"]
+
+
+def test_pipeline_module_spmd_trains_and_matches_sequential():
+    """num_stages=2 on a pipe mesh: trains, and its forward loss matches the
+    same weights composed sequentially."""
+    import deepspeed_tpu
+    from deepspeed_tpu.parallel import mesh as mesh_mod
+    from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule
+
+    mesh_mod.reset_mesh()
+    mesh = initialize_mesh(MeshLayout(dp=4, pp=2))
+    pm = PipelineModule([LayerSpec(_Dense, 8) for _ in range(4)],
+                        num_stages=2, partition_method="uniform",
+                        loss_fn=_mse_head, microbatches=2)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=pm, config={
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 1},
+    }, mesh=mesh)
+    rng = np.random.default_rng(0)
+    batch = {"inputs": rng.normal(size=(engine.train_batch_size, 8)).astype(np.float32),
+             "targets": rng.normal(size=(engine.train_batch_size, 8)).astype(np.float32)}
+
+    # forward parity vs sequential composition of the same stacked weights
+    params = engine.state.params
+    seq = jnp.asarray(batch["inputs"])
+    for s in range(2):
+        for j in range(2):
+            p = jax.tree_util.tree_map(lambda a: a[s], params["stages"][j])
+            seq = _Dense(8).apply(p, seq)
+    ref_loss = float(jnp.mean(jnp.square(seq - jnp.asarray(batch["targets"]))))
+    pipe_loss = float(pm.loss_fn(params, batch))
+    np.testing.assert_allclose(pipe_loss, ref_loss, rtol=1e-5)
+
+    losses = [float(engine.train_batch(batch=batch)) for _ in range(6)]
+    assert losses[-1] < losses[0]
+    mesh_mod.reset_mesh()
+
+
+def test_pipeline_module_rejects_ragged_stages():
+    from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule
+
+    class _D4(_Dense):
+        def __init__(self):
+            super().__init__(4)
+
+    class _D8(_Dense):
+        def __init__(self):
+            super().__init__(8)
+
+    pm = PipelineModule([LayerSpec(_D4), LayerSpec(_D8)], num_stages=2,
+                        partition_method="uniform", loss_fn=_mse_head)
+    with pytest.raises(ValueError, match="identical stages"):
+        pm.init_fn(jax.random.PRNGKey(0))
