@@ -779,6 +779,45 @@ def _attention_cached(cfg, q, ck, cv, q_pos, q_slot, valid, kpos):
     B, S, Hq, hd = q.shape
     T, Hkv = ck.shape[1], ck.shape[2]
     G = Hq // Hkv
+    import os as _os
+    flash_decode_on = _os.environ.get(
+        "DS_TPU_FLASH_DECODE", "").strip().lower() not in ("", "0", "false", "off")
+    if (S == 1 and cfg.position != "alibi" and T % 128 == 0
+            and hd % 8 == 0 and flash_decode_on):
+        # decode step: the Pallas flash-decode kernel streams the cache
+        # through VMEM once (no [Hq,T] HBM score matrix).  Opt-in: decode is
+        # HBM-bandwidth bound and XLA's fused einsum already sits at the
+        # measured roof on the bench chip (T=8192, B=8: kernel 6.2-7.1ms vs
+        # xla 4.5-7.4ms across MHA/GQA head mixes — within noise, either
+        # side); flip the default if a profile on YOUR part says otherwise.
+        # Single-shard only — a model-sharded cache routes through the XLA
+        # einsum, which GSPMD partitions (the kernel has no SPMD rule).
+        from ..parallel import mesh as mesh_mod
+
+        m = mesh_mod._GLOBAL_MESH
+        unsharded = m is None or all(s == 1 for s in m.shape.values())
+        dp = 1 if m is None else mesh_mod.axis_size(m, BATCH_AXES)
+        batch_only = (m is not None and m.shape["model"] == 1
+                      and m.shape["seq"] == 1 and m.shape["pipe"] == 1
+                      and B % dp == 0)
+        if unsharded or batch_only:
+            from ..ops.pallas.decode_attention import flash_decode
+
+            slot_t = jnp.arange(T, dtype=jnp.int32)
+            ok = valid & (slot_t[None, :] <= q_slot[0])     # q_slot: [S=1]
+            sm = 1.0 / math.sqrt(hd)
+            if unsharded:
+                out = flash_decode(q[:, 0], ck, cv, ok, sm_scale=sm)
+            else:
+                # batch rides the DP axes; run the kernel per-shard
+                qs = P(BATCH_AXES, None, None)
+                cs = P(BATCH_AXES, None, None, None)
+                fd = mesh_mod.shard_map_compat(
+                    functools.partial(flash_decode, sm_scale=sm),
+                    m, in_specs=(qs, cs, cs, P(BATCH_AXES, None)),
+                    out_specs=qs)
+                out = fd(q[:, 0], ck, cv, ok)
+            return out[:, None]
     qg = q.reshape(B, S, Hkv, G, hd)
     scores = jnp.einsum("bskgd,btkd->bkgst", qg, ck).astype(jnp.float32)
     scores = scores / math.sqrt(hd)
