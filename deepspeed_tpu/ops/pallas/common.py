@@ -1,0 +1,60 @@
+"""Shared Pallas kernel utilities (reference ``csrc/includes/``: the common
+kernel layer every CUDA op includes — ``reduction_utils.h``,
+``memory_access_utils.h``, ``conversion_utils.h``).
+
+The TPU analogue is small because Mosaic handles tiling/layout, but the
+conventions that DO repeat across kernels live here so they stay aligned:
+
+  - ``NEG_INF`` — the masking constant (finite: ``-inf`` breaks the online
+    softmax's ``exp(m_prev - m_new)`` rescale when a whole block is masked).
+  - ``interpret_default()`` — interpret mode on CPU hosts so the unit suite
+    runs kernels without hardware.
+  - ``pick_block()`` — largest power-of-two tile that divides the axis.
+  - ``mask_to_i32()`` — masks cross the pallas_call boundary as int32 and
+    are compared ``!= 0`` in-kernel: bool memref tiling is a Mosaic
+    lowering hazard.
+  - ``parallel_semantics()`` — CompilerParams with the leading grid axes
+    'parallel' and the innermost (accumulator-carrying) axis 'arbitrary'.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def interpret_default() -> bool:
+    """Kernels run in interpret mode when no TPU is attached."""
+    return jax.devices()[0].platform == "cpu"
+
+
+def pick_block(n: int, want: int, floor: int = 8) -> int:
+    """Largest power-of-two block <= ``want`` dividing ``n`` (>= ``floor``).
+
+    Raises NotImplementedError when no such block exists — callers fall back
+    to their XLA path rather than running a ragged final tile (padded rows
+    would leak through index-based masks).
+    """
+    b = min(want, n)
+    while b > floor and n % b:
+        b //= 2
+    if n % b:
+        raise NotImplementedError(
+            f"axis length {n} has no power-of-two block divisor >= {floor}; "
+            "use the XLA path")
+    return b
+
+
+def mask_to_i32(mask) -> jax.Array:
+    """Boolean mask -> int32 for crossing the pallas_call boundary."""
+    return jnp.asarray(mask).astype(jnp.int32)
+
+
+def parallel_semantics(n_parallel: int, n_arbitrary: int = 1):
+    """CompilerParams for an n-axis grid: leading axes independent, the
+    trailing axes carrying accumulator state across iterations."""
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel",) * n_parallel
+        + ("arbitrary",) * n_arbitrary)

@@ -31,16 +31,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-NEG_INF = -1e30
 DEFAULT_BLOCK_T = 512
 
+from .common import (NEG_INF, interpret_default as _interpret_default,  # noqa: E402
+                     mask_to_i32, parallel_semantics)
+
 # B is independent; the T sweep carries the online-softmax state.
-_COMPILER_PARAMS = pltpu.CompilerParams(
-    dimension_semantics=("parallel", "arbitrary"))
-
-
-def _interpret_default() -> bool:
-    return jax.devices()[0].platform == "cpu"
+_COMPILER_PARAMS = parallel_semantics(1, 1)
 
 
 def _kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, m_scr, l_scr, acc_scr, *,
@@ -136,5 +133,5 @@ def flash_decode(q: jax.Array, ck: jax.Array, cv: jax.Array, mask: jax.Array,
         ],
         compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
-    )(qg, ck, cv, mask[:, None, :].astype(jnp.int32))
+    )(qg, ck, cv, mask_to_i32(mask[:, None, :]))
     return out

@@ -30,31 +30,14 @@ from jax.experimental.pallas import tpu as pltpu
 # wrapper caps the bwd tiles at 1024.  _pick_block shrinks for short S.
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 2048
-NEG_INF = -1e30
+
+from .common import (NEG_INF, interpret_default as _interpret_default,  # noqa: E402
+                     parallel_semantics, pick_block as _pick_block)
 
 # The first three grid axes are independent in every kernel here; only the
 # INNERMOST axis carries accumulator state (the K sweep in _fwd/_bwd_dq, the
 # Q-and-group sweep in _bwd_dkv) and must stay 'arbitrary'.
-_DIM_SEMANTICS = ("parallel", "parallel", "parallel", "arbitrary")
-_COMPILER_PARAMS = pltpu.CompilerParams(dimension_semantics=_DIM_SEMANTICS)
-
-
-def _interpret_default() -> bool:
-    return jax.devices()[0].platform == "cpu"
-
-
-def _pick_block(S: int, want: int) -> int:
-    """Largest power-of-two block <= want that divides S.  Ragged final
-    blocks are unsupported (the dkv backward would fold undefined padded
-    q rows into dk/dv — padded rows pass the `rows >= cols` causal test)."""
-    b = min(want, S)
-    while b > 8 and S % b:
-        b //= 2
-    if S % b:
-        raise NotImplementedError(
-            f"sequence length {S} has no power-of-two block divisor >= 8; "
-            "use the XLA attention path")
-    return b
+_COMPILER_PARAMS = parallel_semantics(3, 1)
 
 
 # ---------------------------------------------------------------------------
