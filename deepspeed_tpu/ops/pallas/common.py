@@ -40,7 +40,9 @@ def pick_block(n: int, want: int, floor: int = 8) -> int:
     b = min(want, n)
     while b > floor and n % b:
         b //= 2
-    if n % b:
+    # a full-axis tile (b == n) is legal at any size (tile == array dim);
+    # otherwise the tile must divide n and respect the floor
+    if n % b or (b < floor and b != n):
         raise NotImplementedError(
             f"axis length {n} has no power-of-two block divisor >= {floor}; "
             "use the XLA path")

@@ -103,9 +103,9 @@ def flash_decode(q: jax.Array, ck: jax.Array, cv: jax.Array, mask: jax.Array,
         raise NotImplementedError(
             f"cache length {T} must be a multiple of 128 (lane-aligned "
             "blocks); use the XLA path")
-    bt = min(block_t, T)
-    while bt > 128 and T % bt:
-        bt //= 2
+    from .common import pick_block
+
+    bt = pick_block(T, block_t, floor=128)
     blocks_t = T // bt
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(hd)

@@ -100,3 +100,21 @@ def test_cached_attention_dispatches_flash_decode(monkeypatch):
     out = decode_logits()
     assert called.get("yes"), "kernel was not dispatched"
     np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_flash_decode_rejects_nondividing_block_t():
+    """A block_t that cannot tile T must raise, not silently truncate."""
+    q = jnp.zeros((1, 4, 64))
+    ck = cv = jnp.zeros((1, 1024, 4, 64))
+    with pytest.raises(NotImplementedError, match="block divisor"):
+        flash_decode(q, ck, cv, jnp.ones((1, 1024), jnp.bool_), block_t=384)
+
+
+def test_pick_block_floor_contract():
+    from deepspeed_tpu.ops.pallas.common import pick_block
+
+    assert pick_block(1024, 512, floor=128) == 512
+    assert pick_block(4, 1024) == 4            # full-axis tile below floor ok
+    assert pick_block(192, 512, floor=128) == 192  # full-axis tile
+    with pytest.raises(NotImplementedError):
+        pick_block(192, 128, floor=128)        # 128∤192 and 96 < floor
