@@ -246,6 +246,33 @@ def wait_all_or_fail(procs, poll_s: float = 0.2, on_fail=None) -> int:
         return 130
 
 
+def _simulate_cmd(args) -> List[str]:
+    """User command wrapped so the child REALLY runs on cpu.
+
+    The env var alone is not enough in environments whose sitecustomize pins
+    the platform programmatically (jax.config beats JAX_PLATFORMS); the
+    bootstrap re-pins cpu after import, before any user code touches jax.
+    """
+    if args.no_python:
+        logger.warning(
+            "--simulate with --no_python cannot pin the child platform to "
+            "cpu (the bootstrap needs to own the python entrypoint); if the "
+            "environment pins a platform via jax.config, the children will "
+            "all open the real device")
+        return _build_user_cmd(args)
+    boot = ("import jax, runpy, sys, os; "
+            "jax.config.update('jax_platforms', 'cpu'); "
+            "sys.argv = sys.argv[1:]; "
+            + ("runpy.run_module(sys.argv[0], run_name='__main__', "
+               "alter_sys=True)" if args.module else
+               # match `python script.py` semantics: script dir on sys.path
+               "sys.path.insert(0, os.path.dirname(os.path.abspath("
+               "sys.argv[0])) or '.'); "
+               "runpy.run_path(sys.argv[0], run_name='__main__')"))
+    return ([sys.executable, "-u", "-c", boot, args.user_script]
+            + list(args.user_args))
+
+
 def _run_simulate(args, n: int) -> int:
     """N local processes, virtual CPU devices, loopback coordinator."""
     procs = []
@@ -258,7 +285,7 @@ def _run_simulate(args, n: int) -> int:
             "PROCESS_ID": str(pid),
             "TPU_VISIBLE_CHIPS": "",
         })
-        procs.append(subprocess.Popen(_build_user_cmd(args), env=env))
+        procs.append(subprocess.Popen(_simulate_cmd(args), env=env))
     return wait_all_or_fail(procs)
 
 
