@@ -81,6 +81,11 @@ class InferenceEngine:
         self._forward = jax.jit(self.apply_fn)
         log_dist(f"inference engine ready: tp={tp} dtype={self._config.dtype}", ranks=[0])
 
+    @property
+    def model(self):
+        """The wrapped model adapter (reference InferenceEngine.module)."""
+        return self._model
+
     def forward(self, *args, **kwargs):
         if self.params is not None:
             return self._forward(self.params, *args, **kwargs)

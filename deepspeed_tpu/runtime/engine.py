@@ -1229,7 +1229,7 @@ class DeepSpeedEngine:
         if self.monitor is None:
             return
         events = [("Train/Samples/train_loss", float(metrics["loss"]), self.global_steps),
-                  ("Train/Samples/lr", self.get_lr(), self.global_steps)]
+                  ("Train/Samples/lr", self.get_current_lr(), self.global_steps)]
         if self.fp16_enabled:
             events.append(("Train/Samples/loss_scale",
                            float(metrics["loss_scale"]), self.global_steps))
@@ -1241,10 +1241,16 @@ class DeepSpeedEngine:
 
     def _report_progress(self, metrics):
         log_dist(f"step={self.global_steps}, skipped={self.skipped_steps}, "
-                 f"lr={self.get_lr():.3e}, loss={float(metrics['loss']):.4f}, "
+                 f"lr={self.get_current_lr():.3e}, loss={float(metrics['loss']):.4f}, "
                  f"grad_norm={float(metrics['grad_norm']):.3f}", ranks=[0])
 
-    def get_lr(self) -> float:
+    def get_lr(self) -> list:
+        """Current learning rate(s), one per param group (reference
+        engine.get_lr -> lr_scheduler.get_lr(), a list; this engine has one
+        logical group).  Scalar convenience: ``get_current_lr()``."""
+        return [self.get_current_lr()]
+
+    def get_current_lr(self) -> float:
         return float(self.lr_schedule(self.state.step))
 
     @property

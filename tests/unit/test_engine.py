@@ -214,7 +214,7 @@ def test_lr_schedule_in_engine():
     engine = _make_engine(scheduler={"type": "WarmupLR", "params": {
         "warmup_min_lr": 0.0, "warmup_max_lr": 0.01, "warmup_num_steps": 10,
         "warmup_type": "linear"}})
-    assert engine.get_lr() < 0.01
+    assert engine.get_lr()[0] < 0.01
     _train(engine, steps=3)
-    lr_mid = engine.get_lr()
+    lr_mid = engine.get_lr()[0]
     assert 0 < lr_mid < 0.01

@@ -1,0 +1,28 @@
+"""The shipped examples must actually run (reference DeepSpeedExamples role)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_BOOT = ("import jax, runpy, sys, os; "
+         "jax.config.update('jax_platforms', 'cpu'); "
+         "sys.argv = sys.argv[1:]; "
+         "sys.path.insert(0, os.path.dirname(os.path.abspath(sys.argv[0]))); "
+         "runpy.run_path(sys.argv[0], run_name='__main__')")
+
+
+@pytest.mark.parametrize("cmd", [
+    ["examples/train.py", "--model", "tiny", "--seq_len", "32", "--steps", "3"],
+    ["examples/generate.py", "--model", "tiny", "--batch", "2",
+     "--prompt_len", "16", "--new_tokens", "4"],
+], ids=["train", "generate"])
+def test_example_runs(cmd):
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-c", _BOOT] + cmd, capture_output=True, text=True,
+        timeout=900, cwd=repo, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
