@@ -28,8 +28,15 @@ from jax.experimental.pallas import tpu as pltpu
 # with a full-row K block (bk=2048: the online-softmax carry disappears),
 # while backward is fastest at 1024 — so fwd defaults to bk=2048 and the
 # wrapper caps the bwd tiles at 1024.  _pick_block shrinks for short S.
+# Tile choice is measured in the FULL remat train step, not in kernel
+# isolation: an isolated fwd+bwd sweep preferred fwd block_q=512 by 11-25%,
+# but the same tiles cost ~2.5% end-to-end (S=8192 llama bench, same
+# thermal state) — the rematerialized fwd inside the backward schedules
+# differently than a standalone chain.  Keep (1024, 2048) fwd + 1024 bwd.
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 2048
+# backward tiles: min(fwd tile, this) — the bwd kernels compile reliably at 1024
+DEFAULT_BWD_BLOCK = 1024
 
 from .common import (NEG_INF, interpret_default as _interpret_default,  # noqa: E402
                      parallel_semantics, pick_block as _pick_block)
@@ -428,8 +435,8 @@ def flash_attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = No
     else:
         block_q = _pick_block(S, block_q)
         block_k = _pick_block(S, block_k)
-        bwd_block_q = _pick_block(S, bwd_block_q or min(block_q, 1024))
-        bwd_block_k = _pick_block(S, bwd_block_k or min(block_k, 1024))
+        bwd_block_q = _pick_block(S, bwd_block_q or min(block_q, DEFAULT_BWD_BLOCK))
+        bwd_block_k = _pick_block(S, bwd_block_k or min(block_k, DEFAULT_BWD_BLOCK))
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     if interpret is None:

@@ -53,6 +53,13 @@ class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):
     pipeline_write: bool = False
     fast_init: bool = False
     ratio: float = Field(1.0, ge=0.0, le=1.0)
+    # device=cpu execution strategy (TPU-specific): True = host SIMD Adam on
+    # RAM-resident state (device never holds fp32 master/m/v — the reference
+    # cpu_offload semantics, required for models near HBM capacity on few
+    # chips); False = state parked in pinned host memory and streamed
+    # through the jitted step (cheaper per step when dp shards the state
+    # thin).  None = auto: host step when the mesh has ONE data shard.
+    host_step: Optional[bool] = None
 
 
 class ZeroConfig(DeepSpeedConfigModel):

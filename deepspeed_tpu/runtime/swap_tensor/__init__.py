@@ -1,4 +1,6 @@
 """Tensor swapping to NVMe (reference ``deepspeed/runtime/swap_tensor/``)."""
-from .partitioned_optimizer_swapper import SwappedAdamOptimizer, TensorSwapper
+from .partitioned_optimizer_swapper import (HostAdamOptimizer,
+                                            SwappedAdamOptimizer,
+                                            TensorSwapper)
 
-__all__ = ["SwappedAdamOptimizer", "TensorSwapper"]
+__all__ = ["HostAdamOptimizer", "SwappedAdamOptimizer", "TensorSwapper"]
