@@ -1,0 +1,111 @@
+"""Offline dataset analysis for curriculum learning (reference
+``runtime/data_pipeline/data_sampling/data_analyzer.py`` ``DataAnalyzer``).
+
+Map-reduce over a dataset: workers each scan a stride-shard computing a
+per-sample difficulty metric and persist partial index files; the reduce
+merges them into the arrays the curriculum machinery consumes —
+
+  - ``metric_values.npy``  : float/int metric aligned to sample index —
+                             exactly the ``sizes`` input of
+                             :class:`..data_sampler.CurriculumBatchSampler`
+                             (which derives the difficulty ordering itself).
+
+The reference parallelizes via launcher-spawned ranks; here ``run()`` uses
+a thread pool (metric fns are usually tokenizer/IO bound and release the
+GIL) and the map/reduce halves stay separately callable so a multi-host
+launcher can still fan the map out by (worker_id, num_workers).
+"""
+from __future__ import annotations
+
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+DEFAULT_METRIC = "seqlen"
+
+
+def _seqlen_metric(sample) -> float:
+    """Default difficulty: document length (reference curriculum seqlen)."""
+    if isinstance(sample, dict):
+        sample = sample.get("input_ids", next(iter(sample.values())))
+    return float(len(sample))
+
+
+class DataAnalyzer:
+    def __init__(self, metric_fn: Optional[Callable] = None,
+                 metric_name: str = DEFAULT_METRIC,
+                 num_workers: int = 1, worker_id: int = 0):
+        self.metric_fn = metric_fn or _seqlen_metric
+        self.metric_name = metric_name
+        self.num_workers = num_workers
+        self.worker_id = worker_id
+
+    # -- map -------------------------------------------------------------
+    def _shard_file(self, save_path: str, worker_id: int) -> str:
+        return os.path.join(save_path,
+                            f"{self.metric_name}_w{worker_id}.npz")
+
+    def run_map(self, dataset: Sequence, save_path: str,
+                worker_id: Optional[int] = None) -> str:
+        """Scan this worker's stride-shard, persist (indices, values)."""
+        wid = self.worker_id if worker_id is None else worker_id
+        os.makedirs(save_path, exist_ok=True)
+        idx = np.arange(wid, len(dataset), self.num_workers)
+        vals = np.asarray([self.metric_fn(dataset[int(i)]) for i in idx],
+                          np.float64)
+        out = self._shard_file(save_path, wid)
+        # fingerprint guards the reduce against merging shards from a
+        # different analysis run left behind in the same save_path
+        np.savez(out, indices=idx, values=vals,
+                 dataset_len=np.int64(len(dataset)),
+                 num_workers=np.int64(self.num_workers))
+        return out
+
+    # -- reduce ----------------------------------------------------------
+    def run_reduce(self, save_path: str) -> str:
+        """Merge every worker shard into the aligned value/order arrays."""
+        parts = [self._shard_file(save_path, w)
+                 for w in range(self.num_workers)]
+        missing = [p for p in parts if not os.path.exists(p)]
+        if missing:
+            raise FileNotFoundError(
+                f"reduce before map finished: missing {missing}")
+        loaded = []
+        fingerprints = set()
+        for p in parts:
+            with np.load(p) as z:
+                loaded.append((z["indices"], z["values"]))
+                fingerprints.add((int(z["dataset_len"]),
+                                  int(z["num_workers"])))
+        if len(fingerprints) != 1 or next(iter(
+                fingerprints))[1] != self.num_workers:
+            raise ValueError(
+                f"shard fingerprints disagree ({sorted(fingerprints)}, "
+                f"reduce num_workers={self.num_workers}) — stale shard "
+                "files from a previous analysis in this save_path?")
+        n = next(iter(fingerprints))[0]
+        values = np.full(n, np.nan)
+        for idx, vals in loaded:
+            values[idx] = vals
+        if np.isnan(values).any():
+            raise ValueError("reduce found sample indices no worker covered "
+                             "— num_workers mismatch between map and reduce?")
+        vpath = os.path.join(save_path, f"{self.metric_name}_values.npy")
+        np.save(vpath, values)
+        return vpath
+
+    # -- convenience: in-process parallel map + reduce -------------------
+    def run(self, dataset: Sequence, save_path: str) -> np.ndarray:
+        with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
+            list(pool.map(lambda w: self.run_map(dataset, save_path, w),
+                          range(self.num_workers)))
+        self.run_reduce(save_path)
+        return load_metric_values(save_path, self.metric_name)
+
+
+def load_metric_values(save_path: str,
+                       metric_name: str = DEFAULT_METRIC) -> np.ndarray:
+    """The ``sizes`` array for CurriculumBatchSampler."""
+    return np.load(os.path.join(save_path, f"{metric_name}_values.npy"))

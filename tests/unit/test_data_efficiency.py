@@ -232,3 +232,54 @@ def test_engine_random_ltd_trains_and_anneals():
     # annealed to full sequence -> ltd inactive variant engaged
     assert engine._random_ltd.get_current_seq() == 64
     assert len(engine._ltd_cache) >= 2  # at least two keep-buckets compiled
+
+
+def test_data_analyzer_map_reduce(tmp_path):
+    """Offline difficulty analysis feeds the curriculum sampler (reference
+    data_analyzer.py map-reduce)."""
+    import numpy as np
+
+    from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (
+        DataAnalyzer, load_metric_values)
+    from deepspeed_tpu.runtime.data_pipeline.data_sampler import (
+        CurriculumBatchSampler)
+
+    rng = np.random.default_rng(0)
+    dataset = [{"input_ids": np.zeros(int(n), np.int32)}
+               for n in rng.integers(4, 64, 101)]
+    an = DataAnalyzer(num_workers=3)
+    values = an.run(dataset, str(tmp_path))
+    assert values.shape == (101,)
+    assert values[7] == len(dataset[7]["input_ids"])
+
+    sampler = CurriculumBatchSampler(load_metric_values(str(tmp_path)),
+                                     batch_size=8)
+    batch = next(iter(sampler))
+    assert len(batch) == 8
+
+
+def test_data_analyzer_reduce_requires_all_shards(tmp_path):
+    import numpy as np
+    import pytest as _pytest
+
+    from deepspeed_tpu.runtime.data_pipeline.data_analyzer import DataAnalyzer
+
+    ds = [np.zeros(3)] * 10
+    an = DataAnalyzer(num_workers=2)
+    an.run_map(ds, str(tmp_path), worker_id=0)
+    with _pytest.raises(FileNotFoundError, match="missing"):
+        an.run_reduce(str(tmp_path))
+
+
+def test_data_analyzer_rejects_stale_shards(tmp_path):
+    import numpy as np
+    import pytest as _pytest
+
+    from deepspeed_tpu.runtime.data_pipeline.data_analyzer import DataAnalyzer
+
+    old = [np.zeros(3)] * 10
+    DataAnalyzer(num_workers=2).run(old, str(tmp_path))   # leaves w0/w1 shards
+    an = DataAnalyzer(num_workers=2)
+    an.run_map([np.zeros(3)] * 12, str(tmp_path), worker_id=0)  # new run, w1 stale
+    with _pytest.raises(ValueError, match="stale shard"):
+        an.run_reduce(str(tmp_path))
