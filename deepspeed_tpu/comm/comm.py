@@ -249,3 +249,27 @@ def log_summary(show_bandwidth: bool = False, print_log: bool = True):
         return ""
     return _comms_logger.log_all(print_log=print_log,
                                  show_bandwidth=show_bandwidth)
+
+
+# -- capability probing (reference comm.py:300 has_all_gather_into_tensor,
+#    torch.py:39 has_coalescing_manager).  The reference gates fast paths on
+#    backend feature flags; on XLA every collective below is native, so the
+#    probes exist for API parity and for user code written against the
+#    reference's feature-detection idiom.
+def has_all_gather_into_tensor() -> bool:
+    """XLA all_gather always lands in one tensor — no Python-list fallback."""
+    return True
+
+
+def has_reduce_scatter_tensor() -> bool:
+    return True
+
+
+def has_coalescing_manager() -> bool:
+    """XLA fuses/coalesces collectives during compilation; there is no
+    eager-mode coalescing manager to expose (the compiler IS the manager)."""
+    return False
+
+
+def has_all_to_all_single() -> bool:
+    return True

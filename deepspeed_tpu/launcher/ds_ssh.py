@@ -1,0 +1,67 @@
+"""Run a command on every host of a hostfile (reference ``bin/ds_ssh``).
+
+    ds_tpu_ssh [-H hostfile] [--include/--exclude filters] -- CMD...
+
+Same pdsh-style fan-out the launcher uses, minus the training-env plumbing —
+for fleet chores ("pkill python", "ls ~/ckpts") on TPU-VM pods.
+"""
+from __future__ import annotations
+
+import argparse
+import shlex
+import subprocess
+import sys
+
+from .runner import fetch_hostfile, parse_resource_filter, wait_all_or_fail
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("ds_tpu_ssh")
+    ap.add_argument("-H", "--hostfile", default="/job/hostfile")
+    ap.add_argument("-i", "--include", default="")
+    ap.add_argument("-e", "--exclude", default="")
+    ap.add_argument("--ssh_port", type=int, default=22)
+    ap.add_argument("command", nargs=argparse.REMAINDER,
+                    help="command to run on every host (prefix with --)")
+    args = ap.parse_args(argv)
+    cmd = args.command[1:] if args.command[:1] == ["--"] else args.command
+    if not cmd:
+        ap.error("no command given")
+
+    pool = fetch_hostfile(args.hostfile)
+    if not pool:
+        print("ds_tpu_ssh: no hostfile; running locally", file=sys.stderr)
+        try:
+            return subprocess.call(cmd)
+        except FileNotFoundError:
+            print(f"ds_tpu_ssh: {cmd[0]}: command not found", file=sys.stderr)
+            return 127
+    active = parse_resource_filter(pool, args.include, args.exclude)
+    narrowed = [h for h, slots in active.items() if len(slots) != pool[h]]
+    if narrowed:
+        ap.error(f"slot-granular filters ({narrowed}) have no meaning here — "
+                 "ds_tpu_ssh runs once per HOST; filter whole hosts "
+                 "(e.g. -e hostname)")
+    procs = []
+    try:
+        for host in active:
+            if host in ("localhost", "127.0.0.1"):
+                procs.append(subprocess.Popen(cmd))
+            else:
+                # shlex.join: the remote shell must see ONE properly quoted
+                # command; BatchMode fails fast instead of prompting (same
+                # flags as multinode_runner.SSHRunner)
+                procs.append(subprocess.Popen(
+                    ["ssh", "-o", "StrictHostKeyChecking=no",
+                     "-o", "BatchMode=yes", "-p", str(args.ssh_port), host,
+                     shlex.join(cmd)]))
+    except FileNotFoundError as e:
+        for p in procs:
+            p.terminate()
+        print(f"ds_tpu_ssh: {e}", file=sys.stderr)
+        return 127
+    return wait_all_or_fail(procs)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

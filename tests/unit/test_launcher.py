@@ -196,3 +196,47 @@ def test_simulate_cmd_wraps_with_cpu_bootstrap():
     margs = parse_args(["--simulate", "2", "--module", "pkg.train"])
     mcmd = _simulate_cmd(margs)
     assert "run_module" in mcmd[3] and mcmd[-1] == "pkg.train"
+
+
+def test_ds_ssh_local_fallback(tmp_path, capsys):
+    from deepspeed_tpu.launcher.ds_ssh import main
+
+    rc = main(["-H", str(tmp_path / "missing_hostfile"), "--", "true"])
+    assert rc == 0
+
+
+def test_ds_ssh_hostfile_localhost(tmp_path):
+    from deepspeed_tpu.launcher.ds_ssh import main
+
+    hf = tmp_path / "hosts"
+    hf.write_text("localhost slots=1\n")
+    marker = tmp_path / "ran"
+    rc = main(["-H", str(hf), "--", "touch", str(marker)])
+    assert rc == 0 and marker.exists()
+
+
+def test_comm_capability_probes():
+    import deepspeed_tpu.comm as dist
+
+    assert dist.has_all_gather_into_tensor() is True
+    assert dist.has_reduce_scatter_tensor() is True
+    assert dist.has_all_to_all_single() is True
+    assert dist.has_coalescing_manager() is False
+
+
+def test_ds_ssh_rejects_slot_filters(tmp_path, capsys):
+    from deepspeed_tpu.launcher.ds_ssh import main
+
+    hf = tmp_path / "hosts"
+    hf.write_text("localhost slots=4\n")
+    with pytest.raises(SystemExit):
+        main(["-H", str(hf), "-e", "localhost:0-1", "--", "true"])
+
+
+def test_ds_ssh_missing_command_rc(tmp_path):
+    from deepspeed_tpu.launcher.ds_ssh import main
+
+    hf = tmp_path / "hosts"
+    hf.write_text("localhost slots=1\n")
+    rc = main(["-H", str(hf), "--", "definitely_not_a_command_xyz"])
+    assert rc == 127
