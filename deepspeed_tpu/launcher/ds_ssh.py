@@ -8,11 +8,13 @@ for fleet chores ("pkill python", "ls ~/ckpts") on TPU-VM pods.
 from __future__ import annotations
 
 import argparse
+import os
 import shlex
 import subprocess
 import sys
 
-from .runner import fetch_hostfile, parse_resource_filter, wait_all_or_fail
+from .multinode_runner import ssh_base_cmd
+from .runner import fetch_hostfile, parse_resource_filter
 
 
 def main(argv=None) -> int:
@@ -30,6 +32,12 @@ def main(argv=None) -> int:
 
     pool = fetch_hostfile(args.hostfile)
     if not pool:
+        if args.include or args.exclude:
+            # filters with no pool would be silently IGNORED — a typo'd -H
+            # path must not turn an exclude-protected fleet command into an
+            # unfiltered local one
+            ap.error(f"hostfile {args.hostfile!r} not found/empty but "
+                     "include/exclude filters were given")
         print("ds_tpu_ssh: no hostfile; running locally", file=sys.stderr)
         try:
             return subprocess.call(cmd)
@@ -43,24 +51,32 @@ def main(argv=None) -> int:
                  "ds_tpu_ssh runs once per HOST; filter whole hosts "
                  "(e.g. -e hostname)")
     procs = []
+    hosts = list(active)
     try:
-        for host in active:
+        for host in hosts:
             if host in ("localhost", "127.0.0.1"):
                 procs.append(subprocess.Popen(cmd))
             else:
-                # shlex.join: the remote shell must see ONE properly quoted
-                # command; BatchMode fails fast instead of prompting (same
-                # flags as multinode_runner.SSHRunner)
+                # one quoted remote command, run from the SAME cwd as the
+                # local invocation (matches SSHRunner._ssh_cmd semantics)
+                remote = f"cd {shlex.quote(os.getcwd())}; {shlex.join(cmd)}"
                 procs.append(subprocess.Popen(
-                    ["ssh", "-o", "StrictHostKeyChecking=no",
-                     "-o", "BatchMode=yes", "-p", str(args.ssh_port), host,
-                     shlex.join(cmd)]))
+                    ssh_base_cmd(args.ssh_port) + [host, remote]))
     except FileNotFoundError as e:
         for p in procs:
             p.terminate()
         print(f"ds_tpu_ssh: {e}", file=sys.stderr)
         return 127
-    return wait_all_or_fail(procs)
+    # fleet-chore semantics: run to completion EVERYWHERE and report
+    # per-host exit codes (the launcher's fail-fast wait would SIGTERM the
+    # other hosts on the first benign nonzero, e.g. `pkill` matching nothing)
+    worst = 0
+    for host, p in zip(hosts, procs):
+        rc = p.wait()
+        if rc != 0:
+            print(f"ds_tpu_ssh: {host}: rc={rc}", file=sys.stderr)
+            worst = worst or rc
+    return worst
 
 
 if __name__ == "__main__":

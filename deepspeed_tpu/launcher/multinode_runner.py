@@ -42,6 +42,18 @@ class MultiNodeRunner:
         raise NotImplementedError
 
 
+def ssh_base_cmd(ssh_port=None, launcher_args=None) -> List[str]:
+    """The one place the ssh invocation flags live (SSHRunner + ds_tpu_ssh):
+    no host-key prompts, fail fast instead of password prompts, optional
+    port and extra user flags."""
+    ssh = ["ssh", "-o", "StrictHostKeyChecking=no", "-o", "BatchMode=yes"]
+    if ssh_port:
+        ssh += ["-p", str(ssh_port)]
+    if launcher_args:
+        ssh += shlex.split(launcher_args)
+    return ssh
+
+
 class SSHRunner(MultiNodeRunner):
     """ssh-per-host fan-out; first failure (or ^C) terminates the job."""
 
@@ -49,11 +61,7 @@ class SSHRunner(MultiNodeRunner):
         env = self.env_for(host)
         exports = " ".join(f"export {k}={shlex.quote(v)};" for k, v in env.items())
         remote = f"{exports} cd {shlex.quote(os.getcwd())}; {shlex.join(user_cmd)}"
-        ssh = ["ssh", "-o", "StrictHostKeyChecking=no", "-o", "BatchMode=yes"]
-        if self.args.ssh_port:
-            ssh += ["-p", str(self.args.ssh_port)]
-        if self.args.launcher_args:
-            ssh += shlex.split(self.args.launcher_args)
+        ssh = ssh_base_cmd(self.args.ssh_port, self.args.launcher_args)
         return ssh + [host, remote]
 
     def launch(self, user_cmd: List[str]) -> int:

@@ -240,3 +240,23 @@ def test_ds_ssh_missing_command_rc(tmp_path):
     hf.write_text("localhost slots=1\n")
     rc = main(["-H", str(hf), "--", "definitely_not_a_command_xyz"])
     assert rc == 127
+
+
+def test_ds_ssh_completes_and_reports_nonzero(tmp_path, capsys):
+    """Fleet semantics: the command runs to completion and the nonzero rc is
+    reported, not turned into a SIGTERM of the fan-out."""
+    from deepspeed_tpu.launcher.ds_ssh import main
+
+    hf = tmp_path / "hosts"
+    hf.write_text("localhost slots=1\n")
+    m1 = tmp_path / "a"
+    rc = main(["-H", str(hf), "--", "sh", "-c", f"touch {m1}; exit 3"])
+    assert rc == 3 and m1.exists()
+    assert "rc=3" in capsys.readouterr().err
+
+
+def test_ds_ssh_missing_hostfile_with_filters_errors(tmp_path):
+    from deepspeed_tpu.launcher.ds_ssh import main
+
+    with pytest.raises(SystemExit):
+        main(["-H", str(tmp_path / "nope"), "-e", "somehost", "--", "true"])
