@@ -58,8 +58,10 @@ def main(argv=None) -> int:
                 procs.append(subprocess.Popen(cmd))
             else:
                 # one quoted remote command, run from the SAME cwd as the
-                # local invocation (matches SSHRunner._ssh_cmd semantics)
-                remote = f"cd {shlex.quote(os.getcwd())}; {shlex.join(cmd)}"
+                # local invocation; && (not ;) so a host missing that
+                # directory fails loudly instead of running the chore
+                # (possibly destructive, possibly relative-path) from $HOME
+                remote = f"cd {shlex.quote(os.getcwd())} && {shlex.join(cmd)}"
                 procs.append(subprocess.Popen(
                     ssh_base_cmd(args.ssh_port) + [host, remote]))
     except FileNotFoundError as e:
@@ -71,11 +73,20 @@ def main(argv=None) -> int:
     # per-host exit codes (the launcher's fail-fast wait would SIGTERM the
     # other hosts on the first benign nonzero, e.g. `pkill` matching nothing)
     worst = 0
-    for host, p in zip(hosts, procs):
-        rc = p.wait()
-        if rc != 0:
-            print(f"ds_tpu_ssh: {host}: rc={rc}", file=sys.stderr)
-            worst = worst or rc
+    try:
+        for host, p in zip(hosts, procs):
+            rc = p.wait()
+            if rc != 0:
+                print(f"ds_tpu_ssh: {host}: rc={rc}", file=sys.stderr)
+                worst = worst or rc
+    except KeyboardInterrupt:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        print("ds_tpu_ssh: interrupted; local ssh processes terminated "
+              "(remote commands already started may keep running)",
+              file=sys.stderr)
+        return 130
     return worst
 
 
