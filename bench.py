@@ -240,6 +240,32 @@ def run_inference(model_name: str, batch: int, prompt_len: int, new_tokens: int)
     }
 
 
+def _device_responsive(timeout_s: float = 180.0):
+    """(ok, error_message).  A wedged remote backend HANGS inside
+    jax.devices()/first dispatch rather than raising; probe in a SHORT-LIVED
+    subprocess so (a) the bench emits its JSON error line quickly instead of
+    eating 3x3600s attempt timeouts, and (b) the orchestrator process never
+    initializes the device runtime itself — TPU clients are per-process
+    exclusive and a parent holding one would starve every child attempt."""
+    import subprocess
+
+    probe_src = ("import jax, jax.numpy as jnp; "
+                 "assert float((jnp.ones((4, 4)) @ jnp.ones((4, 4))).sum()) "
+                 "== 64.0")
+    try:
+        proc = subprocess.run([sys.executable, "-c", probe_src],
+                              capture_output=True, text=True,
+                              timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return False, (f"device backend unresponsive: first tiny dispatch "
+                       f"did not complete in {timeout_s:.0f}s "
+                       "(tunnel/libtpu down?)")
+    if proc.returncode != 0:
+        return False, ("device probe failed: "
+                       + (proc.stderr.strip().splitlines() or ["no stderr"])[-1][:300])
+    return True, ""
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", default="train", choices=["train", "inference"])
@@ -272,6 +298,16 @@ def main():
                     help="run exactly one attempt in-process (used by the "
                          "subprocess-isolated OOM-retry loop)")
     args = ap.parse_args()
+
+    if not args.no_retry:
+        ok, err = _device_responsive()
+        if not ok:
+            metric, unit = (("llama-decode-throughput", "tokens/sec/chip")
+                            if args.mode == "inference" else
+                            ("llama-train-throughput", "model TFLOPs/sec/chip"))
+            print(json.dumps({"metric": metric, "value": 0.0, "unit": unit,
+                              "vs_baseline": 0.0, "error": err}))
+            sys.exit(1)
 
     if args.mode == "inference":
         print(json.dumps(run_inference(args.model, args.micro_batch,
