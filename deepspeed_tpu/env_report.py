@@ -59,31 +59,18 @@ def devices_report(timeout_s: float = 60.0):
     """Device inventory; a report tool must DEGRADE, not hang, when the
     device backend is unreachable (remote/tunneled backends can block
     jax.devices() indefinitely), so the probe runs under a timeout."""
-    import threading
-
     import jax
 
-    # daemon thread, not ThreadPoolExecutor: the executor's shutdown (and
-    # interpreter exit) would JOIN a worker stuck inside backend init,
-    # re-introducing the very hang the timeout exists to escape
-    box = {}
+    from .utils.debug import probe_device_count
 
-    def probe():
-        try:
-            box["devs"] = jax.devices()
-        except Exception as e:
-            box["err"] = e
-
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    if t.is_alive():
+    n, err = probe_device_count(timeout_s)
+    if n is None and err is None:
         return [f"device probe timed out after {timeout_s:.0f}s — backend "
                 "unreachable (tunnel/libtpu down?); host report above is "
                 "still valid"]
-    if "err" in box:
-        return [f"device probe failed: {box['err']}"]
-    devs = box["devs"]
+    if err is not None:
+        return [f"device probe failed: {err}"]
+    devs = jax.devices()   # backend proven responsive; returns immediately
     lines = []
     lines.append(f"platform ............. {devs[0].platform}")
     lines.append(f"local devices ........ {jax.local_device_count()}")
