@@ -75,7 +75,18 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     os.makedirs(ckpt_dir, exist_ok=True)
 
     ce = OrbaxCheckpointEngine()
-    ce.save(engine.state, os.path.join(ckpt_dir, "state"))
+    if engine.state is not None:
+        ce.save(engine.state, os.path.join(ckpt_dir, "state"))
+
+    offload = (getattr(engine, "_offload", None)
+               or getattr(engine, "_param_offload", None))
+    if offload is not None and jax.process_index() == 0:
+        # Host-stepped offload (ZeRO-Offload host RAM / ZeRO-Infinity NVMe):
+        # the fp32 masters + Adam moments live OUTSIDE the TrainState, so
+        # they ride alongside the orbax tree, streamed one leaf at a time
+        # (reference swap_tensor/optimizer_utils.py checkpoints swapped
+        # state the same way: tensors to files next to the torch checkpoint).
+        offload.save_state_files(os.path.join(ckpt_dir, "offload_optimizer"))
 
     from ...checkpoint.universal import CHECKPOINT_VERSION
 
@@ -111,15 +122,42 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     if not os.path.isdir(ckpt_dir):
         raise FileNotFoundError(f"checkpoint tag dir not found: {ckpt_dir}")
 
+    offload = (getattr(engine, "_offload", None)
+               or getattr(engine, "_param_offload", None))
+    if offload is not None and (load_module_only or not load_optimizer_states):
+        # Checked BEFORE any state mutation: host-stepped/param-offload
+        # engines derive the device params FROM the host fp32 masters on
+        # every step — restoring state.params alone would be silently
+        # overwritten by stale masters at the next step (and a param-offload
+        # engine has no orbax state at all).
+        raise NotImplementedError(
+            "partial checkpoint loads (load_module_only / "
+            "load_optimizer_states=False) are not supported with a "
+            "host-stepped or param-offload optimizer: params are derived "
+            "from the host fp32 masters, so a weights-only load would be "
+            "discarded at the next step.  Load the full checkpoint, or "
+            "export weights via checkpoint/zero_to_fp32.py.")
+
     ce = OrbaxCheckpointEngine()
-    # Restore against the CURRENT state's shardings — this IS cross-topology
-    # resharding (saved on any mesh layout, restored onto this one).
-    restored = ce.load(os.path.join(ckpt_dir, "state"), target=engine.state)
-    if load_module_only or not load_optimizer_states:
-        restored = dataclasses_replace_state(engine.state, restored,
-                                             module_only=load_module_only,
-                                             opt=load_optimizer_states)
-    engine.state = restored
+    if engine.state is not None:
+        # Restore against the CURRENT state's shardings — this IS
+        # cross-topology resharding (saved on any mesh layout, restored onto
+        # this one).
+        restored = ce.load(os.path.join(ckpt_dir, "state"), target=engine.state)
+        if load_module_only or not load_optimizer_states:
+            restored = dataclasses_replace_state(engine.state, restored,
+                                                 module_only=load_module_only,
+                                                 opt=load_optimizer_states)
+        engine.state = restored
+
+    if offload is not None:
+        off_dir = os.path.join(ckpt_dir, "offload_optimizer")
+        if not os.path.isdir(off_dir):
+            raise FileNotFoundError(
+                f"checkpoint {tag} has no offload_optimizer/ but this "
+                "engine runs a host-stepped offload optimizer — it was saved "
+                "without offload or from an incompatible config")
+        offload.load_state_files(off_dir)
 
     meta = {}
     meta_path = os.path.join(ckpt_dir, "client_state.json")
@@ -149,6 +187,10 @@ def dataclasses_replace_state(current, restored, module_only: bool, opt: bool):
 def save_16bit_model(engine, save_dir: str, filename: str = "pytree_model"):
     """Consolidated compute-precision weights only (reference save_16bit_model,
     engine.py:3354)."""
+    if engine.state is None:
+        raise NotImplementedError(
+            "save_16bit_model with offload_param: the bf16 params already "
+            "live as per-leaf NVMe files (offload_param.nvme_path)")
     os.makedirs(save_dir, exist_ok=True)
     ce = OrbaxCheckpointEngine()
     path = os.path.join(save_dir, filename)

@@ -438,9 +438,18 @@ class DeepSpeedConfig:
         zc = self.zero_config
 
         if zc.offload_param is not None and \
-                zc.offload_param.device != OffloadDeviceEnum.none:
-            bad.append("zero_optimization.offload_param.device="
-                       f"{zc.offload_param.device} (param offload)")
+                zc.offload_param.device == OffloadDeviceEnum.cpu:
+            bad.append("zero_optimization.offload_param.device=cpu "
+                       "(use device=nvme for the layer-streamed param "
+                       "offload, or offload_optimizer for state-only offload)")
+        if zc.offload_param is not None and \
+                zc.offload_param.device == OffloadDeviceEnum.nvme:
+            if not zc.offload_param.nvme_path:
+                bad.append("zero_optimization.offload_param.device=nvme "
+                           "requires nvme_path")
+            if zc.stage != 3:
+                bad.append("zero_optimization.offload_param requires "
+                           "stage=3 (reference restriction)")
         if zc.offload_optimizer is not None and \
                 zc.offload_optimizer.device == OffloadDeviceEnum.nvme and \
                 not zc.offload_optimizer.nvme_path:

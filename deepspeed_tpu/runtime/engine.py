@@ -41,7 +41,11 @@ from .fp16.loss_scaler import (LossScaleState, dynamic_loss_scale_state,
                                static_loss_scale_state, no_loss_scale_state, scale_loss,
                                grads_finite, update_scale)
 from .zero.planner import plan_sharding, named_shardings, constrain, ZeroShardingPlan
-from ..parallel.mesh import (MeshLayout, initialize_mesh, batch_pspec, dp_world_size,
+from .offload import (resolve_offload_mode, apply_streamed_placement,
+                      HostSteppedOffload)
+from .features import (wire_compression, wire_progressive_layer_drop,
+                       wire_curriculum, wire_random_ltd, wire_flops_profiler)
+from ..parallel.mesh import (dp_world_size, resolve_engine_mesh,
                              BATCH_AXES, ZERO_AXES)
 from ..utils.logging import logger, log_dist
 from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
@@ -153,64 +157,12 @@ class DeepSpeedEngine:
 
         # -- config / mesh --
         self.config = config if isinstance(config, DeepSpeedConfig) else DeepSpeedConfig(config)
-        mc = self.config.mesh
-        mics = self.config.zero_config.mics_shard_size
+        # MiCS/hpZ both factorize the data axis; hpZ's planner divergence
+        # (masters/grads on the FULL group, compute view inner-only) is
+        # applied below via zero_axes
         hpz = self.config.zero_config.zero_hpz_partition_size
-        if mics > 0 and hpz > 1:
-            raise ValueError(
-                "mics_shard_size and zero_hpz_partition_size both factorize "
-                "the data axis — enable one or the other")
-        if hpz > 1:
-            # hpZ reuses the MiCS mesh factorization (inner group = secondary
-            # partition); the planner diverges: masters/grads stay on the FULL
-            # group, only the compute view shards inner-only
-            mics = hpz
-        if mesh is None:
-            dp_outer = 1
-            if mics > 0:
-                # MiCS: ZeRO shards within groups of `mics_shard_size`
-                # devices, replicated across 'data_outer' replica groups
-                # (reference runtime/zero/mics.py:351 — there via nested
-                # process groups, here via mesh factorization: ZERO_AXES stay
-                # inner, BATCH_AXES span both).  ZeRO shards over
-                # ZERO_AXES=('data','expert'), so the group spans the expert
-                # axis too: inner data size = mics / ep.
-                denom = mc.tp * mc.pp * mc.ep * mc.sp
-                world = jax.device_count()
-                if mc.dp is None and world % denom != 0:
-                    raise ValueError(
-                        f"world size {world} not divisible by "
-                        f"tp*pp*ep*sp={denom}")
-                full_dp = mc.dp or (world // denom)
-                if mics % mc.ep != 0:
-                    raise ValueError(
-                        f"mics_shard_size={mics} must be a multiple of "
-                        f"ep={mc.ep}: ZeRO shard groups span the expert axis")
-                inner_dp = mics // mc.ep
-                if full_dp % inner_dp != 0:
-                    raise ValueError(
-                        f"mics_shard_size={mics} (inner data degree "
-                        f"{inner_dp} after the ep={mc.ep} factor) must "
-                        f"divide the DP degree {full_dp}")
-                dp_outer = full_dp // inner_dp
-                mics = inner_dp
-            layout = MeshLayout.from_world(
-                jax.device_count(), tp=mc.tp, pp=mc.pp, ep=mc.ep, sp=mc.sp,
-                dp=(mics if mics > 0 else (mc.dp or None)), dp_outer=dp_outer)
-            mesh = initialize_mesh(layout)
-        elif mics > 0:
-            # ZeRO shard group on an explicit mesh = inner data × expert
-            group = mesh.shape.get("data", 1) * mesh.shape.get("expert", 1)
-            if group != mics:
-                raise ValueError(
-                    f"mics_shard_size={mics} conflicts with the explicit "
-                    f"mesh's ZeRO group size data×expert={group}; build the "
-                    f"mesh with MeshLayout(dp=mics//ep, dp_outer=...) instead")
-        if mics > 0 and self.config.zero_config.mics_hierarchical_params_gather:
-            # XLA already emits hierarchical collectives for factorized-axis
-            # shardings; the knob is satisfied structurally
-            log_dist("MiCS: hierarchical gather is implicit in the factorized "
-                     "mesh (XLA hierarchical collectives)", ranks=[0])
+        mesh = resolve_engine_mesh(self.config.mesh, self.config.zero_config,
+                                   mesh)
         self.mesh = mesh
         self.dp_world = dp_world_size(mesh)
         self.config.resolve_batch_triad(self.dp_world)
@@ -246,48 +198,7 @@ class DeepSpeedEngine:
                      "under GSPMD)", ranks=[0])
 
         # -- compression (QAT / pruning transform on the compute tree) --
-        from ..compression import build_param_transform, parse_compression_config
-
-        model_heads = getattr(getattr(model, "config", None), "num_heads", None)
-        self._compression_transform = build_param_transform(
-            self.config._param_dict, num_heads=model_heads)
-        # activation quantization is a FORWARD concern, not a param
-        # transform: push it into the model config (the transformer applies
-        # fake-quant at the post-norm attention/MLP inputs)
-        aq = [t for t in parse_compression_config(self.config._param_dict)
-              if t.kind == "activation_quantization"]
-        if aq:
-            mcfg = getattr(model, "config", None)
-            if mcfg is None or not hasattr(mcfg, "act_quant_bits"):
-                raise NotImplementedError(
-                    "activation_quantization needs a model whose config "
-                    "supports act_quant_bits (deepspeed_tpu.models.CausalLM)")
-            t = aq[0]
-            # the wiring is MODEL-WIDE (one bits value at every block's
-            # attention/MLP inputs): reject config shapes it cannot honor
-            # instead of silently approximating them
-            all_bits = {int(g.params.get("bits", 8)) for g in t.groups} or {8}
-            if len(all_bits) > 1 or any(
-                    set(g.modules) not in ({"*"}, set()) for g in t.groups):
-                raise NotImplementedError(
-                    "activation_quantization is applied model-wide: use ONE "
-                    "group with modules=['*'] and a single bits value")
-            if int(t.shared.get("schedule_offset", 0)) != 0:
-                raise NotImplementedError(
-                    "activation_quantization.schedule_offset is not "
-                    "supported (fake-quant engages from step 0)")
-            if t.shared.get("range_calibration", "dynamic") != "dynamic":
-                raise NotImplementedError(
-                    "activation_quantization static range calibration is not "
-                    "wired from the config (dynamic per-tensor only)")
-            bits = all_bits.pop()
-            sym = t.shared.get("quantization_type",
-                               "asymmetric") == "symmetric"
-            model.config = dataclasses.replace(
-                mcfg, act_quant_bits=bits, act_quant_symmetric=sym)
-            log_dist(f"activation quantization: {bits}-bit "
-                     f"{'symmetric' if sym else 'asymmetric'} at the "
-                     "attention/MLP inputs", ranks=[0])
+        wire_compression(self, model)
 
         # -- lr schedule --
         if lr_scheduler is not None:
@@ -310,23 +221,131 @@ class DeepSpeedEngine:
             opt_params = dict(opt_cfg.params) if opt_cfg else {}
             self.optimizer = create_optimizer(opt_type, opt_params, self.lr_schedule,
                                               self.config.gradient_clipping)
-            if opt_type.lower().replace("_", "") in ("onebitadam", "onebitlamb",
-                                                     "zerooneadam"):
-                # 1-bit path: error-feedback sign-compressed grad exchange
-                # after freeze_step warmup (reference fp16/onebit/adam.py:308)
-                self._compression = {
-                    "freeze_step": int(opt_params.get("freeze_step", 100))}
+            norm_type = opt_type.lower().replace("_", "")
+            if norm_type in ("onebitadam", "onebitlamb", "zerooneadam"):
                 for ax in ("model", "seq", "pipe", "expert"):
                     if self.mesh.shape.get(ax, 1) > 1:
                         raise ValueError(
                             f"1-bit optimizers need a pure-DP mesh ({ax} "
                             f"axis has size {self.mesh.shape[ax]})")
+            if norm_type == "zerooneadam":
+                # 0/1 Adam (runtime/comm/zero_one.py): variance freeze +
+                # local-step intervals — a DISTINCT algorithm from the
+                # EF-sign 1-bit path (reference fp16/onebit/zoadam.py)
+                if self.zero_stage != 0:
+                    raise ValueError(
+                        "ZeroOneAdam composes with ZeRO stage 0 only (the "
+                        "in-region update reads replicated masters; the "
+                        "reference tutorial lists the same ZeRO "
+                        "incompatibility)")
+                if self.fp16_enabled:
+                    raise NotImplementedError(
+                        "ZeroOneAdam + fp16 loss scaling: the local-step "
+                        "phase has no per-worker overflow protocol")
+                if self.config.gradient_clipping:
+                    raise NotImplementedError(
+                        "ZeroOneAdam supports max_grad_norm=0 only "
+                        "(reference zoadam.py has the same default; clipping "
+                        "a locally-drifted update is undefined)")
+                if self.config.zero_config.offload_optimizer is not None:
+                    raise NotImplementedError(
+                        "ZeroOneAdam + optimizer offload: unsupported")
+                if self._compression_transform is not None:
+                    raise NotImplementedError(
+                        "ZeroOneAdam + compression_training: the in-region "
+                        "update differentiates the raw masters and would "
+                        "silently skip the QAT/pruning transform")
+                self._compression = {"algo": "zo", "hyper": dict(opt_params)}
+            elif norm_type in ("onebitadam", "onebitlamb"):
+                # 1-bit path: error-feedback sign-compressed grad exchange
+                # after freeze_step warmup (reference fp16/onebit/adam.py:308)
+                self._compression = {
+                    "algo": "ef",
+                    "freeze_step": int(opt_params.get("freeze_step", 100))}
                 if self.zero_stage > 1:
                     raise ValueError(
                         "1-bit optimizers compose with ZeRO stage <= 1 only "
                         "(stages 2/3 shard gradients; the reference has the "
                         "same restriction)")
 
+        # -- ZeRO-Infinity parameter offload: params live on NVMe and a
+        #    layer-streamed executor (runtime/zero/infinity.py) replaces the
+        #    fused jitted step entirely --
+        self._param_offload = None
+        zpo = self.config.zero_config.offload_param
+        po_dev = getattr(zpo.device, "value", zpo.device) if zpo else "none"
+        if po_dev == "nvme":
+            from .zero.infinity import InfinityParamEngine
+
+            if self._compression_transform is not None:
+                raise NotImplementedError(
+                    "offload_param + compression_training: unsupported")
+            if self._compression is not None:
+                raise NotImplementedError(
+                    "offload_param + 1-bit optimizers: unsupported")
+            if self.config.data_efficiency.data_routing.random_ltd.enabled:
+                raise NotImplementedError(
+                    "offload_param + random_ltd: the layer-streamed executor "
+                    "builds its programs from the base model config")
+            if self.config.flops_profiler.enabled:
+                raise NotImplementedError(
+                    "offload_param + flops_profiler: the profiler hooks the "
+                    "fused jitted step, which this path replaces")
+            zoo = self.config.zero_config.offload_optimizer
+            if zoo is not None and \
+                    getattr(zoo.device, "value", zoo.device) != "none":
+                raise NotImplementedError(
+                    "offload_param already places the optimizer state on its "
+                    "own NVMe path (masters + moments live beside the "
+                    "params); a simultaneous offload_optimizer config would "
+                    "be silently ignored — remove it")
+            self._param_offload = InfinityParamEngine(
+                self.config, model, self.lr_schedule, mesh)
+            self._offload = None
+            self.offload_active = False
+            self._offload_dev_shardings = None
+            self._train_out_shardings = None
+            self._compute_cast = None
+            self.plan = None
+            self.state = None
+            self.param_count = self._param_offload.param_count
+        else:
+            self._init_device_state(init_fn, params, param_specs, mesh, hpz)
+
+        # -- bookkeeping --
+        self.global_steps = 0
+        self.skipped_steps = 0
+        self.micro_steps = 0
+        self.timers = SynchronizedWallClockTimer()
+        self.tput_timer = ThroughputTimer(batch_size=self.train_batch_size,
+                                          steps_per_output=self.config.steps_per_print)
+        self._compiled_train_step = None
+        self._compiled_grad_step = None
+        self._compiled_eval_step = None
+        self._compiled_micro_grad = None
+        self._compiled_apply_step = None
+        self._accum_grads = None
+        self._accum_count = 0
+        self._window_losses = []
+        self._last_grad_norm: Optional[float] = None
+        self._data_iterator = None
+        self.training_dataloader = self._build_dataloader(training_data)
+        self.monitor = self._build_monitor()
+        # -- optional training features (runtime/features.py owns config
+        #    resolution + validation for each) --
+        wire_progressive_layer_drop(self)
+        wire_curriculum(self)
+        wire_random_ltd(self, self.model)
+        wire_flops_profiler(self)
+        log_dist(
+            f"engine ready: params={self.param_count:,} zero_stage={self.zero_stage} "
+            f"dtype={self.compute_dtype.__name__} mesh={dict(mesh.shape)} "
+            f"batch={self.train_batch_size} (micro={self.micro_batch_size} gas={self.gas} "
+            f"dp={self.dp_world})", ranks=[0])
+
+    def _init_device_state(self, init_fn, params, param_specs, mesh, hpz):
+        """Build the device-resident TrainState: sharded init, ZeRO planning,
+        optimizer state, loss scaler, offload placement."""
         # -- sharded initialization (the zero.Init analogue: params are BORN
         #    sharded; nothing ever materializes replicated, reference
         #    partition_parameters.py:681) --
@@ -386,47 +405,25 @@ class DeepSpeedEngine:
             # fp32 mode: params ARE the masters; keep one copy
             master = None
 
-        # -- ZeRO-Infinity: optimizer state (fp32 masters + Adam moments)
-        #    lives on NVMe; the device holds ONLY bf16 compute params and the
-        #    host applies the native SIMD Adam between steps (reference
-        #    runtime/swap_tensor/partitioned_optimizer_swapper.py +
-        #    csrc/adam/cpu_adam.cpp).
-        self._nvme_swapper = None
-        zc0 = self.config.zero_config
-        nvme_dev = zc0.offload_optimizer.device if zc0.offload_optimizer else None
-        nvme_dev = getattr(nvme_dev, "value", nvme_dev)
-        # device=cpu with ONE data shard: park-and-stream would still pull the
-        # FULL fp32 master/m/v into HBM inside the step, so single-shard cpu
-        # offload routes through the same host-step path as NVMe (state in
-        # RAM instead of on disk) unless host_step=False forces streaming.
-        host_step = False
-        if nvme_dev == "cpu":
-            hs = zc0.offload_optimizer.host_step
-            if hs is not None:
-                host_step = bool(hs)
-            else:
-                # auto: host step only where it's BOTH needed (one data
-                # shard — streaming would pull the full fp32 state into HBM
-                # inside the step) and supported by the host path's
-                # preconditions; otherwise keep the streamed placement,
-                # which handles fp32/fp16/any-optimizer/compression and
-                # checkpointing
-                opt_cfg0 = self.config.optimizer
-                opt_type0 = (opt_cfg0.type if opt_cfg0 else "adamw").lower()
-                host_step = (dp_world_size(mesh) == 1
-                             and master is not None
-                             and not self.fp16_enabled
-                             and self._compression_transform is None
-                             and opt_type0 in ("adam", "adamw"))
-        if nvme_dev == "nvme" or host_step:
-            if self._compression_transform is not None:
-                raise NotImplementedError(
-                    "compression_training with host-stepped optimizer "
-                    "offload is not supported: the grad-only step "
-                    "differentiates the raw params and would silently skip "
-                    "the QAT/pruning transform")
-            self._init_nvme_offload(master, params0, storage=nvme_dev)
+        # -- ZeRO-Offload / ZeRO-Infinity: where the fp32 optimizer state
+        #    rests (runtime/offload.py owns the decision + mechanisms).
+        self._offload = None
+        offload_mode = resolve_offload_mode(
+            self.config, mesh, use_master_weights=master is not None,
+            fp16_enabled=self.fp16_enabled,
+            has_compression=self._compression_transform is not None)
+        if offload_mode in ("host_step", "nvme"):
+            self._offload = HostSteppedOffload(
+                self.config, master, self._param_shardings,
+                storage=("cpu" if offload_mode == "host_step" else "nvme"),
+                fp16_enabled=self.fp16_enabled,
+                has_compression=self._compression_transform is not None)
             master = None
+            opt_state = ()
+        elif self._compression is not None and \
+                self._compression.get("algo") == "zo":
+            # 0/1 Adam owns its whole optimizer state (ZeroOneState rides
+            # the comm_error slot below); no optax state
             opt_state = ()
         else:
             opt_state = jax.jit(self.optimizer.init)(
@@ -451,42 +448,29 @@ class DeepSpeedEngine:
             lambda x: jax.device_put(x, replicated)
             if hasattr(x, "shape") and not hasattr(x.sharding, "spec") else x, opt_state)
 
-        # -- ZeRO-Offload: optimizer state (and fp32 masters) live in host
-        #    RAM between steps (reference stage_1_and_2.py:1041-1124 CPU
-        #    offload).  TPU-native form: the SAME dp-sharded layout, placed in
-        #    pinned host memory via sharding memory kinds; XLA streams shards
-        #    over PCIe into the jitted step and lands the updated state back
-        #    on the host (out_shardings below), so HBM never holds optimizer
-        #    state at rest.
+        # -- ZeRO-Offload streamed placement: optimizer state (and fp32
+        #    masters) rest in pinned host memory; XLA streams the dp-shards
+        #    over PCIe into the jitted step and lands them back on the host
+        #    (out_shardings below), so HBM never holds optimizer state at
+        #    rest (reference stage_1_and_2.py:1041-1124 CPU offload).
         self.offload_active = False
-        zc = self.config.zero_config
-        dev = zc.offload_optimizer.device if zc.offload_optimizer else "none"
-        want_offload = (getattr(dev, "value", dev) == "cpu"
-                        and self._nvme_swapper is None)
-        if want_offload:
-            if jax.devices()[0].platform == "cpu":
-                # Host and "device" memory are the same RAM on the CPU
-                # backend (and XLA cannot compile placement annotations on a
-                # forced multi-device host mesh) — the placement would be a
-                # no-op; the code path is still exercised minus memory kinds.
-                logger.warning(
-                    "offload_optimizer.device=cpu: CPU backend — host memory "
-                    "IS device memory; offload placement skipped")
-            else:
-                to_host = lambda x: jax.device_put(  # noqa: E731
-                    x, x.sharding.with_memory_kind("pinned_host"))
-                opt_state = jax.tree_util.tree_map(to_host, opt_state)
-                if master is not None:
-                    master = jax.tree_util.tree_map(to_host, master)
-                self.offload_active = True
+        self._offload_dev_shardings = None
+        if offload_mode == "streamed":
+            opt_state, master, self._offload_dev_shardings, \
+                self.offload_active = apply_streamed_placement(opt_state, master)
         comm_error = None
         if self._compression is not None:
-            from .comm.compressed import init_error_tree
-
             template = master if self.use_master_weights else params0
-            comm_error = jax.device_put(
-                init_error_tree(template, self.mesh),
-                NamedSharding(self.mesh, P(BATCH_AXES)))
+            if self._compression.get("algo") == "zo":
+                from .comm.zero_one import init_zero_one_state
+
+                comm_error = init_zero_one_state(template, self.mesh)
+            else:
+                from .comm.compressed import init_error_tree
+
+                comm_error = jax.device_put(
+                    init_error_tree(template, self.mesh),
+                    NamedSharding(self.mesh, P(BATCH_AXES)))
         self.state = TrainState(step=step0, params=params0, master_params=master,
                                 opt_state=opt_state, scaler=scaler, rng=seed_rng,
                                 comm_error=comm_error)
@@ -494,94 +478,11 @@ class DeepSpeedEngine:
         # for offloaded leaves); metrics come back replicated on device.
         # The matching device-kind shardings stream the offloaded leaves INTO
         # the step (XLA refuses compute on host-placed operands).
-        if self.offload_active:
-            self._train_out_shardings = (
-                jax.tree_util.tree_map(lambda x: x.sharding, self.state), replicated)
-            to_dev = lambda x: x.sharding.with_memory_kind("device")  # noqa: E731
-            self._offload_dev_shardings = (
-                jax.tree_util.tree_map(to_dev, master) if master is not None else None,
-                jax.tree_util.tree_map(to_dev, opt_state))
-        else:
-            self._train_out_shardings = None
-            self._offload_dev_shardings = None
-
-        # -- bookkeeping --
-        self.global_steps = 0
-        self.skipped_steps = 0
-        self.micro_steps = 0
-        self.timers = SynchronizedWallClockTimer()
-        self.tput_timer = ThroughputTimer(batch_size=self.train_batch_size,
-                                          steps_per_output=self.config.steps_per_print)
-        self._compiled_train_step = None
-        self._compiled_grad_step = None
-        self._compiled_eval_step = None
-        self._compiled_micro_grad = None
-        self._compiled_apply_step = None
-        self._accum_grads = None
-        self._accum_count = 0
-        self._window_losses = []
-        self._last_grad_norm: Optional[float] = None
-        self._data_iterator = None
-        self.training_dataloader = self._build_dataloader(training_data)
-        self.monitor = self._build_monitor()
-        # -- progressive layer drop (reference engine.progressive_layer_drop;
-        #    the schedule lives here, the model consumes batch['pld_theta']) --
-        self.progressive_layer_drop = None
-        pld_cfg = self.config.progressive_layer_drop
-        if pld_cfg.enabled:
-            from .progressive_layer_drop import ProgressiveLayerDrop
-
-            self.progressive_layer_drop = ProgressiveLayerDrop(
-                theta=pld_cfg.theta, gamma=pld_cfg.gamma)
-
-        # -- data efficiency ------------------------------------------------
-        self.curriculum_scheduler = None
-        cl = self.config.curriculum_learning
-        if cl.enabled:
-            from .data_pipeline.curriculum_scheduler import CurriculumScheduler
-
-            if cl.curriculum_type != "seqlen":
-                raise NotImplementedError(
-                    f"curriculum_type {cl.curriculum_type!r}: only 'seqlen' "
-                    "(sequence truncation) is implemented")
-            self.curriculum_scheduler = CurriculumScheduler({
-                "curriculum_type": cl.curriculum_type,
-                "min_difficulty": cl.min_difficulty,
-                "max_difficulty": cl.max_difficulty,
-                "schedule_type": cl.schedule_type,
-                "schedule_config": cl.schedule_config,
-            })
-        self._random_ltd = None
-        self._ltd_keep = None
-        self._ltd_cache = {}
-        rltd = self.config.data_efficiency.data_routing.random_ltd
-        if rltd.enabled:
-            from .data_pipeline.data_routing.random_ltd import RandomLTDScheduler
-
-            if self.model is None or not hasattr(self.model, "config") \
-                    or not hasattr(self.model.config, "random_ltd"):
-                raise ValueError("random_ltd requires a CausalLM-style model "
-                                 "(TransformerConfig with random_ltd fields)")
-            self._random_ltd = RandomLTDScheduler(
-                {"min_value": rltd.min_value, "max_value": rltd.max_value,
-                 "random_ltd_schedule": rltd.random_ltd_schedule})
-        self.flops_profiler = None
-        if self.config.flops_profiler.enabled:
-            from ..profiling.flops_profiler import FlopsProfiler
-
-            self.flops_profiler = FlopsProfiler(engine=self,
-                                                config=self.config.flops_profiler)
-            if self.config.flops_profiler.profile_step <= 1:
-                log_dist("flops_profiler: profile_step=1 measures the first "
-                         "call, which INCLUDES jit compilation — set "
-                         "profile_step>=2 for steady-state latency", ranks=[0])
+        self._train_out_shardings = (
+            (jax.tree_util.tree_map(lambda x: x.sharding, self.state), replicated)
+            if self.offload_active else None)
         self.param_count = sum(
             int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(shapes))
-        log_dist(
-            f"engine ready: params={self.param_count:,} zero_stage={self.zero_stage} "
-            f"dtype={self.compute_dtype.__name__} mesh={dict(mesh.shape)} "
-            f"batch={self.train_batch_size} (micro={self.micro_batch_size} gas={self.gas} "
-            f"dp={self.dp_world})", ranks=[0])
 
     # ------------------------------------------------------------------
     def _build_dataloader(self, training_data):
@@ -732,60 +633,15 @@ class DeepSpeedEngine:
         log_dist(f"random-LTD: keep={keep} tokens/layer "
                  f"({'active' if active else 'full sequence'})", ranks=[0])
 
-    def _init_nvme_offload(self, master, params0, storage: str = "nvme"):
-        """Move fp32 masters + (to-be-created) Adam moments off-device —
-        ``storage="nvme"``: files stepped through aio (ZeRO-Infinity);
-        ``storage="cpu"``: resident host RAM (ZeRO-Offload).  Either way the
-        host applies the native SIMD Adam kernel between steps.
+    # -- host-stepped offload surface (runtime/offload.py owns the state;
+    #    these properties keep the engine's historical attribute names) --
+    @property
+    def _nvme_swapper(self):
+        return self._offload.optimizer if self._offload is not None else None
 
-        Step cost = one fp32-grad download + one bf16-param upload per step
-        (params bytes x6 round trip) — ~0.4s/step for a 1B model over a
-        TPU-VM's local PCIe.  On remote/tunneled device backends that link
-        can be orders of magnitude slower; offload throughput follows the
-        host link, by construction."""
-        if master is None:
-            raise ValueError("optimizer offload requires bf16/fp16 "
-                             "compute (fp32 params have no separate masters "
-                             "to offload)")
-        if self.fp16_enabled:
-            raise NotImplementedError(
-                "host-stepped offload currently pairs with bf16 (fp16 dynamic loss "
-                "scaling would need host-side overflow handling)")
-        opt_cfg = self.config.optimizer
-        opt_type = (opt_cfg.type if opt_cfg else "adamw").lower()
-        if opt_type not in ("adam", "adamw"):
-            raise NotImplementedError(
-                f"host-stepped offload runs the native CPU Adam kernel; optimizer "
-                f"{opt_type!r} is not supported on the host path")
-        from .swap_tensor import HostAdamOptimizer, SwappedAdamOptimizer
-
-        zc = self.config.zero_config.offload_optimizer
-        p = dict(opt_cfg.params) if opt_cfg else {}
-        flat, treedef = jax.tree_util.tree_flatten_with_path(master)
-        names = [jax.tree_util.keystr(path) for path, _ in flat]
-        with jax.transfer_guard("allow"):
-            masters_np = {n: np.asarray(x, np.float32)
-                          for n, (_, x) in zip(names, flat)}
-        self._nvme_names = names
-        self._nvme_treedef = treedef
-        adam_kw = dict(
-            lr=p.get("lr", 1e-3), betas=tuple(p.get("betas", (0.9, 0.999))),
-            eps=p.get("eps", 1e-8), weight_decay=p.get("weight_decay", 0.0),
-            adamw_mode=bool(p.get("adam_w_mode", opt_type == "adamw")))
-        if storage == "cpu":
-            self._nvme_swapper = HostAdamOptimizer(masters_np, **adam_kw)
-            log_dist("ZeRO-Offload: optimizer state in host RAM "
-                     f"({self._nvme_swapper.state_bytes() / 1e9:.2f} GB), "
-                     "host SIMD Adam step", ranks=[0])
-        else:
-            self._nvme_swapper = SwappedAdamOptimizer(
-                masters_np, zc.nvme_path,
-                aio_threads=max(self.config.aio.thread_count,
-                                self.config.aio.queue_depth // 2, 1),
-                pipeline=bool(zc.pipeline_read or zc.pipeline_write),
-                **adam_kw)
-            log_dist(f"ZeRO-Infinity: optimizer state on NVMe at {zc.nvme_path} "
-                     f"({self._nvme_swapper.state_bytes() / 1e9:.2f} GB)", ranks=[0])
+    @property
+    def _nvme_names(self):
+        return self._offload.names if self._offload is not None else None
 
     def _make_grad_only_step(self):
         gas = self.gas
@@ -819,20 +675,9 @@ class DeepSpeedEngine:
         self.tput_timer.start()
         grads, loss, grad_norm, new_rng = self._compiled_grad_step(
             self.state, global_batch)
-        flat_grads = jax.tree_util.tree_leaves(grads)
-        with jax.transfer_guard("allow"):
-            grads_np = {n: np.asarray(g, np.float32)
-                        for n, g in zip(self._nvme_names, flat_grads)}
         lr = float(self.lr_schedule(self.global_steps)) \
             if callable(self.lr_schedule) else float(self.lr_schedule)
-        bf16 = self._nvme_swapper.step(grads_np, lr=lr)
-        import ml_dtypes
-
-        leaves = []
-        shard_leaves = jax.tree_util.tree_leaves(self._param_shardings)
-        for n, sh in zip(self._nvme_names, shard_leaves):
-            leaves.append(jax.device_put(bf16[n].view(ml_dtypes.bfloat16), sh))
-        new_params = jax.tree_util.tree_unflatten(self._nvme_treedef, leaves)
+        new_params = self._offload.host_step(grads, lr)
         self.state = dataclasses.replace(
             self.state, params=new_params, step=self.state.step + 1,
             rng=new_rng)
@@ -849,6 +694,20 @@ class DeepSpeedEngine:
             self._report_progress(metrics)
         return loss_val
 
+    def _train_batch_param_offload(self, global_batch):
+        """ZeRO-Infinity param offload: the layer-streamed executor owns the
+        whole step (fwd/bwd layer loop + host Adam)."""
+        self.tput_timer.start()
+        loss, metrics = self._param_offload.train_batch(global_batch)
+        self.global_steps += 1
+        self.micro_steps += self.gas
+        self._last_grad_norm = float(metrics["grad_norm"])
+        self.tput_timer.stop(sync_tree=loss)
+        self._emit_monitor_events(metrics)
+        if self.global_steps % self.config.steps_per_print == 0:
+            self._report_progress(metrics)
+        return loss
+
     def _make_train_step(self):
         gas = self.gas
         grad_specs = self._grad_shardings
@@ -859,6 +718,50 @@ class DeepSpeedEngine:
         stream_in = self._stream_in
 
         compression = self._compression
+        if compression is not None and compression.get("algo") == "zo":
+            # 0/1 Adam: the region owns grads AND the update (variance
+            # freeze + local steps need per-worker momentum/delta state)
+            from .comm.zero_one import make_zero_one_step
+
+            use_master = self.use_master_weights
+            compute_dtype = self.compute_dtype
+            param_shardings = self._param_shardings
+            lr_schedule = self.lr_schedule
+            template = (self.state.master_params if use_master
+                        else self.state.params)
+            zo_fn = make_zero_one_step(
+                make_grad_accumulator(grad_of_batch, gas,
+                                      self.config.data_types.jnp_dtype()),
+                self.mesh, gas, compute_dtype, template,
+                compression["hyper"])
+
+            def train_step(state: TrainState, batch):
+                masters = (state.master_params if use_master
+                           else state.params)
+                new_rng, region_rng = jax.random.split(state.rng)
+                lr = jnp.float32(lr_schedule(state.step))
+                new_masters, new_zo, loss, gnorm = zo_fn(
+                    masters, state.scaler, batch, region_rng,
+                    state.comm_error, state.step, lr)
+                if use_master:
+                    params = constrain(_cast_tree(new_masters, compute_dtype),
+                                       param_shardings)
+                    new_state = TrainState(
+                        step=state.step + 1, params=params,
+                        master_params=new_masters, opt_state=(),
+                        scaler=state.scaler, rng=new_rng, comm_error=new_zo)
+                else:
+                    new_state = TrainState(
+                        step=state.step + 1, params=new_masters,
+                        master_params=None, opt_state=(),
+                        scaler=state.scaler, rng=new_rng, comm_error=new_zo)
+                metrics = {"loss": loss, "grad_norm": gnorm,
+                           "loss_scale": state.scaler.loss_scale,
+                           "step_applied": jnp.bool_(True)}
+                return new_state, metrics
+
+            return _jit_step(train_step, donate_argnums=(0,))
+
         if compression is not None:
             from .comm.compressed import make_compressed_grad_fn
 
@@ -1031,6 +934,8 @@ class DeepSpeedEngine:
             keep = self._random_ltd.update_seq(self.global_steps)
             if keep != self._ltd_keep:
                 self._swap_ltd_variant(keep)
+        if self._param_offload is not None:
+            return self._train_batch_param_offload(global_batch)
         if self._nvme_swapper is not None:
             return self._train_batch_nvme(global_batch)
         if self._compiled_train_step is None:
@@ -1071,6 +976,10 @@ class DeepSpeedEngine:
         return metrics["loss"]
 
     def eval_batch(self, batch) -> jnp.ndarray:
+        if self._param_offload is not None:
+            raise NotImplementedError(
+                "eval_batch with offload_param is not wired up (the eval "
+                "step would need its own layer-streamed loop)")
         if self._compiled_eval_step is None:
             self._compiled_eval_step = self._make_eval_step()
         micro = self._shard_batch_eval(batch)
@@ -1136,9 +1045,10 @@ class DeepSpeedEngine:
         subsequent ``train_batch`` call does not recompile."""
         global_batch = self._collect_global_batch(batch)
         global_batch = self._inject_pld_theta(global_batch, shape=(self.gas,))
-        if self._nvme_swapper is not None:
+        if self._nvme_swapper is not None or self._param_offload is not None:
             raise NotImplementedError(
-                "compile_train_step does not cover the NVMe grad-only path")
+                "compile_train_step does not cover the NVMe grad-only / "
+                "layer-streamed offload paths")
         if self._compiled_train_step is None:
             self._compiled_train_step = self._make_train_step()
         return self._compiled_train_step.lower(self.state,
@@ -1197,6 +1107,10 @@ class DeepSpeedEngine:
             raise RuntimeError("pipeline engines train with train_batch(); "
                                "per-microbatch forward/backward is not exposed "
                                "(reference PipelineEngine restriction)")
+        if self._param_offload is not None:
+            raise RuntimeError(
+                "offload_param engines train with train_batch() (the layer-"
+                "streamed executor owns the fwd/bwd schedule)")
         if self._compression is not None:
             raise NotImplementedError(
                 "1-bit optimizers run through train_batch() (the compressed "
@@ -1293,10 +1207,13 @@ class DeepSpeedEngine:
         return [self.get_current_lr()]
 
     def get_current_lr(self) -> float:
-        return float(self.lr_schedule(self.state.step))
+        step = self.global_steps if self.state is None else self.state.step
+        return float(self.lr_schedule(step))
 
     @property
     def loss_scale(self) -> float:
+        if self.state is None:
+            return 1.0  # offload_param: bf16-only, no loss scaling
         return float(self.state.scaler.loss_scale)
 
     def get_global_grad_norm(self) -> Optional[float]:
@@ -1306,9 +1223,17 @@ class DeepSpeedEngine:
 
     @property
     def module(self):
+        if self.state is None:
+            raise NotImplementedError(
+                "offload_param engines hold no device param tree; use "
+                "engine._param_offload.read_masters() for the fp32 leaves")
         return self.state.params
 
     def get_params(self, fp32: bool = False):
+        if self.state is None:
+            raise NotImplementedError(
+                "offload_param engines hold no device param tree; use "
+                "engine._param_offload.read_masters() for the fp32 leaves")
         if fp32 and self.state.master_params is not None:
             return self.state.master_params
         return self.state.params
@@ -1317,30 +1242,18 @@ class DeepSpeedEngine:
     # Checkpointing (reference engine.py:2593-3365) — see checkpoint_engine/
     # ------------------------------------------------------------------
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True):
+        """Save the full training state.  With a host-stepped offload
+        optimizer active (ZeRO-Offload host RAM / ZeRO-Infinity NVMe), the
+        host-resident fp32 masters + Adam moments are serialized alongside
+        the orbax tree (reference swap_tensor/optimizer_utils.py)."""
         from .checkpoint_engine.orbax_engine import save_engine_checkpoint
 
-        if self._nvme_swapper is not None:
-            raise NotImplementedError(
-                "checkpointing with a host-stepped optimizer (NVMe/cpu "
-                "offload) is not wired up yet: the Adam state lives in host "
-                "RAM/swap files, and saving only the device params would "
-                "silently lose it on resume.  For device=cpu, "
-                "offload_optimizer.host_step=false selects the streamed "
-                "placement, which checkpoints normally.")
         return save_engine_checkpoint(self, save_dir, tag=tag, client_state=client_state,
                                       save_latest=save_latest)
 
     def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
                         load_lr_scheduler_states=True, load_module_only=False):
         from .checkpoint_engine.orbax_engine import load_engine_checkpoint
-
-        if self._nvme_swapper is not None:
-            raise NotImplementedError(
-                "checkpointing with a host-stepped optimizer (NVMe/cpu "
-                "offload) is not wired up yet: restoring device params alone "
-                "would desync the host-resident masters/moments.  For "
-                "device=cpu, offload_optimizer.host_step=false selects the "
-                "streamed placement, which checkpoints normally.")
 
         return load_engine_checkpoint(self, load_dir, tag=tag,
                                       load_optimizer_states=load_optimizer_states,

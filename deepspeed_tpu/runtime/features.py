@@ -1,0 +1,130 @@
+"""Engine feature wiring — compression, PLD, curriculum, random-LTD, profiler.
+
+Each ``wire_*`` function owns one optional engine capability's config
+resolution and validation, keeping ``DeepSpeedEngine.__init__`` a composition
+root rather than a 460-line special-case ladder.  Attribute names on the
+engine are part of the public surface (tests and reference parity:
+``engine.progressive_layer_drop``, ``engine.curriculum_scheduler``) and are
+preserved exactly.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from ..utils.logging import log_dist
+
+
+def wire_compression(engine, model):
+    """QAT / pruning param transform + activation fake-quant (reference
+    ``deepspeed/compression/compress.py init_compression``).
+
+    Sets ``engine._compression_transform`` and, when activation quantization
+    is configured, pushes the knobs into the model config (the transformer
+    applies fake-quant at the post-norm attention/MLP inputs) — activation
+    quantization is a FORWARD concern, not a param transform.
+    """
+    from ..compression import build_param_transform, parse_compression_config
+
+    model_heads = getattr(getattr(model, "config", None), "num_heads", None)
+    engine._compression_transform = build_param_transform(
+        engine.config._param_dict, num_heads=model_heads)
+    aq = [t for t in parse_compression_config(engine.config._param_dict)
+          if t.kind == "activation_quantization"]
+    if not aq:
+        return
+    mcfg = getattr(model, "config", None)
+    if mcfg is None or not hasattr(mcfg, "act_quant_bits"):
+        raise NotImplementedError(
+            "activation_quantization needs a model whose config "
+            "supports act_quant_bits (deepspeed_tpu.models.CausalLM)")
+    t = aq[0]
+    # the wiring is MODEL-WIDE (one bits value at every block's
+    # attention/MLP inputs): reject config shapes it cannot honor
+    # instead of silently approximating them
+    all_bits = {int(g.params.get("bits", 8)) for g in t.groups} or {8}
+    if len(all_bits) > 1 or any(
+            set(g.modules) not in ({"*"}, set()) for g in t.groups):
+        raise NotImplementedError(
+            "activation_quantization is applied model-wide: use ONE "
+            "group with modules=['*'] and a single bits value")
+    if int(t.shared.get("schedule_offset", 0)) != 0:
+        raise NotImplementedError(
+            "activation_quantization.schedule_offset is not "
+            "supported (fake-quant engages from step 0)")
+    if t.shared.get("range_calibration", "dynamic") != "dynamic":
+        raise NotImplementedError(
+            "activation_quantization static range calibration is not "
+            "wired from the config (dynamic per-tensor only)")
+    bits = all_bits.pop()
+    sym = t.shared.get("quantization_type", "asymmetric") == "symmetric"
+    model.config = dataclasses.replace(
+        mcfg, act_quant_bits=bits, act_quant_symmetric=sym)
+    log_dist(f"activation quantization: {bits}-bit "
+             f"{'symmetric' if sym else 'asymmetric'} at the "
+             "attention/MLP inputs", ranks=[0])
+
+
+def wire_progressive_layer_drop(engine):
+    """Reference ``engine.progressive_layer_drop``: the schedule lives on the
+    engine, the model consumes ``batch['pld_theta']``."""
+    engine.progressive_layer_drop = None
+    pld_cfg = engine.config.progressive_layer_drop
+    if pld_cfg.enabled:
+        from .progressive_layer_drop import ProgressiveLayerDrop
+
+        engine.progressive_layer_drop = ProgressiveLayerDrop(
+            theta=pld_cfg.theta, gamma=pld_cfg.gamma)
+
+
+def wire_curriculum(engine):
+    """Legacy curriculum learning (reference
+    ``runtime/data_pipeline/curriculum_scheduler.py``) plus the
+    data-efficiency metric-driven scheduler when configured."""
+    engine.curriculum_scheduler = None
+    cl = engine.config.curriculum_learning
+    if cl.enabled:
+        from .data_pipeline.curriculum_scheduler import CurriculumScheduler
+
+        if cl.curriculum_type != "seqlen":
+            raise NotImplementedError(
+                f"curriculum_type {cl.curriculum_type!r}: only 'seqlen' "
+                "(sequence truncation) is implemented")
+        engine.curriculum_scheduler = CurriculumScheduler({
+            "curriculum_type": cl.curriculum_type,
+            "min_difficulty": cl.min_difficulty,
+            "max_difficulty": cl.max_difficulty,
+            "schedule_type": cl.schedule_type,
+            "schedule_config": cl.schedule_config,
+        })
+
+
+def wire_random_ltd(engine, model):
+    """Random layerwise token dropping (reference
+    ``runtime/data_pipeline/data_routing/random_ltd.py``)."""
+    engine._random_ltd = None
+    engine._ltd_keep = None
+    engine._ltd_cache = {}
+    rltd = engine.config.data_efficiency.data_routing.random_ltd
+    if rltd.enabled:
+        from .data_pipeline.data_routing.random_ltd import RandomLTDScheduler
+
+        if model is None or not hasattr(model, "config") \
+                or not hasattr(model.config, "random_ltd"):
+            raise ValueError("random_ltd requires a CausalLM-style model "
+                             "(TransformerConfig with random_ltd fields)")
+        engine._random_ltd = RandomLTDScheduler(
+            {"min_value": rltd.min_value, "max_value": rltd.max_value,
+             "random_ltd_schedule": rltd.random_ltd_schedule})
+
+
+def wire_flops_profiler(engine):
+    engine.flops_profiler = None
+    if engine.config.flops_profiler.enabled:
+        from ..profiling.flops_profiler import FlopsProfiler
+
+        engine.flops_profiler = FlopsProfiler(
+            engine=engine, config=engine.config.flops_profiler)
+        if engine.config.flops_profiler.profile_step <= 1:
+            log_dist("flops_profiler: profile_step=1 measures the first "
+                     "call, which INCLUDES jit compilation — set "
+                     "profile_step>=2 for steady-state latency", ranks=[0])

@@ -38,12 +38,14 @@ class TensorSwapper:
         self.aio_threads = aio_threads
         self._lib = AsyncIOBuilder().load()
         self._shapes: Dict[str, Tuple[int, ...]] = {}
+        self._dtypes: Dict[str, np.dtype] = {}
 
     def _path(self, name: str) -> str:
         return os.path.join(self.swap_dir, name.replace("/", "__") + ".swp")
 
     def write(self, name: str, arr: np.ndarray) -> None:
         self._shapes[name] = arr.shape
+        self._dtypes[name] = arr.dtype
         rc = self._lib.ds_aio_write(self._path(name).encode(),
                                     np.ascontiguousarray(arr).ctypes.data,
                                     arr.nbytes, self.aio_threads)
@@ -53,6 +55,7 @@ class TensorSwapper:
     def submit_write(self, name: str, arr: np.ndarray) -> int:
         """arr must stay alive until wait()."""
         self._shapes[name] = arr.shape
+        self._dtypes[name] = arr.dtype
         return self._lib.ds_aio_submit_write(
             self._path(name).encode(), arr.ctypes.data, arr.nbytes,
             self.aio_threads)
@@ -80,9 +83,10 @@ class TensorSwapper:
 
     def _alloc(self, name: str, out: Optional[np.ndarray]) -> np.ndarray:
         shape = self._shapes[name]
+        dtype = self._dtypes.get(name, np.dtype(np.float32))
         if out is None:
-            out = np.empty(shape, np.float32)
-        assert out.flags["C_CONTIGUOUS"] and out.dtype == np.float32
+            out = np.empty(shape, dtype)
+        assert out.flags["C_CONTIGUOUS"] and out.dtype == dtype
         return out
 
 
@@ -178,6 +182,20 @@ class SwappedAdamOptimizer:
     def read_masters(self) -> Dict[str, np.ndarray]:
         return {n: self.swapper.read(f"{n}.master") for n in self.names}
 
+    def read_state(self, name: str) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(master, exp_avg, exp_avg_sq) for one leaf — checkpointing hook."""
+        return tuple(self.swapper.read(f) for f in self._leaf_files(name))
+
+    def state_shape(self, name: str) -> Tuple[int, ...]:
+        """Master shape without touching the swap files."""
+        return tuple(self.swapper._shapes[f"{name}.master"])
+
+    def write_state(self, name: str, master: np.ndarray, m: np.ndarray,
+                    v: np.ndarray) -> None:
+        """Overwrite one leaf's swap files — checkpoint-restore hook."""
+        for f, arr in zip(self._leaf_files(name), (master, m, v)):
+            self.swapper.write(f, np.ascontiguousarray(arr, dtype=np.float32))
+
     def state_bytes(self) -> int:
         return sum(int(np.prod(self.swapper._shapes[f"{n}.master"])) * 4 * 3
                    for n in self.names)
@@ -231,6 +249,22 @@ class HostAdamOptimizer:
 
     def read_masters(self) -> Dict[str, np.ndarray]:
         return {n: self._state[n][0] for n in self.names}
+
+    def read_state(self, name: str) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(master, exp_avg, exp_avg_sq) for one leaf — checkpointing hook."""
+        master, m, v, _ = self._state[name]
+        return master, m, v
+
+    def state_shape(self, name: str) -> Tuple[int, ...]:
+        return tuple(self._state[name][0].shape)
+
+    def write_state(self, name: str, master: np.ndarray, m: np.ndarray,
+                    v: np.ndarray) -> None:
+        """Overwrite one leaf's resident state in place — restore hook."""
+        s_master, s_m, s_v, _ = self._state[name]
+        np.copyto(s_master, master.reshape(s_master.shape))
+        np.copyto(s_m, m.reshape(s_m.shape))
+        np.copyto(s_v, v.reshape(s_v.shape))
 
     def state_bytes(self) -> int:
         return sum(s[0].nbytes * 3 for s in self._state.values())

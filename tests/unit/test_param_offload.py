@@ -1,0 +1,154 @@
+"""ZeRO-Infinity parameter offload: bf16 params live on NVMe and the
+layer-streamed executor (runtime/zero/infinity.py) drives fwd/bwd layer by
+layer (reference runtime/swap_tensor/partitioned_param_swapper.py:36 +
+runtime/zero/stage3.py:502 offload_param; tests model
+tests/unit/runtime/zero/test_zero_nesting_init + nvme swap tests)."""
+import os
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import CausalLM
+from deepspeed_tpu.ops.op_builder import CPUAdamBuilder
+
+pytestmark = pytest.mark.skipif(
+    CPUAdamBuilder().compiler() is None, reason="no C++ toolchain")
+
+SEQ = 32
+BATCH = 2
+
+
+def _config(tmp_path, **extra):
+    return {
+        "train_micro_batch_size_per_gpu": BATCH,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {
+            "stage": 3,
+            "offload_param": {"device": "nvme",
+                              "nvme_path": str(tmp_path / "params")},
+        },
+        "bf16": {"enabled": True},
+        **extra,
+    }
+
+
+def _batch(model, seed=0, batch=None):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(
+        0, model.config.vocab_size, (batch, SEQ)).astype(np.int32)}
+
+
+def _b(engine, model, seed=0):
+    return _batch(model, seed, batch=engine.train_batch_size)
+
+
+def _engine(tmp_path, model_name="tiny", **extra):
+    model = CausalLM(model_name, max_seq_len=SEQ * 2)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config=_config(tmp_path, **extra))
+    return engine, model
+
+
+def test_param_offload_trains_params_on_disk(tmp_path):
+    engine, model = _engine(tmp_path)
+    # no params or optimizer state on device
+    assert engine.state is None
+    files = os.listdir(tmp_path / "params")
+    assert any(f.endswith(".param.swp") for f in files)
+    assert any(f.endswith(".master.swp") for f in files)
+    # one file quartet per leaf
+    assert len(files) == 4 * len(engine._param_offload._leaf_names)
+    batch = _b(engine, model, 0)
+    losses = [float(engine.train_batch(batch=batch)) for _ in range(6)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
+def test_param_offload_loss_parity_with_device_engine(tmp_path):
+    """Layer-streamed NVMe training must track the ordinary fused step."""
+    model = CausalLM("tiny", max_seq_len=SEQ * 2)
+    ref, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": BATCH,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0},
+        "bf16": {"enabled": True},
+    })
+    engine, model2 = _engine(tmp_path)
+    b = _b(ref, model, 0)
+    for i in range(4):
+        l_ref = float(ref.train_batch(batch=b))
+        l_off = float(engine.train_batch(batch=b))
+        # first step: identical init (same seed) => pre-update loss matches
+        if i == 0:
+            np.testing.assert_allclose(l_off, l_ref, rtol=2e-2)
+    np.testing.assert_allclose(l_off, l_ref, rtol=5e-2)
+
+
+def test_param_offload_tied_embeddings(tmp_path):
+    """tiny-gpt2: tied embeddings + learned positions exercise the
+    stem-grad-through-head path."""
+    engine, model = _engine(tmp_path, model_name="tiny-gpt2")
+    batch = _b(engine, model, 0)
+    losses = [float(engine.train_batch(batch=batch)) for _ in range(5)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
+def test_param_offload_gas(tmp_path):
+    engine, model = _engine(tmp_path, gradient_accumulation_steps=2)
+    batch = _b(engine, model, 0)
+    losses = [float(engine.train_batch(batch=batch)) for _ in range(4)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+def test_param_offload_checkpoint_roundtrip(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    e1, model = _engine(tmp_path)
+    batch = _b(e1, model, 0)
+    for _ in range(3):
+        e1.train_batch(batch=batch)
+    saved = {n: m.copy() for n, m in e1._param_offload.read_masters().items()}
+    e1.save_checkpoint(ckpt, tag="t3")
+    cont = [float(e1.train_batch(batch=_b(e1, model, 10 + i)))
+            for i in range(2)]
+
+    e2, _ = _engine(tmp_path / "fresh")
+    e2.load_checkpoint(ckpt, tag="t3")
+    assert e2._param_offload.step_count == 3
+    restored = e2._param_offload.read_masters()
+    for n in saved:
+        np.testing.assert_array_equal(restored[n], saved[n])
+    resumed = [float(e2.train_batch(batch=_b(e2, model, 10 + i)))
+               for i in range(2)]
+    np.testing.assert_allclose(resumed, cont, rtol=1e-6)
+
+
+def test_param_offload_requires_stage3(tmp_path):
+    model = CausalLM("tiny", max_seq_len=SEQ * 2)
+    cfg = _config(tmp_path)
+    cfg["zero_optimization"]["stage"] = 1
+    with pytest.raises(NotImplementedError, match="stage=3"):
+        deepspeed_tpu.initialize(model=model, config=cfg)
+
+
+def test_param_offload_requires_bf16(tmp_path):
+    model = CausalLM("tiny", max_seq_len=SEQ * 2)
+    cfg = _config(tmp_path)
+    del cfg["bf16"]
+    with pytest.raises(ValueError, match="bf16"):
+        deepspeed_tpu.initialize(model=model, config=cfg)
+
+
+def test_param_offload_requires_nvme_path(tmp_path):
+    model = CausalLM("tiny", max_seq_len=SEQ * 2)
+    cfg = _config(tmp_path)
+    del cfg["zero_optimization"]["offload_param"]["nvme_path"]
+    with pytest.raises(NotImplementedError, match="nvme_path"):
+        deepspeed_tpu.initialize(model=model, config=cfg)
+
+
+def test_param_offload_rejects_moe(tmp_path):
+    model = CausalLM("tiny-moe", max_seq_len=SEQ * 2)
+    with pytest.raises(NotImplementedError, match="MoE"):
+        deepspeed_tpu.initialize(model=model, config=_config(tmp_path))
