@@ -36,11 +36,17 @@ def _seqlen_metric(sample) -> float:
 class DataAnalyzer:
     def __init__(self, metric_fn: Optional[Callable] = None,
                  metric_name: str = DEFAULT_METRIC,
-                 num_workers: int = 1, worker_id: int = 0):
+                 num_workers: int = 1, worker_id: int = 0,
+                 run_id: Optional[str] = None):
         self.metric_fn = metric_fn or _seqlen_metric
         self.metric_name = metric_name
         self.num_workers = num_workers
         self.worker_id = worker_id
+        # per-run nonce: (dataset_len, num_workers) alone would silently
+        # merge a stale shard from a previous run over a same-shaped dataset.
+        # Multi-host fan-outs must pass the SAME run_id to every worker and
+        # to the reducer; the in-process run() generates one per call.
+        self.run_id = run_id
 
     # -- map -------------------------------------------------------------
     def _shard_file(self, save_path: str, worker_id: int) -> str:
@@ -60,7 +66,8 @@ class DataAnalyzer:
         # different analysis run left behind in the same save_path
         np.savez(out, indices=idx, values=vals,
                  dataset_len=np.int64(len(dataset)),
-                 num_workers=np.int64(self.num_workers))
+                 num_workers=np.int64(self.num_workers),
+                 run_id=np.asarray(self.run_id or ""))
         return out
 
     # -- reduce ----------------------------------------------------------
@@ -77,13 +84,17 @@ class DataAnalyzer:
         for p in parts:
             with np.load(p) as z:
                 loaded.append((z["indices"], z["values"]))
+                rid = str(z["run_id"][()]) if "run_id" in z.files else ""
                 fingerprints.add((int(z["dataset_len"]),
-                                  int(z["num_workers"])))
+                                  int(z["num_workers"]), rid))
+        want_rid = self.run_id or next(iter(fingerprints))[2]
         if len(fingerprints) != 1 or next(iter(
-                fingerprints))[1] != self.num_workers:
+                fingerprints))[1] != self.num_workers or \
+                next(iter(fingerprints))[2] != want_rid:
             raise ValueError(
                 f"shard fingerprints disagree ({sorted(fingerprints)}, "
-                f"reduce num_workers={self.num_workers}) — stale shard "
+                f"reduce num_workers={self.num_workers}, "
+                f"run_id={want_rid!r}) — stale shard "
                 "files from a previous analysis in this save_path?")
         n = next(iter(fingerprints))[0]
         values = np.full(n, np.nan)
@@ -98,6 +109,10 @@ class DataAnalyzer:
 
     # -- convenience: in-process parallel map + reduce -------------------
     def run(self, dataset: Sequence, save_path: str) -> np.ndarray:
+        if self.run_id is None:
+            import uuid
+
+            self.run_id = uuid.uuid4().hex
         with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
             list(pool.map(lambda w: self.run_map(dataset, save_path, w),
                           range(self.num_workers)))

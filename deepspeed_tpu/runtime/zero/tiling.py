@@ -68,13 +68,22 @@ def tiled_linear(x: jax.Array, w: jax.Array, bias: Optional[jax.Array] = None,
                           -2, 0)
         wt = w.reshape(in_splits, d_in // in_splits, d_out)
 
+        # fp32 scan carry AND fp32 dot outputs (preferred_element_type keeps
+        # the MXU accumulator unrounded): a bf16 carry or per-split bf16 dot
+        # rounding would lose the fp32 accumulation a single dense matmul
+        # gets, with error growing in in_splits; cast back to the promoted
+        # dtype after the scan
+        out_dtype = jnp.promote_types(x.dtype, w.dtype)
+
         def acc(carry, xw):
             xi, wi = xw
-            return carry + xi @ constrain(wi), None
+            part = jnp.matmul(xi, constrain(wi),
+                              preferred_element_type=jnp.float32)
+            return carry + part, None
 
-        zero = jnp.zeros(x.shape[:-1] + (d_out,),
-                         jnp.promote_types(x.dtype, w.dtype))
+        zero = jnp.zeros(x.shape[:-1] + (d_out,), jnp.float32)
         y, _ = jax.lax.scan(acc, zero, (xt, wt))
+        y = y.astype(out_dtype)
     else:
         y = x @ constrain(w)
     if bias is not None:

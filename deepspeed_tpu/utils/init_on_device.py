@@ -60,6 +60,18 @@ class OnDevice(contextlib.AbstractContextManager):
         import jax
         import jax.numpy as jnp
 
+        if any(isinstance(a, jax.core.Tracer) for a in args):
+            # e.g. deepspeed_tpu.initialize() called inside `with OnDevice()`:
+            # the engine's jitted sharded-init would trace into this context
+            # and hand ShapeDtypeStructs to downstream .astype calls — fail
+            # with the actual cause instead
+            raise RuntimeError(
+                "OnDevice context is active while a jitted initializer is "
+                "tracing. Close the OnDevice context before "
+                "deepspeed_tpu.initialize(): the engine already materializes "
+                "params born-sharded (OnDevice is for user-side "
+                "inspection/staging flows).")
+
         def cast(tree):
             if self.dtype is None:
                 return tree
