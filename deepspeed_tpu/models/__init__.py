@@ -12,7 +12,8 @@ import jax.numpy as jnp
 
 from .transformer import (CONFIGS, TransformerConfig, cache_specs,
                           cross_entropy_loss, forward, forward_cached,
-                          get_config, init_cache, init_params, param_specs)
+                          get_config, has_moe, init_cache, init_params,
+                          param_specs)
 
 __all__ = ["CausalLM", "TransformerConfig", "CONFIGS", "get_config", "forward",
            "forward_cached", "init_cache", "cache_specs", "init_params",
@@ -82,7 +83,7 @@ class CausalLM:
                                     deterministic=deterministic, return_aux=True,
                                     pld_theta=None if deterministic else pld_theta)
         loss = cross_entropy_loss(logits, labels)
-        if self.config.num_experts > 1:
+        if has_moe(self.config):
             loss = loss + self.config.moe_aux_loss_coef * aux["moe_aux_loss"]
         return loss
 

@@ -37,6 +37,18 @@ from jax.sharding import PartitionSpec as P
 
 from ..parallel.mesh import BATCH_AXES, constrain_spec
 
+import os as _os
+
+
+def _flash_decode_enabled() -> bool:
+    """DS_TPU_FLASH_DECODE, read per call.  CAVEAT: under jit the read
+    happens at TRACE time — once a decode program is compiled, toggling the
+    env has no effect until a fresh trace (new shapes or a new process).
+    A/B profiling must restart or change shapes between toggles."""
+    return _os.environ.get(
+        "DS_TPU_FLASH_DECODE", "").strip().lower() not in ("", "0", "false",
+                                                           "off")
+
 
 @dataclasses.dataclass(frozen=True)
 class TransformerConfig:
@@ -63,19 +75,35 @@ class TransformerConfig:
     parallel_residual: bool = False
     shared_layernorm: bool = False
     lm_head_bias: bool = False                # GPT-J ties a bias to lm_head
+    # encoder-family knobs (BERT): bidirectional attention, post-layernorm
+    # blocks (attn -> add -> LN), LayerNorm after the embedding sum (also
+    # Bloom), segment/token-type embeddings, no final norm (post-LN blocks
+    # end normalized)
+    causal: bool = True
+    post_layernorm: bool = False
+    embed_layernorm: bool = False
+    type_vocab_size: int = 0
+    final_norm: bool = True
     norm_eps: float = 1e-5
     tie_embeddings: bool = False
     attn_bias: bool = False
     mlp_bias: bool = False
     dropout: float = 0.0
     # MoE (reference deepspeed/moe/): num_experts > 1 makes every block's MLP
-    # an expert-parallel MoE layer (scan-over-layers keeps blocks uniform)
-    num_experts: int = 1
+    # an expert-parallel MoE layer (scan-over-layers keeps blocks uniform).
+    # PR-MoE (reference moe/layer.py:16): a TUPLE gives per-layer expert
+    # counts (the pyramid; 1 = dense layer) — layers become heterogeneous,
+    # so the forward drops to the per-layer loop and pipeline is unsupported.
+    num_experts: Any = 1
     moe_top_k: int = 2
     capacity_factor: float = 1.25
     eval_capacity_factor: float = 2.0
     moe_min_capacity: int = 8
     moe_aux_loss_coef: float = 0.01
+    moe_drop_tokens: bool = True              # False => ragged no-drop path
+    # residual MoE (PR-MoE, reference moe/layer.py use_residual): each MoE
+    # layer also runs a dense MLP; outputs mix via a learned 2-way coefficient
+    moe_use_residual: bool = False
     noisy_gate_policy: Optional[str] = None
     # pipeline parallelism: layers split into stages over the 'pipe' mesh
     # axis; microbatches default to the engine's gradient_accumulation_steps
@@ -115,16 +143,32 @@ class TransformerConfig:
         mlp = 3 * d * f if self.activation == "swiglu" else 2 * d * f
         if self.mlp_bias:
             mlp += (2 * f if self.activation == "swiglu" else f) + d
-        if self.num_experts > 1:
-            mlp = mlp * self.num_experts + d * self.num_experts  # experts + router
+        experts = (tuple(self.num_experts)
+                   if isinstance(self.num_experts, (tuple, list))
+                   else (self.num_experts,) * L)
+        total_mlp = 0
+        for E in experts:
+            m = mlp
+            if E > 1:
+                m = mlp * E + d * E  # experts + router
+                if self.moe_use_residual:
+                    m += mlp + 2 * d  # dense residual branch + coefficient
+            total_mlp += m
         n_norms = 1 if self.shared_layernorm else 2
         norms = n_norms * d * (2 if self.norm == "layernorm" else 1)
         embed = v * d * (1 if self.tie_embeddings else 2)
         if self.lm_head_bias and not self.tie_embeddings:
             embed += v
         pos = self.max_seq_len * d if self.position == "learned" else 0
-        final_norm = d * (2 if self.norm == "layernorm" else 1)
-        return L * (attn + mlp + norms) + embed + pos + final_norm
+        extra = 0
+        if self.embed_layernorm:
+            extra += d * (2 if self.norm == "layernorm" else 1)
+        if self.type_vocab_size:
+            extra += self.type_vocab_size * d
+        final_norm = (d * (2 if self.norm == "layernorm" else 1)
+                      if self.final_norm else 0)
+        return (L * (attn + norms) + total_mlp + embed + pos + extra
+                + final_norm)
 
 
 # -- named configs (sizes from the public model cards; used by bench + tests) --
@@ -185,6 +229,12 @@ CONFIGS: Dict[str, TransformerConfig] = {
     "tiny-moe": TransformerConfig(
         vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
         num_heads=4, max_seq_len=128, num_experts=4, moe_top_k=2, remat=False),
+    # PR-MoE pyramid (reference moe/layer.py use_residual + per-layer expert
+    # counts): dense first layer, 4-expert second, residual mixing
+    "tiny-prmoe": TransformerConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, max_seq_len=128, num_experts=(1, 4), moe_top_k=2,
+        moe_use_residual=True, scan_layers=False, remat=False),
 }
 
 
@@ -193,13 +243,33 @@ def get_config(name_or_cfg, **overrides) -> TransformerConfig:
     return dataclasses.replace(cfg, **overrides) if overrides else cfg
 
 
+def moe_layer_experts(cfg: TransformerConfig) -> Tuple[int, ...]:
+    """Per-layer expert counts; scalar configs broadcast (PR-MoE pyramid:
+    reference moe/layer.py accepts per-layer num_experts lists)."""
+    if isinstance(cfg.num_experts, (tuple, list)):
+        if len(cfg.num_experts) != cfg.num_layers:
+            raise ValueError(
+                f"num_experts tuple has {len(cfg.num_experts)} entries for "
+                f"{cfg.num_layers} layers")
+        return tuple(int(e) for e in cfg.num_experts)
+    return (int(cfg.num_experts),) * cfg.num_layers
+
+
+def has_moe(cfg: TransformerConfig) -> bool:
+    return max(moe_layer_experts(cfg)) > 1
+
+
 # ---------------------------------------------------------------------------
 # Parameters
 # ---------------------------------------------------------------------------
 
 def init_params(cfg: TransformerConfig, rng: jax.Array) -> Dict[str, Any]:
     """Initialize fp32 params. Layer params are stacked on a leading [L] dim
-    so the forward can lax.scan over them."""
+    so the forward can lax.scan over them.  PR-MoE pyramid configs
+    (num_experts tuple) get a LIST of per-layer dicts instead — shapes
+    differ per layer, so there is nothing to scan."""
+    if isinstance(cfg.num_experts, (tuple, list)):
+        return _init_params_het(cfg, rng)
     d, f = cfg.hidden_size, cfg.intermediate_size
     hd, nh, nkv, L = cfg.dims_per_head, cfg.num_heads, cfg.kv_heads, cfg.num_layers
     std = cfg.initializer_range
@@ -234,6 +304,19 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> Dict[str, Any]:
     else:
         layers["w_in"] = dense(keys[4], mlp_shape(d, f))
         layers["w_down"] = dense(keys[6], mlp_shape(f, d), std / math.sqrt(2 * L))
+    if E > 1 and cfg.moe_use_residual:
+        # residual MoE (PR-MoE, reference moe/layer.py use_residual): a dense
+        # MLP branch + learned 2-way mixing coefficient per layer
+        if cfg.activation == "swiglu":
+            layers["res_w_gate"] = dense(keys[11], (L, d, f))
+            layers["res_w_up"] = dense(keys[12], (L, d, f))
+            layers["res_w_down"] = dense(keys[13], (L, f, d),
+                                         std / math.sqrt(2 * L))
+        else:
+            layers["res_w_in"] = dense(keys[11], (L, d, f))
+            layers["res_w_down"] = dense(keys[13], (L, f, d),
+                                         std / math.sqrt(2 * L))
+        layers["coefficient"] = dense(keys[14], (L, d, 2))
     if cfg.attn_bias:
         layers["bq"] = jnp.zeros((L, nh * hd))
         layers["bk"] = jnp.zeros((L, nkv * hd))
@@ -250,12 +333,19 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> Dict[str, Any]:
     params: Dict[str, Any] = {
         "embed": dense(keys[7], (cfg.vocab_size, d)),
         "layers": layers,
-        "final_norm_scale": jnp.ones((d,)),
     }
-    if cfg.norm == "layernorm":
-        params["final_norm_bias"] = jnp.zeros((d,))
+    if cfg.final_norm:
+        params["final_norm_scale"] = jnp.ones((d,))
+        if cfg.norm == "layernorm":
+            params["final_norm_bias"] = jnp.zeros((d,))
     if cfg.position == "learned":
         params["pos_embed"] = dense(keys[8], (cfg.max_seq_len, d))
+    if cfg.embed_layernorm:
+        params["embed_norm_scale"] = jnp.ones((d,))
+        if cfg.norm == "layernorm":
+            params["embed_norm_bias"] = jnp.zeros((d,))
+    if cfg.type_vocab_size:
+        params["type_embed"] = dense(keys[15], (cfg.type_vocab_size, d))
     if not cfg.tie_embeddings:
         params["lm_head"] = dense(keys[9], (d, cfg.vocab_size))
         if cfg.lm_head_bias:
@@ -270,11 +360,89 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> Dict[str, Any]:
     return params
 
 
+def _init_params_het(cfg: TransformerConfig, rng: jax.Array) -> Dict[str, Any]:
+    """PR-MoE pyramid init: per-layer expert counts (1 = dense layer).
+    ``params['layers']`` is a list of per-layer dicts."""
+    if cfg.pipeline_stages > 1:
+        raise NotImplementedError(
+            "per-layer num_experts (PR-MoE pyramid) + pipeline parallelism "
+            "is not supported (stages need uniform layer stacks)")
+    if cfg.mlp_bias or cfg.attn_bias:
+        raise NotImplementedError(
+            "PR-MoE pyramid configs do not support attn/mlp biases")
+    d, f = cfg.hidden_size, cfg.intermediate_size
+    hd, nh, nkv, L = (cfg.dims_per_head, cfg.num_heads, cfg.kv_heads,
+                      cfg.num_layers)
+    std = cfg.initializer_range
+    experts = moe_layer_experts(cfg)
+    lkeys = jax.random.split(rng, L + 1)
+
+    def dense(key, shape, scale=std):
+        return jax.random.normal(key, shape, jnp.float32) * scale
+
+    layers = []
+    for i, E in enumerate(experts):
+        k = jax.random.split(lkeys[i], 10)
+        lp: Dict[str, Any] = {
+            "attn_norm_scale": jnp.ones((d,)),
+            "wq": dense(k[0], (d, nh * hd)),
+            "wk": dense(k[1], (d, nkv * hd)),
+            "wv": dense(k[2], (d, nkv * hd)),
+            "wo": dense(k[3], (nh * hd, d), std / math.sqrt(2 * L)),
+        }
+        if not cfg.shared_layernorm:
+            lp["mlp_norm_scale"] = jnp.ones((d,))
+        if cfg.norm == "layernorm":
+            lp["attn_norm_bias"] = jnp.zeros((d,))
+            if not cfg.shared_layernorm:
+                lp["mlp_norm_bias"] = jnp.zeros((d,))
+        shape = (lambda *s: (E,) + s) if E > 1 else (lambda *s: s)
+        if E > 1:
+            lp["router"] = dense(k[7], (d, E))
+        if cfg.activation == "swiglu":
+            lp["w_gate"] = dense(k[4], shape(d, f))
+            lp["w_up"] = dense(k[5], shape(d, f))
+            lp["w_down"] = dense(k[6], shape(f, d), std / math.sqrt(2 * L))
+        else:
+            lp["w_in"] = dense(k[4], shape(d, f))
+            lp["w_down"] = dense(k[6], shape(f, d), std / math.sqrt(2 * L))
+        if E > 1 and cfg.moe_use_residual:
+            if cfg.activation == "swiglu":
+                lp["res_w_gate"] = dense(k[8], (d, f))
+                lp["res_w_up"] = dense(jax.random.fold_in(k[8], 1), (d, f))
+                lp["res_w_down"] = dense(jax.random.fold_in(k[8], 2), (f, d),
+                                         std / math.sqrt(2 * L))
+            else:
+                lp["res_w_in"] = dense(k[8], (d, f))
+                lp["res_w_down"] = dense(jax.random.fold_in(k[8], 2), (f, d),
+                                         std / math.sqrt(2 * L))
+            lp["coefficient"] = dense(k[9], (d, 2))
+        layers.append(lp)
+
+    keys = jax.random.split(lkeys[-1], 4)
+    params: Dict[str, Any] = {
+        "embed": dense(keys[0], (cfg.vocab_size, d)),
+        "layers": layers,
+        "final_norm_scale": jnp.ones((d,)),
+    }
+    if cfg.norm == "layernorm":
+        params["final_norm_bias"] = jnp.zeros((d,))
+    if cfg.position == "learned":
+        params["pos_embed"] = dense(keys[1], (cfg.max_seq_len, d))
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense(keys[2], (d, cfg.vocab_size))
+        if cfg.lm_head_bias:
+            params["lm_head_bias"] = jnp.zeros((cfg.vocab_size,))
+    return params
+
+
 def param_specs(cfg: TransformerConfig) -> Dict[str, Any]:
     """Megatron-style TP PartitionSpecs over the 'model' axis (reference
     module_inject/layers.py LinearLayer/LinearAllreduce; auto_tp.py infers the
     same split).  Column-parallel: QKV, gate/up.  Row-parallel: out, down.
     The ZeRO planner composes ('data','expert') on top of these."""
+    if isinstance(cfg.num_experts, (tuple, list)):
+        return _param_specs_het(cfg)
     col = P(None, None, "model")     # [L, d, f_shard]
     row = P(None, "model", None)     # [L, f_shard, d]
     rep = P(None, None)
@@ -300,6 +468,12 @@ def param_specs(cfg: TransformerConfig) -> Dict[str, Any]:
         layers.update(w_gate=mcol, w_up=mcol, w_down=mrow)
     else:
         layers.update(w_in=mcol, w_down=mrow)
+    if cfg.num_experts > 1 and cfg.moe_use_residual:
+        if cfg.activation == "swiglu":
+            layers.update(res_w_gate=col, res_w_up=col, res_w_down=row)
+        else:
+            layers.update(res_w_in=col, res_w_down=row)
+        layers["coefficient"] = P(None, None, None)
     if cfg.attn_bias:
         layers.update(bq=P(None, "model"), bk=P(None, "model"), bv=P(None, "model"),
                       bo=P(None, None))
@@ -316,6 +490,60 @@ def param_specs(cfg: TransformerConfig) -> Dict[str, Any]:
 
     specs: Dict[str, Any] = {
         "embed": P("model", None),   # vocab-parallel embedding
+        "layers": layers,
+    }
+    if cfg.final_norm:
+        specs["final_norm_scale"] = P()
+        if cfg.norm == "layernorm":
+            specs["final_norm_bias"] = P()
+    if cfg.position == "learned":
+        specs["pos_embed"] = P(None, None)
+    if cfg.embed_layernorm:
+        specs["embed_norm_scale"] = P()
+        if cfg.norm == "layernorm":
+            specs["embed_norm_bias"] = P()
+    if cfg.type_vocab_size:
+        specs["type_embed"] = P(None, None)
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, "model")
+        if cfg.lm_head_bias:
+            specs["lm_head_bias"] = P("model")
+    return specs
+
+
+def _param_specs_het(cfg: TransformerConfig) -> Dict[str, Any]:
+    """Per-layer spec dicts mirroring :func:`_init_params_het`."""
+    col, row, rep = P(None, "model"), P("model", None), P(None)
+    experts = moe_layer_experts(cfg)
+    layers = []
+    for E in experts:
+        lp: Dict[str, Any] = {"attn_norm_scale": rep,
+                              "wq": col, "wk": col, "wv": col, "wo": row}
+        if not cfg.shared_layernorm:
+            lp["mlp_norm_scale"] = rep
+        if cfg.norm == "layernorm":
+            lp["attn_norm_bias"] = rep
+            if not cfg.shared_layernorm:
+                lp["mlp_norm_bias"] = rep
+        if E > 1:
+            lp["router"] = P(None, None)
+            mcol = P("expert", None, "model")
+            mrow = P("expert", "model", None)
+        else:
+            mcol, mrow = col, row
+        if cfg.activation == "swiglu":
+            lp.update(w_gate=mcol, w_up=mcol, w_down=mrow)
+        else:
+            lp.update(w_in=mcol, w_down=mrow)
+        if E > 1 and cfg.moe_use_residual:
+            if cfg.activation == "swiglu":
+                lp.update(res_w_gate=col, res_w_up=col, res_w_down=row)
+            else:
+                lp.update(res_w_in=col, res_w_down=row)
+            lp["coefficient"] = P(None, None)
+        layers.append(lp)
+    specs: Dict[str, Any] = {
+        "embed": P("model", None),
         "layers": layers,
         "final_norm_scale": P(),
     }
@@ -395,7 +623,7 @@ def _attention(cfg: TransformerConfig, q, k, v, positions, attn_impl: str = "xla
     # GSPMD all-gather the full sequence.  Checked BEFORE "auto" resolves so
     # any seq-sharded mesh routes through the ring by default.
     if attn_impl in ("auto", "ring", "pallas") and cfg.position != "alibi" \
-            and not custom_positions:
+            and cfg.causal and not custom_positions:
         from ..parallel import mesh as mesh_mod
 
         m = mesh_mod._GLOBAL_MESH
@@ -434,7 +662,8 @@ def _attention(cfg: TransformerConfig, q, k, v, positions, attn_impl: str = "xla
         attn_impl = "pallas" if S >= 2048 else "xla"
     # The flash kernel masks by row/col index, so it requires default
     # positions; custom position ids (packed sequences) use the XLA path.
-    if attn_impl == "pallas" and cfg.position != "alibi" and not custom_positions:
+    if attn_impl == "pallas" and cfg.position != "alibi" and cfg.causal \
+            and not custom_positions:
         from ..ops.pallas.flash_attention import flash_attention
         from ..parallel import mesh as mesh_mod
 
@@ -468,8 +697,9 @@ def _attention(cfg: TransformerConfig, q, k, v, positions, attn_impl: str = "xla
     scores = scores.astype(jnp.float32)
     if cfg.position == "alibi":
         scores = scores + _alibi_bias(cfg, positions, Hq, S, jnp.float32)
-    causal = positions[:, None, :, None] >= positions[:, None, None, :]
-    scores = jnp.where(causal, scores, -1e30)
+    if cfg.causal:
+        causal = positions[:, None, :, None] >= positions[:, None, None, :]
+        scores = jnp.where(causal, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
@@ -491,31 +721,20 @@ def _maybe_act_quant(cfg: TransformerConfig, h):
                                  symmetric=cfg.act_quant_symmetric)
 
 
-def _mlp(cfg: TransformerConfig, lp: Dict[str, Any], h, rng, deterministic):
-    """Post-norm MLP/MoE body shared by the training block and the KV-cached
-    decode block: returns (output, moe_aux_loss)."""
-    aux = jnp.float32(0.0)
-    if cfg.num_experts > 1:
-        from ..moe.sharded_moe import MoEConfig, moe_ffn
-
-        m, aux = moe_ffn(
-            h, lp["router"], lp,
-            MoEConfig(num_experts=cfg.num_experts, top_k=cfg.moe_top_k,
-                      capacity_factor=cfg.capacity_factor,
-                      eval_capacity_factor=cfg.eval_capacity_factor,
-                      min_capacity=cfg.moe_min_capacity,
-                      noisy_gate_policy=cfg.noisy_gate_policy),
-            activation=cfg.activation, deterministic=deterministic, rng=rng)
-    elif cfg.activation == "swiglu":
-        g = checkpoint_name(h @ lp["w_gate"], "mlp_gate")
-        u = checkpoint_name(h @ lp["w_up"], "mlp_up")
-        if cfg.mlp_bias:
+def _dense_mlp(cfg: TransformerConfig, lp: Dict[str, Any], h, prefix=""):
+    """Plain MLP body; ``prefix="res_"`` selects the PR-MoE residual branch's
+    weights (biases only exist on the unprefixed dense path)."""
+    bias = cfg.mlp_bias and not prefix
+    if cfg.activation == "swiglu":
+        g = checkpoint_name(h @ lp[prefix + "w_gate"], "mlp_gate")
+        u = checkpoint_name(h @ lp[prefix + "w_up"], "mlp_up")
+        if bias:
             g, u = g + lp["b_gate"], u + lp["b_up"]
         m = jax.nn.silu(g) * u
-        m = m @ lp["w_down"]
+        m = m @ lp[prefix + "w_down"]
     else:
-        m = checkpoint_name(h @ lp["w_in"], "mlp_up")
-        if cfg.mlp_bias:
+        m = checkpoint_name(h @ lp[prefix + "w_in"], "mlp_up")
+        if bias:
             m = m + lp["b_in"]
         if cfg.activation == "relu":
             m = jax.nn.relu(m)
@@ -523,14 +742,82 @@ def _mlp(cfg: TransformerConfig, lp: Dict[str, Any], h, rng, deterministic):
             m = jax.nn.gelu(m, approximate=False)
         else:
             m = jax.nn.gelu(m)
-        m = m @ lp["w_down"]
-    if cfg.num_experts == 1 and cfg.mlp_bias:
+        m = m @ lp[prefix + "w_down"]
+    if bias:
         m = m + lp["b_down"]
+    return m
+
+
+def _mlp(cfg: TransformerConfig, lp: Dict[str, Any], h, rng, deterministic):
+    """Post-norm MLP/MoE body shared by the training block and the KV-cached
+    decode block: returns (output, moe_aux_loss).  MoE-ness is detected from
+    the layer's params (PR-MoE pyramid layers differ per depth)."""
+    aux = jnp.float32(0.0)
+    if "router" in lp:
+        from ..moe.sharded_moe import MoEConfig, moe_ffn
+
+        m, aux = moe_ffn(
+            h, lp["router"], lp,
+            MoEConfig(num_experts=int(lp["router"].shape[-1]),
+                      top_k=cfg.moe_top_k,
+                      capacity_factor=cfg.capacity_factor,
+                      eval_capacity_factor=cfg.eval_capacity_factor,
+                      min_capacity=cfg.moe_min_capacity,
+                      noisy_gate_policy=cfg.noisy_gate_policy,
+                      drop_tokens=cfg.moe_drop_tokens),
+            activation=cfg.activation, deterministic=deterministic, rng=rng)
+        if "coefficient" in lp:
+            # residual MoE (reference moe/layer.py:16 use_residual): dense
+            # branch + learned softmax mixing coefficient
+            res = _dense_mlp(cfg, lp, h, prefix="res_")
+            coef = jax.nn.softmax(
+                (h @ lp["coefficient"]).astype(jnp.float32), axis=-1
+            ).astype(m.dtype)
+            m = m * coef[..., 0:1] + res * coef[..., 1:2]
+    else:
+        m = _dense_mlp(cfg, lp, h)
     return m, aux
+
+
+def _block_postln(cfg: TransformerConfig, lp: Dict[str, Any], x, positions,
+                  rng, attn_impl: str, deterministic: bool,
+                  custom_positions: bool = False):
+    """Post-layernorm encoder block (BERT):  x = LN(x + attn(x));
+    x = LN(x + mlp(x)).  The norm params are the POST-sublayer LayerNorms."""
+    B, S, d = x.shape
+    hd, nh, nkv = cfg.dims_per_head, cfg.num_heads, cfg.kv_heads
+    h = _maybe_act_quant(cfg, x)
+    q = (h @ lp["wq"]).reshape(B, S, nh, hd)
+    k = (h @ lp["wk"]).reshape(B, S, nkv, hd)
+    v = (h @ lp["wv"]).reshape(B, S, nkv, hd)
+    if cfg.attn_bias:
+        q = q + lp["bq"].reshape(nh, hd)
+        k = k + lp["bk"].reshape(nkv, hd)
+        v = v + lp["bv"].reshape(nkv, hd)
+    attn = _attention(cfg, q, k, v, positions, attn_impl, custom_positions)
+    attn = attn.reshape(B, S, nh * hd) @ lp["wo"]
+    if cfg.attn_bias:
+        attn = attn + lp["bo"]
+    if cfg.dropout and not deterministic:
+        rng, sub = jax.random.split(rng)
+        attn = attn * jax.random.bernoulli(
+            sub, 1 - cfg.dropout, attn.shape) / (1 - cfg.dropout)
+    x = _norm(cfg, x + attn, lp["attn_norm_scale"], lp.get("attn_norm_bias"))
+    rng, sub = jax.random.split(rng)
+    m, aux = _mlp(cfg, lp, _maybe_act_quant(cfg, x), sub, deterministic)
+    if cfg.dropout and not deterministic:
+        rng, sub = jax.random.split(rng)
+        m = m * jax.random.bernoulli(
+            sub, 1 - cfg.dropout, m.shape) / (1 - cfg.dropout)
+    return _norm(cfg, x + m, lp["mlp_norm_scale"],
+                 lp.get("mlp_norm_bias")), aux
 
 
 def _block(cfg: TransformerConfig, lp: Dict[str, Any], x, positions, rng,
            attn_impl: str, deterministic: bool, custom_positions: bool = False):
+    if cfg.post_layernorm:
+        return _block_postln(cfg, lp, x, positions, rng, attn_impl,
+                             deterministic, custom_positions)
     B, S, d = x.shape
     hd, nh, nkv = cfg.dims_per_head, cfg.num_heads, cfg.kv_heads
 
@@ -593,7 +880,8 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
             positions: Optional[jax.Array] = None, rng: Optional[jax.Array] = None,
             attn_impl: str = "xla", deterministic: bool = True,
             seq_sharded: bool = True, return_aux: bool = False,
-            pld_theta: Optional[jax.Array] = None):
+            pld_theta: Optional[jax.Array] = None,
+            token_type_ids: Optional[jax.Array] = None):
     """tokens [B, S] int32 -> logits [B, S, V] (+ aux dict if return_aux)."""
     B, S = tokens.shape
     custom_positions = positions is not None
@@ -605,6 +893,13 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
     x = params["embed"].astype(cfg.dtype)[tokens]
     if cfg.position == "learned":
         x = x + params["pos_embed"].astype(cfg.dtype)[positions]
+    if "type_embed" in params:   # BERT segment embeddings
+        tt = (token_type_ids if token_type_ids is not None
+              else jnp.zeros_like(tokens))
+        x = x + params["type_embed"].astype(cfg.dtype)[tt]
+    if cfg.embed_layernorm:      # Bloom / BERT embedding LayerNorm
+        x = _norm(cfg, x, params["embed_norm_scale"],
+                  params.get("embed_norm_bias"))
     # activations: batch over DP axes, sequence over 'seq' axis
     act_spec = P(BATCH_AXES, "seq" if seq_sharded else None, None)
     x = constrain_spec(x, act_spec)
@@ -646,10 +941,12 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
             deterministic)
 
     aux_total = jnp.float32(0.0)
-    if pld_theta is not None and (cfg.pipeline_stages > 1 or not cfg.scan_layers):
+    het = isinstance(params["layers"], (list, tuple))  # PR-MoE pyramid
+    if pld_theta is not None and (cfg.pipeline_stages > 1
+                                  or not cfg.scan_layers or het):
         raise NotImplementedError(
             "progressive layer drop requires the scanned-layers path "
-            "(scan_layers=True, pipeline_stages=1)")
+            "(scan_layers=True, pipeline_stages=1, uniform layers)")
     if cfg.pipeline_stages > 1:
         from ..runtime.pipe.spmd import pipeline_apply
 
@@ -675,7 +972,7 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
         x = y.reshape((B,) + y.shape[2:])
         x = constrain_spec(x, act_spec)
         aux_total = aux_sum / M      # mean over microbatches, sum over layers
-    elif cfg.scan_layers:
+    elif cfg.scan_layers and not het:
         if pld_theta is not None:
             # progressive layer drop (runtime/progressive_layer_drop.py):
             # per-layer keep decisions ride the scan as a second xs — a
@@ -709,12 +1006,15 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
                                                 params["layers"])
     else:
         for i in range(cfg.num_layers):
-            lp = jax.tree_util.tree_map(lambda a: a[i], params["layers"])
+            lp = (params["layers"][i] if het else
+                  jax.tree_util.tree_map(lambda a: a[i], params["layers"]))
             rng, sub = jax.random.split(rng)
             x, aux = block(lp, x, sub, positions)
             aux_total = aux_total + aux
 
-    x = _norm(cfg, x, params["final_norm_scale"], params.get("final_norm_bias"))
+    if cfg.final_norm:
+        x = _norm(cfg, x, params["final_norm_scale"],
+                  params.get("final_norm_bias"))
     if cfg.tie_embeddings:
         logits = x @ params["embed"].astype(cfg.dtype).T
     else:
@@ -779,9 +1079,7 @@ def _attention_cached(cfg, q, ck, cv, q_pos, q_slot, valid, kpos):
     B, S, Hq, hd = q.shape
     T, Hkv = ck.shape[1], ck.shape[2]
     G = Hq // Hkv
-    import os as _os
-    flash_decode_on = _os.environ.get(
-        "DS_TPU_FLASH_DECODE", "").strip().lower() not in ("", "0", "false", "off")
+    flash_decode_on = _flash_decode_enabled()  # trace-time under jit (see doc)
     if (S == 1 and cfg.position != "alibi" and T % 128 == 0
             and hd % 8 == 0 and flash_decode_on):
         # decode step: the Pallas flash-decode kernel streams the cache
@@ -892,6 +1190,10 @@ def forward_cached(cfg: TransformerConfig, params: Dict[str, Any],
     exactly twice.
     """
     assert cfg.pipeline_stages == 1, "cached decode requires pipeline_stages=1"
+    if isinstance(params["layers"], (list, tuple)):
+        raise NotImplementedError(
+            "cached decode with a PR-MoE pyramid (per-layer num_experts) is "
+            "not supported: the KV cache scan needs uniform layer stacks")
     B, S = tokens.shape
     next_slot = cache["next_slot"]
 
@@ -900,9 +1202,16 @@ def forward_cached(cfg: TransformerConfig, params: Dict[str, Any],
                                         (0, next_slot))
     q_slot = next_slot + jnp.arange(S, dtype=jnp.int32)
 
+    if not cfg.causal:
+        raise NotImplementedError(
+            "cached decode is a causal-LM operation; encoder models "
+            "(causal=False) have no autoregressive cache")
     x = params["embed"].astype(cfg.dtype)[tokens]
     if cfg.position == "learned":
         x = x + params["pos_embed"].astype(cfg.dtype)[positions]
+    if cfg.embed_layernorm:      # Bloom embedding LayerNorm
+        x = _norm(cfg, x, params["embed_norm_scale"],
+                  params.get("embed_norm_bias"))
     x = constrain_spec(x, P(BATCH_AXES, None, None))
 
     rng = jax.random.PRNGKey(0)

@@ -102,6 +102,36 @@ def config_from_hf(hf_config) -> TransformerConfig:
             activation="gelu", position="learned", tie_embeddings=True,
             attn_bias=True, mlp_bias=True,
             norm_eps=float(get("layer_norm_epsilon", 1e-5)))
+    if arch == "bloom":
+        return TransformerConfig(
+            vocab_size=get("vocab_size"), hidden_size=get("hidden_size"),
+            intermediate_size=4 * get("hidden_size"),
+            num_layers=get("n_layer"), num_heads=get("n_head"),
+            max_seq_len=get("seq_length", 2048) or 2048,
+            norm="layernorm",
+            activation="gelu",   # BloomGelu is the tanh approximation
+            position="alibi", tie_embeddings=True, attn_bias=True,
+            mlp_bias=True, embed_layernorm=True,
+            norm_eps=float(get("layer_norm_epsilon", 1e-5)))
+    if arch == "bert":
+        # encoder family: bidirectional post-LN blocks, segment embeddings,
+        # LayerNorm after the embedding sum, no final norm.  tie_embeddings
+        # makes the "logits" the hidden states projected on embed^T — the
+        # encoder surface itself is the last pre-logit hidden state.
+        return TransformerConfig(
+            vocab_size=get("vocab_size"), hidden_size=get("hidden_size"),
+            intermediate_size=get("intermediate_size"),
+            num_layers=get("num_hidden_layers"),
+            num_heads=get("num_attention_heads"),
+            max_seq_len=get("max_position_embeddings", 512),
+            norm="layernorm",
+            activation=_hf_activation(get("hidden_act", "gelu")),
+            position="learned", tie_embeddings=True, attn_bias=True,
+            mlp_bias=True, causal=False, post_layernorm=True,
+            embed_layernorm=True,
+            type_vocab_size=get("type_vocab_size", 2),
+            final_norm=False,
+            norm_eps=float(get("layer_norm_eps", 1e-12)))
     if arch == "opt":
         proj = get("word_embed_proj_dim", get("hidden_size"))
         if proj not in (None, get("hidden_size")):
@@ -134,7 +164,7 @@ def _split_fused_qkv(w: np.ndarray, cfg: TransformerConfig, arch: str):
     PER-HEAD interleave [h0_q, h0_k, h0_v, h1_q, ...] on the first dim.
     """
     hd, nh = cfg.dims_per_head, cfg.num_heads
-    if arch == "gpt_neox":
+    if arch in ("gpt_neox", "bloom"):
         if w.ndim == 2:                       # [H*3*hd, d]
             grouped = w.reshape(nh, 3, hd, w.shape[-1])
             q, k, v = (np.ascontiguousarray(
@@ -161,6 +191,11 @@ def hf_state_dict_to_params(state_dict: Dict[str, Any],
 
     policy = POLICIES[arch]
     sd = {k: v for k, v in state_dict.items()}
+    if arch == "bert":
+        # BertForMaskedLM/SequenceClassification prefix the encoder with
+        # "bert."; BertModel exports bare names — normalize to bare
+        sd = {(k[5:] if k.startswith("bert.") else k): v
+              for k, v in sd.items()}
     L = cfg.num_layers
     host_dtype = np.dtype(dtype) if dtype is not None else np.float32
     params: Dict[str, Any] = {"layers": {}}

@@ -173,9 +173,77 @@ NEOX = ArchPolicy(
 )
 
 
+BLOOM = ArchPolicy(
+    name="bloom",
+    top={
+        "embed": ("transformer.word_embeddings.weight", None),
+        "embed_norm_scale": (
+            "transformer.word_embeddings_layernorm.weight", None),
+        "embed_norm_bias": (
+            "transformer.word_embeddings_layernorm.bias", None),
+        "final_norm_scale": ("transformer.ln_f.weight", None),
+        "final_norm_bias": ("transformer.ln_f.bias", None),
+    },
+    layer={
+        "attn_norm_scale": ("transformer.h.{i}.input_layernorm.weight", None),
+        "attn_norm_bias": ("transformer.h.{i}.input_layernorm.bias", None),
+        "mlp_norm_scale": (
+            "transformer.h.{i}.post_attention_layernorm.weight", None),
+        "mlp_norm_bias": (
+            "transformer.h.{i}.post_attention_layernorm.bias", None),
+        "wo": ("transformer.h.{i}.self_attention.dense.weight", _t),
+        "bo": ("transformer.h.{i}.self_attention.dense.bias", None),
+        "w_in": ("transformer.h.{i}.mlp.dense_h_to_4h.weight", _t),
+        "b_in": ("transformer.h.{i}.mlp.dense_h_to_4h.bias", None),
+        "w_down": ("transformer.h.{i}.mlp.dense_4h_to_h.weight", _t),
+        "b_down": ("transformer.h.{i}.mlp.dense_4h_to_h.bias", None),
+    },
+    # Bloom fuses qkv PER HEAD like NeoX: [H*3*hd, d] laid out
+    # [h0_q, h0_k, h0_v, h1_q, ...] (reference containers/bloom.py
+    # qkv_copy transposes the same interleave)
+    fused_qkv="transformer.h.{i}.self_attention.query_key_value.weight",
+    fused_qkv_bias="transformer.h.{i}.self_attention.query_key_value.bias",
+    tie_embeddings=True,
+)
+
+BERT = ArchPolicy(
+    name="bert",
+    top={
+        "embed": ("embeddings.word_embeddings.weight", None),
+        "pos_embed": ("embeddings.position_embeddings.weight", None),
+        "type_embed": ("embeddings.token_type_embeddings.weight", None),
+        "embed_norm_scale": ("embeddings.LayerNorm.weight", None),
+        "embed_norm_bias": ("embeddings.LayerNorm.bias", None),
+    },
+    layer={
+        "wq": ("encoder.layer.{i}.attention.self.query.weight", _t),
+        "bq": ("encoder.layer.{i}.attention.self.query.bias", None),
+        "wk": ("encoder.layer.{i}.attention.self.key.weight", _t),
+        "bk": ("encoder.layer.{i}.attention.self.key.bias", None),
+        "wv": ("encoder.layer.{i}.attention.self.value.weight", _t),
+        "bv": ("encoder.layer.{i}.attention.self.value.bias", None),
+        "wo": ("encoder.layer.{i}.attention.output.dense.weight", _t),
+        "bo": ("encoder.layer.{i}.attention.output.dense.bias", None),
+        # post-LN: these are the POST-sublayer LayerNorms
+        "attn_norm_scale": (
+            "encoder.layer.{i}.attention.output.LayerNorm.weight", None),
+        "attn_norm_bias": (
+            "encoder.layer.{i}.attention.output.LayerNorm.bias", None),
+        "w_in": ("encoder.layer.{i}.intermediate.dense.weight", _t),
+        "b_in": ("encoder.layer.{i}.intermediate.dense.bias", None),
+        "w_down": ("encoder.layer.{i}.output.dense.weight", _t),
+        "b_down": ("encoder.layer.{i}.output.dense.bias", None),
+        "mlp_norm_scale": ("encoder.layer.{i}.output.LayerNorm.weight", None),
+        "mlp_norm_bias": ("encoder.layer.{i}.output.LayerNorm.bias", None),
+    },
+    tie_embeddings=True,
+)
+
+
 POLICIES: Dict[str, ArchPolicy] = {"llama": LLAMA, "gpt2": GPT2, "opt": OPT,
                                    "mistral": LLAMA, "gptj": GPTJ,
-                                   "gpt_neox": NEOX}
+                                   "gpt_neox": NEOX, "bloom": BLOOM,
+                                   "bert": BERT}
 
 
 def detect_arch(hf_config) -> str:

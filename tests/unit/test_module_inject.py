@@ -151,3 +151,60 @@ def test_dtype_cast():
     _, params = load_hf_checkpoint(model, dtype=jnp.bfloat16)
     assert all(x.dtype == jnp.bfloat16
                for x in jax.tree_util.tree_leaves(params))
+
+
+def test_bloom_parity():
+    """Bloom: alibi positions + per-head fused QKV + embedding LayerNorm
+    (reference module_inject/containers/bloom.py; VERDICT r2 item 6)."""
+    hf_cfg = transformers.BloomConfig(
+        vocab_size=128, hidden_size=32, n_layer=2, n_head=4,
+        hidden_dropout=0.0, attention_dropout=0.0)
+    _logit_parity(transformers.BloomForCausalLM(hf_cfg))
+
+
+def test_bert_encoder_parity():
+    """BERT encoder: bidirectional post-LN blocks, segment embeddings,
+    embedding LayerNorm (reference replace_policy.py HFBertLayerPolicy).
+    Parity on the V-dim projection of the last hidden state (tied embed),
+    which implies hidden-state parity."""
+    hf_cfg = transformers.BertConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0)
+    hf = transformers.BertModel(hf_cfg).eval().to(torch.float32)
+    cfg, params = load_hf_checkpoint((hf_cfg, hf.state_dict()))
+    assert not cfg.causal and cfg.post_layernorm and not cfg.final_norm
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, (2, 16)).astype(np.int32)
+    with torch.no_grad():
+        hidden = hf(input_ids=torch.from_numpy(tokens.astype(np.int64))
+                    ).last_hidden_state.numpy()
+    embed = np.asarray(params["embed"], np.float32)
+    ref_logits = hidden @ embed.T
+    import dataclasses
+
+    import jax.numpy as jnp
+    cfg32 = dataclasses.replace(cfg, dtype=jnp.float32)
+    params32 = jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), params)
+    ours = np.asarray(forward(cfg32, params32, jnp.asarray(tokens),
+                              attn_impl="xla", deterministic=True))
+    np.testing.assert_allclose(ours, ref_logits, atol=2e-3, rtol=1e-3)
+
+
+def test_bert_attention_is_bidirectional():
+    """A causal=False model's token 0 output must depend on later tokens."""
+    from deepspeed_tpu.models import init_params
+    from deepspeed_tpu.models.transformer import CONFIGS
+    import dataclasses
+
+    cfg = dataclasses.replace(CONFIGS["tiny"], causal=False,
+                              dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    t1 = np.zeros((1, 8), np.int32)
+    t2 = t1.copy()
+    t2[0, -1] = 5  # change only the LAST token
+    o1 = np.asarray(forward(cfg, params, jnp.asarray(t1)))
+    o2 = np.asarray(forward(cfg, params, jnp.asarray(t2)))
+    assert not np.allclose(o1[0, 0], o2[0, 0]), \
+        "token 0 ignored later tokens — attention is still causal"
