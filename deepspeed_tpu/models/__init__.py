@@ -90,6 +90,24 @@ class CausalLM:
     def loss_fn(self, params, batch, rng):
         return self._loss(params, batch, rng, deterministic=False)
 
+    def pipeline_grad_fn(self):
+        """Manual fwd+bwd through the 1F1B executor (the engine routes here
+        when ``config.pipeline_schedule == "1f1b"``).  Same contract as the
+        engine's ``grad_of_batch``: (grads of scale*mean loss, unscaled
+        per-microbatch losses)."""
+        from .transformer import pipeline_1f1b_loss_and_grads
+
+        def fn(params, scaler, batch, rng):
+            tokens, labels, positions, _ = self._split(batch)
+            if positions is not None:
+                raise NotImplementedError(
+                    "1f1b pipeline requires default positions")
+            return pipeline_1f1b_loss_and_grads(
+                self.config, params, tokens, labels, rng,
+                attn_impl=self.attn_impl, loss_scale=scaler.loss_scale)
+
+        return fn
+
     def eval_fn(self, params, batch, rng):
         return self._loss(params, batch, rng, deterministic=True)
 

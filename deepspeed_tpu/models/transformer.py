@@ -109,6 +109,11 @@ class TransformerConfig:
     # axis; microbatches default to the engine's gradient_accumulation_steps
     pipeline_stages: int = 1
     pipeline_microbatches: Optional[int] = None
+    # "gpipe": fwd wavefront scan + AD backward (fastest span; activation
+    # stash grows with microbatch count M).  "1f1b": interleaved fwd/bwd in
+    # one scan (runtime/pipe/spmd.py:pipeline_1f1b) — O(P²) stash
+    # independent of M, the reference TrainSchedule's memory contract.
+    pipeline_schedule: str = "gpipe"
     remat: bool = True                        # activation checkpointing
     remat_policy: str = "nothing_saveable"    # nothing_saveable | dots_saveable
     # random-LTD (data efficiency): non-deterministic passes run each layer on
@@ -876,34 +881,11 @@ def _block(cfg: TransformerConfig, lp: Dict[str, Any], x, positions, rng,
     return x + m, aux
 
 
-def forward(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
-            positions: Optional[jax.Array] = None, rng: Optional[jax.Array] = None,
-            attn_impl: str = "xla", deterministic: bool = True,
-            seq_sharded: bool = True, return_aux: bool = False,
-            pld_theta: Optional[jax.Array] = None,
-            token_type_ids: Optional[jax.Array] = None):
-    """tokens [B, S] int32 -> logits [B, S, V] (+ aux dict if return_aux)."""
-    B, S = tokens.shape
-    custom_positions = positions is not None
-    if positions is None:
-        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
-    if rng is None:
-        rng = jax.random.PRNGKey(0)
-
-    x = params["embed"].astype(cfg.dtype)[tokens]
-    if cfg.position == "learned":
-        x = x + params["pos_embed"].astype(cfg.dtype)[positions]
-    if "type_embed" in params:   # BERT segment embeddings
-        tt = (token_type_ids if token_type_ids is not None
-              else jnp.zeros_like(tokens))
-        x = x + params["type_embed"].astype(cfg.dtype)[tt]
-    if cfg.embed_layernorm:      # Bloom / BERT embedding LayerNorm
-        x = _norm(cfg, x, params["embed_norm_scale"],
-                  params.get("embed_norm_bias"))
-    # activations: batch over DP axes, sequence over 'seq' axis
-    act_spec = P(BATCH_AXES, "seq" if seq_sharded else None, None)
-    x = constrain_spec(x, act_spec)
-
+def _build_block(cfg: TransformerConfig, attn_impl: str, deterministic: bool,
+                 custom_positions: bool):
+    """One layer's apply fn ``block(lp, x, rng, positions)`` with the remat
+    policy and random-LTD wrapping applied — shared by forward() and the
+    1F1B pipeline executor."""
     block = lambda lp, x, sub, pos: _block(cfg, lp, x, pos, sub, attn_impl,  # noqa: E731
                                            deterministic, custom_positions)
     if cfg.remat:
@@ -939,6 +921,38 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
         block = lambda lp, x, sub, pos: random_ltd_block(  # noqa: E731
             inner_block, cfg, lp, x, pos, sub, cfg.random_ltd_keep,
             deterministic)
+    return block
+
+
+def forward(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
+            positions: Optional[jax.Array] = None, rng: Optional[jax.Array] = None,
+            attn_impl: str = "xla", deterministic: bool = True,
+            seq_sharded: bool = True, return_aux: bool = False,
+            pld_theta: Optional[jax.Array] = None,
+            token_type_ids: Optional[jax.Array] = None):
+    """tokens [B, S] int32 -> logits [B, S, V] (+ aux dict if return_aux)."""
+    B, S = tokens.shape
+    custom_positions = positions is not None
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    if cfg.position == "learned":
+        x = x + params["pos_embed"].astype(cfg.dtype)[positions]
+    if "type_embed" in params:   # BERT segment embeddings
+        tt = (token_type_ids if token_type_ids is not None
+              else jnp.zeros_like(tokens))
+        x = x + params["type_embed"].astype(cfg.dtype)[tt]
+    if cfg.embed_layernorm:      # Bloom / BERT embedding LayerNorm
+        x = _norm(cfg, x, params["embed_norm_scale"],
+                  params.get("embed_norm_bias"))
+    # activations: batch over DP axes, sequence over 'seq' axis
+    act_spec = P(BATCH_AXES, "seq" if seq_sharded else None, None)
+    x = constrain_spec(x, act_spec)
+
+    block = _build_block(cfg, attn_impl, deterministic, custom_positions)
 
     aux_total = jnp.float32(0.0)
     het = isinstance(params["layers"], (list, tuple))  # PR-MoE pyramid
@@ -1024,6 +1038,100 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
     if return_aux:
         return logits, {"moe_aux_loss": aux_total}
     return logits
+
+
+def pipeline_1f1b_loss_and_grads(cfg: TransformerConfig, params: Dict[str, Any],
+                                 tokens: jax.Array, labels: jax.Array,
+                                 rng: jax.Array, attn_impl: str = "xla",
+                                 loss_scale=1.0):
+    """Training fwd+bwd through the 1F1B pipeline executor.
+
+    Returns ``(grads, losses [M])`` with the same contract as the engine's
+    ``grad_of_batch`` (grads of the scaled MEAN loss; losses unscaled).
+    AD cannot express the interleaved schedule (it must finish forward
+    before backward starts), so the executor produces the gradients and
+    this function stitches the embed/head ends back into the full tree.
+    """
+    if has_moe(cfg):
+        raise NotImplementedError(
+            "pipeline_schedule='1f1b' with MoE layers: the manual backward "
+            "does not thread the aux loss; use the gpipe schedule")
+    if cfg.dropout:
+        raise NotImplementedError(
+            "pipeline_schedule='1f1b' with dropout: the stage rng chain "
+            "differs between the paired fwd/bwd stage calls under remat; "
+            "use the gpipe schedule")
+    B, S = tokens.shape
+    M = cfg.pipeline_microbatches or cfg.pipeline_stages
+    assert B % M == 0, f"batch {B} not divisible by {M} microbatches"
+    mb = B // M
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :],
+                                 (mb, S))
+    act_spec = P(BATCH_AXES, "seq", None)
+    block = _build_block(cfg, attn_impl, deterministic=True,
+                         custom_positions=False)
+
+    def stage_fn(lp_stage, xs, srng):
+        def body(carry, lp):
+            xc, r = carry
+            r, sub = jax.random.split(r)
+            xc, _aux = block(lp, xc, sub, positions)
+            return (xc, r), None
+
+        (xs, _), _ = jax.lax.scan(body, (xs, srng), lp_stage)
+        return xs
+
+    stem_keys = [k for k in ("embed", "pos_embed", "embed_norm_scale",
+                             "embed_norm_bias") if k in params]
+    head_keys = [k for k in ("final_norm_scale", "final_norm_bias",
+                             "lm_head", "lm_head_bias") if k in params]
+    stem = {k: params[k] for k in stem_keys}
+    head = {k: params[k] for k in head_keys}
+    if cfg.tie_embeddings:
+        head["embed"] = params["embed"]  # grads from the head sum with stem's
+
+    def embed_fn(stem_p):
+        x = stem_p["embed"].astype(cfg.dtype)[tokens]
+        if "pos_embed" in stem_p:
+            x = x + stem_p["pos_embed"].astype(cfg.dtype)[
+                jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))]
+        if "embed_norm_scale" in stem_p:
+            x = _norm(cfg, x, stem_p["embed_norm_scale"],
+                      stem_p.get("embed_norm_bias"))
+        x = constrain_spec(x, P(BATCH_AXES, "seq", None))
+        return x.reshape((M, mb) + x.shape[1:])
+
+    def head_fn(hp, y, lbl):
+        if cfg.final_norm:
+            y = _norm(cfg, y, hp["final_norm_scale"],
+                      hp.get("final_norm_bias"))
+        if cfg.tie_embeddings:
+            logits = y @ hp["embed"].astype(cfg.dtype).T
+        else:
+            logits = y @ hp["lm_head"].astype(cfg.dtype)
+            if "lm_head_bias" in hp:
+                logits = logits + hp["lm_head_bias"].astype(cfg.dtype)
+        # scaled so the executor's vjp carries exactly the engine's gradient
+        # (scale * mean-over-microbatches)
+        return cross_entropy_loss(logits, lbl) * loss_scale / M
+
+    from ..runtime.pipe.spmd import pipeline_1f1b
+
+    labels_micro = labels.reshape(M, mb, S)
+    x_micro, embed_vjp = jax.vjp(embed_fn, stem)
+    losses_scaled, dstage, dhead, dx_micro = pipeline_1f1b(
+        stage_fn, head_fn, params["layers"], head, x_micro, labels_micro, rng)
+    (dstem,) = embed_vjp(dx_micro.astype(x_micro.dtype))
+
+    grads: Dict[str, Any] = {"layers": dstage}
+    for k in stem_keys:
+        grads[k] = dstem[k].astype(jnp.float32)
+    for k in head_keys:
+        grads[k] = dhead[k]
+    if cfg.tie_embeddings:
+        grads["embed"] = grads["embed"] + dhead["embed"]
+    losses = losses_scaled * (M / loss_scale)   # unscaled per-micro losses
+    return grads, losses
 
 
 # ---------------------------------------------------------------------------

@@ -284,6 +284,9 @@ class CurriculumLearningLegacyConfig(DeepSpeedConfigModel):
     max_difficulty: int = 1024
     schedule_type: str = "fixed_linear"
     schedule_config: Dict[str, Any] = Field(default_factory=dict)
+    # non-seqlen curriculum types: per-sample difficulty values (a
+    # DataAnalyzer ``<metric>_values.npy``) driving the in-loop sampler
+    metric_values_path: Optional[str] = None
 
 
 class RandomLTDConfig(DeepSpeedConfigModel):
@@ -436,6 +439,14 @@ class DeepSpeedConfig:
         """
         bad: List[str] = []
         zc = self.zero_config
+
+        if self._param_dict.get("sparse_gradients", False):
+            bad.append(
+                "sparse_gradients (XLA fuses the embedding scatter-add and "
+                "ZeRO/TP already shard the exchange; a variable-nnz sparse "
+                "allreduce is inexpressible under static shapes — see "
+                "runtime/sparse_tensor.py for the fixed-width row-sparse "
+                "utility and the full position)")
 
         if zc.offload_param is not None and \
                 zc.offload_param.device == OffloadDeviceEnum.cpu:

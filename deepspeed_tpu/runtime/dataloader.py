@@ -67,7 +67,19 @@ class DeepSpeedDataLoader:
     def _indices(self) -> np.ndarray:
         n = len(self.dataset)
         if self.data_sampler is not None:
-            return np.asarray(list(iter(self.data_sampler)))
+            # samplers may be infinite streams (CurriculumBatchSampler) and
+            # may yield either index BATCHES or single indices — draw one
+            # epoch's worth of INDICES either way
+            need = len(self) * self.batch_size
+            out: list = []
+            it = iter(self.data_sampler)
+            while len(out) < need:
+                try:
+                    b = next(it)
+                except StopIteration:
+                    break
+                out.extend(b if hasattr(b, "__len__") else [b])
+            return np.asarray(out)
         idx = np.arange(n)
         if self.shuffle:
             np.random.RandomState(self.seed + self.epoch).shuffle(idx)

@@ -183,11 +183,15 @@ class DeepSpeedEngine:
             if stages != pp:
                 raise ValueError(
                     f"mesh has pipe={pp} but model.config.pipeline_stages={stages}")
+            # pipeline_microbatches is DECOUPLED from gas (VERDICT r2 item 3):
+            # the per-step window (gas × micro_batch × dp samples) splits into
+            # M model-level microbatches; gas remains the optimizer cadence
             micro = getattr(mcfg, "pipeline_microbatches", None) or stages
-            if micro != self.gas:
+            window = self.gas * self.micro_batch_size * self.dp_world
+            if window % micro:
                 raise ValueError(
-                    f"pipeline microbatches ({micro}) must equal "
-                    f"gradient_accumulation_steps ({self.gas})")
+                    f"pipeline microbatches ({micro}) must divide the "
+                    f"per-step sample window gas*micro_batch*dp={window}")
 
         if self.config.activation_checkpointing.partition_activations:
             # satisfied structurally: saved remat residuals carry the model's
@@ -329,14 +333,15 @@ class DeepSpeedEngine:
         self._window_losses = []
         self._last_grad_norm: Optional[float] = None
         self._data_iterator = None
-        self.training_dataloader = self._build_dataloader(training_data)
-        self.monitor = self._build_monitor()
         # -- optional training features (runtime/features.py owns config
-        #    resolution + validation for each) --
+        #    resolution + validation for each; BEFORE the dataloader so an
+        #    in-loop curriculum can drive the sampler) --
         wire_progressive_layer_drop(self)
         wire_curriculum(self)
         wire_random_ltd(self, self.model)
         wire_flops_profiler(self)
+        self.training_dataloader = self._build_dataloader(training_data)
+        self.monitor = self._build_monitor()
         log_dist(
             f"engine ready: params={self.param_count:,} zero_stage={self.zero_stage} "
             f"dtype={self.compute_dtype.__name__} mesh={dict(mesh.shape)} "
@@ -487,12 +492,32 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     def _build_dataloader(self, training_data):
         if training_data is None:
+            if self._curriculum_metric_path is not None:
+                raise ValueError(
+                    "a metric-driven curriculum samples THROUGH the engine "
+                    "dataloader — pass training_data to initialize()")
             return None
         from .dataloader import DeepSpeedDataLoader
 
+        sampler = None
+        if self._curriculum_metric_path is not None:
+            # metric-driven curriculum: difficulty values from a DataAnalyzer
+            # run steer the in-loop sampler (reference DeepSpeedDataSampler,
+            # data_sampler.py:36)
+            from .data_pipeline.data_sampler import CurriculumBatchSampler
+
+            values = np.load(self._curriculum_metric_path)
+            if len(values) != len(training_data):
+                raise ValueError(
+                    f"curriculum metric file has {len(values)} values for a "
+                    f"dataset of {len(training_data)} samples")
+            sampler = CurriculumBatchSampler(
+                values, batch_size=self.micro_batch_size * self.dp_world,
+                curriculum=self.curriculum_scheduler, seed=self.config.seed)
+
         return DeepSpeedDataLoader(training_data,
                                    batch_size=self.micro_batch_size * self.dp_world,
-                                   mesh=self.mesh)
+                                   mesh=self.mesh, data_sampler=sampler)
 
     def _build_monitor(self):
         if not self.config.monitor_config.enabled:
@@ -799,6 +824,29 @@ class DeepSpeedEngine:
         accumulate = make_grad_accumulator(grad_of_batch, gas,
                                            self.config.data_types.jnp_dtype())
 
+        # 1F1B schedule (model config pipeline_schedule="1f1b"): the manual
+        # interleaved executor produces the gradients itself — AD cannot
+        # express fwd/bwd interleaving (runtime/pipe/spmd.py:pipeline_1f1b)
+        manual_pipe = None
+        if pipeline and getattr(getattr(self.model, "config", None),
+                                "pipeline_schedule", "gpipe") == "1f1b":
+            if self._compression_transform is not None:
+                raise NotImplementedError(
+                    "pipeline_schedule='1f1b' + compression_training: the "
+                    "manual executor differentiates the raw params")
+            if self.config.prescale_gradients:
+                raise NotImplementedError(
+                    "pipeline_schedule='1f1b' + prescale_gradients: "
+                    "unsupported")
+            if self.progressive_layer_drop is not None:
+                raise NotImplementedError(
+                    "pipeline_schedule='1f1b' + progressive_layer_drop: the "
+                    "manual executor would silently drop pld_theta")
+            if self._random_ltd is not None:
+                raise NotImplementedError(
+                    "pipeline_schedule='1f1b' + random_ltd: unsupported")
+            manual_pipe = self.model.pipeline_grad_fn()
+
         # landing dtype for the per-step gradients (config
         # data_types.grad_accum_dtype, reference runtime/config.py:867):
         # fp32 by default; bf16 halves the live grad buffer also in the
@@ -817,7 +865,10 @@ class DeepSpeedEngine:
                 flat = jax.tree_util.tree_map(
                     lambda x: x.reshape((-1,) + x.shape[2:]), batch)
                 new_rng, sub = jax.random.split(state.rng)
-                grads, losses = grad_of_batch(work, state.scaler, flat, sub)
+                if manual_pipe is not None:
+                    grads, losses = manual_pipe(work, state.scaler, flat, sub)
+                else:
+                    grads, losses = grad_of_batch(work, state.scaler, flat, sub)
                 grads = jax.tree_util.tree_map(
                     lambda g: g.astype(accum_dtype), grads)
                 eff_gas = 1  # loss already averages over the gas window
@@ -917,9 +968,10 @@ class DeepSpeedEngine:
             batch = data_iter
         global_batch = self._collect_global_batch(batch)
         global_batch = self._inject_pld_theta(global_batch, shape=(self.gas,))
-        if self.curriculum_scheduler is not None:
+        if self._curriculum_seqlen:
             # legacy seqlen curriculum: truncate the window's sequence dim;
             # jit caches one program per distinct difficulty automatically
+            # (metric-driven curricula steer the SAMPLER instead)
             diff = self.curriculum_scheduler.update_difficulty(
                 self.global_steps + 1)
             ref = (global_batch["input_ids"] if isinstance(global_batch, dict)
@@ -1037,6 +1089,21 @@ class DeepSpeedEngine:
         return est.compute_eigenvalue(self.loss_fn, params, micro, rng)
 
     # ------------------------------------------------------------------
+    def lower_train_step(self, batch):
+        """AOT-lower (no backend compile) the fused train step — the cheap
+        host-side half of :meth:`compile_train_step`.  The autotuner's
+        parallel compile-pruning lowers under a lock (global mesh state) and
+        compiles the lowered programs concurrently (XLA releases the GIL)."""
+        global_batch = self._collect_global_batch(batch)
+        global_batch = self._inject_pld_theta(global_batch, shape=(self.gas,))
+        if self._nvme_swapper is not None or self._param_offload is not None:
+            raise NotImplementedError(
+                "lower_train_step does not cover the NVMe grad-only / "
+                "layer-streamed offload paths")
+        if self._compiled_train_step is None:
+            self._compiled_train_step = self._make_train_step()
+        return self._compiled_train_step.lower(self.state, global_batch)
+
     def compile_train_step(self, batch):
         """AOT-compile the fused train step for ``batch``'s shapes and return
         the ``jax.stages.Compiled`` — its ``memory_analysis()`` /

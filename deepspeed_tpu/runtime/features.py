@@ -77,18 +77,35 @@ def wire_progressive_layer_drop(engine):
 
 
 def wire_curriculum(engine):
-    """Legacy curriculum learning (reference
-    ``runtime/data_pipeline/curriculum_scheduler.py``) plus the
-    data-efficiency metric-driven scheduler when configured."""
+    """Curriculum learning (reference legacy curriculum +
+    ``data_pipeline/data_sampling/data_sampler.py:36`` DeepSpeedDataSampler).
+
+    Two modes:
+      - ``curriculum_type == "seqlen"``: the engine truncates each batch's
+        sequence dim to the scheduled difficulty (legacy behavior).
+      - any other type: the difficulty is an ARBITRARY per-sample metric —
+        the engine's dataloader samples through a CurriculumBatchSampler
+        over ``metric_values_path`` (a DataAnalyzer output aligned to the
+        dataset), stepping difficulty in-loop per consumed batch.
+    """
     engine.curriculum_scheduler = None
+    engine._curriculum_seqlen = False
+    engine._curriculum_metric_path = None
     cl = engine.config.curriculum_learning
     if cl.enabled:
         from .data_pipeline.curriculum_scheduler import CurriculumScheduler
 
-        if cl.curriculum_type != "seqlen":
-            raise NotImplementedError(
-                f"curriculum_type {cl.curriculum_type!r}: only 'seqlen' "
-                "(sequence truncation) is implemented")
+        if cl.curriculum_type == "seqlen":
+            engine._curriculum_seqlen = True
+        elif not cl.metric_values_path:
+            raise ValueError(
+                f"curriculum_type {cl.curriculum_type!r} schedules an "
+                "arbitrary difficulty metric through the data sampler — "
+                "set curriculum_learning.metric_values_path to a "
+                "DataAnalyzer metric file (run_map/run_reduce) aligned "
+                "to the training dataset")
+        else:
+            engine._curriculum_metric_path = cl.metric_values_path
         engine.curriculum_scheduler = CurriculumScheduler({
             "curriculum_type": cl.curriculum_type,
             "min_difficulty": cl.min_difficulty,

@@ -70,3 +70,132 @@ def stage_layer_count(num_layers: int, num_stages: int) -> int:
         raise ValueError(
             f"num_layers={num_layers} not divisible by pipeline stages={num_stages}")
     return num_layers // num_stages
+
+
+def pipeline_1f1b(stage_fn: Callable, head_fn: Callable, stage_params: Any,
+                  head_params: Any, x_micro: jnp.ndarray,
+                  labels_micro: jnp.ndarray, rng: jnp.ndarray):
+    """True 1F1B: ONE scan interleaves forward and backward wavefronts
+    (reference ``runtime/pipe/schedule.py:189`` ``TrainSchedule`` — there an
+    imperative per-rank instruction stream; here both wavefronts are buffers
+    rolling in opposite directions over the 'pipe' axis).
+
+    Why not AD of the GPipe scan (``pipeline_apply``): AD must finish the
+    whole forward before the first backward step, so every one of the
+    ``M+P-1`` saved carries is live at once — activation stash grows with M.
+    Here backward for microbatch m starts P-p ticks after its forward at
+    stage p, so the stash is a fixed ring of ``2P`` entries per stage:
+    **activation memory is O(P²·mb·S·D), independent of M** — the 1F1B
+    memory contract that lets M (and with it the bubble term (P-1)/(M+P-1))
+    grow freely.
+
+    Timing (lockstep SPMD): ``M + 2P - 1`` ticks, each tick = one stage
+    forward + one stage backward everywhere (≈3 fwd-units).  GPipe-via-AD
+    spans ``2(M+P-1)`` half-ticks ≈ ``3(M+P-1)`` units — 1F1B trades
+    ``3(P-1)`` extra units of drain for the M-independent memory.  Pick per
+    job via ``pipeline_schedule`` ("gpipe" when activations fit, "1f1b"
+    when they don't).
+
+    Contract:
+      stage_fn(stage_layer_params, x [mb,S,D], rng) -> x      (no aux)
+      head_fn(head_params, y [mb,S,D], labels [mb,S]) -> loss (scaled —
+        its vjp IS the gradient source; callers fold loss-scale/M here)
+    Returns (losses [M] f32, dstage_params, dhead_params, dx_micro).
+    """
+    P_ = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+    M = x_micro.shape[0]
+    K = 2 * P_                       # stash ring: lifetime(m,p) = 2(P-p)-1 < K
+    T = M + 2 * P_ - 1
+    mb_shape = x_micro.shape[1:]
+
+    # per-tick feeds, padded to T ticks
+    zero_mb = jnp.zeros((1,) + mb_shape, x_micro.dtype)
+    xs_in = jnp.concatenate(
+        [x_micro, jnp.broadcast_to(zero_mb, (T - M,) + mb_shape)], axis=0)
+    zero_lb = jnp.zeros((1,) + labels_micro.shape[1:], labels_micro.dtype)
+    # head consumes the exit of tick t: microbatch t-(P-1)
+    labels_pad = jnp.concatenate([
+        jnp.broadcast_to(zero_lb, (P_ - 1,) + labels_micro.shape[1:]),
+        labels_micro,
+        jnp.broadcast_to(zero_lb, (T - M - P_ + 1,) + labels_micro.shape[1:]),
+    ], axis=0)
+
+    f32 = lambda t: jax.tree_util.tree_map(  # noqa: E731
+        lambda g: g.astype(jnp.float32), t)
+
+    def stage_bwd_one(lp, x, r, cot, mask):
+        _, vjp = jax.vjp(lambda lp_, x_: stage_fn(lp_, x_, r), lp, x)
+        dlp, dx = vjp(cot)
+        m = mask.astype(jnp.float32)
+        return (jax.tree_util.tree_map(lambda g: g.astype(jnp.float32) * m,
+                                       dlp),
+                dx * mask.astype(dx.dtype))
+
+    sid = jnp.arange(P_)
+
+    def tick(carry, inp):
+        state, cot, stash, dstage, dhead, t = carry
+        x_in, labels_t = inp
+
+        # ---- backward half: bwd(m_b, p) at tick 2P-1-p+m_b ----
+        m_b = t - (2 * P_ - 1 - sid)                        # [P]
+        bwd_valid = (m_b >= 0) & (m_b < M)
+        slot_b = jnp.remainder(m_b, K)
+        x_stash = jax.vmap(
+            lambda s, i: jax.lax.dynamic_index_in_dim(s, i, 0, False)
+        )(stash, slot_b)                                     # [P, mb, S, D]
+        rngs_b = jax.vmap(
+            lambda m, p: jax.random.fold_in(jax.random.fold_in(rng, m), p)
+        )(jnp.maximum(m_b, 0), sid)
+        dlp, dx = jax.vmap(stage_bwd_one)(stage_params, x_stash, rngs_b,
+                                          cot, bwd_valid)
+        dstage = jax.tree_util.tree_map(lambda a, g: a + g, dstage, dlp)
+        dx_out = dx[0]                                       # stage 0 -> embed
+
+        # ---- forward half: fwd(m_f, p) at tick p+m_f ----
+        state = state.at[0].set(x_in)
+        m_f = t - sid
+        fwd_valid = (m_f >= 0) & (m_f < M)
+        slot_f = jnp.remainder(jnp.maximum(m_f, 0), K)
+        stash = jax.vmap(
+            lambda s, x, i, v: jax.lax.cond(
+                v, lambda: jax.lax.dynamic_update_index_in_dim(s, x, i, 0),
+                lambda: s)
+        )(stash, state, slot_f, fwd_valid)
+        rngs_f = jax.vmap(
+            lambda m, p: jax.random.fold_in(jax.random.fold_in(rng, m), p)
+        )(jnp.maximum(m_f, 0), sid)
+        new_state = jax.vmap(stage_fn)(stage_params, state, rngs_f)
+
+        # ---- head on this tick's exit (microbatch t-(P-1)) ----
+        m_h = t - (P_ - 1)
+        head_valid = ((m_h >= 0) & (m_h < M)).astype(jnp.float32)
+        y = new_state[P_ - 1]
+        loss_t, (dh, dy) = jax.value_and_grad(head_fn, argnums=(0, 1))(
+            head_params, y, labels_t)
+        dhead = jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(jnp.float32) * head_valid, dhead, dh)
+
+        # ---- roll both wavefronts ----
+        new_state = jnp.roll(new_state, 1, axis=0)           # stage s -> s+1
+        # cot[p] <- dx from stage p+1's bwd; cot[P-1] <- head's dy
+        new_cot = jnp.concatenate(
+            [dx[1:], (dy * head_valid.astype(dy.dtype))[None]], axis=0)
+        return ((new_state, new_cot, stash, dstage, dhead, t + 1),
+                (loss_t * head_valid, dx_out))
+
+    state0 = jnp.zeros((P_,) + mb_shape, x_micro.dtype)
+    cot0 = jnp.zeros((P_,) + mb_shape, x_micro.dtype)
+    stash0 = jnp.zeros((P_, K) + mb_shape, x_micro.dtype)
+    dstage0 = jax.tree_util.tree_map(
+        lambda x: jnp.zeros(x.shape, jnp.float32), stage_params)
+    dhead0 = jax.tree_util.tree_map(
+        lambda x: jnp.zeros(x.shape, jnp.float32), head_params)
+    (_, _, _, dstage, dhead, _), (losses_t, dxs_t) = jax.lax.scan(
+        tick, (state0, cot0, stash0, dstage0, dhead0, jnp.int32(0)),
+        (xs_in, labels_pad))
+    # microbatch m's loss lands at tick P-1+m; its embed cotangent exits
+    # stage 0's bwd at tick 2P-1+m
+    losses = jax.lax.dynamic_slice_in_dim(losses_t, P_ - 1, M, 0)
+    dx_micro = jax.lax.dynamic_slice_in_dim(dxs_t, 2 * P_ - 1, M, 0)
+    return losses, dstage, dhead, dx_micro
