@@ -46,21 +46,57 @@ class DeepSpeedHybridEngine:
                 "hybrid engine needs a KV-cache-capable model (apply_cached); "
                 "pass the CausalLM adapter the training engine was built with")
         self.model = model
+        # LoRA actor (runtime/lora.py LoRAModel): generation fuses the
+        # adapters into the base weights ONCE per call instead of per decode
+        # step (reference fuse_lora_weight/unfuse_lora_weight,
+        # hybrid_engine.py:138-160)
+        self._lora = model if hasattr(model, "fused") and \
+            hasattr(model, "base_model") else None
+        self._gen_model = self._lora.base_model if self._lora else model
+        self._fuse_jit = None
+        self._fused_params = None
+        self._fused_at_step = None
         cfg = inference_config or DeepSpeedInferenceConfig(
             dtype="bf16" if str(engine.compute_dtype.__name__) == "bfloat16"
             else "fp32")
         # params=None: generation always reads the LIVE training view
-        self._infer = InferenceEngine(model, config=cfg, params=None,
-                                      apply_fn=model.apply_fn,
+        self._infer = InferenceEngine(self._gen_model, config=cfg, params=None,
+                                      apply_fn=self._gen_model.apply_fn,
                                       mesh=engine.mesh)
         self._generate_calls = 0
         self._generate_time = 0.0
 
+    # -- LoRA fuse/unfuse (reference hybrid_engine.py:138-160) --
+    def fuse_lora_weight(self):
+        """Materialize base + A@B·scale for generation.  Pure function of
+        the live adapter tree — the base weights are never mutated, so
+        'unfuse' is just dropping this cache."""
+        if self._lora is None:
+            return  # API parity no-op (reference skips without LoRA too)
+        import jax
+
+        if self._fuse_jit is None:
+            self._fuse_jit = jax.jit(self._lora.fused)
+        self._fused_params = self._fuse_jit(self.engine.state.params)
+        self._fused_at_step = self.engine.global_steps
+
+    def unfuse_lora_weight(self):
+        self._fused_params = None
+        self._fused_at_step = None
+
+    def _generation_params(self):
+        if self._lora is None:
+            return self.engine.state.params
+        if self._fused_params is None or \
+                self._fused_at_step != self.engine.global_steps:
+            self.fuse_lora_weight()   # auto-refresh after training flips
+        return self._fused_params
+
     # -- generation over the live weights (reference generate():238) --
     def generate(self, input_ids, **kwargs) -> Any:
         t0 = time.perf_counter()
-        out = self._infer.generate(input_ids, model=self.model,
-                                   params=self.engine.state.params, **kwargs)
+        out = self._infer.generate(input_ids, model=self._gen_model,
+                                   params=self._generation_params(), **kwargs)
         self._generate_time += time.perf_counter() - t0
         self._generate_calls += 1
         return out

@@ -1,0 +1,141 @@
+"""LoRA adapters for the hybrid (RLHF) engine.
+
+Parity target: DeepSpeed-Chat's LoRA utilities plus the reference hybrid
+engine's ``fuse_lora_weight``/``unfuse_lora_weight``
+(``runtime/hybrid_engine.py:138-160``): during generation the low-rank
+deltas are folded into the base weights so the inference kernels see plain
+matrices; before training resumes they are unfolded.
+
+TPU-native shape: LoRA is a FUNCTIONAL transform.  The trainable tree IS the
+adapter tree (the engine trains whatever ``init_fn`` returns — base weights
+are a closed-over constant, naturally frozen), and "fusing" is a jitted pure
+function ``fused = base + A @ B * (alpha/r)`` whose output feeds the decode
+program.  There is no module surgery and no unfuse bookkeeping — the base
+tree is never mutated; ``unfuse`` merely drops the cached fused tree.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import log_dist
+
+# attention projections — DeepSpeed-Chat's default LoRA surface
+DEFAULT_TARGETS: Tuple[str, ...] = ("wq", "wk", "wv", "wo")
+
+
+@dataclasses.dataclass(frozen=True)
+class LoRAConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    targets: Tuple[str, ...] = DEFAULT_TARGETS
+
+    @property
+    def scaling(self) -> float:
+        return self.alpha / self.rank
+
+
+def init_lora_params(base_layers: Dict[str, Any], cfg: LoRAConfig,
+                     rng: jax.Array) -> Dict[str, Any]:
+    """A/B factors for each targeted layer weight.
+
+    Targets are leaves of the model's stacked ``layers`` dict with shape
+    [L, d_in, d_out].  A ~ N(0, 1/r) [L, d_in, r], B = 0 [L, r, d_out]
+    (zero-init B makes step-0 output exactly the base model)."""
+    out: Dict[str, Any] = {}
+    keys = jax.random.split(rng, len(cfg.targets))
+    for k, key in zip(cfg.targets, keys):
+        if k not in base_layers:
+            raise ValueError(f"LoRA target {k!r} not in model layers "
+                             f"({sorted(base_layers)})")
+        w = base_layers[k]
+        if w.ndim != 3:
+            raise NotImplementedError(
+                f"LoRA target {k!r} has rank-{w.ndim} weight; only stacked "
+                "[L, d_in, d_out] matmul weights are supported")
+        L, d_in, d_out = w.shape
+        out[k] = {
+            "A": jax.random.normal(key, (L, d_in, cfg.rank), jnp.float32)
+            * (1.0 / cfg.rank),
+            "B": jnp.zeros((L, cfg.rank, d_out), jnp.float32),
+        }
+    return out
+
+
+def lora_param_specs(cfg: LoRAConfig) -> Dict[str, Any]:
+    """Adapters are tiny — replicate them (r ≪ d makes TP sharding noise)."""
+    from jax.sharding import PartitionSpec as P
+
+    return {k: {"A": P(None, None, None), "B": P(None, None, None)}
+            for k in cfg.targets}
+
+
+def apply_lora(base_params: Dict[str, Any], lora: Dict[str, Any],
+               scaling: float, dtype=None) -> Dict[str, Any]:
+    """``fused = base + A @ B * scaling`` on the targeted layer weights —
+    the reference's fuse_lora_weight as a pure function."""
+    layers = dict(base_params["layers"])
+    for k, ab in lora.items():
+        w = layers[k]
+        delta = jnp.einsum("lir,lro->lio", ab["A"], ab["B"]) * scaling
+        layers[k] = (w + delta.astype(w.dtype))
+    out = dict(base_params)
+    out["layers"] = layers
+    return out
+
+
+class LoRAModel:
+    """Engine adapter: train ONLY the LoRA tree against frozen base weights.
+
+    Satisfies the engine's model contract (init_fn/loss_fn/param_specs), so
+    ``deepspeed_tpu.initialize(model=LoRAModel(base, base_params, cfg))``
+    runs ZeRO/offload/etc. over the adapter tree while the base weights ride
+    as a closed-over constant."""
+
+    def __init__(self, base_model, base_params, lora_config: LoRAConfig):
+        self.base_model = base_model
+        # frozen base rides in the COMPUTE dtype (cfg.dtype): the fused tree
+        # must match the activation dtype or every matmul/scan would mix
+        # precisions (and an fp32 base would double the frozen footprint)
+        dt = base_model.config.dtype
+        self.base_params = jax.tree_util.tree_map(
+            lambda x: x.astype(dt)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, base_params)
+        self.lora_config = lora_config
+        self.config = base_model.config
+        self.param_specs = lora_param_specs(lora_config)
+        n = sum(int(jnp.size(l)) for l in
+                jax.tree_util.tree_leaves(base_params))
+        log_dist(f"LoRA: rank={lora_config.rank} over "
+                 f"{list(lora_config.targets)} — base {n:,} params frozen",
+                 ranks=[0])
+
+    def init_fn(self, rng):
+        return init_lora_params(self.base_params["layers"], self.lora_config,
+                                rng)
+
+    def fused(self, lora):
+        return apply_lora(self.base_params, lora, self.lora_config.scaling)
+
+    def loss_fn(self, lora, batch, rng):
+        return self.base_model.loss_fn(self.fused(lora), batch, rng)
+
+    def eval_fn(self, lora, batch, rng):
+        return self.base_model.eval_fn(self.fused(lora), batch, rng)
+
+    # KV-cache decode contract passthrough (generation uses fused weights)
+    def init_cache(self, *a, **k):
+        return self.base_model.init_cache(*a, **k)
+
+    def cache_specs(self):
+        return self.base_model.cache_specs()
+
+    def apply_cached(self, lora, tokens, cache, positions, input_mask):
+        return self.base_model.apply_cached(self.fused(lora), tokens, cache,
+                                            positions, input_mask)
+
+    def apply_fn(self, lora, *a, **k):
+        return self.base_model.apply_fn(self.fused(lora), *a, **k)

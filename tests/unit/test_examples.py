@@ -16,7 +16,9 @@ _BOOT = ("import jax, runpy, sys, os; "
     ["examples/train.py", "--model", "tiny", "--seq_len", "32", "--steps", "3"],
     ["examples/generate.py", "--model", "tiny", "--batch", "2",
      "--prompt_len", "16", "--new_tokens", "4"],
-], ids=["train", "generate"])
+    ["examples/rlhf.py", "--model", "tiny", "--iters", "1",
+     "--new_tokens", "4"],
+], ids=["train", "generate", "rlhf"])
 def test_example_runs(cmd):
     repo = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
