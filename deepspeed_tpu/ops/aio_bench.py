@@ -1,0 +1,116 @@
+"""NVMe/filesystem bandwidth bench for the native aio engine.
+
+Parity target: reference ``csrc/aio/py_test`` (``ds_io`` benchmark suite) —
+sustained read/write GB/s at varying thread counts, plus an honest baseline
+from ``dd`` on the same volume so the engine's overhead is visible.
+
+    python -m deepspeed_tpu.ops.aio_bench --path /tmp/aio_bench \
+        --size-mb 256 --threads 1 4 8 [--direct] [--dd]
+
+Prints one JSON line per configuration:
+    {"op": "read", "threads": 4, "gbps": 2.31, "direct": false, ...}
+"""
+from __future__ import annotations
+
+import argparse
+import ctypes
+import json
+import os
+import subprocess
+import time
+
+import numpy as np
+
+from .op_builder import AsyncIOBuilder
+
+ALIGN = 4096
+
+
+def _aligned_buffer(nbytes: int) -> np.ndarray:
+    """4096-aligned uint8 buffer (O_DIRECT requirement)."""
+    raw = np.empty(nbytes + ALIGN, np.uint8)
+    off = (-raw.ctypes.data) % ALIGN
+    return raw[off:off + nbytes]
+
+
+def bench_engine(path: str, size_mb: int, threads: int, direct: bool,
+                 repeats: int = 3):
+    lib = AsyncIOBuilder().load()
+    nbytes = size_mb * (1 << 20)
+    buf = _aligned_buffer(nbytes)
+    buf[:] = np.random.default_rng(0).integers(0, 255, nbytes, np.uint8)
+    fd = int(lib.ds_aio_open(path.encode(), 1, int(direct)))
+    if fd < 0:
+        raise OSError(-fd, f"open {path}")
+    got_direct = bool(lib.ds_aio_is_direct(fd))
+    out = []
+    try:
+        for op in ("write", "read"):
+            fn = lib.ds_aio_pwrite if op == "write" else lib.ds_aio_pread
+            best = 0.0
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                rc = fn(fd, buf.ctypes.data_as(ctypes.c_void_p), nbytes, 0,
+                        threads)
+                if rc != 0:
+                    raise OSError(-rc, f"aio {op}")
+                os.fsync(fd) if op == "write" else None
+                dt = time.perf_counter() - t0
+                best = max(best, nbytes / dt / 1e9)
+            out.append({"op": op, "engine": "ds_aio", "threads": threads,
+                        "direct": got_direct, "size_mb": size_mb,
+                        "gbps": round(best, 3)})
+    finally:
+        lib.ds_aio_close(fd)
+    return out
+
+
+def bench_dd(path: str, size_mb: int):
+    """Raw ``dd`` on the same volume — the reference comparison point."""
+    out = []
+    blocks = size_mb
+    for op, cmd in (
+            ("write", ["dd", f"if=/dev/zero", f"of={path}", "bs=1M",
+                       f"count={blocks}", "conv=fdatasync"]),
+            ("read", ["dd", f"if={path}", "of=/dev/null", "bs=1M",
+                      f"count={blocks}"])):
+        t0 = time.perf_counter()
+        subprocess.run(cmd, check=True, capture_output=True)
+        dt = time.perf_counter() - t0
+        out.append({"op": op, "engine": "dd", "size_mb": size_mb,
+                    "gbps": round(size_mb * (1 << 20) / dt / 1e9, 3)})
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--path", default="/tmp/ds_aio_bench.bin")
+    ap.add_argument("--size-mb", type=int, default=256)
+    ap.add_argument("--threads", type=int, nargs="+", default=[1, 4, 8])
+    ap.add_argument("--direct", action="store_true",
+                    help="request O_DIRECT (falls back to buffered if the "
+                         "filesystem refuses)")
+    ap.add_argument("--dd", action="store_true",
+                    help="also run the raw dd baseline")
+    args = ap.parse_args(argv)
+
+    results = []
+    for t in args.threads:
+        results += bench_engine(args.path, args.size_mb, t, args.direct)
+    if args.dd:
+        results += bench_dd(args.path + ".dd", args.size_mb)
+        try:
+            os.unlink(args.path + ".dd")
+        except OSError:
+            pass
+    try:
+        os.unlink(args.path)
+    except OSError:
+        pass
+    for r in results:
+        print(json.dumps(r))
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -185,6 +185,24 @@ class AsyncIOBuilder(OpBuilder):
         lib.ds_aio_read.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
                                     ctypes.c_int64, ctypes.c_int]
         lib.ds_aio_read.restype = ctypes.c_int
+        # persistent-fd API (open once per swap file; optional O_DIRECT)
+        lib.ds_aio_open.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                    ctypes.c_int]
+        lib.ds_aio_open.restype = ctypes.c_int64
+        lib.ds_aio_is_direct.argtypes = [ctypes.c_int64]
+        lib.ds_aio_is_direct.restype = ctypes.c_int
+        lib.ds_aio_close.argtypes = [ctypes.c_int64]
+        lib.ds_aio_close.restype = ctypes.c_int
+        for name in ("ds_aio_submit_pwrite", "ds_aio_submit_pread"):
+            fn = getattr(lib, name)
+            fn.argtypes = [ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+                           ctypes.c_int64, ctypes.c_int]
+            fn.restype = ctypes.c_int64
+        for name in ("ds_aio_pwrite", "ds_aio_pread"):
+            fn = getattr(lib, name)
+            fn.argtypes = [ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+                           ctypes.c_int64, ctypes.c_int]
+            fn.restype = ctypes.c_int
 
 
 ALL_OPS: Dict[str, Type[OpBuilder]] = {

@@ -30,7 +30,12 @@ from ...utils.logging import logger
 
 
 class TensorSwapper:
-    """Flat fp32 buffers in files, async via the native aio engine."""
+    """Flat fp32 buffers in files, async via the native aio engine.
+
+    Files are opened ONCE and kept as persistent fds (reference
+    ``deepspeed_py_aio_handle.cpp`` holds the handle per swap file) — the
+    old per-op open/close cost a syscall pair + dentry walk per leaf per
+    step."""
 
     def __init__(self, swap_dir: str, aio_threads: int = 4):
         os.makedirs(swap_dir, exist_ok=True)
@@ -39,16 +44,40 @@ class TensorSwapper:
         self._lib = AsyncIOBuilder().load()
         self._shapes: Dict[str, Tuple[int, ...]] = {}
         self._dtypes: Dict[str, np.dtype] = {}
+        self._fds: Dict[str, int] = {}
 
     def _path(self, name: str) -> str:
         return os.path.join(self.swap_dir, name.replace("/", "__") + ".swp")
 
+    def _fd(self, name: str) -> int:
+        fd = self._fds.get(name)
+        if fd is None:
+            fd = int(self._lib.ds_aio_open(self._path(name).encode(), 1, 0))
+            if fd < 0:
+                raise OSError(-fd, f"aio open failed for {name}")
+            self._fds[name] = fd
+        return fd
+
+    def close(self) -> None:
+        for fd in self._fds.values():
+            self._lib.ds_aio_close(fd)
+        self._fds.clear()
+
+    def __del__(self):  # noqa: D105
+        try:
+            self.close()
+        except Exception:   # noqa: BLE001 — interpreter teardown
+            pass
+
     def write(self, name: str, arr: np.ndarray) -> None:
         self._shapes[name] = arr.shape
         self._dtypes[name] = arr.dtype
-        rc = self._lib.ds_aio_write(self._path(name).encode(),
-                                    np.ascontiguousarray(arr).ctypes.data,
-                                    arr.nbytes, self.aio_threads)
+        # bind the (possible) contiguous copy to a local so it outlives the
+        # native call — `ascontiguousarray(x).ctypes.data` alone can free
+        # the copy before pwrite reads it
+        carr = np.ascontiguousarray(arr)
+        rc = self._lib.ds_aio_pwrite(self._fd(name), carr.ctypes.data,
+                                     carr.nbytes, 0, self.aio_threads)
         if rc != 0:
             raise OSError(-rc, f"aio write failed for {name}")
 
@@ -56,14 +85,13 @@ class TensorSwapper:
         """arr must stay alive until wait()."""
         self._shapes[name] = arr.shape
         self._dtypes[name] = arr.dtype
-        return self._lib.ds_aio_submit_write(
-            self._path(name).encode(), arr.ctypes.data, arr.nbytes,
-            self.aio_threads)
+        return self._lib.ds_aio_submit_pwrite(
+            self._fd(name), arr.ctypes.data, arr.nbytes, 0, self.aio_threads)
 
     def read(self, name: str, out: Optional[np.ndarray] = None) -> np.ndarray:
         out = self._alloc(name, out)
-        rc = self._lib.ds_aio_read(self._path(name).encode(), out.ctypes.data,
-                                   out.nbytes, self.aio_threads)
+        rc = self._lib.ds_aio_pread(self._fd(name), out.ctypes.data,
+                                    out.nbytes, 0, self.aio_threads)
         if rc != 0:
             raise OSError(-rc, f"aio read failed for {name}")
         return out
@@ -71,9 +99,8 @@ class TensorSwapper:
     def submit_read(self, name: str, out: Optional[np.ndarray] = None
                     ) -> Tuple[int, np.ndarray]:
         out = self._alloc(name, out)
-        h = self._lib.ds_aio_submit_read(self._path(name).encode(),
-                                         out.ctypes.data, out.nbytes,
-                                         self.aio_threads)
+        h = self._lib.ds_aio_submit_pread(self._fd(name), out.ctypes.data,
+                                          out.nbytes, 0, self.aio_threads)
         return h, out
 
     def wait(self, handle: int) -> None:

@@ -117,3 +117,71 @@ def test_engine_compute_eigenvalue():
         random_batch(engine.train_batch_size, 16, 0))
     assert np.isfinite(lam)
     assert per_leaf and all(np.isfinite(v) for v in per_leaf.values())
+
+
+# ---------------------------------------------------------------------------
+# SparseTensor (reference runtime/sparse_tensor.py)
+
+
+def test_sparse_tensor_roundtrip():
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.runtime.sparse_tensor import (SparseTensor,
+                                                     from_embedding_grad)
+
+    V, d = 16, 4
+    tokens = jnp.asarray([1, 3, 3, 7], jnp.int32)
+    cot = jnp.arange(4 * d, dtype=jnp.float32).reshape(4, d)
+    st = from_embedding_grad(tokens, cot, V)
+    dense = np.asarray(jax.jit(lambda s: s.to_dense())(st))
+    ref = np.zeros((V, d), np.float32)
+    for t, g in zip(np.asarray(tokens), np.asarray(cot)):
+        ref[t] += g  # duplicates sum — scatter-add semantics
+    np.testing.assert_array_equal(dense, ref)
+    both = st.add(st)
+    np.testing.assert_array_equal(np.asarray(both.to_dense()), 2 * ref)
+    assert st.nbytes < V * d * 4  # sparser than dense for few rows
+
+
+def test_sparse_allreduce_over_data_axis():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.parallel import mesh as mesh_mod
+    from deepspeed_tpu.parallel.mesh import MeshLayout, initialize_mesh
+    from deepspeed_tpu.runtime.sparse_tensor import (SparseTensor,
+                                                     sparse_allreduce)
+
+    mesh_mod.reset_mesh()
+    mesh = initialize_mesh(MeshLayout(dp=8))
+    V, d, N = 32, 4, 8  # N rows per worker
+    rows = jnp.tile(jnp.arange(8, dtype=jnp.int32), 8)          # [64]
+    values = jnp.ones((64, d), jnp.float32)
+
+    def region(r, v):
+        st = sparse_allreduce(SparseTensor(r, v, dense_rows=V), "data")
+        return st.to_dense()
+
+    f = mesh_mod.shard_map_compat(
+        region, mesh, in_specs=(P(("data_outer", "data", "expert")),
+                                P(("data_outer", "data", "expert"), None)),
+        out_specs=P())
+    with mesh_mod.manual_region():
+        dense = np.asarray(f(rows, values))
+    # every worker contributed ones on rows 0..7 -> each row sums to 8·... 
+    np.testing.assert_array_equal(dense[:8], np.full((8, d), 8.0))
+    np.testing.assert_array_equal(dense[8:], np.zeros((V - 8, d)))
+    mesh_mod.reset_mesh()
+
+
+def test_sparse_gradients_config_rejected():
+    import deepspeed_tpu
+    import pytest as _pytest
+
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    with _pytest.raises(NotImplementedError, match="sparse_gradients"):
+        DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1,
+                         "sparse_gradients": True})

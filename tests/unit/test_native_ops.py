@@ -223,3 +223,73 @@ def test_swapped_adam_no_pipeline_same_result(tmp_path):
     b.step(grads)
     for k in masters:
         np.testing.assert_array_equal(a.read_masters()[k], b.read_masters()[k])
+
+
+def test_aio_persistent_fd_api(tmp_path):
+    """Persistent-fd pread/pwrite at offsets (reference
+    deepspeed_py_aio_handle.cpp handle semantics)."""
+    import ctypes
+
+    from deepspeed_tpu.ops.op_builder import AsyncIOBuilder
+
+    lib = AsyncIOBuilder().load()
+    p = str(tmp_path / "fd.bin").encode()
+    fd = int(lib.ds_aio_open(p, 1, 0))
+    assert fd >= 0
+    try:
+        data = np.arange(1 << 16, dtype=np.uint8)
+        rc = lib.ds_aio_pwrite(fd, data.ctypes.data_as(ctypes.c_void_p),
+                               data.nbytes, 0, 2)
+        assert rc == 0
+        # offset write overwrites the tail
+        tail = np.full(1 << 8, 7, np.uint8)
+        rc = lib.ds_aio_pwrite(fd, tail.ctypes.data_as(ctypes.c_void_p),
+                               tail.nbytes, data.nbytes - tail.nbytes, 1)
+        assert rc == 0
+        out = np.empty_like(data)
+        rc = lib.ds_aio_pread(fd, out.ctypes.data_as(ctypes.c_void_p),
+                              out.nbytes, 0, 2)
+        assert rc == 0
+        np.testing.assert_array_equal(out[:-256], data[:-256])
+        np.testing.assert_array_equal(out[-256:], tail)
+    finally:
+        assert lib.ds_aio_close(fd) == 0
+
+
+def test_aio_bench_reports_bandwidth(tmp_path):
+    """The ds_tpu_io bench emits engine GB/s records (reference
+    csrc/aio/py_test role)."""
+    from deepspeed_tpu.ops.aio_bench import bench_engine
+
+    res = bench_engine(str(tmp_path / "b.bin"), size_mb=8, threads=2,
+                       direct=False, repeats=1)
+    ops = {r["op"] for r in res}
+    assert ops == {"read", "write"}
+    assert all(r["gbps"] > 0 for r in res)
+
+
+def test_aio_o_direct_open(tmp_path):
+    """O_DIRECT open succeeds or falls back to buffered — either way the fd
+    works with aligned buffers."""
+    import ctypes
+
+    from deepspeed_tpu.ops.aio_bench import _aligned_buffer
+    from deepspeed_tpu.ops.op_builder import AsyncIOBuilder
+
+    lib = AsyncIOBuilder().load()
+    p = str(tmp_path / "direct.bin").encode()
+    fd = int(lib.ds_aio_open(p, 1, 1))
+    assert fd >= 0
+    try:
+        buf = _aligned_buffer(1 << 16)
+        buf[:] = 3
+        rc = lib.ds_aio_pwrite(fd, buf.ctypes.data_as(ctypes.c_void_p),
+                               buf.nbytes, 0, 1)
+        assert rc == 0
+        out = _aligned_buffer(1 << 16)
+        rc = lib.ds_aio_pread(fd, out.ctypes.data_as(ctypes.c_void_p),
+                              out.nbytes, 0, 1)
+        assert rc == 0
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(buf))
+    finally:
+        lib.ds_aio_close(fd)
