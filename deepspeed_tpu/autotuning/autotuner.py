@@ -44,6 +44,28 @@ class TrialRecord:
         return dataclasses.asdict(self)
 
 
+
+def _memory_bytes(mem) -> int:
+    """Compiled-program HBM estimate — ONE formula for prune and measure."""
+    return int(getattr(mem, "temp_size_in_bytes", 0)
+               + getattr(mem, "argument_size_in_bytes", 0)
+               + getattr(mem, "output_size_in_bytes", 0)
+               - getattr(mem, "alias_size_in_bytes", 0))
+
+
+def _apply_budget(rec: TrialRecord, mem, hbm_bytes: int) -> bool:
+    """Record the estimate; True if the config fits the budget."""
+    if mem is None:
+        return True
+    rec.memory_bytes = _memory_bytes(mem)
+    if rec.memory_bytes > hbm_bytes * MEMORY_SAFETY_MARGIN:
+        rec.status = "compile_oom"
+        rec.error = (f"predicted {rec.memory_bytes / 1e9:.2f} GB > "
+                     f"budget {hbm_bytes / 1e9:.2f} GB")
+        return False
+    return True
+
+
 @dataclasses.dataclass(frozen=True)
 class AutotuningConfig:
     """``autotuning`` block (reference constants.py:41-70)."""
@@ -181,20 +203,8 @@ class Autotuner:
                 try:
                     compiled = low.compile()
                     rec.compile_sec = time.perf_counter() - t0
-                    mem = compiled.memory_analysis()
-                    if mem is not None:
-                        rec.memory_bytes = int(
-                            getattr(mem, "temp_size_in_bytes", 0)
-                            + getattr(mem, "argument_size_in_bytes", 0)
-                            + getattr(mem, "output_size_in_bytes", 0)
-                            - getattr(mem, "alias_size_in_bytes", 0))
-                        if rec.memory_bytes > \
-                                self.config.hbm_bytes * MEMORY_SAFETY_MARGIN:
-                            rec.status = "compile_oom"
-                            rec.error = (
-                                f"predicted {rec.memory_bytes / 1e9:.2f} GB "
-                                f"> budget "
-                                f"{self.config.hbm_bytes / 1e9:.2f} GB")
+                    _apply_budget(rec, compiled.memory_analysis(),
+                                  self.config.hbm_bytes)
                 except Exception as e:  # noqa: BLE001
                     rec.status = ("compile_oom"
                                   if "resource_exhausted" in str(e).lower()
@@ -287,17 +297,8 @@ class Autotuner:
             step = engine.compile_train_step(batch)
             rec.compile_sec = time.perf_counter() - t0
             mem = step.memory_analysis() if hasattr(step, "memory_analysis") else None
-            if mem is not None:
-                rec.memory_bytes = int(
-                    getattr(mem, "temp_size_in_bytes", 0)
-                    + getattr(mem, "argument_size_in_bytes", 0)
-                    + getattr(mem, "output_size_in_bytes", 0)
-                    - getattr(mem, "alias_size_in_bytes", 0))
-                if rec.memory_bytes > self.config.hbm_bytes * MEMORY_SAFETY_MARGIN:
-                    rec.status = "compile_oom"
-                    rec.error = (f"predicted {rec.memory_bytes / 1e9:.2f} GB > "
-                                 f"budget {self.config.hbm_bytes / 1e9:.2f} GB")
-                    return rec
+            if not _apply_budget(rec, mem, self.config.hbm_bytes):
+                return rec
             # timed steps (start/end_profile_step warmup convention)
             warm = self.config.start_profile_step
             steps = max(1, self.config.end_profile_step - warm)

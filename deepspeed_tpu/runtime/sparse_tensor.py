@@ -57,8 +57,8 @@ class SparseTensor:
 
     @property
     def nbytes(self) -> int:
-        return int(self.rows.size * 4 + self.values.size
-                   * self.values.dtype.itemsize)
+        return int(self.rows.size * self.rows.dtype.itemsize
+                   + self.values.size * self.values.dtype.itemsize)
 
 
 def from_embedding_grad(tokens: jnp.ndarray, cotangent: jnp.ndarray,

@@ -37,6 +37,10 @@ class TensorSwapper:
     old per-op open/close cost a syscall pair + dentry walk per leaf per
     step."""
 
+    # fd-cache bound: large models have 3-4 files per param leaf; an
+    # unbounded cache would trip RLIMIT_NOFILE (commonly 1024 soft)
+    MAX_OPEN_FDS = 256
+
     def __init__(self, swap_dir: str, aio_threads: int = 4):
         os.makedirs(swap_dir, exist_ok=True)
         self.swap_dir = swap_dir
@@ -44,18 +48,26 @@ class TensorSwapper:
         self._lib = AsyncIOBuilder().load()
         self._shapes: Dict[str, Tuple[int, ...]] = {}
         self._dtypes: Dict[str, np.dtype] = {}
-        self._fds: Dict[str, int] = {}
+        import collections
+
+        self._fds: "collections.OrderedDict[str, int]" = \
+            collections.OrderedDict()
 
     def _path(self, name: str) -> str:
         return os.path.join(self.swap_dir, name.replace("/", "__") + ".swp")
 
     def _fd(self, name: str) -> int:
         fd = self._fds.get(name)
-        if fd is None:
-            fd = int(self._lib.ds_aio_open(self._path(name).encode(), 1, 0))
-            if fd < 0:
-                raise OSError(-fd, f"aio open failed for {name}")
-            self._fds[name] = fd
+        if fd is not None:
+            self._fds.move_to_end(name)
+            return fd
+        while len(self._fds) >= self.MAX_OPEN_FDS:   # LRU-evict
+            _, old = self._fds.popitem(last=False)
+            self._lib.ds_aio_close(old)
+        fd = int(self._lib.ds_aio_open(self._path(name).encode(), 1, 0))
+        if fd < 0:
+            raise OSError(-fd, f"aio open failed for {name}")
+        self._fds[name] = fd
         return fd
 
     def close(self) -> None:
