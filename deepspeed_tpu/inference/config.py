@@ -46,6 +46,10 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     max_tokens: int = 1024
     enable_cuda_graph: bool = False  # accepted for parity; jit IS the graph
     replace_method: str = "auto"
+    # Pallas flash-decode kernel for KV-cache decode (None = the
+    # DS_TPU_FLASH_DECODE env decides; the config knob is the first-class
+    # switch — the XLA path measures at the HBM roof on the bench chip)
+    use_flash_decode: Optional[bool] = None
     zero: Dict[str, Any] = Field(default_factory=dict)
     triangular_masking: bool = True
     return_tuple: bool = True

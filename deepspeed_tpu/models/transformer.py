@@ -127,6 +127,11 @@ class TransformerConfig:
     act_quant_bits: int = 0
     act_quant_symmetric: bool = False
     scan_layers: bool = True
+    # Pallas flash-decode kernel for KV-cache decode steps: None = the
+    # DS_TPU_FLASH_DECODE env var decides (trace-time); True/False override.
+    # Opt-in because the XLA einsum path measures at the HBM roof on the
+    # bench chip — flip it when a profile on YOUR part says otherwise.
+    flash_decode: Optional[bool] = None
     dtype: Any = jnp.bfloat16                 # compute dtype hint (engine casts)
     initializer_range: float = 0.02
 
@@ -1187,7 +1192,8 @@ def _attention_cached(cfg, q, ck, cv, q_pos, q_slot, valid, kpos):
     B, S, Hq, hd = q.shape
     T, Hkv = ck.shape[1], ck.shape[2]
     G = Hq // Hkv
-    flash_decode_on = _flash_decode_enabled()  # trace-time under jit (see doc)
+    flash_decode_on = (cfg.flash_decode if cfg.flash_decode is not None
+                       else _flash_decode_enabled())  # trace-time under jit
     if (S == 1 and cfg.position != "alibi" and T % 128 == 0
             and hd % 8 == 0 and flash_decode_on):
         # decode step: the Pallas flash-decode kernel streams the cache

@@ -118,3 +118,63 @@ def test_pick_block_floor_contract():
     assert pick_block(192, 512, floor=128) == 192  # full-axis tile
     with pytest.raises(NotImplementedError):
         pick_block(192, 128, floor=128)        # 128∤192 and 96 < floor
+
+
+def test_flash_decode_config_knob(monkeypatch):
+    """cfg.flash_decode=True dispatches the kernel without the env var —
+    the config-driven switch (VERDICT r2 weak #6); False forces it off even
+    with the env set."""
+    from deepspeed_tpu.models import CausalLM
+    from deepspeed_tpu.parallel import mesh as mesh_mod
+    import deepspeed_tpu.ops.pallas.decode_attention as da
+
+    mesh_mod.reset_mesh()
+    monkeypatch.delenv("DS_TPU_FLASH_DECODE", raising=False)
+    model = CausalLM("tiny-gqa", max_seq_len=256, dtype=jnp.float32,
+                     flash_decode=True)
+    params = model.init_fn(jax.random.PRNGKey(0))
+    B, S, T = 2, 100, 256
+    prompt = np.asarray(jax.random.randint(jax.random.PRNGKey(1), (B, S),
+                                           0, 256))
+    pos = np.broadcast_to(np.arange(S, dtype=np.int32), (B, S))
+    mask = np.ones((B, S), bool)
+    called = {}
+    orig = da.flash_decode
+
+    def spy(*a, **k):
+        called["yes"] = True
+        return orig(*a, **k)
+
+    monkeypatch.setattr(da, "flash_decode", spy)
+    cache = model.init_cache(B, T, dtype=jnp.float32)
+    _, cache = model.apply_cached(params, prompt, cache, pos, mask)
+    p1 = np.full((B, 1), S, np.int32)
+    tok = prompt[:, :1]
+    model.apply_cached(params, tok, cache, p1, np.ones((B, 1), bool))
+    assert called.get("yes"), "cfg.flash_decode=True did not dispatch"
+
+    # False wins over the env var
+    called.clear()
+    monkeypatch.setenv("DS_TPU_FLASH_DECODE", "1")
+    model_off = CausalLM("tiny-gqa", max_seq_len=256, dtype=jnp.float32,
+                         flash_decode=False)
+    cache = model_off.init_cache(B, T, dtype=jnp.float32)
+    _, cache = model_off.apply_cached(params, prompt, cache, pos, mask)
+    model_off.apply_cached(params, tok, cache, p1, np.ones((B, 1), bool))
+    assert not called, "cfg.flash_decode=False did not override the env"
+
+
+def test_inference_config_use_flash_decode_wires_model():
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.models import CausalLM
+    from deepspeed_tpu.parallel import mesh as mesh_mod
+
+    mesh_mod.reset_mesh()
+    model = CausalLM("tiny", dtype=jnp.float32)
+    eng = InferenceEngine(model, config=DeepSpeedInferenceConfig(
+        dtype="fp32", use_flash_decode=True))
+    # engine-scoped: the engine's model copy carries the knob...
+    assert eng.model.config.flash_decode is True
+    # ...and the caller's model is untouched (another engine may differ)
+    assert model.config.flash_decode is None
