@@ -1029,9 +1029,9 @@ class DeepSpeedEngine:
 
     def eval_batch(self, batch) -> jnp.ndarray:
         if self._param_offload is not None:
-            raise NotImplementedError(
-                "eval_batch with offload_param is not wired up (the eval "
-                "step would need its own layer-streamed loop)")
+            # forward-only layer-streamed loop (same NVMe prefetch pipeline)
+            return jnp.float32(self._param_offload.eval_batch(
+                self._shard_batch_eval(batch)))
         if self._compiled_eval_step is None:
             self._compiled_eval_step = self._make_eval_step()
         micro = self._shard_batch_eval(batch)

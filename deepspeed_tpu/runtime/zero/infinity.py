@@ -249,12 +249,12 @@ class InfinityParamEngine:
                           stem.get("embed_norm_bias"))
             return constrain_spec(x, act_spec)
 
-        def layer_body(lp, x, rng):
+        def layer_body(lp, x, rng, deterministic=False):
             B, S, _ = x.shape
             pos = jnp.broadcast_to(
                 jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
             y, _aux = _block(cfg, lp, x, pos, rng, attn_impl,
-                             deterministic=False)
+                             deterministic=deterministic)
             return constrain_spec(y, act_spec)
 
         def head_body(head, stem, x, labels):
@@ -273,6 +273,10 @@ class InfinityParamEngine:
 
         self._stem_fwd = jax.jit(stem_body)
         self._layer_fwd = jax.jit(layer_body)
+        # eval variants: deterministic blocks + loss-only head (no vjp)
+        self._layer_fwd_det = jax.jit(
+            lambda lp, x, rng: layer_body(lp, x, rng, deterministic=True))
+        self._head_fwd = jax.jit(head_body)
 
         def head_vjp(head, stem, x, labels):
             if tied:
@@ -330,19 +334,41 @@ class InfinityParamEngine:
         else:
             buf += arr
 
+    def _stream_forward(self, tokens, keys, layer_fwd, keep: bool):
+        """Prefetch-pipelined forward over all layers.  ``keep`` retains the
+        boundary activations (training) — eval discards them.  Returns
+        ``(x_final, xs_or_None, last_layer_params)``."""
+        x = self._stem_fwd(self._stem_dev, tokens)
+        xs = [x] if keep else None
+        pending = self._submit_layer(0, 0)
+        lp = None
+        for i in range(self.num_layers):
+            nxt = (self._submit_layer(i + 1, (i + 1) % 2)
+                   if i + 1 < self.num_layers else None)
+            lp = self._collect_layer(pending)
+            x = layer_fwd(lp, x, keys[i])
+            if keep:
+                xs.append(x)
+            pending = nxt
+        return x, xs, lp
+
+    @staticmethod
+    def _tokens_labels(batch):
+        if isinstance(batch, dict):
+            tokens = batch["input_ids"]
+            labels = batch.get("labels")
+        else:
+            tokens, labels = batch, None
+        if labels is None:
+            labels = jnp.concatenate(
+                [tokens[:, 1:], jnp.full_like(tokens[:, :1], -100)], axis=1)
+        return tokens, labels
+
     def _micro_fwd_bwd(self, tokens, labels, rng):
         L = self.num_layers
         keys = jax.random.split(rng, L)
-        x = self._stem_fwd(self._stem_dev, tokens)
-        xs = [x]
-        pending = self._submit_layer(0, 0)
-        for i in range(L):
-            nxt = self._submit_layer(i + 1, (i + 1) % 2) if i + 1 < L else None
-            lp = self._collect_layer(pending)
-            x = self._layer_fwd(lp, x, keys[i])
-            xs.append(x)
-            pending = nxt
-        last_lp = lp  # layer L-1's params — backward starts here
+        x, xs, last_lp = self._stream_forward(tokens, keys, self._layer_fwd,
+                                              keep=True)
 
         loss, dhead, dstem_h, dx = self._head_vjp(
             self._head_dev, self._stem_dev, xs[L], labels)
@@ -378,6 +404,18 @@ class InfinityParamEngine:
             self._accum(k, g)
         return loss
 
+    def eval_batch(self, batch) -> float:
+        """Forward-only layer-streamed evaluation: deterministic blocks
+        (dropout off), loss-only head (no vjp), no activations kept."""
+        tokens, labels = self._tokens_labels(batch)
+        keys = jax.random.split(jax.random.PRNGKey(self.config.seed),
+                                self.num_layers)
+        x, _, _ = self._stream_forward(tokens, keys, self._layer_fwd_det,
+                                       keep=False)
+        loss = self._head_fwd(self._head_dev, self._stem_dev, x, labels)
+        with jax.transfer_guard("allow"):
+            return float(np.asarray(loss))
+
     def train_batch(self, batch) -> Tuple[Any, Dict[str, Any]]:
         """batch: device tree with leading [gas] dim ({'input_ids', optional
         'labels'}).  Returns (mean_loss, metrics)."""
@@ -399,9 +437,7 @@ class InfinityParamEngine:
             if labels_all is not None:
                 labels = labels_all[g]
             else:
-                labels = jnp.concatenate(
-                    [tokens[:, 1:], jnp.full_like(tokens[:, :1], -100)],
-                    axis=1)
+                _, labels = self._tokens_labels(tokens)
             losses.append(self._micro_fwd_bwd(
                 tokens, labels, jax.random.fold_in(rng, g)))
 

@@ -124,6 +124,19 @@ def test_param_offload_checkpoint_roundtrip(tmp_path):
     np.testing.assert_allclose(resumed, cont, rtol=1e-6)
 
 
+def test_param_offload_eval_batch(tmp_path):
+    """Forward-only layer-streamed eval matches the train-path loss on the
+    same (pre-update) weights."""
+    engine, model = _engine(tmp_path)
+    batch = _b(engine, model, 0)
+    eval_loss = float(engine.eval_batch(batch))
+    train_loss = float(engine.train_batch(batch=batch))  # pre-update loss
+    np.testing.assert_allclose(eval_loss, train_loss, rtol=1e-5)
+    # eval after the update reflects the new weights
+    eval2 = float(engine.eval_batch(batch))
+    assert eval2 < eval_loss
+
+
 def test_param_offload_requires_stage3(tmp_path):
     model = CausalLM("tiny", max_seq_len=SEQ * 2)
     cfg = _config(tmp_path)
