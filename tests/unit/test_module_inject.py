@@ -208,3 +208,13 @@ def test_bert_attention_is_bidirectional():
     o2 = np.asarray(forward(cfg, params, jnp.asarray(t2)))
     assert not np.allclose(o1[0, 0], o2[0, 0]), \
         "token 0 ignored later tokens — attention is still causal"
+
+
+def test_mistral_parity():
+    """Mistral rides the llama policy (GQA + rms + swiglu)."""
+    hf_cfg = transformers.MistralConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, sliding_window=4096,
+        attention_dropout=0.0)
+    _logit_parity(transformers.MistralForCausalLM(hf_cfg))
