@@ -100,6 +100,15 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         "mesh_shape": {k: int(v) for k, v in dict(engine.mesh.shape).items()},
         "client_state": client_state or {},
     }
+    # curriculum / data-sampler state (reference DeepSpeedDataSampler
+    # state_dict rides the checkpoint, data_sampler.py): without it a
+    # resumed run restarts the difficulty schedule from zero
+    sampler = getattr(getattr(engine, "training_dataloader", None),
+                      "data_sampler", None)
+    if sampler is not None and hasattr(sampler, "state_dict"):
+        meta["data_sampler"] = sampler.state_dict()
+    elif engine.curriculum_scheduler is not None:
+        meta["curriculum"] = engine.curriculum_scheduler.get_state()
     if jax.process_index() == 0:
         with open(os.path.join(ckpt_dir, "client_state.json"), "w") as f:
             json.dump(meta, f, indent=2)
@@ -167,6 +176,17 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         engine.global_steps = meta.get("global_steps", engine.global_steps)
         engine.skipped_steps = meta.get("skipped_steps", engine.skipped_steps)
         engine.micro_steps = meta.get("micro_steps", engine.micro_steps)
+        if load_optimizer_states and not load_module_only:
+            # full resume only: a weights-only load starts a FRESH run whose
+            # curriculum must begin at min_difficulty
+            sampler = getattr(getattr(engine, "training_dataloader", None),
+                              "data_sampler", None)
+            if sampler is not None and meta.get("data_sampler") is not None \
+                    and hasattr(sampler, "load_state_dict"):
+                sampler.load_state_dict(meta["data_sampler"])
+            elif engine.curriculum_scheduler is not None \
+                    and meta.get("curriculum") is not None:
+                engine.curriculum_scheduler.set_state(meta["curriculum"])
     log_dist(f"loaded checkpoint {tag} from {ckpt_dir}", ranks=[0])
     return ckpt_dir, meta.get("client_state", {})
 

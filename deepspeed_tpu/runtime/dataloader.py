@@ -65,25 +65,29 @@ class DeepSpeedDataLoader:
         self.epoch = epoch
 
     def _indices(self) -> np.ndarray:
-        n = len(self.dataset)
-        if self.data_sampler is not None:
-            # samplers may be infinite streams (CurriculumBatchSampler) and
-            # may yield either index BATCHES or single indices — draw one
-            # epoch's worth of INDICES either way
-            need = len(self) * self.batch_size
-            out: list = []
-            it = iter(self.data_sampler)
-            while len(out) < need:
-                try:
-                    b = next(it)
-                except StopIteration:
-                    break
-                out.extend(b if hasattr(b, "__len__") else [b])
-            return np.asarray(out)
-        idx = np.arange(n)
+        idx = np.arange(len(self.dataset))
         if self.shuffle:
             np.random.RandomState(self.seed + self.epoch).shuffle(idx)
         return idx
+
+    def _iter_sampler(self) -> Iterator:
+        """LAZY sampler-driven iteration: one index batch drawn per yielded
+        batch, so a curriculum sampler's consumed-batch counter (and with it
+        the difficulty schedule and any checkpointed state) tracks batches
+        actually TRAINED, not an eagerly pre-drawn epoch."""
+        it = iter(self.data_sampler)
+        buf: list = []
+        produced = 0
+        while produced < len(self):
+            try:
+                b = next(it)
+            except StopIteration:
+                break
+            buf.extend(b if hasattr(b, "__len__") else [b])
+            while len(buf) >= self.batch_size and produced < len(self):
+                sel, buf = buf[:self.batch_size], buf[self.batch_size:]
+                produced += 1
+                yield self._collate([self.dataset[int(i)] for i in sel])
 
     def _collate(self, items):
         if self.collate_fn is not None:
@@ -97,6 +101,10 @@ class DeepSpeedDataLoader:
         return np.stack([np.asarray(it) for it in items])
 
     def __iter__(self) -> Iterator:
+        if self.data_sampler is not None:
+            yield from self._iter_sampler()
+            self.epoch += 1
+            return
         idx = self._indices()
         nb = len(self)
         for b in range(nb):

@@ -341,3 +341,49 @@ def test_engine_metric_curriculum_requires_values(tmp_path):
             "bf16": {"enabled": True},
             "curriculum_learning": {"enabled": True,
                                     "curriculum_type": "hardness"}})
+
+
+def test_metric_curriculum_state_survives_checkpoint(tmp_path):
+    """Sampler difficulty state rides the checkpoint (reference
+    DeepSpeedDataSampler state_dict): a resumed run continues the schedule
+    instead of restarting at min_difficulty."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM
+
+    N, S = 64, 16
+    rng = np.random.default_rng(0)
+    data = [{"input_ids": rng.integers(0, 250, S).astype(np.int32)}
+            for _ in range(N)]
+    vals = np.arange(N, dtype=np.float64)
+    np.save(tmp_path / "len_values.npy", vals)
+
+    def build():
+        model = CausalLM("tiny", max_seq_len=S * 2)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, training_data=data, config={
+                "train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "bf16": {"enabled": True},
+                "curriculum_learning": {
+                    "enabled": True, "curriculum_type": "len",
+                    "min_difficulty": 16, "max_difficulty": 64,
+                    "schedule_type": "fixed_linear",
+                    "schedule_config": {"total_curriculum_step": 20,
+                                        "difficulty_step": 1},
+                    "metric_values_path": str(tmp_path / "len_values.npy"),
+                }})
+        return engine
+
+    e1 = build()
+    for _ in range(4):
+        e1.train_batch()
+    consumed = e1.training_dataloader.data_sampler.consumed_batches
+    # LAZY sampler draw: consumed tracks batches actually trained (the old
+    # eager epoch pre-draw would report a whole epoch here)
+    assert 4 <= consumed <= 5, consumed
+    e1.save_checkpoint(str(tmp_path / "ck"), tag="t")
+
+    e2 = build()
+    assert e2.training_dataloader.data_sampler.consumed_batches == 0
+    e2.load_checkpoint(str(tmp_path / "ck"), tag="t")
+    assert e2.training_dataloader.data_sampler.consumed_batches == consumed
