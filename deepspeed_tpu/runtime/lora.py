@@ -16,7 +16,7 @@ tree is never mutated; ``unfuse`` merely drops the cached fused tree.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional, Sequence, Tuple
+from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp

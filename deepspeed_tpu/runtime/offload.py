@@ -22,12 +22,11 @@ policy of its own.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Dict, List
 
 import numpy as np
 
 import jax
-import jax.numpy as jnp
 
 from ..utils.logging import logger, log_dist
 from ..parallel.mesh import dp_world_size

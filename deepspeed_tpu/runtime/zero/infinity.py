@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ...utils.logging import logger, log_dist
+from ...utils.logging import log_dist
 from ...parallel.mesh import BATCH_AXES, constrain_spec
 from ..swap_tensor.partitioned_optimizer_swapper import TensorSwapper
 from ...ops.adam.cpu_adam import DeepSpeedCPUAdam
