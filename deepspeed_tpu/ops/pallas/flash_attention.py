@@ -33,10 +33,30 @@ from jax.experimental.pallas import tpu as pltpu
 # but the same tiles cost ~2.5% end-to-end (S=8192 llama bench, same
 # thermal state) — the rematerialized fwd inside the backward schedules
 # differently than a standalone chain.  Keep (1024, 2048) fwd + 1024 bwd.
-DEFAULT_BLOCK_Q = 1024
-DEFAULT_BLOCK_K = 2048
+import os as _os
+
+def _env_block(name: str, default: int) -> int:
+    """Tile override via env (read at import — trace-time semantics like
+    DS_TPU_FLASH_DECODE): lets tools/tune_flash.py A/B tile choices in the
+    FULL remat train step via subprocess env, the only measurement that
+    predicts end-to-end cost (see note above: isolated sweeps mislead)."""
+    v = _os.environ.get(name, "").strip()
+    if not v:
+        return default
+    try:
+        iv = int(v)
+    except ValueError as e:
+        raise ValueError(f"{name}={v!r} is not an integer") from e
+    if iv < 128 or iv % 128:
+        raise ValueError(f"{name}={iv} must be a positive multiple of 128 "
+                         "(MXU tile granularity)")
+    return iv
+
+
+DEFAULT_BLOCK_Q = _env_block("DS_TPU_FLASH_BLOCK_Q", 1024)
+DEFAULT_BLOCK_K = _env_block("DS_TPU_FLASH_BLOCK_K", 2048)
 # backward tiles: min(fwd tile, this) — the bwd kernels compile reliably at 1024
-DEFAULT_BWD_BLOCK = 1024
+DEFAULT_BWD_BLOCK = _env_block("DS_TPU_FLASH_BWD_BLOCK", 1024)
 
 from .common import (NEG_INF, interpret_default as _interpret_default,  # noqa: E402
                      parallel_semantics, pick_block as _pick_block)
