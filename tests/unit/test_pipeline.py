@@ -357,3 +357,30 @@ def test_pipeline_bubble_fraction_measured():
     # report for the logs (reference PipelineEngine logs its schedule stats)
     print(f"pipeline bubble: P={P_} M={M} -> {bubble:.3f} "
           f"(closed form {(P_-1)}/{M+P_-1})")
+
+
+def test_pipeline_1f1b_zero2_matches_gpipe():
+    """1F1B's manually-assembled gradients must compose with ZeRO-2's
+    reduce-scatter constraint exactly like AD gradients do."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM
+    from deepspeed_tpu.parallel import mesh as M
+
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, (16, 32)).astype(np.int32)
+    base = {"train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2}}
+    losses = {}
+    for sched in ("gpipe", "1f1b"):
+        M.reset_mesh()
+        mesh = initialize_mesh(MeshLayout(dp=4, pp=2))
+        model = CausalLM("tiny", dtype=jnp.float32, num_layers=4,
+                         pipeline_stages=2, pipeline_microbatches=2,
+                         pipeline_schedule=sched)
+        eng, _, _, _ = deepspeed_tpu.initialize(model=model, config=base,
+                                                mesh=mesh)
+        losses[sched] = [float(eng.train_batch(batch={"input_ids": data}))
+                         for _ in range(3)]
+    np.testing.assert_allclose(losses["1f1b"], losses["gpipe"], rtol=2e-4)
