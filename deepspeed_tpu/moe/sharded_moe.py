@@ -38,6 +38,13 @@ class MoEConfig:
 
 
 def _capacity(tokens_per_group: int, cfg: MoEConfig, deterministic: bool) -> int:
+    if not cfg.drop_tokens:
+        # no-drop contract for direct top_k_gating callers: C = T guarantees
+        # every token fits (an expert receives each token at most once across
+        # the k passes).  moe_ffn itself routes no-drop configs to the ragged
+        # moe_ffn_nodrop path before gating, so this worst-case buffer only
+        # materializes for the standalone-gating API.
+        return ((tokens_per_group + 7) // 8) * 8
     cf = cfg.eval_capacity_factor if deterministic else cfg.capacity_factor
     cap = int(cf * tokens_per_group * cfg.top_k / cfg.num_experts)
     cap = max(cap, cfg.min_capacity)
@@ -156,6 +163,30 @@ def moe_ffn_nodrop(x: jnp.ndarray, router_w: jnp.ndarray,
     return y.reshape(B, S, D), aux.astype(jnp.float32)
 
 
+_NODROP_EP_WARNED = False
+
+
+def _warn_nodrop_on_expert_mesh() -> None:
+    """drop_tokens=False on an ep>1 mesh loses the expert-parallel memory/comm
+    benefit (GSPMD gathers the full expert weights per shard — see the
+    moe_ffn_nodrop docstring).  Warn once, rank 0, at trace time."""
+    global _NODROP_EP_WARNED
+    if _NODROP_EP_WARNED:
+        return
+    from ..parallel import mesh as _mesh_mod
+    m = _mesh_mod._GLOBAL_MESH
+    if m is not None and dict(m.shape).get("expert", 1) > 1:
+        _NODROP_EP_WARNED = True
+        if jax.process_index() == 0:
+            import logging
+            logging.getLogger("deepspeed_tpu").warning(
+                "MoE drop_tokens=False with expert mesh axis size %d: the "
+                "ragged no-drop path replicates expert weights per shard "
+                "(no all-to-all dispatch); prefer drop_tokens=True capacity "
+                "buffers when sharding the expert axis.",
+                dict(m.shape)["expert"])
+
+
 def moe_ffn(x: jnp.ndarray, router_w: jnp.ndarray, expert_params: Dict[str, Any],
             cfg: MoEConfig, activation: str = "swiglu", deterministic: bool = True,
             rng: Optional[jnp.ndarray] = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -166,6 +197,7 @@ def moe_ffn(x: jnp.ndarray, router_w: jnp.ndarray, expert_params: Dict[str, Any]
     param_specs.
     """
     if not cfg.drop_tokens:
+        _warn_nodrop_on_expert_mesh()
         return moe_ffn_nodrop(x, router_w, expert_params, cfg,
                               activation=activation,
                               deterministic=deterministic, rng=rng)

@@ -174,8 +174,28 @@ int ds_aio_is_direct(int64_t fd) {
 
 int ds_aio_close(int64_t fd) { return ::close((int)fd) == 0 ? 0 : -errno; }
 
+// O_DIRECT requires 4096-aligned buffer/offset/length on EVERY op, not just
+// at open time (a misaligned tail chunk would fail pread/pwrite with EINVAL
+// mid-job).  When an op arrives misaligned on an O_DIRECT fd, drop to
+// buffered mode for that fd via fcntl — same data path, page cache back in
+// the loop — rather than surfacing a runtime EINVAL from a worker thread.
+static void drop_direct_if_misaligned(int64_t fd, const void* buf,
+                                      int64_t nbytes, int64_t offset) {
+#if O_DIRECT != 0
+  if (((uintptr_t)buf | (uint64_t)nbytes | (uint64_t)offset) & 4095) {
+    int fl = ::fcntl((int)fd, F_GETFL);
+    if (fl >= 0 && (fl & O_DIRECT)) {
+      ::fcntl((int)fd, F_SETFL, fl & ~O_DIRECT);
+    }
+  }
+#else
+  (void)fd; (void)buf; (void)nbytes; (void)offset;
+#endif
+}
+
 int64_t ds_aio_submit_pwrite(int64_t fd, const void* buf, int64_t nbytes,
                              int64_t offset, int nthreads) {
+  drop_direct_if_misaligned(fd, buf, nbytes, offset);
   char* b = (char*)const_cast<void*>(buf);
   return submit_impl(nbytes, nthreads,
                      [fd, b, offset](int64_t off, int64_t len) {
@@ -186,6 +206,7 @@ int64_t ds_aio_submit_pwrite(int64_t fd, const void* buf, int64_t nbytes,
 
 int64_t ds_aio_submit_pread(int64_t fd, void* buf, int64_t nbytes,
                             int64_t offset, int nthreads) {
+  drop_direct_if_misaligned(fd, buf, nbytes, offset);
   char* b = (char*)buf;
   return submit_impl(nbytes, nthreads,
                      [fd, b, offset](int64_t off, int64_t len) {

@@ -134,11 +134,20 @@ class DeepSpeedTransformerLayer:
               input_mask: Optional[jax.Array] = None,
               rng: Optional[jax.Array] = None,
               deterministic: Optional[bool] = None) -> jax.Array:
-        if input_mask is not None and bool(jnp.all(input_mask)) is False:
-            raise NotImplementedError(
-                "per-token input masks are not wired into the layer-level "
-                "API (the BERT injection path handles padding); pass an "
-                "all-ones mask or None")
+        if input_mask is not None:
+            # reject tracers structurally (concretizing one would surface as
+            # a confusing TracerBoolConversionError under jit/vmap); concrete
+            # arrays keep the device-side reduce — one scalar transfer
+            if isinstance(input_mask, jax.core.Tracer):
+                raise NotImplementedError(
+                    "input_mask cannot be a traced value: per-token masks "
+                    "are not wired into the layer-level API (the BERT "
+                    "injection path handles padding); pass None")
+            if not bool(jnp.all(input_mask)):
+                raise NotImplementedError(
+                    "per-token input masks are not wired into the layer-level "
+                    "API (the BERT injection path handles padding); pass an "
+                    "all-ones mask or None")
         B, S, _ = hidden_states.shape
         positions = jnp.broadcast_to(
             jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))

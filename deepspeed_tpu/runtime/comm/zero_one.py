@@ -226,10 +226,13 @@ def make_zero_one_step(accumulate, mesh, gas: int, compute_dtype,
 
             p_new, m_out, delta_out, err_out, lrs_out = lax.cond(
                 on_sync, sync, local)
-            gnorm = jnp.sqrt(sum(
-                jnp.vdot(gi, gi)
-                for gi in jax.tree_util.tree_leaves(local_grads)))
-            gnorm = lax.pmean(gnorm, BATCH_AXES)
+            # pmean the SQUARED sums before the sqrt so the metric stays
+            # norm-like across phases (phase A reports the norm of the synced
+            # gradient; mean-of-norms would jump discontinuously at
+            # var_freeze_step)
+            gsq = sum(jnp.vdot(gi, gi)
+                      for gi in jax.tree_util.tree_leaves(local_grads))
+            gnorm = jnp.sqrt(lax.pmean(gsq, BATCH_AXES))
             # local-step interval schedule (zoadam.py:284-289)
             lc = zo.local_counter + 1
             grow = lc == local_step_scaler

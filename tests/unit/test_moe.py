@@ -42,6 +42,19 @@ def test_capacity_drops_tokens():
     assert kept == 8  # capacity = max(0.25*64/2, 8) = 8
 
 
+def test_gating_nodrop_contract_keeps_every_token():
+    """Direct top_k_gating callers with drop_tokens=False must never lose a
+    token: capacity sizes to C=T regardless of the capacity factor (ADVICE r3
+    medium — the no-drop contract of the exported API)."""
+    cfg = MoEConfig(num_experts=2, top_k=1, capacity_factor=0.25,
+                    min_capacity=8, drop_tokens=False)
+    # all 64 tokens prefer expert 0 — with dropping this keeps only 8
+    logits = jnp.stack([jnp.ones(64), -jnp.ones(64)], axis=1)
+    combine, dispatch, _ = top_k_gating(logits, cfg, deterministic=False)
+    assert int(dispatch.sum()) == 64
+    assert dispatch.shape[2] >= 64
+
+
 def test_top1_combine_keeps_gate_probability():
     """Switch routing: combine weight must be the softmax prob, not 1.0."""
     cfg = MoEConfig(num_experts=4, top_k=1, capacity_factor=4.0)
