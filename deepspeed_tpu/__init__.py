@@ -65,7 +65,22 @@ def init_inference(model: Any = None, config=None, **kwargs):
                    **{k: v for k, v in kwargs.items()
                       if k in DeepSpeedInferenceConfig.model_fields}})
         dtype = cfg_probe.jnp_dtype
-        model, params = CausalLM.from_hf(model, dtype=dtype)
+        # resolve the mesh BEFORE loading so directory checkpoints stream
+        # leaf-by-leaf straight onto their target shards (sharded_load) —
+        # the engine then reuses this mesh and its jit cast moves nothing
+        mesh = engine_kwargs.get("mesh")
+        if mesh is None and isinstance(model, str):
+            import jax as _jax
+
+            from .parallel.mesh import MeshLayout, initialize_mesh
+
+            tp = (cfg_probe.tensor_parallel.tp_size
+                  if cfg_probe.tensor_parallel.enabled else 1)
+            mesh = initialize_mesh(MeshLayout.from_world(
+                _jax.device_count(), tp=tp, ep=cfg_probe.moe.ep_size))
+            engine_kwargs["mesh"] = mesh
+        model, params = CausalLM.from_hf(model, dtype=dtype, mesh=mesh,
+                                         checkpoint=cfg_probe.checkpoint)
         engine_kwargs.setdefault("params", params)
     if isinstance(config, DeepSpeedInferenceConfig):
         ds_inference_config = config

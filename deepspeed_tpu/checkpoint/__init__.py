@@ -8,6 +8,7 @@ from .universal import (  # noqa: F401
     inspect_checkpoint,
     validate_checkpoint,
 )
+from .reshard import reshard_inference_checkpoint  # noqa: F401
 from .zero_to_fp32 import (  # noqa: F401
     convert_zero_checkpoint_to_fp32_state_dict,
     get_fp32_state_dict_from_zero_checkpoint,

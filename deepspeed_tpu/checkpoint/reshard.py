@@ -1,0 +1,133 @@
+"""Offline MP-degree resharding for inference checkpoints (reference
+``deepspeed/runtime/state_dict_factory.py`` — MegatronSDLoader merge
+:339-405 / split :406-455 — behind ``SDLoaderFactory``).
+
+The live path needs no tool at all: ``sharded_load`` merges per-mp-rank
+shards on the fly and GSPMD lays the result onto whatever mesh degree the
+engine runs — that IS cross-degree resharding.  This tool is the offline
+half: rewrite a checkpoint (HF directory or DeepSpeed checkpoint json at
+mp_size=K) as ``target_mp`` per-rank shard files so a fleet can load
+rank-local files without reading K source shards each.  Streaming: one
+output rank's tensors in memory at a time (peak host = model/target_mp + one
+full tensor).
+
+On-disk split axes come from the arch policy's declared PartitionSpecs
+translated to the HF layout (module_inject/sharded_load.make_classifier), so
+the same single source of truth drives live sharding, on-the-fly merge, and
+this offline rewrite.  Fused-QKV tensors regroup per rank the way the
+reference's ``qkv_copy``/``qkv_split`` do (state_dict_factory.py:339).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+from jax import numpy as jnp
+
+
+def _split_tensor(t: np.ndarray, kind: str, axis: Optional[int],
+                  target_mp: int, name: str) -> List[np.ndarray]:
+    if kind == "replicated" or target_mp == 1:
+        return [t] * target_mp
+    if kind == "split":
+        if t.shape[axis] % target_mp != 0:
+            raise ValueError(
+                f"{name}: dim {axis} size {t.shape[axis]} does not divide "
+                f"by target mp_size {target_mp}")
+        return [np.ascontiguousarray(p)
+                for p in np.split(t, target_mp, axis=axis)]
+    if kind == "qkv_cols":
+        q, k, v = np.split(t, 3, axis=-1)
+        for part in (q, k, v):
+            if part.shape[-1] % target_mp != 0:
+                raise ValueError(
+                    f"{name}: fused qkv part dim {part.shape[-1]} does not "
+                    f"divide by target mp_size {target_mp}")
+        qs = np.split(q, target_mp, -1)
+        ks = np.split(k, target_mp, -1)
+        vs = np.split(v, target_mp, -1)
+        return [np.ascontiguousarray(np.concatenate([qs[m], ks[m], vs[m]], -1))
+                for m in range(target_mp)]
+    raise ValueError(f"unknown placement kind {kind!r} for {name!r}")
+
+
+def reshard_inference_checkpoint(src: str, target_mp: int, out_dir: str,
+                                 model_dir: Optional[str] = None,
+                                 dtype: Any = None) -> str:
+    """Rewrite ``src`` (HF dir or DS checkpoint json, any source mp degree)
+    as ``target_mp`` per-rank safetensors shards under ``out_dir``.  Returns
+    the path of the written checkpoint json (loadable by ``sharded_load`` /
+    ``init_inference(checkpoint=...)``)."""
+    import transformers
+    from safetensors.numpy import save_file
+
+    from ..module_inject.policies import POLICIES, detect_arch
+    from ..module_inject.load import config_from_hf
+    from ..module_inject.sharded_load import (make_classifier,
+                                              open_checkpoint_source)
+    from ..utils.logging import logger
+
+    cfg_dir = model_dir or (src if os.path.isdir(src)
+                            else os.path.dirname(os.path.abspath(src)))
+    hf_config = transformers.AutoConfig.from_pretrained(cfg_dir)
+    arch = detect_arch(hf_config)
+    policy = POLICIES[arch]
+    cfg = config_from_hf(hf_config)
+    classify = make_classifier(policy, cfg)
+    source = open_checkpoint_source(src, policy, cfg)
+
+    os.makedirs(out_dir, exist_ok=True)
+    names = sorted(source.keys())
+    if dtype is not None:
+        import ml_dtypes  # registers bfloat16/float8 names with numpy # noqa: F401
+    host_dtype = np.dtype(dtype) if dtype is not None else None
+    files = []
+    for m in range(target_mp):
+        shard: Dict[str, np.ndarray] = {}
+        for name in names:
+            kind, axis = classify(name)
+            t = source.get(name)
+            # jnp.issubdtype, not np: ml_dtypes.bfloat16 has numpy kind 'V'
+            # and np.issubdtype(..., np.floating) is False for it
+            if host_dtype is not None and jnp.issubdtype(t.dtype, jnp.floating):
+                t = t.astype(host_dtype)
+            shard[name] = _split_tensor(t, kind, axis, target_mp, name)[m]
+        fname = f"mp_rank_{m:02d}_model_states.safetensors"
+        save_file(shard, os.path.join(out_dir, fname))
+        files.append(fname)
+        logger.info(f"reshard: wrote {fname} "
+                    f"({sum(v.nbytes for v in shard.values()) / 1e6:.1f} MB)")
+    meta = {"type": arch, "version": 1.0, "mp_size": target_mp,
+            "parallelization": "tp", "checkpoints": files}
+    meta_path = os.path.join(out_dir, "ds_inference_config.json")
+    with open(meta_path, "w") as f:
+        json.dump(meta, f, indent=2)
+    source.close()
+    return meta_path
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Reshard an inference checkpoint across MP degrees "
+                    "(reference runtime/state_dict_factory.py)")
+    ap.add_argument("src", help="HF checkpoint directory or DeepSpeed "
+                                "checkpoint json")
+    ap.add_argument("out_dir")
+    ap.add_argument("--target_mp", type=int, required=True)
+    ap.add_argument("--model_dir", default=None,
+                    help="directory holding config.json when src is a "
+                         "checkpoint json outside the model directory")
+    ap.add_argument("--dtype", default=None,
+                    choices=["float32", "bfloat16", "float16"])
+    args = ap.parse_args(argv)
+    path = reshard_inference_checkpoint(
+        args.src, args.target_mp, args.out_dir, model_dir=args.model_dir,
+        dtype=args.dtype)
+    print(path)
+
+
+if __name__ == "__main__":
+    main()

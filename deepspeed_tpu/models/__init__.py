@@ -8,6 +8,8 @@ engine.py:181) without inheriting from a module class.
 """
 from __future__ import annotations
 
+import os
+
 import jax.numpy as jnp
 
 from .transformer import (CONFIGS, TransformerConfig, cache_specs,
@@ -31,13 +33,39 @@ class CausalLM:
 
     @classmethod
     def from_hf(cls, model_or_path, dtype=None, attn_impl: str = "auto",
-                **overrides):
+                checkpoint=None, mesh=None, **overrides):
         """(model, params) from an HF checkpoint — a ``from_pretrained``
         directory, a live transformers module, or (config, state_dict)
-        (module_inject policies; reference replace_module checkpoint load)."""
-        from ..module_inject import load_hf_checkpoint
+        (module_inject policies; reference replace_module checkpoint load).
 
-        cfg, params = load_hf_checkpoint(model_or_path, dtype=dtype)
+        Directory paths stream shard-by-shard onto ``mesh`` (never the whole
+        model on host — reference inference/engine.py:449 sd_loader path);
+        ``checkpoint`` overrides the weight source (e.g. a DeepSpeed
+        checkpoint json with per-mp-rank shard files) while ``model_or_path``
+        still supplies the config."""
+        if checkpoint is not None or (
+                isinstance(model_or_path, str) and os.path.isdir(model_or_path)):
+            from ..module_inject.sharded_load import load_hf_checkpoint_sharded
+
+            hf_config = None
+            if checkpoint is not None:
+                if isinstance(model_or_path, str):
+                    import transformers
+
+                    hf_config = transformers.AutoConfig.from_pretrained(
+                        model_or_path)
+                else:
+                    # a live module (or anything carrying its HF config)
+                    # supplies the config — the checkpoint json's directory
+                    # need not hold a config.json
+                    hf_config = getattr(model_or_path, "config", None)
+            cfg, params = load_hf_checkpoint_sharded(
+                checkpoint or model_or_path, dtype=dtype, mesh=mesh,
+                hf_config=hf_config)
+        else:
+            from ..module_inject import load_hf_checkpoint
+
+            cfg, params = load_hf_checkpoint(model_or_path, dtype=dtype)
         import dataclasses
 
         if dtype is not None:

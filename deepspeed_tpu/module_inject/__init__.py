@@ -9,3 +9,9 @@ from .load import (  # noqa: F401
     load_hf_checkpoint,
 )
 from .policies import POLICIES, ArchPolicy, detect_arch  # noqa: F401
+from .sharded_load import (  # noqa: F401
+    MPMergedSource,
+    ShardedTensorSource,
+    load_hf_checkpoint_sharded,
+    open_checkpoint_source,
+)
