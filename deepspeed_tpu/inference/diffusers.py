@@ -1,0 +1,232 @@
+"""DSUNet / DSVAE inference adapters (reference
+``model_implementations/diffusers/unet.py`` + ``vae.py`` and the
+``generic_injection`` entry in ``module_inject/replace_module.py:310``).
+
+The reference's wrappers exist to (a) capture the module into a CUDA graph
+and (b) keep the pipeline-facing API (``in_channels``, ``config``,
+``.sample``-style outputs) intact.  Under XLA, (a) is just ``jax.jit`` — the
+first call per shape compiles the whole graph, every later call replays it —
+so these adapters are thin: jit-cached functional forwards over the native
+diffusion family (models/diffusion.py) with the diffusers calling
+convention preserved exactly: NCHW tensors, ``return_dict``, outputs with
+``.sample`` / ``.latent_dist``, and NO internal scaling_factor handling
+(pipelines apply it themselves — ``AutoencoderKL`` never scales)."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models.diffusion import (UNetConfig, VAEConfig, init_unet_params,
+                                init_vae_params, load_diffusers_state_dict,
+                                unet_forward, vae_decode, vae_encode_moments)
+
+
+def _to_nhwc(x):
+    return jnp.transpose(x, (0, 2, 3, 1))
+
+
+def _to_nchw(x):
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+@dataclasses.dataclass
+class UNetOutput:
+    """diffusers UNet2DConditionOutput shape: attribute + key access."""
+    sample: Any
+
+    def __getitem__(self, k):
+        return getattr(self, k)
+
+
+@dataclasses.dataclass
+class DecoderOutput:
+    sample: Any
+
+    def __getitem__(self, k):
+        return getattr(self, k)
+
+
+class DiagonalGaussianDistribution:
+    """diffusers DiagonalGaussianDistribution over NCHW moments."""
+
+    def __init__(self, mean, logvar):
+        self.mean = mean
+        self.logvar = jnp.clip(logvar, -30.0, 20.0)
+        self.std = jnp.exp(0.5 * self.logvar)
+        self.var = jnp.exp(self.logvar)
+
+    def sample(self, rng=None):
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        return self.mean + self.std * jax.random.normal(
+            rng, self.mean.shape, self.mean.dtype)
+
+    def mode(self):
+        return self.mean
+
+    def kl(self):
+        return 0.5 * jnp.sum(self.mean ** 2 + self.var - 1.0 - self.logvar,
+                             axis=(1, 2, 3))
+
+
+@dataclasses.dataclass
+class AutoencoderKLOutput:
+    latent_dist: DiagonalGaussianDistribution
+
+    def __getitem__(self, k):
+        return getattr(self, k)
+
+
+class DSUNet:
+    """UNet2DConditionModel adapter.  ``data_format="NCHW"`` (default)
+    matches the diffusers/SD-pipeline convention; internally everything is
+    NHWC (TPU conv layout).  ``enable_cuda_graph`` is accepted for API
+    parity and ignored — jit IS the graph capture."""
+
+    def __init__(self, config: Optional[UNetConfig] = None, params: Any = None,
+                 rng: Optional[jax.Array] = None, data_format: str = "NCHW",
+                 enable_cuda_graph: bool = True):
+        del enable_cuda_graph
+        self.config = config or UNetConfig()
+        if params is None:
+            params = init_unet_params(
+                self.config, rng if rng is not None else jax.random.PRNGKey(0))
+        self.params = params
+        self.in_channels = self.config.in_channels   # SD pipeline reads this
+        self.dtype = self.config.dtype
+        self.data_format = data_format
+        self.fwd_count = 0
+        self._jitted = jax.jit(
+            lambda p, s, t, c: unet_forward(self.config, p, s, t, c))
+
+    @classmethod
+    def from_diffusers(cls, unet_module, dtype=None, **kwargs) -> "DSUNet":
+        """Wrap a live ``diffusers`` UNet2DConditionModel (the reference
+        UNetPolicy.apply): config translated field-for-field, weights
+        through the rank-keyed layout transform."""
+        c = unet_module.config
+        head_dim = c.attention_head_dim
+        cfg = UNetConfig(
+            sample_size=c.sample_size, in_channels=c.in_channels,
+            out_channels=c.out_channels,
+            block_out_channels=tuple(c.block_out_channels),
+            down_block_types=tuple(c.down_block_types),
+            up_block_types=tuple(c.up_block_types),
+            layers_per_block=c.layers_per_block,
+            cross_attention_dim=c.cross_attention_dim,
+            attention_head_dim=(tuple(head_dim)
+                                if isinstance(head_dim, (list, tuple))
+                                else head_dim),
+            norm_num_groups=c.norm_num_groups,
+            norm_eps=getattr(c, "norm_eps", 1e-5),
+            dtype=dtype or jnp.float32)
+        params = load_diffusers_state_dict(unet_module.state_dict(),
+                                           dtype=dtype)
+        return cls(cfg, params, **kwargs)
+
+    def __call__(self, sample, timestep, encoder_hidden_states,
+                 return_dict: bool = True, cross_attention_kwargs=None,
+                 **kwargs):
+        if cross_attention_kwargs:
+            raise NotImplementedError(
+                "cross_attention_kwargs are not supported")
+        extra = {k: v for k, v in kwargs.items() if v is not None}
+        if extra:
+            raise NotImplementedError(
+                f"unsupported UNet kwargs: {sorted(extra)}")
+        self.fwd_count += 1
+        if self.data_format == "NCHW":
+            sample = _to_nhwc(jnp.asarray(sample))
+        out = self._jitted(self.params, sample, jnp.asarray(timestep),
+                           jnp.asarray(encoder_hidden_states))
+        if self.data_format == "NCHW":
+            out = _to_nchw(out)
+        return UNetOutput(sample=out) if return_dict else (out,)
+
+    forward = __call__
+
+
+class DSVAE:
+    """AutoencoderKL adapter: jit-cached ``encode``/``decode`` (the
+    reference DSVAE splits CUDA graphs per method for the same reason —
+    distinct programs).  Pipeline contract honored exactly: encode returns
+    ``.latent_dist`` UNSCALED, decode takes already-descaled latents and
+    returns ``.sample`` (pipelines do ``vae.decode(latents /
+    scaling_factor)`` themselves)."""
+
+    def __init__(self, config: Optional[VAEConfig] = None, params: Any = None,
+                 rng: Optional[jax.Array] = None, data_format: str = "NCHW",
+                 enable_cuda_graph: bool = True):
+        del enable_cuda_graph
+        self.config = config or VAEConfig()
+        if params is None:
+            params = init_vae_params(
+                self.config, rng if rng is not None else jax.random.PRNGKey(0))
+        self.params = params
+        self.dtype = self.config.dtype
+        self.data_format = data_format
+        self._enc = jax.jit(
+            lambda p, x: vae_encode_moments(self.config, p, x))
+        self._dec = jax.jit(
+            lambda p, z: vae_decode(self.config, p, z, scale=False))
+
+    @classmethod
+    def from_diffusers(cls, vae_module, dtype=None, **kwargs) -> "DSVAE":
+        c = vae_module.config
+        cfg = VAEConfig(
+            in_channels=c.in_channels, out_channels=c.out_channels,
+            latent_channels=c.latent_channels,
+            block_out_channels=tuple(c.block_out_channels),
+            layers_per_block=c.layers_per_block,
+            norm_num_groups=c.norm_num_groups,
+            scaling_factor=getattr(c, "scaling_factor", 0.18215),
+            dtype=dtype or jnp.float32)
+        params = load_diffusers_state_dict(vae_module.state_dict(),
+                                           dtype=dtype)
+        return cls(cfg, params, **kwargs)
+
+    def encode(self, sample, return_dict: bool = True):
+        if self.data_format == "NCHW":
+            sample = _to_nhwc(jnp.asarray(sample))
+        mean, logvar = self._enc(self.params, sample)
+        if self.data_format == "NCHW":
+            mean, logvar = _to_nchw(mean), _to_nchw(logvar)
+        dist = DiagonalGaussianDistribution(mean, logvar)
+        return AutoencoderKLOutput(latent_dist=dist) if return_dict \
+            else (dist,)
+
+    def decode(self, latents, return_dict: bool = True):
+        if self.data_format == "NCHW":
+            latents = _to_nhwc(jnp.asarray(latents))
+        img = self._dec(self.params, latents)
+        if self.data_format == "NCHW":
+            img = _to_nchw(img)
+        return DecoderOutput(sample=img) if return_dict else (img,)
+
+    def forward(self, sample, return_dict: bool = True):
+        dist = self.encode(sample).latent_dist
+        return self.decode(dist.mode(), return_dict=return_dict)
+
+    __call__ = forward
+
+
+def generic_injection(pipeline, dtype=None, enable_cuda_graph: bool = True):
+    """Reference ``replace_module.generic_injection``: swap a diffusers
+    pipeline's ``unet``/``vae`` for the DS adapters in place.  Needs a live
+    ``diffusers`` install (absent in this image — the native family is the
+    supported path; see models/diffusion.py)."""
+    replaced = False
+    if hasattr(pipeline, "unet"):
+        pipeline.unet = DSUNet.from_diffusers(
+            pipeline.unet, dtype=dtype, enable_cuda_graph=enable_cuda_graph)
+        replaced = True
+    if hasattr(pipeline, "vae"):
+        pipeline.vae = DSVAE.from_diffusers(
+            pipeline.vae, dtype=dtype, enable_cuda_graph=enable_cuda_graph)
+        replaced = True
+    if not replaced:
+        raise ValueError("pipeline exposes neither .unet nor .vae")
+    return pipeline

@@ -1,0 +1,259 @@
+"""Diffusion family tests (reference N11 spatial/diffusers subsystem:
+``model_implementations/diffusers/{unet,vae}.py``,
+``module_inject/containers/{unet,vae}.py``).
+
+diffusers itself is not installed in this image, so block-level parity is
+checked against torch.nn.functional (which IS available) and the
+UNet/VAE are driven e2e: full denoise loop, VAE roundtrip, and a
+layout-transform roundtrip for real-checkpoint loading."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.diffusion import (
+    TINY_UNET, TINY_VAE, attention, conv2d, group_norm, init_unet_params,
+    init_vae_params, layer_norm, load_diffusers_state_dict,
+    timestep_embedding, unet_forward, vae_decode, vae_encode)
+from deepspeed_tpu.inference.diffusers import DSUNet, DSVAE
+
+torch = pytest.importorskip("torch")
+
+
+# ---------------------------------------------------------------------------
+# primitive parity vs torch (the numerical ground truth available in-image)
+# ---------------------------------------------------------------------------
+
+def test_group_norm_matches_torch():
+    r = np.random.default_rng(0)
+    x = r.standard_normal((2, 4, 4, 16)).astype(np.float32)
+    scale = r.standard_normal(16).astype(np.float32)
+    bias = r.standard_normal(16).astype(np.float32)
+    got = np.asarray(group_norm({"scale": jnp.asarray(scale),
+                                 "bias": jnp.asarray(bias)},
+                                jnp.asarray(x), groups=4))
+    want = torch.nn.functional.group_norm(
+        torch.from_numpy(x.transpose(0, 3, 1, 2)), 4,
+        torch.from_numpy(scale), torch.from_numpy(bias),
+        eps=1e-6).numpy().transpose(0, 2, 3, 1)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_conv2d_matches_torch():
+    r = np.random.default_rng(1)
+    x = r.standard_normal((2, 8, 8, 3)).astype(np.float32)
+    w = r.standard_normal((16, 3, 3, 3)).astype(np.float32)    # OIHW
+    b = r.standard_normal(16).astype(np.float32)
+    got = np.asarray(conv2d({"kernel": jnp.asarray(w.transpose(2, 3, 1, 0)),
+                             "bias": jnp.asarray(b)}, jnp.asarray(x)))
+    want = torch.nn.functional.conv2d(
+        torch.from_numpy(x.transpose(0, 3, 1, 2)), torch.from_numpy(w),
+        torch.from_numpy(b), padding=1).numpy().transpose(0, 2, 3, 1)
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_attention_matches_torch_sdpa():
+    r = np.random.default_rng(2)
+    B, T, S, C, H = 2, 6, 5, 32, 4
+    x = r.standard_normal((B, T, C)).astype(np.float32)
+    ctx = r.standard_normal((B, S, C)).astype(np.float32)
+    ws = {n: (r.standard_normal((C, C)) / np.sqrt(C)).astype(np.float32)
+          for n in ("q", "k", "v", "o")}
+    bo = r.standard_normal(C).astype(np.float32)
+    p = {"to_q": {"kernel": jnp.asarray(ws["q"])},
+         "to_k": {"kernel": jnp.asarray(ws["k"])},
+         "to_v": {"kernel": jnp.asarray(ws["v"])},
+         "to_out": [{"kernel": jnp.asarray(ws["o"]), "bias": jnp.asarray(bo)}]}
+    got = np.asarray(attention(p, jnp.asarray(x), jnp.asarray(ctx), heads=H))
+
+    q = (torch.from_numpy(x) @ torch.from_numpy(ws["q"])).reshape(B, T, H, -1)
+    k = (torch.from_numpy(ctx) @ torch.from_numpy(ws["k"])).reshape(B, S, H, -1)
+    v = (torch.from_numpy(ctx) @ torch.from_numpy(ws["v"])).reshape(B, S, H, -1)
+    o = torch.nn.functional.scaled_dot_product_attention(
+        q.transpose(1, 2), k.transpose(1, 2), v.transpose(1, 2))
+    want = (o.transpose(1, 2).reshape(B, T, C) @ torch.from_numpy(ws["o"])
+            + torch.from_numpy(bo)).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_timestep_embedding_properties():
+    emb = np.asarray(timestep_embedding(jnp.asarray([0.0, 10.0, 999.0]), 32))
+    assert emb.shape == (3, 32)
+    # t=0: cos(0)=1 on the first half, sin(0)=0 on the second
+    np.testing.assert_allclose(emb[0, :16], 1.0, atol=1e-6)
+    np.testing.assert_allclose(emb[0, 16:], 0.0, atol=1e-6)
+    assert not np.allclose(emb[1], emb[2])
+
+
+# ---------------------------------------------------------------------------
+# model e2e
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def unet():
+    return TINY_UNET, init_unet_params(TINY_UNET, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def vae():
+    return TINY_VAE, init_vae_params(TINY_VAE, jax.random.PRNGKey(1))
+
+
+def test_unet_forward_shape_and_finite(unet):
+    cfg, params = unet
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 8, 8, cfg.in_channels))
+    ctx = jax.random.normal(jax.random.PRNGKey(3),
+                            (2, 3, cfg.cross_attention_dim))
+    out = unet_forward(cfg, params, x, jnp.asarray([10, 500]), ctx)
+    assert out.shape == (2, 8, 8, cfg.out_channels)
+    assert bool(jnp.isfinite(out).all())
+    # conditioning actually conditions
+    out2 = unet_forward(cfg, params, x, jnp.asarray([10, 500]), ctx * 2.0)
+    assert not np.allclose(np.asarray(out), np.asarray(out2))
+
+
+def test_vae_roundtrip_shapes(vae):
+    cfg, params = vae
+    img = jax.random.normal(jax.random.PRNGKey(4), (2, 16, 16, 3))
+    z = vae_encode(cfg, params, img)
+    down = 2 ** (len(cfg.block_out_channels) - 1)
+    assert z.shape == (2, 16 // down, 16 // down, cfg.latent_channels)
+    rec = vae_decode(cfg, params, z)
+    assert rec.shape == (2, 16, 16, 3)
+    assert bool(jnp.isfinite(rec).all())
+    # posterior sampling differs from the mean path
+    zs = vae_encode(cfg, params, img, rng=jax.random.PRNGKey(5),
+                    sample_posterior=True)
+    assert not np.allclose(np.asarray(z), np.asarray(zs))
+
+
+def test_ds_unet_adapter_nchw_api(unet):
+    cfg, params = unet
+    m = DSUNet(cfg, params)
+    assert m.in_channels == cfg.in_channels      # SD pipeline reads this
+    sample = np.random.default_rng(6).standard_normal(
+        (1, cfg.in_channels, 8, 8)).astype(np.float32)
+    ctx = np.zeros((1, 3, cfg.cross_attention_dim), np.float32)
+    out = m(sample, 7, ctx).sample               # attribute access, like
+    assert m(sample, 7, ctx)["sample"] is not None   # ...and key access
+    assert out.shape == sample.shape             # NCHW in, NCHW out
+    out2 = m(sample, 7, ctx, return_dict=False)[0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2))
+    assert m.fwd_count == 3
+    # pipeline kwargs: None extras pass, real extras raise
+    m(sample, 7, ctx, timestep_cond=None)
+    with pytest.raises(NotImplementedError):
+        m(sample, 7, ctx, timestep_cond=np.zeros(1))
+
+
+def test_ds_vae_adapter_pipeline_contract(vae):
+    """The exact SD-pipeline calling sequence: encode().latent_dist.sample()
+    * scaling_factor ... vae.decode(latents / scaling_factor).sample —
+    the adapter must NOT scale internally (AutoencoderKL never does)."""
+    cfg, params = vae
+    m = DSVAE(cfg, params)
+    img = np.random.default_rng(7).standard_normal(
+        (1, 3, 16, 16)).astype(np.float32)
+    dist = m.encode(img).latent_dist
+    assert np.asarray(dist.mode()).shape[1] == cfg.latent_channels   # NCHW
+    latents = dist.mode() * cfg.scaling_factor      # pipeline-side scaling
+    rec = m.decode(latents / cfg.scaling_factor).sample
+    assert np.asarray(rec).shape == img.shape
+    # unscaled adapter path == native path with scale=True end-to-end
+    from deepspeed_tpu.models.diffusion import vae_encode
+    znat = vae_encode(cfg, params, jnp.asarray(img.transpose(0, 2, 3, 1)))
+    np.testing.assert_allclose(np.asarray(latents).transpose(0, 2, 3, 1),
+                               np.asarray(znat), atol=1e-6)
+    # return_dict=False tuples, like diffusers
+    assert isinstance(m.encode(img, return_dict=False), tuple)
+    assert isinstance(m.decode(latents, return_dict=False), tuple)
+
+
+def test_unet_per_block_head_counts():
+    """SD2.x passes attention_head_dim as a per-block list — each block must
+    use ITS entry (reversed for up blocks), not the first one."""
+    import dataclasses
+
+    cfg = dataclasses.replace(TINY_UNET, attention_head_dim=(2, 4))
+    assert cfg.heads_for_block(0) == 2 and cfg.heads_for_block(1) == 4
+    assert cfg.heads_for_block(0, up=True) == 4
+    assert cfg.heads_for_block(1, up=True) == 2
+    params = init_unet_params(cfg, jax.random.PRNGKey(11))
+    x = jax.random.normal(jax.random.PRNGKey(12), (1, 8, 8, cfg.in_channels))
+    ctx = jax.random.normal(jax.random.PRNGKey(13),
+                            (1, 3, cfg.cross_attention_dim))
+    out = unet_forward(cfg, params, x, jnp.asarray([5]), ctx)
+    assert bool(jnp.isfinite(out).all())
+
+
+def test_denoise_loop_e2e(unet):
+    """A 6-step DDIM-style loop through the jitted UNet — the reference's
+    pipeline role (StableDiffusionPipeline drives exactly this call
+    pattern through DSUNet)."""
+    cfg, params = unet
+    m = DSUNet(cfg, params, data_format="NHWC")
+    rng = jax.random.PRNGKey(8)
+    latents = jax.random.normal(rng, (1, 8, 8, cfg.in_channels))
+    ctx = jax.random.normal(jax.random.PRNGKey(9),
+                            (1, 4, cfg.cross_attention_dim))
+    alphas = jnp.cumprod(1.0 - jnp.linspace(1e-4, 0.02, 1000))
+    steps = jnp.asarray([999, 799, 599, 399, 199, 0])
+    x = latents
+    for i in range(len(steps)):
+        t = steps[i]
+        eps = m(x, t, ctx, return_dict=False)[0]
+        a_t = alphas[t]
+        a_prev = alphas[steps[i + 1]] if i + 1 < len(steps) else jnp.float32(1.0)
+        x0 = (x - jnp.sqrt(1.0 - a_t) * eps) / jnp.sqrt(a_t)
+        x = jnp.sqrt(a_prev) * x0 + jnp.sqrt(1.0 - a_prev) * eps
+    assert bool(jnp.isfinite(x).all())
+    assert not np.allclose(np.asarray(x), np.asarray(latents))
+
+
+# ---------------------------------------------------------------------------
+# checkpoint layout transform
+# ---------------------------------------------------------------------------
+
+def _to_torch_layout_state_dict(params, prefix=""):
+    """Reverse of load_diffusers_state_dict: native tree → diffusers-named
+    torch-layout numpy state dict (for roundtrip testing)."""
+    sd = {}
+    if isinstance(params, dict):
+        items = params.items()
+    else:
+        items = ((str(i), v) for i, v in enumerate(params))
+    for k, v in items:
+        name = f"{prefix}{k}"
+        if isinstance(v, (dict, list)):
+            sd.update(_to_torch_layout_state_dict(v, name + "."))
+        else:
+            a = np.asarray(v)
+            if k == "kernel":
+                name = f"{prefix}weight"
+                a = (a.transpose(3, 2, 0, 1) if a.ndim == 4
+                     else np.ascontiguousarray(a.T))
+            elif k == "scale":
+                name = f"{prefix}weight"
+            sd[name] = a
+    return sd
+
+
+def test_diffusers_state_dict_roundtrip(unet):
+    cfg, params = unet
+    sd = _to_torch_layout_state_dict(params)
+    assert "down_blocks.0.resnets.0.conv1.weight" in sd
+    assert sd["down_blocks.0.resnets.0.conv1.weight"].shape[2:] == (3, 3)
+    loaded = load_diffusers_state_dict(sd)
+    assert (jax.tree_util.tree_structure(loaded)
+            == jax.tree_util.tree_structure(params))
+    for (pa, a), (_, b) in zip(jax.tree_util.tree_leaves_with_path(loaded),
+                               jax.tree_util.tree_leaves_with_path(params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b), str(pa))
+
+
+def test_vae_state_dict_roundtrip(vae):
+    cfg, params = vae
+    loaded = load_diffusers_state_dict(_to_torch_layout_state_dict(params))
+    assert (jax.tree_util.tree_structure(loaded)
+            == jax.tree_util.tree_structure(params))
