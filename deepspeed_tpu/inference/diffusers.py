@@ -14,6 +14,7 @@ convention preserved exactly: NCHW tensors, ``return_dict``, outputs with
 from __future__ import annotations
 
 import dataclasses
+import itertools
 from typing import Any, Optional
 
 import jax
@@ -58,9 +59,19 @@ class DiagonalGaussianDistribution:
         self.std = jnp.exp(0.5 * self.logvar)
         self.var = jnp.exp(self.logvar)
 
+    _draws = itertools.count()   # distinct keys for bare sample() calls
+
     def sample(self, rng=None):
+        """``rng``: a jax PRNGKey, a torch.Generator (what diffusers
+        pipelines pass — its stream seeds a key), or None (fresh key per
+        call, so repeated encodes give independent posterior samples)."""
         if rng is None:
-            rng = jax.random.PRNGKey(0)
+            rng = jax.random.PRNGKey(next(self._draws))
+        elif hasattr(rng, "initial_seed"):   # torch.Generator
+            import torch
+
+            seed = int(torch.randint(0, 2 ** 31 - 1, (), generator=rng))
+            rng = jax.random.PRNGKey(seed)
         return self.mean + self.std * jax.random.normal(
             rng, self.mean.shape, self.mean.dtype)
 
@@ -108,6 +119,11 @@ class DSUNet:
         UNetPolicy.apply): config translated field-for-field, weights
         through the rank-keyed layout transform."""
         c = unet_module.config
+        if getattr(c, "use_linear_projection", False):
+            raise NotImplementedError(
+                "use_linear_projection=True UNets (Linear proj_in/proj_out) "
+                "are not supported — the native Transformer2D uses the "
+                "SD1.x conv projections")
         head_dim = c.attention_head_dim
         cfg = UNetConfig(
             sample_size=c.sample_size, in_channels=c.in_channels,

@@ -61,7 +61,7 @@ class TransformerConfig:
     head_dim: Optional[int] = None            # None => hidden // heads
     max_seq_len: int = 2048
     norm: str = "rmsnorm"                     # rmsnorm | layernorm
-    activation: str = "swiglu"                # swiglu | gelu | gelu_exact | relu
+    activation: str = "swiglu"    # swiglu | gelu | gelu_exact | relu | quick_gelu
     position: str = "rope"                    # rope | learned | alibi
     rope_theta: float = 10000.0
     # partial rotary (GPT-J/NeoX): apply rope to the first rotary_dim dims
@@ -85,6 +85,16 @@ class TransformerConfig:
     type_vocab_size: int = 0
     final_norm: bool = True
     norm_eps: float = 1e-5
+    # GPT-Neo: per-layer attention-type alternation — a tuple of
+    # "global"/"local" per layer; "local" layers see a sliding window of
+    # window_size keys (HF GPTNeoConfig attention_types/window_size).  The
+    # window rides the layer scan as a per-layer scalar so layers stay
+    # uniform; flash/ring paths defer to the masked XLA path.
+    attention_layers: Optional[tuple] = None
+    window_size: int = 256
+    # softmax scale override: GPT-Neo applies NO 1/sqrt(hd) scaling
+    # (modeling_gpt_neo scales by 1.0); None = the standard 1/sqrt(hd)
+    attn_softmax_scale: Optional[float] = None
     tie_embeddings: bool = False
     attn_bias: bool = False
     mlp_bias: bool = False
@@ -269,6 +279,24 @@ def has_moe(cfg: TransformerConfig) -> bool:
     return max(moe_layer_experts(cfg)) > 1
 
 
+def layer_windows(cfg: TransformerConfig) -> Optional[jax.Array]:
+    """[L] int32 of local-attention window sizes (0 = global) from
+    cfg.attention_layers, or None when the config has no alternation."""
+    if cfg.attention_layers is None:
+        return None
+    if len(cfg.attention_layers) != cfg.num_layers:
+        raise ValueError(
+            f"attention_layers has {len(cfg.attention_layers)} entries for "
+            f"{cfg.num_layers} layers")
+    return jnp.asarray([cfg.window_size if t == "local" else 0
+                        for t in cfg.attention_layers], jnp.int32)
+
+
+def _sm_scale(cfg: TransformerConfig, hd: int) -> float:
+    return (cfg.attn_softmax_scale if cfg.attn_softmax_scale is not None
+            else 1.0 / math.sqrt(hd))
+
+
 # ---------------------------------------------------------------------------
 # Parameters
 # ---------------------------------------------------------------------------
@@ -305,7 +333,10 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> Dict[str, Any]:
     E = cfg.num_experts
     mlp_shape = (lambda *s: (L, E) + s) if E > 1 else (lambda *s: (L,) + s)
     if E > 1:
-        assert not cfg.mlp_bias, "MoE experts do not support mlp_bias"
+        # per-expert biases supported on the gelu/relu path (Megatron-DS MoE
+        # experts are biased Linears); swiglu experts stay bias-free
+        assert not (cfg.mlp_bias and cfg.activation == "swiglu"), \
+            "swiglu MoE experts do not support mlp_bias"
         layers["router"] = dense(keys[10], (L, d, E))
     if cfg.activation == "swiglu":
         layers["w_gate"] = dense(keys[4], mlp_shape(d, f))
@@ -337,8 +368,8 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> Dict[str, Any]:
             layers["b_gate"] = jnp.zeros((L, f))
             layers["b_up"] = jnp.zeros((L, f))
         else:
-            layers["b_in"] = jnp.zeros((L, f))
-        layers["b_down"] = jnp.zeros((L, d))
+            layers["b_in"] = jnp.zeros(mlp_shape(f))
+        layers["b_down"] = jnp.zeros(mlp_shape(d))
 
     params: Dict[str, Any] = {
         "embed": dense(keys[7], (cfg.vocab_size, d)),
@@ -490,9 +521,12 @@ def param_specs(cfg: TransformerConfig) -> Dict[str, Any]:
     if cfg.mlp_bias:
         if cfg.activation == "swiglu":
             layers.update(b_gate=P(None, "model"), b_up=P(None, "model"))
+        elif cfg.num_experts > 1:      # per-expert biases [L, E, f]
+            layers["b_in"] = P(None, "expert", "model")
         else:
             layers["b_in"] = P(None, "model")
-        layers["b_down"] = P(None, None)
+        layers["b_down"] = (P(None, "expert", None) if cfg.num_experts > 1
+                            and cfg.activation != "swiglu" else P(None, None))
 
     if cfg.pipeline_stages > 1:
         # stage dim rides the 'pipe' axis; each shard holds its stage's layers
@@ -624,8 +658,11 @@ def _alibi_slopes(num_heads: int) -> np.ndarray:
 
 
 def _attention(cfg: TransformerConfig, q, k, v, positions, attn_impl: str = "xla",
-               custom_positions: bool = False):
-    """q:[B,S,Hq,hd] k,v:[B,S,Hkv,hd] -> [B,S,Hq,hd], causal."""
+               custom_positions: bool = False, window=None):
+    """q:[B,S,Hq,hd] k,v:[B,S,Hkv,hd] -> [B,S,Hq,hd], causal.
+
+    ``window``: traced per-layer scalar (0 = global) — local layers mask
+    keys older than ``window`` positions; rides the masked XLA path only."""
     B, S, Hq, hd = q.shape
     Hkv = k.shape[2]
     # Sequence-parallel mesh: ring attention keeps queries resident and
@@ -633,7 +670,7 @@ def _attention(cfg: TransformerConfig, q, k, v, positions, attn_impl: str = "xla
     # GSPMD all-gather the full sequence.  Checked BEFORE "auto" resolves so
     # any seq-sharded mesh routes through the ring by default.
     if attn_impl in ("auto", "ring", "pallas") and cfg.position != "alibi" \
-            and cfg.causal and not custom_positions:
+            and cfg.causal and not custom_positions and window is None:
         from ..parallel import mesh as mesh_mod
 
         m = mesh_mod._GLOBAL_MESH
@@ -651,7 +688,7 @@ def _attention(cfg: TransformerConfig, q, k, v, positions, attn_impl: str = "xla
 
                 return ring_attention_sharded(
                     q, k, v, m, BATCH_AXES, causal=True,
-                    sm_scale=1.0 / math.sqrt(hd))
+                    sm_scale=_sm_scale(cfg, hd))
             if attn_impl == "ring":
                 raise ValueError(
                     f"ring attention requested but unsatisfiable: {failed}")
@@ -673,11 +710,11 @@ def _attention(cfg: TransformerConfig, q, k, v, positions, attn_impl: str = "xla
     # The flash kernel masks by row/col index, so it requires default
     # positions; custom position ids (packed sequences) use the XLA path.
     if attn_impl == "pallas" and cfg.position != "alibi" and cfg.causal \
-            and not custom_positions:
+            and not custom_positions and window is None:
         from ..ops.pallas.flash_attention import flash_attention
         from ..parallel import mesh as mesh_mod
 
-        sm = 1.0 / math.sqrt(hd)
+        sm = _sm_scale(cfg, hd)
         m = mesh_mod._GLOBAL_MESH
         sharded = m is not None and any(s > 1 for s in m.shape.values())
         if not sharded:
@@ -703,13 +740,20 @@ def _attention(cfg: TransformerConfig, q, k, v, positions, attn_impl: str = "xla
         rep = Hq // Hkv
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(hd)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * _sm_scale(cfg, hd)
     scores = scores.astype(jnp.float32)
     if cfg.position == "alibi":
         scores = scores + _alibi_bias(cfg, positions, Hq, S, jnp.float32)
     if cfg.causal:
         causal = positions[:, None, :, None] >= positions[:, None, None, :]
         scores = jnp.where(causal, scores, -1e30)
+    if window is not None:
+        # sliding window (GPT-Neo local layers): key within `window` of the
+        # query; window == 0 means this layer is global — mask is all-true,
+        # so one uniform computation serves both layer kinds under the scan
+        rel = positions[:, None, :, None] - positions[:, None, None, :]
+        local_ok = (window <= 0) | (rel < window)
+        scores = jnp.where(local_ok, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
@@ -750,6 +794,8 @@ def _dense_mlp(cfg: TransformerConfig, lp: Dict[str, Any], h, prefix=""):
             m = jax.nn.relu(m)
         elif cfg.activation == "gelu_exact":   # HF 'gelu' (erf)
             m = jax.nn.gelu(m, approximate=False)
+        elif cfg.activation == "quick_gelu":   # CLIP: x * sigmoid(1.702 x)
+            m = m * jax.nn.sigmoid(1.702 * m)
         else:
             m = jax.nn.gelu(m)
         m = m @ lp[prefix + "w_down"]
@@ -791,7 +837,7 @@ def _mlp(cfg: TransformerConfig, lp: Dict[str, Any], h, rng, deterministic):
 
 def _block_postln(cfg: TransformerConfig, lp: Dict[str, Any], x, positions,
                   rng, attn_impl: str, deterministic: bool,
-                  custom_positions: bool = False):
+                  custom_positions: bool = False, window=None):
     """Post-layernorm encoder block (BERT):  x = LN(x + attn(x));
     x = LN(x + mlp(x)).  The norm params are the POST-sublayer LayerNorms."""
     B, S, d = x.shape
@@ -804,7 +850,8 @@ def _block_postln(cfg: TransformerConfig, lp: Dict[str, Any], x, positions,
         q = q + lp["bq"].reshape(nh, hd)
         k = k + lp["bk"].reshape(nkv, hd)
         v = v + lp["bv"].reshape(nkv, hd)
-    attn = _attention(cfg, q, k, v, positions, attn_impl, custom_positions)
+    attn = _attention(cfg, q, k, v, positions, attn_impl, custom_positions,
+                      window=window)
     attn = attn.reshape(B, S, nh * hd) @ lp["wo"]
     if cfg.attn_bias:
         attn = attn + lp["bo"]
@@ -824,10 +871,11 @@ def _block_postln(cfg: TransformerConfig, lp: Dict[str, Any], x, positions,
 
 
 def _block(cfg: TransformerConfig, lp: Dict[str, Any], x, positions, rng,
-           attn_impl: str, deterministic: bool, custom_positions: bool = False):
+           attn_impl: str, deterministic: bool, custom_positions: bool = False,
+           window=None):
     if cfg.post_layernorm:
         return _block_postln(cfg, lp, x, positions, rng, attn_impl,
-                             deterministic, custom_positions)
+                             deterministic, custom_positions, window=window)
     B, S, d = x.shape
     hd, nh, nkv = cfg.dims_per_head, cfg.num_heads, cfg.kv_heads
 
@@ -850,7 +898,8 @@ def _block(cfg: TransformerConfig, lp: Dict[str, Any], x, positions, rng,
     q = checkpoint_name(q, "q_proj")
     k = checkpoint_name(k, "k_proj")
     v = checkpoint_name(v, "v_proj")
-    attn = _attention(cfg, q, k, v, positions, attn_impl, custom_positions)
+    attn = _attention(cfg, q, k, v, positions, attn_impl, custom_positions,
+                      window=window)
     # named checkpoint: the "save_attn" remat policy stashes this one tensor
     # per layer ([B,S,H*hd] bf16) so the backward skips recomputing the whole
     # attention (the costliest part of the recompute) while the rest of the
@@ -891,8 +940,9 @@ def _build_block(cfg: TransformerConfig, attn_impl: str, deterministic: bool,
     """One layer's apply fn ``block(lp, x, rng, positions)`` with the remat
     policy and random-LTD wrapping applied — shared by forward() and the
     1F1B pipeline executor."""
-    block = lambda lp, x, sub, pos: _block(cfg, lp, x, pos, sub, attn_impl,  # noqa: E731
-                                           deterministic, custom_positions)
+    block = lambda lp, x, sub, pos, window=None: _block(  # noqa: E731
+        cfg, lp, x, pos, sub, attn_impl, deterministic, custom_positions,
+        window=window)
     if cfg.remat:
         if cfg.remat_policy == "save_attn":
             # keep each layer's attention output ([B,S,D] bf16 — ~2*B*S*D
@@ -922,6 +972,10 @@ def _build_block(cfg: TransformerConfig, attn_impl: str, deterministic: bool,
         from ..runtime.data_pipeline.data_routing.random_ltd import \
             random_ltd_block
 
+        if cfg.attention_layers is not None:
+            raise NotImplementedError(
+                "random-LTD with per-layer attention types is not supported "
+                "(the token-subset wrapper does not thread the window)")
         inner_block = block
         block = lambda lp, x, sub, pos: random_ltd_block(  # noqa: E731
             inner_block, cfg, lp, x, pos, sub, cfg.random_ltd_keep,
@@ -961,11 +1015,16 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
 
     aux_total = jnp.float32(0.0)
     het = isinstance(params["layers"], (list, tuple))  # PR-MoE pyramid
+    windows = layer_windows(cfg)
     if pld_theta is not None and (cfg.pipeline_stages > 1
                                   or not cfg.scan_layers or het):
         raise NotImplementedError(
             "progressive layer drop requires the scanned-layers path "
             "(scan_layers=True, pipeline_stages=1, uniform layers)")
+    if windows is not None and cfg.pipeline_stages > 1:
+        raise NotImplementedError(
+            "pipeline parallelism with per-layer attention types "
+            "(attention_layers) is not supported")
     if cfg.pipeline_stages > 1:
         from ..runtime.pipe.spmd import pipeline_apply
 
@@ -1001,6 +1060,11 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
             rng, sub = jax.random.split(rng)
             keep = pld_keep_mask(sub, cfg.num_layers, pld_theta)
 
+            if windows is not None:
+                raise NotImplementedError(
+                    "progressive layer drop with per-layer attention types "
+                    "is not supported")
+
             def body(carry, xs):
                 lp, keep_i = xs
                 x, r, aux_sum = carry
@@ -1013,6 +1077,19 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
 
             (x, _, aux_total), _ = jax.lax.scan(
                 body, (x, rng, aux_total), (params["layers"], keep))
+        elif windows is not None:
+            # per-layer window rides the scan as a second xs — layers stay
+            # uniform (window==0 reduces to the plain causal mask)
+            def body(carry, xs):
+                lp, w = xs
+                x, r, aux_sum = carry
+                r, sub = jax.random.split(r)
+                x, aux = block(lp, x, sub, positions, w)
+                x = constrain_spec(x, act_spec)
+                return (x, r, aux_sum + aux), None
+
+            (x, _, aux_total), _ = jax.lax.scan(body, (x, rng, aux_total),
+                                                (params["layers"], windows))
         else:
             def body(carry, lp):
                 x, r, aux_sum = carry
@@ -1028,7 +1105,8 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any], tokens: jax.Array,
             lp = (params["layers"][i] if het else
                   jax.tree_util.tree_map(lambda a: a[i], params["layers"]))
             rng, sub = jax.random.split(rng)
-            x, aux = block(lp, x, sub, positions)
+            x, aux = block(lp, x, sub, positions,
+                           None if windows is None else windows[i])
             aux_total = aux_total + aux
 
     if cfg.final_norm:
@@ -1181,7 +1259,7 @@ def cache_specs(cfg: TransformerConfig) -> Dict[str, P]:
             "pos": P(BATCH_AXES, None), "next_slot": P()}
 
 
-def _attention_cached(cfg, q, ck, cv, q_pos, q_slot, valid, kpos):
+def _attention_cached(cfg, q, ck, cv, q_pos, q_slot, valid, kpos, window=None):
     """q:[B,S,Hq,hd] against the full cache ck/cv:[B,T,Hkv,hd].
 
     GQA contracts grouped query heads against the Hkv cache directly (no
@@ -1195,7 +1273,7 @@ def _attention_cached(cfg, q, ck, cv, q_pos, q_slot, valid, kpos):
     flash_decode_on = (cfg.flash_decode if cfg.flash_decode is not None
                        else _flash_decode_enabled())  # trace-time under jit
     if (S == 1 and cfg.position != "alibi" and T % 128 == 0
-            and hd % 8 == 0 and flash_decode_on):
+            and hd % 8 == 0 and flash_decode_on and window is None):
         # decode step: the Pallas flash-decode kernel streams the cache
         # through VMEM once (no [Hq,T] HBM score matrix).  Opt-in: decode is
         # HBM-bandwidth bound and XLA's fused einsum already sits at the
@@ -1232,7 +1310,7 @@ def _attention_cached(cfg, q, ck, cv, q_pos, q_slot, valid, kpos):
             return out[:, None]
     qg = q.reshape(B, S, Hkv, G, hd)
     scores = jnp.einsum("bskgd,btkd->bkgst", qg, ck).astype(jnp.float32)
-    scores = scores / math.sqrt(hd)
+    scores = scores * _sm_scale(cfg, hd)
     if cfg.position == "alibi":
         slopes = jnp.asarray(_alibi_slopes(Hq)).reshape(Hkv, G)
         rel = (q_pos[:, :, None] - kpos[:, None, :]).astype(jnp.float32)  # [B,S,T]
@@ -1240,6 +1318,11 @@ def _attention_cached(cfg, q, ck, cv, q_pos, q_slot, valid, kpos):
                            * slopes[None, :, :, None, None])
     slot_t = jnp.arange(T, dtype=jnp.int32)
     ok = valid[:, None, :] & (slot_t[None, None, :] <= q_slot[None, :, None])
+    if window is not None:
+        # GPT-Neo local layers: only keys within `window` positions of the
+        # query (window == 0 -> global, mask all-true)
+        rel_pos = q_pos[:, :, None] - kpos[:, None, :]          # [B,S,T]
+        ok = ok & ((window <= 0) | (rel_pos < window))
     scores = jnp.where(ok[:, None, None, :, :], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     out = jnp.einsum("bkgst,btkd->bskgd", probs, cv)
@@ -1247,7 +1330,7 @@ def _attention_cached(cfg, q, ck, cv, q_pos, q_slot, valid, kpos):
 
 
 def _block_cached(cfg, lp, x, ck, cv, q_pos, q_slot, valid, kpos, next_slot,
-                  rng):
+                  rng, window=None):
     """One transformer block with cache read/write.  ck/cv are this layer's
     [B,T,Hkv,hd] buffers; returns (x, updated ck, cv)."""
     B, S, _ = x.shape
@@ -1271,7 +1354,8 @@ def _block_cached(cfg, lp, x, ck, cv, q_pos, q_slot, valid, kpos, next_slot,
     cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, next_slot, 0, 0))
     ck = constrain_spec(ck, P(BATCH_AXES, None, "model", None))
     cv = constrain_spec(cv, P(BATCH_AXES, None, "model", None))
-    attn = _attention_cached(cfg, q, ck, cv, q_pos, q_slot, valid, kpos)
+    attn = _attention_cached(cfg, q, ck, cv, q_pos, q_slot, valid, kpos,
+                             window=window)
     attn = attn.reshape(B, S, nh * hd) @ lp["wo"]
     if cfg.attn_bias:
         attn = attn + lp["bo"]
@@ -1330,15 +1414,28 @@ def forward_cached(cfg: TransformerConfig, params: Dict[str, Any],
 
     rng = jax.random.PRNGKey(0)
 
-    def body(x, layer):
-        lp, ck, cv = layer
-        x, ck, cv = _block_cached(cfg, lp, x, ck, cv, positions, q_slot, valid,
-                                  kpos, next_slot, rng)
-        x = constrain_spec(x, P(BATCH_AXES, None, None))
-        return x, (ck, cv)
+    windows = layer_windows(cfg)
+    if windows is None:
+        def body(x, layer):
+            lp, ck, cv = layer
+            x, ck, cv = _block_cached(cfg, lp, x, ck, cv, positions, q_slot,
+                                      valid, kpos, next_slot, rng)
+            x = constrain_spec(x, P(BATCH_AXES, None, None))
+            return x, (ck, cv)
 
-    x, (ck_all, cv_all) = jax.lax.scan(
-        body, x, (params["layers"], cache["k"], cache["v"]))
+        x, (ck_all, cv_all) = jax.lax.scan(
+            body, x, (params["layers"], cache["k"], cache["v"]))
+    else:
+        # per-layer local window rides the scan (GPT-Neo alternation)
+        def body(x, layer):
+            lp, ck, cv, w = layer
+            x, ck, cv = _block_cached(cfg, lp, x, ck, cv, positions, q_slot,
+                                      valid, kpos, next_slot, rng, window=w)
+            x = constrain_spec(x, P(BATCH_AXES, None, None))
+            return x, (ck, cv)
+
+        x, (ck_all, cv_all) = jax.lax.scan(
+            body, x, (params["layers"], cache["k"], cache["v"], windows))
 
     x = _norm(cfg, x, params["final_norm_scale"], params.get("final_norm_bias"))
     if cfg.tie_embeddings:

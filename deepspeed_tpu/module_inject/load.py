@@ -43,7 +43,8 @@ def _hf_activation(name: str) -> str:
     """HF activation names → native: HF 'gelu' is the EXACT erf GELU;
     'gelu_new'/'gelu_pytorch_tanh' are the tanh approximation."""
     table = {"gelu": "gelu_exact", "gelu_new": "gelu",
-             "gelu_pytorch_tanh": "gelu", "relu": "relu"}
+             "gelu_pytorch_tanh": "gelu", "relu": "relu",
+             "quick_gelu": "quick_gelu"}
     if name not in table:
         raise NotImplementedError(f"HF activation {name!r} is not supported")
     return table[name]
@@ -132,6 +133,82 @@ def config_from_hf(hf_config) -> TransformerConfig:
             type_vocab_size=get("type_vocab_size", 2),
             final_norm=False,
             norm_eps=float(get("layer_norm_eps", 1e-12)))
+    if arch == "gpt_neo":
+        # local/global attention alternation + NO softmax scaling
+        # (modeling_gpt_neo applies scale 1.0) — both are config-declared
+        # so the native family reproduces the arch, not just the weights
+        attn_layers = get("attention_layers")
+        if attn_layers is None:
+            # expand attention_types [[["global","local"], N]] form
+            attn_layers = []
+            for pattern, count in get("attention_types"):
+                attn_layers += list(pattern) * count
+        return TransformerConfig(
+            vocab_size=get("vocab_size"), hidden_size=get("hidden_size"),
+            intermediate_size=get("intermediate_size")
+            or 4 * get("hidden_size"),
+            num_layers=get("num_layers"),
+            num_heads=get("num_heads"),
+            max_seq_len=get("max_position_embeddings", 2048),
+            norm="layernorm",
+            activation=_hf_activation(get("activation_function", "gelu_new")),
+            position="learned", tie_embeddings=True,
+            attention_layers=tuple(attn_layers),
+            window_size=get("window_size", 256),
+            attn_softmax_scale=1.0,
+            attn_bias=True, mlp_bias=True,
+            norm_eps=float(get("layer_norm_epsilon", 1e-5)))
+    if arch == "distilbert":
+        return TransformerConfig(
+            vocab_size=get("vocab_size"), hidden_size=get("dim"),
+            intermediate_size=get("hidden_dim"),
+            num_layers=get("n_layers"), num_heads=get("n_heads"),
+            max_seq_len=get("max_position_embeddings", 512),
+            norm="layernorm",
+            activation=_hf_activation(get("activation", "gelu")),
+            position="learned", tie_embeddings=True, attn_bias=True,
+            mlp_bias=True, causal=False, post_layernorm=True,
+            embed_layernorm=True, final_norm=False,
+            norm_eps=1e-12)
+    if arch == "clip":
+        text = get("text_config")          # full CLIPModel wraps text_config
+        if text is not None:
+            gett = (text.get if isinstance(text, dict)
+                    else lambda k, d=None: getattr(text, k, d))
+        else:
+            gett = get
+        return TransformerConfig(
+            vocab_size=gett("vocab_size"), hidden_size=gett("hidden_size"),
+            intermediate_size=gett("intermediate_size"),
+            num_layers=gett("num_hidden_layers"),
+            num_heads=gett("num_attention_heads"),
+            max_seq_len=gett("max_position_embeddings", 77),
+            norm="layernorm",
+            activation=_hf_activation(gett("hidden_act", "quick_gelu")),
+            position="learned", tie_embeddings=True,  # encoder surface
+            attn_bias=True, mlp_bias=True, causal=True,
+            norm_eps=float(gett("layer_norm_eps", 1e-5)))
+    if arch in ("megatron_gpt", "megatron_gpt_moe"):
+        cfg_kwargs = dict(
+            vocab_size=get("vocab_size", get("padded_vocab_size")),
+            hidden_size=get("hidden_size"),
+            intermediate_size=get("intermediate_size")
+            or get("ffn_hidden_size") or 4 * get("hidden_size"),
+            num_layers=get("num_layers"),
+            num_heads=get("num_attention_heads"),
+            max_seq_len=get("max_position_embeddings", 2048),
+            norm="layernorm", activation="gelu", position="learned",
+            tie_embeddings=True, attn_bias=True, mlp_bias=True,
+            norm_eps=float(get("layernorm_epsilon", 1e-5)))
+        if arch == "megatron_gpt_moe":
+            E = get("num_experts") or get("moe_num_experts")
+            if isinstance(E, (list, tuple)):
+                raise NotImplementedError(
+                    "megatron_gpt_moe: per-layer expert counts are not "
+                    "supported by the checkpoint policy (uniform only)")
+            cfg_kwargs.update(num_experts=int(E),
+                              moe_top_k=get("moe_top_k", get("topk", 1)) or 1)
+        return TransformerConfig(**cfg_kwargs)
     if arch == "opt":
         proj = get("word_embed_proj_dim", get("hidden_size"))
         if proj not in (None, get("hidden_size")):
@@ -164,7 +241,7 @@ def _split_fused_qkv(w: np.ndarray, cfg: TransformerConfig, arch: str):
     PER-HEAD interleave [h0_q, h0_k, h0_v, h1_q, ...] on the first dim.
     """
     hd, nh = cfg.dims_per_head, cfg.num_heads
-    if arch in ("gpt_neox", "bloom"):
+    if arch in ("gpt_neox", "bloom", "megatron_gpt", "megatron_gpt_moe"):
         if w.ndim == 2:                       # [H*3*hd, d]
             grouped = w.reshape(nh, 3, hd, w.shape[-1])
             q, k, v = (np.ascontiguousarray(
@@ -191,10 +268,12 @@ def hf_state_dict_to_params(state_dict: Dict[str, Any],
 
     policy = POLICIES[arch]
     sd = {k: v for k, v in state_dict.items()}
-    if arch == "bert":
-        # BertForMaskedLM/SequenceClassification prefix the encoder with
-        # "bert."; BertModel exports bare names — normalize to bare
-        sd = {(k[5:] if k.startswith("bert.") else k): v
+    if arch in ("bert", "distilbert"):
+        # task-head wrappers (BertForMaskedLM, DistilBertForSequence...)
+        # prefix the encoder with the model name; bare models export bare
+        # names — normalize to bare
+        prefix = arch + "."
+        sd = {(k[len(prefix):] if k.startswith(prefix) else k): v
               for k, v in sd.items()}
     L = cfg.num_layers
     host_dtype = np.dtype(dtype) if dtype is not None else np.float32
@@ -229,11 +308,33 @@ def hf_state_dict_to_params(state_dict: Dict[str, Any],
             continue   # e.g. NeoX attention_bias=False exports omit them
         if native in mlp_bias_keys and not cfg.mlp_bias:
             continue
+        if tmpl is None:   # zero-filled slot (e.g. GPT-Neo's q/k/v biases)
+            from .policies import zero_shape
+
+            params["layers"][native] = jnp.zeros((L,) + zero_shape(native, cfg),
+                                                 host_dtype)
+            continue
         stack = []
         for i in range(L):
             w = fetch(tmpl.format(i=i))
             stack.append(tf(w) if tf is not None else w)
         params["layers"][native] = jnp.asarray(np.stack(stack))
+
+    if policy.moe_router is not None:
+        E = int(cfg.num_experts)
+        tmpl, tf = policy.moe_router
+        params["layers"]["router"] = jnp.asarray(np.stack(
+            [tf(fetch(tmpl.format(i=i))) if tf is not None
+             else fetch(tmpl.format(i=i)) for i in range(L)]))
+        for native, (etmpl, etf) in (policy.moe_experts or {}).items():
+            if native in mlp_bias_keys and not cfg.mlp_bias:
+                continue
+            stack = []
+            for i in range(L):
+                es = [etf(fetch(etmpl.format(i=i, e=e))) if etf is not None
+                      else fetch(etmpl.format(i=i, e=e)) for e in range(E)]
+                stack.append(np.stack(es))
+            params["layers"][native] = jnp.asarray(np.stack(stack))  # [L,E,..]
 
     if policy.fused_qkv is not None:
         for part, names in (("weight", ("wq", "wk", "wv")),

@@ -21,7 +21,10 @@ import numpy as np
 @dataclasses.dataclass(frozen=True)
 class ArchPolicy:
     """Name templates: ``{i}`` is the layer index.  Values are
-    (hf_name, transform) where transform is applied to the numpy tensor."""
+    (hf_name, transform) where transform is applied to the numpy tensor.
+    A ``None`` hf key means the native slot has no checkpoint tensor and is
+    zero-filled (GPT-Neo's q/k/v have no bias but out_proj does — the native
+    attn_bias knob is all-or-nothing, and zero biases are identity)."""
     name: str
     # top-level: native key -> (hf key, transform)
     top: Dict[str, Tuple[str, Optional[Callable]]]
@@ -32,6 +35,21 @@ class ArchPolicy:
     fused_qkv_bias: Optional[str] = None
     tie_embeddings: bool = False
     pos_embed_offset: int = 0     # OPT stores positions with a +2 offset
+    # MoE (Megatron-DeepSpeed): per-layer router template and per-expert
+    # weight templates with BOTH {i} (layer) and {e} (expert) slots; experts
+    # stack on an [E] dim after the transform
+    moe_router: Optional[Tuple[str, Optional[Callable]]] = None
+    moe_experts: Optional[Dict[str, Tuple[str, Optional[Callable]]]] = None
+
+
+def zero_shape(native: str, cfg) -> Tuple[int, ...]:
+    """Shape of a zero-filled native slot (hf key None in a policy)."""
+    hd = cfg.dims_per_head
+    shapes = {"bq": (cfg.num_heads * hd,), "bk": (cfg.kv_heads * hd,),
+              "bv": (cfg.kv_heads * hd,), "bo": (cfg.hidden_size,)}
+    if native not in shapes:
+        raise KeyError(f"no zero-fill shape rule for native slot {native!r}")
+    return shapes[native]
 
 
 def _t(x: np.ndarray) -> np.ndarray:
@@ -240,10 +258,162 @@ BERT = ArchPolicy(
 )
 
 
+GPTNEO = ArchPolicy(
+    name="gpt_neo",
+    top={
+        "embed": ("transformer.wte.weight", None),
+        "pos_embed": ("transformer.wpe.weight", None),
+        "final_norm_scale": ("transformer.ln_f.weight", None),
+        "final_norm_bias": ("transformer.ln_f.bias", None),
+    },
+    layer={
+        "attn_norm_scale": ("transformer.h.{i}.ln_1.weight", None),
+        "attn_norm_bias": ("transformer.h.{i}.ln_1.bias", None),
+        # q/k/v are bias-free nn.Linear; out_proj carries a bias — zero-fill
+        # bq/bk/bv (None key) so the all-or-nothing attn_bias knob matches
+        "wq": ("transformer.h.{i}.attn.attention.q_proj.weight", _t),
+        "bq": (None, None),
+        "wk": ("transformer.h.{i}.attn.attention.k_proj.weight", _t),
+        "bk": (None, None),
+        "wv": ("transformer.h.{i}.attn.attention.v_proj.weight", _t),
+        "bv": (None, None),
+        "wo": ("transformer.h.{i}.attn.attention.out_proj.weight", _t),
+        "bo": ("transformer.h.{i}.attn.attention.out_proj.bias", None),
+        "mlp_norm_scale": ("transformer.h.{i}.ln_2.weight", None),
+        "mlp_norm_bias": ("transformer.h.{i}.ln_2.bias", None),
+        # c_fc/c_proj are nn.Linear here (GPT-2's same-named Conv1D is not)
+        "w_in": ("transformer.h.{i}.mlp.c_fc.weight", _t),
+        "b_in": ("transformer.h.{i}.mlp.c_fc.bias", None),
+        "w_down": ("transformer.h.{i}.mlp.c_proj.weight", _t),
+        "b_down": ("transformer.h.{i}.mlp.c_proj.bias", None),
+    },
+    tie_embeddings=True,
+)
+
+DISTILBERT = ArchPolicy(
+    name="distilbert",
+    top={
+        "embed": ("embeddings.word_embeddings.weight", None),
+        "pos_embed": ("embeddings.position_embeddings.weight", None),
+        "embed_norm_scale": ("embeddings.LayerNorm.weight", None),
+        "embed_norm_bias": ("embeddings.LayerNorm.bias", None),
+    },
+    layer={
+        "wq": ("transformer.layer.{i}.attention.q_lin.weight", _t),
+        "bq": ("transformer.layer.{i}.attention.q_lin.bias", None),
+        "wk": ("transformer.layer.{i}.attention.k_lin.weight", _t),
+        "bk": ("transformer.layer.{i}.attention.k_lin.bias", None),
+        "wv": ("transformer.layer.{i}.attention.v_lin.weight", _t),
+        "bv": ("transformer.layer.{i}.attention.v_lin.bias", None),
+        "wo": ("transformer.layer.{i}.attention.out_lin.weight", _t),
+        "bo": ("transformer.layer.{i}.attention.out_lin.bias", None),
+        # post-LN encoder: sa_layer_norm / output_layer_norm are the
+        # POST-sublayer norms (same block shape as BERT)
+        "attn_norm_scale": ("transformer.layer.{i}.sa_layer_norm.weight", None),
+        "attn_norm_bias": ("transformer.layer.{i}.sa_layer_norm.bias", None),
+        "w_in": ("transformer.layer.{i}.ffn.lin1.weight", _t),
+        "b_in": ("transformer.layer.{i}.ffn.lin1.bias", None),
+        "w_down": ("transformer.layer.{i}.ffn.lin2.weight", _t),
+        "b_down": ("transformer.layer.{i}.ffn.lin2.bias", None),
+        "mlp_norm_scale": (
+            "transformer.layer.{i}.output_layer_norm.weight", None),
+        "mlp_norm_bias": ("transformer.layer.{i}.output_layer_norm.bias", None),
+    },
+    tie_embeddings=True,
+)
+
+CLIP = ArchPolicy(
+    name="clip",
+    top={
+        "embed": ("text_model.embeddings.token_embedding.weight", None),
+        "pos_embed": ("text_model.embeddings.position_embedding.weight", None),
+        "final_norm_scale": ("text_model.final_layer_norm.weight", None),
+        "final_norm_bias": ("text_model.final_layer_norm.bias", None),
+    },
+    layer={
+        "attn_norm_scale": (
+            "text_model.encoder.layers.{i}.layer_norm1.weight", None),
+        "attn_norm_bias": (
+            "text_model.encoder.layers.{i}.layer_norm1.bias", None),
+        "wq": ("text_model.encoder.layers.{i}.self_attn.q_proj.weight", _t),
+        "bq": ("text_model.encoder.layers.{i}.self_attn.q_proj.bias", None),
+        "wk": ("text_model.encoder.layers.{i}.self_attn.k_proj.weight", _t),
+        "bk": ("text_model.encoder.layers.{i}.self_attn.k_proj.bias", None),
+        "wv": ("text_model.encoder.layers.{i}.self_attn.v_proj.weight", _t),
+        "bv": ("text_model.encoder.layers.{i}.self_attn.v_proj.bias", None),
+        "wo": ("text_model.encoder.layers.{i}.self_attn.out_proj.weight", _t),
+        "bo": ("text_model.encoder.layers.{i}.self_attn.out_proj.bias", None),
+        "mlp_norm_scale": (
+            "text_model.encoder.layers.{i}.layer_norm2.weight", None),
+        "mlp_norm_bias": (
+            "text_model.encoder.layers.{i}.layer_norm2.bias", None),
+        "w_in": ("text_model.encoder.layers.{i}.mlp.fc1.weight", _t),
+        "b_in": ("text_model.encoder.layers.{i}.mlp.fc1.bias", None),
+        "w_down": ("text_model.encoder.layers.{i}.mlp.fc2.weight", _t),
+        "b_down": ("text_model.encoder.layers.{i}.mlp.fc2.bias", None),
+    },
+    tie_embeddings=True,
+)
+
+# Megatron-LM GPT naming (reference module_inject/containers/megatron_gpt.py
+# targets ParallelTransformerLayer; runtime/state_dict_factory.py
+# MegatronSDLoader reads exactly these templates).  QKV fuses per head
+# [H*3*hd, d] like NeoX — same split.
+MEGATRON_GPT = ArchPolicy(
+    name="megatron_gpt",
+    top={
+        "embed": ("word_embeddings.weight", None),
+        "pos_embed": ("position_embeddings.weight", None),
+        "final_norm_scale": ("transformer.final_layernorm.weight", None),
+        "final_norm_bias": ("transformer.final_layernorm.bias", None),
+    },
+    layer={
+        "attn_norm_scale": ("transformer.layers.{i}.input_layernorm.weight", None),
+        "attn_norm_bias": ("transformer.layers.{i}.input_layernorm.bias", None),
+        "wo": ("transformer.layers.{i}.attention.dense.weight", _t),
+        "bo": ("transformer.layers.{i}.attention.dense.bias", None),
+        "mlp_norm_scale": (
+            "transformer.layers.{i}.post_attention_layernorm.weight", None),
+        "mlp_norm_bias": (
+            "transformer.layers.{i}.post_attention_layernorm.bias", None),
+        "w_in": ("transformer.layers.{i}.mlp.dense_h_to_4h.weight", _t),
+        "b_in": ("transformer.layers.{i}.mlp.dense_h_to_4h.bias", None),
+        "w_down": ("transformer.layers.{i}.mlp.dense_4h_to_h.weight", _t),
+        "b_down": ("transformer.layers.{i}.mlp.dense_4h_to_h.bias", None),
+    },
+    fused_qkv="transformer.layers.{i}.attention.query_key_value.weight",
+    fused_qkv_bias="transformer.layers.{i}.attention.query_key_value.bias",
+    tie_embeddings=True,
+)
+
+# Megatron-DeepSpeed MoE (reference containers/megatron_gpt_moe.py): every
+# layer's MLP is an expert bank behind a TopKGate; expert Linears keep their
+# biases (the native MoE layer carries [E, ...] bias slots for this).
+MEGATRON_GPT_MOE = dataclasses.replace(
+    MEGATRON_GPT,
+    name="megatron_gpt_moe",
+    layer={k: v for k, v in MEGATRON_GPT.layer.items()
+           if k not in ("w_in", "b_in", "w_down", "b_down")},
+    moe_router=("transformer.layers.{i}.mlp.deepspeed_moe.gate.wg.weight", _t),
+    moe_experts={
+        "w_in": ("transformer.layers.{i}.mlp.deepspeed_moe.experts."
+                 "deepspeed_experts.{e}.dense_h_to_4h.weight", _t),
+        "b_in": ("transformer.layers.{i}.mlp.deepspeed_moe.experts."
+                 "deepspeed_experts.{e}.dense_h_to_4h.bias", None),
+        "w_down": ("transformer.layers.{i}.mlp.deepspeed_moe.experts."
+                   "deepspeed_experts.{e}.dense_4h_to_h.weight", _t),
+        "b_down": ("transformer.layers.{i}.mlp.deepspeed_moe.experts."
+                   "deepspeed_experts.{e}.dense_4h_to_h.bias", None),
+    },
+)
+
 POLICIES: Dict[str, ArchPolicy] = {"llama": LLAMA, "gpt2": GPT2, "opt": OPT,
                                    "mistral": LLAMA, "gptj": GPTJ,
                                    "gpt_neox": NEOX, "bloom": BLOOM,
-                                   "bert": BERT}
+                                   "bert": BERT, "gpt_neo": GPTNEO,
+                                   "distilbert": DISTILBERT, "clip": CLIP,
+                                   "megatron_gpt": MEGATRON_GPT,
+                                   "megatron_gpt_moe": MEGATRON_GPT_MOE}
 
 
 def detect_arch(hf_config) -> str:
@@ -251,6 +421,10 @@ def detect_arch(hf_config) -> str:
     ``replace_policy`` auto-selection by module class)."""
     mt = getattr(hf_config, "model_type", None) or (
         hf_config.get("model_type") if isinstance(hf_config, dict) else None)
+    if mt in ("clip_text_model", "clip"):    # CLIPTextModel / full CLIPModel
+        return "clip"
+    if mt in ("megatron-gpt", "megatron_gpt2", "megatron-gpt2"):
+        return "megatron_gpt"
     if mt in POLICIES:
         return mt
     raise NotImplementedError(

@@ -235,7 +235,8 @@ def make_classifier(policy: ArchPolicy, cfg: TransformerConfig,
     rules: List[Tuple[Any, str, Optional[int]]] = []
 
     def to_regex(tmpl: str):
-        return re.compile("^" + re.escape(tmpl).replace(r"\{i\}", r"\d+") + "$")
+        return re.compile("^" + re.escape(tmpl).replace(r"\{i\}", r"\d+")
+                          .replace(r"\{e\}", r"\d+") + "$")
 
     for native, (hf_name, tf) in policy.top.items():
         spec = specs.get(native)
@@ -246,14 +247,32 @@ def make_classifier(policy: ArchPolicy, cfg: TransformerConfig,
                       else "replicated", axis))
     layer_specs = specs.get("layers", {})
     for native, (tmpl, tf) in policy.layer.items():
+        if tmpl is None:     # zero-filled slot — no on-disk tensor to match
+            continue
         spec = layer_specs.get(native)
         axis = _native_tp_axis(spec, True) if spec is not None else None
         if axis is not None and tf is _t and len(tuple(spec)) - 1 == 2:
             axis = 1 - axis
         rules.append((to_regex(tmpl), "split" if axis is not None
                       else "replicated", axis))
+    if policy.moe_router is not None:
+        rules.append((to_regex(policy.moe_router[0]), "replicated", None))
+        for native, (etmpl, etf) in (policy.moe_experts or {}).items():
+            spec = layer_specs.get(native)
+            # per-expert on-disk tensor: drop the [L] and [E] leading dims
+            entries = tuple(spec)[2:] if spec is not None else ()
+            axis = next(
+                (i for i, e in enumerate(entries)
+                 if "model" in (e if isinstance(e, (tuple, list)) else (e,))),
+                None)
+            if axis is not None and etf is _t and len(entries) == 2:
+                axis = 1 - axis
+            rules.append((to_regex(etmpl), "split" if axis is not None
+                          else "replicated", axis))
+
     if policy.fused_qkv is not None:
-        if policy.name in ("gpt_neox", "bloom"):
+        if policy.name in ("gpt_neox", "bloom", "megatron_gpt",
+                           "megatron_gpt_moe"):
             # per-head fused [H*3*hd, d]: heads are outermost, a contiguous
             # axis-0 split keeps each head's q/k/v together (Megatron layout)
             kinds = [(to_regex(policy.fused_qkv), "split", 0)]
@@ -284,7 +303,7 @@ def make_classifier(policy: ArchPolicy, cfg: TransformerConfig,
 # ---------------------------------------------------------------------------
 
 def _arch_prefixes(arch: str) -> Tuple[str, ...]:
-    return ("bert.",) if arch == "bert" else ()
+    return {"bert": ("bert.",), "distilbert": ("distilbert.",)}.get(arch, ())
 
 
 def open_checkpoint_source(path: str, policy: ArchPolicy,
@@ -384,6 +403,10 @@ def _leaf_builders(policy: ArchPolicy, cfg: TransformerConfig, arch: str,
             return np.stack(parts)
         return build
 
+    def zeros_builder(idx, shape):
+        return np.zeros(tuple(s.stop - s.start
+                              for s in _normalize(idx, shape)), host_dtype)
+
     attn_bias_keys = ("bq", "bk", "bv", "bo")
     mlp_bias_keys = ("b_in", "b_gate", "b_up", "b_down")
     for native, (tmpl, tf) in policy.layer.items():
@@ -391,10 +414,30 @@ def _leaf_builders(policy: ArchPolicy, cfg: TransformerConfig, arch: str,
             continue
         if native in mlp_bias_keys and not cfg.mlp_bias:
             continue
+        if tmpl is None:   # zero-filled slot (e.g. GPT-Neo's q/k/v biases)
+            builders[("layers", native)] = zeros_builder
+            continue
         builders[("layers", native)] = layer_builder(
             (lambda t, f: (lambda i: f(source.get(t.format(i=i)))
                            if f is not None
                            else source.get(t.format(i=i))))(tmpl, tf))
+
+    if policy.moe_router is not None:
+        E = int(cfg.num_experts)
+        rtmpl, rtf = policy.moe_router
+        builders[("layers", "router")] = layer_builder(
+            lambda i: rtf(source.get(rtmpl.format(i=i))) if rtf is not None
+            else source.get(rtmpl.format(i=i)))
+        for native, (etmpl, etf) in (policy.moe_experts or {}).items():
+            if native in mlp_bias_keys and not cfg.mlp_bias:
+                continue
+
+            def fetch_expert_stack(i, _t=etmpl, _f=etf):
+                es = [_f(source.get(_t.format(i=i, e=e))) if _f is not None
+                      else source.get(_t.format(i=i, e=e)) for e in range(E)]
+                return np.stack(es)          # [E, ...] per layer
+
+            builders[("layers", native)] = layer_builder(fetch_expert_stack)
 
     if policy.fused_qkv is not None:
         for part_idx, names in ((0, ("wq", "wk", "wv")),

@@ -151,13 +151,19 @@ def moe_ffn_nodrop(x: jnp.ndarray, router_w: jnp.ndarray,
     group_sizes = jnp.bincount(flat_expert, length=E).astype(jnp.int32)
 
     w = lambda n: expert_params[n].astype(x.dtype)  # noqa: E731
+    row_expert = flat_expert[order]                              # [T*k]
     if activation == "swiglu":
         g = jax.lax.ragged_dot(xs, w("w_gate"), group_sizes)
         u = jax.lax.ragged_dot(xs, w("w_up"), group_sizes)
         h = jax.nn.silu(g) * u
     else:
-        h = jax.nn.gelu(jax.lax.ragged_dot(xs, w("w_in"), group_sizes))
+        h = jax.lax.ragged_dot(xs, w("w_in"), group_sizes)
+        if "b_in" in expert_params:   # per-expert bias (Megatron-DS experts)
+            h = h + w("b_in")[row_expert]
+        h = jax.nn.gelu(h)
     out = jax.lax.ragged_dot(h, w("w_down"), group_sizes)        # [T*k, D]
+    if "b_down" in expert_params and activation != "swiglu":
+        out = out + w("b_down")[row_expert]
     out = out * vals.reshape(T * k)[order][:, None].astype(x.dtype)
     y = jnp.zeros((T, D), out.dtype).at[token_of].add(out)
     return y.reshape(B, S, D), aux.astype(jnp.float32)
@@ -219,10 +225,16 @@ def moe_ffn(x: jnp.ndarray, router_w: jnp.ndarray, expert_params: Dict[str, Any]
                        expert_params["w_up"].astype(x.dtype))
         h = jax.nn.silu(g) * u
     else:
-        h = jax.nn.gelu(jnp.einsum("gecd,edf->gecf", expert_in,
-                                   expert_params["w_in"].astype(x.dtype)))
+        h = jnp.einsum("gecd,edf->gecf", expert_in,
+                       expert_params["w_in"].astype(x.dtype))
+        if "b_in" in expert_params:   # per-expert bias [E, F]
+            h = h + expert_params["b_in"].astype(x.dtype)[None, :, None, :]
+        h = jax.nn.gelu(h)
     expert_out = jnp.einsum("gecf,efd->gecd", h,
                             expert_params["w_down"].astype(x.dtype))
+    if "b_down" in expert_params and activation != "swiglu":
+        expert_out = expert_out + \
+            expert_params["b_down"].astype(x.dtype)[None, :, None, :]
     expert_out = constrain_spec(expert_out, P(DATA_AXES, "expert", None, None))
 
     out = jnp.einsum("gsec,gecd->gsd", combine.astype(x.dtype), expert_out)
