@@ -1,0 +1,97 @@
+"""Async checkpoint engine with commit semantics (reference
+``runtime/checkpoint_engine/nebula_checkpoint_engine.py``).
+
+Nebula's contract: ``save()`` returns once the state is snapshotted to a
+fast tier and the persistent write proceeds in the background; ``latest``
+becomes visible only when the tag is *committed* (durable), so a crash
+mid-write can never leave ``latest`` pointing at a torn checkpoint.
+
+The TPU-native implementation rides orbax's AsyncCheckpointer: ``save()``
+blocks only for the device→host snapshot (the part that must happen before
+training mutates the arrays — Nebula's tier-0 copy), then storage I/O runs
+on orbax's background thread.  A finalize thread per tag waits for
+durability and only then writes ``latest`` — the commit barrier.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Optional
+
+from .checkpoint_engine import CheckpointEngine
+from .orbax_engine import LATEST_FILE, OrbaxCheckpointEngine
+from ...utils.logging import log_dist, logger
+
+
+class AsyncOrbaxCheckpointEngine(CheckpointEngine):
+    """Keep ONE instance alive across saves — the async checkpointer owns a
+    background thread and serializes overlapping saves itself."""
+
+    def __init__(self, config_params=None, timeout_secs: int = 600):
+        super().__init__(config_params)
+        import orbax.checkpoint as ocp
+
+        self._ckptr = ocp.AsyncCheckpointer(
+            ocp.StandardCheckpointHandler(), timeout_secs=timeout_secs)
+        self._sync = OrbaxCheckpointEngine()
+
+    def save(self, state_dict: Any, path: str) -> None:
+        """Returns after the device→host snapshot; the write is async."""
+        import orbax.checkpoint as ocp
+
+        self._ckptr.save(os.path.abspath(path),
+                         args=ocp.args.StandardSave(state_dict), force=True)
+
+    def load(self, path: str, target: Any = None, shardings: Any = None) -> Any:
+        self.wait()   # never read through an in-flight write
+        return self._sync.load(path, target=target, shardings=shardings)
+
+    def commit(self, tag: str) -> bool:
+        self.wait()
+        return True
+
+    def wait(self) -> None:
+        self._ckptr.wait_until_finished()
+
+    def close(self) -> None:
+        self._ckptr.close()
+
+
+def async_save_engine_checkpoint(engine, save_dir: str, ckpt_dir: str,
+                                 tag: str, save_latest: bool) -> None:
+    """Launch the commit finalizer: wait for durability, then (and only
+    then) publish ``latest``.  Stores the thread on the engine so
+    ``wait_for_checkpoint()`` / the next load can join it."""
+    ce: AsyncOrbaxCheckpointEngine = engine._async_ckpt_engine
+
+    def finalize():
+        try:
+            ce.commit(tag)
+        except Exception as e:   # surface on wait; never publish latest
+            engine._async_ckpt_error = e
+            logger.error(f"async checkpoint {tag} failed: {e}")
+            return
+        import jax
+
+        if save_latest and jax.process_index() == 0:
+            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+                f.write(str(tag))
+        log_dist(f"committed async checkpoint {tag} -> {ckpt_dir}", ranks=[0])
+
+    t = threading.Thread(target=finalize, name=f"ckpt-commit-{tag}",
+                         daemon=True)
+    engine._pending_ckpt_thread = t
+    t.start()
+
+
+def wait_for_pending_checkpoint(engine) -> None:
+    """Join the in-flight async save, re-raising its failure if any."""
+    t: Optional[threading.Thread] = getattr(engine, "_pending_ckpt_thread",
+                                            None)
+    if t is not None:
+        t.join()
+        engine._pending_ckpt_thread = None
+    err = getattr(engine, "_async_ckpt_error", None)
+    if err is not None:
+        engine._async_ckpt_error = None
+        raise RuntimeError("async checkpoint save failed") from err

@@ -74,7 +74,20 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     ckpt_dir = os.path.join(save_dir, str(tag))
     os.makedirs(ckpt_dir, exist_ok=True)
 
-    ce = OrbaxCheckpointEngine()
+    async_save = bool(getattr(engine.config, "checkpoint_config", None)
+                      and engine.config.checkpoint_config.async_save)
+    if async_save:
+        from .async_engine import (AsyncOrbaxCheckpointEngine,
+                                   wait_for_pending_checkpoint)
+
+        # serialize against a still-pending previous save (orbax would queue
+        # it anyway; joining keeps the latest-file ordering deterministic)
+        wait_for_pending_checkpoint(engine)
+        if getattr(engine, "_async_ckpt_engine", None) is None:
+            engine._async_ckpt_engine = AsyncOrbaxCheckpointEngine()
+        ce: Any = engine._async_ckpt_engine
+    else:
+        ce = OrbaxCheckpointEngine()
     if engine.state is not None:
         ce.save(engine.state, os.path.join(ckpt_dir, "state"))
 
@@ -114,15 +127,31 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
             json.dump(meta, f, indent=2)
         with open(os.path.join(ckpt_dir, "ds_config.json"), "w") as f:
             json.dump(engine.config.to_dict(), f, indent=2, default=str)
-        if save_latest:
-            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-                f.write(str(tag))
+    if async_save:
+        # commit semantics: `latest` is published by the finalizer thread
+        # only once the background write is durable — the caller returns
+        # now, having paid only the device->host snapshot
+        from .async_engine import async_save_engine_checkpoint
+
+        async_save_engine_checkpoint(engine, save_dir, ckpt_dir, str(tag),
+                                     save_latest)
+        log_dist(f"async checkpoint {tag} snapshotted; committing in "
+                 f"background -> {ckpt_dir}", ranks=[0])
+        return ckpt_dir
+    if save_latest and jax.process_index() == 0:
+        with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+            f.write(str(tag))
     log_dist(f"saved checkpoint {tag} -> {ckpt_dir}", ranks=[0])
     return ckpt_dir
 
 
 def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                            load_optimizer_states: bool = True, load_module_only: bool = False):
+    if getattr(engine, "_pending_ckpt_thread", None) is not None:
+        # never read through an in-flight async save
+        from .async_engine import wait_for_pending_checkpoint
+
+        wait_for_pending_checkpoint(engine)
     tag = tag or _read_latest(load_dir)
     if tag is None:
         logger.warning(f"no `latest` file in {load_dir}; nothing loaded")

@@ -237,6 +237,10 @@ class CheckpointConfig(DeepSpeedConfigModel):
     load_universal: bool = False
     use_node_local_storage: bool = False
     parallel_write_pipeline: bool = False
+    # Nebula-analogue async tiered save (reference nebula_checkpoint_engine):
+    # save_checkpoint returns after the device->host snapshot; the storage
+    # write runs in the background and `latest` is published only on commit
+    async_save: bool = False
 
 
 class DataTypeConfig(DeepSpeedConfigModel):

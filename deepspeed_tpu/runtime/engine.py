@@ -1318,6 +1318,14 @@ class DeepSpeedEngine:
         return save_engine_checkpoint(self, save_dir, tag=tag, client_state=client_state,
                                       save_latest=save_latest)
 
+    def wait_for_checkpoint(self):
+        """Block until an in-flight async save (checkpoint.async_save) is
+        durable and `latest` is published; re-raises a failed save.  No-op
+        for synchronous saves (reference Nebula commit barrier)."""
+        from .checkpoint_engine.async_engine import wait_for_pending_checkpoint
+
+        wait_for_pending_checkpoint(self)
+
     def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
                         load_lr_scheduler_states=True, load_module_only=False):
         from .checkpoint_engine.orbax_engine import load_engine_checkpoint

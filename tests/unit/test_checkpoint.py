@@ -144,3 +144,55 @@ def test_moe_expert_cross_ep_restore(tmp_path):
     shard = experts[0].sharding.shard_shape(experts[0].shape)
     assert shard[1] == experts[0].shape[1] // 4
     mesh_mod.reset_mesh()
+
+
+def test_async_save_overlaps_and_resumes_bit_exact(tmp_path):
+    """Nebula-analogue async engine (checkpoint.async_save): save returns
+    after the device->host snapshot, training continues and MUTATES state
+    while the write is in flight, `latest` appears only on commit, and the
+    restore is bit-exact to the state AT SAVE TIME (snapshot isolation)."""
+    cfg = make_config(batch_size=16, stage=0)
+    cfg["checkpoint"] = {"async_save": True}
+    e1, _, _, _ = deepspeed_tpu.initialize(model=SimpleModel(HID), config=cfg)
+    for s in range(2):
+        e1.train_batch(batch=random_batch(16, HID, seed=s))
+    snap = _params_flat(e1)
+    e1.save_checkpoint(str(tmp_path))            # returns pre-durability
+    # overlap: two more steps mutate the live state while the write runs
+    for s in range(2, 4):
+        e1.train_batch(batch=random_batch(16, HID, seed=s))
+    assert not np.array_equal(_params_flat(e1), snap)
+    e1.wait_for_checkpoint()                     # commit barrier
+    assert (tmp_path / "latest").read_text() == "global_step2"
+
+    e2, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(HID), config=make_config(batch_size=16, stage=0))
+    e2.load_checkpoint(str(tmp_path))
+    np.testing.assert_array_equal(_params_flat(e2), snap)   # bit-exact
+    assert e2.global_steps == 2
+
+
+def test_async_save_load_without_explicit_wait(tmp_path):
+    """load_checkpoint must serialize against an in-flight async save on
+    its own — no torn reads if the user never calls wait_for_checkpoint."""
+    cfg = make_config(batch_size=16, stage=0)
+    cfg["checkpoint"] = {"async_save": True}
+    e1, _, _, _ = deepspeed_tpu.initialize(model=SimpleModel(HID), config=cfg)
+    e1.train_batch(batch=random_batch(16, HID, seed=0))
+    e1.save_checkpoint(str(tmp_path))
+    snap = _params_flat(e1)
+    e1.load_checkpoint(str(tmp_path))            # waits internally
+    np.testing.assert_array_equal(_params_flat(e1), snap)
+
+
+def test_async_back_to_back_saves_keep_latest_ordered(tmp_path):
+    cfg = make_config(batch_size=16, stage=0)
+    cfg["checkpoint"] = {"async_save": True}
+    e, _, _, _ = deepspeed_tpu.initialize(model=SimpleModel(HID), config=cfg)
+    e.train_batch(batch=random_batch(16, HID, seed=0))
+    e.save_checkpoint(str(tmp_path), tag="A")
+    e.train_batch(batch=random_batch(16, HID, seed=1))
+    e.save_checkpoint(str(tmp_path), tag="B")    # joins A first
+    e.wait_for_checkpoint()
+    assert (tmp_path / "latest").read_text() == "B"
+    assert (tmp_path / "A").is_dir() and (tmp_path / "B").is_dir()
