@@ -28,6 +28,7 @@ from typing import Any, Callable, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray, jnp.ndarray], Tuple[jnp.ndarray, jnp.ndarray]],
@@ -83,11 +84,17 @@ def pipeline_1f1b(stage_fn: Callable, head_fn: Callable, stage_params: Any,
     Why not AD of the GPipe scan (``pipeline_apply``): AD must finish the
     whole forward before the first backward step, so every one of the
     ``M+P-1`` saved carries is live at once — activation stash grows with M.
-    Here backward for microbatch m starts P-p ticks after its forward at
-    stage p, so the stash is a fixed ring of ``2P`` entries per stage:
-    **activation memory is O(P²·mb·S·D), independent of M** — the 1F1B
-    memory contract that lets M (and with it the bubble term (P-1)/(M+P-1))
-    grow freely.
+    Here stage p's activation for microbatch m lives exactly
+    ``2(P-p)-1`` ticks (fwd at tick p+m, cotangent arrives at 2P-1-p+m —
+    forced by the immediate cot chaining, the lockstep analogue of the
+    reference's ``num_pipe_buffers = P-p`` in-flight bound,
+    schedule.py:247).  The stash is therefore a per-stage-sized ring packed
+    into ONE flat buffer of ``sum_p 2(P-p)-1 = P²`` entries:
+    **activation memory is exactly P²·mb·S·D, independent of M** — the
+    1F1B memory contract that lets M (and with it the bubble term
+    (P-1)/(M+P-1)) grow freely.  (A uniform 2P ring per stage — 2P²
+    total — was the r3 allocation; the packed rings halve it to the
+    schedule's true lower bound.)
 
     Timing (lockstep SPMD): ``M + 2P - 1`` ticks, each tick = one stage
     forward + one stage backward everywhere (≈3 fwd-units).  GPipe-via-AD
@@ -104,7 +111,18 @@ def pipeline_1f1b(stage_fn: Callable, head_fn: Callable, stage_params: Any,
     """
     P_ = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
     M = x_micro.shape[0]
-    K = 2 * P_                       # stash ring: lifetime(m,p) = 2(P-p)-1 < K
+    # per-stage ring sizes: stage p's activation lives 2(P-p)-1 ticks; the
+    # rings pack contiguously into one flat [P²] buffer (global slot =
+    # offset_p + m mod K_p; ranges are disjoint so the scatter is safe).
+    # Under a pipe-sharded mesh GSPMD splits dim 0 evenly (P²/pp per shard
+    # — the memory halving vs the old uniform 2P ring holds per-device);
+    # rings straddle shard boundaries, so some tick gathers cross shards —
+    # ~one state-sized transfer, same order as the roll's ppermute
+    ring_np = 2 * (P_ - np.arange(P_)) - 1                   # [P] K_p
+    ring_k = jnp.asarray(ring_np, jnp.int32)
+    ring_off = jnp.asarray(
+        np.concatenate([[0], np.cumsum(ring_np)[:-1]]), jnp.int32)
+    stash_total = int(ring_np.sum())                         # = P²
     T = M + 2 * P_ - 1
     mb_shape = x_micro.shape[1:]
 
@@ -140,10 +158,8 @@ def pipeline_1f1b(stage_fn: Callable, head_fn: Callable, stage_params: Any,
         # ---- backward half: bwd(m_b, p) at tick 2P-1-p+m_b ----
         m_b = t - (2 * P_ - 1 - sid)                        # [P]
         bwd_valid = (m_b >= 0) & (m_b < M)
-        slot_b = jnp.remainder(m_b, K)
-        x_stash = jax.vmap(
-            lambda s, i: jax.lax.dynamic_index_in_dim(s, i, 0, False)
-        )(stash, slot_b)                                     # [P, mb, S, D]
+        slot_b = ring_off + jnp.remainder(jnp.maximum(m_b, 0), ring_k)
+        x_stash = stash[slot_b]                              # [P, mb, S, D]
         rngs_b = jax.vmap(
             lambda m, p: jax.random.fold_in(jax.random.fold_in(rng, m), p)
         )(jnp.maximum(m_b, 0), sid)
@@ -156,12 +172,9 @@ def pipeline_1f1b(stage_fn: Callable, head_fn: Callable, stage_params: Any,
         state = state.at[0].set(x_in)
         m_f = t - sid
         fwd_valid = (m_f >= 0) & (m_f < M)
-        slot_f = jnp.remainder(jnp.maximum(m_f, 0), K)
-        stash = jax.vmap(
-            lambda s, x, i, v: jax.lax.cond(
-                v, lambda: jax.lax.dynamic_update_index_in_dim(s, x, i, 0),
-                lambda: s)
-        )(stash, state, slot_f, fwd_valid)
+        slot_f = ring_off + jnp.remainder(jnp.maximum(m_f, 0), ring_k)
+        keep = fwd_valid.reshape((P_,) + (1,) * len(mb_shape))
+        stash = stash.at[slot_f].set(jnp.where(keep, state, stash[slot_f]))
         rngs_f = jax.vmap(
             lambda m, p: jax.random.fold_in(jax.random.fold_in(rng, m), p)
         )(jnp.maximum(m_f, 0), sid)
@@ -186,7 +199,7 @@ def pipeline_1f1b(stage_fn: Callable, head_fn: Callable, stage_params: Any,
 
     state0 = jnp.zeros((P_,) + mb_shape, x_micro.dtype)
     cot0 = jnp.zeros((P_,) + mb_shape, x_micro.dtype)
-    stash0 = jnp.zeros((P_, K) + mb_shape, x_micro.dtype)
+    stash0 = jnp.zeros((stash_total,) + mb_shape, x_micro.dtype)
     dstage0 = jax.tree_util.tree_map(
         lambda x: jnp.zeros(x.shape, jnp.float32), stage_params)
     dhead0 = jax.tree_util.tree_map(
