@@ -93,7 +93,8 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
 
     offload = (getattr(engine, "_offload", None)
                or getattr(engine, "_param_offload", None))
-    if offload is not None and jax.process_index() == 0:
+    if offload is not None and (jax.process_index() == 0
+                                or getattr(offload, "_multi", False)):
         # Host-stepped offload (ZeRO-Offload host RAM / ZeRO-Infinity NVMe):
         # the fp32 masters + Adam moments live OUTSIDE the TrainState, so
         # they ride alongside the orbax tree, streamed one leaf at a time

@@ -28,6 +28,7 @@ arithmetic intensity per byte streamed).
 from __future__ import annotations
 
 import math
+import os
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -46,6 +47,42 @@ def _bf16():
     import ml_dtypes
 
     return ml_dtypes.bfloat16
+
+
+def _idx_key(idx, shape) -> str:
+    """Normalized hashable key for a device index (tuple of slices)."""
+    return ",".join(f"{s.indices(d)[0]}:{s.indices(d)[1]}"
+                    for s, d in zip(idx, shape))
+
+
+def _norm_slices(idx, shape):
+    return tuple(slice(*s.indices(d)[:2]) for s, d in zip(idx, shape))
+
+
+def _leaf_shards(mesh, spec, shape, multi: bool):
+    """Per-process shard descriptors for one leaf.
+
+    Returns ``{idx_key: (suffix, slices)}`` plus ``{suffix: weight}`` where
+    weight = 1 / (#processes holding that shard) — the grad-norm correction
+    so globally-summed squared norms count each distinct shard once.
+    Single-process collapses to ONE full-leaf shard with suffix '' (the
+    legacy file layout, byte-identical behavior)."""
+    if not multi:
+        full = tuple(slice(0, d) for d in shape)
+        return {_idx_key(full, shape): ("", full)}, {"": 1.0}
+    sharding = NamedSharding(mesh, spec)
+    holders: Dict[str, set] = {}
+    for dev, idx in sharding.devices_indices_map(shape).items():
+        holders.setdefault(_idx_key(idx, shape), set()).add(dev.process_index)
+    local = {}
+    for dev, idx in sharding.addressable_devices_indices_map(shape).items():
+        local.setdefault(_idx_key(idx, shape), idx)
+    info, weights = {}, {}
+    for n, (key, idx) in enumerate(sorted(local.items())):
+        sfx = f".s{n}"
+        info[key] = (sfx, _norm_slices(idx, shape))
+        weights[sfx] = 1.0 / len(holders[key])
+    return info, weights
 
 
 class InfinityParamEngine:
@@ -90,10 +127,12 @@ class InfinityParamEngine:
             raise NotImplementedError(
                 "offload_param trains causal LMs (encoder models have no "
                 "next-token loss for the layer-streamed executor)")
-        if jax.process_count() > 1:
-            raise NotImplementedError(
-                "offload_param is single-process for now (multi-host would "
-                "need per-host shard files)")
+        # Multi-host: per-host shard files — each process stores ONLY the
+        # unique addressable shards of every leaf (the reference swapper is
+        # per-rank by the same construction,
+        # partitioned_param_swapper.py:36), so host RAM/NVMe per process
+        # scales down with the process count for sharded leaves
+        self._multi = jax.process_count() > 1
         opt_cfg = config.optimizer
         opt_type = (opt_cfg.type if opt_cfg else "adamw").lower()
         if opt_type not in ("adam", "adamw"):
@@ -117,8 +156,14 @@ class InfinityParamEngine:
             eps=p.get("eps", 1e-8), weight_decay=p.get("weight_decay", 0.0),
             adamw_mode=bool(p.get("adam_w_mode", opt_type == "adamw")))
         zc = config.zero_config.offload_param
+        nvme_path = zc.nvme_path
+        if self._multi:
+            # shard files are process-local; a shared filesystem must not
+            # collide across hosts
+            nvme_path = os.path.join(nvme_path,
+                                     f"proc{jax.process_index()}")
         self.swapper = TensorSwapper(
-            zc.nvme_path, aio_threads=max(config.aio.thread_count, 1))
+            nvme_path, aio_threads=max(config.aio.thread_count, 1))
 
         self._init_param_store(config.seed)
         self._build_programs()
@@ -168,55 +213,119 @@ class InfinityParamEngine:
                 "know where they belong")
         self._flat_specs = {k: specs[k] for k in
                             self.stem_keys + self.head_keys}
+        self._flat_shapes = {k: params[k].shape
+                             for k in self.stem_keys + self.head_keys}
 
         self.param_count = sum(
             int(np.prod(l.shape))
             for l in jax.tree_util.tree_leaves(params))
 
+        # per-leaf shard descriptors (single-process: one '' full shard)
+        self._flat_shards: Dict[str, Dict] = {}
+        self._shard_weight: Dict[str, float] = {}
+        for k in self.stem_keys + self.head_keys:
+            info, w = _leaf_shards(self.mesh, self._flat_specs[k],
+                                   self._flat_shapes[k], self._multi)
+            self._flat_shards[k] = info
+            for sfx, wt in w.items():
+                self._shard_weight[f"{k}{sfx}"] = wt
+        self._layer_shards: Dict[str, Dict] = {}
+        self._layer_shard_weight: Dict[str, float] = {}
+        for k in self.layer_keys:
+            info, w = _leaf_shards(self.mesh, self._layer_specs[k],
+                                   self._layer_shapes[k], self._multi)
+            self._layer_shards[k] = info
+            self._layer_shard_weight[k] = w
+        for i in range(L):
+            for k in self.layer_keys:
+                for sfx, wt in self._layer_shard_weight[k].items():
+                    self._shard_weight[f"layers.{i}.{k}{sfx}"] = wt
+
         bf16 = _bf16()
-        # write every leaf: fp32 master + zero moments + bf16 param
-        def put(name, arr32):
-            self.swapper.write(f"{name}.master", arr32)
-            z = np.zeros_like(arr32)
-            self.swapper.write(f"{name}.exp_avg", z)
-            self.swapper.write(f"{name}.exp_avg_sq", z)
-            self.swapper.write(f"{name}.param", arr32.astype(bf16))
+        # write every SHARD: fp32 master + zero moments + bf16 param
+        def put(name, arr32, shards):
+            for sfx, slices in shards.values():
+                piece = np.ascontiguousarray(arr32[slices])
+                self.swapper.write(f"{name}{sfx}.master", piece)
+                z = np.zeros_like(piece)
+                self.swapper.write(f"{name}{sfx}.exp_avg", z)
+                self.swapper.write(f"{name}{sfx}.exp_avg_sq", z)
+                self.swapper.write(f"{name}{sfx}.param", piece.astype(bf16))
+                self._leaf_names.append(f"{name}{sfx}")
 
         self._leaf_names: List[str] = []
         for k in self.stem_keys + self.head_keys:
-            put(k, params[k])
-            self._leaf_names.append(k)
+            put(k, params[k], self._flat_shards[k])
         for i in range(L):
             for k in self.layer_keys:
-                name = f"layers.{i}.{k}"
-                put(name, np.ascontiguousarray(params["layers"][k][i]))
-                self._leaf_names.append(name)
+                put(f"layers.{i}.{k}",
+                    np.ascontiguousarray(params["layers"][k][i]),
+                    self._layer_shards[k])
 
         # stem + head are touched every microbatch (the reference's
         # persistence-threshold behavior): resident bf16 device copies
-        self._stem_dev = {k: self._put_flat(k, params[k].astype(bf16))
-                          for k in self.stem_keys}
-        self._head_dev = {k: self._put_flat(k, params[k].astype(bf16))
-                          for k in self.head_keys}
+        self._stem_dev = {k: self._put_flat(k) for k in self.stem_keys}
+        self._head_dev = {k: self._put_flat(k) for k in self.head_keys}
 
         # double-buffered pinned host buffers for the layer stream
+        # (keyed per shard; single-process = one '' shard per leaf)
+        def shard_shape(k, slices):
+            return tuple(s.stop - s.start for s in slices)
+
         self._layer_bufs = [
-            {k: np.empty(self._layer_shapes[k], bf16) for k in self.layer_keys}
+            {(k, sfx): np.empty(shard_shape(k, slices), bf16)
+             for k in self.layer_keys
+             for sfx, slices in self._layer_shards[k].values()}
             for _ in range(2)]
         # host fp32 gradient accumulators (allocated lazily per window)
         self._host_grads: Optional[Dict[str, np.ndarray]] = None
 
-    def _put_flat(self, key, arr):
-        return jax.device_put(
-            arr, NamedSharding(self.mesh, self._flat_specs[key]))
+    def _put_flat(self, key, arr=None):
+        """Global stem/head array from the process-local shard files.
+        ``arr`` (single-process fast path) skips the NVMe re-read."""
+        sharding = NamedSharding(self.mesh, self._flat_specs[key])
+        if not self._multi:
+            if arr is None:
+                arr = self.swapper.read(f"{key}.param")
+            return jax.device_put(arr, sharding)
+        shape = self._flat_shapes[key]
+        info = self._flat_shards[key]
+        cache: Dict[str, np.ndarray] = {}
+
+        def cb(idx):
+            sfx = info[_idx_key(idx, shape)][0]
+            if sfx not in cache:
+                cache[sfx] = self.swapper.read(f"{key}{sfx}.param")
+            return cache[sfx]
+
+        return jax.make_array_from_callback(shape, sharding, cb)
 
     def _put_layer(self, bufs):
         # .copy(): device_put from numpy can be zero-copy on the CPU backend,
         # and these double-buffered read buffers are refilled by the next
         # aio submit — the device array must own its memory
-        return {k: jax.device_put(
-            bufs[k].copy(), NamedSharding(self.mesh, self._layer_specs[k]))
-            for k in self.layer_keys}
+        if not self._multi:
+            return {k: jax.device_put(
+                bufs[(k, "")].copy(),
+                NamedSharding(self.mesh, self._layer_specs[k]))
+                for k in self.layer_keys}
+        out = {}
+        for k in self.layer_keys:
+            shape = self._layer_shapes[k]
+            info = self._layer_shards[k]
+            sharding = NamedSharding(self.mesh, self._layer_specs[k])
+            cache: Dict[str, np.ndarray] = {}   # one copy per unique shard
+            # (make_array_from_callback calls the cb per DEVICE; partially
+            # replicated local shards would otherwise copy N_local times)
+
+            def cb(idx, _i=info, _s=shape, _k=k, _c=cache):
+                sfx = _i[_idx_key(idx, _s)][0]
+                if sfx not in _c:
+                    _c[sfx] = bufs[(_k, sfx)].copy()
+                return _c[sfx]
+
+            out[k] = jax.make_array_from_callback(shape, sharding, cb)
+        return out
 
     # ------------------------------------------------------------------
     # The five jitted programs (each compiled once; layer programs are
@@ -309,8 +418,10 @@ class InfinityParamEngine:
     # ------------------------------------------------------------------
     def _submit_layer(self, i: int, slot: int):
         bufs = self._layer_bufs[slot]
-        return [self.swapper.submit_read(f"layers.{i}.{k}.param", out=bufs[k])
-                for k in self.layer_keys], slot
+        return [self.swapper.submit_read(f"layers.{i}.{k}{sfx}.param",
+                                         out=bufs[(k, sfx)])
+                for k in self.layer_keys
+                for sfx, _ in self._layer_shards[k].values()], slot
 
     def _collect_layer(self, pending):
         handles, slot = pending
@@ -322,15 +433,37 @@ class InfinityParamEngine:
     # Train step
     # ------------------------------------------------------------------
     def _accum(self, name: str, g) -> None:
-        with jax.transfer_guard("allow"):
-            arr = np.asarray(g, np.float32)
         if self._host_grads is None:
             self._host_grads = {}
-        buf = self._host_grads.get(name)
+        if self._multi:
+            # pull only the process-local unique shards of the global grad
+            if name.startswith("layers."):
+                leaf_key = name.split(".", 2)[2]
+                info = self._layer_shards[leaf_key]
+            else:
+                info = self._flat_shards[name]
+            shape = g.shape
+            seen = set()
+            for sh in g.addressable_shards:
+                key = _idx_key(sh.index, shape)
+                sfx = info[key][0]
+                if sfx in seen:
+                    continue          # replicated across local devices
+                seen.add(sfx)
+                with jax.transfer_guard("allow"):
+                    arr = np.asarray(sh.data, np.float32)
+                self._accum_host(f"{name}{sfx}", arr)
+            return
+        with jax.transfer_guard("allow"):
+            arr = np.asarray(g, np.float32)
+        self._accum_host(name, arr)
+
+    def _accum_host(self, key: str, arr: np.ndarray) -> None:
+        buf = self._host_grads.get(key)
         if buf is None:
             # np.asarray of a jax.Array is a read-only zero-copy view; the
             # accumulator mutates in place, so it must own writable memory
-            self._host_grads[name] = np.array(arr, np.float32, order="C")
+            self._host_grads[key] = np.array(arr, np.float32, order="C")
         else:
             buf += arr
 
@@ -364,8 +497,23 @@ class InfinityParamEngine:
                 [tokens[:, 1:], jnp.full_like(tokens[:, :1], -100)], axis=1)
         return tokens, labels
 
+    def _to_global(self, arr):
+        """Multi-host: every process feeds the same host batch; build the
+        dp-sharded global array from it.  Arrays that are already jax global
+        arrays (the engine's _shard_batch path) pass through — np.asarray on
+        a non-addressable array would throw."""
+        if not self._multi or isinstance(arr, jax.Array):
+            return arr
+        a = np.asarray(arr)
+        sharding = NamedSharding(self.mesh,
+                                 P(BATCH_AXES, *([None] * (a.ndim - 1))))
+        return jax.make_array_from_callback(a.shape, sharding,
+                                            lambda idx: a[idx])
+
     def _micro_fwd_bwd(self, tokens, labels, rng):
         L = self.num_layers
+        tokens = self._to_global(tokens)
+        labels = self._to_global(labels)
         keys = jax.random.split(rng, L)
         x, xs, last_lp = self._stream_forward(tokens, keys, self._layer_fwd,
                                               keep=True)
@@ -408,6 +556,8 @@ class InfinityParamEngine:
         """Forward-only layer-streamed evaluation: deterministic blocks
         (dropout off), loss-only head (no vjp), no activations kept."""
         tokens, labels = self._tokens_labels(batch)
+        tokens = self._to_global(tokens)
+        labels = self._to_global(labels)
         keys = jax.random.split(jax.random.PRNGKey(self.config.seed),
                                 self.num_layers)
         x, _, _ = self._stream_forward(tokens, keys, self._layer_fwd_det,
@@ -462,9 +612,18 @@ class InfinityParamEngine:
         assert grads is not None, "train window produced no gradients"
         inv_gas = 1.0 / self.gas
         sq = 0.0
-        for g in grads.values():
+        for name, g in grads.items():
             g *= inv_gas
-            sq += float(np.vdot(g, g))
+            # weight corrects for shards held by several processes (weight
+            # 1/#holders; single-process weights are all 1.0) so the global
+            # sum counts each distinct shard exactly once
+            sq += self._shard_weight.get(name, 1.0) * float(np.vdot(g, g))
+        if self._multi:
+            # every process must clip with the SAME global norm
+            from jax.experimental import multihost_utils
+
+            sq = float(np.sum(multihost_utils.process_allgather(
+                np.float64(sq))))
         gnorm = math.sqrt(sq)
         factor = 1.0
         if self.clip and self.clip > 0 and gnorm > self.clip:
@@ -493,6 +652,13 @@ class InfinityParamEngine:
                 self._stem_dev[name] = self._put_flat(name, new16)
             elif name in self._head_dev:
                 self._head_dev[name] = self._put_flat(name, new16)
+        if self._multi:
+            # shard-named leaves: rebuild the global stem/head arrays from
+            # the updated shard files once, after all shards stepped
+            for k in self.stem_keys:
+                self._stem_dev[k] = self._put_flat(k)
+            for k in self.head_keys:
+                self._head_dev[k] = self._put_flat(k)
         self._host_grads = None
         return gnorm
 
@@ -520,10 +686,15 @@ class InfinityParamEngine:
         elif name in self._head_dev:
             self._head_dev[name] = self._put_flat(name, new16)
 
+    def _ckpt_dir(self, base: str) -> str:
+        """Multi-host shard state is process-local — one subdir per host."""
+        return (os.path.join(base, f"proc{jax.process_index()}")
+                if self._multi else base)
+
     def save_state_files(self, out_dir: str) -> None:
         from ..offload import save_offload_state_files
 
-        save_offload_state_files(out_dir, self._leaf_names,
+        save_offload_state_files(self._ckpt_dir(out_dir), self._leaf_names,
                                  self._read_leaf_state, self.step_count)
 
     def load_state_files(self, in_dir: str) -> None:
@@ -532,8 +703,13 @@ class InfinityParamEngine:
         shapes = {n: self.swapper._shapes[f"{n}.master"]
                   for n in self._leaf_names}
         self.step_count = load_offload_state_files(
-            in_dir, self._leaf_names, self._write_leaf_state,
+            self._ckpt_dir(in_dir), self._leaf_names, self._write_leaf_state,
             expected_shapes=shapes)
+        if self._multi:
+            for k in self.stem_keys:
+                self._stem_dev[k] = self._put_flat(k)
+            for k in self.head_keys:
+                self._head_dev[k] = self._put_flat(k)
 
     def read_masters(self) -> Dict[str, np.ndarray]:
         return {n: self.swapper.read(f"{n}.master")

@@ -165,3 +165,89 @@ def test_param_offload_rejects_moe(tmp_path):
     model = CausalLM("tiny-moe", max_seq_len=SEQ * 2)
     with pytest.raises(NotImplementedError, match="MoE"):
         deepspeed_tpu.initialize(model=model, config=_config(tmp_path))
+
+
+_MULTIHOST_SCRIPT = r"""
+import json, os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+sys.path.insert(0, REPO)
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import deepspeed_tpu
+from deepspeed_tpu.models import CausalLM
+
+deepspeed_tpu.init_distributed()          # COORDINATOR_ADDRESS env rendezvous
+rank = jax.process_index()
+model = CausalLM("tiny", max_seq_len=64)
+config = {
+    "train_micro_batch_size_per_gpu": 1,
+    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+    "zero_optimization": {"stage": 3,
+                          "offload_param": {"device": "nvme",
+                                            "nvme_path": NVME}},
+    "bf16": {"enabled": True},
+}
+engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+off = engine._param_offload
+assert off._multi, "multi-host mode not engaged"
+losses = []
+for s in range(3):
+    rng = np.random.default_rng(s)
+    batch = {"input_ids": rng.integers(
+        0, model.config.vocab_size,
+        (engine.train_batch_size, 32)).astype(np.int32)}
+    losses.append(float(engine.train_batch(batch=batch)))
+with open(os.path.join(OUT, f"losses.{rank}.json"), "w") as f:
+    json.dump(losses, f)
+"""
+
+
+@pytest.mark.slow
+def test_param_offload_multihost_simulate(tmp_path):
+    """VERDICT r3 item 5: offload_param on the launcher's --simulate
+    2-process rendezvous — per-host shard files, identical loss trajectory
+    across processes AND vs the single-process run."""
+    import json
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    script = tmp_path / "train_mh.py"
+    nvme = tmp_path / "params_mh"
+    script.write_text(
+        f"REPO = {repo!r}\nNVME = {str(nvme)!r}\nOUT = {str(tmp_path)!r}\n"
+        + _MULTIHOST_SCRIPT)
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    out = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher", "--simulate", "2",
+         "--master_port", "29517", str(script)],
+        capture_output=True, text=True, cwd=repo, timeout=900, env=env)
+    assert out.returncode == 0, (out.stdout[-1500:], out.stderr[-3000:])
+    l0 = json.loads((tmp_path / "losses.0.json").read_text())
+    l1 = json.loads((tmp_path / "losses.1.json").read_text())
+    np.testing.assert_allclose(l0, l1, rtol=1e-6)   # replica consistency
+    assert (nvme / "proc0").is_dir() and (nvme / "proc1").is_dir()
+
+    # single-process ground truth, same batches/config (global batch 8 =
+    # 2 procs x 4 devices x mb 1 -> single: 8 devices x mb 1)
+    model = CausalLM("tiny", max_seq_len=64)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 3,
+                                  "offload_param": {
+                                      "device": "nvme",
+                                      "nvme_path": str(tmp_path / "p1")}},
+            "bf16": {"enabled": True}})
+    ref = []
+    for s in range(3):
+        rng = np.random.default_rng(s)
+        batch = {"input_ids": rng.integers(
+            0, model.config.vocab_size,
+            (engine.train_batch_size, 32)).astype(np.int32)}
+        ref.append(float(engine.train_batch(batch=batch)))
+    np.testing.assert_allclose(l0, ref, rtol=2e-2, atol=2e-2)
