@@ -18,7 +18,8 @@ _BOOT = ("import jax, runpy, sys, os; "
      "--prompt_len", "16", "--new_tokens", "4"],
     ["examples/rlhf.py", "--model", "tiny", "--iters", "1",
      "--new_tokens", "4"],
-], ids=["train", "generate", "rlhf"])
+    ["examples/stable_diffusion.py", "--steps", "3", "--size", "8"],
+], ids=["train", "generate", "rlhf", "stable_diffusion"])
 def test_example_runs(cmd):
     repo = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
