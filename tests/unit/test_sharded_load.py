@@ -89,18 +89,23 @@ def tiny_ckpt(tmp_path):
     return _write_sharded_ckpt(tmp_path, TINY_LLAMA, sd, n_shards=2), sd
 
 
-def test_sharded_load_matches_dense(tiny_ckpt):
-    path, sd = tiny_ckpt
-    cfg, params = load_hf_checkpoint_sharded(path)
-    cfg_ref = config_from_hf(TINY_LLAMA)
-    dense = hf_state_dict_to_params(sd, cfg_ref, "llama")
-    flat_s = jax.tree_util.tree_leaves_with_path(params)
+def _assert_trees_equal(streamed, dense, tag=""):
+    flat_s = jax.tree_util.tree_leaves_with_path(streamed)
     flat_d = {jax.tree_util.keystr(p): np.asarray(x)
               for p, x in jax.tree_util.tree_leaves_with_path(dense)}
     assert len(flat_s) == len(flat_d)
     for p, x in flat_s:
         np.testing.assert_array_equal(np.asarray(x),
-                                      flat_d[jax.tree_util.keystr(p)], p)
+                                      flat_d[jax.tree_util.keystr(p)],
+                                      err_msg=f"{tag}:{p}")
+
+
+def test_sharded_load_matches_dense(tiny_ckpt):
+    path, sd = tiny_ckpt
+    cfg, params = load_hf_checkpoint_sharded(path)
+    cfg_ref = config_from_hf(TINY_LLAMA)
+    dense = hf_state_dict_to_params(sd, cfg_ref, "llama")
+    _assert_trees_equal(params, dense)
 
 
 def test_sharded_load_onto_tp_mesh_logit_parity(tiny_ckpt):
@@ -229,6 +234,76 @@ def test_init_inference_with_checkpoint_json(tiny_ckpt, tmp_path):
     logits = np.asarray(engine(jnp.asarray(tokens)))
     assert logits.shape == (8, 8, TINY_LLAMA["vocab_size"])
     assert np.isfinite(logits).all()
+
+
+# ---------------------------------------------------------------------------
+# Policy x loader matrix: EVERY HF-instantiable arch streams through the
+# sharded loader identically to the dense state-dict path — covers fused-qkv
+# splitting (gpt2 cols, neox/bloom per-head), export prefixes (bert/
+# distilbert), zero-filled slots (gpt_neo q/k/v biases), and optional-bias
+# handling under shard-file mmap reads.
+# ---------------------------------------------------------------------------
+
+def _tiny_hf(arch):
+    transformers = pytest.importorskip("transformers")
+    torch = pytest.importorskip("torch")
+    torch.manual_seed(0)
+    common = dict(vocab_size=96, max_position_embeddings=64)
+    if arch == "gpt2":
+        m = transformers.GPT2LMHeadModel(transformers.GPT2Config(
+            vocab_size=96, n_embd=32, n_layer=2, n_head=4, n_positions=64))
+    elif arch == "gpt_neox":
+        m = transformers.GPTNeoXForCausalLM(transformers.GPTNeoXConfig(
+            hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+            intermediate_size=64, **common))
+    elif arch == "bloom":
+        m = transformers.BloomForCausalLM(transformers.BloomConfig(
+            vocab_size=96, hidden_size=32, n_layer=2, n_head=4))
+    elif arch == "gptj":
+        m = transformers.GPTJForCausalLM(transformers.GPTJConfig(
+            vocab_size=96, n_embd=32, n_layer=2, n_head=4, n_positions=64,
+            rotary_dim=8))
+    elif arch == "opt":
+        m = transformers.OPTForCausalLM(transformers.OPTConfig(
+            hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+            ffn_dim=64, word_embed_proj_dim=32, **common))
+    elif arch == "gpt_neo":
+        m = transformers.GPTNeoForCausalLM(transformers.GPTNeoConfig(
+            vocab_size=96, hidden_size=32, num_layers=2, num_heads=4,
+            intermediate_size=64, max_position_embeddings=64,
+            attention_types=[[["global", "local"], 1]], window_size=8))
+    elif arch == "bert":
+        m = transformers.BertForMaskedLM(transformers.BertConfig(
+            vocab_size=96, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=64,
+            max_position_embeddings=64))      # "bert." export prefix
+    elif arch == "distilbert":
+        m = transformers.DistilBertForMaskedLM(transformers.DistilBertConfig(
+            vocab_size=96, dim=32, n_layers=2, n_heads=4, hidden_dim=64,
+            max_position_embeddings=64))      # "distilbert." prefix
+    elif arch == "clip":
+        m = transformers.CLIPTextModel(transformers.CLIPTextConfig(
+            vocab_size=96, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=32))
+    else:
+        raise KeyError(arch)
+    return m.config, {k: v.detach().float().numpy()
+                      for k, v in m.state_dict().items()
+                      if v.dtype.is_floating_point}
+
+
+@pytest.mark.parametrize("arch", ["gpt2", "gpt_neox", "bloom", "gptj", "opt",
+                                  "gpt_neo", "bert", "distilbert", "clip"])
+def test_policy_matrix_sharded_equals_dense(arch, tmp_path):
+    hf_cfg, sd = _tiny_hf(arch)
+    cfg_dict = hf_cfg.to_dict()
+    path = _write_sharded_ckpt(tmp_path, cfg_dict, sd, n_shards=3)
+    cfg, streamed = load_hf_checkpoint_sharded(path)
+    from deepspeed_tpu.module_inject import detect_arch
+
+    dense = hf_state_dict_to_params(sd, cfg, detect_arch(cfg_dict))
+    _assert_trees_equal(streamed, dense, tag=arch)
 
 
 _RSS_SCRIPT = r"""
