@@ -102,10 +102,12 @@ class InfinityParamEngine:
         cfg = model.config
         from ...models.transformer import has_moe
 
-        if has_moe(cfg):
+        if isinstance(cfg.num_experts, (tuple, list)):
             raise NotImplementedError(
-                "offload_param with MoE layers is not supported (expert "
-                "params are expert-sharded, not layer-streamed)")
+                "offload_param with a PR-MoE pyramid (per-layer expert "
+                "counts) is not supported: the layer stream needs uniform "
+                "layer files")
+        self._moe = has_moe(cfg)
         if cfg.pipeline_stages > 1:
             raise NotImplementedError(
                 "offload_param composes with pipeline_stages=1 (a pipelined "
@@ -358,13 +360,22 @@ class InfinityParamEngine:
                           stem.get("embed_norm_bias"))
             return constrain_spec(x, act_spec)
 
+        moe = self._moe
+        # single source: the SAME value is jit-baked into layer_bwd's aux
+        # cotangent and read by the loss reporting in _micro_fwd_bwd /
+        # eval_batch — they must never disagree
+        self._aux_coef = aux_coef = cfg.moe_aux_loss_coef
+
         def layer_body(lp, x, rng, deterministic=False):
             B, S, _ = x.shape
             pos = jnp.broadcast_to(
                 jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
-            y, _aux = _block(cfg, lp, x, pos, rng, attn_impl,
-                             deterministic=deterministic)
-            return constrain_spec(y, act_spec)
+            y, aux = _block(cfg, lp, x, pos, rng, attn_impl,
+                            deterministic=deterministic)
+            y = constrain_spec(y, act_spec)
+            # MoE: the load-balancing aux is part of the loss, so it must be
+            # a layer OUTPUT for the vjp to route router gradients
+            return (y, aux) if moe else y
 
         def head_body(head, stem, x, labels):
             if "final_norm_scale" in head:
@@ -399,10 +410,18 @@ class InfinityParamEngine:
 
         self._head_vjp = jax.jit(head_vjp)
 
-        def layer_bwd(lp, x, rng, dy):
-            y, vjp = jax.vjp(lambda l, xi: layer_body(l, xi, rng), lp, x)
-            dlp, dx = vjp(dy)
-            return f32(dlp), dx
+        if moe:
+            def layer_bwd(lp, x, rng, dy):
+                (y, aux), vjp = jax.vjp(
+                    lambda l, xi: layer_body(l, xi, rng), lp, x)
+                # d(ce + coef*sum_l aux_l)/d(layer l) — aux cotangent = coef
+                dlp, dx = vjp((dy, jnp.asarray(aux_coef, aux.dtype)))
+                return f32(dlp), dx
+        else:
+            def layer_bwd(lp, x, rng, dy):
+                y, vjp = jax.vjp(lambda l, xi: layer_body(l, xi, rng), lp, x)
+                dlp, dx = vjp(dy)
+                return f32(dlp), dx
 
         self._layer_bwd = jax.jit(layer_bwd)
 
@@ -470,20 +489,26 @@ class InfinityParamEngine:
     def _stream_forward(self, tokens, keys, layer_fwd, keep: bool):
         """Prefetch-pipelined forward over all layers.  ``keep`` retains the
         boundary activations (training) — eval discards them.  Returns
-        ``(x_final, xs_or_None, last_layer_params)``."""
+        ``(x_final, xs_or_None, last_layer_params, moe_aux_sum)``."""
         x = self._stem_fwd(self._stem_dev, tokens)
         xs = [x] if keep else None
         pending = self._submit_layer(0, 0)
         lp = None
+        aux_sum = jnp.float32(0.0)
         for i in range(self.num_layers):
             nxt = (self._submit_layer(i + 1, (i + 1) % 2)
                    if i + 1 < self.num_layers else None)
             lp = self._collect_layer(pending)
-            x = layer_fwd(lp, x, keys[i])
+            out = layer_fwd(lp, x, keys[i])
+            if self._moe:
+                x, aux = out
+                aux_sum = aux_sum + aux
+            else:
+                x = out
             if keep:
                 xs.append(x)
             pending = nxt
-        return x, xs, lp
+        return x, xs, lp, aux_sum
 
     @staticmethod
     def _tokens_labels(batch):
@@ -515,11 +540,15 @@ class InfinityParamEngine:
         tokens = self._to_global(tokens)
         labels = self._to_global(labels)
         keys = jax.random.split(rng, L)
-        x, xs, last_lp = self._stream_forward(tokens, keys, self._layer_fwd,
-                                              keep=True)
+        x, xs, last_lp, aux_sum = self._stream_forward(
+            tokens, keys, self._layer_fwd, keep=True)
 
         loss, dhead, dstem_h, dx = self._head_vjp(
             self._head_dev, self._stem_dev, xs[L], labels)
+        if self._moe:
+            # reported loss matches the fused engine: ce + coef*sum(aux);
+            # the aux GRADIENT flows via the layer vjp's aux cotangent
+            loss = loss + self._aux_coef * aux_sum
         for k, g in dhead.items():
             self._accum(k, g)
         for k, g in dstem_h.items():
@@ -560,9 +589,11 @@ class InfinityParamEngine:
         labels = self._to_global(labels)
         keys = jax.random.split(jax.random.PRNGKey(self.config.seed),
                                 self.num_layers)
-        x, _, _ = self._stream_forward(tokens, keys, self._layer_fwd_det,
-                                       keep=False)
+        x, _, _, aux_sum = self._stream_forward(
+            tokens, keys, self._layer_fwd_det, keep=False)
         loss = self._head_fwd(self._head_dev, self._stem_dev, x, labels)
+        if self._moe:
+            loss = loss + self._aux_coef * aux_sum
         with jax.transfer_guard("allow"):
             return float(np.asarray(loss))
 

@@ -60,10 +60,12 @@ def test_llama7b_train_step_compiles_and_fits_hbm():
     compiled = lowered.compile()
     mem = compiled.memory_analysis()
 
-    arg = getattr(mem, "argument_size_in_bytes", 0)
-    tmp = getattr(mem, "temp_size_in_bytes", 0)
-    out = getattr(mem, "output_size_in_bytes", 0)
-    alias = getattr(mem, "alias_size_in_bytes", 0)
+    # direct attribute access: a renamed/dropped stats field must FAIL the
+    # cert loudly, not silently zero the component the budget bounds
+    arg = mem.argument_size_in_bytes
+    tmp = mem.temp_size_in_bytes
+    out = mem.output_size_in_bytes
+    alias = mem.alias_size_in_bytes
     total = arg + tmp + out - alias
     # params are ~6.7B bf16: full tree 13.5 GB, 1/tp shard ~3.4 GB; grads
     # the same again; activations under full remat are boundary-only
