@@ -213,3 +213,39 @@ def test_moe_expert_parallel_matches_unsharded():
     with mesh:
         out = jax.jit(lambda p, t: forward(cfg, p, t))(sharded, tokens)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+
+def test_expert_biases_capacity_and_nodrop_agree():
+    """Per-expert biases (Megatron-DS experts, gelu path) must act as true
+    per-expert Linear biases on BOTH dispatch paths: with ample capacity the
+    capacity-buffer einsum path and the ragged no-drop path compute the same
+    function."""
+    r = np.random.default_rng(0)
+    D, F, E, B, S = 16, 32, 4, 2, 8
+    x = jnp.asarray(r.standard_normal((B, S, D)).astype(np.float32) * 0.3)
+    router = jnp.asarray(r.standard_normal((D, E)).astype(np.float32) * 0.3)
+    params = {
+        "w_in": jnp.asarray(r.standard_normal((E, D, F)) * 0.2, jnp.float32),
+        "b_in": jnp.asarray(r.standard_normal((E, F)) * 0.5, jnp.float32),
+        "w_down": jnp.asarray(r.standard_normal((E, F, D)) * 0.2, jnp.float32),
+        "b_down": jnp.asarray(r.standard_normal((E, D)) * 0.5, jnp.float32),
+    }
+    # deterministic=True draws eval_capacity_factor — set BOTH so the
+    # no-drop precondition (capacity >= T=8 per group) holds by factor too
+    cap = MoEConfig(num_experts=E, top_k=1, capacity_factor=8.0,
+                    eval_capacity_factor=8.0, min_capacity=64)
+    y_cap, _ = moe_ffn(x, router, params, cap, activation="gelu",
+                       deterministic=True)
+    nd = MoEConfig(num_experts=E, top_k=1, drop_tokens=False)
+    y_nd, _ = moe_ffn(x, router, params, nd, activation="gelu",
+                      deterministic=True)
+    # tolerance matches test_no_drop_matches_uncapped_capacity_path: the
+    # einsum vs ragged_dot accumulation differs under TPU matmul precision
+    np.testing.assert_allclose(np.asarray(y_cap), np.asarray(y_nd),
+                               rtol=5e-4, atol=5e-5)
+    # biases actually matter: zeroing them changes the output
+    zeroed = dict(params, b_in=jnp.zeros_like(params["b_in"]),
+                  b_down=jnp.zeros_like(params["b_down"]))
+    y_zero, _ = moe_ffn(x, router, zeroed, nd, activation="gelu",
+                        deterministic=True)
+    assert not np.allclose(np.asarray(y_nd), np.asarray(y_zero))
