@@ -121,3 +121,27 @@ def test_train_loss_decreases_with_engine():
     for _ in range(10):
         last = float(engine.train_batch(batch={"input_ids": data}))
     assert last < first * 0.9, (first, last)
+
+
+def test_windowed_attention_trains_through_scan():
+    """GPT-Neo-style per-layer window alternation must survive the TRAIN
+    path — the window rides the layer scan as a traced scalar through
+    remat + grad (the parity tests only cover forward/cached)."""
+    import deepspeed_tpu
+
+    model = CausalLM("tiny", dtype=jnp.float32,
+                     attention_layers=("global", "local"), window_size=4,
+                     attn_softmax_scale=1.0, remat=True)
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 1},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, model.config.vocab_size,
+                        (engine.train_batch_size, 32)).astype(np.int32)
+    first = float(engine.train_batch(batch={"input_ids": data}))
+    for _ in range(8):
+        last = float(engine.train_batch(batch={"input_ids": data}))
+    assert np.isfinite(last) and last < first * 0.9, (first, last)
