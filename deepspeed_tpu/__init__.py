@@ -64,7 +64,11 @@ def init_inference(model: Any = None, config=None, **kwargs):
                 **{**dict(config or {}),
                    **{k: v for k, v in kwargs.items()
                       if k in DeepSpeedInferenceConfig.model_fields}})
-        dtype = cfg_probe.jnp_dtype
+        # weight quantization loads in COMPUTE precision (the engine
+        # blockwise-quantizes on device; a direct astype(int8) would
+        # truncate) — compute_jnp_dtype folds that rule in
+        dtype = (cfg_probe.compute_jnp_dtype if cfg_probe.weights_quantized
+                 else cfg_probe.jnp_dtype)
         # resolve the mesh BEFORE loading so directory checkpoints stream
         # leaf-by-leaf straight onto their target shards (sharded_load) —
         # the engine then reuses this mesh and its jit cast moves nothing

@@ -61,3 +61,19 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
         return {"bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16, "float16": jnp.float16,
                 "fp16": jnp.float16, "half": jnp.float16, "float32": jnp.float32,
                 "fp32": jnp.float32, "int8": jnp.int8}[str(self.dtype)]
+
+    @property
+    def weights_quantized(self) -> bool:
+        """dtype "int8" means WEIGHT-ONLY quantization (reference
+        ZeRO-Inference ``init_inference(dtype=torch.int8)``), as does the
+        explicit quant block — one property so loader and engine agree."""
+        return bool(self.quant.enabled or str(self.dtype) == "int8")
+
+    @property
+    def compute_jnp_dtype(self):
+        """Activation/dequant dtype: int8 storage computes in bf16; any
+        other configured dtype is honored (quant.enabled + fp32 runs fp32)."""
+        import jax.numpy as jnp
+
+        d = self.jnp_dtype
+        return jnp.bfloat16 if d == jnp.int8 else d

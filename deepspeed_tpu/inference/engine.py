@@ -87,19 +87,66 @@ class InferenceEngine:
                                                          ep=self._config.moe.ep_size))
         self.mesh = mesh
 
+        # Weight-only quantization (reference ZeRO-Inference int8 path:
+        # init_inference(dtype=torch.int8)): weights stored int8/int4 at
+        # rest, dequantized inside the jitted programs at use
+        self._quant = self._config.weights_quantized
+        if self._quant:
+            if tp != 1:
+                raise NotImplementedError(
+                    "quantized inference is single-shard (tp=1) for "
+                    "now: blockwise scales do not carry TP specs")
+            if params is None:
+                raise ValueError(
+                    "weight quantization (dtype int8 / quant.enabled) needs "
+                    "a param tree — a bare apply_fn engine has no weights "
+                    "to quantize")
         if params is not None:
-            dtype = self._config.jnp_dtype
-            specs = auto_tp_specs(params, mesh)
-            shardings = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), specs,
-                                               is_leaf=lambda x: isinstance(x, P))
-            cast = lambda x: x.astype(dtype) if hasattr(x, "dtype") and jnp.issubdtype(  # noqa: E731
-                x.dtype, jnp.floating) else x
-            self.params = jax.jit(lambda p: jax.tree_util.tree_map(cast, p),
-                                  out_shardings=shardings)(params)
+            if self._quant:
+                from .quantization import quantize_params
+
+                bits = self._config.quant.num_bits
+                cdtype = self._config.compute_jnp_dtype
+                # per-leaf quantization: peak device memory stays at the
+                # loaded tree + ONE leaf's quantized copy, not the full
+                # tree twice.  No donation — the caller owns `params`.
+                # (Quantize-during-stream for models whose compute-dtype
+                # form exceeds HBM is future loader work.)
+                qleaf = jax.jit(lambda x: quantize_params(
+                    x, bits=bits, compute_dtype=cdtype))
+                self.params = jax.tree_util.tree_map(qleaf, params)
+            else:
+                dtype = self._config.jnp_dtype
+                specs = auto_tp_specs(params, mesh)
+                shardings = jax.tree_util.tree_map(
+                    lambda s: NamedSharding(mesh, s), specs,
+                    is_leaf=lambda x: isinstance(x, P))
+                cast = lambda x: x.astype(dtype) if hasattr(x, "dtype") and jnp.issubdtype(  # noqa: E731
+                    x.dtype, jnp.floating) else x
+                self.params = jax.jit(lambda p: jax.tree_util.tree_map(cast, p),
+                                      out_shardings=shardings)(params)
         else:
             self.params = None
+        if self._quant:
+            from .quantization import dequantize_params
+
+            inner_apply = self.apply_fn
+            self.apply_fn = lambda p, *a, **k: inner_apply(
+                dequantize_params(p), *a, **k)
+            if self._model is not None and hasattr(self._model, "apply_cached"):
+                # generate()'s decode programs call model.apply_cached —
+                # shim it so the cache loop reads int8 weights every step
+                import copy
+
+                shim = copy.copy(self._model)
+                inner_cached = self._model.apply_cached
+                shim.apply_cached = lambda p, *a, **k: inner_cached(
+                    dequantize_params(p), *a, **k)
+                self._model = shim
         self._forward = jax.jit(self.apply_fn)
-        log_dist(f"inference engine ready: tp={tp} dtype={self._config.dtype}", ranks=[0])
+        log_dist(f"inference engine ready: tp={tp} dtype={self._config.dtype}"
+                 + (f" quant=int{self._config.quant.num_bits}"
+                    if self._quant else ""), ranks=[0])
 
     @property
     def model(self):
@@ -202,6 +249,13 @@ class InferenceEngine:
         original ids with ``max_new_tokens`` generated tokens appended (rows
         that hit ``eos_token_id`` repeat it).
         """
+        if (model is not None and model is not self._model
+                and self._quant and params is None):
+            raise NotImplementedError(
+                "generate(model=...) on a quantized engine needs explicit "
+                "params: self.params is a QuantizedWeight tree the override "
+                "model's apply_cached cannot consume (the engine's own "
+                "model is shimmed to dequantize)")
         model = model or self._model
         if model is None or not hasattr(model, "apply_cached"):
             if attention_mask is not None:

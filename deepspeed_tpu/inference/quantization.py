@@ -1,0 +1,99 @@
+"""Weight-only quantized inference (reference ZeRO-Inference:
+``init_inference(dtype=torch.int8, ...)`` routes through
+``module_inject/replace_module`` weight quantization +
+``docs/_posts/2022-09-10-zero-inference.md`` — weights live int8/int4 at
+rest, dequantized at use).
+
+TPU-native shape: params leaves ≥2D are blockwise-quantized
+(ops/quantizer — the same kernels qwZ uses for training comm) into
+:class:`QuantizedWeight` pytree nodes.  The engine's jitted programs
+dequantize at entry, so XLA fuses the int8 read + scale into the consuming
+matmul where it can: HBM at rest drops ~2x (int8) / ~4x (int4), and the
+decode loop — weight-bandwidth-bound — reads the narrow representation
+every step."""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.quantizer.quantizer import dequantize_blockwise, quantize_blockwise
+
+# leaves smaller than this stay in compute dtype (norm scales, biases —
+# quantizing them saves nothing and costs accuracy)
+MIN_QUANT_SIZE = 4096
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedWeight:
+    """A pytree node holding one blockwise-quantized weight."""
+
+    def __init__(self, q, scale, shape, dtype, bits: int, block: int):
+        self.q = q
+        self.scale = scale
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.bits = int(bits)
+        self.block = int(block)
+
+    def dequantize(self):
+        return dequantize_blockwise(self.q, self.scale, self.shape,
+                                    self.dtype, block=self.block,
+                                    bits=self.bits)
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.q.size * self.q.dtype.itemsize
+                   + self.scale.size * self.scale.dtype.itemsize)
+
+    def tree_flatten(self):
+        return (self.q, self.scale), (self.shape, self.dtype, self.bits,
+                                      self.block)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        q, scale = children
+        shape, dtype, bits, block = aux
+        return cls(q, scale, shape, dtype, bits, block)
+
+
+def _is_qw(x) -> bool:
+    return isinstance(x, QuantizedWeight)
+
+
+def quantize_params(params: Any, bits: int = 8, block: int = 256,
+                    compute_dtype=jnp.bfloat16,
+                    min_size: int = MIN_QUANT_SIZE) -> Any:
+    """Quantize every big floating ≥2D leaf; cast the rest to compute
+    dtype.  Pure function of arrays — call under jit for on-device quant."""
+    def q(leaf):
+        if not hasattr(leaf, "dtype"):
+            return leaf
+        if not jnp.issubdtype(leaf.dtype, jnp.floating):
+            return leaf
+        if leaf.ndim >= 2 and leaf.size >= min_size:
+            qv, s = quantize_blockwise(jnp.asarray(leaf), block=block,
+                                       bits=bits)
+            return QuantizedWeight(qv, s, leaf.shape, compute_dtype, bits,
+                                   block)
+        return jnp.asarray(leaf).astype(compute_dtype)
+
+    return jax.tree_util.tree_map(q, params)
+
+
+def dequantize_params(params: Any) -> Any:
+    """Materialize the compute-dtype tree (inside jit: fused per use)."""
+    return jax.tree_util.tree_map(
+        lambda l: l.dequantize() if _is_qw(l) else l, params, is_leaf=_is_qw)
+
+
+def tree_nbytes(params: Any) -> int:
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(
+            params, is_leaf=_is_qw):
+        if _is_qw(leaf):
+            total += leaf.nbytes
+        elif hasattr(leaf, "nbytes"):
+            total += int(leaf.nbytes)
+    return total

@@ -1,0 +1,125 @@
+"""Weight-only quantized inference (reference ZeRO-Inference int8:
+``init_inference(dtype=torch.int8)``, docs/_posts/2022-09-10-zero-inference.md;
+quantization via the same blockwise kernels as qwZ)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.inference.quantization import (
+    QuantizedWeight, dequantize_params, quantize_params, tree_nbytes)
+from deepspeed_tpu.models import CausalLM
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    model = CausalLM("tiny", dtype=jnp.float32)
+    params = model.init_fn(jax.random.PRNGKey(0))
+    return model, params
+
+
+def test_quantize_roundtrip_error_bounded(tiny):
+    _, params = tiny
+    qp = quantize_params(params, bits=8)
+    # big 2D leaves became QuantizedWeight nodes
+    n_q = sum(isinstance(l, QuantizedWeight)
+              for l in jax.tree_util.tree_leaves(
+                  qp, is_leaf=lambda x: isinstance(x, QuantizedWeight)))
+    assert n_q > 0
+    deq = dequantize_params(qp)
+    for (pa, a), (_, b) in zip(jax.tree_util.tree_leaves_with_path(deq),
+                               jax.tree_util.tree_leaves_with_path(params)):
+        a32 = np.asarray(a, np.float32)
+        b32 = np.asarray(b, np.float32)
+        scale = max(np.abs(b32).max(), 1e-6)
+        assert np.abs(a32 - b32).max() <= scale / 100, pa  # int8: ~1% of amax
+
+
+def test_int8_memory_halves(tiny):
+    _, params = tiny
+    bf16 = jax.tree_util.tree_map(lambda x: x.astype(jnp.bfloat16), params)
+    q8 = quantize_params(params, bits=8)
+    q4 = quantize_params(params, bits=4)
+    assert tree_nbytes(q8) < 0.62 * tree_nbytes(bf16)
+    assert tree_nbytes(q4) < 0.40 * tree_nbytes(bf16)
+
+
+def test_int8_engine_logit_parity(tiny):
+    model, params = tiny
+    ref = deepspeed_tpu.init_inference(model=model, params=params,
+                                       config={"dtype": "float32"})
+    q = deepspeed_tpu.init_inference(model=model, params=params,
+                                     config={"dtype": "int8"})
+    tokens = jnp.asarray(np.random.default_rng(0).integers(
+        0, model.config.vocab_size, (8, 16)).astype(np.int32))
+    l_ref = np.asarray(ref(tokens), np.float32)
+    l_q = np.asarray(q(tokens), np.float32)
+    # quantization noise, not garbage: logits track the fp32 engine
+    denom = np.abs(l_ref).max()
+    assert np.abs(l_q - l_ref).max() / denom < 0.15
+    # and stored weights really are int8 at rest
+    from deepspeed_tpu.inference.quantization import tree_nbytes as nb
+
+    assert nb(q.params) < 0.62 * nb(ref.params) / 2  # ref is fp32: /2 ~ bf16
+
+
+def test_int8_generate_runs(tiny):
+    model, params = tiny
+    q = deepspeed_tpu.init_inference(model=model, params=params,
+                                     config={"dtype": "int8"})
+    prompt = np.random.default_rng(1).integers(
+        0, model.config.vocab_size, (2, 8)).astype(np.int32)
+    out = np.asarray(q.generate(jnp.asarray(prompt), max_new_tokens=6))
+    assert out.shape == (2, 14)
+    assert (out >= 0).all() and (out < model.config.vocab_size).all()
+
+
+def test_quant_config_flag_equivalent(tiny):
+    """quant.enabled with bf16 dtype quantizes too (config-block spelling)."""
+    model, params = tiny
+    q = deepspeed_tpu.init_inference(
+        model=model, params=params,
+        config={"dtype": "bfloat16", "quant": {"enabled": True,
+                                               "num_bits": 4}})
+    assert q._quant
+    leaves = jax.tree_util.tree_leaves(
+        q.params, is_leaf=lambda x: isinstance(x, QuantizedWeight))
+    assert any(isinstance(l, QuantizedWeight) and l.bits == 4
+               for l in leaves)
+
+
+def test_quant_rejects_tp(tiny):
+    model, params = tiny
+    with pytest.raises(NotImplementedError, match="tp=1"):
+        deepspeed_tpu.init_inference(
+            model=model, params=params,
+            config={"dtype": "int8", "tensor_parallel": {"tp_size": 2}})
+
+
+def test_quant_fp32_compute_dtype_honored(tiny):
+    """quant.enabled + dtype fp32 must compute fp32 (only dtype 'int8'
+    implies bf16 compute)."""
+    model, params = tiny
+    q = deepspeed_tpu.init_inference(
+        model=model, params=params,
+        config={"dtype": "float32", "quant": {"enabled": True}})
+    deq = dequantize_params(q.params)
+    assert all(l.dtype == jnp.float32
+               for l in jax.tree_util.tree_leaves(deq))
+
+
+def test_quant_needs_params(tiny):
+    with pytest.raises(ValueError, match="param tree"):
+        deepspeed_tpu.init_inference(
+            apply_fn=lambda p, x: x, config={"dtype": "int8"})
+
+
+def test_quant_generate_model_override_guarded(tiny):
+    model, params = tiny
+    q = deepspeed_tpu.init_inference(model=model, params=params,
+                                     config={"dtype": "int8"})
+    other = CausalLM("tiny", dtype=jnp.float32)
+    with pytest.raises(NotImplementedError, match="explicit params"):
+        q.generate(np.zeros((1, 4), np.int32), max_new_tokens=2, model=other)
