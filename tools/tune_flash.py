@@ -9,8 +9,12 @@ the chip to zero allocation between candidates).
 
 Run on the real chip (VERDICT r2 item 1's ">=105 vs the ~110 roof" push):
 
-    python tools/tune_flash.py                      # default grid @ S=8192
+    python tools/tune_flash.py                      # default grid @ S=16384
+    python tools/tune_flash.py --seq_len 8192 --micro_batch 3   # r3 regime
     python tools/tune_flash.py --bwd 512 1024 2048  # custom bwd tiles
+
+(`tools/artifacts/flash_sweep_r4.jsonl` was recorded at S=8192/mb=3 — pass
+the second form to measure numbers comparable to it.)
 """
 from __future__ import annotations
 
@@ -49,14 +53,23 @@ def main():
     ap.add_argument("--fwd_k", type=int, nargs="+", default=[2048])
     ap.add_argument("--bwd", type=int, nargs="+", default=[512, 1024, 2048])
     ap.add_argument("--steps", type=int, default=12)
-    # must exceed bench.py's own worst case (probe retries + up to three
-    # 3600s-bounded attempts); a timed-out candidate records 0.0, the sweep
-    # continues
+    # A/B comparability: pin the bench config EXPLICITLY so bench.py's
+    # defaulted-run cross-regime OOM fallback can never record one candidate
+    # at a different (seq, mb) than the others — an explicit --seq_len only
+    # ever retries the mb ladder within the same regime
+    ap.add_argument("--seq_len", type=int, default=16384)
+    ap.add_argument("--micro_batch", type=int, default=1)
+    # must exceed bench.py's worst case for the pinned config (probe retries
+    # + the explicit-config mb ladder of 3600s-bounded attempts); a
+    # timed-out candidate records 0.0, the sweep continues
     ap.add_argument("--timeout", type=int, default=3 * 3600 + 1200)
     ap.add_argument("--bench_args", nargs="*", default=[])
     args = ap.parse_args()
 
-    bench_args = ["--steps", str(args.steps)] + list(args.bench_args)
+    bench_args = (["--steps", str(args.steps),
+                   "--seq_len", str(args.seq_len),
+                   "--micro_batch", str(args.micro_batch)]
+                  + list(args.bench_args))
     results = []
     for bq, bk, bb in itertools.product(args.fwd_q, args.fwd_k, args.bwd):
         env = {"DS_TPU_FLASH_BLOCK_Q": bq, "DS_TPU_FLASH_BLOCK_K": bk,
