@@ -215,16 +215,39 @@ class DeepSpeedEngine:
                   if self.config.optimizer else 1e-3)
             self.lr_schedule = constant_lr(lr)
 
+        # -- frozen parameters (reference requires_grad=False semantics:
+        #    excluded from updates, grad norm and clipping; still in params
+        #    + checkpoints).  The functional analogue of torch's per-tensor
+        #    flag: the model exposes ``frozen_spec() -> pytree of bool``
+        #    (True = frozen) matching its param tree.  LoRA
+        #    (runtime/lora.py) remains the memory-optimal freezing route —
+        #    this path keeps the full tree in the optimizer for API parity.
+        frozen_spec = getattr(model, "frozen_spec", None)
+        self._frozen_mask = frozen_spec() if callable(frozen_spec) else frozen_spec
+        if self._frozen_mask is not None and not any(
+                jax.tree_util.tree_leaves(self._frozen_mask)):
+            self._frozen_mask = None    # nothing frozen: skip the masking
+
         # -- optimizer --
         self._compression = None
         if optimizer is not None:
+            if self._frozen_mask is not None:
+                # same contract as engine-built chains: whatever the client
+                # chain emits (including weight decay), frozen leaves get a
+                # zero update; grads are additionally zeroed in apply_update
+                from .optimizer import zero_frozen_updates
+                optimizer = optax.chain(
+                    optimizer, zero_frozen_updates(self._frozen_mask))
+                log_dist("client optimizer wrapped with frozen-parameter "
+                         "masking (model.frozen_spec)", ranks=[0])
             self.optimizer = optimizer
         else:
             opt_cfg = self.config.optimizer
             opt_type = opt_cfg.type if opt_cfg else "adamw"
             opt_params = dict(opt_cfg.params) if opt_cfg else {}
             self.optimizer = create_optimizer(opt_type, opt_params, self.lr_schedule,
-                                              self.config.gradient_clipping)
+                                              self.config.gradient_clipping,
+                                              frozen_mask=self._frozen_mask)
             norm_type = opt_type.lower().replace("_", "")
             if norm_type in ("onebitadam", "onebitlamb", "zerooneadam"):
                 for ax in ("model", "seq", "pipe", "expert"):
@@ -236,6 +259,11 @@ class DeepSpeedEngine:
                 # 0/1 Adam (runtime/comm/zero_one.py): variance freeze +
                 # local-step intervals — a DISTINCT algorithm from the
                 # EF-sign 1-bit path (reference fp16/onebit/zoadam.py)
+                if self._frozen_mask is not None:
+                    raise NotImplementedError(
+                        "model.frozen_spec does not compose with ZeroOneAdam "
+                        "(it owns its whole optimizer state outside the "
+                        "masked optax chain)")
                 if self.zero_stage != 0:
                     raise ValueError(
                         "ZeroOneAdam composes with ZeRO stage 0 only (the "
@@ -303,6 +331,12 @@ class DeepSpeedEngine:
                     "own NVMe path (masters + moments live beside the "
                     "params); a simultaneous offload_optimizer config would "
                     "be silently ignored — remove it")
+            if self._frozen_mask is not None:
+                raise NotImplementedError(
+                    "model.frozen_spec does not compose with offload_param "
+                    "(the layer-streamed host Adam steps every shard); use "
+                    "the LoRA path (runtime/lora.py) to train adapters "
+                    "against NVMe-resident frozen weights")
             self._param_offload = InfinityParamEngine(
                 self.config, model, self.lr_schedule, mesh)
             self._offload = None
@@ -361,6 +395,19 @@ class DeepSpeedEngine:
         else:
             shapes = jax.eval_shape(init_fn, seed_rng)
             init_thunk = init_fn
+        if self._frozen_mask is not None:
+            mask_td = jax.tree_util.tree_structure(self._frozen_mask)
+            shapes_td = jax.tree_util.tree_structure(shapes)
+            if mask_td != shapes_td:
+                raise ValueError(
+                    "model.frozen_spec() structure does not match the param "
+                    f"tree: mask {mask_td} vs params {shapes_td}")
+            n_frozen = sum(
+                int(np.prod(s.shape)) for s, m in zip(
+                    jax.tree_util.tree_leaves(shapes),
+                    jax.tree_util.tree_leaves(self._frozen_mask)) if m)
+            log_dist(f"frozen parameters: {n_frozen:,} excluded from "
+                     "updates/grad-norm (model.frozen_spec)", ranks=[0])
         self.plan: ZeroShardingPlan = plan_sharding(
             shapes, self.zero_stage, mesh, tp_specs=param_specs,
             persistence_threshold=self.config.zero_config.stage3_param_persistence_threshold,
@@ -418,6 +465,13 @@ class DeepSpeedEngine:
             fp16_enabled=self.fp16_enabled,
             has_compression=self._compression_transform is not None)
         if offload_mode in ("host_step", "nvme"):
+            if self._frozen_mask is not None:
+                raise NotImplementedError(
+                    "model.frozen_spec does not compose with optimizer "
+                    "offload yet (the host-stepped executor updates every "
+                    "shard); drop the offload config or use the LoRA path "
+                    "(runtime/lora.py) which keeps frozen weights out of "
+                    "the optimizer entirely")
             self._offload = HostSteppedOffload(
                 self.config, master, self._param_shardings,
                 storage=("cpu" if offload_mode == "host_step" else "nvme"),
@@ -601,11 +655,21 @@ class DeepSpeedEngine:
         prescale = self.config.prescale_gradients
         predivide = self.config.gradient_predivide_factor
 
+        frozen_mask = self._frozen_mask
+
         def apply_update(state: TrainState, masters, opt_in, grads, eff_gas):
             inv = 1.0 / (state.scaler.loss_scale * eff_gas)
             if prescale:
                 inv = inv * predivide
             grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+            if frozen_mask is not None:
+                # frozen params produce no gradient in the reference
+                # (requires_grad=False): zero theirs BEFORE the overflow
+                # check, grad norm and clipping so none of the three sees
+                # them (a frozen layer's inf would otherwise skip the step)
+                grads = jax.tree_util.tree_map(
+                    lambda m, g: jnp.zeros_like(g) if m else g,
+                    frozen_mask, grads)
             finite = grads_finite(grads) if fp16 else jnp.bool_(True)
             grad_norm = optax.global_norm(grads)
             updates, new_opt = optimizer.update(grads, opt_in, masters)

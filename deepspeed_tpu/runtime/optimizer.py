@@ -152,10 +152,30 @@ def _base_transform(name: str, params: Dict[str, Any]) -> optax.GradientTransfor
     raise ValueError(f"unsupported optimizer {name!r}; supported: {SUPPORTED}")
 
 
+def zero_frozen_updates(frozen_mask) -> optax.GradientTransformation:
+    """Final-link masking for frozen parameters (the reference's
+    ``requires_grad=False`` contract: frozen params receive NO update, not
+    even weight decay — ``add_decayed_weights`` earlier in the chain would
+    otherwise still move them).  ``frozen_mask`` is a static pytree of
+    Python bools matching the param tree (True = frozen), so the masking
+    resolves at trace time and frozen leaves cost nothing in the compiled
+    step.  Composition of stock combinators: ``masked`` applies
+    ``set_to_zero`` to exactly the frozen leaves and passes the rest
+    through untouched."""
+    return optax.masked(optax.set_to_zero(), frozen_mask)
+
+
 def create_optimizer(opt_type: str, opt_params: Optional[Dict[str, Any]] = None,
                      lr_schedule: Optional[Callable] = None,
-                     gradient_clipping: float = 0.0) -> optax.GradientTransformation:
-    """Build the full update chain:  clip -> optimizer math -> -lr(step)·update."""
+                     gradient_clipping: float = 0.0,
+                     frozen_mask: Any = None) -> optax.GradientTransformation:
+    """Build the full update chain:  clip -> optimizer math -> -lr(step)·update.
+
+    frozen_mask: optional pytree of bools (True = frozen) shaped like the
+    param tree — frozen leaves get a zero update (the engine additionally
+    zeroes their incoming gradients so clipping/grad-norm exclude them,
+    matching the reference where ``requires_grad=False`` params produce no
+    ``.grad`` at all)."""
     opt_params = dict(opt_params or {})
     lr = opt_params.get("lr", 1e-3)
     chain = []
@@ -166,4 +186,6 @@ def create_optimizer(opt_type: str, opt_params: Optional[Dict[str, Any]] = None,
         chain.append(optax.scale_by_learning_rate(lr_schedule))
     else:
         chain.append(optax.scale_by_learning_rate(lr))
+    if frozen_mask is not None:
+        chain.append(zero_frozen_updates(frozen_mask))
     return optax.chain(*chain)

@@ -67,6 +67,22 @@ class SimpleTPModel(SimpleModel):
         return specs
 
 
+class SimpleFrozenModel(SimpleModel):
+    """First linear layer frozen (reference tests/unit/simple_model.py
+    ``SimpleFrozenModel``: requires_grad=False on one module).  The
+    functional analogue: ``frozen_spec()`` returns a bool pytree (True =
+    frozen) matching the param tree; the engine masks those leaves out of
+    updates, grad norm and clipping."""
+
+    def frozen_spec(self):
+        spec = {f"linear_{i}": {"kernel": i == 0, "bias": i == 0}
+                for i in range(self.nlayers)}
+        spec["head"] = {"kernel": False}
+        if self.empty_grad:
+            spec["unused"] = {"kernel": False}
+        return spec
+
+
 def random_dataset(n: int, hidden_dim: int, seed: int = 0):
     rs = np.random.RandomState(seed)
     xs = rs.randn(n, hidden_dim).astype(np.float32)
