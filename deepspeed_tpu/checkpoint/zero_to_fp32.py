@@ -113,16 +113,9 @@ def load_state_dict_from_zero_checkpoint(params_template: Any,
     flat = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
     leaves_with_paths = jax.tree_util.tree_flatten_with_path(params_template)[0]
 
-    def key_str(path):
-        parts = []
-        for p in path:
-            if hasattr(p, "key"):
-                parts.append(str(p.key))
-            elif hasattr(p, "idx"):
-                parts.append(str(p.idx))
-            else:
-                parts.append(str(p))
-        return "/".join(parts)
+    from ..utils.debug import path_str as key_str  # shared spelling:
+    # matches _flatten's 'a/b/c' naming for dict/list trees and keeps
+    # GetAttrKey handling consistent with checksum_tree/frozen_spec
 
     out = {}
     for path, leaf in leaves_with_paths:

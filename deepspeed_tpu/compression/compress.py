@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 from .prune import (apply_mask, channel_mask, head_mask, row_mask, sparse_mask)
 from .quantize import quantize_ste_scheduled
+from ..utils.debug import path_str as _path_str
 from ..utils.logging import logger
 
 
@@ -80,11 +81,6 @@ def _matches(path: str, patterns: Sequence[str]) -> bool:
     return any(p == "*" or p in path for p in patterns)
 
 
-def _path_str(path) -> str:
-    parts = []
-    for p in path:
-        parts.append(str(getattr(p, "key", getattr(p, "idx", p))))
-    return "/".join(parts)
 
 
 STRUCTURED = ("row_pruning", "head_pruning", "channel_pruning")

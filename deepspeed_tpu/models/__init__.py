@@ -85,6 +85,40 @@ class CausalLM:
 
         return on_device_init(lambda r: init_params(self.config, r))(rng)
 
+    def frozen_spec(self):
+        """Engine frozen-parameter contract (requires_grad=False parity):
+        bool pytree (True = frozen) from ``config.frozen_keywords``, or
+        None when nothing is frozen.  A keyword freezes leaves whose path
+        contains it as an EXACT '/'-separated segment — 'embed' freezes
+        'embed' but not 'pos_embed' (substring matching would silently
+        sweep in the learned position/type embeddings)."""
+        keywords = self.config.frozen_keywords
+        if not keywords:
+            return None
+        if isinstance(keywords, str):   # tuple-vs-string slip: 'embed'
+            keywords = (keywords,)      # must not iterate as characters
+        import jax
+
+        from ..utils.debug import path_str
+
+        shapes = jax.eval_shape(lambda: init_params(self.config,
+                                                    jax.random.PRNGKey(0)))
+
+        def frozen(path, _):
+            name = "/" + path_str(path) + "/"
+            # exact-segment match; a '/'-qualified keyword matches the
+            # contiguous segment run ('layers/wq' freezes layers/wq only)
+            return any("/" + k.strip("/") + "/" in name for k in keywords)
+
+        mask = jax.tree_util.tree_map_with_path(frozen, shapes)
+        if not any(jax.tree_util.tree_leaves(mask)):
+            raise ValueError(
+                f"frozen_keywords {tuple(keywords)} matched no parameter "
+                "path — keywords match exact '/'-separated segments "
+                "('embed', 'wq') or qualified runs ('layers/wq'); paths "
+                "look like 'layers/wq', 'embed', 'lm_head'")
+        return mask
+
     def _split(self, batch):
         pld_theta = None
         if isinstance(batch, dict):

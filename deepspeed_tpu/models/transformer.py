@@ -144,6 +144,16 @@ class TransformerConfig:
     flash_decode: Optional[bool] = None
     dtype: Any = jnp.bfloat16                 # compute dtype hint (engine casts)
     initializer_range: float = 0.02
+    # frozen parameters (reference requires_grad=False; engine contract
+    # model.frozen_spec): leaves whose '/'-joined param path contains any of
+    # these as an EXACT path segment are frozen — no update (not even
+    # weight decay), excluded from grad norm + clipping.  Examples:
+    # ("embed",) freezes the token embedding only (NOT pos_embed/type_embed
+    # — list those separately on learned-position configs); ("wq", "wk",
+    # "wv", "wo") freezes all attention projections (stacked [L, ...]
+    # leaves freeze whole stacks — per-layer granularity needs the LoRA
+    # path, runtime/lora.py).
+    frozen_keywords: Tuple[str, ...] = ()
 
     @property
     def kv_heads(self) -> int:

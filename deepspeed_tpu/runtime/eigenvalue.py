@@ -119,9 +119,8 @@ class Eigenvalue:
         per_leaf: Dict[str, float] = {}
         flat_v = jax.tree_util.tree_flatten_with_path(v)[0]
         flat_h = jax.tree_util.tree_leaves(hv)
+        from ..utils.debug import path_str
         for (path, vl), hl in zip(flat_v, flat_h):
-            name = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
-                            for p in path)
-            per_leaf[name] = float(jnp.sum(
+            per_leaf[path_str(path)] = float(jnp.sum(
                 vl.astype(jnp.float32) * hl.astype(jnp.float32)))
         return float(eig), per_leaf

@@ -600,10 +600,20 @@ class DeepSpeedEngine:
         prescale = self.config.prescale_gradients
         predivide = self.config.gradient_predivide_factor
         cast_inside = self._compute_cast if self.use_master_weights else None
+        frozen_mask = self._frozen_mask
 
         def grad_of_batch(tree, scaler, one_batch, sub):
             def scaled(t):
                 p = cast_inside(t) if cast_inside is not None else t
+                if frozen_mask is not None:
+                    # stop_gradient lets XLA dead-code-eliminate the whole
+                    # backward for frozen leaves (the reference's
+                    # requires_grad=False computes no grad at all); the
+                    # update-side masking in apply_update stays as the
+                    # semantic contract for paths that skip this closure
+                    p = jax.tree_util.tree_map(
+                        lambda m, x: jax.lax.stop_gradient(x) if m else x,
+                        frozen_mask, p)
                 out = loss_fn(p, one_batch, sub)
                 loss, _ = out if isinstance(out, tuple) else (out, {})
                 return scale_loss(loss, scaler), loss

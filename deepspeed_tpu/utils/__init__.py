@@ -1,5 +1,5 @@
 from .debug import (assert_deterministic, assert_replicas_consistent,
-                    checksum_tree)
+                    checksum_tree, path_str)
 from .logging import logger, log_dist, print_rank_0
 from .memory import memory_status, see_memory_usage
 from .timer import SynchronizedWallClockTimer, ThroughputTimer

@@ -46,13 +46,25 @@ def _leaf_fingerprint(x) -> str:
     return h.hexdigest()[:16]
 
 
+def path_str(path) -> str:
+    """'/'-joined name for a jax key path — the one shared spelling of the
+    idiom (DictKey .key, SequenceKey .idx, GetAttrKey .name, else str)."""
+    parts = []
+    for p in path:
+        part = getattr(p, "key", None)
+        if part is None:
+            part = getattr(p, "idx", None)
+        if part is None:
+            part = getattr(p, "name", None)
+        parts.append(str(p if part is None else part))
+    return "/".join(parts)
+
+
 def checksum_tree(tree: Any) -> Dict[str, str]:
     """{'path': sha256-16} per leaf — a stable state fingerprint."""
     out: Dict[str, str] = {}
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-        name = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
-                        for p in path)
-        out[name] = _leaf_fingerprint(leaf)
+        out[path_str(path)] = _leaf_fingerprint(leaf)
     return out
 
 

@@ -34,7 +34,11 @@ class SimpleModel:
             "kernel": jax.random.normal(keys[-1], (self.hidden_dim, 1), jnp.float32) * 0.1,
         }
         if self.empty_grad:
-            params["unused"] = {"kernel": jnp.zeros((self.hidden_dim, self.hidden_dim))}
+            # nonzero init: a stays-at-init assertion against this leaf must
+            # be able to catch decay/multiplicative updates (zeros would
+            # survive those and pass vacuously)
+            params["unused"] = {"kernel": jax.random.normal(
+                keys[0], (self.hidden_dim, self.hidden_dim), jnp.float32) * 0.1}
         return params
 
     def apply(self, params, x):

@@ -132,6 +132,70 @@ def test_client_optimizer_gets_wrapped():
     assert not np.array_equal(_leaf(e.state.params, "linear_1", "kernel"), t0)
 
 
+def test_causallm_frozen_keywords():
+    """Model-family wiring: config.frozen_keywords freezes matched stacks
+    (here the embedding) through a real train loop."""
+    from deepspeed_tpu.models import CausalLM
+
+    mesh_mod.reset_mesh()
+    model = CausalLM("tiny", frozen_keywords=("embed",))
+    e, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adamw",
+                      "params": {"lr": 1e-2, "weight_decay": 0.1}},
+        "bf16": {"enabled": True},
+    })
+    emb0 = np.asarray(e.state.params["embed"], np.float32)
+    head0 = np.asarray(e.state.params["lm_head"], np.float32)
+    rng = np.random.default_rng(0)
+    for _ in range(3):
+        batch = {"input_ids": rng.integers(
+            0, model.config.vocab_size,
+            (e.train_batch_size, 16)).astype(np.int32)}
+        e.train_batch(batch=batch)
+    np.testing.assert_array_equal(
+        np.asarray(e.state.params["embed"], np.float32), emb0)
+    assert not np.array_equal(
+        np.asarray(e.state.params["lm_head"], np.float32), head0)
+    mesh_mod.reset_mesh()
+
+
+def test_causallm_frozen_keywords_typo_raises():
+    from deepspeed_tpu.models import CausalLM
+
+    model = CausalLM("tiny", frozen_keywords=("embeddings_typo",))
+    with pytest.raises(ValueError, match="matched no"):
+        model.frozen_spec()
+
+
+def test_causallm_frozen_keywords_bare_string_and_segments():
+    """A bare string must behave as a one-keyword tuple (not iterate as
+    characters and freeze everything), and matching is by exact path
+    segment: 'embed' must NOT sweep in pos_embed on learned-position
+    configs."""
+    import jax
+
+    from deepspeed_tpu.models import CausalLM
+
+    model = CausalLM("tiny-gpt2", frozen_keywords="embed")
+    mask = model.frozen_spec()
+    flat = {"/".join(str(getattr(p, "key", p)) for p in path): m
+            for path, m in jax.tree_util.tree_flatten_with_path(mask)[0]}
+    assert flat["embed"] is True
+    pos_keys = [k for k in flat if "pos_embed" in k]
+    assert pos_keys and all(flat[k] is False for k in pos_keys)
+    # a bare string must not freeze the world
+    assert not flat["lm_head"] if "lm_head" in flat else True
+    assert sum(flat.values()) < len(flat)
+    # '/'-qualified keywords freeze exactly the named run
+    model2 = CausalLM("tiny", frozen_keywords=("layers/wq",))
+    mask2 = model2.frozen_spec()
+    flat2 = {"/".join(str(getattr(p, "key", p)) for p in path): m
+             for path, m in jax.tree_util.tree_flatten_with_path(mask2)[0]}
+    assert flat2["layers/wq"] is True
+    assert sum(flat2.values()) == 1
+
+
 def test_frozen_rejects_param_offload():
     """The ZeRO-Infinity layer-streamed executor steps every shard with the
     host Adam — frozen_spec must be rejected, not silently ignored."""

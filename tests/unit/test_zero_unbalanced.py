@@ -1,0 +1,72 @@
+"""ZeRO with awkward parameter shapes (reference
+``TestZeroUnbalancedGradients``, tests/unit/runtime/zero/test_zero.py:55,
+and the unused-parameter cases): leaves whose sizes do not divide the
+8-way ZeRO axis must degrade gracefully (replicate, not crash) and keep
+loss-trajectory parity with stage 0; params with no gradient path (the
+reference's ``empty_grad``) must not break any stage."""
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.parallel import mesh as mesh_mod
+
+from .simple_model import SimpleModel, random_batch
+
+HID = 13            # prime-ish: indivisible by the 8-device ZeRO axis
+STEPS = 4
+
+
+def _train(stage, empty_grad=False, hid=HID):
+    mesh_mod.reset_mesh()
+    model = SimpleModel(hid, nlayers=3, empty_grad=empty_grad)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": stage},
+    })
+    losses = [float(engine.train_batch(
+        batch=random_batch(engine.train_batch_size, hid, s)))
+        for s in range(STEPS)]
+    mesh_mod.reset_mesh()
+    return losses
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    return _train(stage=0)
+
+
+@pytest.mark.parametrize("stage", [1, 2, 3])
+def test_unbalanced_shapes_stage_parity(baseline, stage):
+    np.testing.assert_allclose(_train(stage), baseline, rtol=1e-5)
+
+
+@pytest.mark.parametrize("stage", [0, 2, 3])
+def test_unused_param_trains(stage):
+    """empty_grad: a param no loss path touches — its gradient is
+    structurally zero; every stage must step through it without error and
+    leave it exactly at init (adamw: zero grad => zero update)."""
+    mesh_mod.reset_mesh()
+    model = SimpleModel(HID, empty_grad=True)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": stage},
+    })
+    unused0 = np.asarray(engine.state.params["unused"]["kernel"], np.float32)
+    losses = [float(engine.train_batch(
+        batch=random_batch(engine.train_batch_size, HID, s)))
+        for s in range(STEPS)]
+    assert np.isfinite(losses).all()
+    np.testing.assert_array_equal(
+        np.asarray(engine.state.params["unused"]["kernel"], np.float32),
+        unused0)
+    mesh_mod.reset_mesh()
+
+
+def test_unbalanced_matches_balanced_semantics():
+    """Cross-check the harness itself: a divisible hidden size runs the
+    same parity (guards against the unbalanced test passing because
+    everything silently replicated into stage-0 behavior)."""
+    base = _train(stage=0, hid=16)
+    np.testing.assert_allclose(_train(stage=3, hid=16), base, rtol=1e-5)
