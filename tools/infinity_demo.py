@@ -71,33 +71,37 @@ def main():
         0, model.config.vocab_size,
         (engine.train_batch_size, args.seq_len)).astype(np.int32)}
 
-    losses, times = [], []
-    for _ in range(args.steps):
-        t0 = time.perf_counter()
-        losses.append(float(engine.train_batch(batch=batch)))
-        times.append(time.perf_counter() - t0)
+    try:
+        losses, times = [], []
+        for _ in range(args.steps):
+            t0 = time.perf_counter()
+            losses.append(float(engine.train_batch(batch=batch)))
+            times.append(time.perf_counter() - t0)
 
-    store_bytes = sum(
-        os.path.getsize(os.path.join(r, f))
-        for r, _, fs in os.walk(store) for f in fs)
-    if not np.isfinite(losses).all():
-        raise RuntimeError(f"divergent run, no artifact: losses={losses}")
-    steady = times[1:] or times
-    sec_per_step = sum(steady) / len(steady)
-    print(json.dumps({
-        "metric": "zero-infinity-train",
-        "params": model.param_count,
-        "hbm_equivalent_state_gb": round(model.param_count * 10 / 2 ** 30, 1),
-        "nvme_store_gb": round(store_bytes / 2 ** 30, 1),
-        "sec_per_step": round(sec_per_step, 1),
-        "tokens_per_sec": round(
-            engine.train_batch_size * args.seq_len / sec_per_step, 1),
-        "first_step_sec": round(times[0], 1),
-        "losses": [round(l, 4) for l in losses],
-        "seq_len": args.seq_len,
-    }))
-    if not args.keep_store:
-        shutil.rmtree(store, ignore_errors=True)
+        store_bytes = sum(
+            os.path.getsize(os.path.join(r, f))
+            for r, _, fs in os.walk(store) for f in fs)
+        if not np.isfinite(losses).all():
+            raise RuntimeError(f"divergent run, no artifact: losses={losses}")
+        steady = times[1:] or times
+        sec_per_step = sum(steady) / len(steady)
+        print(json.dumps({
+            "metric": "zero-infinity-train",
+            "params": model.param_count,
+            "hbm_equivalent_state_gb": round(
+                model.param_count * 10 / 2 ** 30, 1),
+            "nvme_store_gb": round(store_bytes / 2 ** 30, 1),
+            "sec_per_step": round(sec_per_step, 1),
+            "tokens_per_sec": round(
+                engine.train_batch_size * args.seq_len / sec_per_step, 1),
+            "first_step_sec": round(times[0], 1),
+            "losses": [round(l, 4) for l in losses],
+            "seq_len": args.seq_len,
+        }))
+    finally:
+        # a crashed ~2.7B attempt otherwise strands a ~35 GB store
+        if not args.keep_store:
+            shutil.rmtree(store, ignore_errors=True)
 
 
 if __name__ == "__main__":
