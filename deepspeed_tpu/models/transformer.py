@@ -667,6 +667,20 @@ def _alibi_slopes(num_heads: int) -> np.ndarray:
     return np.asarray(slopes, dtype=np.float32)
 
 
+def _sharded_flash(mesh, spec, sm_scale, q, k, v):
+    """Causal flash attention per shard via shard_map (pallas_call has no
+    SPMD partitioning rule); ``spec`` carries the head-axis placement —
+    P(..., 'model', ...) for the tp path, P(..., ('model','seq'), ...) for
+    ulysses.  One wrapper so a kernel-signature change lands once."""
+    from ..ops.pallas.flash_attention import flash_attention
+    from ..parallel import mesh as mesh_mod
+
+    fa = mesh_mod.shard_map_compat(
+        functools.partial(flash_attention, causal=True, sm_scale=sm_scale),
+        mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fa(q, k, v)
+
+
 def _attention(cfg: TransformerConfig, q, k, v, positions, attn_impl: str = "xla",
                custom_positions: bool = False, window=None):
     """q:[B,S,Hq,hd] k,v:[B,S,Hkv,hd] -> [B,S,Hq,hd], causal.
@@ -709,6 +723,44 @@ def _attention(cfg: TransformerConfig, q, k, v, positions, attn_impl: str = "xla
     elif attn_impl == "ring":
         raise ValueError("ring attention requires a mesh with seq > 1, "
                          "default positions, and non-alibi attention")
+    if attn_impl == "ulysses":
+        # DeepSpeed-Ulysses sequence parallelism, the GSPMD way (the
+        # reference snapshot predates Ulysses — beyond-parity like ring):
+        # re-constrain [B,S,H,hd] from sequence-sharded to head-sharded —
+        # XLA lowers the resharding to the head<->sequence all-to-all the
+        # paper hand-writes — run FULL-sequence flash attention per shard,
+        # constrain back.  vs ring: 2 all-to-alls + local attention
+        # (bandwidth ~ O(B·S·H·hd/N) per hop) instead of N ppermute hops
+        # overlapped with compute; prefer ulysses when heads >> sp and the
+        # mesh's all-to-all rides one ICI hop, ring when S is the scarce
+        # resource or heads are few (GQA).
+        from ..parallel import mesh as mesh_mod
+
+        m = mesh_mod._GLOBAL_MESH
+        if m is None or m.shape["seq"] <= 1:
+            raise ValueError(
+                "ulysses attention requires an initialized mesh with a "
+                f"'seq' axis > 1 (mesh={'none' if m is None else dict(m.shape)})")
+        sp, tp = m.shape["seq"], m.shape["model"]
+        dp = mesh_mod.axis_size(m, BATCH_AXES)
+        failed = [c for c, ok in [
+            (f"Hq={Hq} % sp*tp={sp * tp}", Hq % (sp * tp) == 0),
+            (f"Hkv={Hkv} % sp*tp={sp * tp}", Hkv % (sp * tp) == 0),
+            (f"S={S} % 128", S % 128 == 0),
+            (f"B={B} % dp={dp}", B % dp == 0),
+            ("causal", bool(cfg.causal)),
+            ("non-alibi", cfg.position != "alibi"),
+            ("default positions", not custom_positions),
+            ("no window", window is None)] if not ok]
+        if failed:
+            raise ValueError(f"ulysses attention unsatisfiable: {failed}")
+        head_spec = P(BATCH_AXES, None, ("model", "seq"), None)
+        q = constrain_spec(q, head_spec)
+        k = constrain_spec(k, head_spec)
+        v = constrain_spec(v, head_spec)
+        out = _sharded_flash(m, head_spec, _sm_scale(cfg, hd), q, k, v)
+        out = out.astype(q.dtype)           # kernel may widen to f32
+        return constrain_spec(out, P(BATCH_AXES, "seq", "model", None))
     if attn_impl == "auto":
         # Measured on v5e (B=8,H=16,hd=64, bf16, fwd + fwd‖bwd):
         #   S=1024: xla 13.9ms vs pallas 15.9ms  — xla wins
@@ -741,11 +793,8 @@ def _attention(cfg: TransformerConfig, q, k, v, positions, attn_impl: str = "xla
             ok = (S % 128 == 0 and m.shape["seq"] == 1 and m.shape["pipe"] == 1
                   and Hq % tp == 0 and Hkv % tp == 0 and B % dp == 0)
             if ok:
-                spec = P(BATCH_AXES, None, "model", None)
-                fa = mesh_mod.shard_map_compat(
-                    functools.partial(flash_attention, causal=True, sm_scale=sm),
-                    m, in_specs=(spec, spec, spec), out_specs=spec)
-                return fa(q, k, v)
+                return _sharded_flash(m, P(BATCH_AXES, None, "model", None),
+                                      sm, q, k, v)
     if Hkv != Hq:  # GQA: repeat KV groups
         rep = Hq // Hkv
         k = jnp.repeat(k, rep, axis=2)

@@ -55,23 +55,25 @@ def main():
                      intermediate_size=args.intermediate,
                      num_heads=args.heads, max_seq_len=args.seq_len)
     os.makedirs(store, exist_ok=True)
-    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
-        "train_micro_batch_size_per_gpu": 1,
-        "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
-        "zero_optimization": {
-            "stage": 3,
-            "offload_param": {"device": "nvme",
-                              "nvme_path": store},
-        },
-        "bf16": {"enabled": True},
-        "steps_per_print": 10 ** 9,
-    })
-    rng = np.random.default_rng(0)
-    batch = {"input_ids": rng.integers(
-        0, model.config.vocab_size,
-        (engine.train_batch_size, args.seq_len)).astype(np.int32)}
-
+    # the try opens BEFORE initialize(): init is the phase that writes the
+    # ~35 GB store, so an init crash (e.g. disk full) must also clean up
     try:
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+            "zero_optimization": {
+                "stage": 3,
+                "offload_param": {"device": "nvme",
+                                  "nvme_path": store},
+            },
+            "bf16": {"enabled": True},
+            "steps_per_print": 10 ** 9,
+        })
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": rng.integers(
+            0, model.config.vocab_size,
+            (engine.train_batch_size, args.seq_len)).astype(np.int32)}
+
         losses, times = [], []
         for _ in range(args.steps):
             t0 = time.perf_counter()
