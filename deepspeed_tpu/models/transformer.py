@@ -748,6 +748,9 @@ def _attention(cfg: TransformerConfig, q, k, v, positions, attn_impl: str = "xla
             (f"Hkv={Hkv} % sp*tp={sp * tp}", Hkv % (sp * tp) == 0),
             (f"S={S} % 128", S % 128 == 0),
             (f"B={B} % dp={dp}", B % dp == 0),
+            # same shard_map kernel as the tp flash path: its specs never
+            # mention 'pipe', so a pipelined mesh must be rejected here
+            ("pipe=1", m.shape["pipe"] == 1),
             ("causal", bool(cfg.causal)),
             ("non-alibi", cfg.position != "alibi"),
             ("default positions", not custom_positions),

@@ -89,6 +89,20 @@ def test_ulysses_requires_seq_mesh():
     mesh_mod.reset_mesh()
 
 
+def test_ulysses_rejects_pipeline_mesh():
+    """The shard_map kernel's specs never mention 'pipe' — a pipelined mesh
+    must get the clean ValueError, not silently-wrong outputs."""
+    mesh_mod.reset_mesh()
+    initialize_mesh(MeshLayout(pp=2, sp=4))
+    model = CausalLM("tiny", max_seq_len=S, dtype=jnp.float32,
+                     attn_impl="ulysses")
+    params = model.init_fn(jax.random.PRNGKey(0))
+    tokens = jnp.zeros((B, S), jnp.int32)
+    with pytest.raises(ValueError, match="pipe"):
+        model.apply_fn(params, tokens)
+    mesh_mod.reset_mesh()
+
+
 def test_ulysses_unsatisfiable_heads_raise():
     mesh_mod.reset_mesh()
     initialize_mesh(MeshLayout(sp=8))   # tiny has 4 heads: 4 % 8 != 0
