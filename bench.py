@@ -184,6 +184,12 @@ def run(model_name: str, micro_batch: int, seq_len: int, steps: int, warmup: int
         # A100 cluster number (see BASELINE.md "single-chip reinterpretation");
         # MFU against the chip's measured matmul roof is the judgeable figure
         "mfu_vs_measured_roof": mfu_roof,
+        # headline-convention flops with the causal 1/2 applied to the
+        # attention term (6N + 6LdS per token) — reported TOP-LEVEL so the
+        # long-S default regime (which inflates the uncorrected headline)
+        # can't be mistaken for a real throughput win across regimes
+        "causal_corrected_tflops": round(
+            tok_per_sec_chip * (base + attn_coeff * seq_len / 2) / 1e12, 2),
         "tokens_per_sec_per_chip": round(tok_per_sec_chip, 1),
         "detail": {
             "model": model_name if on_tpu else "tiny(cpu-smoke)",

@@ -678,7 +678,9 @@ def _sharded_flash(mesh, spec, sm_scale, q, k, v):
     fa = mesh_mod.shard_map_compat(
         functools.partial(flash_attention, causal=True, sm_scale=sm_scale),
         mesh, in_specs=(spec, spec, spec), out_specs=spec)
-    return fa(q, k, v)
+    # kernel may widen to f32; cast HERE so the tp and ulysses call sites
+    # can never disagree on output dtype
+    return fa(q, k, v).astype(q.dtype)
 
 
 def _attention(cfg: TransformerConfig, q, k, v, positions, attn_impl: str = "xla",
@@ -762,7 +764,6 @@ def _attention(cfg: TransformerConfig, q, k, v, positions, attn_impl: str = "xla
         k = constrain_spec(k, head_spec)
         v = constrain_spec(v, head_spec)
         out = _sharded_flash(m, head_spec, _sm_scale(cfg, hd), q, k, v)
-        out = out.astype(q.dtype)           # kernel may widen to f32
         return constrain_spec(out, P(BATCH_AXES, "seq", "model", None))
     if attn_impl == "auto":
         # Measured on v5e (B=8,H=16,hd=64, bf16, fwd + fwd‖bwd):

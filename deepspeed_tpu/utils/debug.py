@@ -48,7 +48,15 @@ def _leaf_fingerprint(x) -> str:
 
 def path_str(path) -> str:
     """'/'-joined name for a jax key path — the one shared spelling of the
-    idiom (DictKey .key, SequenceKey .idx, GetAttrKey .name, else str)."""
+    idiom (DictKey .key, SequenceKey .idx, GetAttrKey .name, else str).
+
+    NOTE (intentional spelling change, round 4): GetAttrKey entries render
+    as bare ``name`` here, where the pre-round-4 ``str(p)`` fallback rendered
+    ``.name``.  Fingerprint KEYS over attr-keyed pytrees (dataclass /
+    namedtuple nodes, e.g. optax opt_state) therefore differ from checksums
+    recorded before that commit; the VALUES are unchanged.  Nothing in-tree
+    persists these keys across versions — they are session-local debug
+    fingerprints — so no compatibility alias is kept."""
     parts = []
     for p in path:
         part = getattr(p, "key", None)

@@ -196,6 +196,38 @@ def test_causallm_frozen_keywords_bare_string_and_segments():
     assert sum(flat2.values()) == 1
 
 
+def test_frozen_composes_with_onebit_adam():
+    """frozen_spec + the EF 1-bit optimizers: frozen grads are structurally
+    zero, so they ride the error-feedback compression with zero message and
+    zero carried error — the frozen leaf must stay bit-identical on BOTH
+    sides of the freeze_step boundary (full-precision warmup AND compressed
+    regime), and trainable leaves must keep moving."""
+    from deepspeed_tpu.parallel.mesh import MeshLayout, initialize_mesh
+
+    mesh_mod.reset_mesh()
+    initialize_mesh(MeshLayout(dp=8))
+    model = SimpleFrozenModel(HID)
+    e, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "onebitadam",
+                      "params": {"lr": 1e-2, "freeze_step": 2}},
+        "zero_optimization": {"stage": 1},
+    })
+    p0 = jax.tree_util.tree_map(lambda x: np.asarray(x, np.float32),
+                                e.state.params)
+    # 2 warmup steps + 3 compressed steps: crosses the freeze_step boundary
+    for s in range(5):
+        e.train_batch(batch=random_batch(e.train_batch_size, HID, s))
+    p1 = e.state.params
+    np.testing.assert_array_equal(_leaf(p1, "linear_0", "kernel"),
+                                  p0["linear_0"]["kernel"])
+    np.testing.assert_array_equal(_leaf(p1, "linear_0", "bias"),
+                                  p0["linear_0"]["bias"])
+    assert not np.array_equal(_leaf(p1, "linear_1", "kernel"),
+                              p0["linear_1"]["kernel"])
+    mesh_mod.reset_mesh()
+
+
 def test_frozen_rejects_param_offload():
     """The ZeRO-Infinity layer-streamed executor steps every shard with the
     host Adam — frozen_spec must be rejected, not silently ignored."""
