@@ -1,10 +1,24 @@
-"""Multi-host fan-out backends (reference ``launcher/multinode_runner.py:51``).
+"""Multi-host fan-out backends (reference ``launcher/multinode_runner.py:51``
+— PDSH/OpenMPI/MPICH/IMPI/SLURM/MVAPICH; the TPU build keeps the same
+runner-per-scheduler shape over one shared command builder).
 
-TPU-first: one ssh per host, each running ONE controller process that owns the
-host's chips — there is no per-rank nsenter/numactl business because device
-binding is the TPU runtime's job, and no MPI/pdsh dependency: a poll loop over
-one ssh subprocess per host covers the pod case, and ``LocalRunner`` covers
-same-host multi-process testing.
+TPU-first: one ssh/srun/mpirun *task per host*, each running ONE controller
+process that owns the host's chips — there is no per-rank nsenter/numactl
+business because device binding is the TPU runtime's job.  Runners:
+
+- :class:`SSHRunner`   — pdsh-style thread fan-out over plain ssh (pods,
+  bare metal); also the engine under :class:`PodRunner`.
+- :class:`PodRunner`   — SSHRunner whose host pool came from TPU-pod/GKE
+  metadata discovery (``pod.discover_pod``) instead of a hostfile.
+- :class:`SlurmRunner` — one ``srun`` that launches every task; per-task
+  rank is taken from ``SLURM_PROCID`` *inside* the task (srun owns
+  placement, so per-host env like ssh's would race the scheduler).
+- :class:`MPIRunner`   — ``mpirun`` with one slot per host; rank from
+  ``OMPI_COMM_WORLD_RANK``/``PMI_RANK`` inside the task.
+- :class:`LocalRunner` — same-host multi-process testing/CI.
+
+All translate to the ONE rendezvous contract ``comm.init_distributed``
+reads: COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID.
 """
 from __future__ import annotations
 
@@ -78,6 +92,155 @@ class SSHRunner(MultiNodeRunner):
         if rc == 130:
             logger.info("launcher: interrupted; all hosts terminated")
         return rc
+
+
+class PodRunner(SSHRunner):
+    """SSHRunner over a host pool DISCOVERED from the platform rather than a
+    hostfile: TPU-VM / GKE metadata (``pod.discover_pod``).  The invoking
+    host fans out to every worker in the slice — including itself, so the
+    command is uniform whether launched from worker 0 or an external
+    bastion with ssh reach."""
+
+    def __init__(self, args, active, base_env, pool=None, info=None):
+        super().__init__(args, active, base_env, pool=pool)
+        self.info = info
+
+    def launch(self, user_cmd: List[str]) -> int:
+        if self.info is not None:
+            from .pod import describe
+
+            logger.info("launcher: %s", describe(self.info))
+        return super().launch(user_cmd)
+
+
+def _rank_bootstrap_cmd(user_cmd: List[str], rank_vars: List[str]) -> str:
+    """One shell line that maps the scheduler's rank variable onto the
+    rendezvous contract then execs the user command — shared by the srun and
+    mpirun runners (both launch ALL tasks from one command, so rank can only
+    be read inside the task).  If NO rank var is set the shell itself fails
+    with a message naming them (bash ``:?``) — better than exporting
+    garbage and dying later in init_distributed's int() parse."""
+    msg = f"no scheduler rank variable set (tried {' '.join(rank_vars)})"
+    fallback = ("".join("${%s:-" % v for v in rank_vars[:-1])
+                + "${%s:?%s}" % (rank_vars[-1], msg)
+                + "}" * (len(rank_vars) - 1))
+    return f'export PROCESS_ID="{fallback}"; exec {shlex.join(user_cmd)}'
+
+
+class _SchedulerRunner(MultiNodeRunner):
+    """Shared guards for runners whose scheduler launches ALL tasks from one
+    command (srun/mpirun): the backend binary must exist, and per-host env
+    (slot narrowing -> TPU_VISIBLE_CHIPS) cannot be expressed — reject it
+    loudly instead of silently running on all chips (the ssh path honors
+    it; use that for chip filters)."""
+
+    backend_binary = ""
+
+    def backend_exists(self) -> bool:
+        import shutil
+
+        return shutil.which(self.backend_binary) is not None
+
+    def _preflight(self) -> None:
+        if not self.backend_exists():
+            raise RuntimeError(
+                f"--launcher {self.args.launcher}: '{self.backend_binary}' "
+                "not found on PATH (is this a "
+                f"{self.args.launcher} environment?)")
+        narrowed = [h for h in self.hosts
+                    if self.pool.get(h) is not None
+                    and self.active[h] != list(range(self.pool[h]))]
+        if narrowed:
+            raise ValueError(
+                f"--launcher {self.args.launcher} launches uniformly and "
+                "cannot export per-host TPU_VISIBLE_CHIPS; chip-slot filters "
+                f"were given for {narrowed} — use --launcher ssh for slot "
+                "narrowing, or drop the :slot filter")
+
+    def _exports(self) -> Dict[str, str]:
+        exports = dict(self.base_env)
+        exports.pop("PROCESS_ID", None)  # per-task, from the scheduler's rank
+        return exports
+
+
+class SlurmRunner(_SchedulerRunner):
+    """``srun``-backed launch for SLURM-scheduled TPU slices (reference
+    SlurmRunner, ``launcher/multinode_runner.py:307``): one task per host,
+    exports carried via ``--export``, rank from ``SLURM_PROCID``."""
+
+    backend_binary = "srun"
+
+    def launch(self, user_cmd: List[str]) -> int:
+        self._preflight()
+        import tempfile
+
+        n = len(self.hosts)
+        # Rank->host placement must follow OUR host order (the rendezvous
+        # env names hosts[0] as the coordinator, and SLURM_PROCID becomes
+        # PROCESS_ID), but plain --nodelist tasks are placed in SLURM's
+        # internal sorted node order — not list order.  The contract SLURM
+        # provides for caller-ordered placement is SLURM_HOSTFILE +
+        # --distribution=arbitrary: task i runs on line i of the file.
+        hf = tempfile.NamedTemporaryFile("w", prefix="ds_tpu_slurm_hosts_",
+                                         suffix=".txt", delete=False)
+        hf.write("\n".join(self.hosts) + "\n")
+        hf.close()
+        srun = ["srun", "--nodes", str(n), "--ntasks", str(n),
+                "--ntasks-per-node", "1", "--distribution", "arbitrary",
+                "--export",
+                ",".join(["ALL"] + [f"{k}={v}"
+                                    for k, v in self._exports().items()])]
+        # operator passthrough (--partition, --account, ...)
+        if getattr(self.args, "launcher_args", ""):
+            srun += shlex.split(self.args.launcher_args)
+        cmd = srun + ["bash", "-c",
+                      _rank_bootstrap_cmd(user_cmd, ["SLURM_PROCID"])]
+        logger.info("launcher[slurm]: %s (SLURM_HOSTFILE=%s)",
+                    shlex.join(cmd[:12]) + " ...", hf.name)
+        env = dict(os.environ)
+        env["SLURM_HOSTFILE"] = hf.name
+        try:
+            return subprocess.call(cmd, env=env)
+        finally:
+            try:
+                os.unlink(hf.name)
+            except OSError:
+                pass
+
+
+class MPIRunner(_SchedulerRunner):
+    """``mpirun``-backed launch (reference OpenMPI/MPICH/IMPI runners,
+    ``launcher/multinode_runner.py:107``): one slot per host.  The flag
+    dialect follows the selected flavor — OpenMPI (``--host h:1``,
+    ``-x K=V``, rank in ``OMPI_COMM_WORLD_RANK``) vs the Hydra launchers
+    MPICH/Intel MPI (``-hosts``, ``-ppn 1``, ``-genv K V``, rank in
+    ``PMI_RANK``)."""
+
+    backend_binary = "mpirun"
+
+    def launch(self, user_cmd: List[str]) -> int:
+        self._preflight()
+        n = len(self.hosts)
+        flavor = getattr(self.args, "launcher", "openmpi")
+        if flavor == "openmpi":
+            cmd = ["mpirun", "-np", str(n), "--host",
+                   ",".join(f"{h}:1" for h in self.hosts)]
+            for k, v in self._exports().items():
+                cmd += ["-x", f"{k}={v}"]
+            rank_vars = ["OMPI_COMM_WORLD_RANK", "PMI_RANK"]
+        else:  # mpich / impi: Hydra process manager dialect
+            cmd = ["mpirun", "-np", str(n),
+                   "-hosts", ",".join(self.hosts), "-ppn", "1"]
+            for k, v in self._exports().items():
+                cmd += ["-genv", k, v]
+            # no second fallback: Hydra's other vars are LOCAL ranks (0 on
+            # every host at ppn=1) — better to fail loudly than desync
+            rank_vars = ["PMI_RANK"]
+        if getattr(self.args, "launcher_args", ""):
+            cmd += shlex.split(self.args.launcher_args)
+        cmd += ["bash", "-c", _rank_bootstrap_cmd(user_cmd, rank_vars)]
+        logger.info("launcher[%s]: %s", flavor, shlex.join(cmd[:8]) + " ...")
+        return subprocess.call(cmd)
 
 
 class LocalRunner(MultiNodeRunner):

@@ -60,8 +60,12 @@ def parse_args(args=None):
                    help="coordinator address; default = first host in the pool")
     p.add_argument("--master_port", type=int, default=DEFAULT_COORD_PORT,
                    help="coordinator port")
-    p.add_argument("--launcher", default="ssh", choices=["ssh", "local"],
-                   help="multinode backend ('local' requires all hosts == localhost)")
+    p.add_argument("--launcher", default="ssh",
+                   choices=["ssh", "local", "pod", "slurm", "openmpi", "impi",
+                            "mpich"],
+                   help="multinode backend: ssh fan-out, local subprocesses, "
+                        "'pod' = TPU-VM/GKE metadata discovery + ssh, "
+                        "'slurm' = srun, 'openmpi'/'impi'/'mpich' = mpirun")
     p.add_argument("--launcher_args", default="",
                    help="extra args passed to ssh (e.g. '-p 2222')")
     p.add_argument("--ssh_port", type=int, default=None)
@@ -299,6 +303,34 @@ def main(args=None) -> int:
         return _run_simulate(args, args.simulate)
 
     pool = fetch_hostfile(args.hostfile)
+    pod_info = None
+    if args.launcher == "pod" or (not pool and args.launcher in
+                                  ("slurm", "openmpi", "impi", "mpich")):
+        # discovery-backed pools: TPU-VM/GKE metadata ('pod') or the SLURM
+        # allocation env; a hostfile, when present, still wins for the
+        # scheduler runners so operators can narrow the allocation
+        from .pod import DEFAULT_SOURCES, discover_pod, pod_pool
+
+        # a SLURM launch must get SLURM node names even when TPU metadata
+        # is also present (srun rejects the metadata's bare IPs)
+        sources = (("slurm", "env", "gce-metadata")
+                   if args.launcher == "slurm" else DEFAULT_SOURCES)
+        pod_info = discover_pod(coord_port=args.master_port, sources=sources)
+        if args.launcher == "pod" and pod_info is None:
+            raise RuntimeError(
+                "--launcher pod: no pod discovered (need "
+                "TPU_WORKER_HOSTNAMES, GCE metadata, or a SLURM "
+                "allocation)")
+        if pod_info is not None:
+            # any discovery source feeds any scheduler runner: an mpi/slurm
+            # launch on a TPU-VM pod uses the metadata-discovered hosts
+            pool = pod_pool(pod_info)
+        elif args.launcher in ("slurm", "openmpi", "impi", "mpich"):
+            raise RuntimeError(
+                f"--launcher {args.launcher}: no hostfile at "
+                f"{args.hostfile!r} and no allocation/pod discovered — an "
+                "explicit multi-host launcher must not silently degrade to "
+                "a single local process")
     if not pool:
         if args.include or args.exclude or args.num_nodes > 0:
             raise ValueError(
@@ -318,16 +350,28 @@ def main(args=None) -> int:
     if not multi and hosts[0] in ("localhost", "127.0.0.1"):
         return _run_local_single(args, active)
 
-    from .multinode_runner import LocalRunner, SSHRunner
+    from .multinode_runner import (LocalRunner, MPIRunner, PodRunner,
+                                   SlurmRunner, SSHRunner)
 
+    # coordinator = first ACTIVE host (not the discovered pod's worker 0:
+    # filters may have excluded it, and every launched process must be able
+    # to reach — and one of them bind — this address)
     master = args.master_addr or hosts[0]
     base_env = {
         "COORDINATOR_ADDRESS": f"{master}:{args.master_port}",
         "NUM_PROCESSES": str(len(hosts)),
         "DS_TPU_WORLD_INFO": encode_world_info(active),
     }
-    cls = SSHRunner if args.launcher == "ssh" else LocalRunner
-    runner = cls(args, active, base_env, pool=pool)
+    if args.launcher == "pod":
+        runner = PodRunner(args, active, base_env, pool=pool, info=pod_info)
+    elif args.launcher == "slurm":
+        runner = SlurmRunner(args, active, base_env, pool=pool)
+    elif args.launcher in ("openmpi", "impi", "mpich"):
+        runner = MPIRunner(args, active, base_env, pool=pool)
+    elif args.launcher == "ssh":
+        runner = SSHRunner(args, active, base_env, pool=pool)
+    else:
+        runner = LocalRunner(args, active, base_env, pool=pool)
     return runner.launch(_build_user_cmd(args))
 
 

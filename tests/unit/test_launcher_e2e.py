@@ -1,0 +1,103 @@
+"""End-to-end multi-process launch: ``launcher → rendezvous → initialize →
+train_batch → save/load`` on real separate OS processes (reference
+tests/unit/launcher + multi-node CI jobs; here the pod is N local processes
+with jax.distributed over loopback and Gloo CPU collectives — the exact
+rendezvous path a TPU pod takes, minus the ICI).
+
+These tests spawn subprocesses through the launcher CLI itself, so they
+certify the full contract: env fan-out (COORDINATOR_ADDRESS / NUM_PROCESSES
+/ PROCESS_ID), ``init_distributed`` rendezvous, cross-process collectives
+inside the jitted train step, multi-controller checkpoint save/load, and
+replica-consistent losses.
+"""
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = str(Path(__file__).resolve().parents[2])
+
+# The per-process training script: every process runs this identically (the
+# launcher assigns PROCESS_ID).  It trains, checkpoints, restores into a
+# fresh engine, trains one more step, and dumps its observations as JSON.
+TRAIN_SCRIPT = """
+import json, os, sys
+sys.path.insert(0, {repo!r})
+import numpy as np
+import deepspeed_tpu
+from deepspeed_tpu.parallel import mesh as mesh_mod
+sys.path.insert(0, {testdir!r})
+from simple_model import SimpleModel, random_batch
+
+import jax
+HID = 16
+out_dir = {out_dir!r}
+
+config = {{
+    "train_micro_batch_size_per_gpu": 2,
+    "optimizer": {{"type": "adamw", "params": {{"lr": 1e-2}}}},
+    "zero_optimization": {{"stage": 1}},
+}}
+engine, _, _, _ = deepspeed_tpu.initialize(model=SimpleModel(HID),
+                                           config=config)
+assert jax.process_count() == int(os.environ["NUM_PROCESSES"])
+losses = [float(engine.train_batch(
+    batch=random_batch(engine.train_batch_size, HID, s))) for s in range(3)]
+engine.save_checkpoint(os.path.join(out_dir, "ckpt"), tag="e2e")
+
+# fresh engine restores and continues — same data => same loss everywhere
+mesh_mod.reset_mesh()
+engine2, _, _, _ = deepspeed_tpu.initialize(model=SimpleModel(HID),
+                                            config=config)
+engine2.load_checkpoint(os.path.join(out_dir, "ckpt"), tag="e2e")
+from deepspeed_tpu.utils.debug import assert_replicas_consistent
+assert_replicas_consistent(engine2.state.params, "restored params")
+losses.append(float(engine2.train_batch(
+    batch=random_batch(engine2.train_batch_size, HID, 99))))
+assert engine2.global_steps == 4, engine2.global_steps
+
+with open(os.path.join(out_dir, f"result.{{jax.process_index()}}"), "w") as f:
+    json.dump({{"losses": losses, "nprocs": jax.process_count(),
+               "ndev": jax.device_count()}}, f)
+"""
+
+
+def _launch(nprocs: int, tmp_path):
+    script = tmp_path / "train_e2e.py"
+    script.write_text(TRAIN_SCRIPT.format(
+        repo=REPO, testdir=str(Path(__file__).parent), out_dir=str(tmp_path)))
+    out = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher",
+         "--simulate", str(nprocs),
+         "--master_port", str(18480 + nprocs),  # distinct per param case
+         str(script)],
+        capture_output=True, text=True, cwd=REPO, timeout=900)
+    assert out.returncode == 0, f"stdout:\n{out.stdout}\nstderr:\n{out.stderr}"
+    results = []
+    for pid in range(nprocs):
+        f = tmp_path / f"result.{pid}"
+        assert f.exists(), f"process {pid} left no result\n{out.stderr}"
+        results.append(json.loads(f.read_text()))
+    return results
+
+
+@pytest.mark.parametrize("nprocs", [2, 4])
+def test_launch_train_checkpoint_resume(nprocs, tmp_path):
+    results = _launch(nprocs, tmp_path)
+    for r in results:
+        assert r["nprocs"] == nprocs
+        # each process contributes the same local device count (1 bare, 8
+        # under the suite's xla_force_host_platform_device_count conftest)
+        assert r["ndev"] % nprocs == 0 and r["ndev"] >= nprocs
+        assert np.isfinite(r["losses"]).all()
+    # replica consistency: every process observed the SAME loss trajectory
+    # (global batch + cross-process grad reduction), including the
+    # post-restore step — desync anywhere would fork the losses
+    ref = results[0]["losses"]
+    for r in results[1:]:
+        np.testing.assert_allclose(r["losses"], ref, rtol=1e-6)
+    # training moved: losses changed across steps
+    assert ref[0] != ref[1]

@@ -1,0 +1,328 @@
+"""Pod/SLURM/MPI launch-path units (reference
+tests/unit/launcher/test_multinode_runner.py models the command-construction
+assertions; discovery is TPU-native — metadata/env instead of pdsh/MPI
+probing)."""
+import subprocess
+from collections import OrderedDict
+
+import pytest
+
+from deepspeed_tpu.launcher import pod as pod_mod
+from deepspeed_tpu.launcher.multinode_runner import (MPIRunner, PodRunner,
+                                                     SlurmRunner,
+                                                     _rank_bootstrap_cmd)
+from deepspeed_tpu.launcher.pod import (PodInfo, apply_pod_env, discover_pod,
+                                        parse_slurm_nodelist, pod_pool)
+from deepspeed_tpu.launcher.runner import parse_args
+
+
+# ---------------------------------------------------------------- discovery
+def test_discover_from_tpu_env_vars():
+    info = discover_pod(env={"TPU_WORKER_HOSTNAMES": "t0,t1,t2,t3",
+                             "TPU_WORKER_ID": "2",
+                             "TPU_ACCELERATOR_TYPE": "v5litepod-16"})
+    assert info.source == "env"
+    assert info.worker_hostnames == ["t0", "t1", "t2", "t3"]
+    assert info.worker_id == 2
+    assert info.coordinator_address == "t0:8476"
+    assert info.accelerator_type == "v5litepod-16"
+    assert info.num_hosts == 4
+
+
+def test_discover_from_gce_metadata(monkeypatch):
+    attrs = {
+        "worker-network-endpoints":
+            "7a8:8470:10.130.0.2,7a9:8470:10.130.0.3",
+        "agent-worker-number": "1",
+        "accelerator-type": "v4-16",
+    }
+    monkeypatch.setattr(pod_mod, "_gce_metadata",
+                        lambda key, timeout=1.0: attrs.get(key))
+    info = discover_pod(env={})
+    assert info.source == "gce-metadata"
+    assert info.worker_hostnames == ["10.130.0.2", "10.130.0.3"]
+    assert info.worker_id == 1
+    assert info.coordinator_address == "10.130.0.2:8476"
+
+
+def test_discover_metadata_probe_skippable(monkeypatch):
+    calls = []
+    monkeypatch.setattr(pod_mod, "_gce_metadata",
+                        lambda key, timeout=1.0: calls.append(key))
+    assert discover_pod(env={"DS_TPU_SKIP_METADATA": "1"}) is None
+    assert calls == []
+
+
+def test_discover_from_slurm_env():
+    info = discover_pod(env={"SLURM_JOB_NODELIST": "tpu-[001-003]",
+                             "SLURM_NODEID": "1"})
+    assert info.source == "slurm"
+    assert info.worker_hostnames == ["tpu-001", "tpu-002", "tpu-003"]
+    assert info.worker_id == 1
+
+
+def test_discover_nothing():
+    assert discover_pod(env={"DS_TPU_SKIP_METADATA": "1"}) is None
+
+
+@pytest.mark.parametrize("nodelist,expected", [
+    ("n1", ["n1"]),
+    ("a,b,c", ["a", "b", "c"]),
+    ("tpu-[1-3]", ["tpu-1", "tpu-2", "tpu-3"]),
+    ("tpu-[001-003,010]", ["tpu-001", "tpu-002", "tpu-003", "tpu-010"]),
+    ("n[1,3-5],login1", ["n1", "n3", "n4", "n5", "login1"]),
+    ("rack[1-2]-node", ["rack1-node", "rack2-node"]),
+])
+def test_parse_slurm_nodelist(nodelist, expected):
+    assert parse_slurm_nodelist(nodelist) == expected
+
+
+def test_apply_pod_env_contract():
+    info = PodInfo(worker_hostnames=["a", "b"], worker_id=1,
+                   coordinator_address="a:8476", source="env")
+    env = apply_pod_env({}, info)
+    assert env == {"COORDINATOR_ADDRESS": "a:8476", "NUM_PROCESSES": "2",
+                   "PROCESS_ID": "1"}
+    # fan-out override
+    assert apply_pod_env({}, info, worker_id=0)["PROCESS_ID"] == "0"
+    info_unknown = PodInfo(worker_hostnames=["a"], worker_id=-1,
+                           coordinator_address="a:8476", source="gce-metadata")
+    with pytest.raises(ValueError, match="worker id"):
+        apply_pod_env({}, info_unknown)
+
+
+def test_pod_pool_one_controller_slot_per_host():
+    info = PodInfo(worker_hostnames=["x", "y"], worker_id=0,
+                   coordinator_address="x:8476", source="env")
+    assert pod_pool(info) == OrderedDict([("x", 1), ("y", 1)])
+
+
+# ------------------------------------------------------------------ runners
+def _mk(active_hosts, launcher="slurm", extra=()):
+    args = parse_args([f"--launcher={launcher}", *extra, "train.py"])
+    active = OrderedDict((h, [0]) for h in active_hosts)
+    base_env = {"COORDINATOR_ADDRESS": f"{active_hosts[0]}:8476",
+                "NUM_PROCESSES": str(len(active_hosts))}
+    return args, active, base_env
+
+
+@pytest.fixture
+def scheduler_backends(monkeypatch):
+    """Pretend srun/mpirun exist (this CI container has neither) and capture
+    the constructed command instead of running it."""
+    captured = {}
+
+    def fake_call(cmd, **kw):
+        captured["cmd"] = cmd
+        captured["env"] = kw.get("env")
+        hf = (kw.get("env") or {}).get("SLURM_HOSTFILE")
+        if hf:  # read NOW — the runner unlinks it after launch returns
+            captured["hostfile_content"] = open(hf).read()
+        return 0
+
+    monkeypatch.setattr(
+        "deepspeed_tpu.launcher.multinode_runner._SchedulerRunner"
+        ".backend_exists", lambda self: True)
+    monkeypatch.setattr(subprocess, "call", fake_call)
+    return captured
+
+
+def test_slurm_runner_srun_command(scheduler_backends):
+    args, active, env = _mk(["n1", "n2", "n3"])
+    SlurmRunner(args, active, env).launch(["python", "train.py"])
+    cmd = scheduler_backends["cmd"]
+    assert cmd[:7] == ["srun", "--nodes", "3", "--ntasks", "3",
+                       "--ntasks-per-node", "1"]
+    # rank->host placement must follow OUR host order (hosts[0] is the
+    # coordinator): SLURM's contract for that is SLURM_HOSTFILE +
+    # --distribution=arbitrary (plain --nodelist places in SLURM's sorted
+    # node order, which would desync PROCESS_ID from the rendezvous env)
+    assert cmd[cmd.index("--distribution") + 1] == "arbitrary"
+    assert scheduler_backends["hostfile_content"].split() == ["n1", "n2", "n3"]
+    import os
+    assert not os.path.exists(scheduler_backends["env"]["SLURM_HOSTFILE"])
+    exp = cmd[cmd.index("--export") + 1]
+    assert exp.startswith("ALL,") and "COORDINATOR_ADDRESS=n1:8476" in exp
+    assert "PROCESS_ID" not in exp          # per-task, from SLURM_PROCID
+    assert cmd[-2] == "-c" and "SLURM_PROCID" in cmd[-1]
+    assert "exec python train.py" in cmd[-1]
+
+
+def test_scheduler_runner_missing_backend_raises():
+    args, active, env = _mk(["n1", "n2"])
+    with pytest.raises(RuntimeError, match="srun.*not found"):
+        SlurmRunner(args, active, env).launch(["python", "train.py"])
+
+
+def test_scheduler_runner_rejects_slot_narrowing(scheduler_backends):
+    """srun/mpirun launch uniformly — a per-host chip filter can't ride
+    them and must fail loudly, not silently run on all chips."""
+    args, active, env = _mk(["n1", "n2"])
+    active["n1"] = [0, 1]                      # narrowed vs 4 total slots
+    pool = OrderedDict([("n1", 4), ("n2", 4)])
+    with pytest.raises(ValueError, match="TPU_VISIBLE_CHIPS"):
+        SlurmRunner(args, active, env, pool=pool).launch(["python", "t.py"])
+    with pytest.raises(ValueError, match="TPU_VISIBLE_CHIPS"):
+        MPIRunner(args, active, env, pool=pool).launch(["python", "t.py"])
+
+
+def test_mpi_runner_openmpi_dialect(scheduler_backends):
+    args, active, env = _mk(["h1", "h2"], launcher="openmpi")
+    MPIRunner(args, active, env).launch(["python", "train.py"])
+    cmd = scheduler_backends["cmd"]
+    assert cmd[:3] == ["mpirun", "-np", "2"]
+    assert cmd[cmd.index("--host") + 1] == "h1:1,h2:1"
+    assert "-x" in cmd and "-genv" not in cmd
+    boot = cmd[-1]
+    assert "OMPI_COMM_WORLD_RANK" in boot and "PMI_RANK" in boot
+
+
+@pytest.mark.parametrize("flavor", ["mpich", "impi"])
+def test_mpi_runner_hydra_dialect(scheduler_backends, flavor):
+    """MPICH/Intel-MPI use the Hydra flag dialect (-hosts/-ppn/-genv), not
+    OpenMPI's --host/-x; rank comes from PMI_RANK with NO local-rank
+    fallback (local ranks are 0 on every host at ppn=1)."""
+    args, active, env = _mk(["h1", "h2"], launcher=flavor)
+    MPIRunner(args, active, env).launch(["python", "train.py"])
+    cmd = scheduler_backends["cmd"]
+    assert cmd[cmd.index("-hosts") + 1] == "h1,h2"
+    assert cmd[cmd.index("-ppn") + 1] == "1"
+    assert "-genv" in cmd and "-x" not in cmd and "--host" not in cmd
+    boot = cmd[-1]
+    assert "PMI_RANK" in boot and "OMPI" not in boot and "LOCALRANK" not in boot
+
+
+def test_rank_bootstrap_fallback_chain():
+    line = _rank_bootstrap_cmd(["python", "t.py"],
+                               ["OMPI_COMM_WORLD_RANK", "PMI_RANK"])
+    assert "${OMPI_COMM_WORLD_RANK:-${PMI_RANK:?" in line
+    # the bootstrap actually resolves the rank in a real shell
+    out = subprocess.run(
+        ["bash", "-c", _rank_bootstrap_cmd(
+            ["bash", "-c", "echo rank=$PROCESS_ID"], ["MY_RANK"])],
+        env={"MY_RANK": "7", "PATH": "/usr/bin:/bin"},
+        capture_output=True, text=True)
+    assert out.stdout.strip() == "rank=7"
+    # fallback chain resolves the second var
+    out = subprocess.run(
+        ["bash", "-c", _rank_bootstrap_cmd(
+            ["bash", "-c", "echo rank=$PROCESS_ID"], ["UNSET_A", "MY_RANK"])],
+        env={"MY_RANK": "3", "PATH": "/usr/bin:/bin"},
+        capture_output=True, text=True)
+    assert out.stdout.strip() == "rank=3"
+    # NO rank var set: the shell itself must fail, naming the vars —
+    # exporting garbage would desync every process to the same rank later
+    out = subprocess.run(
+        ["bash", "-c", _rank_bootstrap_cmd(
+            ["bash", "-c", "echo rank=$PROCESS_ID"], ["UNSET_A", "UNSET_B"])],
+        env={"PATH": "/usr/bin:/bin"}, capture_output=True, text=True)
+    assert out.returncode != 0 and "UNSET_A" in out.stderr
+
+
+def test_slurm_runner_launcher_args_passthrough(scheduler_backends):
+    args, active, env = _mk(["n1", "n2"],
+                            extra=["--launcher_args=--partition=tpu --account=x"])
+    SlurmRunner(args, active, env).launch(["python", "train.py"])
+    cmd = scheduler_backends["cmd"]
+    assert "--partition=tpu" in cmd and "--account=x" in cmd
+    assert cmd.index("--partition=tpu") < cmd.index("bash")
+
+
+def test_discover_prefers_slurm_when_asked():
+    """On a SLURM-scheduled TPU slice BOTH surfaces exist; srun only accepts
+    allocation node names, so the slurm launcher probes slurm first."""
+    env = {"TPU_WORKER_HOSTNAMES": "10.0.0.1,10.0.0.2",
+           "SLURM_JOB_NODELIST": "n[1-2]", "SLURM_NODEID": "0"}
+    assert discover_pod(env=env).source == "env"
+    info = discover_pod(env=env, sources=("slurm", "env", "gce-metadata"))
+    assert info.source == "slurm" and info.worker_hostnames == ["n1", "n2"]
+
+
+def test_pod_runner_env_per_host():
+    args, active, env = _mk(["w0", "w1"], launcher="pod")
+    info = PodInfo(worker_hostnames=["w0", "w1"], worker_id=0,
+                   coordinator_address="w0:8476", source="env")
+    r = PodRunner(args, active, env, info=info)
+    assert r.env_for("w0")["PROCESS_ID"] == "0"
+    assert r.env_for("w1")["PROCESS_ID"] == "1"
+    ssh_cmd = r._ssh_cmd("w1", ["python", "train.py"])
+    joined = " ".join(ssh_cmd)
+    assert "ssh" == ssh_cmd[0] and "w1" in ssh_cmd
+    assert "PROCESS_ID=1" in joined and "COORDINATOR_ADDRESS=w0:8476" in joined
+
+
+def test_runner_main_pod_requires_discovery(tmp_path, monkeypatch):
+    from deepspeed_tpu.launcher import runner as runner_mod
+
+    monkeypatch.setattr("deepspeed_tpu.launcher.pod.discover_pod",
+                        lambda coord_port=8476, sources=None: None)
+    with pytest.raises(RuntimeError, match="no pod discovered"):
+        runner_mod.main(["--launcher", "pod",
+                         "--hostfile", str(tmp_path / "none"), "train.py"])
+
+
+def test_runner_main_pod_dispatch(tmp_path, monkeypatch):
+    """--launcher pod: discovery fills the pool, PodRunner gets the hosts
+    and the discovered coordinator."""
+    from deepspeed_tpu.launcher import runner as runner_mod
+
+    info = PodInfo(worker_hostnames=["w0", "w1", "w2"], worker_id=0,
+                   coordinator_address="w0:9999", source="env")
+    monkeypatch.setattr("deepspeed_tpu.launcher.pod.discover_pod",
+                        lambda coord_port=8476, sources=None: info)
+    seen = {}
+
+    def fake_launch(self, user_cmd):
+        seen["hosts"] = self.hosts
+        seen["env"] = dict(self.base_env)
+        return 0
+
+    monkeypatch.setattr(
+        "deepspeed_tpu.launcher.multinode_runner.PodRunner.launch",
+        fake_launch)
+    rc = runner_mod.main(["--launcher", "pod",
+                          "--hostfile", str(tmp_path / "none"), "train.py"])
+    assert rc == 0
+    assert seen["hosts"] == ["w0", "w1", "w2"]
+    assert seen["env"]["COORDINATOR_ADDRESS"] == "w0:8476"
+    assert seen["env"]["NUM_PROCESSES"] == "3"
+
+    # excluding the discovered worker 0 must move the coordinator to the
+    # first ACTIVE host — a coordinator on an unlaunched host would hang
+    # every worker in rendezvous
+    rc = runner_mod.main(["--launcher", "pod", "--exclude", "w0",
+                          "--hostfile", str(tmp_path / "none"), "train.py"])
+    assert rc == 0
+    assert seen["hosts"] == ["w1", "w2"]
+    assert seen["env"]["COORDINATOR_ADDRESS"] == "w1:8476"
+    assert seen["env"]["NUM_PROCESSES"] == "2"
+
+
+def test_runner_main_scheduler_requires_pool(tmp_path, monkeypatch):
+    """An explicit multi-host launcher with nothing to launch on must error,
+    not silently degrade to one local process."""
+    from deepspeed_tpu.launcher import runner as runner_mod
+
+    monkeypatch.setattr("deepspeed_tpu.launcher.pod.discover_pod",
+                        lambda coord_port=8476, sources=None: None)
+    with pytest.raises(RuntimeError, match="must not silently degrade"):
+        runner_mod.main(["--launcher", "openmpi",
+                         "--hostfile", str(tmp_path / "none"), "train.py"])
+
+
+def test_runner_main_mpi_uses_pod_discovery(tmp_path, monkeypatch):
+    """mpi/slurm launchers accept a metadata-discovered pool (TPU-VM pod
+    without a hostfile)."""
+    from deepspeed_tpu.launcher import runner as runner_mod
+
+    info = PodInfo(worker_hostnames=["w0", "w1"], worker_id=0,
+                   coordinator_address="w0:8476", source="env")
+    monkeypatch.setattr("deepspeed_tpu.launcher.pod.discover_pod",
+                        lambda coord_port=8476, sources=None: info)
+    seen = {}
+    monkeypatch.setattr(
+        "deepspeed_tpu.launcher.multinode_runner.MPIRunner.launch",
+        lambda self, cmd: seen.setdefault("hosts", self.hosts) and 0)
+    rc = runner_mod.main(["--launcher", "openmpi",
+                          "--hostfile", str(tmp_path / "none"), "train.py"])
+    assert rc == 0 and seen["hosts"] == ["w0", "w1"]
