@@ -95,8 +95,11 @@ class ElasticAgent:
 
     def run(self, train_step_fn: Callable, total_steps: int) -> int:
         """Run to ``total_steps`` or preemption; returns the last global step
-        completed.  Exit code contract: the wrapper script should relaunch
-        while the returned step < total_steps."""
+        completed.  Exit code contract: exit nonzero while the returned step
+        < total_steps — the IN-TREE supervisor (``elasticity/supervisor.py``,
+        ``deepspeed_tpu.launcher --elastic_restarts N``) relaunches on any
+        failure exit, re-discovering resources so a resized slice resumes at
+        its new world size from the last committed checkpoint."""
         start = self.restore_if_present()
         saved_at = -1
         for step in range(start, total_steps):

@@ -1,0 +1,81 @@
+"""In-tree elastic restart supervisor (reference
+``deepspeed/elasticity/elastic_agent.py:28`` ``DSElasticAgent`` +
+``launcher/launch.py:255-313`` — torch-elastic restarts worker groups on
+membership change; the TPU equivalent relaunches the JOB at the current
+resource shape and lets checkpoint resharding absorb the topology change).
+
+This implements the wrapper contract ``ElasticAgent.run`` documents: the
+training process checkpoints on preemption and exits nonzero while work
+remains; the supervisor re-discovers resources and relaunches until the job
+exits 0 (complete) or the restart budget is exhausted.  Because discovery
+runs again on every round, a restart after a resize naturally launches at
+the NEW world size — ``ElasticAgent.restore_if_present`` +
+``compute_elastic_config`` rebuild the schedule there, and orbax restores
+the last committed checkpoint onto the new mesh.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from ..utils.logging import logger
+
+# exit codes that must NOT trigger a relaunch
+RC_COMPLETE = 0          # training finished
+RC_INTERRUPT = 130       # operator ^C through the launcher
+
+
+class Supervisor:
+    """Relaunch loop around a launch attempt.
+
+    ``attempt(round_idx) -> int`` performs one full discovery + launch and
+    returns the job's exit code.  The supervisor relaunches on any failure
+    exit until ``max_restarts`` is spent; interrupts are terminal.
+    """
+
+    def __init__(self, attempt: Callable[[int], int], max_restarts: int = 10,
+                 backoff_s: float = 3.0,
+                 on_round: Optional[Callable[[int, int], None]] = None):
+        self.attempt = attempt
+        self.max_restarts = max_restarts
+        self.backoff_s = backoff_s
+        self.on_round = on_round
+
+    def run(self) -> int:
+        restarts = 0
+        while True:
+            try:
+                rc = self.attempt(restarts)
+            except KeyboardInterrupt:
+                raise
+            except Exception as e:
+                # a transient discovery failure (e.g. pod metadata absent
+                # WHILE the preempted slice is being recreated) must consume
+                # a restart, not crash the supervisor in exactly the window
+                # elastic restarts exist to survive
+                logger.warning("elastic supervisor: attempt raised %s: %s; "
+                               "treating as failed round", type(e).__name__, e)
+                rc = 1
+            if self.on_round is not None:
+                self.on_round(restarts, rc)
+            if rc == RC_COMPLETE:
+                if restarts:
+                    logger.info("elastic supervisor: job complete after "
+                                "%d restart(s)", restarts)
+                return 0
+            if rc == RC_INTERRUPT:
+                logger.info("elastic supervisor: interrupted; not restarting")
+                return rc
+            if restarts >= self.max_restarts:
+                logger.error(
+                    "elastic supervisor: rc=%d with restart budget exhausted "
+                    "(%d); giving up", rc, self.max_restarts)
+                return rc
+            restarts += 1
+            logger.warning(
+                "elastic supervisor: job exited rc=%d; relaunching "
+                "(restart %d/%d) after %.1fs — resources are re-discovered, "
+                "so a resized slice relaunches at the new world size",
+                rc, restarts, self.max_restarts, self.backoff_s)
+            if self.backoff_s > 0:
+                time.sleep(self.backoff_s)

@@ -98,8 +98,10 @@ class PodRunner(SSHRunner):
     """SSHRunner over a host pool DISCOVERED from the platform rather than a
     hostfile: TPU-VM / GKE metadata (``pod.discover_pod``).  The invoking
     host fans out to every worker in the slice — including itself, so the
-    command is uniform whether launched from worker 0 or an external
-    bastion with ssh reach."""
+    command is uniform across workers.  Run it from a pod worker (where the
+    env/metadata surfaces exist); from an external bastion, export
+    ``TPU_WORKER_HOSTNAMES`` yourself — discovery has nothing to probe
+    there otherwise."""
 
     def __init__(self, args, active, base_env, pool=None, info=None):
         super().__init__(args, active, base_env, pool=pool)

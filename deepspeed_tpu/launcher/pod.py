@@ -150,7 +150,10 @@ def _probe_env(env, coord_port, metadata_timeout) -> Optional[PodInfo]:
     if not hostnames.strip():
         return None
     hosts = [h.strip() for h in hostnames.split(",") if h.strip()]
-    wid = int(env.get("TPU_WORKER_ID", "-1") or -1)
+    wid_s = (env.get("TPU_WORKER_ID", "") or "").strip()
+    # malformed id degrades to unknown (-1), same as _probe_gce — a bad env
+    # export must not kill discovery for paths that don't need the local id
+    wid = int(wid_s) if wid_s.lstrip("-").isdigit() else -1
     return PodInfo(worker_hostnames=hosts, worker_id=wid,
                    coordinator_address=_with_port(hosts[0], coord_port),
                    source="env",
@@ -213,9 +216,15 @@ def discover_pod(coord_port: int = DEFAULT_COORD_PORT,
 def apply_pod_env(env: Dict[str, str], info: PodInfo,
                   worker_id: Optional[int] = None) -> Dict[str, str]:
     """Write the rendezvous contract for one worker into ``env`` (in place,
-    also returned).  ``worker_id`` overrides ``info.worker_id`` — the fan-out
-    path assigns ids per ssh target while the local path uses the
-    discovered one."""
+    also returned).  ``worker_id`` overrides ``info.worker_id``.
+
+    This is the PROGRAMMATIC (launcher-less) path: a script started
+    uniformly on every worker (gcloud ``--worker=all`` style) calls
+    ``apply_pod_env(os.environ, discover_pod())`` before
+    ``init_distributed``.  The launcher's fan-out does NOT use it — there
+    the coordinator must be the first ACTIVE (filter-surviving) host and
+    ids follow the ssh-target order (``MultiNodeRunner.env_for``), not the
+    discovered ids."""
     wid = info.worker_id if worker_id is None else worker_id
     if wid < 0:
         raise ValueError(

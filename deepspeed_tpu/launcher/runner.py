@@ -67,7 +67,10 @@ def parse_args(args=None):
                         "'pod' = TPU-VM/GKE metadata discovery + ssh, "
                         "'slurm' = srun, 'openmpi'/'impi'/'mpich' = mpirun")
     p.add_argument("--launcher_args", default="",
-                   help="extra args passed to ssh (e.g. '-p 2222')")
+                   help="extra args spliced into the selected backend's "
+                        "command: ssh flags for ssh/pod (e.g. '-p 2222'), "
+                        "srun flags for slurm (e.g. '--partition=tpu'), "
+                        "mpirun flags for openmpi/mpich/impi")
     p.add_argument("--ssh_port", type=int, default=None)
     p.add_argument("--module", action="store_true",
                    help="run user_script as 'python -m <module>'")
@@ -78,6 +81,14 @@ def parse_args(args=None):
                         "(SPMD debugging without a pod)")
     p.add_argument("--save_pid", action="store_true",
                    help="write launcher pid to /tmp/ds_tpu_launcher.pid")
+    p.add_argument("--elastic_restarts", type=int, default=0, metavar="N",
+                   help="elastic supervisor: relaunch the job up to N times "
+                        "on failure/preemption, re-discovering resources "
+                        "each round (0 = off); training scripts should use "
+                        "elasticity.ElasticAgent so restarts resume from "
+                        "the last committed checkpoint")
+    p.add_argument("--elastic_backoff", type=float, default=3.0,
+                   help="seconds between elastic relaunches")
     p.add_argument("--force_multi", action="store_true",
                    help="use the multinode path even for a single local host")
     p.add_argument("user_script", help="training script (or module with --module)")
@@ -216,21 +227,30 @@ def _run_local_single(args, active) -> int:
     return subprocess.call(cmd, env=env)
 
 
-def wait_all_or_fail(procs, poll_s: float = 0.2, on_fail=None) -> int:
+def wait_all_or_fail(procs, poll_s: float = 0.2, on_fail=None,
+                     kill_grace_s: float = 15.0) -> int:
     """Wait on a set of processes; on the FIRST nonzero exit, terminate the
     survivors and return that exit code (a sequential ``wait`` loop would hang
     on an earlier-indexed process blocked in rendezvous while a later one has
     already died).  KeyboardInterrupt terminates everything and returns 130.
     ``on_fail(idx, rc)`` is called for the root-cause process only — never for
-    the SIGTERM-ed survivors."""
+    the SIGTERM-ed survivors.  Reaping escalates SIGTERM -> SIGKILL after
+    ``kill_grace_s``: a survivor blocked inside a native collective (its
+    peer just died) never runs the python signal handler, so a plain
+    ``wait()`` would hang the launcher forever."""
     import time
 
     def _reap_all():
         for p in procs:
             if p.poll() is None:
                 p.terminate()
+        deadline = time.time() + kill_grace_s
         for p in procs:
-            p.wait()
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
 
     try:
         while True:
@@ -299,6 +319,20 @@ def main(args=None) -> int:
         with open("/tmp/ds_tpu_launcher.pid", "w") as f:
             f.write(str(os.getpid()))
 
+    if args.elastic_restarts > 0:
+        from ..elasticity.supervisor import Supervisor
+
+        # every attempt re-runs _dispatch, i.e. re-reads the hostfile /
+        # re-discovers the pod — a resized slice relaunches at its new size
+        return Supervisor(lambda _round: _dispatch(args),
+                          max_restarts=args.elastic_restarts,
+                          backoff_s=args.elastic_backoff).run()
+    return _dispatch(args)
+
+
+def _dispatch(args) -> int:
+    """One discovery + launch round (the unit the elastic supervisor
+    retries)."""
     if args.simulate > 0:
         return _run_simulate(args, args.simulate)
 

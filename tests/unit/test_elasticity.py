@@ -177,3 +177,55 @@ def test_elastic_agent_checkpoints_on_preemption(tmp_path):
     finally:
         agent2.guard.uninstall()
     mesh_mod.reset_mesh()
+
+
+# ---------------------------------------------------------------- supervisor
+def test_supervisor_relaunches_until_complete():
+    from deepspeed_tpu.elasticity.supervisor import Supervisor
+
+    rcs = iter([9, 1, 0])
+    rounds = []
+    sup = Supervisor(lambda r: next(rcs), max_restarts=5, backoff_s=0,
+                     on_round=lambda r, rc: rounds.append((r, rc)))
+    assert sup.run() == 0
+    assert rounds == [(0, 9), (1, 1), (2, 0)]
+
+
+def test_supervisor_interrupt_is_terminal():
+    from deepspeed_tpu.elasticity.supervisor import Supervisor
+
+    calls = []
+    sup = Supervisor(lambda r: calls.append(r) or 130, max_restarts=5,
+                     backoff_s=0)
+    assert sup.run() == 130
+    assert calls == [0]  # no relaunch after ^C
+
+
+def test_supervisor_budget_exhaustion():
+    from deepspeed_tpu.elasticity.supervisor import Supervisor
+
+    calls = []
+    sup = Supervisor(lambda r: calls.append(r) or 7, max_restarts=2,
+                     backoff_s=0)
+    assert sup.run() == 7
+    assert calls == [0, 1, 2]  # initial attempt + 2 restarts
+
+
+def test_supervisor_attempt_exception_consumes_restart():
+    """A transient discovery failure during the preemption window must burn
+    a restart, not crash the supervisor."""
+    from deepspeed_tpu.elasticity.supervisor import Supervisor
+
+    seq = iter([RuntimeError("no pod discovered"), 0])
+
+    def attempt(r):
+        x = next(seq)
+        if isinstance(x, Exception):
+            raise x
+        return x
+
+    rounds = []
+    sup = Supervisor(attempt, max_restarts=3, backoff_s=0,
+                     on_round=lambda r, rc: rounds.append((r, rc)))
+    assert sup.run() == 0
+    assert rounds == [(0, 1), (1, 0)]

@@ -84,6 +84,72 @@ def _launch(nprocs: int, tmp_path):
     return results
 
 
+# Elastic script: ElasticAgent drives the loop; on the FIRST round process 1
+# SIGKILLs itself mid-step-2 (after committing the step-1 checkpoint),
+# simulating a preempted/failed worker.  The launcher's supervisor must
+# relaunch and the second round must resume from the last committed
+# checkpoint and run to completion.
+ELASTIC_SCRIPT = """
+import json, os, signal, sys
+sys.path.insert(0, {repo!r})
+sys.path.insert(0, {testdir!r})
+import numpy as np
+import deepspeed_tpu
+from deepspeed_tpu.elasticity.elastic_agent import ElasticAgent
+from simple_model import SimpleModel, random_batch
+import jax
+
+HID = 16
+out_dir = {out_dir!r}
+config = {{
+    "train_micro_batch_size_per_gpu": 2,
+    "optimizer": {{"type": "adamw", "params": {{"lr": 1e-2}}}},
+}}
+engine, _, _, _ = deepspeed_tpu.initialize(model=SimpleModel(HID),
+                                           config=config)
+pid = jax.process_index()
+agent = ElasticAgent(engine, os.path.join(out_dir, "ckpt"), ckpt_every=1)
+start = agent.restore_if_present()
+with open(os.path.join(out_dir, f"rounds.{{pid}}"), "a") as f:
+    f.write(f"{{start}}\\n")
+marker = os.path.join(out_dir, "killed.marker")
+
+def step_fn(engine, step):
+    engine.train_batch(batch=random_batch(engine.train_batch_size, HID, step))
+    if pid == 1 and step == 2 and not os.path.exists(marker):
+        open(marker, "w").write("x")
+        os.kill(os.getpid(), signal.SIGKILL)   # simulated preemption
+
+final = agent.run(step_fn, total_steps=5)
+with open(os.path.join(out_dir, f"final.{{pid}}"), "w") as f:
+    json.dump({{"final": final, "resumed": agent.resumed_step}}, f)
+sys.exit(0 if final >= 5 else 99)
+"""
+
+
+def test_elastic_supervisor_resumes_after_worker_kill(tmp_path):
+    script = tmp_path / "train_elastic.py"
+    script.write_text(ELASTIC_SCRIPT.format(
+        repo=REPO, testdir=str(Path(__file__).parent), out_dir=str(tmp_path)))
+    out = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher",
+         "--simulate", "2", "--master_port", "18492",
+         "--elastic_restarts", "3", "--elastic_backoff", "0.5",
+         str(script)],
+        capture_output=True, text=True, cwd=REPO, timeout=900)
+    assert out.returncode == 0, f"stdout:\n{out.stdout}\nstderr:\n{out.stderr}"
+    assert (tmp_path / "killed.marker").exists()   # round 1 really died
+    for pid in range(2):
+        rounds = [int(x) for x in
+                  (tmp_path / f"rounds.{pid}").read_text().split()]
+        # round 1 from scratch; round 2 resumed from a COMMITTED step
+        assert rounds[0] == 0 and len(rounds) == 2 and rounds[1] >= 1, rounds
+        final = json.loads((tmp_path / f"final.{pid}").read_text())
+        assert final["final"] == 5
+        assert final["resumed"] == rounds[1]
+    assert "relaunching" in out.stderr or "relaunching" in out.stdout
+
+
 @pytest.mark.parametrize("nprocs", [2, 4])
 def test_launch_train_checkpoint_resume(nprocs, tmp_path):
     results = _launch(nprocs, tmp_path)

@@ -326,3 +326,9 @@ def test_runner_main_mpi_uses_pod_discovery(tmp_path, monkeypatch):
     rc = runner_mod.main(["--launcher", "openmpi",
                           "--hostfile", str(tmp_path / "none"), "train.py"])
     assert rc == 0 and seen["hosts"] == ["w0", "w1"]
+
+
+def test_probe_env_malformed_worker_id_degrades():
+    info = discover_pod(env={"TPU_WORKER_HOSTNAMES": "t0,t1",
+                             "TPU_WORKER_ID": "worker-0"})
+    assert info.source == "env" and info.worker_id == -1
