@@ -153,7 +153,10 @@ def _probe_env(env, coord_port, metadata_timeout) -> Optional[PodInfo]:
     wid_s = (env.get("TPU_WORKER_ID", "") or "").strip()
     # malformed id degrades to unknown (-1), same as _probe_gce — a bad env
     # export must not kill discovery for paths that don't need the local id
-    wid = int(wid_s) if wid_s.lstrip("-").isdigit() else -1
+    try:
+        wid = int(wid_s)
+    except ValueError:
+        wid = -1
     return PodInfo(worker_hostnames=hosts, worker_id=wid,
                    coordinator_address=_with_port(hosts[0], coord_port),
                    source="env",

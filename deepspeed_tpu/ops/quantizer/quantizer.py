@@ -81,7 +81,8 @@ def dequantize_blockwise(q: jax.Array, scale: jax.Array, shape, dtype,
 
 def quantized_all_gather(x_shard: jax.Array, axis_name, gather_dim: int = 0,
                          block: int = DEFAULT_BLOCK, bits: int = 8,
-                         out_dtype=None, grad_bits: int = None) -> jax.Array:
+                         out_dtype=None, grad_bits: int = None,
+                         grad_hierarchy=None) -> jax.Array:
     """qwZ: all-gather a parameter shard with an int8/int4 wire format.
 
     Forward: quantize the local shard -> all_gather(q, scales) -> dequantize
@@ -91,7 +92,12 @@ def quantized_all_gather(x_shard: jax.Array, axis_name, gather_dim: int = 0,
     output cotangent; fp32 by default, or the qgZ quantized reduction when
     ``grad_bits`` is set.  ``axis_name`` may be a tuple of mesh axes (their
     shards concatenate major-to-minor in tuple order, matching GSPMD's
-    dim-spec ordering).
+    dim-spec ordering).  ``grad_hierarchy=(inner_axes, outer_axis)`` routes
+    the quantized reduction through the two-hop intra-then-inter path
+    (:func:`hierarchical_quantized_reduce_scatter`); the tuple must cover
+    exactly the axes of ``axis_name`` with the outer axis FIRST in
+    ``axis_name`` (major), so the hierarchical landing matches the gather's
+    concatenation order.
     """
     out_dtype = out_dtype or x_shard.dtype
     grad_dtype = x_shard.dtype
@@ -117,6 +123,11 @@ def quantized_all_gather(x_shard: jax.Array, axis_name, gather_dim: int = 0,
         if grad_bits is None:
             dx = jax.lax.psum_scatter(dy, axis_name,
                                       scatter_dimension=gather_dim, tiled=True)
+        elif grad_hierarchy is not None:
+            inner, outer = grad_hierarchy
+            dx = hierarchical_quantized_reduce_scatter(
+                dy, inner, outer, scatter_dim=gather_dim, block=block,
+                bits=grad_bits)
         else:
             name = (axis_name if not isinstance(axis_name, (tuple, list))
                     or len(axis_name) > 1 else axis_name[0])
@@ -167,3 +178,42 @@ def quantized_reduce_scatter(grads: jax.Array, axis_name, scatter_dim: int = 0,
     out = jnp.moveaxis(
         total.reshape(lead // n, *moved.shape[1:]), 0, scatter_dim)
     return out.astype(grads.dtype)
+
+
+def hierarchical_quantized_reduce_scatter(grads: jax.Array, inner_axes,
+                                          outer_axis, scatter_dim: int = 0,
+                                          block: int = DEFAULT_BLOCK,
+                                          bits: int = 8) -> jax.Array:
+    """qgZ two-hop: intra-group (ICI) quantized reduce-scatter, THEN
+    inter-group (DCN) — the reference's hierarchical all-to-all reduction
+    (coalesced_collectives.py:31 + docs/_posts/2023-06-22-zeropp.md): the
+    intra hop shrinks the data n_inner× before it crosses the expensive
+    links, so the outer hop moves 1/n_inner of the bytes a flat reduction
+    over the full group would.
+
+    Landing layout is OUTER-MAJOR (device (i,j) of outer index i, inner
+    index j owns chunk ``i*n_inner + j``), matching both GSPMD's partition
+    order for a dim sharded ``P((outer, *inner))`` and the concatenation
+    order of ``quantized_all_gather`` over ``(outer, *inner)`` — achieved
+    by scattering the INNER-chunk axis of a ``[n_outer, n_inner, L/N]``
+    view in hop 1 (a strided chunk set), then the outer axis in hop 2.
+    Each hop re-quantizes, exactly like the reference's two quantization
+    points per gradient.
+    """
+    n_i = jax.lax.psum(1, inner_axes)
+    n_o = jax.lax.psum(1, outer_axis)
+    moved = jnp.moveaxis(grads, scatter_dim, 0)
+    lead = moved.shape[0]
+    n = n_i * n_o
+    assert lead % n == 0, (
+        f"dim {scatter_dim} ({lead}) not divisible by group {n_o}x{n_i}")
+    view = moved.reshape(n_o, n_i, lead // n, *moved.shape[1:])
+    # hop 1 — intra: member j of each inner group collects chunk column j
+    r1 = quantized_reduce_scatter(view, inner_axes, scatter_dim=1,
+                                  block=block, bits=bits)
+    r1 = r1.reshape(n_o, lead // n, *moved.shape[1:])
+    # hop 2 — inter: n_inner x fewer bytes than a flat reduce would move
+    r2 = quantized_reduce_scatter(r1, outer_axis, scatter_dim=0,
+                                  block=block, bits=bits)
+    out = r2.reshape(lead // n, *moved.shape[1:])
+    return jnp.moveaxis(out, 0, scatter_dim).astype(grads.dtype)

@@ -300,12 +300,21 @@ def resolve_engine_mesh(mc, zero_cfg, mesh: Optional[Mesh] = None) -> Mesh:
 
     mics = zero_cfg.mics_shard_size
     hpz = zero_cfg.zero_hpz_partition_size
-    if mics > 0 and hpz > 1:
+    hier = getattr(zero_cfg, "zero_hierarchical_dp_size", -1)
+    actives = [k for k, v in [("mics_shard_size", mics > 0),
+                              ("zero_hpz_partition_size", hpz > 1),
+                              ("zero_hierarchical_dp_size", hier > 1)] if v]
+    if len(actives) > 1:
         raise ValueError(
-            "mics_shard_size and zero_hpz_partition_size both factorize "
-            "the data axis — enable one or the other")
+            f"{' and '.join(actives)} all factorize the data axis — "
+            "enable exactly one")
     if hpz > 1:
         mics = hpz
+    elif hier > 1:
+        # hierarchical qgZ: same inner x outer factorization as MiCS; the
+        # planner diverges (masters/params shard over BOTH axes — plain
+        # ZeRO-3 semantics with a 2-level reduction topology)
+        mics = hier
     if mesh is None:
         dp_outer = 1
         if mics > 0:

@@ -102,6 +102,13 @@ class ZeroConfig(DeepSpeedConfigModel):
     mics_shard_size: int = Field(-1)
     mics_hierarchical_params_gather: bool = False
 
+    # hierarchical qgZ (reference coalesced_collectives.py:31 — the 2-hop
+    # intra-node -> inter-node quantized gradient reduction): inner ZeRO
+    # group size (the ICI domain); grads quantize-reduce within the inner
+    # group first, then across 'data_outer', moving 1/inner of the bytes
+    # over the expensive links
+    zero_hierarchical_dp_size: int = Field(-1)
+
     ignore_unused_parameters: bool = True
 
     @model_validator(mode="after")
@@ -121,12 +128,21 @@ class ZeroConfig(DeepSpeedConfigModel):
         if self.zero_hpz_partition_size > 1 and self.stage != 3:
             raise ValueError(
                 "zero_hpz_partition_size (ZeRO++ hpZ) requires stage 3")
-        if self.zero_hpz_partition_size > 1 and (
-                self.zero_quantized_weights or self.zero_quantized_gradients):
+        if self.zero_hierarchical_dp_size > 1 and self.stage != 3:
             raise ValueError(
-                "zero_hpz_partition_size cannot combine with qwZ/qgZ yet: the "
-                "quantized-collective region assumes master and param specs "
-                "shard identically, which hpZ's secondary partition breaks")
+                "zero_hierarchical_dp_size (hierarchical qgZ) requires "
+                "stage 3")
+        if self.zero_hierarchical_dp_size > 1 and self.mics_shard_size > 0:
+            raise ValueError(
+                "zero_hierarchical_dp_size and mics_shard_size both "
+                "factorize the data axis — enable one or the other")
+        if self.zero_hierarchical_dp_size > 1 \
+                and self.zero_hpz_partition_size > 1:
+            raise ValueError(
+                "zero_hierarchical_dp_size and zero_hpz_partition_size both "
+                "factorize the data axis — hpZ already makes the outer hop "
+                "the only explicit one; hierarchical qgZ needs masters "
+                "sharded over both hops")
         return self
 
 

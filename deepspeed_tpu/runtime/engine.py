@@ -408,11 +408,19 @@ class DeepSpeedEngine:
                     jax.tree_util.tree_leaves(self._frozen_mask)) if m)
             log_dist(f"frozen parameters: {n_frozen:,} excluded from "
                      "updates/grad-norm (model.frozen_spec)", ranks=[0])
+        hier = self.config.zero_config.zero_hierarchical_dp_size
         self.plan: ZeroShardingPlan = plan_sharding(
             shapes, self.zero_stage, mesh, tp_specs=param_specs,
             persistence_threshold=self.config.zero_config.stage3_param_persistence_threshold,
-            # hpZ: masters/opt/grads on the full group, compute view inner-only
-            zero_axes=(BATCH_AXES if hpz > 1 else ZERO_AXES),
+            # hpZ: masters/opt/grads on the full group, compute view
+            # inner-only — with 'data_outer' MINOR in the dim tuple, so that
+            # stripping the outer axis yields the CONTIGUOUS inner shard
+            # (outer-major would make the secondary copy a permutation of
+            # the true rows; caught by the composition loss-parity test).
+            # hierarchical qgZ: EVERYTHING on the full group, outer-MAJOR —
+            # the 2-hop reduce lands outer-major by construction.
+            zero_axes=(ZERO_AXES + ("data_outer",) if hpz > 1
+                       else BATCH_AXES if hier > 1 else ZERO_AXES),
             param_zero_axes=(ZERO_AXES if hpz > 1 else None))
         self._param_shardings = named_shardings(mesh, self.plan.param_specs)
         self._master_shardings = named_shardings(mesh, self.plan.master_specs)
@@ -431,11 +439,22 @@ class DeepSpeedEngine:
             # on TPU vs the reference's NVLink+IB two-hop makes bandwidth
             # cheaper and convergence the scarcer resource; int4 remains
             # available in ops/quantizer for the hierarchical path.
+            #
+            # Region-axes selection = the ZeRO++ composition switch (see
+            # make_zeropp_cast): hpZ covers only the outer hop; the
+            # hierarchical knob covers both hops with a 2-hop reduce.
+            if hpz > 1:
+                region_axes, hier_outer = ("data_outer",), None
+            elif hier > 1:
+                region_axes, hier_outer = BATCH_AXES, "data_outer"
+            else:
+                region_axes, hier_outer = ZERO_AXES, None
             self._compute_cast = make_zeropp_cast(
                 self.plan.master_specs, self.plan.param_specs, mesh,
-                self.compute_dtype, ZERO_AXES,
+                self.compute_dtype, region_axes,
                 weight_bits=8 if zcfg.zero_quantized_weights else None,
-                grad_bits=8 if zcfg.zero_quantized_gradients else None)
+                grad_bits=8 if zcfg.zero_quantized_gradients else None,
+                hierarchical_outer=hier_outer)
             if self._compute_cast.num_quantized_leaves == 0:
                 logger.warning(
                     "ZeRO++ enabled but no parameter is ZeRO-sharded (all "

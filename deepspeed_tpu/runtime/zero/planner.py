@@ -80,12 +80,28 @@ def _shard_dim_for(shape: Tuple[int, ...], base_spec: P, mesh: Mesh, zero_size: 
 
 
 def _compose_spec(shape: Tuple[int, ...], base_spec: Optional[P], mesh: Mesh,
-                  zero_axes: Tuple[str, ...]) -> P:
+                  zero_axes: Tuple[str, ...],
+                  preferred_dim: Optional[int] = None) -> P:
     base_spec = base_spec if base_spec is not None else P()
     zero_size = axis_size(mesh, list(zero_axes))
     if zero_size == 1:
         return base_spec
-    dim = _shard_dim_for(shape, base_spec, mesh, zero_size, frozenset(zero_axes))
+    dim = None
+    if preferred_dim is not None:
+        # hpZ: the compute view must shard the SAME dim the master does —
+        # the quantized-gather region strips the outer axis from the master
+        # spec, which only yields the param spec when the dims agree
+        entries = list(base_spec) + [None] * (len(shape) - len(base_spec))
+        axes_here = _spec_axes_in_dim(entries[preferred_dim])
+        tp_div = (int(np.prod([mesh.shape[a] for a in axes_here]))
+                  if axes_here else 1)
+        if (not (set(axes_here) & set(zero_axes))  # never duplicate an axis
+                and shape[preferred_dim] % tp_div == 0
+                and (shape[preferred_dim] // tp_div) % zero_size == 0):
+            dim = preferred_dim
+    if dim is None:
+        dim = _shard_dim_for(shape, base_spec, mesh, zero_size,
+                             frozenset(zero_axes))
     if dim is None:
         return base_spec
     entries = list(base_spec) + [None] * (len(shape) - len(base_spec))
@@ -119,23 +135,36 @@ def plan_sharding(param_shapes: Any, stage: int, mesh: Mesh, tp_specs: Optional[
     """
     if tp_specs is None:
         tp_specs = jax.tree_util.tree_map(lambda _: P(), param_shapes)
+    hpz_mode = param_zero_axes is not None and param_zero_axes != zero_axes
     param_zero_axes = param_zero_axes if param_zero_axes is not None else zero_axes
 
-    def spec_for(shaped, base, threshold, axes):
+    def spec_for(shaped, base, threshold, axes, preferred_dim=None):
         shape = tuple(shaped.shape)
         if threshold and _leaf_size(shape) < threshold:
             return base if base is not None else P()
-        return _compose_spec(shape, base, mesh, axes)
+        return _compose_spec(shape, base, mesh, axes,
+                             preferred_dim=preferred_dim)
+
+    def _zero_dim_of(spec: P, axes) -> Optional[int]:
+        for dim, entry in enumerate(spec):
+            if set(_spec_axes_in_dim(entry)) & set(axes):
+                return dim
+        return None
 
     # stage >= 1: master/opt sharded; no size threshold (opt state is the
     # memory hog the stage exists to shard)
     master = (jax.tree_util.tree_map(
         lambda s, b: spec_for(s, b, 0, zero_axes), param_shapes, tp_specs)
         if stage >= 1 else tp_specs)
-    # stage >= 3: compute params sharded, small params persist replicated
+    # stage >= 3: compute params sharded, small params persist replicated.
+    # Under hpZ the param spec must use the SAME dim as the master spec
+    # (the secondary partition is the master shard re-gathered over the
+    # outer axis only).
     params = (jax.tree_util.tree_map(
-        lambda s, b: spec_for(s, b, persistence_threshold, param_zero_axes),
-        param_shapes, tp_specs)
+        lambda s, b, m: spec_for(
+            s, b, persistence_threshold, param_zero_axes,
+            preferred_dim=(_zero_dim_of(m, zero_axes) if hpz_mode else None)),
+        param_shapes, tp_specs, master)
         if stage >= 3 else tp_specs)
     # stage >= 2: grads land sharded (XLA lowers the DP reduction to
     # reduce-scatter + the step's gather); stage 3 grads match param sharding

@@ -55,12 +55,14 @@ def _zero_axes_in_spec(spec: P, zero_axes) -> Tuple[Optional[int], Tuple[str, ..
 
 def _quantized_gather_leaf(x, axis_names: Tuple[str, ...], gather_dim: int,
                            compute_dtype, weight_bits: Optional[int],
-                           grad_bits: Optional[int], block: int):
+                           grad_bits: Optional[int], block: int,
+                           grad_hierarchy=None):
     """Runs inside the manual region.  x: local master shard (fp32); the
     wire-format + VJP logic is the shared op in ops/quantizer."""
     return quantized_all_gather(x, axis_names, gather_dim=gather_dim,
                                 block=block, bits=weight_bits,
-                                out_dtype=compute_dtype, grad_bits=grad_bits)
+                                out_dtype=compute_dtype, grad_bits=grad_bits,
+                                grad_hierarchy=grad_hierarchy)
 
 
 def _strip_axes(spec: P, drop) -> P:
@@ -76,7 +78,8 @@ def _strip_axes(spec: P, drop) -> P:
 def make_zeropp_cast(master_specs: Any, param_specs: Any, mesh, compute_dtype,
                      zero_axes, weight_bits: Optional[int],
                      grad_bits: Optional[int],
-                     block: int = DEFAULT_BLOCK):
+                     block: int = DEFAULT_BLOCK,
+                     hierarchical_outer: Optional[str] = None):
     """cast_fn(masters) -> compute params, with explicit quantized
     collectives on every ZeRO-sharded leaf.  Drop-in for the engine's
     ``_cast_tree(masters, compute_dtype)``.
@@ -85,17 +88,46 @@ def make_zeropp_cast(master_specs: Any, param_specs: Any, mesh, compute_dtype,
     sharding (TP axes included — their shards pass through untouched), the
     region gathers over the ZeRO axes only, and out_specs keep the TP axes.
     (The partial-manual ``axis_names`` mode would be the natural fit but
-    crashes XLA's SPMD partitioner in this jax/XLA version.)"""
+    crashes XLA's SPMD partitioner in this jax/XLA version.)
+
+    ``zero_axes`` selects WHICH axes the region covers — the composition
+    switch (reference partition_parameters.py:1019-1158 composes hpZ with
+    qwZ/qgZ; coalesced_collectives.py:31 is the hierarchical reduce):
+      plain qwZ/qgZ      ZERO_AXES: full gather/reduce, quantized
+      hpZ × qwZ/qgZ      ('data_outer',): only the expensive outer hop is
+                         explicit+quantized; the inner per-layer gathers
+                         stay implicit GSPMD over ICI in bf16
+      hierarchical qgZ   BATCH_AXES + ``hierarchical_outer='data_outer'``:
+                         the backward reduce runs the two-hop
+                         intra-then-inter quantized path
+    The master spec (not the param spec) locates the sharded dim, so the
+    hpZ case — where the compute view drops 'data_outer' — still finds it."""
     from ...parallel.mesh import shard_map_compat
 
     def leaf_fn(master_spec: P, param_spec: P):
-        dim, axes = _zero_axes_in_spec(param_spec, zero_axes)
+        from ...parallel.mesh import BATCH_AXES
+
+        dim, axes = _zero_axes_in_spec(master_spec, zero_axes)
         if dim is None:
-            return None  # persistent/unsharded: plain cast
+            return None  # unsharded master: plain cast
+        pdim, _ = _zero_axes_in_spec(param_spec, BATCH_AXES)
+        if pdim is None:
+            return None  # persistent param (replicated compute view)
+        grad_hierarchy = None
+        if hierarchical_outer is not None and hierarchical_outer in axes \
+                and len(axes) > 1 and grad_bits is not None:
+            if axes[0] != hierarchical_outer:
+                raise ValueError(
+                    f"hierarchical qgZ requires the outer axis "
+                    f"{hierarchical_outer!r} MAJOR in the spec entry {axes} "
+                    "(landing layout must match the gather order)")
+            grad_hierarchy = (tuple(a for a in axes
+                                    if a != hierarchical_outer),
+                              hierarchical_outer)
         region = functools.partial(
             _quantized_gather_leaf, axis_names=axes, gather_dim=dim,
             compute_dtype=compute_dtype, weight_bits=weight_bits,
-            grad_bits=grad_bits, block=block)
+            grad_bits=grad_bits, block=block, grad_hierarchy=grad_hierarchy)
         return shard_map_compat(region, mesh, in_specs=(master_spec,),
                                 out_specs=_strip_axes(master_spec, zero_axes))
 

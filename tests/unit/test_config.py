@@ -57,11 +57,21 @@ def test_zeropp_requires_stage3():
     assert z.zero_quantized_weights
     z = ZeroConfig(stage=3, zero_hpz_partition_size=8)
     assert z.zero_hpz_partition_size == 8
-    # hpZ diverges master/param shardings; the qwZ gather region assumes
-    # they match, so the combination is rejected until it is taught hpZ
-    with pytest.raises(Exception, match="hpz"):
-        ZeroConfig(stage=3, zero_quantized_weights=True,
-                   zero_hpz_partition_size=8)
+    # full ZeRO++ composition: hpZ + qwZ/qgZ accepted (the gather region
+    # covers only the outer hop; see runtime/zero/zeropp.py)
+    z = ZeroConfig(stage=3, zero_quantized_weights=True,
+                   zero_quantized_gradients=True, zero_hpz_partition_size=8)
+    assert z.zero_hpz_partition_size == 8
+    # hierarchical qgZ knob: stage-3 only, exclusive with hpZ/MiCS
+    z = ZeroConfig(stage=3, zero_hierarchical_dp_size=4)
+    assert z.zero_hierarchical_dp_size == 4
+    with pytest.raises(Exception, match="requires"):
+        ZeroConfig(stage=2, zero_hierarchical_dp_size=4)
+    with pytest.raises(Exception, match="factorize"):
+        ZeroConfig(stage=3, zero_hierarchical_dp_size=4,
+                   zero_hpz_partition_size=4)
+    with pytest.raises(Exception, match="factorize"):
+        ZeroConfig(stage=3, zero_hierarchical_dp_size=4, mics_shard_size=4)
 
 
 def test_fp16_bf16_exclusive():

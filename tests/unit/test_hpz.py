@@ -4,6 +4,7 @@ import numpy as np
 import pytest
 
 import jax
+import jax.numpy as jnp
 from pydantic import ValidationError
 
 import deepspeed_tpu
@@ -71,7 +72,7 @@ def test_hpz_requires_stage3():
 
 
 def test_hpz_conflicts_with_mics():
-    with pytest.raises(ValueError, match="one or the other"):
+    with pytest.raises(ValueError, match="factorize the data axis"):
         _engine_conflict()
 
 
@@ -83,3 +84,30 @@ def _engine_conflict():
                               "mics_shard_size": 4},
         "bf16": {"enabled": True},
     })
+
+
+def test_hpz_user_spec_already_on_zero_axis_kept():
+    """A leaf whose tp_specs explicitly shard a dim over a ZeRO axis must
+    keep the user spec under hpZ — the preferred-dim alignment must never
+    duplicate an axis into the PartitionSpec (regression: produced
+    P(('data','data','expert')) which NamedSharding rejects)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deepspeed_tpu.parallel.mesh import (MeshLayout, ZERO_AXES,
+                                             initialize_mesh)
+    from deepspeed_tpu.runtime.zero.planner import plan_sharding
+
+    mesh_mod.reset_mesh()
+    mesh = initialize_mesh(MeshLayout(dp=4, dp_outer=2))
+    shapes = {"w": jax.ShapeDtypeStruct((32, 8), jnp.float32)}
+    tp = {"w": P("data")}   # user already ZeRO-shards dim 0
+    plan = plan_sharding(shapes, 3, mesh, tp_specs=tp,
+                         zero_axes=ZERO_AXES + ("data_outer",),
+                         param_zero_axes=ZERO_AXES)
+    for spec in (plan.master_specs["w"], plan.param_specs["w"]):
+        flat = [a for e in spec for a in
+                ((e,) if isinstance(e, str) else (e or ()))]
+        assert len(flat) == len(set(flat)), f"duplicate axis in {spec}"
+        NamedSharding(mesh, spec)  # must construct
+    mesh_mod.reset_mesh()

@@ -332,3 +332,9 @@ def test_probe_env_malformed_worker_id_degrades():
     info = discover_pod(env={"TPU_WORKER_HOSTNAMES": "t0,t1",
                              "TPU_WORKER_ID": "worker-0"})
     assert info.source == "env" and info.worker_id == -1
+
+
+def test_probe_env_double_dash_worker_id_degrades():
+    info = discover_pod(env={"TPU_WORKER_HOSTNAMES": "t0,t1",
+                             "TPU_WORKER_ID": "--5"})
+    assert info.source == "env" and info.worker_id == -1

@@ -99,3 +99,37 @@ def test_quantized_reduce_scatter_close_to_exact(bits):
     tol = 8 * (np.abs(g).max() / qmax) * 0.5 + 1e-6
     assert out.shape == g.shape
     assert np.abs(out - expect).max() <= tol
+
+
+def test_hierarchical_reduce_scatter_sum_and_landing():
+    """Two-hop qgZ primitive: (1) the result equals the full cross-group sum
+    (within quant noise), (2) the landing layout is OUTER-MAJOR — device
+    (i, j) owns chunk i*n_inner+j — matching GSPMD's partition order for a
+    dim sharded P(('data_outer', 'data')) and the concatenation order of
+    quantized_all_gather."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from deepspeed_tpu.ops.quantizer import hierarchical_quantized_reduce_scatter
+    from deepspeed_tpu.parallel.mesh import shard_map_compat
+
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("do", "d"))
+    rng = np.random.default_rng(0)
+    L, K = 32, 3
+    locals_ = rng.standard_normal((8, L, K)).astype(np.float32)
+
+    f = shard_map_compat(
+        lambda x: hierarchical_quantized_reduce_scatter(
+            x, "d", "do", scatter_dim=0, block=16),
+        mesh, in_specs=(P(("do", "d"), None),),
+        out_specs=P(("do", "d"), None))
+    # each device feeds its own [L, K] block, stacked along axis 0
+    out = np.asarray(f(jnp.asarray(locals_.reshape(8 * L, K))))
+    expected = locals_.sum(axis=0)          # [L, K]
+    assert out.shape == expected.shape
+    # shard_map reassembles device (i,j)'s output at chunk i*4+j under the
+    # P(('do','d')) out-spec, so element-order equality proves the landing
+    np.testing.assert_allclose(out, expected, atol=0.15 * np.abs(expected).max())
