@@ -290,7 +290,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
 
 def _bwd(sm_scale, causal, block_q, block_k, interpret, res, g,
-         block_mask=None):
+         block_mask=None, dlse=None):
     q, k, v, out, lse = res
     do = g
     B, Hq, S, hd = q.shape
@@ -305,6 +305,13 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, res, g,
 
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)[:, :, None, :]
+    if dlse is not None:
+        # lse cotangent folds into delta: d s_ij = p_ij (dp_ij - delta_i)
+        # + p_ij dlse_i  ==  p_ij (dp_ij - (delta_i - dlse_i)) — so the
+        # kernels run unchanged with a shifted delta (the ring-attention
+        # merge differentiates through lse, unlike the plain path whose
+        # lse is consumed only by checkpoint_name)
+        delta = delta - dlse.astype(jnp.float32)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
@@ -413,14 +420,49 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, bwd_block_q,
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+# Same kernels, but lse is a REAL (differentiable) output: the ring
+# merge computes output weights from per-block lse, so its cotangent is
+# nonzero — _flash would silently drop it (wrong gradients); here it is
+# folded into the backward's delta term (see _bwd).
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash_lse(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+               bwd_block_q, bwd_block_k):
+    return _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret, None)
+
+
+def _flash_lse_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+                   bwd_block_q, bwd_block_k):
+    from jax.ad_checkpoint import checkpoint_name
+
+    out, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+                    None)
+    # same residual tagging as _flash_fwd: a remat policy pinning
+    # 'attn_lse' must cover the ring path too, or every ring step's
+    # backward re-runs the forward kernel
+    lse = checkpoint_name(lse, "attn_lse")
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_lse_bwd(sm_scale, causal, block_q, block_k, interpret,
+                   bwd_block_q, bwd_block_k, res, g):
+    do, dlse = g
+    return _bwd(sm_scale, causal, bwd_block_q, bwd_block_k, interpret, res,
+                do, None, dlse=dlse)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
 def flash_attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = None,
                     bias=None, block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
                     bwd_block_q: Optional[int] = None,
                     bwd_block_k: Optional[int] = None,
                     interpret: Optional[bool] = None,
-                    block_mask=None):
-    """q [B,S,Hq,hd], k/v [B,S,Hkv,hd] -> [B,S,Hq,hd].
+                    block_mask=None, return_lse: bool = False):
+    """q [B,S,Hq,hd], k/v [B,S,Hkv,hd] -> [B,S,Hq,hd]
+    (or ``(out, lse [B,Hq,S])`` with ``return_lse`` — the ring-attention
+    inner block consumes the lse for its cross-block merge).
 
     bias is not fused (alibi models use the XLA path); causal is.
     ``block_mask`` (optional bool [S/block_q, S/block_k]) skips dead blocks in
@@ -463,6 +505,16 @@ def flash_attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = No
         interpret = _interpret_default()
     # [B,S,H,hd] -> [B,H,S,hd]
     qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
-    out, _ = _flash(qt, kt, vt, sm_scale, causal, block_q, block_k, interpret,
-                    bwd_block_q, bwd_block_k, block_mask)
+    if return_lse:
+        if block_mask is not None:
+            raise NotImplementedError("return_lse + block_mask")
+        # the lse-differentiable variant — callers that CONSUME lse (ring
+        # merge) would get silently-wrong grads from _flash's dropped
+        # cotangent
+        out, lse = _flash_lse(qt, kt, vt, sm_scale, causal, block_q,
+                              block_k, interpret, bwd_block_q, bwd_block_k)
+        return jnp.swapaxes(out, 1, 2), lse.reshape(lse.shape[0],
+                                                    lse.shape[1], -1)
+    out, _ = _flash(qt, kt, vt, sm_scale, causal, block_q, block_k,
+                    interpret, bwd_block_q, bwd_block_k, block_mask)
     return jnp.swapaxes(out, 1, 2)

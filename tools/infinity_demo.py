@@ -42,6 +42,8 @@ def main():
                     help="directory for the NVMe store; the demo works in "
                          "an own subdirectory and removes only that")
     ap.add_argument("--keep_store", action="store_true")
+    ap.add_argument("--out", default="",
+                    help="also write the JSON record to this path")
     args = ap.parse_args()
     # never rmtree a user directory: all shard files go into (and only
     # this subdirectory is removed at exit)
@@ -87,7 +89,7 @@ def main():
             raise RuntimeError(f"divergent run, no artifact: losses={losses}")
         steady = times[1:] or times
         sec_per_step = sum(steady) / len(steady)
-        print(json.dumps({
+        record = json.dumps({
             "metric": "zero-infinity-train",
             "params": model.param_count,
             "hbm_equivalent_state_gb": round(
@@ -99,7 +101,11 @@ def main():
             "first_step_sec": round(times[0], 1),
             "losses": [round(l, 4) for l in losses],
             "seq_len": args.seq_len,
-        }))
+        })
+        print(record)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(record + "\n")
     finally:
         # a crashed ~2.7B attempt otherwise strands a ~35 GB store
         if not args.keep_store:

@@ -1,0 +1,122 @@
+"""On-chip timing for the flash-ring inner block (VERDICT r4 item 5).
+
+One chip has no 'seq' mesh axis, so what hardware can certify is the RING
+STEP: at global S with ring size sp, every step runs attention between the
+local q shard [B, S/sp, H, hd] and one rotated K/V block of the same
+length.  This script times that block both ways —
+
+  flash  : the Pallas kernel (O(tile²) score memory, lse-differentiable)
+  einsum : the fallback (materializes the [Sl, Sl] fp32 score block)
+
+— at the shard sizes a S=32k/64k ring at sp=8 actually sees (Sl=4k/8k),
+fwd and fwd+bwd, and reports per-step latency + the derived full-ring
+estimate (sp steps, compute-bound; ppermute overlap hides the ICI hop).
+
+Timing via tools/chiptimer.py (K-chained scan + scalar-fetch join + two-K
+overhead cancellation): block_until_ready returns early on this backend,
+so naive per-call timing measures dispatch, not kernels.
+
+Writes tools/artifacts/ring_flash_r5.json.
+"""
+from __future__ import annotations
+
+import functools
+import json
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "artifacts",
+                   "ring_flash_r5.json")
+
+
+
+
+def einsum_block(q, k, v, sm_scale):
+    B, Sq, Hq, hd = q.shape
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    lse = jax.nn.logsumexp(s, axis=-1)
+    p = jnp.exp(s - lse[..., None]).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def main() -> None:
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+    dev = jax.devices()[0]
+    rng = jax.random.PRNGKey(0)
+    rows = []
+    B, H, hd = 1, 16, 128
+    sp = 8
+    for S_global in (32768, 65536):
+        Sl = S_global // sp
+        ks = jax.random.split(rng, 3)
+        q = jax.random.normal(ks[0], (B, Sl, H, hd), jnp.bfloat16)
+        k = jax.random.normal(ks[1], (B, Sl, H, hd), jnp.bfloat16)
+        v = jax.random.normal(ks[2], (B, Sl, H, hd), jnp.bfloat16)
+        sm = 1.0 / math.sqrt(hd)
+
+        from chiptimer import device_time
+
+        def chain_fwd(attn):
+            return lambda c: (attn(c[0], c[1], c[2]).astype(c[0].dtype),
+                              c[1], c[2])
+
+        def chain_bwd(attn):
+            g = jax.grad(lambda q, k, v: jnp.sum(
+                attn(q, k, v).astype(jnp.float32)), argnums=(0, 1, 2))
+
+            def step(c):
+                dq, dk, dv = g(c[0], c[1], c[2])
+                return (dq.astype(c[0].dtype), dk.astype(c[1].dtype),
+                        dv.astype(c[2].dtype))
+
+            return step
+
+        flash = functools.partial(flash_attention, causal=False, sm_scale=sm)
+        ein = functools.partial(einsum_block, sm_scale=sm)
+        t_ff = device_time(chain_fwd(flash), (q, k, v))
+        t_fg = device_time(chain_bwd(flash), (q, k, v))
+        try:
+            t_ef = device_time(chain_fwd(ein), (q, k, v))
+            t_eg = device_time(chain_bwd(ein), (q, k, v))
+        except Exception as e:  # [Sl,Sl] fp32 can OOM at 8k
+            t_ef = t_eg = None
+            print(f"einsum block failed at Sl={Sl}: {type(e).__name__}")
+        score_mb = B * H * Sl * Sl * 4 / 2 ** 20
+        rows.append({
+            "S_global": S_global, "sp": sp, "S_local": Sl,
+            "B": B, "H": H, "hd": hd,
+            "flash_fwd_ms": round(t_ff * 1e3, 2),
+            "flash_fwd_bwd_ms": round(t_fg * 1e3, 2),
+            "einsum_fwd_ms": round(t_ef * 1e3, 2) if t_ef is not None else None,
+            "einsum_fwd_bwd_ms": (round(t_eg * 1e3, 2)
+                                  if t_eg is not None else None),
+            "einsum_score_block_mb": round(score_mb, 1),
+            "ring_full_fwd_bwd_est_ms": round(t_fg * 1e3 * sp, 1),
+        })
+        print(rows[-1], flush=True)
+
+    result = {
+        "platform": dev.platform, "device": str(dev),
+        "what": "per-ring-step attention block at the shard sizes a "
+                "S=32k/64k sp=8 ring sees; flash kernel vs the [Sl,Sl] "
+                "fp32 einsum fallback",
+        "rows": rows,
+    }
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "w") as f:
+        json.dump(result, f, indent=2)
+    print(f"wrote {OUT}")
+
+
+if __name__ == "__main__":
+    main()

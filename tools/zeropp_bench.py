@@ -11,12 +11,11 @@ trade:
     worth it  <=>  (bytes_saved / link_bw)  >  overhead
               <=>  link_bw  <  bytes_saved / overhead   ("break-even bw")
 
-This script times the blockwise kernels at bench shapes on the real chip,
-measures HBM bandwidth (the ceiling for any on-chip data motion), and
-reports the break-even link bandwidth per size: links FASTER than the
-break-even make quantization a net loss; slower links make it a win.  The
-go/no-go is then a statement about TPU link classes: ICI (~10^2 GB/s) vs
-DCN (~10^0-10^1 GB/s).
+This script times the blockwise quant+dequant round-trip at bench shapes
+on the real chip and reports the break-even link bandwidth per size:
+links FASTER than the break-even make quantization a net loss; slower
+links make it a win.  The go/no-go is then a statement about TPU link
+classes: ICI (~10^2 GB/s) vs DCN (~10^0-10^1 GB/s).
 
 Writes tools/artifacts/zeropp_r5.json.
 """
@@ -25,9 +24,9 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
@@ -41,22 +40,6 @@ OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "artifacts",
                    "zeropp_r5.json")
 
 
-def _timeit(fn, *args, iters=10, batches=5, warmup=3):
-    """MIN over several timed batches: the tunneled chip throttles in
-    episodes (see bench.py), and min-of-batches is robust to them where a
-    single long average is not (a 300x episode was observed polluting one
-    shape's number)."""
-    for _ in range(warmup):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    best = float("inf")
-    for _ in range(batches):
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = fn(*args)
-        jax.block_until_ready(out)
-        best = min(best, (time.perf_counter() - t0) / iters)
-    return best
 
 
 def main() -> None:
@@ -67,40 +50,37 @@ def main() -> None:
     # bench shapes: a llama-740m layer's fused QKV/MLP mats and a big
     # embedding — the leaves qwZ actually moves
     shapes = [(1536, 4096), (4096, 1536), (1536, 6144), (32000, 1536)]
-    # PHASE 1 — every timing, with ZERO device->host transfers: on the
-    # tunneled backend, the FIRST D2H transfer permanently drops dispatch
-    # into a ~11ms synchronous-RPC mode (measured: 26us -> 11000us for the
-    # identical jitted call after one jax.device_get of a tiny array), so a
-    # single float() mid-loop poisons every number after it
-    timed = []
+    # Timing via tools/chiptimer.py: K-chained scan inside one jit with a
+    # scalar-fetch completion join and two-K overhead cancellation —
+    # block_until_ready returns EARLY on the tunneled backend, so naive
+    # per-call timing measures dispatch (~15-30us) regardless of work (the
+    # first artifact shipped exactly that bug)
+    from chiptimer import device_time
+
     for shape in shapes:
         x = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
-        quant = jax.jit(lambda v: quantize_blockwise(v, block=256, bits=8))
-        q, s = quant(x)
-        deq = jax.jit(lambda q, s: dequantize_blockwise(
-            q, s, shape, jnp.bfloat16, block=256, bits=8))
+        quant = lambda v: quantize_blockwise(v, block=256, bits=8)
+        q, s = jax.jit(quant)(x)
+
+        def roundtrip(v):
+            qq, ss = quant(v)
+            return dequantize_blockwise(qq, ss, shape, jnp.bfloat16,
+                                        block=256, bits=8)
+
+        overhead_s = device_time(roundtrip, x)
         err_fn = jax.jit(lambda q, s, x: (
             jnp.max(jnp.abs(dequantize_blockwise(
                 q, s, shape, jnp.float32, block=256, bits=8)
                 - x.astype(jnp.float32))),
             jnp.max(jnp.abs(x.astype(jnp.float32)))))
-        t_q = _timeit(quant, x)
-        t_dq = _timeit(deq, q, s)
-        timed.append((shape, x, q, s, t_q, t_dq, err_fn(q, s, x)))
-
-    # PHASE 2 — transfers are safe now that nothing else gets timed
-    for shape, x, q, s, t_q, t_dq, errs in timed:
+        err, amax = (float(v) for v in err_fn(q, s, x))
         nbytes_bf16 = x.size * 2
-        overhead_s = t_q + t_dq
         bytes_saved = nbytes_bf16 - (q.size + s.size * 4)  # int8 + fp32 scales
         breakeven_gbps = bytes_saved / overhead_s / 1e9
-        err, amax = (float(v) for v in errs)
         rows.append({
             "shape": list(shape),
             "mbytes_bf16": round(nbytes_bf16 / 1e6, 2),
-            "t_quantize_us": round(t_q * 1e6, 1),
-            "t_dequantize_us": round(t_dq * 1e6, 1),
-            "overhead_us": round(overhead_s * 1e6, 1),
+            "quant_plus_dequant_us": round(overhead_s * 1e6, 1),
             "wire_bytes_saved_mb": round(bytes_saved / 1e6, 2),
             "breakeven_link_gbps": round(breakeven_gbps, 1),
             "max_abs_err_vs_amax": round(err / amax, 5),
@@ -117,9 +97,8 @@ def main() -> None:
         "per_shape": rows,
         "interpretation": {
             "rule": "quantization wins iff link_bw < breakeven_link_gbps",
-            "measured": "quant+dequant is HBM-bound and nearly size-"
-                        "independent (~30-40us for 12-98MB tensors), so the "
-                        "break-even bandwidth GROWS with tensor size",
+            "measured": "quant+dequant roundtrip is HBM-bound (time scales "
+                        "with bytes); see per_shape rows",
             "worst_breakeven_gbps": worst_breakeven,
             "dcn_always_wins": worst_breakeven > DCN_GBPS,
             "ici_wins_for_shapes": [r["shape"] for r in rows
@@ -129,15 +108,15 @@ def main() -> None:
         },
         "recommendation": {
             "default": "ON for any collective crossing DCN (hpZ x qwZ/qgZ "
-                       "outer hop, hierarchical qgZ inter-group hop) — every "
-                       "measured break-even is far above DCN bandwidth.  On "
-                       "pure-ICI meshes the measured overhead is small "
-                       "enough that qwZ also breaks even for >=13MB leaves; "
-                       "the cost there is quantization NOISE, not time, so "
-                       "gate it on convergence tolerance, not speed",
+                       "outer hop, hierarchical qgZ inter-group hop): every "
+                       "measured break-even (19-73 GB/s) sits far above DCN "
+                       "bandwidth.  OFF for pure-ICI meshes: ICI's O(100) "
+                       "GB/s links beat the break-even, so int8 there costs "
+                       "time AND noise — exactly the composition the hpZ x "
+                       "qwZ/qgZ region implements (quantize the outer hop "
+                       "only)",
             "config": {
-                "pure_ici": {"zero_quantized_weights": "optional (noise "
-                             "tradeoff only)",
+                "pure_ici": {"zero_quantized_weights": False,
                              "zero_quantized_gradients": False},
                 "multi_host_dcn": {"zero_quantized_weights": True,
                                    "zero_quantized_gradients": True,
