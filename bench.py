@@ -98,6 +98,11 @@ def measure_matmul_peak() -> float:
                        / (_PEAK_ITERS - _PEAK_ITERS_SMALL))
     samples.sort()
     dt = samples[len(samples) // 2]
+    if dt <= 0:
+        # jitter swamped the difference quotient even at the median —
+        # report "unknown" (callers already handle NaN) rather than a
+        # negative or absurd roof
+        return float("nan")
     return 2 * 8192 ** 3 / dt / 1e12
 
 

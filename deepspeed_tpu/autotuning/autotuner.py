@@ -117,11 +117,14 @@ class Autotuner:
 
     def __init__(self, make_engine: Callable[[Dict[str, Any]], Any],
                  make_batch: Callable[[Any], Any],
-                 config: Optional[AutotuningConfig] = None):
+                 config: Optional[AutotuningConfig] = None, model=None):
         self.make_engine = make_engine
         self.make_batch = make_batch
         self.config = config or AutotuningConfig(enabled=True)
         self.records: List[TrialRecord] = []
+        # optional: enables profiler-informed cost-model feature scaling
+        # (see _tune_model_based)
+        self.model = model
 
     # -- candidate space (reference _generate_experiments / tune_space) --
     def sweeps(self) -> List[List[Dict[str, Any]]]:
@@ -185,7 +188,19 @@ class Autotuner:
         S = float(ov.get("_seq_len") or space.get("seq_default") or 1.0)
         Sn = S / max(space.get("seq_scale", 1.0), 1.0)   # normalized seq
         gas = float(ov.get("gradient_accumulation_steps", 1))
-        x = [1.0, mb, mb * mb, Sn * mb, Sn * Sn * mb, Sn, gas, gas * mb]
+        if "dense_coeff" in space and "attn_coeff" in space:
+            # profiler-informed: ONE physical model-flops column
+            # (dc + ac·Sn)·Sn·mb replaces the separate S·mb / S²·mb terms —
+            # the per-module profile pins the dense:attention ratio, so the
+            # ridge has one fewer free parameter to identify from seed
+            # trials.  (Scaling the two columns separately would be a no-op:
+            # the per-column max-abs normalization cancels constant scales.)
+            dc = float(space["dense_coeff"])
+            ac = float(space["attn_coeff"])
+            x = [1.0, mb, mb * mb, (dc + ac * Sn) * Sn * mb, Sn, gas,
+                 gas * mb]
+        else:
+            x = [1.0, mb, mb * mb, Sn * mb, Sn * Sn * mb, Sn, gas, gas * mb]
         off = (ov["zero_optimization"].get("offload_optimizer") or {}
                ).get("device")
         cats = [("stages", ov["zero_optimization"]["stage"]),
@@ -281,6 +296,21 @@ class Autotuner:
             "seq_default": float(seqs[0]),
             "seq_scale": float(max(seqs)),
         }
+        # profiler-informed feature scaling: the S·mb (dense) and S²·mb
+        # (attention) features carry the MODEL'S measured per-token flop
+        # coefficients (flops_profiler per-module breakdown) instead of
+        # unit weights — the ridge fit then starts from physically-scaled
+        # regressors and needs fewer seed trials to separate the two terms
+        try:
+            from ..profiling.flops_profiler import get_detailed_profile
+
+            det = get_detailed_profile(self.model, batch_size=1,
+                                       seq_len=int(space["seq_default"]))
+            tot = det["total"]["flops_per_token"] or 1.0
+            space["dense_coeff"] = det["dense_flops_per_token"] / tot
+            space["attn_coeff"] = det["attn_flops_per_token"] / tot
+        except Exception:
+            pass
         key = lambda ov: json.dumps(ov, sort_keys=True)  # noqa: E731
         measured: Dict[str, TrialRecord] = {}
         best: Optional[TrialRecord] = None
@@ -503,7 +533,12 @@ def autotune(model_factory: Callable[[], Any], base_config: Dict[str, Any],
         engine.autotune_seq_len = seq
         return engine
 
-    tuner = Autotuner(make_engine, batch_factory, at_cfg)
+    try:
+        profile_model = model_factory()
+    except Exception:
+        profile_model = None
+    tuner = Autotuner(make_engine, batch_factory, at_cfg,
+                      model=profile_model)
     best, records = tuner.tune()
     full = None
     if best is not None:

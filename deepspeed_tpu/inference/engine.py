@@ -52,26 +52,13 @@ class InferenceEngine:
         self._config = config or DeepSpeedInferenceConfig()
         self._model = model if hasattr(model, "apply_cached") else None
         self._gen_cache: dict = {}
-        if self._config.use_flash_decode is not None:
-            if self._model is not None and hasattr(self._model, "config") \
-                    and hasattr(self._model.config, "flash_decode"):
-                # engine-scoped: shallow-copy the adapter so the caller's
-                # model (possibly shared with a training engine or another
-                # InferenceEngine) keeps its own decode path
-                import copy
-                import dataclasses as _dc
-
-                m2 = copy.copy(self._model)
-                m2.config = _dc.replace(
-                    m2.config,
-                    flash_decode=bool(self._config.use_flash_decode))
-                self._model = m2
-                model = m2
-            else:
-                logger.warning(
-                    "use_flash_decode is set but the model exposes no "
-                    "flash_decode config (bare apply_fn or non-native "
-                    "adapter) — the knob has no effect")
+        if self._config.use_flash_decode:
+            logger.warning(
+                "use_flash_decode: the Pallas decode kernel was RETIRED in "
+                "round 5 — it lost 21/22 cells of the honest per-(B, T, "
+                "head-mix) A/B (tools/artifacts/decode_r5.json); decode "
+                "always uses the XLA einsum path now.  The knob is accepted "
+                "for config compatibility and ignored.")
         if model is not None:
             apply_fn = apply_fn or getattr(model, "apply_fn", None) or getattr(
                 model, "apply", None)
@@ -180,10 +167,9 @@ class InferenceEngine:
                           top_k=0, top_p=1.0):
         cfg = model.config
 
-        # KV-cache length rounded up to a 128 multiple: the flash-decode
-        # kernel tiles the cache in 128-slot blocks (T % 128 == 0 gate) and
-        # S_pad + max_new almost never lands on one — without this the
-        # use_flash_decode knob could never engage through generate()
+        # KV-cache length rounded up to a 128 multiple: lane-aligned cache
+        # tiles keep the decode einsum on clean XLA tilings (and the bucket
+        # rounding below reuses the same granularity)
         T_cache = -(-(S_pad + max_new) // 128) * 128
 
         def prog(params, tokens, input_mask, positions, rng, eos_id, temperature):

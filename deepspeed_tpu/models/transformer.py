@@ -37,19 +37,6 @@ from jax.sharding import PartitionSpec as P
 
 from ..parallel.mesh import BATCH_AXES, constrain_spec
 
-import os as _os
-
-
-def _flash_decode_enabled() -> bool:
-    """DS_TPU_FLASH_DECODE, read per call.  CAVEAT: under jit the read
-    happens at TRACE time — once a decode program is compiled, toggling the
-    env has no effect until a fresh trace (new shapes or a new process).
-    A/B profiling must restart or change shapes between toggles."""
-    return _os.environ.get(
-        "DS_TPU_FLASH_DECODE", "").strip().lower() not in ("", "0", "false",
-                                                           "off")
-
-
 @dataclasses.dataclass(frozen=True)
 class TransformerConfig:
     vocab_size: int = 32000
@@ -137,10 +124,10 @@ class TransformerConfig:
     act_quant_bits: int = 0
     act_quant_symmetric: bool = False
     scan_layers: bool = True
-    # Pallas flash-decode kernel for KV-cache decode steps: None = the
-    # DS_TPU_FLASH_DECODE env var decides (trace-time); True/False override.
-    # Opt-in because the XLA einsum path measures at the HBM roof on the
-    # bench chip — flip it when a profile on YOUR part says otherwise.
+    # RETIRED knob, accepted for config compat: the Pallas flash-decode
+    # kernel was removed in round 5 after losing 21/22 cells of an honest
+    # per-(B, T, head-mix) A/B (tools/artifacts/decode_r5.json); decode
+    # always rides the XLA einsum now (see _attention_cached)
     flash_decode: Optional[bool] = None
     dtype: Any = jnp.bfloat16                 # compute dtype hint (engine casts)
     initializer_range: float = 0.02
@@ -1333,44 +1320,17 @@ def _attention_cached(cfg, q, ck, cv, q_pos, q_slot, valid, kpos, window=None):
     B, S, Hq, hd = q.shape
     T, Hkv = ck.shape[1], ck.shape[2]
     G = Hq // Hkv
-    flash_decode_on = (cfg.flash_decode if cfg.flash_decode is not None
-                       else _flash_decode_enabled())  # trace-time under jit
-    if (S == 1 and cfg.position != "alibi" and T % 128 == 0
-            and hd % 8 == 0 and flash_decode_on and window is None):
-        # decode step: the Pallas flash-decode kernel streams the cache
-        # through VMEM once (no [Hq,T] HBM score matrix).  Opt-in: decode is
-        # HBM-bandwidth bound and XLA's fused einsum already sits at the
-        # measured roof on the bench chip (T=8192, B=8: kernel 6.2-7.1ms vs
-        # xla 4.5-7.4ms across MHA/GQA head mixes — within noise, either
-        # side); flip the default if a profile on YOUR part says otherwise.
-        # Single-shard only — a model-sharded cache routes through the XLA
-        # einsum, which GSPMD partitions (the kernel has no SPMD rule).
-        from ..parallel import mesh as mesh_mod
-
-        m = mesh_mod._GLOBAL_MESH
-        unsharded = m is None or all(s == 1 for s in m.shape.values())
-        dp = 1 if m is None else mesh_mod.axis_size(m, BATCH_AXES)
-        batch_only = (m is not None and m.shape["model"] == 1
-                      and m.shape["seq"] == 1 and m.shape["pipe"] == 1
-                      and B % dp == 0)
-        if unsharded or batch_only:
-            from ..ops.pallas.decode_attention import flash_decode
-
-            slot_t = jnp.arange(T, dtype=jnp.int32)
-            ok = valid & (slot_t[None, :] <= q_slot[0])     # q_slot: [S=1]
-            sm = 1.0 / math.sqrt(hd)
-            if unsharded:
-                out = flash_decode(q[:, 0], ck, cv, ok, sm_scale=sm)
-            else:
-                # batch rides the DP axes; run the kernel per-shard
-                qs = P(BATCH_AXES, None, None)
-                cs = P(BATCH_AXES, None, None, None)
-                fd = mesh_mod.shard_map_compat(
-                    functools.partial(flash_decode, sm_scale=sm),
-                    m, in_specs=(qs, cs, cs, P(BATCH_AXES, None)),
-                    out_specs=qs)
-                out = fd(q[:, 0], ck, cv, ok)
-            return out[:, None]
+    # There is deliberately NO custom decode kernel here.  A Pallas
+    # flash-decode shipped in rounds 2-4 and was REMOVED in round 5 after
+    # an honest per-cell A/B (tools/decode_bench.py ->
+    # tools/artifacts/decode_r5.json): the XLA einsum below won 21/22
+    # (B, T, head-mix) cells (its one loss is a jitter outlier: an
+    # anomalous 2x-slow XLA sample at a shape XLA wins at the next size
+    # up) — decode attention is HBM-bound, XLA
+    # saturates the bandwidth, and at small GQA caches it additionally
+    # keeps the cache VMEM-resident across the generate scan, which a
+    # per-call kernel cannot.  The einsum also GSPMD-partitions for every
+    # sharded layout a kernel would need bespoke rules for.
     qg = q.reshape(B, S, Hkv, G, hd)
     scores = jnp.einsum("bskgd,btkd->bkgst", qg, ck).astype(jnp.float32)
     scores = scores * _sm_scale(cfg, hd)

@@ -1,5 +1,6 @@
 """Profiling (reference ``deepspeed/profiling/``): XLA-cost-analysis flops
 profiler; wall-clock breakdown lives in utils/timer.py."""
-from .flops_profiler import FlopsProfiler, get_model_profile
+from .flops_profiler import (FlopsProfiler, get_detailed_profile,
+                             get_model_profile)
 
-__all__ = ["FlopsProfiler", "get_model_profile"]
+__all__ = ["FlopsProfiler", "get_model_profile", "get_detailed_profile"]

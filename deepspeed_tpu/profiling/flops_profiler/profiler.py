@@ -163,6 +163,18 @@ def get_model_profile(model, batch_size: int = 1, seq_len: int = 128,
                 lines.append(f"HBM bytes accessed:     {_number(float(ba))}B")
                 lines.append(f"arithmetic intensity:   {flops / float(ba):.1f} flop/B")
         lines.append("-" * 72)
+        if detailed:
+            # per-module rows (reference module tree, profiler.py:273)
+            try:
+                det = get_detailed_profile(model, batch_size, seq_len)
+                lines.append(f"{'module':<38}{'count':>6}{'flops':>12}"
+                             f"{'%':>7}")
+                for r in det["modules"]:
+                    lines.append(f"{r['name']:<38}{r['count']:>6}"
+                                 f"{_number(r['flops']):>12}{r['pct']:>6.1f}%")
+                lines.append("-" * 72)
+            except Exception as e:  # het/MoE configs may lack a block slice
+                lines.append(f"(per-module breakdown unavailable: {e})")
         report = "\n".join(lines)
         if output_file:
             with open(output_file, "w") as f:
@@ -173,6 +185,133 @@ def get_model_profile(model, batch_size: int = 1, seq_len: int = 128,
     if as_string:
         return flops_to_string(flops), macs_to_string(macs), params_to_string(nparams)
     return flops, macs, nparams
+
+
+def get_detailed_profile(model, batch_size: int = 1, seq_len: int = 128,
+                         print_profile: bool = False):
+    """Per-module breakdown (reference ``FlopsProfiler`` module tree,
+    profiler.py:273/493): the reference hooks every nn.Module and counts
+    MACs per call; post-fusion HLO has no module boundaries, so the TPU
+    build COST-ANALYZES PER-BLOCK PROGRAMS of the same building blocks the
+    model's forward composes (embed / per-layer attention core / per-layer
+    MLP / full layer / lm_head) and derives the rest (projections, norms,
+    residuals, loss) as measured remainders.
+
+    Returns ``{"total": {...}, "modules": [row, ...]}`` where each row has
+    ``name / flops / bytes / pct / count`` (count = L for per-layer rows).
+    The ``dense_flops_per_token`` / ``attn_flops_per_token`` keys feed the
+    autotuner's cost-model features.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ...models import transformer as T
+
+    # totals must come from the UNROLLED program: XLA cost analysis counts
+    # a lax.scan body once, not trip-count times (same handling as
+    # get_model_profile)
+    cfg0 = getattr(model, "config", None)
+    if cfg0 is not None and getattr(cfg0, "scan_layers", False):
+        try:
+            model = type(model)(cfg0, scan_layers=False)
+        except Exception:
+            pass
+    cfg = model.config
+    # pin attention to the XLA path everywhere: the Pallas kernel engages
+    # under 'auto' at S>=2048 and its custom-call flops are INVISIBLE to
+    # cost_analysis — mixing paths would misattribute attention and could
+    # push the derived dense coefficient negative
+    if getattr(model, "attn_impl", "xla") != "xla":
+        import copy
+
+        model = copy.copy(model)   # never mutate the caller's model
+        model.attn_impl = "xla"
+    params = model.init_fn(jax.random.PRNGKey(0))
+    compute_dtype = getattr(cfg, "dtype", None) or jnp.float32
+    params = jax.tree_util.tree_map(
+        lambda x: x.astype(compute_dtype)
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+        else x, params)
+    layers = params["layers"]
+    stacked = jax.tree_util.tree_leaves(layers)[0].ndim >= 1 and \
+        jax.tree_util.tree_leaves(layers)[0].shape[0] == cfg.num_layers
+    lp0 = (jax.tree_util.tree_map(lambda x: x[0], layers) if stacked
+           else layers)
+    L = cfg.num_layers
+    B, S, d = batch_size, seq_len, cfg.hidden_size
+    hd, nh, nkv = cfg.dims_per_head, cfg.num_heads, cfg.kv_heads
+    rng = jax.random.PRNGKey(0)
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    x = jax.random.normal(rng, (B, S, d), compute_dtype)
+    q = jax.random.normal(rng, (B, S, nh, hd), compute_dtype)
+    kv = jax.random.normal(rng, (B, S, nkv, hd), compute_dtype)
+    tokens = jnp.zeros((B, S), jnp.int32)
+
+    def _flops_bytes(fn, *args):
+        ca = cost_analysis_of(jax.jit(fn), *args)
+        return float(ca.get("flops", 0.0)), float(ca.get("bytes accessed",
+                                                         0.0))
+
+    rows = []
+
+    def add(name, fl, by, count=1):
+        rows.append({"name": name, "flops": fl * count, "bytes": by * count,
+                     "count": count})
+
+    emb_f, emb_b = _flops_bytes(lambda e, t: jnp.take(e, t, axis=0),
+                                params["embed"], tokens)
+    add("embed", emb_f, emb_b)
+    attn_f, attn_b = _flops_bytes(
+        lambda q, k, v: T._attention(cfg, q, k, v, positions, "xla"),
+        q, kv, kv)
+    mlp_f, mlp_b = _flops_bytes(
+        lambda lp, h: T._mlp(cfg, lp, h, rng, True)[0], lp0, x)
+    blk_f, blk_b = _flops_bytes(
+        lambda lp, h: T._block(cfg, lp, h, positions, rng, "xla", True)[0],
+        lp0, x)
+    proj_f = max(blk_f - attn_f - mlp_f, 0.0)
+    proj_b = max(blk_b - attn_b - mlp_b, 0.0)
+    add("layer.attention_core", attn_f, attn_b, count=L)
+    add("layer.qkv_out_projections+norms", proj_f, proj_b, count=L)
+    add("layer.mlp", mlp_f, mlp_b, count=L)
+    head_f, head_b = _flops_bytes(lambda w, h: h @ w, params["lm_head"], x)
+    add("lm_head", head_f, head_b)
+
+    total_f, total_b = _flops_bytes(model.apply_fn, params, tokens)
+    accounted_f = sum(r["flops"] for r in rows)
+    accounted_b = sum(r["bytes"] for r in rows)
+    add("other (final norm, residuals, loss)",
+        max(total_f - accounted_f, 0.0), max(total_b - accounted_b, 0.0))
+    for r in rows:
+        r["pct"] = round(100.0 * r["flops"] / total_f, 1) if total_f else 0.0
+
+    ntok = B * S
+    out = {
+        "total": {"flops": total_f, "bytes": total_b,
+                  "flops_per_token": total_f / ntok},
+        "modules": rows,
+        "dense_flops_per_token": max(total_f - attn_f * L, 0.0) / ntok,
+        "attn_flops_per_token": attn_f * L / ntok,
+        "batch_size": B, "seq_len": S,
+    }
+    if print_profile:
+        lines = ["-" * 72,
+                 "DeepSpeed-TPU Flops Profiler — per-module breakdown "
+                 f"(B={B}, S={S})",
+                 "-" * 72,
+                 f"{'module':<38}{'count':>6}{'flops':>12}{'bytes':>12}"
+                 f"{'%':>6}"]
+        for r in rows:
+            lines.append(f"{r['name']:<38}{r['count']:>6}"
+                         f"{_number(r['flops']):>12}"
+                         f"{_number(r['bytes']):>12}B{r['pct']:>5.1f}")
+        lines.append(f"{'TOTAL (compiled forward)':<38}{'':>6}"
+                     f"{_number(total_f):>12}{_number(total_b):>12}B"
+                     f"{100.0:>5.1f}")
+        lines.append("-" * 72)
+        logger.info("\n" + "\n".join(lines))
+    return out
 
 
 class FlopsProfiler:

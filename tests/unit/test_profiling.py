@@ -115,3 +115,51 @@ def test_comms_logger_bandwidth_columns():
     assert len(bw_cols) == 2 and all(float(c) > 0 for c in bw_cols), ar_row
     weird_row = [ln for ln in lines if "123" in ln or "123.0" in ln]
     assert weird_row and "-" in weird_row[0]
+
+
+def test_detailed_profile_per_module_rows():
+    """Per-module breakdown (reference profiler.py:273 module tree): rows
+    for embed / attention / projections / mlp / lm_head, per-layer counts,
+    and module flops summing to the unrolled compiled total."""
+    from deepspeed_tpu.models import CausalLM
+    from deepspeed_tpu.profiling import get_detailed_profile
+
+    model = CausalLM("tiny")
+    det = get_detailed_profile(model, batch_size=2, seq_len=128)
+    names = [r["name"] for r in det["modules"]]
+    assert "embed" in names and "lm_head" in names
+    assert any("attention_core" in n for n in names)
+    assert any("mlp" in n for n in names)
+    L = model.config.num_layers
+    per_layer = [r for r in det["modules"] if r["count"] == L]
+    assert len(per_layer) >= 3
+    total = det["total"]["flops"]
+    assert total > 0
+    acc = sum(r["flops"] for r in det["modules"])
+    # 'other' row is the residual, so the rows account for the whole total
+    assert abs(acc - total) / total < 0.05
+    assert det["dense_flops_per_token"] > 0
+    assert det["attn_flops_per_token"] > 0
+
+
+def test_detailed_profile_feeds_autotuner_features():
+    from deepspeed_tpu.autotuning.autotuner import Autotuner
+
+    space = {"stages": [0], "remats": [None], "attns": [None],
+             "offloads": [None], "pps": [None], "seq_default": 128.0,
+             "seq_scale": 256.0, "dense_coeff": 0.7, "attn_coeff": 0.3}
+    ov = {"train_micro_batch_size_per_gpu": 4,
+          "zero_optimization": {"stage": 0}, "_seq_len": 128}
+    x = Autotuner._features(ov, space)
+    # profiled: ONE combined physical column (dc + ac*Sn)*Sn*mb replaces
+    # the separate S*mb / S^2*mb terms (feature vector is one SHORTER) —
+    # a per-column rescale would be cancelled by the max-abs normalization
+    Sn = 0.5
+    assert x[3] == (0.7 + 0.3 * Sn) * Sn * 4
+    x0 = Autotuner._features(ov, {k: v for k, v in space.items()
+                                  if "coeff" not in k})
+    assert len(x0) == len(x) + 1          # generic form keeps both columns
+    # the profile changes the feature SPAN across seq lens, not just scale:
+    ov2 = dict(ov, _seq_len=256)
+    x2 = Autotuner._features(ov2, space)
+    assert x2[3] / x[3] != 2.0            # non-constant ratio vs S*mb alone

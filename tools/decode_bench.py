@@ -1,0 +1,102 @@
+"""Decode-attention A/B: Pallas flash-decode vs the XLA einsum path,
+per (batch, KV-length, head-mix) cell, timed honestly (tools/chiptimer.py).
+
+Round 4 shipped the kernel opt-in-off after an end-to-end A/B at ONE cell
+(B=32, T=8192) showed it losing.  This grid measured the attention OP
+itself across the regimes the round-4 verdict named (long KV, small
+batch, GQA).  OUTCOME: XLA won 21/22 cells (the one pallas "win" sits
+next to an anomalous 2x-slower XLA sample at the same shape — a jitter
+outlier), so the kernel was DELETED from the product; the copy in
+tools/retired_decode_attention.py exists only to keep this A/B
+reproducible.
+
+Writes tools/artifacts/decode_r5.json.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "artifacts",
+                   "decode_r5.json")
+
+
+def xla_decode(q, ck, cv, ok, sm_scale):
+    """The einsum path of models/transformer.py:_attention_cached,
+    decode-shaped: q [B,Hq,hd], cache [B,T,Hkv,hd], ok [B,T]."""
+    B, Hq, hd = q.shape
+    T, Hkv = ck.shape[1], ck.shape[2]
+    G = Hq // Hkv
+    qg = q.reshape(B, Hkv, G, hd)
+    s = jnp.einsum("bkgd,btkd->bkgt", qg, ck).astype(jnp.float32) * sm_scale
+    s = jnp.where(ok[:, None, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bkgt,btkd->bkgd", p, cv).reshape(B, Hq, hd)
+
+
+def main() -> None:
+    from chiptimer import device_time
+    from retired_decode_attention import flash_decode
+
+    dev = jax.devices()[0]
+    rng = jax.random.PRNGKey(0)
+    hd = 128
+    cells = []
+    for Hq, Hkv in ((16, 16), (32, 8)):         # MHA and GQA(4x)
+        for B in (1, 8, 32):
+            for T in (2048, 8192, 16384, 32768):
+                if B * T > 32 * 16384:           # cache memory cap
+                    continue
+                cells.append((Hq, Hkv, B, T))
+
+    rows = []
+    for Hq, Hkv, B, T in cells:
+        ks = jax.random.split(rng, 3)
+        q = jax.random.normal(ks[0], (B, Hq, hd), jnp.bfloat16)
+        ck = jax.random.normal(ks[1], (B, T, Hkv, hd), jnp.bfloat16)
+        cv = jax.random.normal(ks[2], (B, T, Hkv, hd), jnp.bfloat16)
+        ok = jnp.ones((B, T), bool)
+        sm = 1.0 / math.sqrt(hd)
+
+        # chain on q only (the cache stays resident, as in real decode)
+        def step_pallas(c):
+            return (flash_decode(c[0], c[1], c[2], c[3],
+                                 sm_scale=sm).astype(c[0].dtype),
+                    c[1], c[2], c[3])
+
+        def step_xla(c):
+            return (xla_decode(c[0], c[1], c[2], c[3], sm).astype(c[0].dtype),
+                    c[1], c[2], c[3])
+
+        args = (q, ck, cv, ok)
+        t_p = device_time(step_pallas, args)
+        t_x = device_time(step_xla, args)
+        cache_mb = 2 * B * T * Hkv * hd * 2 / 2 ** 20
+        rows.append({
+            "Hq": Hq, "Hkv": Hkv, "B": B, "T": T,
+            "cache_mb": round(cache_mb, 1),
+            "pallas_us": round(t_p * 1e6, 1),
+            "xla_us": round(t_x * 1e6, 1),
+            "winner": "pallas" if t_p < t_x else "xla",
+            "speedup_vs_xla": round(t_x / t_p, 3),
+        })
+        print(rows[-1], flush=True)
+
+    result = {"platform": dev.platform, "device": str(dev), "hd": hd,
+              "rows": rows}
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "w") as f:
+        json.dump(result, f, indent=2)
+    print(f"wrote {OUT}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,4 +1,11 @@
-"""Pallas flash-decode: single-token attention against the KV cache.
+"""RETIRED round-5: Pallas flash-decode, kept ONLY so tools/decode_bench.py
+can reproduce the A/B that justified deleting it from the product
+(tools/artifacts/decode_r5.json: XLA won 21/22 cells; the single pallas
+"win" is an XLA jitter outlier).  Not imported by deepspeed_tpu.
+
+Original docstring:
+
+Pallas flash-decode: single-token attention against the KV cache.
 
 TPU-native analogue of the reference's fused decode attention
 (``csrc/transformer/inference/csrc/softmax.cu`` ``attn_softmax_context`` —
@@ -33,8 +40,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_BLOCK_T = 512
 
-from .common import (NEG_INF, interpret_default as _interpret_default,  # noqa: E402
-                     mask_to_i32, parallel_semantics)
+import os as _os, sys as _sys  # noqa: E402
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+from deepspeed_tpu.ops.pallas.common import (  # noqa: E402
+    NEG_INF, interpret_default as _interpret_default, mask_to_i32,
+    parallel_semantics)
 
 # B is independent; the T sweep carries the online-softmax state.
 _COMPILER_PARAMS = parallel_semantics(1, 1)
