@@ -190,14 +190,20 @@ class Autotuner:
         gas = float(ov.get("gradient_accumulation_steps", 1))
         if "dense_coeff" in space and "attn_coeff" in space:
             # profiler-informed: ONE physical model-flops column
-            # (dc + ac·Sn)·Sn·mb replaces the separate S·mb / S²·mb terms —
-            # the per-module profile pins the dense:attention ratio, so the
-            # ridge has one fewer free parameter to identify from seed
-            # trials.  (Scaling the two columns separately would be a no-op:
-            # the per-column max-abs normalization cancels constant scales.)
+            # (dc + ac·(S/S₀))·Sn·mb replaces the separate S·mb / S²·mb
+            # terms — the per-module profile pins the dense:attention
+            # ratio, so the ridge has one fewer free parameter to identify
+            # from seed trials.  (Scaling the two columns separately would
+            # be a no-op: the per-column max-abs normalization cancels
+            # constant scales.)  The coefficients were MEASURED at
+            # S₀ = seq_default, and attention flops/token scale linearly
+            # in S, so the ratio term must be S/S₀ — normalizing by
+            # seq_scale instead would mis-weight attention by
+            # seq_scale/seq_default at the profiled point.
             dc = float(space["dense_coeff"])
             ac = float(space["attn_coeff"])
-            x = [1.0, mb, mb * mb, (dc + ac * Sn) * Sn * mb, Sn, gas,
+            r = S / max(float(space.get("seq_default", 1.0)), 1.0)
+            x = [1.0, mb, mb * mb, (dc + ac * r) * Sn * mb, Sn, gas,
                  gas * mb]
         else:
             x = [1.0, mb, mb * mb, Sn * mb, Sn * Sn * mb, Sn, gas, gas * mb]

@@ -215,8 +215,15 @@ def get_detailed_profile(model, batch_size: int = 1, seq_len: int = 128,
     if cfg0 is not None and getattr(cfg0, "scan_layers", False):
         try:
             model = type(model)(cfg0, scan_layers=False)
-        except Exception:
-            pass
+        except Exception as e:
+            # silently keeping the scanned model would count the scan body
+            # ONCE against per-layer rows multiplied by L — garbage
+            # percentages and a clamped-to-zero dense coefficient that
+            # would silently skew the autotuner's cost model
+            raise RuntimeError(
+                f"get_detailed_profile: cannot rebuild {type(model).__name__} "
+                f"with scan_layers=False ({e}); per-module totals need the "
+                "unrolled program") from e
     cfg = model.config
     # pin attention to the XLA path everywhere: the Pallas kernel engages
     # under 'auto' at S>=2048 and its custom-call flops are INVISIBLE to

@@ -124,7 +124,25 @@ def _base_transform(name: str, params: Dict[str, Any]) -> optax.GradientTransfor
         params = {k: v for k, v in params.items()
                   if k not in ("freeze_step", "cuda_aware", "comm_backend_name")}
         return _base_transform(ADAM_OPTIMIZER, params)
-    if name in (LAMB_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER):
+    if name == ONEBIT_LAMB_OPTIMIZER:
+        # compensated 1-bit LAMB (reference fp16/onebit/lamb.py): frozen
+        # variance + factor-scaled frozen trust ratio after freeze_step;
+        # the EF-compressed grad exchange is wired by the engine with the
+        # SAME freeze_step, so wire compression and variance freeze flip
+        # together
+        from .fp16.onebit_lamb import scale_by_onebit_lamb
+
+        return scale_by_onebit_lamb(
+            b1=b1, b2=b2, eps=eps,
+            freeze_step=int(params.get("freeze_step", 100)),
+            weight_decay=weight_decay,
+            max_coeff=float(params.get("max_coeff", 10.0)),
+            min_coeff=float(params.get("min_coeff", 0.01)),
+            coeff_beta=float(params.get("coeff_beta", 0.9)),
+            factor_max=float(params.get("factor_max", 4.0)),
+            factor_min=float(params.get("factor_min", 0.5)),
+            factor_threshold=float(params.get("factor_threshold", 0.1)))
+    if name == LAMB_OPTIMIZER:
         return optax.chain(
             _adam_core(),
             optax.add_decayed_weights(weight_decay) if weight_decay else optax.identity(),

@@ -104,3 +104,17 @@ def test_model_pallas_path_matches_xla():
     a = forward(cfg, params, tokens, attn_impl="xla", seq_sharded=False)
     b = forward(cfg, params, tokens, attn_impl="pallas", seq_sharded=False)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+
+
+def test_pick_block_floor_contract():
+    """pick_block drives production tile selection for the flash kernels
+    (previously covered by the deleted decode-kernel test file)."""
+    import pytest
+
+    from deepspeed_tpu.ops.pallas.common import pick_block
+
+    assert pick_block(1024, 512, floor=128) == 512
+    assert pick_block(4, 1024) == 4            # full-axis tile below floor ok
+    assert pick_block(192, 512, floor=128) == 192  # full-axis tile
+    with pytest.raises(NotImplementedError):
+        pick_block(192, 128, floor=128)        # 128 does not divide 192

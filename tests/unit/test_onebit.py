@@ -142,3 +142,62 @@ def test_onebit_lamb_trains():
     losses = _train(_make_engine("onebitlamb", freeze_step=2, lr=5e-3), steps=6,
                     fixed_batch=True)
     assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+# ----------------------------------------------------- compensated 1-bit LAMB
+def test_onebit_lamb_warmup_matches_plain_lamb():
+    """Warmup (full-precision) steps of the compensated optimizer must track
+    plain LAMB: same Adam moments, same clipped trust ratio."""
+    ref = _train(_make_engine("lamb", lr=5e-3), steps=3, fixed_batch=True)
+    mesh_mod.reset_mesh()
+    ob = _train(_make_engine("onebitlamb", freeze_step=100, lr=5e-3),
+                steps=3, fixed_batch=True)
+    np.testing.assert_allclose(ob, ref, rtol=2e-2, atol=1e-3)
+
+
+def test_onebit_lamb_convergence_parity_vs_lamb():
+    """Convergence parity across the freeze boundary (the methodology of
+    test_zero_one_adam's Adam-tracking test): the compressed-stage
+    compensated updates must keep descending and land near plain LAMB."""
+    ref = _train(_make_engine("lamb", lr=5e-3), steps=12, fixed_batch=True)
+    mesh_mod.reset_mesh()
+    ob = _train(_make_engine("onebitlamb", freeze_step=3, lr=5e-3),
+                steps=12, fixed_batch=True)
+    assert np.isfinite(ob).all()
+    np.testing.assert_allclose(ob[:3], ref[:3], rtol=2e-2, atol=1e-3)
+    assert ob[-1] < ob[3]                      # still optimizing compressed
+    assert ob[-1] < 4 * ref[-1] + 0.05         # tracks plain LAMB's level
+
+
+def test_onebit_lamb_variance_freezes():
+    """After freeze_step the SECOND MOMENT must stop moving (the defining
+    compensation property) while the shadow nu_fresh keeps updating."""
+    engine = _make_engine("onebitlamb", freeze_step=2, lr=5e-3)
+    for s in range(3):
+        engine.train_batch(batch=random_batch(engine.train_batch_size, HID, s))
+
+    def find_state(tree):
+        from deepspeed_tpu.runtime.fp16.onebit_lamb import OnebitLambState
+
+        for leaf in jax.tree_util.tree_leaves(
+                tree, is_leaf=lambda x: isinstance(x, OnebitLambState)):
+            if isinstance(leaf, OnebitLambState):
+                return leaf
+        raise AssertionError("no OnebitLambState in opt_state")
+
+    st1 = find_state(engine.state.opt_state)
+    nu1 = jax.tree_util.tree_map(np.asarray, st1.nu)
+    fresh1 = jax.tree_util.tree_map(np.asarray, st1.nu_fresh)
+    engine.train_batch(batch=random_batch(engine.train_batch_size, HID, 9))
+    st2 = find_state(engine.state.opt_state)
+    for a, b in zip(jax.tree_util.tree_leaves(nu1),
+                    jax.tree_util.tree_leaves(st2.nu)):
+        np.testing.assert_array_equal(a, np.asarray(b))   # frozen
+    moved = any(not np.array_equal(a, np.asarray(b))
+                for a, b in zip(jax.tree_util.tree_leaves(fresh1),
+                                jax.tree_util.tree_leaves(st2.nu_fresh)))
+    assert moved                                          # shadow keeps going
+    # rate-limited factor memory stays within the clip band
+    for f in jax.tree_util.tree_leaves(st2.last_factor):
+        v = float(f)
+        assert 0.5 <= v <= 4.0

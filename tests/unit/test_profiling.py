@@ -154,8 +154,10 @@ def test_detailed_profile_feeds_autotuner_features():
     # profiled: ONE combined physical column (dc + ac*Sn)*Sn*mb replaces
     # the separate S*mb / S^2*mb terms (feature vector is one SHORTER) —
     # a per-column rescale would be cancelled by the max-abs normalization
-    Sn = 0.5
-    assert x[3] == (0.7 + 0.3 * Sn) * Sn * 4
+    # ratio term is S/seq_default (coefficients were MEASURED there, and
+    # attention flops/token are linear in S); outer scale stays Sn
+    Sn, r = 0.5, 1.0
+    assert x[3] == (0.7 + 0.3 * r) * Sn * 4
     x0 = Autotuner._features(ov, {k: v for k, v in space.items()
                                   if "coeff" not in k})
     assert len(x0) == len(x) + 1          # generic form keeps both columns
