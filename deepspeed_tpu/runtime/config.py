@@ -321,9 +321,69 @@ class DataRoutingConfig(DeepSpeedConfigModel):
     random_ltd: RandomLTDConfig = Field(default_factory=RandomLTDConfig)
 
 
+class CurriculumMetricConfig(DeepSpeedConfigModel):
+    """One metric of the multi-metric curriculum (reference
+    ``data_efficiency.data_sampling.curriculum_learning.curriculum_metrics``
+    entries, constants.py CURRICULUM_LEARNING_METRICS)."""
+
+    metric_values_path: str  # a DataAnalyzer `<metric>_values.npy`
+    difficulty_type: str = "value"          # 'value' | 'percentile'
+    clustering_type: str = "schedule_based"  # | 'single_cluster'
+    min_difficulty: int = 1
+    max_difficulty: int = 100
+    schedule_type: str = "fixed_linear"
+    schedule_config: Dict[str, Any] = Field(default_factory=dict)
+
+    @model_validator(mode="after")
+    def _validate(self):
+        if self.difficulty_type not in ("value", "percentile"):
+            raise ValueError(
+                f"difficulty_type={self.difficulty_type!r}: 'value' or "
+                "'percentile'")
+        if self.clustering_type not in ("schedule_based", "single_cluster"):
+            raise ValueError(
+                f"clustering_type={self.clustering_type!r}: "
+                "'schedule_based' or 'single_cluster'")
+        return self
+
+
+class CurriculumLearningConfig(DeepSpeedConfigModel):
+    """Multi-metric cluster-bucketed curriculum (reference
+    data_sampling/data_sampler.py:36 DeepSpeedDataSampler)."""
+
+    enabled: bool = False
+    curriculum_metrics: Dict[str, CurriculumMetricConfig] = Field(
+        default_factory=dict)
+
+    @model_validator(mode="after")
+    def _validate(self):
+        if self.enabled and not self.curriculum_metrics:
+            raise ValueError(
+                "data_sampling.curriculum_learning.enabled needs >=1 entry "
+                "in curriculum_metrics")
+        return self
+
+
+class DataSamplingConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    curriculum_learning: CurriculumLearningConfig = Field(
+        default_factory=CurriculumLearningConfig)
+
+    @model_validator(mode="after")
+    def _validate(self):
+        if self.curriculum_learning.enabled and not self.enabled:
+            raise ValueError(
+                "data_sampling.curriculum_learning.enabled=true requires "
+                "data_sampling.enabled=true (the engine gates on both — a "
+                "silently-ignored curriculum would train uniformly)")
+        return self
+
+
 class DataEfficiencyConfig(DeepSpeedConfigModel):
     enabled: bool = False
     data_routing: DataRoutingConfig = Field(default_factory=DataRoutingConfig)
+    data_sampling: DataSamplingConfig = Field(
+        default_factory=DataSamplingConfig)
 
 
 class AIOConfig(DeepSpeedConfigModel):

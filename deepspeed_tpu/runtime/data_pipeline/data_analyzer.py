@@ -37,9 +37,19 @@ class DataAnalyzer:
     def __init__(self, metric_fn: Optional[Callable] = None,
                  metric_name: str = DEFAULT_METRIC,
                  num_workers: int = 1, worker_id: int = 0,
-                 run_id: Optional[str] = None):
-        self.metric_fn = metric_fn or _seqlen_metric
-        self.metric_name = metric_name
+                 run_id: Optional[str] = None,
+                 metrics: Optional[dict] = None):
+        """``metrics={'name': fn, ...}`` analyzes SEVERAL metrics in one
+        dataset pass (reference DataAnalyzer's metric_names/metric_functions
+        lists); the single ``metric_fn``/``metric_name`` form is the
+        one-metric special case."""
+        self.metrics = dict(metrics) if metrics else {
+            metric_name: metric_fn or _seqlen_metric}
+        if metrics and (metric_fn is not None):
+            raise ValueError("pass either metrics={...} or metric_fn, not both")
+        # single-metric accessors kept for the existing API surface
+        self.metric_name = next(iter(self.metrics))
+        self.metric_fn = self.metrics[self.metric_name]
         self.num_workers = num_workers
         self.worker_id = worker_id
         # per-run nonce: (dataset_len, num_workers) alone would silently
@@ -49,31 +59,45 @@ class DataAnalyzer:
         self.run_id = run_id
 
     # -- map -------------------------------------------------------------
-    def _shard_file(self, save_path: str, worker_id: int) -> str:
-        return os.path.join(save_path,
-                            f"{self.metric_name}_w{worker_id}.npz")
+    def _shard_file(self, save_path: str, worker_id: int,
+                    metric_name: Optional[str] = None) -> str:
+        return os.path.join(
+            save_path, f"{metric_name or self.metric_name}_w{worker_id}.npz")
 
     def run_map(self, dataset: Sequence, save_path: str,
                 worker_id: Optional[int] = None) -> str:
-        """Scan this worker's stride-shard, persist (indices, values)."""
+        """Scan this worker's stride-shard ONCE, computing every metric;
+        persist (indices, values) per metric."""
         wid = self.worker_id if worker_id is None else worker_id
         os.makedirs(save_path, exist_ok=True)
         idx = np.arange(wid, len(dataset), self.num_workers)
-        vals = np.asarray([self.metric_fn(dataset[int(i)]) for i in idx],
-                          np.float64)
-        out = self._shard_file(save_path, wid)
-        # fingerprint guards the reduce against merging shards from a
-        # different analysis run left behind in the same save_path
-        np.savez(out, indices=idx, values=vals,
-                 dataset_len=np.int64(len(dataset)),
-                 num_workers=np.int64(self.num_workers),
-                 run_id=np.asarray(self.run_id or ""))
+        # fetch each sample ONCE (disk/mmap datasets: k metrics must not
+        # mean k decode passes)
+        rows = []
+        for i in idx:
+            s = dataset[int(i)]
+            rows.append([fn(s) for fn in self.metrics.values()])
+        arr = np.asarray(rows, np.float64).reshape(len(idx),
+                                                   len(self.metrics))
+        out = None
+        for col, name in enumerate(self.metrics):
+            out_m = self._shard_file(save_path, wid, name)
+            # fingerprint guards the reduce against merging shards from a
+            # different analysis run left behind in the same save_path
+            np.savez(out_m, indices=idx, values=arr[:, col],
+                     dataset_len=np.int64(len(dataset)),
+                     num_workers=np.int64(self.num_workers),
+                     run_id=np.asarray(self.run_id or ""))
+            if name == self.metric_name:
+                out = out_m
         return out
 
     # -- reduce ----------------------------------------------------------
-    def run_reduce(self, save_path: str) -> str:
+    def run_reduce(self, save_path: str,
+                   metric_name: Optional[str] = None) -> str:
         """Merge every worker shard into the aligned value/order arrays."""
-        parts = [self._shard_file(save_path, w)
+        metric_name = metric_name or self.metric_name
+        parts = [self._shard_file(save_path, w, metric_name)
                  for w in range(self.num_workers)]
         missing = [p for p in parts if not os.path.exists(p)]
         if missing:
@@ -103,7 +127,7 @@ class DataAnalyzer:
         if np.isnan(values).any():
             raise ValueError("reduce found sample indices no worker covered "
                              "— num_workers mismatch between map and reduce?")
-        vpath = os.path.join(save_path, f"{self.metric_name}_values.npy")
+        vpath = os.path.join(save_path, f"{metric_name}_values.npy")
         np.save(vpath, values)
         return vpath
 
@@ -116,8 +140,16 @@ class DataAnalyzer:
         with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
             list(pool.map(lambda w: self.run_map(dataset, save_path, w),
                           range(self.num_workers)))
-        self.run_reduce(save_path)
+        for name in self.metrics:
+            self.run_reduce(save_path, name)
         return load_metric_values(save_path, self.metric_name)
+
+    def run_multi(self, dataset: Sequence, save_path: str) -> dict:
+        """One dataset pass, every metric merged:
+        ``{name: aligned values array}``."""
+        self.run(dataset, save_path)
+        return {name: load_metric_values(save_path, name)
+                for name in self.metrics}
 
 
 def load_metric_values(save_path: str,

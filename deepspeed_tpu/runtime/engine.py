@@ -573,6 +573,48 @@ class DeepSpeedEngine:
         from .dataloader import DeepSpeedDataLoader
 
         sampler = None
+        ds_cfg = self.config.data_efficiency.data_sampling
+        if ds_cfg.enabled and ds_cfg.curriculum_learning.enabled:
+            if self._curriculum_metric_path is not None:
+                raise ValueError(
+                    "both the legacy curriculum_learning.metric_values_path "
+                    "sampler and data_efficiency.data_sampling."
+                    "curriculum_learning are configured — they would fight "
+                    "over the batch stream; enable exactly one")
+            # multi-metric cluster-bucketed curriculum (reference
+            # DeepSpeedDataSampler); per-metric values come from
+            # DataAnalyzer runs, schedulers from per-metric configs
+            from .data_pipeline.curriculum_scheduler import \
+                CurriculumScheduler
+            from .data_pipeline.data_sampler import \
+                MultiMetricCurriculumSampler
+
+            metrics = {}
+            for name, mc in ds_cfg.curriculum_learning.curriculum_metrics.items():
+                values = np.load(mc.metric_values_path)
+                if len(values) != len(training_data):
+                    raise ValueError(
+                        f"curriculum metric {name!r} has {len(values)} "
+                        f"values for a dataset of {len(training_data)} "
+                        "samples")
+                metrics[name] = {
+                    "values": values,
+                    "difficulty_type": mc.difficulty_type,
+                    "clustering_type": mc.clustering_type,
+                    "scheduler": CurriculumScheduler({
+                        "curriculum_type": name,
+                        "min_difficulty": mc.min_difficulty,
+                        "max_difficulty": mc.max_difficulty,
+                        "schedule_type": mc.schedule_type,
+                        "schedule_config": mc.schedule_config}),
+                }
+            sampler = MultiMetricCurriculumSampler(
+                metrics, batch_size=self.micro_batch_size * self.dp_world,
+                seed=self.config.seed)
+            return DeepSpeedDataLoader(
+                training_data,
+                batch_size=self.micro_batch_size * self.dp_world,
+                mesh=self.mesh, data_sampler=sampler)
         if self._curriculum_metric_path is not None:
             # metric-driven curriculum: difficulty values from a DataAnalyzer
             # run steer the in-loop sampler (reference DeepSpeedDataSampler,

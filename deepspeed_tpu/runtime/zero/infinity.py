@@ -157,6 +157,13 @@ class InfinityParamEngine:
             lr=p.get("lr", 1e-3), betas=tuple(p.get("betas", (0.9, 0.999))),
             eps=p.get("eps", 1e-8), weight_decay=p.get("weight_decay", 0.0),
             adamw_mode=bool(p.get("adam_w_mode", opt_type == "adamw")))
+        # moment STORE dtypes (same memory-lean knobs as the fused device
+        # optimizer's mu_dtype/nu_dtype): bf16 halves the NVMe footprint of
+        # m and/or v — 14 B/param (fp32 moments) -> 10 B/param with both,
+        # the difference between a 7B store fitting a ~90 GB disk or not.
+        # The host Adam always steps fp32; bf16 is the at-rest format.
+        self._mu16 = str(p.get("mu_dtype", "")).lower() == "bfloat16"
+        self._nu16 = str(p.get("nu_dtype", "")).lower() == "bfloat16"
         zc = config.zero_config.offload_param
         nvme_path = zc.nvme_path
         if self._multi:
@@ -170,10 +177,13 @@ class InfinityParamEngine:
         self._init_param_store(config.seed)
         self._build_programs()
         total = self.param_count
+        opt_bytes = 4 + (2 if self._mu16 else 4) + (2 if self._nu16 else 4)
         log_dist(
             f"ZeRO-Infinity param offload: {total:,} params "
             f"({total * 2 / 1e9:.2f} GB bf16) + optimizer state "
-            f"({total * 12 / 1e9:.2f} GB fp32) on NVMe at {zc.nvme_path}; "
+            f"({total * opt_bytes / 1e9:.2f} GB, moments "
+            f"{'bf16' if self._mu16 else 'fp32'}/"
+            f"{'bf16' if self._nu16 else 'fp32'}) on NVMe at {zc.nvme_path}; "
             f"device holds 1/{cfg.num_layers} of the layer stack at a time",
             ranks=[0])
 
@@ -244,14 +254,17 @@ class InfinityParamEngine:
                     self._shard_weight[f"layers.{i}.{k}{sfx}"] = wt
 
         bf16 = _bf16()
-        # write every SHARD: fp32 master + zero moments + bf16 param
+        # write every SHARD: fp32 master + zero moments (store dtype) +
+        # bf16 param
         def put(name, arr32, shards):
             for sfx, slices in shards.values():
                 piece = np.ascontiguousarray(arr32[slices])
                 self.swapper.write(f"{name}{sfx}.master", piece)
                 z = np.zeros_like(piece)
-                self.swapper.write(f"{name}{sfx}.exp_avg", z)
-                self.swapper.write(f"{name}{sfx}.exp_avg_sq", z)
+                self.swapper.write(f"{name}{sfx}.exp_avg",
+                                   z.astype(bf16) if self._mu16 else z)
+                self.swapper.write(f"{name}{sfx}.exp_avg_sq",
+                                   z.astype(bf16) if self._nu16 else z)
                 self.swapper.write(f"{name}{sfx}.param", piece.astype(bf16))
                 self._leaf_names.append(f"{name}{sfx}")
 
@@ -669,14 +682,19 @@ class InfinityParamEngine:
             master = self.swapper.read(f"{name}.master")
             m = self.swapper.read(f"{name}.exp_avg")
             v = self.swapper.read(f"{name}.exp_avg_sq")
+            # the host Adam steps fp32; bf16 is only the at-rest format
+            m32 = (np.ascontiguousarray(m, np.float32) if self._mu16 else m)
+            v32 = (np.ascontiguousarray(v, np.float32) if self._nu16 else v)
             out16 = np.empty(master.size, np.uint16)
             self.adam.step_flat(master.reshape(-1),
                                 np.ascontiguousarray(g.reshape(-1)),
-                                m.reshape(-1), v.reshape(-1), step=step,
+                                m32.reshape(-1), v32.reshape(-1), step=step,
                                 bf16_out=out16, lr=lr)
             self.swapper.write(f"{name}.master", master)
-            self.swapper.write(f"{name}.exp_avg", m)
-            self.swapper.write(f"{name}.exp_avg_sq", v)
+            self.swapper.write(f"{name}.exp_avg",
+                               m32.astype(bf16) if self._mu16 else m32)
+            self.swapper.write(f"{name}.exp_avg_sq",
+                               v32.astype(bf16) if self._nu16 else v32)
             new16 = out16.view(bf16).reshape(master.shape)
             self.swapper.write(f"{name}.param", new16)
             if name in self._stem_dev:
@@ -704,11 +722,13 @@ class InfinityParamEngine:
 
     def _write_leaf_state(self, name: str, master, m, v) -> None:
         master = np.ascontiguousarray(master, np.float32)
+        bf16 = _bf16()
         self.swapper.write(f"{name}.master", master)
-        self.swapper.write(f"{name}.exp_avg",
-                           np.ascontiguousarray(m, np.float32))
-        self.swapper.write(f"{name}.exp_avg_sq",
-                           np.ascontiguousarray(v, np.float32))
+        # checkpoint files stay fp32; the STORE keeps its at-rest dtype
+        self.swapper.write(f"{name}.exp_avg", np.ascontiguousarray(
+            m, bf16 if self._mu16 else np.float32))
+        self.swapper.write(f"{name}.exp_avg_sq", np.ascontiguousarray(
+            v, bf16 if self._nu16 else np.float32))
         # the bf16 compute params derive from the restored masters
         new16 = master.astype(_bf16())
         self.swapper.write(f"{name}.param", new16)

@@ -387,3 +387,185 @@ def test_metric_curriculum_state_survives_checkpoint(tmp_path):
     assert e2.training_dataloader.data_sampler.consumed_batches == 0
     e2.load_checkpoint(str(tmp_path / "ck"), tag="t")
     assert e2.training_dataloader.data_sampler.consumed_batches == consumed
+
+
+# ------------------------------------------------- multi-metric curriculum
+def _mm_scheduler(mind, maxd, total=40, step=1):
+    return CurriculumScheduler({
+        "curriculum_type": "m", "min_difficulty": mind,
+        "max_difficulty": maxd, "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": total,
+                            "difficulty_step": step}})
+
+
+def test_multimetric_sampler_clusters_and_intersection():
+    """Two schedule_based metrics: eligibility is the INTERSECTION; each
+    difficulty advance adds one new cluster of newly-eligible samples
+    (reference get_new_cluster semantics)."""
+    from deepspeed_tpu.runtime.data_pipeline import MultiMetricCurriculumSampler
+
+    n = 64
+    rng = np.random.default_rng(0)
+    lens = rng.integers(1, 33, n).astype(float)      # metric A: value-based
+    rarity = rng.random(n)                           # metric B: percentile
+    s = MultiMetricCurriculumSampler({
+        "seqlen": {"values": lens, "scheduler": _mm_scheduler(8, 32),
+                   "difficulty_type": "value"},
+        "rarity": {"values": rarity, "scheduler": _mm_scheduler(50, 100),
+                   "difficulty_type": "percentile"},
+    }, batch_size=8, seed=0)
+    it = iter(s)
+    b0 = next(it)
+    assert len(b0) == 8
+    # every drawn sample satisfies BOTH current difficulties
+    d_len = s.current_difficulties["seqlen"]
+    rar_rank = np.argsort(np.argsort(rarity))
+    cut = int(n * s.current_difficulties["rarity"] / 100)
+    for i in b0:
+        assert lens[i] <= d_len
+        assert rar_rank[i] < cut
+    c0 = len(s.clusters)
+    for _ in range(30):              # advance the schedules
+        next(it)
+    assert len(s.clusters) > c0      # new clusters appeared as difficulty grew
+    union = np.concatenate(s.clusters)
+    assert len(union) == len(np.unique(union))   # clusters are disjoint
+
+
+def test_multimetric_sampler_state_roundtrip_continues_stream():
+    """Checkpointed distributed state: restoring mid-stream reproduces the
+    EXACT same continuation (clusters, positions, RNG)."""
+    from deepspeed_tpu.runtime.data_pipeline import MultiMetricCurriculumSampler
+
+    n = 48
+    vals = np.arange(n, dtype=float)
+
+    def mk():
+        return MultiMetricCurriculumSampler({
+            "m": {"values": vals.copy(), "scheduler": _mm_scheduler(8, 48),
+                  "difficulty_type": "value"}}, batch_size=4, seed=7)
+
+    s1 = mk()
+    it1 = iter(s1)
+    for _ in range(5):
+        next(it1)
+    snap = s1.state_dict()
+    cont1 = [next(it1) for _ in range(6)]
+
+    s2 = mk()
+    s2.load_state_dict(snap)
+    it2 = iter(s2)
+    cont2 = [next(it2) for _ in range(6)]
+    assert cont1 == cont2
+
+
+def test_analyzer_multi_metric_single_pass(tmp_path):
+    from deepspeed_tpu.runtime.data_pipeline import DataAnalyzer
+
+    data = [{"input_ids": list(range(3 + i % 7))} for i in range(23)]
+    an = DataAnalyzer(metrics={
+        "seqlen": lambda s: float(len(s["input_ids"])),
+        "maxtok": lambda s: float(max(s["input_ids"])),
+    }, num_workers=3)
+    out = an.run_multi(data, str(tmp_path))
+    assert set(out) == {"seqlen", "maxtok"}
+    np.testing.assert_array_equal(out["seqlen"],
+                                  [3 + i % 7 for i in range(23)])
+    np.testing.assert_array_equal(out["maxtok"],
+                                  [2 + i % 7 for i in range(23)])
+
+
+def test_multimetric_curriculum_end_to_end_differs_from_uniform(tmp_path):
+    """Engine-level run: a curriculum that feeds short documents first must
+    produce a measurably DIFFERENT loss trajectory from the uniform
+    sampler on the same data (the reference's data-efficiency claim,
+    exercised end-to-end through config -> analyzer -> sampler -> engine),
+    and its sampler state must ride engine checkpoints."""
+    from deepspeed_tpu.parallel import mesh as mesh_mod
+    from deepspeed_tpu.runtime.data_pipeline import DataAnalyzer
+
+    rng = np.random.default_rng(0)
+    n, S = 256, 32
+    # synthetic LM data: difficulty = number of real tokens
+    lengths = rng.integers(4, S + 1, n)
+    data = []
+    for i in range(n):
+        ids = np.zeros(S, np.int32)
+        ids[:lengths[i]] = rng.integers(1, 250, lengths[i])
+        data.append({"input_ids": ids})
+    an = DataAnalyzer(metric_fn=lambda s: float((np.asarray(
+        s["input_ids"]) != 0).sum()), metric_name="reallen")
+    an.run(data, str(tmp_path))
+
+    def train(curriculum):
+        mesh_mod.reset_mesh()
+        cfg = {
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 5e-3}},
+            "bf16": {"enabled": True},
+        }
+        if curriculum:
+            cfg["data_efficiency"] = {
+                "enabled": True,
+                "data_sampling": {
+                    "enabled": True,
+                    "curriculum_learning": {
+                        "enabled": True,
+                        "curriculum_metrics": {
+                            "reallen": {
+                                "metric_values_path": str(
+                                    tmp_path / "reallen_values.npy"),
+                                "difficulty_type": "value",
+                                "min_difficulty": 8,
+                                "max_difficulty": int(S),
+                                "schedule_type": "fixed_linear",
+                                "schedule_config": {
+                                    "total_curriculum_step": 12,
+                                    "difficulty_step": 1}}}}}}
+        model = CausalLM("tiny")
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, config=cfg, training_data=data)
+        losses = [float(engine.train_batch()) for _ in range(10)]
+        return engine, losses
+
+    e_cur, cur = train(curriculum=True)
+    from deepspeed_tpu.runtime.data_pipeline import MultiMetricCurriculumSampler
+    assert isinstance(e_cur.training_dataloader.data_sampler,
+                      MultiMetricCurriculumSampler)
+    # sampler state rides the checkpoint
+    e_cur.save_checkpoint(str(tmp_path / "ckpt"), tag="de")
+    import json as _json
+    meta = _json.loads((tmp_path / "ckpt" / "de" /
+                        "client_state.json").read_text())
+    assert meta.get("data_sampler", {}).get("consumed_batches", 0) > 0
+
+    _, uni = train(curriculum=False)
+    assert np.isfinite(cur).all() and np.isfinite(uni).all()
+    # measurably different trajectories (same seed, same data, same model)
+    diff = float(np.mean(np.abs(np.asarray(cur) - np.asarray(uni))))
+    assert diff > 1e-3, (cur, uni)
+    mesh_mod.reset_mesh()
+
+
+def test_multimetric_draw_wraps_small_cluster():
+    """A draw larger than 2x the cluster must loop the reshuffle (was: a
+    short batch + out-of-range position)."""
+    from deepspeed_tpu.runtime.data_pipeline import MultiMetricCurriculumSampler
+
+    vals = np.arange(40, dtype=float)
+    s = MultiMetricCurriculumSampler({
+        "m": {"values": vals, "scheduler": _mm_scheduler(3, 40, total=1000),
+              "difficulty_type": "value"}}, batch_size=8, seed=0)
+    b = next(iter(s))        # only 3-4 samples eligible at min difficulty
+    assert len(b) == 8
+    assert all(vals[i] <= s.current_difficulties["m"] for i in b)
+    assert 0 <= s.positions[0] <= len(s.clusters[0])
+
+
+def test_data_sampling_config_gate_validator():
+    from deepspeed_tpu.runtime.config import DataSamplingConfig
+
+    with pytest.raises(Exception, match="data_sampling.enabled"):
+        DataSamplingConfig(enabled=False, curriculum_learning={
+            "enabled": True, "curriculum_metrics": {
+                "m": {"metric_values_path": "x.npy"}}})

@@ -62,7 +62,12 @@ def main():
     try:
         engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
             "train_micro_batch_size_per_gpu": 1,
-            "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+            # bf16 at-rest moments (the docstring's 10 B/param math): the
+            # difference between a 7B store (67 GB) fitting this disk's
+            # ~90 GB budget and ENOSPC at layer 29 (14 B/param = 94 GB)
+            "optimizer": {"type": "adamw",
+                          "params": {"lr": 1e-4, "mu_dtype": "bfloat16",
+                                     "nu_dtype": "bfloat16"}},
             "zero_optimization": {
                 "stage": 3,
                 "offload_param": {"device": "nvme",
