@@ -10,9 +10,17 @@ kernels exist to compress ZeRO-3's two big collectives:
 
 Here the quant/dequant math is expressed as XLA ops (reshape + reduce +
 round — XLA fuses the whole block pipeline into the surrounding collective
-program; a hand-rolled Pallas kernel would only re-derive the same fusion),
-and the collectives are `lax` collectives inside shard_map manual regions,
-so the wire payload really is int8/int4.
+program), and the collectives are `lax` collectives inside shard_map manual
+regions, so the wire payload really is int8/int4.
+
+Measured on the round-5 chip (tools/artifacts/zeropp_r5.json, honest
+chiptimer): the XLA round-trip runs HBM-bound at ~0.35-0.5 TB/s effective.
+A Pallas fusion could at best halve that overhead (~2 HBM passes
+theoretical), but the op only pays off on DCN-crossing hops — where the
+WIRE dominates the trade by 1-2 orders of magnitude — so the kernel-
+engineering spend fails its own cost model; the XLA formulation stays.
+The reference's swizzled layout solves a GPU-memory-coalescing problem
+the XLA layout engine handles for us.
 
 Symmetric per-block scaling: block of K consecutive elements shares one
 fp32 scale = amax/qmax.  int4 packs two lanes per int8 byte (the TPU has no

@@ -611,11 +611,7 @@ class DeepSpeedEngine:
             sampler = MultiMetricCurriculumSampler(
                 metrics, batch_size=self.micro_batch_size * self.dp_world,
                 seed=self.config.seed)
-            return DeepSpeedDataLoader(
-                training_data,
-                batch_size=self.micro_batch_size * self.dp_world,
-                mesh=self.mesh, data_sampler=sampler)
-        if self._curriculum_metric_path is not None:
+        elif self._curriculum_metric_path is not None:
             # metric-driven curriculum: difficulty values from a DataAnalyzer
             # run steer the in-loop sampler (reference DeepSpeedDataSampler,
             # data_sampler.py:36)

@@ -126,6 +126,11 @@ class HostSteppedOffload:
                 "compression_training with host-stepped optimizer offload is "
                 "not supported: the grad-only step differentiates the raw "
                 "params and would silently skip the QAT/pruning transform")
+        # the host Adam sweep + any aio threads inherit this affinity —
+        # cross-NUMA master/moment traffic is the reference's numactl case
+        from ..utils.numa import bind_for_offload
+
+        bind_for_offload()
         opt_cfg = config.optimizer
         opt_type = (opt_cfg.type if opt_cfg else "adamw").lower()
         if opt_type not in ("adam", "adamw"):

@@ -135,6 +135,12 @@ class InfinityParamEngine:
         # partitioned_param_swapper.py:36), so host RAM/NVMe per process
         # scales down with the process count for sharded leaves
         self._multi = jax.process_count() > 1
+        # bind the host side (SIMD Adam + aio threadpool) to one NUMA node
+        # BEFORE the pools spawn (threads inherit the mask); DS_TPU_NUMA_NODE
+        # overrides, 'off' disables
+        from ...utils.numa import bind_for_offload
+
+        bind_for_offload()
         opt_cfg = config.optimizer
         opt_type = (opt_cfg.type if opt_cfg else "adamw").lower()
         if opt_type not in ("adam", "adamw"):

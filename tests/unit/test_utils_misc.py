@@ -151,3 +151,43 @@ def test_pipeline_module_missing_loss_raises_before_forward():
     pm = PipelineModule([LayerSpec(Boom)], num_stages=1)
     with pytest.raises(ValueError, match="needs loss_fn"):
         pm.loss_fn({"layers": [{}], "tied": {}}, {"inputs": jnp.ones((2, 4))})
+
+
+# ---------------------------------------------------------------- numa
+def test_numa_parse_cpu_list():
+    from deepspeed_tpu.utils.numa import _parse_cpu_list
+
+    assert _parse_cpu_list("0-3,8-11") == [0, 1, 2, 3, 8, 9, 10, 11]
+    assert _parse_cpu_list("5") == [5]
+    assert _parse_cpu_list("") == []
+    assert _parse_cpu_list("0,2-3") == [0, 2, 3]
+
+
+def test_numa_bind_noop_paths(monkeypatch):
+    """Single-node/hidden topology and the 'off' switch are clean no-ops —
+    the binding must never crash an offload run on a container that hides
+    sysfs."""
+    from deepspeed_tpu.utils import numa
+
+    monkeypatch.setattr(numa, "get_numa_nodes", lambda: {})
+    assert numa.bind_to_node() == []
+    monkeypatch.setattr(numa, "get_numa_nodes", lambda: {0: [0, 1]})
+    assert numa.bind_to_node() == []           # single node -> no-op
+    monkeypatch.setenv("DS_TPU_NUMA_NODE", "off")
+    assert numa.bind_for_offload() == []
+
+
+def test_numa_bind_picks_majority_node(monkeypatch):
+    from deepspeed_tpu.utils import numa
+
+    calls = {}
+    monkeypatch.setattr(numa, "get_numa_nodes",
+                        lambda: {0: [0, 1, 2, 3], 1: [4, 5, 6, 7]})
+    monkeypatch.setattr(numa, "current_affinity", lambda: [2, 3, 4, 5, 6])
+    monkeypatch.setattr(numa.os, "sched_setaffinity",
+                        lambda pid, cpus: calls.setdefault("cpus",
+                                                           sorted(cpus)))
+    monkeypatch.delenv("DS_TPU_NUMA_NODE", raising=False)
+    got = numa.bind_for_offload()
+    # node 1 owns 3 of the 5 allowed CPUs -> picked; mask intersected
+    assert calls["cpus"] == [4, 5, 6] and got == [4, 5, 6]
