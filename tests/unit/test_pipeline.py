@@ -384,3 +384,42 @@ def test_pipeline_1f1b_zero2_matches_gpipe():
         losses[sched] = [float(eng.train_batch(batch={"input_ids": data}))
                          for _ in range(3)]
     np.testing.assert_allclose(losses["1f1b"], losses["gpipe"], rtol=2e-4)
+
+
+def test_pipeline_1f1b_memory_bound_compiler_certified():
+    """The 1F1B claim, certified from the compiled program (r4 weak #5):
+    GPipe stashes ALL `mb` microbatch activations per stage for backward,
+    1F1B's packed ring holds at most P in flight — so with mb >> P the
+    compiled 1F1B step must allocate measurably less temp memory, and the
+    gap must GROW with mb (the same memory-analysis machinery the 7B
+    HBM-fit certificate uses)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM
+    from deepspeed_tpu.parallel import mesh as M
+
+    def temp_bytes(sched, microbatches):
+        M.reset_mesh()
+        mesh = initialize_mesh(MeshLayout(dp=4, pp=2))
+        model = CausalLM("tiny", dtype=jnp.float32, num_layers=4,
+                         hidden_size=256, max_seq_len=256,
+                         pipeline_stages=2,
+                         pipeline_microbatches=microbatches,
+                         pipeline_schedule=sched)
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=model, config={
+                "train_micro_batch_size_per_gpu": 2 * microbatches,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}}},
+            mesh=mesh)
+        rng = np.random.default_rng(0)
+        compiled = eng.compile_train_step({"input_ids": rng.integers(
+            0, 256, (eng.train_batch_size, 256)).astype(np.int32)})
+        mem = compiled.memory_analysis()
+        M.reset_mesh()
+        return int(mem.temp_size_in_bytes)
+
+    g8, f8 = temp_bytes("gpipe", 8), temp_bytes("1f1b", 8)
+    assert f8 < g8, (f8, g8)
+    # the gap grows with mb: GPipe's stash is O(mb), 1F1B's is O(P)
+    g16, f16 = temp_bytes("gpipe", 16), temp_bytes("1f1b", 16)
+    assert f16 < g16, (f16, g16)
+    assert (g16 - f16) > (g8 - f8), (g8, f8, g16, f16)
