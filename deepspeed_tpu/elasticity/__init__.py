@@ -18,3 +18,4 @@ from .elastic_agent import (  # noqa: F401
     PreemptionGuard,
     resolve_plan_for_current_world,
 )
+from .supervisor import RC_COMPLETE, RC_INTERRUPT, Supervisor  # noqa: F401

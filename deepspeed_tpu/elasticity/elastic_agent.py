@@ -22,6 +22,8 @@ import time
 from typing import Callable, Optional
 
 from .elasticity import ElasticPlan, compute_elastic_config
+from ..resilience.integrity import (LATEST_FILE, MANIFEST_FILE,
+                                    candidate_tags, quarantine_tag)
 from ..utils.logging import log_dist, logger
 
 
@@ -72,25 +74,86 @@ class ElasticAgent:
     """
 
     def __init__(self, engine, ckpt_dir: str, ckpt_every: int = 0,
-                 tag: str = "elastic"):
+                 tag: Optional[str] = None, keep: int = 3):
         self.engine = engine
         self.ckpt_dir = ckpt_dir
         self.ckpt_every = ckpt_every
+        # tag=None -> per-step generation tags (global_stepN): corruption of
+        # the newest generation can fall back to the previous one.  A fixed
+        # tag keeps the old single-slot behaviour (no fallback depth).
         self.tag = tag
+        self.keep = keep
         self.guard = PreemptionGuard.install()
         self.resumed_step = 0
 
     def restore_if_present(self) -> int:
-        """Load the newest checkpoint (any prior topology); returns the step
-        training should resume from."""
-        if os.path.isdir(self.ckpt_dir) and os.listdir(self.ckpt_dir):
+        """Load the newest *verified* checkpoint (any prior topology);
+        returns the step training should resume from.
+
+        Walks committed tags newest-to-oldest.  A tag that fails manifest
+        verification (``load_checkpoint`` verifies before mutating state)
+        or errors during restore — torn write, bit rot, incompatible
+        payload, flaky storage — is quarantined (renamed ``<tag>.corrupt``)
+        and the walk falls back one generation, instead of letting the
+        error escape and permanently crash-loop the supervisor on the same
+        poisoned tag.
+
+        Multi-host caveat: each host walks and verifies independently
+        against shared storage; the quarantine rename and the ``latest``
+        re-point run on process 0 only.  A host-local read flake can still
+        diverge hosts onto different generations — the next collective then
+        fails and the supervisor recycles the round, which is the designed
+        backstop rather than a coordinated election."""
+        import jax
+
+        if not (os.path.isdir(self.ckpt_dir) and os.listdir(self.ckpt_dir)):
+            return self.resumed_step
+        from ..resilience.integrity import CheckpointIntegrityError
+
+        for tag in candidate_tags(self.ckpt_dir):
+            tag_dir = os.path.join(self.ckpt_dir, tag)
             try:
-                self.engine.load_checkpoint(self.ckpt_dir)
-                self.resumed_step = int(self.engine.global_steps)
-                log_dist(f"elastic resume from step {self.resumed_step} "
-                         f"on {self.engine.dp_world} DP devices", ranks=[0])
-            except FileNotFoundError:
-                pass
+                try:
+                    self.engine.load_checkpoint(self.ckpt_dir, tag=tag)
+                except KeyboardInterrupt:
+                    raise
+                except CheckpointIntegrityError:
+                    raise   # proven corruption: no point retrying
+                except Exception as e:
+                    # could be a transient storage blip, not corruption —
+                    # one retry before the IRREVERSIBLE quarantine rename
+                    logger.warning(
+                        "elastic restore: load of %s raised %s: %s; "
+                        "retrying once before quarantining",
+                        tag_dir, type(e).__name__, e)
+                    self.engine.load_checkpoint(self.ckpt_dir, tag=tag)
+            except KeyboardInterrupt:
+                raise
+            except Exception as e:
+                logger.error(
+                    "elastic restore: checkpoint %s unusable (%s: %s); "
+                    "quarantining and falling back one generation",
+                    tag_dir, type(e).__name__, e)
+                if jax.process_index() == 0:
+                    try:
+                        quarantine_tag(self.ckpt_dir, tag)
+                    except OSError as qe:   # storage flaking mid-quarantine:
+                        logger.error("elastic restore: quarantine of %s "
+                                     "failed (%s); skipping tag", tag_dir, qe)
+                continue
+            self.resumed_step = int(self.engine.global_steps)
+            # re-point `latest` at the generation that actually loaded so
+            # the next writer/reader agree on the committed frontier
+            if jax.process_index() == 0:
+                with open(os.path.join(self.ckpt_dir, LATEST_FILE), "w") as f:
+                    f.write(str(tag))
+            log_dist(f"elastic resume from step {self.resumed_step} "
+                     f"(tag {tag}) on {self.engine.dp_world} DP devices",
+                     ranks=[0])
+            break
+        else:
+            logger.warning("elastic restore: no usable checkpoint under %s; "
+                           "starting fresh", self.ckpt_dir)
         return self.resumed_step
 
     def run(self, train_step_fn: Callable, total_steps: int) -> int:
@@ -106,15 +169,68 @@ class ElasticAgent:
             train_step_fn(self.engine, step)
             at_interval = self.ckpt_every and (step + 1) % self.ckpt_every == 0
             if at_interval or self.guard.should_stop:
-                self.engine.save_checkpoint(self.ckpt_dir, tag=self.tag)
-                saved_at = step + 1
+                try:
+                    self.engine.save_checkpoint(self.ckpt_dir, tag=self.tag)
+                    if self.guard.should_stop:
+                        # about to exit: an async save's commit runs on a
+                        # daemon thread that dies with the process — join it
+                        # or the preemption checkpoint is torn and lost
+                        self._join_pending_save()
+                    saved_at = step + 1
+                    self._prune_generations()
+                except Exception as e:
+                    if not self.guard.should_stop:
+                        raise
+                    # preemption is latched: the save failed but the logged
+                    # exit contract below must still run so the supervisor
+                    # sees a failure exit and relaunches from the last
+                    # COMMITTED generation — raising here would skip it
+                    logger.error(
+                        "elastic exit: preemption-path checkpoint save "
+                        "failed (%s: %s); exiting without a new generation "
+                        "— restart resumes from the previous committed tag",
+                        type(e).__name__, e)
             if self.guard.should_stop:
                 log_dist(f"elastic exit at step {step + 1} "
                          f"(signal {self.guard.received})", ranks=[0])
                 return step + 1
         if saved_at != total_steps:
             self.engine.save_checkpoint(self.ckpt_dir, tag=self.tag)
+            self._join_pending_save()
+            self._prune_generations()
+        else:
+            self._join_pending_save()
         return total_steps
+
+    def _join_pending_save(self) -> None:
+        """Commit barrier before the process may exit (no-op for sync
+        saves): wait_for_checkpoint joins the async finalize thread with
+        the engine's bounded timeout and re-raises a failed save."""
+        wait = getattr(self.engine, "wait_for_checkpoint", None)
+        if wait is not None:
+            wait()
+
+    def _prune_generations(self) -> None:
+        """Bound disk: keep the newest ``keep`` COMMITTED generations.
+        Only manifest-bearing tags are prune candidates: an in-flight async
+        save has no manifest yet and must never be rmtree'd under its
+        writer; torn tags are left for quarantine, quarantined ``*.corrupt``
+        dirs for the operator.  With a fixed tag there is a single
+        overwritten generation and nothing to prune."""
+        if self.tag is not None or self.keep <= 0:
+            return
+        import jax
+
+        if jax.process_index() != 0:
+            return
+        import shutil
+
+        committed = [t for t in candidate_tags(self.ckpt_dir)
+                     if os.path.exists(os.path.join(self.ckpt_dir, t,
+                                                    MANIFEST_FILE))]
+        for old in committed[self.keep:]:
+            shutil.rmtree(os.path.join(self.ckpt_dir, old),
+                          ignore_errors=True)
 
 
 def resolve_plan_for_current_world(config, dp_world_size: int,

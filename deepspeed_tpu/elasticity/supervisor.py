@@ -12,12 +12,28 @@ runs again on every round, a restart after a resize naturally launches at
 the NEW world size — ``ElasticAgent.restore_if_present`` +
 ``compute_elastic_config`` rebuild the schedule there, and orbax restores
 the last committed checkpoint onto the new mesh.
+
+Hardening (resilience subsystem):
+
+- **jittered exponential backoff**: retry delay grows ``backoff_s *
+  backoff_mult**(n-1)`` capped at ``backoff_max_s``, with a deterministic
+  seeded jitter so a preempted pod's hosts don't stampede storage in
+  lockstep;
+- **progress-aware restart budget**: with a ``progress_fn`` (see
+  ``resilience.checkpoint_progress_fn``), a failed round that still
+  advanced the committed checkpoint refreshes the budget — long jobs on
+  preemptible capacity survive unbounded *productive* restarts — while
+  ``zero_progress_limit`` consecutive rounds with no progress trip a
+  circuit breaker with a terminal diagnosis instead of crash-looping
+  forever on a poisoned state.
 """
 from __future__ import annotations
 
 import time
+from random import Random
 from typing import Callable, Optional
 
+from ..resilience.fault_injection import SITE_SUPERVISOR_ATTEMPT, maybe_fire
 from ..utils.logging import logger
 
 # exit codes that must NOT trigger a relaunch
@@ -31,20 +47,52 @@ class Supervisor:
     ``attempt(round_idx) -> int`` performs one full discovery + launch and
     returns the job's exit code.  The supervisor relaunches on any failure
     exit until ``max_restarts`` is spent; interrupts are terminal.
+
+    ``progress_fn() -> int`` (optional) reports monotonically comparable
+    progress (newest committed checkpoint step); ``zero_progress_limit`` of
+    K > 0 trips the circuit breaker after K consecutive failed rounds that
+    made no progress.  After the run, ``breaker_tripped`` / ``diagnosis``
+    describe a terminal failure.
     """
 
     def __init__(self, attempt: Callable[[int], int], max_restarts: int = 10,
                  backoff_s: float = 3.0,
-                 on_round: Optional[Callable[[int, int], None]] = None):
+                 on_round: Optional[Callable[[int, int], None]] = None,
+                 backoff_mult: float = 2.0, backoff_max_s: float = 60.0,
+                 jitter: float = 0.25,
+                 progress_fn: Optional[Callable[[], int]] = None,
+                 zero_progress_limit: int = 0, seed: int = 0):
         self.attempt = attempt
         self.max_restarts = max_restarts
         self.backoff_s = backoff_s
         self.on_round = on_round
+        self.backoff_mult = backoff_mult
+        self.backoff_max_s = backoff_max_s
+        self.jitter = jitter
+        self.progress_fn = progress_fn
+        self.zero_progress_limit = zero_progress_limit
+        self._rng = Random(seed)
+        self.breaker_tripped = False
+        self.diagnosis: Optional[str] = None
+
+    def backoff_delay(self, consecutive_failures: int) -> float:
+        """Exponential in the *consecutive* failure count (a productive
+        restart resets it), capped, with ±jitter."""
+        base = self.backoff_s * self.backoff_mult ** max(
+            0, consecutive_failures - 1)
+        base = min(base, self.backoff_max_s)
+        if self.jitter > 0 and base > 0:
+            base *= 1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
+        return base
 
     def run(self) -> int:
-        restarts = 0
+        restarts = 0          # spent against max_restarts; refreshed on progress
+        consecutive = 0       # consecutive failures, drives backoff + breaker
+        rounds = 0
+        last_progress = self.progress_fn() if self.progress_fn else None
         while True:
             try:
+                maybe_fire(SITE_SUPERVISOR_ATTEMPT, round=rounds)
                 rc = self.attempt(restarts)
             except KeyboardInterrupt:
                 raise
@@ -56,26 +104,66 @@ class Supervisor:
                 logger.warning("elastic supervisor: attempt raised %s: %s; "
                                "treating as failed round", type(e).__name__, e)
                 rc = 1
+            rounds += 1
             if self.on_round is not None:
                 self.on_round(restarts, rc)
             if rc == RC_COMPLETE:
-                if restarts:
+                if restarts or rounds > 1:
                     logger.info("elastic supervisor: job complete after "
-                                "%d restart(s)", restarts)
+                                "%d round(s)", rounds)
                 return 0
             if rc == RC_INTERRUPT:
                 logger.info("elastic supervisor: interrupted; not restarting")
                 return rc
+            consecutive += 1
+            if self.progress_fn is not None:
+                cur = self.progress_fn()
+                if last_progress is None or cur > last_progress:
+                    # the failed round still committed new checkpoints —
+                    # productive preemption churn, not a crash loop
+                    logger.info(
+                        "elastic supervisor: round failed (rc=%d) but "
+                        "progress advanced %s -> %s; refreshing restart "
+                        "budget", rc, last_progress, cur)
+                    last_progress = cur
+                    restarts = 0
+                    consecutive = 1
+                elif cur < last_progress:
+                    # the committed frontier REGRESSED (newest generation
+                    # quarantined on restore): re-anchor, or genuine forward
+                    # progress from the fallback generation would keep
+                    # comparing against the dead high-water mark and read
+                    # as a crash loop
+                    logger.warning(
+                        "elastic supervisor: committed progress regressed "
+                        "%s -> %s (generation quarantined?); re-anchoring",
+                        last_progress, cur)
+                    last_progress = cur
+                elif self.zero_progress_limit and \
+                        consecutive >= self.zero_progress_limit:
+                    self.breaker_tripped = True
+                    self.diagnosis = (
+                        f"circuit breaker: {consecutive} consecutive "
+                        f"failed rounds with no checkpoint progress "
+                        f"(stuck at step {cur}, last rc={rc}) — the job is "
+                        "crash-looping on a non-transient fault (poisoned "
+                        "state, incompatible config, or unrecoverable "
+                        "corruption); NOT relaunching. Inspect the newest "
+                        "*.corrupt quarantine dirs and the last failure "
+                        "log before restarting manually.")
+                    logger.error("elastic supervisor: %s", self.diagnosis)
+                    return rc
             if restarts >= self.max_restarts:
                 logger.error(
                     "elastic supervisor: rc=%d with restart budget exhausted "
                     "(%d); giving up", rc, self.max_restarts)
                 return rc
             restarts += 1
+            delay = self.backoff_delay(consecutive)
             logger.warning(
                 "elastic supervisor: job exited rc=%d; relaunching "
                 "(restart %d/%d) after %.1fs — resources are re-discovered, "
                 "so a resized slice relaunches at the new world size",
-                rc, restarts, self.max_restarts, self.backoff_s)
-            if self.backoff_s > 0:
-                time.sleep(self.backoff_s)
+                rc, restarts, self.max_restarts, delay)
+            if delay > 0:
+                time.sleep(delay)

@@ -88,12 +88,30 @@ def parse_args(args=None):
                         "elasticity.ElasticAgent so restarts resume from "
                         "the last committed checkpoint")
     p.add_argument("--elastic_backoff", type=float, default=3.0,
-                   help="seconds between elastic relaunches")
+                   help="base seconds between elastic relaunches (grows "
+                        "exponentially with consecutive failures, jittered)")
+    p.add_argument("--elastic_backoff_max", type=float, default=60.0,
+                   help="cap on the exponential relaunch backoff")
+    p.add_argument("--elastic_zero_progress", type=int, default=0,
+                   metavar="K",
+                   help="circuit breaker: stop relaunching after K "
+                        "consecutive failed rounds with no checkpoint "
+                        "progress (0 = off; needs --elastic_ckpt_dir)")
+    p.add_argument("--elastic_ckpt_dir", default="",
+                   help="checkpoint dir the training script writes; lets "
+                        "the supervisor track committed-step progress so "
+                        "productive restarts refresh the restart budget")
     p.add_argument("--force_multi", action="store_true",
                    help="use the multinode path even for a single local host")
     p.add_argument("user_script", help="training script (or module with --module)")
     p.add_argument("user_args", nargs=argparse.REMAINDER)
-    return p.parse_args(args)
+    parsed = p.parse_args(args)
+    if parsed.elastic_zero_progress > 0 and not parsed.elastic_ckpt_dir:
+        # without a progress source the breaker silently never arms — the
+        # job would crash-loop through the whole restart budget undiagnosed
+        p.error("--elastic_zero_progress needs --elastic_ckpt_dir (the "
+                "breaker tracks committed checkpoint steps)")
+    return parsed
 
 
 def fetch_hostfile(path: str) -> "OrderedDict[str, int]":
@@ -322,11 +340,19 @@ def main(args=None) -> int:
     if args.elastic_restarts > 0:
         from ..elasticity.supervisor import Supervisor
 
+        progress_fn = None
+        if args.elastic_ckpt_dir:
+            from ..resilience import checkpoint_progress_fn
+
+            progress_fn = checkpoint_progress_fn(args.elastic_ckpt_dir)
         # every attempt re-runs _dispatch, i.e. re-reads the hostfile /
         # re-discovers the pod — a resized slice relaunches at its new size
         return Supervisor(lambda _round: _dispatch(args),
                           max_restarts=args.elastic_restarts,
-                          backoff_s=args.elastic_backoff).run()
+                          backoff_s=args.elastic_backoff,
+                          backoff_max_s=args.elastic_backoff_max,
+                          progress_fn=progress_fn,
+                          zero_progress_limit=args.elastic_zero_progress).run()
     return _dispatch(args)
 
 

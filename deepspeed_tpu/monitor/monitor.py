@@ -22,6 +22,12 @@ class Monitor:
     def write_events(self, event_list: List[Event]) -> None:
         raise NotImplementedError
 
+    def write_report(self, name: str, text: str) -> None:
+        """Freeform diagnostic report (watchdog stack dumps, terminal
+        supervisor diagnoses).  Backends that can persist text do; the
+        default is the log, so a report is never silently dropped."""
+        logger.error("monitor report [%s]:\n%s", name, text)
+
 
 class TensorBoardMonitor(Monitor):
     def __init__(self, tensorboard_config):
@@ -90,6 +96,13 @@ class csvMonitor(Monitor):
                     f.write("step,value\n")
                 f.write(f"{step},{value}\n")
 
+    def write_report(self, name: str, text: str) -> None:
+        if not self.enabled:
+            return
+        fname = os.path.join(self.log_dir, name.replace("/", "_") + ".txt")
+        with open(fname, "a") as f:
+            f.write(text + "\n")
+
 
 class MonitorMaster(Monitor):
     """Rank-0 fan-out to all enabled writers (reference monitor.py:29)."""
@@ -109,3 +122,10 @@ class MonitorMaster(Monitor):
         for mon in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
             if mon is not None:
                 mon.write_events(event_list)
+
+    def write_report(self, name: str, text: str) -> None:
+        # every process may report (a hang is per-host); csv persists on the
+        # writer, the log carries it everywhere
+        logger.error("monitor report [%s]:\n%s", name, text)
+        if self.csv_monitor is not None:
+            self.csv_monitor.write_report(name, text)

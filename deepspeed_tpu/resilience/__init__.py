@@ -1,0 +1,61 @@
+"""Resilience subsystem: make the restart loop trustworthy end to end.
+
+Four pieces (see docs/RESILIENCE.md for the failure model):
+
+- ``fault_injection``: config/env-driven :class:`FaultInjector` with named
+  hook points in the checkpoint, train-step and supervisor paths, so every
+  recovery path is exercised by deterministic tests rather than hope;
+- ``integrity``: per-tag ``manifest.json`` written at save, verified at load;
+  corrupt generations are quarantined (``<tag>.corrupt``) and the elastic
+  agent falls back to the previous committed generation;
+- ``watchdog``: :class:`HangWatchdog` armed around ``train_batch`` and
+  async-checkpoint finalization — a hang becomes a stack report plus a
+  nonzero exit the supervisor can recycle;
+- supervisor hardening lives in ``elasticity/supervisor.py`` (jittered
+  exponential backoff, progress-aware restart budget, circuit breaker);
+  :func:`checkpoint_progress_fn` here supplies the progress signal.
+"""
+from .fault_injection import (  # noqa: F401
+    FaultInjector,
+    FaultRule,
+    InjectedFault,
+    SITE_CKPT_LOAD,
+    SITE_CKPT_SAVE,
+    SITE_LATEST_PUBLISH,
+    SITE_SUPERVISOR_ATTEMPT,
+    SITE_TRAIN_STEP,
+    clear_injector,
+    get_injector,
+    install_injector,
+    maybe_fire,
+)
+from .integrity import (  # noqa: F401
+    CheckpointIntegrityError,
+    MANIFEST_FILE,
+    build_manifest,
+    candidate_tags,
+    quarantine_tag,
+    verify_checkpoint_dir,
+    write_manifest,
+)
+from .watchdog import HangWatchdog  # noqa: F401
+
+
+def checkpoint_progress_fn(ckpt_dir: str):
+    """Progress signal for the supervisor's restart budget: the newest
+    committed global step under ``ckpt_dir`` (-1 while nothing is
+    committed).  A restart that advanced this number made real progress and
+    refreshes the budget; K restarts that did not trip the breaker."""
+    import os
+
+    from .integrity import candidate_tags, read_tag_step
+
+    def progress() -> int:
+        if not os.path.isdir(ckpt_dir):
+            return -1
+        best = -1
+        for tag in candidate_tags(ckpt_dir):
+            best = max(best, read_tag_step(os.path.join(ckpt_dir, tag)))
+        return best
+
+    return progress

@@ -1,0 +1,250 @@
+"""Checkpoint integrity: per-tag manifest written at save, verified at load.
+
+A committed tag directory looks like::
+
+    <tag>/
+      state/                # orbax sharded pytree
+      client_state.json     # engine counters + user client_state
+      ds_config.json        # config snapshot
+      manifest.json         # written LAST (before `latest` is published)
+
+``manifest.json`` records the logical tree structure (leaf paths, global
+shapes, dtypes), content checksums of the small JSON sidecars, a size
+listing of the orbax payload, and the writer world size.  Because it is
+written after every other file and *before* the ``latest`` pointer, its
+presence marks the commit point: a torn save is a tag directory without a
+manifest, and a bit-rotted sidecar fails its checksum.
+
+Verification failures raise :class:`CheckpointIntegrityError`; the elastic
+agent responds by quarantining the tag (rename to ``<tag>.corrupt``) and
+falling back one generation (``elastic_agent.restore_if_present``).
+Legacy tags without a manifest verify as "unverified" (warn, accept) so
+pre-manifest checkpoints keep loading.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional
+
+from ..utils.logging import logger
+
+MANIFEST_FILE = "manifest.json"
+MANIFEST_VERSION = 1
+CORRUPT_SUFFIX = ".corrupt"
+# the newest-committed-tag pointer (single source; orbax_engine re-exports)
+LATEST_FILE = "latest"
+# dropped at the start of a save, removed when the manifest lands: its
+# presence distinguishes a TORN save (crash mid-write) from a LEGACY
+# pre-manifest tag — both lack a manifest, only the former must be rejected
+INCOMPLETE_MARKER = ".incomplete"
+# small sidecars cheap enough to checksum on every save/load
+_CHECKSUMMED = ("client_state.json", "ds_config.json")
+# payload subtrees listed (path -> size) in the manifest
+_PAYLOAD_DIRS = ("state", "offload_optimizer")
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """A checkpoint tag failed verification (torn write, corruption, or a
+    manifest/content mismatch)."""
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _tree_summary(state) -> Dict[str, Dict]:
+    """Leaf path -> {shape, dtype} for the saved pytree (global shapes, so
+    the summary is topology-invariant — a dp8 save verifies on tp2×dp4)."""
+    import jax
+
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        if hasattr(leaf, "shape"):
+            out[jax.tree_util.keystr(path)] = {
+                "shape": [int(d) for d in leaf.shape],
+                "dtype": str(getattr(leaf, "dtype", "")),
+            }
+    return out
+
+
+def _payload_listing(ckpt_dir: str) -> Dict[str, int]:
+    """Relative path -> size for the payload subtrees (orbax ``state/`` and
+    host-stepped ``offload_optimizer/`` files).  Catches truncated/missing
+    array files without checksumming gigabytes."""
+    listing = {}
+    for sub in _PAYLOAD_DIRS:
+        for root, _dirs, files in os.walk(os.path.join(ckpt_dir, sub)):
+            for name in files:
+                p = os.path.join(root, name)
+                listing[os.path.relpath(p, ckpt_dir)] = os.path.getsize(p)
+    return listing
+
+
+def mark_incomplete(ckpt_dir: str) -> None:
+    """Drop the torn-save marker; removed by :func:`write_manifest` once the
+    tag commits.  Call before writing any other file of the tag."""
+    with open(os.path.join(ckpt_dir, INCOMPLETE_MARKER), "w") as f:
+        f.write("save in progress; a crash before manifest.json removes "
+                "this tag from the restore path\n")
+
+
+def build_manifest(engine, tag: str) -> Dict:
+    """The save-time half that needs the live engine; file checksums and the
+    payload listing are added by :func:`write_manifest` once the payload is
+    durable (sync: immediately; async: in the commit finalizer)."""
+    import jax
+
+    manifest: Dict = {
+        "manifest_version": MANIFEST_VERSION,
+        "tag": str(tag),
+        "global_steps": int(engine.global_steps),
+        "writer_world_size": int(jax.process_count()),
+    }
+    if engine.state is not None:
+        manifest["tree"] = _tree_summary(engine.state)
+    return manifest
+
+
+def write_manifest(ckpt_dir: str, manifest: Dict) -> str:
+    """Checksum the sidecars, list the payload, write ``manifest.json``.
+    Must run after every other file of the tag is durable and before the
+    ``latest`` pointer moves — the manifest IS the commit marker."""
+    manifest = dict(manifest)
+    files = {}
+    for name in _CHECKSUMMED:
+        p = os.path.join(ckpt_dir, name)
+        if os.path.exists(p):
+            files[name] = {"sha256": _sha256(p), "size": os.path.getsize(p)}
+    manifest["files"] = files
+    manifest["payload"] = _payload_listing(ckpt_dir)
+    path = os.path.join(ckpt_dir, MANIFEST_FILE)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=2)
+    os.replace(tmp, path)   # the manifest itself must never be torn
+    marker = os.path.join(ckpt_dir, INCOMPLETE_MARKER)
+    if os.path.exists(marker):
+        os.remove(marker)   # commit: the tag is now complete AND marked so
+    return path
+
+
+def read_manifest(ckpt_dir: str) -> Optional[Dict]:
+    path = os.path.join(ckpt_dir, MANIFEST_FILE)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        # ValueError covers JSONDecodeError AND UnicodeDecodeError — a
+        # bit-flipped manifest is frequently not even valid UTF-8
+        raise CheckpointIntegrityError(
+            f"unreadable manifest {path}: {e}") from e
+
+
+def verify_checkpoint_dir(ckpt_dir: str) -> Optional[Dict]:
+    """Verify a tag directory against its manifest.
+
+    Returns the manifest (or ``None`` for legacy pre-manifest tags, which
+    are accepted with a warning).  Raises :class:`CheckpointIntegrityError`
+    on any mismatch: missing/short payload file, sidecar checksum drift,
+    or an unreadable manifest.
+    """
+    if not os.path.isdir(ckpt_dir):
+        raise CheckpointIntegrityError(f"checkpoint dir missing: {ckpt_dir}")
+    if os.path.exists(os.path.join(ckpt_dir, INCOMPLETE_MARKER)):
+        # the save died between first write and manifest commit — without
+        # this marker a torn tag would be indistinguishable from a legacy
+        # pre-manifest tag and sail through the `manifest is None` branch
+        raise CheckpointIntegrityError(
+            f"checkpoint {ckpt_dir} is a torn save ({INCOMPLETE_MARKER} "
+            "present: the writer died before committing the manifest)")
+    manifest = read_manifest(ckpt_dir)
+    if manifest is None:
+        logger.warning("checkpoint %s has no manifest (pre-manifest save); "
+                       "loading unverified", ckpt_dir)
+        return None
+    problems: List[str] = []
+    for name, meta in manifest.get("files", {}).items():
+        p = os.path.join(ckpt_dir, name)
+        if not os.path.exists(p):
+            problems.append(f"{name}: missing")
+        elif os.path.getsize(p) != meta["size"]:
+            problems.append(f"{name}: size {os.path.getsize(p)} != "
+                            f"{meta['size']}")
+        elif _sha256(p) != meta["sha256"]:
+            problems.append(f"{name}: checksum mismatch")
+    for rel, size in manifest.get("payload", {}).items():
+        p = os.path.join(ckpt_dir, rel)
+        if not os.path.exists(p):
+            problems.append(f"{rel}: missing")
+        elif os.path.getsize(p) != size:
+            problems.append(f"{rel}: size {os.path.getsize(p)} != {size}")
+    if problems:
+        raise CheckpointIntegrityError(
+            f"checkpoint {ckpt_dir} failed verification: "
+            + "; ".join(problems[:8])
+            + (f" (+{len(problems) - 8} more)" if len(problems) > 8 else ""))
+    return manifest
+
+
+def read_tag_step(ckpt_dir: str) -> int:
+    """Best-effort global step of a tag (manifest first, then the sidecar);
+    -1 when unreadable — sorts such tags last."""
+    try:
+        m = read_manifest(ckpt_dir)
+        if m is not None:
+            return int(m.get("global_steps", -1))
+    except CheckpointIntegrityError:
+        return -1
+    p = os.path.join(ckpt_dir, "client_state.json")
+    try:
+        with open(p) as f:
+            return int(json.load(f).get("global_steps", -1))
+    except (OSError, ValueError, json.JSONDecodeError):
+        return -1
+
+
+def candidate_tags(save_dir: str) -> List[str]:
+    """Restore candidates newest-to-oldest: the ``latest`` pointer's tag
+    first, then every other non-quarantined tag by descending step."""
+    if not os.path.isdir(save_dir):
+        return []
+    tags = [d for d in os.listdir(save_dir)
+            if os.path.isdir(os.path.join(save_dir, d))
+            and CORRUPT_SUFFIX not in d]
+    tags.sort(key=lambda t: (read_tag_step(os.path.join(save_dir, t)), t),
+              reverse=True)
+    latest_path = os.path.join(save_dir, LATEST_FILE)
+    if os.path.exists(latest_path):
+        with open(latest_path) as f:
+            latest = f.read().strip()
+        if latest in tags:
+            tags.remove(latest)
+            tags.insert(0, latest)
+    return tags
+
+
+def quarantine_tag(save_dir: str, tag: str) -> str:
+    """Rename a failed tag to ``<tag>.corrupt`` (numbered on collision) so
+    the fallback walk never re-reads it; drop a ``latest`` pointing at it."""
+    src = os.path.join(save_dir, tag)
+    dst = src + CORRUPT_SUFFIX
+    n = 0
+    while os.path.exists(dst):
+        n += 1
+        dst = f"{src}{CORRUPT_SUFFIX}.{n}"
+    os.replace(src, dst)
+    latest_path = os.path.join(save_dir, LATEST_FILE)
+    if os.path.exists(latest_path):
+        with open(latest_path) as f:
+            if f.read().strip() == str(tag):
+                os.remove(latest_path)
+    logger.error("quarantined corrupt checkpoint %s -> %s", src, dst)
+    return dst

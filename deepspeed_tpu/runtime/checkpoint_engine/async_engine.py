@@ -20,7 +20,13 @@ from typing import Any, Optional
 
 from .checkpoint_engine import CheckpointEngine
 from .orbax_engine import LATEST_FILE, OrbaxCheckpointEngine
+from ...resilience.fault_injection import SITE_LATEST_PUBLISH, maybe_fire
+from ...resilience.integrity import write_manifest
 from ...utils.logging import log_dist, logger
+
+# upper bound on joining a wedged finalize thread at shutdown/next-save when
+# the engine carries no explicit timeout
+DEFAULT_FINALIZE_TIMEOUT_S = 600.0
 
 
 class AsyncOrbaxCheckpointEngine(CheckpointEngine):
@@ -31,6 +37,7 @@ class AsyncOrbaxCheckpointEngine(CheckpointEngine):
         super().__init__(config_params)
         import orbax.checkpoint as ocp
 
+        self.timeout_secs = timeout_secs
         self._ckptr = ocp.AsyncCheckpointer(
             ocp.StandardCheckpointHandler(), timeout_secs=timeout_secs)
         self._sync = OrbaxCheckpointEngine()
@@ -58,24 +65,32 @@ class AsyncOrbaxCheckpointEngine(CheckpointEngine):
 
 
 def async_save_engine_checkpoint(engine, save_dir: str, ckpt_dir: str,
-                                 tag: str, save_latest: bool) -> None:
-    """Launch the commit finalizer: wait for durability, then (and only
-    then) publish ``latest``.  Stores the thread on the engine so
-    ``wait_for_checkpoint()`` / the next load can join it."""
+                                 tag: str, save_latest: bool,
+                                 manifest=None) -> None:
+    """Launch the commit finalizer: wait for durability, then write the
+    manifest (the commit marker), then (and only then) publish ``latest``.
+    Stores the thread on the engine so ``wait_for_checkpoint()`` / the next
+    load can join it."""
     ce: AsyncOrbaxCheckpointEngine = engine._async_ckpt_engine
 
     def finalize():
         try:
             ce.commit(tag)
+            import jax
+
+            if jax.process_index() == 0:
+                if manifest is not None:
+                    # after commit: the payload listing must see the
+                    # durable orbax files
+                    write_manifest(ckpt_dir, manifest)
+                if save_latest:
+                    maybe_fire(SITE_LATEST_PUBLISH, path=save_dir, tag=tag)
+                    with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+                        f.write(str(tag))
         except Exception as e:   # surface on wait; never publish latest
             engine._async_ckpt_error = e
             logger.error(f"async checkpoint {tag} failed: {e}")
             return
-        import jax
-
-        if save_latest and jax.process_index() == 0:
-            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-                f.write(str(tag))
         log_dist(f"committed async checkpoint {tag} -> {ckpt_dir}", ranks=[0])
 
     t = threading.Thread(target=finalize, name=f"ckpt-commit-{tag}",
@@ -84,12 +99,31 @@ def async_save_engine_checkpoint(engine, save_dir: str, ckpt_dir: str,
     t.start()
 
 
-def wait_for_pending_checkpoint(engine) -> None:
-    """Join the in-flight async save, re-raising its failure if any."""
+def wait_for_pending_checkpoint(engine, timeout_s: Optional[float] = None) -> None:
+    """Join the in-flight async save, re-raising its failure if any.
+
+    The join is BOUNDED: a wedged storage write must not hang shutdown (or
+    the next save/load) forever.  The bound comes from, in order: the
+    ``timeout_s`` argument, the async engine's ``timeout_secs``, or
+    ``DEFAULT_FINALIZE_TIMEOUT_S``.  On timeout the finalize thread is left
+    referenced (it may still complete and publish ``latest``) and a
+    descriptive error is raised — under the elastic supervisor that exit
+    recycles the process, which is the only real cure for a wedged write."""
     t: Optional[threading.Thread] = getattr(engine, "_pending_ckpt_thread",
                                             None)
     if t is not None:
-        t.join()
+        if timeout_s is None:
+            ce = getattr(engine, "_async_ckpt_engine", None)
+            timeout_s = float(getattr(ce, "timeout_secs", None)
+                              or DEFAULT_FINALIZE_TIMEOUT_S)
+        t.join(timeout=timeout_s)
+        if t.is_alive():
+            raise RuntimeError(
+                f"async checkpoint finalize ({t.name}) still running after "
+                f"{timeout_s:.0f}s — the storage write is wedged.  `latest` "
+                "still points at the previous committed tag; restart the "
+                "process (the elastic supervisor does this automatically) "
+                "and inspect storage health.")
         engine._pending_ckpt_thread = None
     err = getattr(engine, "_async_ckpt_error", None)
     if err is not None:

@@ -27,6 +27,11 @@ import jax
 import numpy as np
 
 from .checkpoint_engine import CheckpointEngine
+from ...resilience.fault_injection import (SITE_CKPT_LOAD, SITE_CKPT_SAVE,
+                                           SITE_LATEST_PUBLISH, maybe_fire)
+from ...resilience.integrity import (LATEST_FILE, build_manifest,
+                                     mark_incomplete, verify_checkpoint_dir,
+                                     write_manifest)
 from ...utils.logging import logger, log_dist
 
 
@@ -57,9 +62,6 @@ class OrbaxCheckpointEngine(CheckpointEngine):
             return ckptr.restore(path)
 
 
-LATEST_FILE = "latest"
-
-
 def _read_latest(save_dir: str) -> Optional[str]:
     p = os.path.join(save_dir, LATEST_FILE)
     if os.path.exists(p):
@@ -72,7 +74,13 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                            client_state: Optional[Dict] = None, save_latest: bool = True):
     tag = tag or f"global_step{engine.global_steps}"
     ckpt_dir = os.path.join(save_dir, str(tag))
+    maybe_fire(SITE_CKPT_SAVE, path=ckpt_dir, tag=str(tag))
     os.makedirs(ckpt_dir, exist_ok=True)
+    if jax.process_index() == 0:
+        # torn-save marker: removed when the manifest commits; a crash in
+        # between leaves a tag verify_checkpoint_dir rejects (vs. a LEGACY
+        # manifest-less tag, which stays loadable)
+        mark_incomplete(ckpt_dir)
 
     async_save = bool(getattr(engine.config, "checkpoint_config", None)
                       and engine.config.checkpoint_config.async_save)
@@ -128,20 +136,30 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
             json.dump(meta, f, indent=2)
         with open(os.path.join(ckpt_dir, "ds_config.json"), "w") as f:
             json.dump(engine.config.to_dict(), f, indent=2, default=str)
+    manifest = build_manifest(engine, str(tag)) \
+        if jax.process_index() == 0 else None
     if async_save:
         # commit semantics: `latest` is published by the finalizer thread
         # only once the background write is durable — the caller returns
-        # now, having paid only the device->host snapshot
+        # now, having paid only the device->host snapshot.  The manifest is
+        # finalized there too: its payload listing must see the durable
+        # orbax files, and its presence is the commit marker.
         from .async_engine import async_save_engine_checkpoint
 
         async_save_engine_checkpoint(engine, save_dir, ckpt_dir, str(tag),
-                                     save_latest)
+                                     save_latest, manifest=manifest)
         log_dist(f"async checkpoint {tag} snapshotted; committing in "
                  f"background -> {ckpt_dir}", ranks=[0])
         return ckpt_dir
-    if save_latest and jax.process_index() == 0:
-        with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-            f.write(str(tag))
+    if jax.process_index() == 0:
+        # manifest last (commit marker), then the `latest` pointer: a crash
+        # between any two writes leaves either an uncommitted tag dir or a
+        # committed tag `latest` doesn't see — never a published torn tag
+        write_manifest(ckpt_dir, manifest)
+        if save_latest:
+            maybe_fire(SITE_LATEST_PUBLISH, path=save_dir, tag=str(tag))
+            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+                f.write(str(tag))
     log_dist(f"saved checkpoint {tag} -> {ckpt_dir}", ranks=[0])
     return ckpt_dir
 
@@ -160,6 +178,13 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     ckpt_dir = os.path.join(load_dir, str(tag))
     if not os.path.isdir(ckpt_dir):
         raise FileNotFoundError(f"checkpoint tag dir not found: {ckpt_dir}")
+    maybe_fire(SITE_CKPT_LOAD, path=ckpt_dir, tag=str(tag))
+    if getattr(getattr(engine, "config", None), "resilience", None) is None \
+            or engine.config.resilience.verify_on_load:
+        # manifest check (raises CheckpointIntegrityError on a torn or
+        # bit-rotted tag) BEFORE any engine state is mutated, so a caller
+        # like ElasticAgent can quarantine and fall back cleanly
+        verify_checkpoint_dir(ckpt_dir)
 
     offload = (getattr(engine, "_offload", None)
                or getattr(engine, "_param_offload", None))

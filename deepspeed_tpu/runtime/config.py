@@ -259,6 +259,26 @@ class CheckpointConfig(DeepSpeedConfigModel):
     async_save: bool = False
 
 
+class WatchdogConfig(DeepSpeedConfigModel):
+    """Hang watchdog (resilience/watchdog.py): armed around ``train_batch``
+    and async-checkpoint finalization; past ``timeout_s`` it dumps an
+    all-thread stack report through the monitor layer and exits
+    ``exit_code`` so the elastic supervisor can recycle the process."""
+
+    enabled: bool = False
+    timeout_s: float = Field(600.0, gt=0.0)
+    exit_code: int = 85   # resilience.watchdog.RC_HANG
+
+
+class ResilienceConfig(DeepSpeedConfigModel):
+    """``resilience`` block: checkpoint verification + hang watchdog (fault
+    injection is env/test-driven via DS_TPU_FAULTS, never config)."""
+
+    # verify manifest.json (checksums + payload listing) before any load
+    verify_on_load: bool = True
+    watchdog: WatchdogConfig = Field(default_factory=WatchdogConfig)
+
+
 class DataTypeConfig(DeepSpeedConfigModel):
     grad_accum_dtype: Optional[str] = None
 
@@ -484,6 +504,7 @@ class DeepSpeedConfig:
         self.flops_profiler = FlopsProfilerConfig(**config.get("flops_profiler", {}))
         self.comms_logger = CommsLoggerConfig(**config.get("comms_logger", {}))
         self.checkpoint_config = CheckpointConfig(**config.get("checkpoint", {}))
+        self.resilience = ResilienceConfig(**config.get("resilience", {}))
         self.data_types = DataTypeConfig(**config.get("data_types", {}))
         self.pipeline = PipelineConfig(**config.get("pipeline", {}))
         self.aio = AIOConfig(**config.get("aio", {}))

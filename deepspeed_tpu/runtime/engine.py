@@ -376,6 +376,7 @@ class DeepSpeedEngine:
         wire_flops_profiler(self)
         self.training_dataloader = self._build_dataloader(training_data)
         self.monitor = self._build_monitor()
+        self._watchdog = self._build_watchdog()
         log_dist(
             f"engine ready: params={self.param_count:,} zero_stage={self.zero_stage} "
             f"dtype={self.compute_dtype.__name__} mesh={dict(mesh.shape)} "
@@ -636,6 +637,16 @@ class DeepSpeedEngine:
         from ..monitor.monitor import MonitorMaster
 
         return MonitorMaster(self.config.monitor_config)
+
+    def _build_watchdog(self):
+        rc = getattr(self.config, "resilience", None)
+        if rc is None or not rc.watchdog.enabled:
+            return None
+        from ..resilience.watchdog import HangWatchdog
+
+        return HangWatchdog(timeout_s=rc.watchdog.timeout_s,
+                            exit_code=rc.watchdog.exit_code,
+                            monitor=self.monitor)
 
     # ------------------------------------------------------------------
     # The jitted step
@@ -1085,7 +1096,23 @@ class DeepSpeedEngine:
 
     def train_batch(self, data_iter=None, batch=None) -> jnp.ndarray:
         """One full optimizer step over gas micro-batches (reference
-        PipelineEngine.train_batch semantics for the non-pipeline engine)."""
+        PipelineEngine.train_batch semantics for the non-pipeline engine).
+
+        Resilience hooks: the ``train.step`` fault-injection site fires on
+        entry, and the hang watchdog (config ``resilience.watchdog``) is
+        armed for the step's duration — a step wedged inside a collective
+        becomes a stack report + supervisor-recyclable exit instead of a
+        silent forever-hang."""
+        from ..resilience.fault_injection import SITE_TRAIN_STEP, maybe_fire
+
+        if self._watchdog is None:
+            maybe_fire(SITE_TRAIN_STEP, step=self.global_steps + 1)
+            return self._train_batch_impl(data_iter=data_iter, batch=batch)
+        with self._watchdog.armed(f"train_batch step {self.global_steps + 1}"):
+            maybe_fire(SITE_TRAIN_STEP, step=self.global_steps + 1)
+            return self._train_batch_impl(data_iter=data_iter, batch=batch)
+
+    def _train_batch_impl(self, data_iter=None, batch=None) -> jnp.ndarray:
         if batch is None:
             if data_iter is None:
                 if self.training_dataloader is None:
@@ -1452,10 +1479,16 @@ class DeepSpeedEngine:
     def wait_for_checkpoint(self):
         """Block until an in-flight async save (checkpoint.async_save) is
         durable and `latest` is published; re-raises a failed save.  No-op
-        for synchronous saves (reference Nebula commit barrier)."""
+        for synchronous saves (reference Nebula commit barrier).  The join
+        is bounded (the engine's finalize timeout) and the hang watchdog is
+        armed around it, so a wedged storage write ends in a stack report +
+        restartable exit, never a hung shutdown."""
         from .checkpoint_engine.async_engine import wait_for_pending_checkpoint
 
-        wait_for_pending_checkpoint(self)
+        if self._watchdog is None:
+            return wait_for_pending_checkpoint(self)
+        with self._watchdog.armed("async-checkpoint finalize"):
+            return wait_for_pending_checkpoint(self)
 
     def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
                         load_lr_scheduler_states=True, load_module_only=False):

@@ -29,10 +29,7 @@ if not _REAL_TPU:
 import pytest  # noqa: E402
 
 
-def pytest_configure(config):
-    config.addinivalue_line("markers", "tpu: requires real TPU hardware")
-    config.addinivalue_line("markers", "sequential: must not run in parallel")
-    config.addinivalue_line("markers", "slow: long-running test")
+# markers are declared once, in pyproject.toml [tool.pytest.ini_options]
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""Chaos soak: N supervised elastic rounds under seeded random fault
+injection; asserts the run still converges to the final step.
+
+Each soak round draws a fault mix from a seeded PRNG — preemption SIGTERMs
+at random steps, checkpoint-write failures, corruption of the newest
+committed generation, publish-point crashes — and runs a supervised
+training session (Supervisor + ElasticAgent + a real engine on the virtual
+CPU mesh) to ``--total-steps``.  The invariants checked after every soak:
+
+- the supervisor exits 0 (work completed despite the faults);
+- the final committed checkpoint verifies and carries ``total_steps``;
+- every corrupted generation ended in a ``*.corrupt`` quarantine, never in
+  the resume path.
+
+Deterministic per ``--seed``: the same seed replays the same fault
+schedule.  Usage::
+
+    JAX_PLATFORMS=cpu python tools/chaos_soak.py --soaks 3 --seed 7
+
+The tier-1 suite runs the equivalent single deterministic scenario
+(tests/unit/test_resilience.py); this driver is the long-form randomized
+variant (its pytest hook is marked ``slow``).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+from random import Random
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir, "tests"))
+
+
+def run_soak(seed: int, total_steps: int, ckpt_every: int, ckpt_dir: str,
+             verbose: bool = True) -> dict:
+    """One supervised session under a random fault schedule; returns stats.
+    Raises AssertionError when an invariant breaks."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import deepspeed_tpu
+    from deepspeed_tpu.elasticity import ElasticAgent, Supervisor
+    from deepspeed_tpu.parallel import mesh as mesh_mod
+    from deepspeed_tpu.resilience import (FaultInjector, candidate_tags,
+                                          checkpoint_progress_fn,
+                                          clear_injector, install_injector,
+                                          verify_checkpoint_dir)
+    from deepspeed_tpu.resilience.fault_injection import (
+        SITE_CKPT_SAVE, SITE_LATEST_PUBLISH, SITE_TRAIN_STEP, corrupt_file)
+    from unit.simple_model import SimpleModel, make_config, random_batch
+
+    rng = Random(seed)
+    inj = FaultInjector()
+    # a couple of preemptions at random steps across the session
+    for _ in range(rng.randint(1, 2)):
+        inj.add(site=SITE_TRAIN_STEP, kind="sigterm",
+                at_call=rng.randint(2, max(3, total_steps - 1)))
+    # one failed save and/or one publish-point crash
+    if rng.random() < 0.8:
+        inj.add(site=SITE_CKPT_SAVE, kind="raise",
+                at_call=rng.randint(1, 3))
+    if rng.random() < 0.5:
+        inj.add(site=SITE_LATEST_PUBLISH, kind="raise",
+                at_call=rng.randint(1, 2))
+    corrupt_in_round = rng.randint(1, 3) if rng.random() < 0.8 else -1
+    install_injector(inj)
+
+    corrupted = []
+
+    def attempt(round_idx):
+        if round_idx == corrupt_in_round and not corrupted:
+            tags = candidate_tags(ckpt_dir)
+            if tags:
+                victim = os.path.join(
+                    ckpt_dir, tags[0],
+                    rng.choice(["client_state.json", "manifest.json"]))
+                if os.path.exists(victim):
+                    corrupt_file(victim, seed=seed)
+                    corrupted.append(victim)
+        mesh_mod.reset_mesh()
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=SimpleModel(16), config=make_config(batch_size=16))
+        agent = ElasticAgent(engine, ckpt_dir, ckpt_every=ckpt_every)
+        try:
+            last = agent.run(
+                lambda eng, i: eng.train_batch(
+                    batch=random_batch(16, 16, seed=i)), total_steps)
+        finally:
+            agent.guard.uninstall()
+        return 0 if last >= total_steps else 75
+
+    progress = checkpoint_progress_fn(ckpt_dir)
+    sup = Supervisor(attempt, max_restarts=12, backoff_s=0,
+                     progress_fn=progress, zero_progress_limit=4, seed=seed)
+    rc = sup.run()
+    clear_injector()
+
+    assert rc == 0, f"soak seed={seed}: supervisor exited rc={rc} " \
+                    f"(diagnosis: {sup.diagnosis})"
+    final = progress()
+    assert final == total_steps, \
+        f"soak seed={seed}: converged to step {final}, wanted {total_steps}"
+    newest = candidate_tags(ckpt_dir)[0]
+    verify_checkpoint_dir(os.path.join(ckpt_dir, newest))
+    stats = {
+        "seed": seed,
+        "faults_fired": len(inj.log),
+        "fault_log": inj.log,
+        "corrupted": [os.path.relpath(c, ckpt_dir) for c in corrupted],
+        "quarantined": sorted(d for d in os.listdir(ckpt_dir)
+                              if ".corrupt" in d),
+        "final_step": final,
+    }
+    if corrupted:
+        assert stats["quarantined"], \
+            f"soak seed={seed}: corruption injected but nothing quarantined"
+    if verbose:
+        print(f"  seed={seed}: OK — {stats['faults_fired']} fault(s) fired, "
+              f"{len(stats['quarantined'])} quarantined, "
+              f"final step {final}")
+    return stats
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="randomized fault-injection soak for the resilience "
+                    "subsystem")
+    ap.add_argument("--soaks", type=int, default=3,
+                    help="number of supervised sessions to soak")
+    ap.add_argument("--total-steps", type=int, default=8)
+    ap.add_argument("--ckpt-every", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=0,
+                    help="base seed; soak i uses seed+i")
+    ap.add_argument("--keep-dirs", action="store_true",
+                    help="keep the per-soak checkpoint dirs for inspection")
+    args = ap.parse_args(argv)
+
+    failures = 0
+    for i in range(args.soaks):
+        seed = args.seed + i
+        ckpt_dir = tempfile.mkdtemp(prefix=f"chaos_soak_{seed}_")
+        print(f"soak {i + 1}/{args.soaks} (seed={seed}) -> {ckpt_dir}")
+        try:
+            run_soak(seed, args.total_steps, args.ckpt_every, ckpt_dir)
+        except AssertionError as e:
+            failures += 1
+            print(f"  FAILED: {e}", file=sys.stderr)
+        finally:
+            if not args.keep_dirs:
+                shutil.rmtree(ckpt_dir, ignore_errors=True)
+    print(f"chaos soak: {args.soaks - failures}/{args.soaks} converged")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
