@@ -306,8 +306,11 @@ def _device_responsive(timeout_s: float = 180.0):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--mode", default="train", choices=["train", "inference"])
-    ap.add_argument("--model", default="llama-740m")
+    ap.add_argument("--mode", default="train",
+                    choices=["train", "inference", "serve"])
+    # default=None sentinel so serve mode can pick its own default model
+    # without silently overriding an EXPLICIT --model llama-740m
+    ap.add_argument("--model", default=None)
     # default config: long-context llama (S=16384) — the regime the flash
     # kernel + remat design target; measured best on the single v5e chip
     # (r4 on-chip: mb1/S16384: 108.35 and 108.34 across two runs vs
@@ -345,6 +348,10 @@ def main():
                     help="run exactly one attempt in-process (used by the "
                          "subprocess-isolated OOM-retry loop)")
     args = ap.parse_args()
+    if args.model is None:
+        # serve decodes a 374m-class model by default (the 740m train
+        # default is sized for the fused-Adam training peak, not decode)
+        args.model = "llama-374m" if args.mode == "serve" else "llama-740m"
 
     if not args.no_retry:
         # retry the probe a few times before declaring the device down: the
@@ -370,6 +377,20 @@ def main():
             print(json.dumps({"metric": metric, "value": 0.0, "unit": unit,
                               "vs_baseline": 0.0, "error": err}))
             sys.exit(1)
+
+    if args.mode == "serve":
+        # continuous-batching serving bench (BENCH_SERVE JSON): mixed-length
+        # seeded stream through ServingEngine vs sequential generate();
+        # details + thresholds live in tools/serve_bench.py
+        import os
+
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "tools"))
+        from serve_bench import run_serve_bench
+
+        b_slots = 8 if args.micro_batch is None else args.micro_batch
+        print(json.dumps(run_serve_bench(args.model, b_slots=b_slots)))
+        return
 
     if args.mode == "inference":
         batch = 3 if args.micro_batch is None else args.micro_batch

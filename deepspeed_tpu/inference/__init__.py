@@ -1,0 +1,6 @@
+"""Inference subsystem: the one-shot engine (``engine.InferenceEngine``,
+built by ``deepspeed_tpu.init_inference``) and the continuous-batching
+serving engine (``serving.ServingEngine``)."""
+from .config import DeepSpeedInferenceConfig  # noqa: F401
+from .engine import InferenceEngine  # noqa: F401
+from .serving import Request, RequestResult, ServingEngine  # noqa: F401

@@ -14,6 +14,8 @@ captured graph.
 """
 from __future__ import annotations
 
+import weakref
+from collections import OrderedDict
 from typing import Any, Callable, Optional
 
 import numpy as np
@@ -47,11 +49,17 @@ def auto_tp_specs(params: Any, mesh) -> Any:
 
 
 class InferenceEngine:
+    # bound LRU of compiled generate programs: distinct (model, shape,
+    # sampling) tuples each hold a full jitted program — unbounded growth is
+    # a memory leak on long-lived engines serving many shapes
+    GEN_CACHE_MAX = 32
+    _warned_uncached = False   # one-time fallback warning (class-wide)
+
     def __init__(self, model: Any = None, config: Optional[DeepSpeedInferenceConfig] = None,
                  apply_fn: Optional[Callable] = None, params: Any = None, mesh=None):
         self._config = config or DeepSpeedInferenceConfig()
         self._model = model if hasattr(model, "apply_cached") else None
-        self._gen_cache: dict = {}
+        self._gen_cache: OrderedDict = OrderedDict()
         if self._config.use_flash_decode:
             logger.warning(
                 "use_flash_decode: the Pallas decode kernel was RETIRED in "
@@ -140,6 +148,23 @@ class InferenceEngine:
         """The wrapped model adapter (reference InferenceEngine.module)."""
         return self._model
 
+    def serving(self, **kwargs):
+        """A continuous-batching :class:`~.serving.ServingEngine` sharing
+        this engine's model and (cast/sharded) params, so serving numerics
+        are identical to :meth:`generate`.  See docs/SERVING.md."""
+        if self._quant:
+            raise NotImplementedError(
+                "serving on a quantized engine: the paged decode path has "
+                "no dequantize shim yet")
+        if self._model is None or not hasattr(self._model, "apply_paged"):
+            raise ValueError(
+                "serving() needs a model with the paged decode contract "
+                "(apply_paged) — see models.CausalLM")
+        from .serving import ServingEngine
+
+        kwargs.setdefault("mesh", self.mesh)
+        return ServingEngine(self._model, self.params, **kwargs)
+
     def forward(self, *args, **kwargs):
         if self.params is not None:
             return self._forward(self.params, *args, **kwargs)
@@ -211,7 +236,12 @@ class InferenceEngine:
                 cache, lg, pos, done, key = carry
                 key, sub = jax.random.split(key)
                 tok = sample(lg, sub)
-                tok = jnp.where(done, jnp.maximum(eos_id, 0), tok)
+                # done rows repeat eos_id verbatim (never a clamped stand-in:
+                # jnp.maximum(eos_id, 0) silently emitted token 0 for done
+                # rows).  With eos_token_id=None the sentinel is -1, tokens
+                # are >= 0, so `done` can never become True and the sentinel
+                # is never emitted.
+                tok = jnp.where(done, eos_id, tok)
                 done = done | (tok == eos_id)
                 lg2, cache = model.apply_cached(
                     params, tok[:, None], cache, pos[:, None], ~done[:, None])
@@ -270,14 +300,32 @@ class InferenceEngine:
         # positions: cumulative index of real tokens (pads repeat the last)
         pos = np.maximum(np.cumsum(mpad, axis=1) - 1, 0).astype(np.int32)
 
-        key = (id(model), B, S_pad, max_new_tokens, greedy, top_k, top_p)
-        if key not in self._gen_cache:
-            self._gen_cache[key] = self._generate_program(
+        # weakref-held model identity: id(model) can be REUSED after GC and
+        # would then serve a stale program compiled for a different model.
+        # A weakref compares by referent identity while alive and can never
+        # equal a ref to a new object once dead — stale entries are inert
+        # and age out of the LRU below.  (Either way the cached program's
+        # closure pins the model while its entry lives, so an id in a live
+        # key can never be recycled; eviction releases the pin.)
+        try:
+            mkey: Any = weakref.ref(model)
+            hash(mkey)   # a ref hashes via its referent — an unhashable
+        except TypeError:          # or weakref-less adapter falls back:
+            mkey = (id(model),)    # id is safe while the entry (and its
+                                   # closure pin on the model) lives
+        key = (mkey, B, S_pad, max_new_tokens, greedy, top_k, top_p)
+        prog = self._gen_cache.get(key)
+        if prog is None:
+            prog = self._gen_cache[key] = self._generate_program(
                 model, B, S_pad, max_new_tokens, greedy,
                 top_k=top_k, top_p=top_p)
+            while len(self._gen_cache) > self.GEN_CACHE_MAX:
+                self._gen_cache.popitem(last=False)
+        else:
+            self._gen_cache.move_to_end(key)
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         eos = jnp.int32(-1 if eos_token_id is None else eos_token_id)
-        new = self._gen_cache[key](
+        new = prog(
             self.params if params is None else params,
             jnp.asarray(toks), jnp.asarray(mpad), jnp.asarray(pos),
             rng, eos, jnp.float32(temperature))
@@ -288,24 +336,67 @@ class InferenceEngine:
                            rng: Optional[jax.Array] = None, temperature: float = 1.0,
                            params=None):
         """Full-recompute fallback for arbitrary logits-returning apply_fns
-        (and the parity reference for the cached path in tests)."""
-        ids = jnp.asarray(input_ids)
+        (and the parity reference for the cached path in tests).
+
+        The forward runs on sequences RIGHT-PADDED to the ``_bucket``
+        granularity, reading logits at the last real position — a growing
+        ``ids`` would otherwise retrace/recompile the jitted forward EVERY
+        step; padded, the whole generation compiles O(log) programs.  The
+        bucketing requires a causal ``apply_fn`` (tail pads must not affect
+        earlier positions' logits); the first call probes this with one
+        padded-vs-unpadded logit comparison and a non-causal apply_fn drops
+        back to the exact (per-step retracing) path with a warning."""
+        if not InferenceEngine._warned_uncached:
+            InferenceEngine._warned_uncached = True
+            logger.warning(
+                "generate() is using the full-recompute fallback (O(S) "
+                "forward per token).  Give the model a KV cache "
+                "(apply_cached — see models.CausalLM) for the single-"
+                "program cached decode path.")
+        ids = np.asarray(input_ids)
         if ids.ndim == 1:
             ids = ids[None, :]
+        B = ids.shape[0]
         rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+        def fwd(tokens):
+            logits = (self._forward(params, tokens) if params is not None
+                      else self.forward(tokens))
+            return logits[0] if isinstance(logits, tuple) else logits
+
         for _ in range(max_new_tokens):
-            if params is not None:
-                logits = self._forward(params, ids)
+            n = ids.shape[1]
+            if getattr(self, "_uncached_causal", None) is False:
+                next_logits = fwd(ids)[:, n - 1, :]
             else:
-                logits = self.forward(ids)
-            logits = logits[0] if isinstance(logits, tuple) else logits
-            next_logits = logits[:, -1, :]
+                padded = np.zeros((B, self._bucket(n)), ids.dtype)
+                padded[:, :n] = ids
+                next_logits = fwd(padded)[:, n - 1, :]
+                if (getattr(self, "_uncached_causal", None) is None
+                        and padded.shape[1] > n):
+                    # one-time causality probe: tail pads must not reach
+                    # position n-1 or the bucketed outputs would silently
+                    # diverge from the exact ones (prefix-LM apply_fns).
+                    # Only a genuinely padded step can probe — at n ==
+                    # bucket(n) the two forwards would compare identical
+                    # arrays and latch a vacuous True verdict
+                    exact = fwd(ids)[:, n - 1, :]
+                    self._uncached_causal = bool(jnp.allclose(
+                        exact, next_logits, rtol=1e-4, atol=1e-5))
+                    if not self._uncached_causal:
+                        logger.warning(
+                            "uncached generate: apply_fn is not causal "
+                            "(pad tokens leak into earlier logits) — "
+                            "using the exact per-step path, which "
+                            "retraces every new length")
+                        next_logits = exact
             if greedy:
                 nxt = jnp.argmax(next_logits, axis=-1)
             else:
                 rng, sub = jax.random.split(rng)
                 nxt = jax.random.categorical(sub, next_logits / temperature, axis=-1)
-            ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
+            ids = np.concatenate([ids, np.asarray(nxt)[:, None].astype(ids.dtype)],
+                                 axis=1)
             if eos_token_id is not None and bool((nxt == eos_token_id).all()):
                 break
-        return ids
+        return jnp.asarray(ids)

@@ -12,14 +12,16 @@ import os
 
 import jax.numpy as jnp
 
-from .transformer import (CONFIGS, TransformerConfig, cache_specs,
+from .transformer import (CONFIGS, PAGE_SIZE, TransformerConfig, cache_specs,
                           cross_entropy_loss, forward, forward_cached,
-                          get_config, has_moe, init_cache, init_params,
+                          forward_paged, get_config, has_moe, init_cache,
+                          init_paged_cache, init_params, paged_cache_specs,
                           param_specs)
 
 __all__ = ["CausalLM", "TransformerConfig", "CONFIGS", "get_config", "forward",
-           "forward_cached", "init_cache", "cache_specs", "init_params",
-           "param_specs", "cross_entropy_loss"]
+           "forward_cached", "forward_paged", "init_cache", "init_paged_cache",
+           "cache_specs", "paged_cache_specs", "init_params", "param_specs",
+           "cross_entropy_loss", "PAGE_SIZE"]
 
 
 class CausalLM:
@@ -184,6 +186,18 @@ class CausalLM:
     def apply_cached(self, params, tokens, cache, positions, input_mask):
         return forward_cached(self.config, params, tokens, cache, positions,
                               input_mask)
+
+    # -- block-paged decode contract (used by ServingEngine): one physical
+    #    page pool multiplexed across decode slots via per-slot page tables --
+    def init_paged_cache(self, num_pages, page_size=PAGE_SIZE, dtype=None):
+        return init_paged_cache(self.config, num_pages, page_size, dtype)
+
+    def paged_cache_specs(self):
+        return paged_cache_specs(self.config)
+
+    def apply_paged(self, params, tokens, cache, page_table, start, seq_mask):
+        return forward_paged(self.config, params, tokens, cache, page_table,
+                             start, seq_mask)
 
     @property
     def param_count(self) -> int:

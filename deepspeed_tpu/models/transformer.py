@@ -1472,6 +1472,203 @@ def forward_cached(cfg: TransformerConfig, params: Dict[str, Any],
     return logits, new_cache
 
 
+# ---------------------------------------------------------------------------
+# Block-paged KV cache (the serving path).  Reference: the inference_context
+# KV workspace sizes one persistent cache and multiplexes requests through it
+# (csrc/transformer/inference/includes/inference_context.h); vLLM's
+# PagedAttention (SOSP '23) showed the block-table indirection that lets
+# requests of different lengths share one physical pool.  TPU redesign: the
+# pool is a fixed-shape [L, P, page, Hkv, hd] array, a page is 128 tokens
+# (lane-aligned), and every program over it — bucketed prefill, one-token
+# decode — has a static shape, so XLA compiles the whole serving loop into a
+# constant program inventory.  Slot-local token index == position (the
+# serving engine admits each request at position 0 of a fresh slot), so the
+# causal mask IS the validity mask and no per-slot bitmap is needed.
+# ---------------------------------------------------------------------------
+
+PAGE_SIZE = 128   # tokens per KV page; 128 keeps cache tiles lane-aligned
+
+
+def init_paged_cache(cfg: TransformerConfig, num_pages: int,
+                     page_size: int = PAGE_SIZE, dtype=None) -> Dict[str, Any]:
+    """Allocate the physical page pool: ``k``/``v`` are
+    ``[L, num_pages, page_size, Hkv, hd]``.
+
+    Physical page 0 is RESERVED as the trash page: pad-token and
+    inactive-slot writes are redirected there (a masked write must still be
+    a static-shape scatter), and it is also the page-table value for
+    unallocated entries — its slot-indices always sit beyond every real
+    query position, so the causal mask keeps it out of attention.  The
+    serving engine hands out pages 1..num_pages-1.
+    """
+    dtype = dtype or cfg.dtype
+    kv = (cfg.num_layers, num_pages, page_size, cfg.kv_heads,
+          cfg.dims_per_head)
+    return {"k": jnp.zeros(kv, dtype), "v": jnp.zeros(kv, dtype)}
+
+
+def paged_cache_specs(cfg: TransformerConfig) -> Dict[str, P]:
+    """Shardings for the page pool: KV heads over 'model'; pages replicated
+    (any slot on any data shard may own any page)."""
+    kv = P(None, None, None, "model", None)
+    return {"k": kv, "v": kv}
+
+
+def _attention_paged(cfg, q, ck, cv, q_pos):
+    """q:[B,S,Hq,hd] against gathered per-slot pages ck/cv:[B,T,Hkv,hd].
+
+    Slot-local index == position, so the mask is purely causal
+    (``t <= q_pos``): every slot-index at or before the query holds a real
+    token of this request, everything after (including trash-page gathers
+    from unallocated page-table entries) is masked.  Same einsum structure
+    as :func:`_attention_cached` — GQA contracts grouped heads against the
+    Hkv cache directly, and decode stays on the XLA path (the Pallas decode
+    kernel was retired in round 5 on an honest A/B).
+    """
+    B, S, Hq, hd = q.shape
+    T, Hkv = ck.shape[1], ck.shape[2]
+    G = Hq // Hkv
+    qg = q.reshape(B, S, Hkv, G, hd)
+    scores = jnp.einsum("bskgd,btkd->bkgst", qg, ck).astype(jnp.float32)
+    scores = scores * _sm_scale(cfg, hd)
+    t = jnp.arange(T, dtype=jnp.int32)
+    if cfg.position == "alibi":
+        slopes = jnp.asarray(_alibi_slopes(Hq)).reshape(Hkv, G)
+        rel = (q_pos[:, :, None] - t[None, None, :]).astype(jnp.float32)
+        scores = scores - (jnp.abs(rel)[:, None, None, :, :]
+                           * slopes[None, :, :, None, None])
+    ok = t[None, None, :] <= q_pos[:, :, None]                  # [B,S,T]
+    scores = jnp.where(ok[:, None, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs, cv)
+    return out.reshape(B, S, Hq, hd)
+
+
+def _block_paged(cfg, lp, x, ckf, cvf, positions, write_idx, gather_idx, rng):
+    """One transformer block against the paged pool.  ``ckf``/``cvf`` are
+    this layer's pool flattened to ``[P*page, Hkv, hd]``; ``write_idx``
+    [B*S] flat destinations (trash-redirected for masked tokens);
+    ``gather_idx`` [B, T] flat sources for each slot's pages."""
+    B, S, _ = x.shape
+    hd, nh, nkv = cfg.dims_per_head, cfg.num_heads, cfg.kv_heads
+
+    h = _norm(cfg, x, lp["attn_norm_scale"], lp.get("attn_norm_bias"))
+    h = _maybe_act_quant(cfg, h)
+    q = h @ lp["wq"]
+    k = h @ lp["wk"]
+    v = h @ lp["wv"]
+    if cfg.attn_bias:
+        q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+    q = q.reshape(B, S, nh, hd)
+    k = k.reshape(B, S, nkv, hd)
+    v = v.reshape(B, S, nkv, hd)
+    if cfg.position == "rope":
+        q, k = _rope(q, k, positions, cfg.rope_theta, hd,
+                     rotary_dim=cfg.rotary_dim,
+                     interleaved=cfg.rope_interleaved)
+    ckf = ckf.at[write_idx].set(k.reshape(B * S, nkv, hd).astype(ckf.dtype))
+    cvf = cvf.at[write_idx].set(v.reshape(B * S, nkv, hd).astype(cvf.dtype))
+    ckf = constrain_spec(ckf, P(None, "model", None))
+    cvf = constrain_spec(cvf, P(None, "model", None))
+    ck = ckf[gather_idx]   # [B, T, Hkv, hd] — each slot's pages, in order
+    cv = cvf[gather_idx]
+    attn = _attention_paged(cfg, q, ck, cv, positions)
+    attn = attn.reshape(B, S, nh * hd) @ lp["wo"]
+    if cfg.attn_bias:
+        attn = attn + lp["bo"]
+
+    if cfg.parallel_residual:
+        h2 = h if cfg.shared_layernorm else _maybe_act_quant(cfg, _norm(
+            cfg, x, lp["mlp_norm_scale"], lp.get("mlp_norm_bias")))
+        m, _ = _mlp(cfg, lp, h2, rng, deterministic=True)
+        return x + attn + m, ckf, cvf
+
+    x = x + attn
+    h = _norm(cfg, x, lp["mlp_norm_scale"], lp.get("mlp_norm_bias"))
+    h = _maybe_act_quant(cfg, h)
+    m, _ = _mlp(cfg, lp, h, rng, deterministic=True)
+    return x + m, ckf, cvf
+
+
+def forward_paged(cfg: TransformerConfig, params: Dict[str, Any],
+                  tokens: jax.Array, cache: Dict[str, Any],
+                  page_table: jax.Array, start: jax.Array,
+                  seq_mask: jax.Array):
+    """Run ``tokens [B,S]`` against the paged pool, writing each real token's
+    K/V at its slot position and attending each query to its own slot only.
+
+    ``page_table [B, maxp]`` int32: physical page id of each slot's logical
+    page (0 = the reserved trash page, also the unallocated filler).
+    ``start [B]``: slot position of ``tokens[:, 0]`` (0 for prefill, the
+    current length for decode).  ``seq_mask [B,S]``: True for real tokens —
+    False tokens' K/V are redirected to the trash page and their logits are
+    garbage (the caller reads logits only at real positions).
+
+    One function, two static shapes at steady state — bucketed prefill
+    ``[1, S_pad]`` and fleet decode ``[B_slots, 1]`` — so admission into a
+    running batch never recompiles.  Returns ``(logits [B,S,V], new_cache)``.
+    """
+    assert cfg.pipeline_stages == 1, "paged decode requires pipeline_stages=1"
+    if not cfg.causal:
+        raise NotImplementedError(
+            "paged decode is a causal-LM operation; encoder models "
+            "(causal=False) have no autoregressive cache")
+    if isinstance(params["layers"], (list, tuple)):
+        raise NotImplementedError(
+            "paged decode with a PR-MoE pyramid (per-layer num_experts) is "
+            "not supported: the layer scan needs uniform stacks")
+    if cfg.attention_layers is not None:
+        raise NotImplementedError(
+            "paged decode does not support per-layer attention windows "
+            "(attention_layers); use the contiguous cache path")
+    B, S = tokens.shape
+    num_pages, ps = cache["k"].shape[1], cache["k"].shape[2]
+    maxp = page_table.shape[1]
+
+    positions = start[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+    page_idx = jnp.minimum(positions // ps, maxp - 1)
+    phys = jnp.take_along_axis(page_table, page_idx, axis=1)       # [B,S]
+    flat = phys * ps + positions % ps
+    # masked tokens write to the trash page (page 0, offset 0): the scatter
+    # keeps its static shape and real pages are never corrupted
+    write_idx = jnp.where(seq_mask, flat, 0).reshape(B * S)
+    gather_idx = (page_table[:, :, None] * ps
+                  + jnp.arange(ps, dtype=jnp.int32)[None, None, :]
+                  ).reshape(B, maxp * ps)
+
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    if cfg.position == "learned":
+        safe_pos = jnp.minimum(positions, cfg.max_seq_len - 1)
+        x = x + params["pos_embed"].astype(cfg.dtype)[safe_pos]
+    if cfg.embed_layernorm:      # Bloom embedding LayerNorm
+        x = _norm(cfg, x, params["embed_norm_scale"],
+                  params.get("embed_norm_bias"))
+    x = constrain_spec(x, P(BATCH_AXES, None, None))
+
+    rng = jax.random.PRNGKey(0)
+
+    def body(x, layer):
+        lp, ck, cv = layer
+        x, ckf, cvf = _block_paged(cfg, lp, x,
+                                   ck.reshape(num_pages * ps, *ck.shape[2:]),
+                                   cv.reshape(num_pages * ps, *cv.shape[2:]),
+                                   positions, write_idx, gather_idx, rng)
+        x = constrain_spec(x, P(BATCH_AXES, None, None))
+        return x, (ckf.reshape(ck.shape), cvf.reshape(cv.shape))
+
+    x, (ck_all, cv_all) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"]))
+
+    x = _norm(cfg, x, params["final_norm_scale"], params.get("final_norm_bias"))
+    if cfg.tie_embeddings:
+        logits = x @ params["embed"].astype(cfg.dtype).T
+    else:
+        logits = x @ params["lm_head"].astype(cfg.dtype)
+        if "lm_head_bias" in params:   # GPT-J ties a bias to the LM head
+            logits = logits + params["lm_head_bias"].astype(cfg.dtype)
+    return logits, {"k": ck_all, "v": cv_all}
+
+
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array,
                        ignore_index: int = -100) -> jax.Array:
     """Mean next-token NLL; positions with ``labels == ignore_index`` masked."""

@@ -104,6 +104,30 @@ class csvMonitor(Monitor):
             f.write(text + "\n")
 
 
+class InMemoryMonitor(Monitor):
+    """Event sink that keeps ``(name, value, step)`` tuples in memory.
+
+    Used by the serving engine's tests/tools to assert on the gauge stream
+    (TTFT, tokens/sec, queue depth, slot occupancy — serving.py writes
+    ``serve/*`` events every tick) without filesystem or backend setup."""
+
+    def __init__(self, monitor_config=None):
+        super().__init__(monitor_config)
+        self.events: List[Event] = []
+        self.reports: List[Tuple[str, str]] = []
+
+    def write_events(self, event_list: List[Event]) -> None:
+        self.events.extend(event_list)
+
+    def write_report(self, name: str, text: str) -> None:
+        self.reports.append((name, text))
+
+    def series(self, name: str) -> List[Tuple[int, float]]:
+        """[(step, value)] of every event with this name, in write order."""
+        return [(step, value) for (n, value, step) in self.events
+                if n == name]
+
+
 class MonitorMaster(Monitor):
     """Rank-0 fan-out to all enabled writers (reference monitor.py:29)."""
 

@@ -22,6 +22,8 @@ from .fault_injection import (  # noqa: F401
     SITE_CKPT_LOAD,
     SITE_CKPT_SAVE,
     SITE_LATEST_PUBLISH,
+    SITE_SERVE_ADMIT,
+    SITE_SERVE_TICK,
     SITE_SUPERVISOR_ATTEMPT,
     SITE_TRAIN_STEP,
     clear_injector,

@@ -16,6 +16,9 @@ Sites wired into the tree:
                           tag directory about to be read
 ``train.step``            entry of ``DeepSpeedEngine.train_batch``
 ``supervisor.attempt``    inside ``Supervisor.run`` before each attempt
+``serve.tick``            top of every ``ServingEngine.step`` scheduler tick
+``serve.admit``           inside ``ServingEngine`` admission, after a queued
+                          request is popped and before its prefill runs
 ========================  ====================================================
 
 Fault kinds: ``raise`` (raise :class:`InjectedFault`), ``delay`` (sleep
@@ -51,9 +54,12 @@ SITE_CKPT_LOAD = "ckpt.load"
 SITE_LATEST_PUBLISH = "ckpt.publish_latest"
 SITE_TRAIN_STEP = "train.step"
 SITE_SUPERVISOR_ATTEMPT = "supervisor.attempt"
+SITE_SERVE_TICK = "serve.tick"
+SITE_SERVE_ADMIT = "serve.admit"
 
 SITES = (SITE_CKPT_SAVE, SITE_CKPT_LOAD, SITE_LATEST_PUBLISH,
-         SITE_TRAIN_STEP, SITE_SUPERVISOR_ATTEMPT)
+         SITE_TRAIN_STEP, SITE_SUPERVISOR_ATTEMPT, SITE_SERVE_TICK,
+         SITE_SERVE_ADMIT)
 KINDS = ("raise", "delay", "corrupt", "sigterm")
 
 FAULTS_ENV = "DS_TPU_FAULTS"

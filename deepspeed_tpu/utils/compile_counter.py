@@ -1,0 +1,32 @@
+"""Process-wide XLA compile counter over ``jax.monitoring``.
+
+The ``/jax/core/compile/backend_compile_duration`` duration event fires once
+per actual backend compile (cache hits don't), which makes it the honest
+instrument for zero-recompile contracts (serving admission, bench steady
+state).  ``jax.monitoring`` has no unregister, so the listener is a
+process-wide singleton — every caller shares one event list and takes
+deltas around the section it cares about.
+"""
+from __future__ import annotations
+
+from typing import Callable, List
+
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_EVENTS: List[str] = []
+_INSTALLED = False
+
+
+def compile_counter() -> Callable[[], int]:
+    """Install (once) the backend-compile listener and return a zero-arg
+    ``count()``; callers snapshot it before/after a section and diff."""
+    global _INSTALLED
+    if not _INSTALLED:
+        _INSTALLED = True
+        import jax.monitoring
+
+        def _listen(name, duration, **kw):
+            if name == _BACKEND_COMPILE_EVENT:
+                _EVENTS.append(name)
+
+        jax.monitoring.register_event_duration_secs_listener(_listen)
+    return lambda: len(_EVENTS)

@@ -1,0 +1,367 @@
+"""Continuous-batching serving engine tests (ISSUE 2 tentpole).
+
+Covers: ragged-stream token parity vs per-request ``generate()``, the
+constant program inventory (zero-recompile admission), paged-pool
+bookkeeping, EOS/length retirement, arrival gating, monitor gauges, and the
+chaos-marker admission-under-delay case.
+
+Compile discipline (single-core CI): one module-scoped tiny engine + ONE
+shared ServingEngine shape serve most tests, and streams draw max_new from
+a small choice set — every distinct (bucket, max_new) pair costs a baseline
+generate() scan compile.
+"""
+import gc
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.inference.serving import Request, ServingEngine
+from deepspeed_tpu.models import CausalLM
+from deepspeed_tpu.monitor import InMemoryMonitor
+from deepspeed_tpu.resilience import (FaultInjector, SITE_SERVE_ADMIT,
+                                      SITE_SERVE_TICK, clear_injector,
+                                      install_injector)
+
+from deepspeed_tpu.utils.compile_counter import compile_counter
+
+_compile_count = compile_counter()
+
+
+def _make_engine(model_name="tiny", **overrides):
+    model = CausalLM(model_name, dtype=jnp.float32, attn_impl="xla",
+                     **overrides)
+    params = model.init_fn(jax.random.PRNGKey(3))
+    return deepspeed_tpu.init_inference(
+        model=model, config={"dtype": "float32"}, params=params)
+
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    return _make_engine()
+
+
+@pytest.fixture(scope="module")
+def tiny_serve(tiny_engine):
+    """One shared slot fleet (multi-page: page_size 8 < prompts+outputs).
+    run() drains completely, so tests can safely share it."""
+    return tiny_engine.serving(b_slots=3, page_size=8, max_model_len=64,
+                               monitor=InMemoryMonitor())
+
+
+def _stream(n, seed=0, smin=3, smax=14, new_choices=(4, 6, 8), vocab=250,
+            eos=None):
+    rng = np.random.default_rng(seed)
+    return [Request(rid=i,
+                    input_ids=rng.integers(1, vocab,
+                                           int(rng.integers(smin, smax))
+                                           ).astype(np.int32),
+                    max_new_tokens=int(rng.choice(new_choices)),
+                    eos_token_id=eos)
+            for i in range(n)]
+
+
+def _assert_parity(engine, results, requests):
+    by_rid = {r.rid: r for r in requests}
+    assert sorted(r.rid for r in results) == sorted(by_rid)
+    for res in results:
+        req = by_rid[res.rid]
+        base = np.asarray(engine.generate(
+            req.input_ids[None], max_new_tokens=req.max_new_tokens,
+            eos_token_id=req.eos_token_id))[0, len(req.input_ids):]
+        if req.eos_token_id is not None:
+            # generate() pads to max_new repeating eos; serving stops at eos
+            n = len(res.output_ids)
+            np.testing.assert_array_equal(res.output_ids, base[:n])
+            if res.finish_reason == "eos":
+                assert res.output_ids[-1] == req.eos_token_id
+                assert (base[n:] == req.eos_token_id).all()
+        else:
+            np.testing.assert_array_equal(res.output_ids, base)
+
+
+def test_serving_parity_mixed_length_stream(tiny_engine, tiny_serve):
+    """A ragged mixed-length stream through the slot scheduler must be
+    token-identical to per-request greedy generate() (acceptance)."""
+    reqs = _stream(8, seed=1)
+    results = tiny_serve.run(list(reqs))
+    _assert_parity(tiny_engine, results, reqs)
+    # slots + every page returned
+    assert not tiny_serve._active.any()
+    assert len(tiny_serve._free_pages) == tiny_serve.num_pages - 1
+
+
+def test_serving_parity_gqa():
+    """Grouped-query attention through the paged pool."""
+    engine = _make_engine("tiny-gqa")
+    serve = engine.serving(b_slots=2, page_size=8, max_model_len=64)
+    reqs = _stream(3, seed=2, smin=9, smax=14, new_choices=(5, 7))
+    results = serve.run(list(reqs))
+    _assert_parity(engine, results, reqs)
+
+
+def test_serving_parity_alibi():
+    """Position-from-slot-index must hold for alibi's relative biases."""
+    engine = _make_engine("tiny", position="alibi", norm="layernorm",
+                          activation="gelu")
+    serve = engine.serving(b_slots=2, page_size=16, max_model_len=64)
+    reqs = _stream(3, seed=3, new_choices=(5, 7))
+    results = serve.run(list(reqs))
+    _assert_parity(engine, results, reqs)
+
+
+def test_serving_eos_retires_slot(tiny_engine, tiny_serve):
+    probe = _stream(1, seed=4, new_choices=(8,))[0]
+    base = np.asarray(tiny_engine.generate(probe.input_ids[None],
+                                           max_new_tokens=8))[0]
+    eos = int(base[len(probe.input_ids) + 2])   # 3rd generated token
+    req = Request(rid="e", input_ids=probe.input_ids, max_new_tokens=8,
+                  eos_token_id=eos)
+    (res,) = tiny_serve.run([req])
+    assert res.finish_reason == "eos"
+    assert res.output_ids[-1] == eos
+    assert len(res.output_ids) <= 8
+    _assert_parity(tiny_engine, [res], [req])
+    assert not tiny_serve._active.any()
+    assert len(tiny_serve._free_pages) == tiny_serve.num_pages - 1
+
+
+def test_serving_zero_recompile_admission(tiny_engine, tiny_serve):
+    """Acceptance: at steady state the program inventory is 1 decode + 1
+    prefill per bucket, and further streams compile NOTHING new."""
+    tiny_serve.run(list(_stream(3, seed=5)))     # inventory warm (no-op if
+    inv = tiny_serve.program_inventory()         # earlier tests ran first)
+    assert inv["decode"] == 1
+    assert inv["prefill_buckets"] == [16]   # prompts 3..13 share one bucket
+    before = _compile_count()
+    results = tiny_serve.run(list(_stream(6, seed=6)))   # same buckets
+    assert len(results) == 6
+    assert _compile_count() == before       # admission never recompiled
+    assert tiny_serve.program_inventory() == inv
+
+
+def test_serving_arrival_gating_and_gauges(tiny_engine, tiny_serve):
+    mon = tiny_serve.monitor
+    mon.events.clear()
+    reqs = _stream(4, seed=7)
+    for i, r in enumerate(reqs):
+        r.arrival_time = 0.02 * i
+    results = tiny_serve.run(list(reqs))
+    _assert_parity(tiny_engine, results, reqs)
+    for gauge in ("serve/queue_depth", "serve/active_slots",
+                  "serve/slot_occupancy", "serve/free_pages",
+                  "serve/tokens_per_sec"):
+        assert mon.series(gauge), f"missing gauge {gauge}"
+    ttfts = mon.series("serve/ttft_s")
+    assert len(ttfts) == len(reqs)
+    assert all(v >= 0 for _, v in ttfts)
+
+
+def test_serving_submit_validation(tiny_engine, tiny_serve):
+    with pytest.raises(ValueError, match="max_model_len"):
+        tiny_serve.submit(Request(rid=0,
+                                  input_ids=np.arange(60, dtype=np.int32),
+                                  max_new_tokens=10))
+    with pytest.raises(ValueError, match="empty"):
+        tiny_serve.submit(Request(rid=1, input_ids=np.zeros((0,), np.int32)))
+    with pytest.raises(ValueError):
+        ServingEngine(tiny_engine.model, tiny_engine.params, b_slots=1,
+                      page_size=16, max_model_len=64,
+                      num_pages=2)   # cannot hold one slot
+    # duplicate rids would corrupt the results map — rejected at submit
+    tiny_serve.submit(Request(rid="dup", input_ids=np.array([1, 2, 3],
+                                                            np.int32),
+                              max_new_tokens=2))
+    with pytest.raises(ValueError, match="unique"):
+        tiny_serve.submit(Request(rid="dup", input_ids=np.array([4, 5],
+                                                                np.int32),
+                          max_new_tokens=2))
+    (res,) = tiny_serve.run([])   # drain the queued original
+    assert res.rid == "dup" and len(res.output_ids) == 2
+
+
+def test_serving_prefill_failure_unwinds_reservation(tiny_engine, tiny_serve):
+    """A prefill that dies on the device call must not leak pages or drop
+    the request: the reservation unwinds and the request stays at the
+    queue head for a retry."""
+    free_before = len(tiny_serve._free_pages)
+    real_prog = tiny_serve._prefill_progs[16]
+
+    def boom(*a, **k):
+        raise RuntimeError("injected prefill failure")
+
+    tiny_serve._prefill_progs[16] = boom
+    req = Request(rid="pf", input_ids=np.array([1, 2, 3], np.int32),
+                  max_new_tokens=3)
+    tiny_serve.submit(req)
+    try:
+        with pytest.raises(RuntimeError, match="injected prefill"):
+            tiny_serve.step()
+        assert len(tiny_serve._free_pages) == free_before   # pages returned
+        assert tiny_serve._queue[0].rid == "pf"             # still queued
+        assert not tiny_serve._active.any()
+    finally:
+        tiny_serve._prefill_progs[16] = real_prog
+    (res,) = tiny_serve.run([])                             # retry succeeds
+    assert res.rid == "pf" and len(res.output_ids) == 3
+
+
+@pytest.mark.chaos
+def test_serving_chaos_admission_delay_no_deadlock(tiny_engine, tiny_serve):
+    """Satellite: a FaultInjector delay hook on admission + ticks must slow
+    the loop, never wedge it — the stream completes exactly (seeded).  The
+    shared fleet's pool (3 slots) is outsized by the 6-request stream, so
+    admission blocks on busy slots while the injector stalls it."""
+    inj = FaultInjector()
+    inj.add(site=SITE_SERVE_ADMIT, kind="delay", delay_s=0.02, every=1,
+            max_fires=4)
+    inj.add(site=SITE_SERVE_TICK, kind="delay", delay_s=0.005, every=5,
+            max_fires=3)
+    install_injector(inj)
+    try:
+        reqs = _stream(6, seed=9)
+        results = tiny_serve.run(list(reqs), max_ticks=2000)
+    finally:
+        clear_injector()
+    assert len(inj.log) >= 4
+    _assert_parity(tiny_engine, results, reqs)
+    assert len(tiny_serve._free_pages) == tiny_serve.num_pages - 1
+
+
+# ---------------------------------------------------------------- satellites
+
+
+def test_gen_cache_weakref_key_and_lru(tiny_engine):
+    """Satellite: _gen_cache keys on weakref identity (id reuse after GC
+    cannot alias a live entry) and is LRU-bounded."""
+    import weakref
+
+    engine = tiny_engine
+    engine._gen_cache.clear()
+    engine.generate(np.array([[1, 2, 3]]), max_new_tokens=4)
+    assert len(engine._gen_cache) == 1
+    (key,) = engine._gen_cache
+    assert isinstance(key[0], weakref.ref)
+    assert key[0]() is engine.model
+    # same shape re-hit: no growth
+    engine.generate(np.array([[4, 5, 6]]), max_new_tokens=4)
+    assert len(engine._gen_cache) == 1
+
+    # a cached program pins its model via closure (so an id can never be
+    # recycled into a stale hit); eviction releases the pin — the weakref
+    # key carries identity, the LRU cap bounds the pinning
+    other = CausalLM("tiny", dtype=jnp.float32, attn_impl="xla")
+    engine.generate(np.array([[1, 2]]), max_new_tokens=2, model=other,
+                    params=engine.params)
+    assert len(engine._gen_cache) == 2
+    dead_ref = weakref.ref(other)
+    del other
+    gc.collect()
+    assert dead_ref() is not None            # pinned while cached
+    engine._gen_cache.clear()
+    gc.collect()
+    assert dead_ref() is None                # released with its entry
+
+    # unhashable adapters (hash(ref) delegates to the referent) fall back
+    # to the pinned-id key instead of crashing the cache lookup
+    uh = type("UnhashableLM", (CausalLM,), {"__hash__": None})(
+        "tiny", dtype=jnp.float32, attn_impl="xla")
+    out = engine.generate(np.array([[1, 2, 3]]), max_new_tokens=2, model=uh,
+                          params=engine.params)
+    assert out.shape == (1, 5)
+    assert any(isinstance(k[0], tuple) for k in engine._gen_cache)
+
+    # LRU cap: many shapes never grow past GEN_CACHE_MAX (reuse the two
+    # max_new values already compiled above + one new)
+    old = engine.GEN_CACHE_MAX
+    try:
+        type(engine).GEN_CACHE_MAX = 2
+        for m in (4, 2, 3):
+            engine.generate(np.array([[1, 2, 3]]), max_new_tokens=m)
+        assert len(engine._gen_cache) == 2
+        # most-recent entries survive (key[3] is max_new_tokens)
+        assert {k[3] for k in engine._gen_cache} == {2, 3}
+    finally:
+        type(engine).GEN_CACHE_MAX = old
+
+
+def test_uncached_fallback_bounded_compiles():
+    """Satellite: the full-recompute fallback pads to the bucket granularity
+    — long generations compile O(1) programs, not one per token."""
+    from tests.unit.test_inference import tiny_lm
+
+    params, apply_fn = tiny_lm()
+    engine = deepspeed_tpu.init_inference(config={"dtype": "float32"},
+                                          apply_fn=apply_fn, params=params)
+    out = engine.generate(np.array([[1, 2, 3]]), max_new_tokens=20)
+    assert out.shape == (1, 23)
+    # lengths 3..22 span buckets {16, 32} plus the one-time causality
+    # probe's exact (1, 3) forward: three compiled programs, not 20
+    assert engine._forward._cache_size() == 3
+    assert engine._uncached_causal is True
+
+
+def test_uncached_fallback_noncausal_drops_to_exact_path():
+    """A non-causal apply_fn (pads would leak into earlier logits) must be
+    detected by the one-time probe and served by the exact per-step loop."""
+    rng = jax.random.PRNGKey(0)
+    k1, k2 = jax.random.split(rng)
+    params = {"emb": jax.random.normal(k1, (32, 16)) * 0.1,
+              "out": jax.random.normal(k2, (16, 32)) * 0.1}
+
+    def apply_fn(p, ids):
+        h = p["emb"][ids]
+        ctx = h.mean(axis=1, keepdims=True)   # sees the WHOLE row, pads too
+        return (h + ctx) @ p["out"]
+
+    engine = deepspeed_tpu.init_inference(config={"dtype": "float32"},
+                                          apply_fn=apply_fn, params=params)
+    out = np.asarray(engine.generate(np.array([[1, 2, 3]]),
+                                     max_new_tokens=3))
+    assert engine._uncached_causal is False
+    # exact reference: the pre-bucketing growing-sequence loop
+    ids = np.array([[1, 2, 3]])
+    for _ in range(3):
+        logits = np.asarray(apply_fn(params, jnp.asarray(ids)))
+        ids = np.concatenate(
+            [ids, logits[:, -1, :].argmax(-1)[:, None]], axis=1)
+    np.testing.assert_array_equal(out, ids)
+
+
+def test_eos_sentinel_never_emits_token_zero(tiny_engine):
+    """Satellite: done rows repeat eos_id itself; with eos_token_id=None the
+    -1 sentinel can never mark a row done, so no filler is ever emitted."""
+    prompt = np.array([[5, 3, 9, 2]], np.int32)
+    ref = np.asarray(tiny_engine.generate(prompt, max_new_tokens=6))
+    eos = int(ref[0, 5])
+    out = np.asarray(tiny_engine.generate(prompt, max_new_tokens=6,
+                                          eos_token_id=eos))
+    gen = out[0, 4:]
+    hit = np.where(gen == eos)[0]
+    assert len(hit) > 0
+    assert (gen[hit[0]:] == eos).all()          # repeats eos, not token 0
+    # eos=None output is identical to the no-eos reference (sentinel inert)
+    out_none = np.asarray(tiny_engine.generate(prompt, max_new_tokens=6,
+                                               eos_token_id=None))
+    np.testing.assert_array_equal(out_none, ref)
+
+
+def test_serve_smoke_tool():
+    """Satellite: tools/serve_smoke.py (the tier-1 compile-count assert)
+    runs in-process — real jax.monitoring counters, no fresh interpreter."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(
+        __file__)), os.pardir, os.pardir, "tools"))
+    try:
+        from serve_smoke import run_smoke
+    finally:
+        sys.path.pop(0)
+    out = run_smoke(n_requests=4)
+    assert out["ok"], out
+    assert out["steady_state_compiles"] == 0
+    assert out["first_run_compiles"] <= out["compile_budget"]

@@ -1,0 +1,87 @@
+"""Fast serving smoke: assert the zero-recompile admission contract.
+
+Runs a tiny model on CPU through two mixed-length request streams and counts
+ACTUAL XLA compiles via ``jax.monitoring`` (the
+``/jax/core/compile/backend_compile_duration`` event fires once per backend
+compile).  The first stream may compile at most the static program inventory
+(1 decode step + 1 prefill per prompt bucket + the argmax/bookkeeping those
+wrap); the second stream — different lengths, same buckets — must compile
+NOTHING.  Exits nonzero on violation.
+
+Wired into tier-1 via tests/unit/test_serving.py::test_serve_smoke_tool
+(non-slow, in-process).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_smoke(n_requests: int = 5, b_slots: int = 2, seed: int = 0) -> dict:
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.inference.serving import Request
+    from deepspeed_tpu.models import CausalLM
+    from deepspeed_tpu.utils.compile_counter import compile_counter
+
+    count = compile_counter()
+    model = CausalLM("tiny", dtype=jnp.float32, attn_impl="xla")
+    params = model.init_fn(jax.random.PRNGKey(0))
+    engine = deepspeed_tpu.init_inference(
+        model=model, config={"dtype": "float32"}, params=params)
+    serve = engine.serving(b_slots=b_slots, page_size=16, max_model_len=64)
+
+    def stream(seed):
+        rng = np.random.default_rng(seed)
+        return [Request(rid=i,
+                        input_ids=rng.integers(
+                            1, 250, int(rng.integers(3, 14))).astype(np.int32),
+                        max_new_tokens=int(rng.integers(3, 9)))
+                for i in range(n_requests)]
+
+    base = count()
+    serve.run(stream(seed))
+    inventory = serve.program_inventory()
+    # budget: the decode program + one prefill per bucket (each is ONE jit)
+    budget = inventory["decode"] + len(inventory["prefill_buckets"])
+    first_run = count() - base
+
+    base = count()
+    results = serve.run(stream(seed + 1))
+    steady = count() - base
+
+    out = {
+        "metric": "serve-smoke",
+        "first_run_compiles": first_run,
+        "compile_budget": budget,
+        "steady_state_compiles": steady,
+        "program_inventory": inventory,
+        "requests_served": len(results),
+        "ok": bool(first_run <= budget and steady == 0
+                   and len(results) == n_requests),
+    }
+    return out
+
+
+def main(argv=None) -> int:
+    # must win before jax initializes a backend (harmless under pytest's
+    # conftest, which already pinned cpu)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    result = run_smoke()
+    print(json.dumps(result))
+    if not result["ok"]:
+        print("serve smoke FAILED: compile count exceeded the static "
+              "program inventory (admission recompiled?)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
