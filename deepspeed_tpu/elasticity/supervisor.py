@@ -127,7 +127,11 @@ class Supervisor:
                         "budget", rc, last_progress, cur)
                     last_progress = cur
                     restarts = 0
-                    consecutive = 1
+                    # the productive round itself must not count toward the
+                    # zero-progress streak: consecutive resets to 0, so the
+                    # breaker needs zero_progress_limit FURTHER barren
+                    # rounds (1 here tripped it one round early)
+                    consecutive = 0
                 elif cur < last_progress:
                     # the committed frontier REGRESSED (newest generation
                     # quarantined on restore): re-anchor, or genuine forward

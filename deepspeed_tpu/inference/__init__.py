@@ -1,6 +1,18 @@
 """Inference subsystem: the one-shot engine (``engine.InferenceEngine``,
-built by ``deepspeed_tpu.init_inference``) and the continuous-batching
-serving engine (``serving.ServingEngine``)."""
+built by ``deepspeed_tpu.init_inference``), the continuous-batching serving
+engine (``serving.ServingEngine``), and its warm-restart wrapper
+(``serving_supervisor.ServingSupervisor``)."""
 from .config import DeepSpeedInferenceConfig  # noqa: F401
 from .engine import InferenceEngine  # noqa: F401
-from .serving import Request, RequestResult, ServingEngine  # noqa: F401
+from .serving import (  # noqa: F401
+    PoolConsumedError,
+    Request,
+    RequestResult,
+    ServeTimeout,
+    ServingEngine,
+    SlotPrefillError,
+)
+from .serving_supervisor import (  # noqa: F401
+    RestartBudgetExhausted,
+    ServingSupervisor,
+)

@@ -165,6 +165,18 @@ class InferenceEngine:
         kwargs.setdefault("mesh", self.mesh)
         return ServingEngine(self._model, self.params, **kwargs)
 
+    def supervised_serving(self, max_restarts: int = 5, **kwargs):
+        """A :class:`~.serving_supervisor.ServingSupervisor` whose engine
+        factory is :meth:`serving` with these kwargs: decode-tick faults
+        warm-restart a fresh KV pool (compiled programs carried over) and
+        replay queue + in-flight requests token-exactly.  See
+        docs/SERVING.md "Failure handling"."""
+        from .serving_supervisor import ServingSupervisor
+
+        return ServingSupervisor(lambda: self.serving(**kwargs),
+                                 max_restarts=max_restarts,
+                                 monitor=kwargs.get("monitor"))
+
     def forward(self, *args, **kwargs):
         if self.params is not None:
             return self._forward(self.params, *args, **kwargs)

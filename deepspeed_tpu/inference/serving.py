@@ -36,12 +36,23 @@ outputs to per-request ``generate(greedy=True)``; per-slot sampling state is
 future work).  The loop is host-driven and synchronous: one device program +
 one [B_slots] token fetch per tick.
 
-Resilience: every tick fires the ``serve.tick`` fault-injection site and
-every admission fires ``serve.admit`` (see resilience/fault_injection.py),
-and an optional :class:`~deepspeed_tpu.resilience.HangWatchdog` can be armed
-around each device step so a wedged collective becomes a stack report + a
+Resilience (docs/SERVING.md "Failure handling"): per-request deadlines and a
+bounded admission queue with explicit load shedding — expired or shed
+requests finish with a typed :class:`RequestResult` (``finish_reason``
+``"deadline"`` / ``"shed"``) carrying a ``retry_after_s`` hint instead of
+occupying pages forever; a slot whose prefill fails repeatedly is
+quarantined (fenced from scheduling, its pages leaked-and-accounted);
+:meth:`health` snapshots the loop and :meth:`drain` stops admission,
+finishes in-flight work and hands back unserved requests.  Fault-injection
+sites: ``serve.tick`` (every tick), ``serve.admit`` (every admission),
+``serve.prefill`` / ``serve.decode`` (immediately before the respective
+device calls — see resilience/fault_injection.py).  An optional
+:class:`~deepspeed_tpu.resilience.HangWatchdog` can be armed around each
+device step so a wedged collective becomes a stack report + a
 supervisor-recyclable exit instead of a silent forever-hang
-(docs/RESILIENCE.md).
+(docs/RESILIENCE.md).  :class:`~.serving_supervisor.ServingSupervisor`
+wraps this engine with a warm-restart loop that replays the queue and
+in-flight requests token-exactly after a poisoned-pool or injected failure.
 """
 from __future__ import annotations
 
@@ -57,22 +68,52 @@ import jax
 import jax.numpy as jnp
 
 from ..models.transformer import PAGE_SIZE
-from ..resilience import SITE_SERVE_ADMIT, SITE_SERVE_TICK, maybe_fire
-from ..utils.logging import log_dist
+from ..resilience import (SITE_SERVE_ADMIT, SITE_SERVE_DECODE,
+                          SITE_SERVE_PREFILL, SITE_SERVE_TICK, maybe_fire)
+from ..utils.logging import log_dist, logger
 from .engine import InferenceEngine
 
 _bucket = InferenceEngine._bucket   # shared prompt-length bucketing (pow2>=16)
 
 
+class ServeTimeout(RuntimeError):
+    """``run``/``drain`` exceeded its ``max_ticks`` budget.  Deliberately
+    NOT retried by :class:`~.serving_supervisor.ServingSupervisor` — a tick
+    budget is a test/caller bound, not a fault."""
+
+
+class PoolConsumedError(RuntimeError):
+    """The donated KV pool was consumed by a failed device call — the engine
+    cannot continue and must be rebuilt (``ServingSupervisor`` does this
+    automatically, replaying queue + in-flight requests)."""
+
+
+class SlotPrefillError(RuntimeError):
+    """A prefill failed in a way attributable to one slot/request; the
+    reservation was unwound and the request re-queued.  When the pool
+    survived (no donation, or the failure fired before the device call) the
+    engine keeps serving — no restart needed."""
+
+    def __init__(self, msg: str, slot: int, rid: Any, quarantined: bool):
+        super().__init__(msg)
+        self.slot = slot
+        self.rid = rid
+        self.quarantined = quarantined
+
+
 @dataclasses.dataclass
 class Request:
     """One generation request.  ``arrival_time`` is seconds relative to the
-    start of :meth:`ServingEngine.run` (0 = available immediately)."""
+    start of :meth:`ServingEngine.run` (0 = available immediately);
+    ``deadline_s`` is a serving budget measured from arrival — a request
+    still queued (or still decoding) past it finishes with
+    ``finish_reason="deadline"`` instead of occupying queue/pages forever."""
     rid: Any
     input_ids: np.ndarray
     max_new_tokens: int = 32
     eos_token_id: Optional[int] = None
     arrival_time: float = 0.0
+    deadline_s: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -80,13 +121,16 @@ class RequestResult:
     rid: Any
     input_ids: np.ndarray
     output_ids: np.ndarray          # generated tokens (incl. eos when hit)
-    finish_reason: str              # "eos" | "length"
+    finish_reason: str              # "eos" | "length" | "deadline" | "shed"
     prefill_bucket: int
     # absolute time.monotonic() stamps (arrival = admission availability)
     arrival_s: float = 0.0
     admit_s: float = 0.0
     first_token_s: float = 0.0
     finish_s: float = 0.0
+    # set on "shed" and queue-expired "deadline" results: a backlog-derived
+    # hint for when a resubmission is likely to be admitted
+    retry_after_s: Optional[float] = None
 
     @property
     def ttft_s(self) -> float:
@@ -121,7 +165,8 @@ class ServingEngine:
     def __init__(self, model, params, b_slots: int = 4,
                  page_size: int = PAGE_SIZE, num_pages: Optional[int] = None,
                  max_model_len: Optional[int] = None, monitor=None,
-                 watchdog=None, dtype=None, mesh=None):
+                 watchdog=None, dtype=None, mesh=None,
+                 max_queue: Optional[int] = None, quarantine_limit: int = 2):
         if not hasattr(model, "apply_paged"):
             raise ValueError(
                 "ServingEngine needs a model with the paged decode contract "
@@ -148,6 +193,19 @@ class ServingEngine:
                 f"+ the trash page)")
         self.monitor = monitor
         self.watchdog = watchdog
+        # bounded admission: submissions past max_queue waiting requests are
+        # shed with a typed result + retry-after hint (None = unbounded)
+        self.max_queue = int(max_queue) if max_queue is not None else None
+        if self.max_queue is not None and self.max_queue < 1:
+            raise ValueError(f"max_queue={self.max_queue} must be >= 1")
+        # consecutive prefill failures before a slot is fenced
+        self.quarantine_limit = int(quarantine_limit)
+        if self.quarantine_limit < 1:
+            # 0 would mean "never fence": a persistent slot fault then loops
+            # forever without ever reaching the all-quarantined terminal
+            # error that hands control to the supervisor
+            raise ValueError(
+                f"quarantine_limit={self.quarantine_limit} must be >= 1")
 
         cache = model.init_paged_cache(self.num_pages, self.page_size,
                                        dtype=dtype)
@@ -185,6 +243,18 @@ class ServingEngine:
         self._tick = 0
         self._tokens_out = 0
         self._t0 = time.monotonic()
+        # ---- resilience state (docs/SERVING.md "Failure handling")
+        self._quarantined = np.zeros((self.b_slots,), bool)
+        self._quarantined_pages: List[int] = []   # leaked-and-accounted
+        self._slot_failures = np.zeros((self.b_slots,), np.int64)
+        self._draining = False
+        # deadline-bearing requests currently waiting (queue + pending):
+        # lets _expire skip its O(backlog) queue scan entirely in the
+        # common no-deadlines case
+        self._waiting_deadlines = 0
+        self.shed_count = 0
+        self.deadline_count = 0
+        self._ema_service_s: Optional[float] = None   # drives retry hints
 
         # donation: each tick consumes and reproduces the pool — donate the
         # buffers so the pool exists once in HBM, not twice (CPU has no
@@ -240,8 +310,83 @@ class ServingEngine:
     def _pages_needed(self, req: Request) -> int:
         return -(-(len(req.input_ids) + req.max_new_tokens) // self.page_size)
 
+    def _usable_slots(self) -> int:
+        return int(self.b_slots - self._quarantined.sum())
+
+    def _retry_after_hint(self) -> float:
+        """Backlog-derived resubmission hint: waves of requests ahead times
+        the EMA of observed service time (a conservative floor before any
+        request has completed)."""
+        per_req = self._ema_service_s if self._ema_service_s else 0.25
+        backlog = (len(self._queue) + len(self._pending)
+                   + int(self._active.sum()))
+        lanes = max(1, self._usable_slots())
+        waves = max(1, -(-max(backlog, 1) // lanes))
+        return round(per_req * waves, 4)
+
+    def _shed(self, request: Request, why: str) -> Any:
+        """Terminal "shed" result for a request admission refused: typed,
+        counted, and carrying a retry-after hint — never silently dropped,
+        never parked on an unbounded queue."""
+        t = time.monotonic()
+        hint = self._retry_after_hint()
+        self._results[request.rid] = RequestResult(
+            rid=request.rid, input_ids=request.input_ids,
+            output_ids=np.zeros((0,), np.int32), finish_reason="shed",
+            prefill_bucket=0, arrival_s=t, admit_s=t, first_token_s=t,
+            finish_s=t, retry_after_s=hint)
+        self._finished_order.append(request.rid)
+        self._live_rids.add(request.rid)
+        self.shed_count += 1
+        logger.warning("serve: shed request %r (%s); retry_after=%.3fs",
+                       request.rid, why, hint)
+        return request.rid
+
+    def _expire(self, now: float) -> None:
+        """Finish every request whose deadline (arrival + deadline_s) has
+        passed: queued requests exit with an empty "deadline" result and a
+        retry hint; in-flight requests retire with the tokens generated so
+        far and give their slot + pages back this tick.  The queue scan is
+        skipped outright while no waiting request carries a deadline (the
+        common case must not pay O(backlog) per tick)."""
+        if self._queue and self._waiting_deadlines:
+            keep: Deque[Request] = deque()
+            for req in self._queue:
+                if (req.deadline_s is not None
+                        and now >= req.arrival_time + req.deadline_s):
+                    self._waiting_deadlines -= 1
+                    t = time.monotonic()
+                    self._results[req.rid] = RequestResult(
+                        rid=req.rid, input_ids=req.input_ids,
+                        output_ids=np.zeros((0,), np.int32),
+                        finish_reason="deadline", prefill_bucket=0,
+                        arrival_s=self._t0 + req.arrival_time, admit_s=t,
+                        first_token_s=t, finish_s=t,
+                        retry_after_s=self._retry_after_hint())
+                    self._finished_order.append(req.rid)
+                    self.deadline_count += 1
+                    logger.warning("serve: request %r expired in queue "
+                                   "(deadline %.3fs)", req.rid, req.deadline_s)
+                else:
+                    keep.append(req)
+            self._queue = keep
+        for slot in np.flatnonzero(self._active):
+            req = self._slots[slot].request
+            if (req.deadline_s is not None
+                    and now >= req.arrival_time + req.deadline_s):
+                logger.warning("serve: request %r expired in flight after "
+                               "%d token(s) (deadline %.3fs)", req.rid,
+                               len(self._slots[slot].tokens), req.deadline_s)
+                self._finish(slot, "deadline")
+
     def submit(self, request: Request) -> Any:
-        """Queue a request (FIFO).  Validates it can ever be served."""
+        """Queue a request (FIFO).  Validates it can ever be served.
+
+        Admission control: while the engine is draining, or the bounded
+        queue (``max_queue``) is full, the request is SHED — it still gets
+        a terminal :class:`RequestResult` (``finish_reason="shed"``, with a
+        ``retry_after_s`` hint) rather than an unbounded queue growing
+        until every deadline in it is dead on arrival."""
         ids = np.asarray(request.input_ids, np.int32).reshape(-1)
         # flatten BEFORE validating: _pages_needed counts len(input_ids),
         # which on a [1, S] prompt would count rows, not tokens
@@ -260,12 +405,23 @@ class ServingEngine:
             raise ValueError(
                 f"request {request.rid!r} needs {self._pages_needed(request)} "
                 f"pages but the pool holds {self.num_pages - 1}")
+        if request.deadline_s is not None and request.deadline_s <= 0:
+            raise ValueError(
+                f"request {request.rid!r}: deadline_s={request.deadline_s} "
+                "must be > 0 (measured from arrival)")
         rid = request.rid
         if rid in self._live_rids:
             raise ValueError(
                 f"request id {rid!r} is already queued, in flight, or has "
                 f"an unclaimed result — rids must be unique")
+        backlog = len(self._queue) + len(self._pending)
+        if self._draining or (self.max_queue is not None
+                              and backlog >= self.max_queue):
+            return self._shed(request,
+                              "draining" if self._draining else "queue full")
         self._live_rids.add(rid)
+        if request.deadline_s is not None:
+            self._waiting_deadlines += 1
         if request.arrival_time > 0:
             bisect.insort(self._pending, request,
                           key=lambda r: r.arrival_time)
@@ -283,7 +439,8 @@ class ServingEngine:
             req = self._queue[0]
             try:
                 slot = next(i for i in range(self.b_slots)
-                            if not self._active[i])
+                            if not self._active[i]
+                            and not self._quarantined[i])
             except StopIteration:
                 break
             need = self._pages_needed(req)
@@ -293,23 +450,55 @@ class ServingEngine:
             # request queued (recoverable), not silently dropped
             maybe_fire(SITE_SERVE_ADMIT, rid=req.rid, slot=slot)
             self._queue.popleft()
+            if req.deadline_s is not None:
+                self._waiting_deadlines -= 1
             pages = [self._free_pages.pop() for _ in range(need)]
             try:
                 self._prefill(slot, req, pages, now)
-            except BaseException:
+            except BaseException as e:
                 # a failed prefill (transient device error, injected fault)
                 # must not leak its reservation or drop the request.  If the
-                # slot never registered, unwind fully — pages back, request
-                # back at the head; if it did (failure in the post-launch
-                # bookkeeping), the slot owns the pages and the next run
-                # continues it.  Either way re-raise for the caller.  NOTE:
-                # with donation enabled a failed DEVICE call also consumes
-                # the pool — step() then refuses with a rebuild-me error;
-                # the unwind still leaves the queue replayable.
+                # slot never registered, unwind — request back at the head —
+                # and count the failure against the slot: quarantine_limit
+                # consecutive failures fence it, with THIS attempt's pages
+                # leaked into the quarantine account (suspect contents are
+                # never recycled) and scheduling continuing on the rest of
+                # the fleet.  If the slot did register (failure in the
+                # post-launch bookkeeping), it owns the pages and the next
+                # run continues it.  NOTE: with donation enabled a failed
+                # DEVICE call also consumes the pool — step() then refuses
+                # with PoolConsumedError; the unwind still leaves the queue
+                # replayable (ServingSupervisor rebuilds + replays).
                 if self._slots[slot] is None:
-                    self._free_pages.extend(pages)
                     self._page_table[slot, :] = 0
                     self._queue.appendleft(req)
+                    if req.deadline_s is not None:
+                        self._waiting_deadlines += 1
+                    if not isinstance(e, Exception):
+                        # KeyboardInterrupt/SystemExit is the operator, not
+                        # the slot: plain unwind, no quarantine accounting
+                        self._free_pages.extend(pages)
+                        raise
+                    self._slot_failures[slot] += 1
+                    fails = int(self._slot_failures[slot])
+                    fenced = fails >= self.quarantine_limit
+                    if fenced:
+                        self._quarantined[slot] = True
+                        self._quarantined_pages.extend(pages)
+                        logger.error(
+                            "serve: slot %d quarantined after %d consecutive "
+                            "prefill failures; %d page(s) leaked-and-"
+                            "accounted, %d slot(s) remain", slot, fails,
+                            len(pages), self._usable_slots())
+                    else:
+                        self._free_pages.extend(pages)
+                    raise SlotPrefillError(
+                        f"prefill failed in slot {slot} for request "
+                        f"{req.rid!r} (failure {fails}/"
+                        f"{self.quarantine_limit}"
+                        f"{', slot quarantined' if fenced else ''}): "
+                        f"{e}", slot=slot, rid=req.rid,
+                        quarantined=fenced) from e
                 raise
 
     def _prefill(self, slot: int, req: Request, pages: List[int],
@@ -323,6 +512,7 @@ class ServingEngine:
         self._page_table[slot, :len(pages)] = pages
         toks = np.zeros((1, s_pad), np.int32)
         toks[0, :S] = req.input_ids
+        maybe_fire(SITE_SERVE_PREFILL, rid=req.rid, slot=slot)
         with self._armed(f"serve.prefill rid={req.rid!r}"):
             nxt, self._kpool, self._vpool = prog(
                 self.params, self._kpool, self._vpool,
@@ -330,6 +520,7 @@ class ServingEngine:
                 jnp.asarray(toks), jnp.int32(S))
             tok = int(nxt)   # host fetch inside the watchdog window
         t = time.monotonic()
+        self._slot_failures[slot] = 0   # quarantine counts CONSECUTIVE fails
         self._slots[slot] = _Slot(
             request=req, pages=pages, tokens=[tok], bucket=s_pad,
             arrival_s=self._t0 + req.arrival_time, admit_s=self._t0 + now,
@@ -357,6 +548,7 @@ class ServingEngine:
         return contextlib.nullcontext()
 
     def _decode_tick(self) -> None:
+        maybe_fire(SITE_SERVE_DECODE, tick=self._tick)
         with self._armed(f"serve.decode tick {self._tick}"):
             nxt, self._kpool, self._vpool = self._decode_prog(
                 self.params, self._kpool, self._vpool,
@@ -384,6 +576,14 @@ class ServingEngine:
             finish_reason=reason, prefill_bucket=st.bucket,
             arrival_s=st.arrival_s, admit_s=st.admit_s,
             first_token_s=st.first_token_s, finish_s=time.monotonic())
+        if reason == "deadline":
+            self.deadline_count += 1
+        else:
+            # served-to-completion service time (admit -> finish) feeds the
+            # retry-after hint; expired requests would bias it short
+            dt = max(result.finish_s - result.admit_s, 1e-6)
+            self._ema_service_s = (dt if self._ema_service_s is None
+                                   else 0.8 * self._ema_service_s + 0.2 * dt)
         self._results[st.request.rid] = result
         self._finished_order.append(st.request.rid)
         self._free_pages.extend(st.pages)
@@ -395,29 +595,39 @@ class ServingEngine:
 
     # ------------------------------------------------------------ the loop
 
+    def pool_alive(self) -> bool:
+        """False once a failed donated device call consumed the pool
+        buffers — the engine can no longer decode and must be rebuilt."""
+        dead = getattr(self._kpool, "is_deleted", None)
+        return not (dead and self._kpool.is_deleted())
+
     def step(self, now: Optional[float] = None) -> int:
-        """One scheduler tick: admit into free slots, then ONE fixed-shape
-        decode step over all active slots.  Returns the number of requests
-        still in flight or queued."""
-        if getattr(self._kpool, "is_deleted", None) and self._kpool.is_deleted():
+        """One scheduler tick: expire dead deadlines, admit into free
+        slots, then ONE fixed-shape decode step over all active slots.
+        Returns the number of requests still in flight or queued."""
+        if not self.pool_alive():
             # a failed DONATED device call consumed the pool buffers (the
             # admission unwind preserved queue/page accounting, but in-
             # flight KV is gone) — fail loudly instead of feeding deleted
             # arrays to the next program
-            raise RuntimeError(
+            raise PoolConsumedError(
                 "KV pool was consumed by a failed donated device call; "
                 "rebuild the ServingEngine and resubmit — queued requests "
-                "were preserved by the admission unwind")
+                "were preserved by the admission unwind (ServingSupervisor "
+                "automates the rebuild and replays in-flight work)")
         self._tick += 1
         maybe_fire(SITE_SERVE_TICK, tick=self._tick)
         if now is None:
             now = time.monotonic() - self._t0
-        self._admit(now)
+        self._expire(now)
+        if not self._draining:
+            self._admit(now)
         if self._active.any():
             self._decode_tick()
             # refill slots the decode just retired — the queue head starts
             # its prefill this tick instead of idling one scheduler round
-            self._admit(now)
+            if not self._draining:
+                self._admit(now)
             # gauges only on working ticks: idle arrival-wait ticks would
             # otherwise dilute occupancy stats and spam csv backends
             self._write_gauges()
@@ -425,15 +635,22 @@ class ServingEngine:
                 + len(self._pending))
 
     def run(self, requests: Optional[List[Request]] = None,
-            max_ticks: Optional[int] = None) -> List[RequestResult]:
+            max_ticks: Optional[int] = None,
+            resume: bool = False) -> List[RequestResult]:
         """Serve ``requests`` (plus anything already submitted) to
         completion; returns results in completion order.  ``arrival_time``
         offsets gate admission against the wall clock measured from this
         call.  Results finished during a previous run() that raised (e.g.
         ``max_ticks``, an injected fault) are still in the completion log
-        and are returned by the next run() alongside its own."""
-        self._t0 = time.monotonic()
-        self._tokens_out = 0       # per-run: the tokens/sec gauge divides
+        and are returned by the next run() alongside its own.
+
+        ``resume=True`` continues a previous run() of THIS engine that was
+        interrupted by a fault, WITHOUT re-anchoring the arrival/deadline
+        clock or the tokens/sec accounting — the supervisor uses it so a
+        continued stream's deadlines are not silently extended."""
+        if not resume:
+            self._t0 = time.monotonic()
+            self._tokens_out = 0   # per-run: the tokens/sec gauge divides
                                    # by elapsed-since-_t0
         start_tick = self._tick    # max_ticks bounds THIS run on a reused engine
         for req in requests or []:
@@ -443,10 +660,20 @@ class ServingEngine:
             if pending == 0:
                 break
             if max_ticks is not None and self._tick - start_tick >= max_ticks:
-                raise RuntimeError(
+                raise ServeTimeout(
                     f"serve loop exceeded max_ticks={max_ticks} with "
                     f"{pending} request(s) outstanding")
             if not self._active.any():
+                if self._draining:
+                    # admission is closed: with no slot active this loop
+                    # could never serve the waiters — without this guard a
+                    # queued request would read as a bogus admission
+                    # deadlock and pending-only work would spin forever
+                    raise RuntimeError(
+                        "engine is draining: admission is closed and "
+                        f"{len(self._queue) + len(self._pending)} "
+                        "request(s) remain unserved — call drain() to "
+                        "finish in-flight work and hand them back")
                 if self._pending and not self._queue:
                     # idle until the next arrival is due: the loop is
                     # single-threaded, nothing can change while we sleep
@@ -455,18 +682,95 @@ class ServingEngine:
                     if wait > 0:
                         time.sleep(wait)
                 elif self._queue:
-                    # the step above ended with every slot free and STILL
-                    # could not admit the head: the pool genuinely cannot
-                    # hold it (submit() validation should make this
-                    # unreachable — it means pages leaked)
+                    if self._usable_slots() == 0:
+                        # every slot fenced: nothing can ever be admitted
+                        # again on this engine — terminal for the engine,
+                        # recoverable via a supervisor warm restart
+                        raise RuntimeError(
+                            f"all {self.b_slots} slots quarantined with "
+                            f"{len(self._queue)} request(s) queued; rebuild "
+                            "the engine (ServingSupervisor restarts + "
+                            "replays automatically)")
+                    # the step above ended with every usable slot free and
+                    # STILL could not admit the head: the pool genuinely
+                    # cannot hold it — quarantined slots leaked enough
+                    # pages, or (a bug) pages leaked silently
                     req = self._queue[0]
                     raise RuntimeError(
                         f"admission deadlock: request {req.rid!r} needs "
                         f"{self._pages_needed(req)} pages, "
-                        f"{len(self._free_pages)} free with no slot active")
+                        f"{len(self._free_pages)} free "
+                        f"({len(self._quarantined_pages)} quarantined) "
+                        f"with no slot active")
+        return self.take_results()
+
+    def take_results(self) -> List[RequestResult]:
+        """Claim every finished result (completion order) and release their
+        rids for reuse.  :meth:`run` calls this on a clean drain; after a
+        fault it lets a supervisor harvest what finished before the crash."""
         order, self._finished_order = self._finished_order, []
         self._live_rids.difference_update(order)
         return [self._results.pop(rid) for rid in order]
+
+    # ------------------------------------------------------- health / drain
+
+    def _oldest_age_s(self, now_abs: float) -> float:
+        """Age of the oldest queued or in-flight request (0 when idle);
+        pending requests that have not arrived yet clamp to 0.  O(b_slots),
+        not O(backlog) — this runs every working tick for the gauge: the
+        queue is FIFO (head oldest) and ``_pending`` is sorted by arrival."""
+        arrivals = [st.arrival_s for st in self._slots if st is not None]
+        if self._queue:
+            arrivals.append(self._t0 + self._queue[0].arrival_time)
+        if self._pending:
+            arrivals.append(self._t0 + self._pending[0].arrival_time)
+        return max(0.0, now_abs - min(arrivals)) if arrivals else 0.0
+
+    def health(self) -> Dict[str, Any]:
+        """One-call snapshot of loop health — what an external load
+        balancer / readiness probe polls.  Mirrors the ``serve/*`` gauges
+        plus the resilience counters and page accounting."""
+        now = time.monotonic()
+        return {
+            "tick": self._tick,
+            "pool_alive": self.pool_alive(),
+            "draining": self._draining,
+            "queue_depth": len(self._queue) + len(self._pending),
+            "active_slots": int(self._active.sum()),
+            "usable_slots": self._usable_slots(),
+            "quarantined_slots": int(self._quarantined.sum()),
+            "free_pages": len(self._free_pages),
+            "quarantined_pages": len(self._quarantined_pages),
+            "shed_total": self.shed_count,
+            "deadline_expired_total": self.deadline_count,
+            "oldest_request_age_s": round(self._oldest_age_s(now), 4),
+            "retry_after_hint_s": self._retry_after_hint(),
+            "unclaimed_results": len(self._finished_order),
+        }
+
+    def drain(self, max_ticks: Optional[int] = None) -> List[Request]:
+        """Stop admission, finish in-flight work, hand back the unserved
+        queue (admission order) for hand-off to another engine.  Finished
+        results stay claimable via :meth:`take_results`; later ``submit()``
+        calls are shed.  Deadlines keep being enforced while draining."""
+        self._draining = True
+        start = self._tick
+        while self._active.any():
+            self.step()
+            if max_ticks is not None and self._tick - start >= max_ticks:
+                raise ServeTimeout(
+                    f"drain exceeded max_ticks={max_ticks} with "
+                    f"{int(self._active.sum())} slot(s) still decoding")
+        unserved = list(self._queue)
+        unserved.extend(self._pending)
+        self._queue.clear()
+        self._pending.clear()
+        self._waiting_deadlines = 0
+        self._live_rids.difference_update(r.rid for r in unserved)
+        log_dist(f"serve: drained — {len(unserved)} unserved request(s) "
+                 f"handed back, {len(self._finished_order)} result(s) "
+                 "claimable", ranks=[0])
+        return unserved
 
     def _write_gauges(self) -> None:
         if self.monitor is None:
@@ -480,4 +784,13 @@ class ServingEngine:
             ("serve/slot_occupancy", active / self.b_slots, self._tick),
             ("serve/free_pages", float(len(self._free_pages)), self._tick),
             ("serve/tokens_per_sec", self._tokens_out / elapsed, self._tick),
+            ("serve/shed_total", float(self.shed_count), self._tick),
+            ("serve/deadline_expired_total", float(self.deadline_count),
+             self._tick),
+            ("serve/quarantined_slots", float(self._quarantined.sum()),
+             self._tick),
+            ("serve/quarantined_pages", float(len(self._quarantined_pages)),
+             self._tick),
+            ("serve/oldest_request_age_s",
+             self._oldest_age_s(time.monotonic()), self._tick),
         ])

@@ -1,0 +1,367 @@
+"""Serving supervisor: warm restarts with exact in-flight replay.
+
+:class:`~.serving.ServingEngine` is deliberately fail-loud: a failed donated
+device call consumes the KV pool (``PoolConsumedError``), an armed watchdog
+turns a wedged collective into a supervisor-recyclable exit, and repeated
+slot failures fence slots until nothing can be admitted.  The engine's own
+failure contract guarantees that at any such point the HOST-side state —
+the queue, and for every in-flight slot the prompt plus the tokens decoded
+so far — is intact and sufficient to reconstruct the stream.
+
+:class:`ServingSupervisor` closes the loop the way
+``elasticity.Supervisor`` does for training.  It owns an engine built by a
+caller-supplied factory and drives the same ``run``/``submit``/``health``/
+``drain`` surface; when a tick fails it
+
+1. harvests every result that finished before the crash (nothing completed
+   is ever re-decoded or lost);
+2. builds a replacement engine — a fresh KV pool, but **reusing the dead
+   engine's compiled program inventory** when the fleet shape matches
+   (same model / ``b_slots`` / page geometry), so a warm restart costs pool
+   re-init, not recompilation;
+3. replays in-flight requests by re-prefilling ``prompt + tokens generated
+   so far`` with the remaining token budget — greedy decoding makes the
+   continuation **token-exact**, so a replayed request's stitched output is
+   identical to a fault-free run (the chaos tests assert this);
+4. re-queues everything that was still waiting (bounded-queue shedding is
+   suspended during replay: a request the engine already accepted is never
+   shed by its own recovery).
+
+Slot-attributable prefill failures (``SlotPrefillError``) with a live pool
+do NOT restart — the engine already unwound the reservation, re-queued the
+request and counted the failure toward slot quarantine; the supervisor just
+keeps ticking.  ``ServeTimeout`` (a caller's ``max_ticks`` bound) and
+``KeyboardInterrupt`` are never treated as faults.
+
+The restart budget is absolute (``max_restarts`` across the supervisor's
+lifetime); exhausting it raises :class:`RestartBudgetExhausted` carrying a
+diagnosis plus the fault log, mirroring the training supervisor's circuit
+breaker.  Every restart fires the ``serve.replay`` fault-injection site per
+replayed request, so the replay path itself is chaos-testable.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..resilience import SITE_SERVE_REPLAY, maybe_fire
+from ..utils.logging import log_dist, logger
+from .serving import (Request, RequestResult, ServeTimeout, ServingEngine,
+                      SlotPrefillError)
+
+__all__ = ["RestartBudgetExhausted", "ServingSupervisor"]
+
+
+class RestartBudgetExhausted(RuntimeError):
+    """The supervisor spent ``max_restarts`` warm restarts without reaching
+    a healthy engine — the fault is not transient.  ``diagnosis`` and
+    ``restart_log`` describe the terminal state."""
+
+    def __init__(self, diagnosis: str, restart_log: List[Dict]):
+        super().__init__(diagnosis)
+        self.diagnosis = diagnosis
+        self.restart_log = restart_log
+
+
+class ServingSupervisor:
+    """Run a :class:`ServingEngine` under a warm-restart loop.
+
+    ``engine_factory() -> ServingEngine`` builds a fresh engine (fresh KV
+    pool) — use ``InferenceEngine.supervised_serving(...)`` to get a
+    supervisor whose factory shares the inference engine's model/params.
+    """
+
+    def __init__(self, engine_factory: Callable[[], ServingEngine],
+                 max_restarts: int = 5, monitor=None):
+        self.engine_factory = engine_factory
+        self.max_restarts = int(max_restarts)
+        self.engine = engine_factory()
+        self.monitor = monitor if monitor is not None else self.engine.monitor
+        self.restarts = 0
+        self.restart_log: List[Dict] = []
+        # counters harvested from dead incarnations — a restart must not
+        # zero the *_total numbers (health/bench/soak read them through
+        # the supervisor)
+        self._shed_base = 0
+        self._deadline_base = 0
+        self._quarantined_slots_lifetime = 0
+        self._quarantined_pages_lifetime = 0
+        # rid -> original request (result stitching + drain hand-off)
+        self._orig: Dict[Any, Request] = {}
+        # rid -> tokens decoded in previous engine incarnations; replay
+        # outputs are prefixed with these when results are stitched
+        self._prefix: Dict[Any, List[int]] = {}
+        self._collected: Dict[Any, RequestResult] = {}
+        self._order: List[Any] = []
+
+    # ----------------------------------------------------------- submission
+
+    def submit(self, request: Request) -> Any:
+        ids = np.asarray(request.input_ids, np.int32).reshape(-1)
+        request = dataclasses.replace(request, input_ids=ids)
+        rid = self.engine.submit(request)
+        self._orig[rid] = request
+        return rid
+
+    # ------------------------------------------------------------- the loop
+
+    def run(self, requests: Optional[List[Request]] = None,
+            max_ticks: Optional[int] = None) -> List[RequestResult]:
+        """Serve to completion under the restart loop; returns stitched
+        results in completion order (completion order is per-incarnation —
+        results harvested across a restart keep their original order)."""
+        for req in requests or []:
+            self.submit(req)
+        budget = max_ticks       # spent across ALL continuations/restarts —
+        resume = False           # a repeating fault cannot stretch the bound
+        while True:
+            eng = self.engine
+            start_tick = eng._tick
+            try:
+                finished = eng.run([], max_ticks=budget, resume=resume)
+            except KeyboardInterrupt:
+                raise
+            except ServeTimeout:
+                raise            # a tick budget is a caller bound, not a fault
+            except SlotPrefillError as e:
+                budget = self._spend(budget, eng, start_tick)
+                if eng.pool_alive():
+                    # the engine already unwound the reservation, re-queued
+                    # the request, and counted the failure toward slot
+                    # quarantine — keep serving on the same pool.  resume:
+                    # the continued run must NOT re-anchor arrival/deadline
+                    # clocks mid-stream.
+                    logger.warning("serve supervisor: continuing past %s", e)
+                    resume = True
+                    continue
+                self._safe_restart(e)
+                resume = False   # fresh engine: clocks re-anchor (documented)
+                continue
+            except Exception as e:
+                budget = self._spend(budget, eng, start_tick)
+                self._safe_restart(e)
+                resume = False
+                continue
+            for res in finished:
+                self._collect(res)
+            order, self._order = self._order, []
+            return [self._collected.pop(rid) for rid in order]
+
+    @staticmethod
+    def _spend(budget: Optional[int], eng: ServingEngine,
+               start_tick: int) -> Optional[int]:
+        if budget is None:
+            return None
+        budget -= eng._tick - start_tick
+        if budget <= 0:
+            raise ServeTimeout(
+                "serve loop exceeded the caller's max_ticks budget across "
+                "fault continuations")
+        return budget
+
+    def drain(self, max_ticks: Optional[int] = None) -> List[Request]:
+        """Stop admission and finish in-flight work; returns the ORIGINAL
+        request objects that were never served, for hand-off.  A fault
+        mid-drain warm-restarts and hands the affected requests back
+        unserved (their partial progress is discarded — the hand-off target
+        re-serves from the original prompt)."""
+        while True:
+            try:
+                unserved = self.engine.drain(max_ticks=max_ticks)
+            except KeyboardInterrupt:
+                raise
+            except ServeTimeout:
+                raise
+            except Exception as e:
+                self._safe_restart(e)
+                # the replacement engine holds the replays in its queue;
+                # draining it hands them back rather than re-serving them
+                self.engine._draining = True
+                continue
+            for res in self.engine.take_results():
+                self._collect(res)
+            # hand back the ORIGINAL requests and release their tracking —
+            # the hand-off target owns them now
+            handed = [self._orig.pop(r.rid, r) for r in unserved]
+            for r in handed:
+                self._prefix.pop(r.rid, None)
+            return handed
+
+    def take_results(self) -> List[RequestResult]:
+        """Claim stitched results collected so far (completion order)."""
+        for res in self.engine.take_results():
+            self._collect(res)
+        order, self._order = self._order, []
+        return [self._collected.pop(rid) for rid in order]
+
+    def health(self) -> Dict[str, Any]:
+        """Engine health snapshot plus supervisor restart counters.  The
+        ``*_total`` counters are cumulative across restarts (a fresh engine
+        starts at zero; the supervisor carries the dead incarnations'
+        counts); ``quarantined_slots``/``quarantined_pages`` stay the
+        CURRENT engine's capacity view, with ``*_lifetime`` variants
+        accumulating across incarnations."""
+        h = self.engine.health()
+        h["shed_total"] += self._shed_base
+        h["deadline_expired_total"] += self._deadline_base
+        h["quarantined_slots_lifetime"] = (self._quarantined_slots_lifetime
+                                           + h["quarantined_slots"])
+        h["quarantined_pages_lifetime"] = (self._quarantined_pages_lifetime
+                                           + h["quarantined_pages"])
+        h["restarts"] = self.restarts
+        h["max_restarts"] = self.max_restarts
+        h["last_restart_cause"] = (self.restart_log[-1]["cause"]
+                                   if self.restart_log else None)
+        return h
+
+    # -------------------------------------------------------- warm restart
+
+    def _collect(self, res: RequestResult) -> None:
+        prefix = self._prefix.pop(res.rid, None)
+        orig = self._orig.pop(res.rid, None)
+        if prefix:
+            # a replayed request: its engine-side prompt was orig + prefix
+            # and its output is the continuation — stitch the caller-facing
+            # result back to the original request's frame
+            res = dataclasses.replace(
+                res,
+                input_ids=orig.input_ids if orig is not None
+                else res.input_ids[:len(res.input_ids) - len(prefix)],
+                output_ids=np.concatenate(
+                    [np.asarray(prefix, np.int32), res.output_ids]))
+        self._collected[res.rid] = res
+        self._order.append(res.rid)
+
+    def _safe_restart(self, cause: BaseException) -> None:
+        """Restart until one succeeds; the budget check inside ``_restart``
+        bounds the loop (restart-path faults, e.g. an injected
+        ``serve.replay`` raise, count a restart and are retried)."""
+        while True:
+            try:
+                self._restart(cause)
+                return
+            except KeyboardInterrupt:
+                raise
+            except RestartBudgetExhausted:
+                raise
+            except Exception as e:
+                logger.warning("serve supervisor: restart itself failed "
+                               "(%s: %s); retrying", type(e).__name__, e)
+                cause = e
+
+    def _restart(self, cause: BaseException) -> None:
+        if self.restarts >= self.max_restarts:
+            raise RestartBudgetExhausted(
+                f"serving restart budget exhausted ({self.max_restarts}); "
+                f"last cause: {type(cause).__name__}: {cause} — the fault "
+                "is not transient (poisoned params, a fault rule with "
+                "unlimited fires, or broken storage); inspect restart_log",
+                self.restart_log)
+        self.restarts += 1
+        old = self.engine
+        # (1) harvest everything that finished before the crash
+        for res in old.take_results():
+            self._collect(res)
+        # (2) snapshot host-side stream state.  In-flight slots replay in
+        # admission order (they were ahead of the queue in FIFO order);
+        # queued requests follow with arrival_time rebased to 0 — they had
+        # ALREADY arrived, and the new engine would otherwise re-gate them
+        # behind their full original offset; not-yet-due pending requests
+        # keep their remaining offset.  Deadlines carry their REMAINING
+        # budget (deadline_s is measured from arrival, and the rebased
+        # arrival restarts on the new engine's clock — without the
+        # deduction every restart would silently hand the request a fresh
+        # full deadline window).
+        inflight = sorted((st for st in old._slots if st is not None),
+                          key=lambda st: st.admit_s)
+        elapsed = time.monotonic() - old._t0
+        waiting = [self._rebase(r, elapsed) for r in old._queue]
+        waiting.extend(
+            dataclasses.replace(r, arrival_time=max(
+                0.0, r.arrival_time - elapsed))
+            for r in old._pending)
+        # (3) the replay fault site fires BEFORE any state is mutated, so a
+        # raise here leaves the dead engine intact for the retried restart
+        for st in inflight:
+            maybe_fire(SITE_SERVE_REPLAY, rid=st.request.rid,
+                       generated=len(st.tokens))
+        # (4) fresh pool, warm programs
+        new = self.engine_factory()
+        reused = self._adopt_programs(new, old)
+        # (5) replay.  Admission control is suspended: a request the old
+        # engine already accepted must never be shed by its own recovery.
+        saved_max_queue, new.max_queue = new.max_queue, None
+        try:
+            replayed = []
+            for st in inflight:
+                req = st.request
+                replay = dataclasses.replace(
+                    self._rebase(req, elapsed),
+                    input_ids=np.concatenate(
+                        [req.input_ids, np.asarray(st.tokens, np.int32)]),
+                    max_new_tokens=req.max_new_tokens - len(st.tokens))
+                new.submit(replay)
+                replayed.append((req.rid, list(st.tokens)))
+            for req in waiting:
+                new.submit(req)
+        finally:
+            new.max_queue = saved_max_queue
+        # (6) commit: prefixes only once every submission landed, so a
+        # failed restart never double-counts replay tokens
+        for rid, tokens in replayed:
+            self._prefix[rid] = self._prefix.get(rid, []) + tokens
+        self._shed_base += old.shed_count
+        self._deadline_base += old.deadline_count
+        self._quarantined_slots_lifetime += int(old._quarantined.sum())
+        self._quarantined_pages_lifetime += len(old._quarantined_pages)
+        self.engine = new
+        entry = {
+            "restart": self.restarts,
+            "cause": f"{type(cause).__name__}: {cause}",
+            "replayed_inflight": len(replayed),
+            "requeued": len(waiting),
+            "programs_reused": reused,
+            "at_tick": old._tick,
+        }
+        self.restart_log.append(entry)
+        if self.monitor is not None:
+            self.monitor.write_events([
+                ("serve/restarts", float(self.restarts), old._tick)])
+        log_dist(
+            f"serve supervisor: warm restart {self.restarts}/"
+            f"{self.max_restarts} after {entry['cause']} — replayed "
+            f"{len(replayed)} in-flight, re-queued {len(waiting)}, "
+            f"programs {'reused' if reused else 'rebuilt'}", ranks=[0])
+
+    @staticmethod
+    def _rebase(req: Request, elapsed: float) -> Request:
+        """An already-arrived request re-anchored to the new engine's
+        clock: arrival becomes 0, and a deadline keeps only its remaining
+        budget (floored at an epsilon so an already-expired request still
+        flows through the normal expiry path to a terminal result)."""
+        deadline = req.deadline_s
+        if deadline is not None:
+            deadline = max(1e-6, deadline
+                           - max(0.0, elapsed - req.arrival_time))
+        return dataclasses.replace(req, arrival_time=0.0,
+                                   deadline_s=deadline)
+
+    @staticmethod
+    def _adopt_programs(new: ServingEngine, old: ServingEngine) -> bool:
+        """Carry the compiled decode/prefill programs across a restart when
+        the fleet shape matches — jax.jit caches on argument avals, and the
+        fresh pool has the same shape/dtype, so every adopted program is a
+        cache hit instead of a recompile."""
+        if (new.model is old.model
+                and new.b_slots == old.b_slots
+                and new.page_size == old.page_size
+                and new.num_pages == old.num_pages
+                and new.max_model_len == old.max_model_len
+                and new._donate == old._donate):
+            new._decode_prog = old._decode_prog
+            new._prefill_progs.update(old._prefill_progs)
+            return True
+        return False

@@ -127,6 +127,14 @@ class InMemoryMonitor(Monitor):
         return [(step, value) for (n, value, step) in self.events
                 if n == name]
 
+    def latest(self, name: str) -> Optional[float]:
+        """Most recent value of a gauge, or None if it never fired —
+        what a health/readiness assertion usually wants."""
+        for n, value, _step in reversed(self.events):
+            if n == name:
+                return value
+        return None
+
 
 class MonitorMaster(Monitor):
     """Rank-0 fan-out to all enabled writers (reference monitor.py:29)."""

@@ -23,6 +23,9 @@ from .fault_injection import (  # noqa: F401
     SITE_CKPT_SAVE,
     SITE_LATEST_PUBLISH,
     SITE_SERVE_ADMIT,
+    SITE_SERVE_DECODE,
+    SITE_SERVE_PREFILL,
+    SITE_SERVE_REPLAY,
     SITE_SERVE_TICK,
     SITE_SUPERVISOR_ATTEMPT,
     SITE_TRAIN_STEP,
@@ -50,14 +53,24 @@ def checkpoint_progress_fn(ckpt_dir: str):
     refreshes the budget; K restarts that did not trip the breaker."""
     import os
 
-    from .integrity import candidate_tags, read_tag_step
+    from .integrity import MANIFEST_FILE, candidate_tags, read_tag_step
 
     def progress() -> int:
         if not os.path.isdir(ckpt_dir):
             return -1
         best = -1
         for tag in candidate_tags(ckpt_dir):
-            best = max(best, read_tag_step(os.path.join(ckpt_dir, tag)))
+            tag_dir = os.path.join(ckpt_dir, tag)
+            # only manifest-bearing (committed) tags count — the same
+            # filter as ElasticAgent._prune_generations.  A torn save has
+            # no manifest, but read_tag_step would still surface its step
+            # through the client_state.json fallback, and counting it
+            # would refresh the restart budget off a tag the restore path
+            # rejects — defeating the circuit breaker on exactly the
+            # crash loops it exists to diagnose.
+            if not os.path.exists(os.path.join(tag_dir, MANIFEST_FILE)):
+                continue
+            best = max(best, read_tag_step(tag_dir))
         return best
 
     return progress

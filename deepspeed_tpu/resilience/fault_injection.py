@@ -19,6 +19,12 @@ Sites wired into the tree:
 ``serve.tick``            top of every ``ServingEngine.step`` scheduler tick
 ``serve.admit``           inside ``ServingEngine`` admission, after a queued
                           request is popped and before its prefill runs
+``serve.prefill``         inside ``ServingEngine._prefill``, immediately
+                          before the prefill device call (slot-attributable)
+``serve.decode``          inside ``ServingEngine._decode_tick``, immediately
+                          before the decode device call (fleet-wide)
+``serve.replay``          inside ``ServingSupervisor`` warm restart, before
+                          each in-flight request is re-submitted for replay
 ========================  ====================================================
 
 Fault kinds: ``raise`` (raise :class:`InjectedFault`), ``delay`` (sleep
@@ -56,10 +62,14 @@ SITE_TRAIN_STEP = "train.step"
 SITE_SUPERVISOR_ATTEMPT = "supervisor.attempt"
 SITE_SERVE_TICK = "serve.tick"
 SITE_SERVE_ADMIT = "serve.admit"
+SITE_SERVE_PREFILL = "serve.prefill"
+SITE_SERVE_DECODE = "serve.decode"
+SITE_SERVE_REPLAY = "serve.replay"
 
 SITES = (SITE_CKPT_SAVE, SITE_CKPT_LOAD, SITE_LATEST_PUBLISH,
          SITE_TRAIN_STEP, SITE_SUPERVISOR_ATTEMPT, SITE_SERVE_TICK,
-         SITE_SERVE_ADMIT)
+         SITE_SERVE_ADMIT, SITE_SERVE_PREFILL, SITE_SERVE_DECODE,
+         SITE_SERVE_REPLAY)
 KINDS = ("raise", "delay", "corrupt", "sigterm")
 
 FAULTS_ENV = "DS_TPU_FAULTS"

@@ -375,6 +375,30 @@ def test_zero_progress_crash_loop_trips_breaker():
     assert len(calls) == 3                          # K rounds, then terminal
 
 
+@pytest.mark.chaos
+def test_productive_round_not_counted_by_breaker():
+    """Regression (PR 2 review): a productive failed round must reset the
+    zero-progress streak to 0, not 1 — the breaker then allows exactly
+    ``zero_progress_limit`` FURTHER barren rounds (the off-by-one tripped
+    it one round early)."""
+    progress = {"v": 0}
+    calls = []
+
+    def attempt(r):
+        calls.append(r)
+        if len(calls) == 1:
+            progress["v"] += 1      # round 1 fails but commits a checkpoint
+        return 1
+
+    sup = Supervisor(attempt, max_restarts=100, backoff_s=0,
+                     progress_fn=lambda: progress["v"],
+                     zero_progress_limit=3)
+    assert sup.run() == 1
+    assert sup.breaker_tripped
+    # 1 productive round + 3 (not 2) zero-progress rounds before the trip
+    assert len(calls) == 4
+
+
 def test_progress_refreshes_restart_budget():
     """6 failures would exhaust max_restarts=2, but each failed round still
     advanced the checkpoint — productive preemption churn keeps its budget."""
@@ -398,6 +422,32 @@ def test_checkpoint_progress_fn_reads_committed_steps(tmp_path):
     engine = _engine()
     _train(engine, 2)
     engine.save_checkpoint(str(tmp_path))
+    assert fn() == 2
+
+
+@pytest.mark.chaos
+def test_progress_fn_ignores_torn_manifestless_tags(tmp_path):
+    """Regression (PR 2 review): a torn save — tag dir with a
+    client_state.json but no manifest — must NOT count as progress: the
+    restore path rejects it, so counting it would refresh the restart
+    budget off unreachable state and defeat the circuit breaker."""
+    from deepspeed_tpu.resilience.integrity import mark_incomplete
+
+    engine = _engine()
+    _train(engine, 2)
+    engine.save_checkpoint(str(tmp_path))          # global_step2 committed
+    fn = checkpoint_progress_fn(str(tmp_path))
+    assert fn() == 2
+    # a torn save that died after the sidecar but before the manifest
+    torn = tmp_path / "global_step7"
+    torn.mkdir()
+    mark_incomplete(str(torn))
+    (torn / "client_state.json").write_text(json.dumps({"global_steps": 7}))
+    assert fn() == 2                               # fallback step 7 ignored
+    # same for a manifest-less dir without even the torn marker
+    bare = tmp_path / "global_step9"
+    bare.mkdir()
+    (bare / "client_state.json").write_text(json.dumps({"global_steps": 9}))
     assert fn() == 2
 
 

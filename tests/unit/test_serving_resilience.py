@@ -1,0 +1,451 @@
+"""Serving resilience layer (ISSUE 3 tentpole): deadlines, load shedding,
+slot quarantine, health/drain, and the ServingSupervisor warm-restart loop
+with exact in-flight replay.
+
+Every fault here fires from a seeded :class:`FaultInjector` rule at an
+exact call count (or a seeded random one, drawn deterministically) — never
+from real flaky infrastructure.  The acceptance invariants (ISSUE 3):
+
+- every submitted request reaches a terminal ``RequestResult`` (completed,
+  ``"deadline"``, or ``"shed"`` — none lost);
+- completed outputs are token-identical to a fault-free run (greedy decode
+  makes supervisor replay exact);
+- page accounting balances after drain: pool pages = free + quarantined.
+"""
+from random import Random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.inference.serving import (Request, ServeTimeout,
+                                             ServingEngine, SlotPrefillError)
+from deepspeed_tpu.inference.serving_supervisor import (RestartBudgetExhausted,
+                                                        ServingSupervisor)
+from deepspeed_tpu.models import CausalLM
+from deepspeed_tpu.monitor import InMemoryMonitor
+from deepspeed_tpu.resilience import (FaultInjector, SITE_SERVE_DECODE,
+                                      SITE_SERVE_PREFILL, SITE_SERVE_REPLAY,
+                                      clear_injector, install_injector)
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    clear_injector()
+    yield
+    clear_injector()
+
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    model = CausalLM("tiny", dtype=jnp.float32, attn_impl="xla")
+    params = model.init_fn(jax.random.PRNGKey(3))
+    return deepspeed_tpu.init_inference(
+        model=model, config={"dtype": "float32"}, params=params)
+
+
+SERVE_KW = dict(b_slots=3, page_size=8, max_model_len=64)
+
+
+def _stream(n, seed=0, smin=3, smax=14, new_choices=(4, 6, 8), eos=None,
+            **extra):
+    rng = np.random.default_rng(seed)
+    return [Request(rid=i,
+                    input_ids=rng.integers(1, 250,
+                                           int(rng.integers(smin, smax))
+                                           ).astype(np.int32),
+                    max_new_tokens=int(rng.choice(new_choices)),
+                    eos_token_id=eos, **extra)
+            for i in range(n)]
+
+
+def _copies(reqs):
+    """Fresh Request objects (rids are single-use while live)."""
+    return [Request(rid=r.rid, input_ids=r.input_ids,
+                    max_new_tokens=r.max_new_tokens,
+                    eos_token_id=r.eos_token_id,
+                    arrival_time=r.arrival_time, deadline_s=r.deadline_s)
+            for r in reqs]
+
+
+@pytest.fixture(scope="module")
+def reference(tiny_engine):
+    """Fault-free serving outputs for the seed-1 stream — the parity oracle
+    every supervised/chaos run below is checked against."""
+    reqs = _stream(6, seed=1)
+    serve = tiny_engine.serving(**SERVE_KW)
+    return reqs, {r.rid: r.output_ids for r in serve.run(_copies(reqs))}
+
+
+# ------------------------------------------------------------- deadlines
+
+def test_deadline_expires_queued_request(tiny_engine):
+    serve = tiny_engine.serving(b_slots=1, page_size=8, max_model_len=64)
+    hog = Request(rid="hog", input_ids=np.array([1, 2, 3], np.int32),
+                  max_new_tokens=6)
+    doomed = Request(rid="doomed", input_ids=np.array([4, 5], np.int32),
+                     max_new_tokens=4, deadline_s=0.5)
+    serve.submit(hog)
+    serve.submit(doomed)
+    serve.step(now=0.0)        # hog takes the only slot; doomed queued
+    assert serve.step(now=1.0) >= 0   # doomed expires: 1.0 > 0 + 0.5
+    results = {r.rid: r for r in serve.run([])}
+    assert results["hog"].finish_reason == "length"
+    d = results["doomed"]
+    assert d.finish_reason == "deadline"
+    assert d.output_ids.size == 0
+    assert d.retry_after_s is not None and d.retry_after_s > 0
+    assert serve.deadline_count == 1
+    assert len(serve._free_pages) == serve.num_pages - 1
+
+
+def test_deadline_expires_inflight_request_and_frees_pages(tiny_engine):
+    serve = tiny_engine.serving(b_slots=1, page_size=8, max_model_len=64)
+    serve.submit(Request(rid="slow", input_ids=np.array([7, 8, 9], np.int32),
+                         max_new_tokens=50, deadline_s=0.5))
+    serve.step(now=0.0)                      # admitted, decoding
+    assert serve._active.any()
+    assert serve.step(now=2.0) == 0          # expired mid-flight
+    (res,) = serve.take_results()
+    assert res.finish_reason == "deadline"
+    assert res.output_ids.size >= 1          # partial progress returned
+    assert len(res.output_ids) < 50
+    assert not serve._active.any()
+    assert len(serve._free_pages) == serve.num_pages - 1
+    # the freed slot serves the next request normally
+    (res2,) = serve.run([Request(rid="next",
+                                 input_ids=np.array([1, 2], np.int32),
+                                 max_new_tokens=3)])
+    assert res2.finish_reason == "length"
+
+
+def test_deadline_validation(tiny_engine):
+    serve = tiny_engine.serving(**SERVE_KW)
+    with pytest.raises(ValueError, match="deadline_s"):
+        serve.submit(Request(rid=0, input_ids=np.array([1], np.int32),
+                             max_new_tokens=2, deadline_s=0.0))
+
+
+# ---------------------------------------------------------- load shedding
+
+def test_bounded_queue_sheds_with_retry_hint(tiny_engine):
+    serve = tiny_engine.serving(b_slots=1, page_size=8, max_model_len=64,
+                                max_queue=2)
+    reqs = _stream(4, seed=2, new_choices=(4,))
+    for r in reqs[:2]:
+        serve.submit(r)                      # fill the bounded queue
+    serve.submit(reqs[2])                    # backlog 2 >= max_queue: shed
+    assert serve.shed_count == 1
+    results = {r.rid: r for r in serve.run([])}
+    shed = results[2]
+    assert shed.finish_reason == "shed"
+    assert shed.output_ids.size == 0
+    assert shed.retry_after_s > 0
+    assert results[0].finish_reason == "length"
+    assert results[1].finish_reason == "length"
+    # the shed rid was released with its result — resubmission now works
+    (res,) = serve.run([_copies([reqs[2]])[0]])
+    assert res.rid == 2 and res.finish_reason == "length"
+    # retry hints track observed service time once completions exist
+    assert serve._ema_service_s is not None and serve._ema_service_s > 0
+
+
+def test_shed_results_flow_through_supervised_run(tiny_engine):
+    sup = tiny_engine.supervised_serving(b_slots=1, page_size=8,
+                                         max_model_len=64, max_queue=1)
+    reqs = _stream(3, seed=3, new_choices=(4,))
+    results = {r.rid: r for r in sup.run(_copies(reqs), max_ticks=500)}
+    assert len(results) == 3                 # none lost
+    reasons = sorted(r.finish_reason for r in results.values())
+    assert reasons.count("shed") >= 1
+    assert "length" in reasons
+
+
+def test_counters_survive_warm_restart(tiny_engine):
+    """A restart swaps in a fresh engine whose counters start at zero; the
+    supervisor's health() must still report lifetime *_total numbers."""
+    sup = tiny_engine.supervised_serving(b_slots=1, page_size=8,
+                                         max_model_len=64, max_queue=2)
+    reqs = _stream(4, seed=12, new_choices=(6,))
+    inj = install_injector(FaultInjector())
+    inj.add(site=SITE_SERVE_DECODE, kind="raise", at_call=2)
+    results = sup.run(_copies(reqs), max_ticks=500)
+    assert sup.restarts == 1
+    n_shed = sum(r.finish_reason == "shed" for r in results)
+    assert n_shed >= 1                       # max_queue=2 shed the overflow
+    assert sup.engine.shed_count == 0        # fresh incarnation...
+    assert sup.health()["shed_total"] == n_shed   # ...lifetime preserved
+
+
+# -------------------------------------------------------- slot quarantine
+
+def test_repeated_prefill_failure_quarantines_slot(tiny_engine):
+    mon = InMemoryMonitor()
+    sup = tiny_engine.supervised_serving(monitor=mon, **SERVE_KW)
+    inj = install_injector(FaultInjector())
+    # two consecutive failures land on the same (first free) slot; the
+    # engine fences it and serves the stream on the remaining fleet
+    inj.add(site=SITE_SERVE_PREFILL, kind="raise", every=1, max_fires=2)
+    reqs = _stream(4, seed=4)
+    results = sup.run(_copies(reqs), max_ticks=2000)
+    assert sup.restarts == 0                 # pool survived: no restart
+    assert len(results) == 4
+    assert all(r.finish_reason == "length" for r in results)
+    eng = sup.engine
+    assert bool(eng._quarantined[0]) and not eng._quarantined[1:].any()
+    assert len(eng._quarantined_pages) > 0
+    h = sup.health()
+    assert h["quarantined_slots"] == 1
+    assert h["usable_slots"] == SERVE_KW["b_slots"] - 1
+    # leaked pages are accounted, never recycled
+    assert h["free_pages"] + h["quarantined_pages"] == eng.num_pages - 1
+    assert mon.latest("serve/quarantined_slots") == 1.0
+
+
+def test_single_prefill_failure_does_not_quarantine(tiny_engine):
+    sup = tiny_engine.supervised_serving(**SERVE_KW)
+    inj = install_injector(FaultInjector())
+    inj.add(site=SITE_SERVE_PREFILL, kind="raise", at_call=1)
+    (res,) = sup.run([Request(rid="r", input_ids=np.array([1, 2, 3], np.int32),
+                              max_new_tokens=3)], max_ticks=500)
+    assert res.finish_reason == "length"
+    assert not sup.engine._quarantined.any()     # success reset the count
+    assert int(sup.engine._slot_failures.sum()) == 0
+
+
+def test_all_slots_quarantined_recovers_via_warm_restart(tiny_engine):
+    sup = tiny_engine.supervised_serving(b_slots=1, page_size=8,
+                                         max_model_len=64,
+                                         quarantine_limit=1)
+    inj = install_injector(FaultInjector())
+    inj.add(site=SITE_SERVE_PREFILL, kind="raise", at_call=1)
+    (res,) = sup.run([Request(rid="q", input_ids=np.array([5, 6], np.int32),
+                              max_new_tokens=4)], max_ticks=500)
+    # the single slot was fenced -> engine terminal -> supervisor rebuilt
+    assert sup.restarts == 1
+    assert "quarantined" in sup.restart_log[0]["cause"]
+    assert res.finish_reason == "length"
+
+
+# ------------------------------------------- supervisor: restart + replay
+
+def test_decode_fault_warm_restart_replays_token_exact(tiny_engine,
+                                                       reference):
+    reqs, ref = reference
+    sup = tiny_engine.supervised_serving(**SERVE_KW)
+    inj = install_injector(FaultInjector())
+    inj.add(site=SITE_SERVE_DECODE, kind="raise", at_call=4)
+    results = sup.run(_copies(reqs), max_ticks=2000)
+    assert sup.restarts == 1
+    assert sup.restart_log[0]["replayed_inflight"] >= 1
+    assert sup.restart_log[0]["programs_reused"] is True
+    assert sorted(r.rid for r in results) == sorted(ref)
+    for r in results:
+        np.testing.assert_array_equal(r.output_ids, ref[r.rid])
+        assert np.array_equal(r.input_ids, reqs[r.rid].input_ids)
+
+
+def test_replay_fault_is_retried_within_budget(tiny_engine, reference):
+    reqs, ref = reference
+    sup = tiny_engine.supervised_serving(**SERVE_KW)
+    inj = install_injector(FaultInjector())
+    inj.add(site=SITE_SERVE_DECODE, kind="raise", at_call=3)
+    # the first restart dies at the replay fault site; the retried restart
+    # must not double-count already-generated prefix tokens
+    inj.add(site=SITE_SERVE_REPLAY, kind="raise", at_call=1)
+    results = sup.run(_copies(reqs), max_ticks=2000)
+    assert sup.restarts == 2
+    for r in results:
+        np.testing.assert_array_equal(r.output_ids, ref[r.rid])
+
+
+def test_restart_budget_exhaustion_is_terminal(tiny_engine):
+    sup = tiny_engine.supervised_serving(max_restarts=2, **SERVE_KW)
+    inj = install_injector(FaultInjector())
+    inj.add(site=SITE_SERVE_DECODE, kind="raise", every=1, max_fires=0)
+    with pytest.raises(RestartBudgetExhausted, match="budget exhausted"):
+        sup.run(_stream(2, seed=6), max_ticks=2000)
+    assert sup.restarts == 2
+    assert len(sup.restart_log) == 2
+
+
+def test_serve_timeout_is_not_treated_as_a_fault(tiny_engine):
+    sup = tiny_engine.supervised_serving(**SERVE_KW)
+    with pytest.raises(ServeTimeout):
+        sup.run(_stream(3, seed=7, new_choices=(8,)), max_ticks=1)
+    assert sup.restarts == 0
+
+
+@pytest.mark.chaos
+def test_chaos_decode_kill_at_random_tick_replays_token_exact(tiny_engine,
+                                                              reference):
+    """Satellite: inject a ``serve.decode`` failure at a seeded-random tick
+    mid-stream; the supervisor's replayed outputs must be token-identical
+    to the fault-free run for every request, with none lost."""
+    reqs, ref = reference
+    for seed in (11, 23, 37):
+        kill_tick = Random(seed).randint(2, 8)
+        inj = install_injector(FaultInjector())
+        inj.add(site=SITE_SERVE_DECODE, kind="raise", at_call=kill_tick)
+        sup = tiny_engine.supervised_serving(**SERVE_KW)
+        try:
+            results = sup.run(_copies(reqs), max_ticks=2000)
+        finally:
+            clear_injector()
+        assert sup.restarts == 1, f"seed={seed} tick={kill_tick}"
+        assert sorted(r.rid for r in results) == sorted(ref)
+        for r in results:
+            np.testing.assert_array_equal(
+                r.output_ids, ref[r.rid],
+                err_msg=f"seed={seed} kill_tick={kill_tick} rid={r.rid}")
+        h = sup.health()
+        assert h["free_pages"] + h["quarantined_pages"] == \
+            sup.engine.num_pages - 1
+
+
+# ---------------------------------------------------------- health / drain
+
+def test_health_snapshot_and_gauges(tiny_engine):
+    mon = InMemoryMonitor()
+    serve = tiny_engine.serving(monitor=mon, **SERVE_KW)
+    serve.run(_stream(3, seed=8))
+    h = serve.health()
+    for key in ("tick", "pool_alive", "draining", "queue_depth",
+                "active_slots", "usable_slots", "quarantined_slots",
+                "free_pages", "quarantined_pages", "shed_total",
+                "deadline_expired_total", "oldest_request_age_s",
+                "retry_after_hint_s", "unclaimed_results"):
+        assert key in h, key
+    assert h["pool_alive"] is True
+    assert h["queue_depth"] == 0 and h["active_slots"] == 0
+    for gauge in ("serve/shed_total", "serve/deadline_expired_total",
+                  "serve/quarantined_slots", "serve/quarantined_pages",
+                  "serve/oldest_request_age_s"):
+        assert mon.series(gauge), f"missing gauge {gauge}"
+    assert mon.latest("serve/shed_total") == 0.0
+
+
+def test_drain_finishes_inflight_and_hands_back_queue(tiny_engine):
+    serve = tiny_engine.serving(b_slots=2, page_size=8, max_model_len=64)
+    reqs = _stream(5, seed=9, new_choices=(6,))
+    for r in reqs:
+        serve.submit(r)
+    serve.step()                             # two admitted, three queued
+    assert int(serve._active.sum()) == 2
+    unserved = serve.drain(max_ticks=200)
+    assert [r.rid for r in unserved] == [2, 3, 4]
+    results = serve.take_results()
+    assert sorted(r.rid for r in results) == [0, 1]
+    assert all(r.finish_reason == "length" for r in results)
+    assert len(serve._free_pages) == serve.num_pages - 1
+    assert serve.health()["draining"] is True
+    # admission is closed: later submissions shed (typed, not dropped)
+    serve.submit(Request(rid="late", input_ids=np.array([1], np.int32),
+                         max_new_tokens=2))
+    (late,) = serve.take_results()
+    assert late.finish_reason == "shed"
+    # unserved rids were released for hand-off resubmission elsewhere
+    other = tiny_engine.serving(b_slots=2, page_size=8, max_model_len=64)
+    handed = {r.rid: r for r in other.run(unserved)}
+    assert sorted(handed) == [2, 3, 4]
+
+
+def test_run_on_draining_engine_fails_loudly(tiny_engine):
+    """run() must not misread disabled admission as an admission deadlock
+    (or spin on pending-only work): a draining engine with waiters tells
+    the caller to drain() instead."""
+    serve = tiny_engine.serving(b_slots=1, page_size=8, max_model_len=64)
+    serve.submit(Request(rid="a", input_ids=np.array([1, 2], np.int32),
+                         max_new_tokens=6))
+    serve.submit(Request(rid="b", input_ids=np.array([3, 4], np.int32),
+                         max_new_tokens=2))
+    serve.step()                 # "a" takes the only slot, "b" queued
+    assert serve._active.any()
+    serve._draining = True
+    with pytest.raises(RuntimeError, match="draining"):
+        serve.run([])            # finishes "a", then must refuse, not spin
+    assert [r.rid for r in serve.drain()] == ["b"]
+
+
+def test_rebase_carries_remaining_deadline_budget():
+    """A warm restart must not hand a request a fresh deadline window —
+    only the unspent budget survives the re-anchor."""
+    req = Request(rid=0, input_ids=np.array([1], np.int32),
+                  max_new_tokens=2, arrival_time=0.0, deadline_s=1.0)
+    rebased = ServingSupervisor._rebase(req, elapsed=0.75)
+    assert rebased.arrival_time == 0.0
+    assert abs(rebased.deadline_s - 0.25) < 1e-9
+    # already expired: floored at an epsilon so the normal expiry path
+    # still produces a terminal "deadline" result
+    expired = ServingSupervisor._rebase(req, elapsed=5.0)
+    assert 0 < expired.deadline_s <= 1e-6
+    # no deadline stays no deadline; pending offset spent counts from arrival
+    free = Request(rid=1, input_ids=np.array([1], np.int32),
+                   max_new_tokens=2, arrival_time=0.5, deadline_s=1.0)
+    assert ServingSupervisor._rebase(free, elapsed=0.7).deadline_s == \
+        pytest.approx(0.8)
+    assert ServingSupervisor._rebase(
+        Request(rid=2, input_ids=np.array([1], np.int32), max_new_tokens=2),
+        elapsed=9.0).deadline_s is None
+
+
+def test_supervised_drain_returns_original_requests(tiny_engine):
+    sup = tiny_engine.supervised_serving(b_slots=1, page_size=8,
+                                         max_model_len=64)
+    reqs = _stream(3, seed=10, new_choices=(5,))
+    for r in reqs:
+        sup.submit(r)
+    sup.engine.step()
+    unserved = sup.drain(max_ticks=200)
+    assert [r.rid for r in unserved] == [1, 2]
+    assert all(isinstance(r, Request) for r in unserved)
+    (done,) = sup.take_results()
+    assert done.rid == 0 and done.finish_reason == "length"
+
+
+# ------------------------------------------------------------- serve soak
+
+@pytest.mark.chaos
+def test_serve_soak_short_deterministic():
+    """Tier-1 variant of ``tools/chaos_soak.py --mode serve``: one seeded
+    soak round — randomized decode/prefill/replay kills + shedding — with
+    the full invariant suite (terminality, parity, page accounting)."""
+    import os
+    import sys
+
+    # remove the exact entry, NOT sys.path.pop(0): importing chaos_soak
+    # runs its own path inserts (repo root + tests/, needed by its lazy
+    # imports), and a blind pop would strip the one it just added
+    tools = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         os.pardir, os.pardir, "tools")
+    sys.path.insert(0, tools)
+    try:
+        from chaos_soak import run_serve_soak
+    finally:
+        sys.path.remove(tools)
+    stats = run_serve_soak(seed=5, n_requests=6, verbose=False)
+    assert stats["terminal"] == stats["submitted"] == 6
+    assert stats["faults_fired"] >= 1
+    assert stats["parity_checked"] >= 1
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_serve_soak_driver_multiseed(tmp_path):
+    """Long-form randomized serving soak (see tools/chaos_soak.py)."""
+    import os
+    import sys
+
+    tools = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         os.pardir, os.pardir, "tools")
+    sys.path.insert(0, tools)
+    try:
+        from chaos_soak import run_serve_soak
+    finally:
+        sys.path.remove(tools)
+    for seed in (20, 21, 22):
+        stats = run_serve_soak(seed=seed, n_requests=8, verbose=False)
+        assert stats["terminal"] == stats["submitted"]

@@ -1,6 +1,17 @@
 #!/usr/bin/env python
-"""Chaos soak: N supervised elastic rounds under seeded random fault
-injection; asserts the run still converges to the final step.
+"""Chaos soak: N supervised sessions under seeded random fault injection.
+
+Two modes (``--mode train`` is the default):
+
+- **train**: supervised elastic training rounds — preemption SIGTERMs,
+  checkpoint-write failures, corruption of the newest generation — must
+  still converge to ``--total-steps`` (invariants below);
+- **serve**: a ``ServingSupervisor`` request stream hammered with
+  randomized ``serve.decode`` / ``serve.prefill`` / ``serve.replay``
+  kills plus bounded-queue shedding and a dead-on-arrival deadline — every
+  request must reach a terminal result, completed outputs must be
+  token-identical to a fault-free reference run, and page accounting must
+  balance after drain (pool pages = free + quarantined).
 
 Each soak round draws a fault mix from a seeded PRNG — preemption SIGTERMs
 at random steps, checkpoint-write failures, corruption of the newest
@@ -17,10 +28,12 @@ Deterministic per ``--seed``: the same seed replays the same fault
 schedule.  Usage::
 
     JAX_PLATFORMS=cpu python tools/chaos_soak.py --soaks 3 --seed 7
+    JAX_PLATFORMS=cpu python tools/chaos_soak.py --mode serve --soaks 3
 
-The tier-1 suite runs the equivalent single deterministic scenario
-(tests/unit/test_resilience.py); this driver is the long-form randomized
-variant (its pytest hook is marked ``slow``).
+The tier-1 suite runs the equivalent single deterministic scenarios
+(tests/unit/test_resilience.py for train,
+tests/unit/test_serving_resilience.py for serve); this driver is the
+long-form randomized variant (its pytest hooks are marked ``slow``).
 """
 from __future__ import annotations
 
@@ -131,14 +144,139 @@ def run_soak(seed: int, total_steps: int, ckpt_every: int, ckpt_dir: str,
     return stats
 
 
+def run_serve_soak(seed: int, n_requests: int = 8, b_slots: int = 3,
+                   verbose: bool = True) -> dict:
+    """One supervised serving session under a seeded random kill schedule.
+
+    The soak draws decode/prefill/replay kill points (and, half the time, a
+    bounded queue + one dead-on-arrival deadline) from ``seed``, replays a
+    mixed-length stream through :class:`ServingSupervisor`, and asserts the
+    ISSUE 3 acceptance invariants:
+
+    - every submitted request reaches a terminal ``RequestResult``
+      (completed / ``"deadline"`` / ``"shed"`` — none lost);
+    - completed outputs are token-identical to a fault-free reference run
+      of the same stream (greedy decode makes supervisor replay exact);
+    - after ``drain()`` the page accounting balances:
+      pool pages = free + quarantined.
+    """
+    import numpy as np
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.inference.serving import Request
+    from deepspeed_tpu.models import CausalLM
+    from deepspeed_tpu.resilience import (FaultInjector, clear_injector,
+                                          install_injector)
+    from deepspeed_tpu.resilience.fault_injection import (
+        SITE_SERVE_DECODE, SITE_SERVE_PREFILL, SITE_SERVE_REPLAY)
+
+    rng = Random(seed)
+    model = CausalLM("tiny", dtype=jnp.float32, attn_impl="xla")
+    params = model.init_fn(jax.random.PRNGKey(0))
+    engine = deepspeed_tpu.init_inference(
+        model=model, config={"dtype": "float32"}, params=params)
+
+    nprng = np.random.default_rng(seed)
+    base = [Request(rid=i,
+                    input_ids=nprng.integers(
+                        1, model.config.vocab_size,
+                        int(nprng.integers(3, 14))).astype(np.int32),
+                    max_new_tokens=int(nprng.choice((4, 6, 8))))
+            for i in range(n_requests)]
+
+    def copies(deadline_rid=None):
+        return [Request(rid=r.rid, input_ids=r.input_ids,
+                        max_new_tokens=r.max_new_tokens,
+                        deadline_s=(1e-4 if r.rid == deadline_rid else None))
+                for r in base]
+
+    # fault-free reference (no injector installed yet)
+    ref_serve = engine.serving(b_slots=b_slots, page_size=8, max_model_len=64)
+    ref = {r.rid: r.output_ids for r in ref_serve.run(copies())}
+
+    # seeded random kill schedule.  The first decode kill lands early so a
+    # short (possibly shed-thinned) stream still exercises a restart;
+    # later kills may or may not fire before the stream drains.
+    inj = FaultInjector()
+    inj.add(site=SITE_SERVE_DECODE, kind="raise", at_call=rng.randint(2, 5))
+    for _ in range(rng.randint(0, 2)):
+        inj.add(site=SITE_SERVE_DECODE, kind="raise",
+                at_call=rng.randint(2, 2 * n_requests))
+    if rng.random() < 0.7:
+        inj.add(site=SITE_SERVE_PREFILL, kind="raise",
+                at_call=rng.randint(1, n_requests))
+    if rng.random() < 0.3:
+        inj.add(site=SITE_SERVE_REPLAY, kind="raise", at_call=1)
+    max_queue = rng.randint(3, n_requests) if rng.random() < 0.5 else None
+    deadline_rid = rng.randrange(n_requests) if rng.random() < 0.5 else None
+    install_injector(inj)
+    try:
+        sup = engine.supervised_serving(
+            b_slots=b_slots, page_size=8, max_model_len=64,
+            max_queue=max_queue, max_restarts=12)
+        results = sup.run(copies(deadline_rid), max_ticks=5000)
+    finally:
+        clear_injector()
+
+    # invariant: none lost — a terminal result per submitted rid
+    by_rid = {r.rid: r for r in results}
+    assert sorted(by_rid) == sorted(r.rid for r in base), \
+        f"serve soak seed={seed}: lost requests " \
+        f"{sorted(set(r.rid for r in base) - set(by_rid))}"
+    # invariant: completed outputs token-identical to the fault-free run
+    parity_checked = 0
+    for rid, res in by_rid.items():
+        if res.finish_reason in ("eos", "length"):
+            assert np.array_equal(res.output_ids, ref[rid]), \
+                f"serve soak seed={seed}: rid {rid} diverged after replay"
+            parity_checked += 1
+        else:
+            assert res.finish_reason in ("deadline", "shed"), res.finish_reason
+    # invariant: page accounting balances after drain
+    unserved = sup.drain(max_ticks=500)
+    assert not unserved, f"serve soak seed={seed}: {len(unserved)} unserved"
+    h = sup.health()
+    assert h["free_pages"] + h["quarantined_pages"] == \
+        sup.engine.num_pages - 1, \
+        f"serve soak seed={seed}: page accounting broken: {h}"
+    stats = {
+        "seed": seed,
+        "submitted": len(base),
+        "terminal": len(by_rid),
+        "parity_checked": parity_checked,
+        "faults_fired": len(inj.log),
+        "fault_log": inj.log,
+        "restarts": sup.restarts,
+        "shed": h["shed_total"],
+        "deadline_expired": h["deadline_expired_total"],
+        "quarantined_slots": h["quarantined_slots"],
+    }
+    if verbose:
+        print(f"  seed={seed}: OK — {stats['faults_fired']} fault(s) fired, "
+              f"{stats['restarts']} restart(s), {stats['shed']} shed, "
+              f"{stats['deadline_expired']} expired, "
+              f"{parity_checked} parity-checked")
+    return stats
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="randomized fault-injection soak for the resilience "
                     "subsystem")
+    ap.add_argument("--mode", choices=("train", "serve"), default="train",
+                    help="train: supervised elastic rounds; serve: "
+                         "ServingSupervisor kill/replay soak")
     ap.add_argument("--soaks", type=int, default=3,
                     help="number of supervised sessions to soak")
     ap.add_argument("--total-steps", type=int, default=8)
     ap.add_argument("--ckpt-every", type=int, default=2)
+    ap.add_argument("--requests", type=int, default=8,
+                    help="serve mode: requests per soak stream")
     ap.add_argument("--seed", type=int, default=0,
                     help="base seed; soak i uses seed+i")
     ap.add_argument("--keep-dirs", action="store_true",
@@ -148,17 +286,29 @@ def main(argv=None) -> int:
     failures = 0
     for i in range(args.soaks):
         seed = args.seed + i
+        if args.mode == "serve":
+            print(f"serve soak {i + 1}/{args.soaks} (seed={seed})")
+            try:
+                run_serve_soak(seed, n_requests=args.requests)
+            # broad catch by design: RestartBudgetExhausted / ServeTimeout /
+            # an escaped InjectedFault ARE the per-seed failure signal this
+            # driver exists to tally — one bad seed must not kill the rest
+            except Exception as e:
+                failures += 1
+                print(f"  FAILED ({type(e).__name__}): {e}", file=sys.stderr)
+            continue
         ckpt_dir = tempfile.mkdtemp(prefix=f"chaos_soak_{seed}_")
         print(f"soak {i + 1}/{args.soaks} (seed={seed}) -> {ckpt_dir}")
         try:
             run_soak(seed, args.total_steps, args.ckpt_every, ckpt_dir)
-        except AssertionError as e:
+        except Exception as e:
             failures += 1
-            print(f"  FAILED: {e}", file=sys.stderr)
+            print(f"  FAILED ({type(e).__name__}): {e}", file=sys.stderr)
         finally:
             if not args.keep_dirs:
                 shutil.rmtree(ckpt_dir, ignore_errors=True)
-    print(f"chaos soak: {args.soaks - failures}/{args.soaks} converged")
+    print(f"chaos soak ({args.mode}): "
+          f"{args.soaks - failures}/{args.soaks} converged")
     return 1 if failures else 0
 
 
