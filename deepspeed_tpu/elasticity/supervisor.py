@@ -53,6 +53,12 @@ class Supervisor:
     K > 0 trips the circuit breaker after K consecutive failed rounds that
     made no progress.  After the run, ``breaker_tripped`` / ``diagnosis``
     describe a terminal failure.
+
+    ``monitor`` (optional): every failed round ships a flight-recorder
+    dump through ``monitor.write_report`` when tracing is enabled, so a
+    crash-looping job's restart log carries the spans of each failed
+    attempt (docs/OBSERVABILITY.md); the most recent dump also stays
+    readable on ``last_flight_dump``.
     """
 
     def __init__(self, attempt: Callable[[int], int], max_restarts: int = 10,
@@ -61,7 +67,7 @@ class Supervisor:
                  backoff_mult: float = 2.0, backoff_max_s: float = 60.0,
                  jitter: float = 0.25,
                  progress_fn: Optional[Callable[[], int]] = None,
-                 zero_progress_limit: int = 0, seed: int = 0):
+                 zero_progress_limit: int = 0, seed: int = 0, monitor=None):
         self.attempt = attempt
         self.max_restarts = max_restarts
         self.backoff_s = backoff_s
@@ -74,6 +80,8 @@ class Supervisor:
         self._rng = Random(seed)
         self.breaker_tripped = False
         self.diagnosis: Optional[str] = None
+        self.monitor = monitor
+        self.last_flight_dump: Optional[str] = None
 
     def backoff_delay(self, consecutive_failures: int) -> float:
         """Exponential in the *consecutive* failure count (a productive
@@ -116,6 +124,19 @@ class Supervisor:
                 logger.info("elastic supervisor: interrupted; not restarting")
                 return rc
             consecutive += 1
+            # failed round: capture the attempt's span history before the
+            # next attempt overwrites the ring (None when tracing is off)
+            try:
+                from ..observability.trace import (DEFAULT_DUMP_WINDOW_S,
+                                                   flight_dump)
+
+                self.last_flight_dump = flight_dump(
+                    f"supervisor.round[{rounds}] rc={rc}",
+                    monitor=self.monitor, last_s=DEFAULT_DUMP_WINDOW_S)
+            except Exception as e:
+                logger.warning("elastic supervisor: flight dump failed "
+                               "(%s: %s)", type(e).__name__, e)
+                self.last_flight_dump = None
             if self.progress_fn is not None:
                 cur = self.progress_fn()
                 if last_progress is None or cur > last_progress:

@@ -53,6 +53,12 @@ supervisor-recyclable exit instead of a silent forever-hang
 (docs/RESILIENCE.md).  :class:`~.serving_supervisor.ServingSupervisor`
 wraps this engine with a warm-restart loop that replays the queue and
 in-flight requests token-exactly after a poisoned-pool or injected failure.
+
+Observability (docs/OBSERVABILITY.md): every tick/admission/prefill/decode
+runs under a ``serve.*`` span on the process-global tracer (no-op when
+tracing is disabled), so a flight-recorder dump after a fault covers the
+poisoned tick, and :class:`RequestResult` carries a per-request timeline
+(``queued_s``, ``ttft_s``, ``decode_ticks``, ``replays``).
 """
 from __future__ import annotations
 
@@ -68,6 +74,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models.transformer import PAGE_SIZE
+from ..observability.trace import trace_count, trace_span
 from ..resilience import (SITE_SERVE_ADMIT, SITE_SERVE_DECODE,
                           SITE_SERVE_PREFILL, SITE_SERVE_TICK, maybe_fire)
 from ..utils.logging import log_dist, logger
@@ -131,6 +138,15 @@ class RequestResult:
     # set on "shed" and queue-expired "deadline" results: a backlog-derived
     # hint for when a resubmission is likely to be admitted
     retry_after_s: Optional[float] = None
+    # ---- per-request timeline (docs/OBSERVABILITY.md): decode program
+    # invocations that fed this request, and how many times a warm restart
+    # re-prefilled it (ServingSupervisor stamps both when stitching replayed
+    # results).  Prefill-emitted tokens (one per incarnation) are not decode
+    # ticks, so for any result that generated tokens
+    # decode_ticks == len(output_ids) - 1 - replays; empty-output terminals
+    # (shed / queue-expired) carry 0/0.
+    decode_ticks: int = 0
+    replays: int = 0
 
     @property
     def ttft_s(self) -> float:
@@ -140,6 +156,11 @@ class RequestResult:
     @property
     def latency_s(self) -> float:
         return self.finish_s - self.arrival_s
+
+    @property
+    def queued_s(self) -> float:
+        """Time from arrival to slot admission (pure queueing, no decode)."""
+        return self.admit_s - self.arrival_s
 
 
 @dataclasses.dataclass
@@ -446,60 +467,67 @@ class ServingEngine:
             need = self._pages_needed(req)
             if len(self._free_pages) < need:
                 break   # FIFO head-of-line blocking: wait for retirements
-            # fire BEFORE the pop: a raise-kind injected fault must leave the
-            # request queued (recoverable), not silently dropped
-            maybe_fire(SITE_SERVE_ADMIT, rid=req.rid, slot=slot)
-            self._queue.popleft()
-            if req.deadline_s is not None:
-                self._waiting_deadlines -= 1
-            pages = [self._free_pages.pop() for _ in range(need)]
-            try:
-                self._prefill(slot, req, pages, now)
-            except BaseException as e:
-                # a failed prefill (transient device error, injected fault)
-                # must not leak its reservation or drop the request.  If the
-                # slot never registered, unwind — request back at the head —
-                # and count the failure against the slot: quarantine_limit
-                # consecutive failures fence it, with THIS attempt's pages
-                # leaked into the quarantine account (suspect contents are
-                # never recycled) and scheduling continuing on the rest of
-                # the fleet.  If the slot did register (failure in the
-                # post-launch bookkeeping), it owns the pages and the next
-                # run continues it.  NOTE: with donation enabled a failed
-                # DEVICE call also consumes the pool — step() then refuses
-                # with PoolConsumedError; the unwind still leaves the queue
-                # replayable (ServingSupervisor rebuilds + replays).
-                if self._slots[slot] is None:
-                    self._page_table[slot, :] = 0
-                    self._queue.appendleft(req)
-                    if req.deadline_s is not None:
-                        self._waiting_deadlines += 1
-                    if not isinstance(e, Exception):
-                        # KeyboardInterrupt/SystemExit is the operator, not
-                        # the slot: plain unwind, no quarantine accounting
-                        self._free_pages.extend(pages)
-                        raise
-                    self._slot_failures[slot] += 1
-                    fails = int(self._slot_failures[slot])
-                    fenced = fails >= self.quarantine_limit
-                    if fenced:
-                        self._quarantined[slot] = True
-                        self._quarantined_pages.extend(pages)
-                        logger.error(
-                            "serve: slot %d quarantined after %d consecutive "
-                            "prefill failures; %d page(s) leaked-and-"
-                            "accounted, %d slot(s) remain", slot, fails,
-                            len(pages), self._usable_slots())
-                    else:
-                        self._free_pages.extend(pages)
-                    raise SlotPrefillError(
-                        f"prefill failed in slot {slot} for request "
-                        f"{req.rid!r} (failure {fails}/"
-                        f"{self.quarantine_limit}"
-                        f"{', slot quarantined' if fenced else ''}): "
-                        f"{e}", slot=slot, rid=req.rid,
-                        quarantined=fenced) from e
-                raise
+            with trace_span("serve.admit", rid=req.rid, slot=slot):
+                self._admit_one(req, slot, need, now)
+
+    def _admit_one(self, req: Request, slot: int, need: int,
+                   now: float) -> None:
+        """Pop the queue head into ``slot`` and prefill it (one admission —
+        the ``serve.admit`` span/fault unit)."""
+        # fire BEFORE the pop: a raise-kind injected fault must leave the
+        # request queued (recoverable), not silently dropped
+        maybe_fire(SITE_SERVE_ADMIT, rid=req.rid, slot=slot)
+        self._queue.popleft()
+        if req.deadline_s is not None:
+            self._waiting_deadlines -= 1
+        pages = [self._free_pages.pop() for _ in range(need)]
+        try:
+            self._prefill(slot, req, pages, now)
+        except BaseException as e:
+            # a failed prefill (transient device error, injected fault)
+            # must not leak its reservation or drop the request.  If the
+            # slot never registered, unwind — request back at the head —
+            # and count the failure against the slot: quarantine_limit
+            # consecutive failures fence it, with THIS attempt's pages
+            # leaked into the quarantine account (suspect contents are
+            # never recycled) and scheduling continuing on the rest of
+            # the fleet.  If the slot did register (failure in the
+            # post-launch bookkeeping), it owns the pages and the next
+            # run continues it.  NOTE: with donation enabled a failed
+            # DEVICE call also consumes the pool — step() then refuses
+            # with PoolConsumedError; the unwind still leaves the queue
+            # replayable (ServingSupervisor rebuilds + replays).
+            if self._slots[slot] is None:
+                self._page_table[slot, :] = 0
+                self._queue.appendleft(req)
+                if req.deadline_s is not None:
+                    self._waiting_deadlines += 1
+                if not isinstance(e, Exception):
+                    # KeyboardInterrupt/SystemExit is the operator, not
+                    # the slot: plain unwind, no quarantine accounting
+                    self._free_pages.extend(pages)
+                    raise
+                self._slot_failures[slot] += 1
+                fails = int(self._slot_failures[slot])
+                fenced = fails >= self.quarantine_limit
+                if fenced:
+                    self._quarantined[slot] = True
+                    self._quarantined_pages.extend(pages)
+                    logger.error(
+                        "serve: slot %d quarantined after %d consecutive "
+                        "prefill failures; %d page(s) leaked-and-"
+                        "accounted, %d slot(s) remain", slot, fails,
+                        len(pages), self._usable_slots())
+                else:
+                    self._free_pages.extend(pages)
+                raise SlotPrefillError(
+                    f"prefill failed in slot {slot} for request "
+                    f"{req.rid!r} (failure {fails}/"
+                    f"{self.quarantine_limit}"
+                    f"{', slot quarantined' if fenced else ''}): "
+                    f"{e}", slot=slot, rid=req.rid,
+                    quarantined=fenced) from e
+            raise
 
     def _prefill(self, slot: int, req: Request, pages: List[int],
                  now: float) -> None:
@@ -512,13 +540,15 @@ class ServingEngine:
         self._page_table[slot, :len(pages)] = pages
         toks = np.zeros((1, s_pad), np.int32)
         toks[0, :S] = req.input_ids
-        maybe_fire(SITE_SERVE_PREFILL, rid=req.rid, slot=slot)
-        with self._armed(f"serve.prefill rid={req.rid!r}"):
-            nxt, self._kpool, self._vpool = prog(
-                self.params, self._kpool, self._vpool,
-                jnp.asarray(self._page_table[slot:slot + 1]),
-                jnp.asarray(toks), jnp.int32(S))
-            tok = int(nxt)   # host fetch inside the watchdog window
+        with trace_span("serve.prefill", rid=req.rid, slot=slot,
+                        bucket=s_pad):
+            maybe_fire(SITE_SERVE_PREFILL, rid=req.rid, slot=slot)
+            with self._armed(f"serve.prefill rid={req.rid!r}"):
+                nxt, self._kpool, self._vpool = prog(
+                    self.params, self._kpool, self._vpool,
+                    jnp.asarray(self._page_table[slot:slot + 1]),
+                    jnp.asarray(toks), jnp.int32(S))
+                tok = int(nxt)   # host fetch inside the watchdog window
         t = time.monotonic()
         self._slot_failures[slot] = 0   # quarantine counts CONSECUTIVE fails
         self._slots[slot] = _Slot(
@@ -548,14 +578,17 @@ class ServingEngine:
         return contextlib.nullcontext()
 
     def _decode_tick(self) -> None:
-        maybe_fire(SITE_SERVE_DECODE, tick=self._tick)
-        with self._armed(f"serve.decode tick {self._tick}"):
-            nxt, self._kpool, self._vpool = self._decode_prog(
-                self.params, self._kpool, self._vpool,
-                jnp.asarray(self._page_table), jnp.asarray(self._lengths),
-                jnp.asarray(self._last_tok), jnp.asarray(self._active))
-            nxt = np.asarray(nxt)
-        for slot in np.flatnonzero(self._active):
+        with trace_span("serve.decode", tick=self._tick):
+            maybe_fire(SITE_SERVE_DECODE, tick=self._tick)
+            with self._armed(f"serve.decode tick {self._tick}"):
+                nxt, self._kpool, self._vpool = self._decode_prog(
+                    self.params, self._kpool, self._vpool,
+                    jnp.asarray(self._page_table), jnp.asarray(self._lengths),
+                    jnp.asarray(self._last_tok), jnp.asarray(self._active))
+                nxt = np.asarray(nxt)   # host fetch = device sync
+        active_slots = np.flatnonzero(self._active)
+        trace_count("serve.tokens", float(len(active_slots)))
+        for slot in active_slots:
             st = self._slots[slot]
             req = st.request
             tok = int(nxt[slot])
@@ -575,7 +608,10 @@ class ServingEngine:
             output_ids=np.asarray(st.tokens, np.int32),
             finish_reason=reason, prefill_bucket=st.bucket,
             arrival_s=st.arrival_s, admit_s=st.admit_s,
-            first_token_s=st.first_token_s, finish_s=time.monotonic())
+            first_token_s=st.first_token_s, finish_s=time.monotonic(),
+            # the prefill produced tokens[0]; every later token is one
+            # decode-program invocation (the request's timeline tick count)
+            decode_ticks=len(st.tokens) - 1)
         if reason == "deadline":
             self.deadline_count += 1
         else:
@@ -616,21 +652,24 @@ class ServingEngine:
                 "were preserved by the admission unwind (ServingSupervisor "
                 "automates the rebuild and replays in-flight work)")
         self._tick += 1
-        maybe_fire(SITE_SERVE_TICK, tick=self._tick)
-        if now is None:
-            now = time.monotonic() - self._t0
-        self._expire(now)
-        if not self._draining:
-            self._admit(now)
-        if self._active.any():
-            self._decode_tick()
-            # refill slots the decode just retired — the queue head starts
-            # its prefill this tick instead of idling one scheduler round
+        with trace_span("serve.tick", tick=self._tick):
+            maybe_fire(SITE_SERVE_TICK, tick=self._tick)
+            if now is None:
+                now = time.monotonic() - self._t0
+            self._expire(now)
             if not self._draining:
                 self._admit(now)
-            # gauges only on working ticks: idle arrival-wait ticks would
-            # otherwise dilute occupancy stats and spam csv backends
-            self._write_gauges()
+            if self._active.any():
+                self._decode_tick()
+                # refill slots the decode just retired — the queue head
+                # starts its prefill this tick instead of idling one
+                # scheduler round
+                if not self._draining:
+                    self._admit(now)
+                # gauges only on working ticks: idle arrival-wait ticks
+                # would otherwise dilute occupancy stats and spam csv
+                # backends
+                self._write_gauges()
         return (int(self._active.sum()) + len(self._queue)
                 + len(self._pending))
 

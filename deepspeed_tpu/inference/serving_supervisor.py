@@ -47,6 +47,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from ..observability.trace import (DEFAULT_DUMP_WINDOW_S, flight_dump,
+                                   trace_span)
 from ..resilience import SITE_SERVE_REPLAY, maybe_fire
 from ..utils.logging import log_dist, logger
 from .serving import (Request, RequestResult, ServeTimeout, ServingEngine,
@@ -94,8 +96,14 @@ class ServingSupervisor:
         # rid -> tokens decoded in previous engine incarnations; replay
         # outputs are prefixed with these when results are stitched
         self._prefix: Dict[Any, List[int]] = {}
+        # rid -> number of in-flight replays (stamped on stitched results)
+        self._replay_count: Dict[Any, int] = {}
         self._collected: Dict[Any, RequestResult] = {}
         self._order: List[Any] = []
+        # flight-recorder dump captured at the most recent warm restart
+        # (None until a restart happens, or when tracing is disabled) —
+        # the post-mortem for "what was the engine doing when it died"
+        self.last_flight_dump: Optional[str] = None
 
     # ----------------------------------------------------------- submission
 
@@ -188,6 +196,7 @@ class ServingSupervisor:
             handed = [self._orig.pop(r.rid, r) for r in unserved]
             for r in handed:
                 self._prefix.pop(r.rid, None)
+                self._replay_count.pop(r.rid, None)
             return handed
 
     def take_results(self) -> List[RequestResult]:
@@ -222,16 +231,27 @@ class ServingSupervisor:
     def _collect(self, res: RequestResult) -> None:
         prefix = self._prefix.pop(res.rid, None)
         orig = self._orig.pop(res.rid, None)
+        replays = self._replay_count.pop(res.rid, 0)
         if prefix:
             # a replayed request: its engine-side prompt was orig + prefix
             # and its output is the continuation — stitch the caller-facing
-            # result back to the original request's frame
+            # result back to the original request's frame.  decode_ticks
+            # accumulates across incarnations: each of the `replays` dead
+            # incarnations produced its first prefix token via prefill, the
+            # rest via decode ticks — so a stitched result that kept
+            # decoding keeps  decode_ticks == len(output_ids) - 1 - replays
+            # (a replay terminated before its re-prefill contributes no new
+            # prefill token and sits one above that line).
             res = dataclasses.replace(
                 res,
                 input_ids=orig.input_ids if orig is not None
                 else res.input_ids[:len(res.input_ids) - len(prefix)],
                 output_ids=np.concatenate(
-                    [np.asarray(prefix, np.int32), res.output_ids]))
+                    [np.asarray(prefix, np.int32), res.output_ids]),
+                decode_ticks=res.decode_ticks + len(prefix) - replays,
+                replays=replays)
+        elif replays:
+            res = dataclasses.replace(res, replays=replays)
         self._collected[res.rid] = res
         self._order.append(res.rid)
 
@@ -253,6 +273,21 @@ class ServingSupervisor:
                 cause = e
 
     def _restart(self, cause: BaseException) -> None:
+        # post-mortem FIRST, before any state is touched: the flight
+        # recorder still holds the failed attempt's spans (the poisoned
+        # tick's serve.tick/serve.decode carry the exception type) plus
+        # whatever is still open.  Ships via monitor.write_report and stays
+        # readable on last_flight_dump; None when tracing is disabled.
+        # Guarded: a dump failure (e.g. a rid whose repr raises) must never
+        # abort the warm restart it is documenting.
+        try:
+            self.last_flight_dump = flight_dump(
+                f"serve.restart {type(cause).__name__}", monitor=self.monitor,
+                last_s=DEFAULT_DUMP_WINDOW_S)
+        except Exception as e:
+            self.last_flight_dump = None
+            logger.warning("serve supervisor: flight dump failed (%s: %s)",
+                           type(e).__name__, e)
         if self.restarts >= self.max_restarts:
             raise RestartBudgetExhausted(
                 f"serving restart budget exhausted ({self.max_restarts}); "
@@ -262,6 +297,11 @@ class ServingSupervisor:
                 self.restart_log)
         self.restarts += 1
         old = self.engine
+        with trace_span("serve.restart", restart=self.restarts,
+                        cause=type(cause).__name__):
+            self._restart_body(cause, old)
+
+    def _restart_body(self, cause: BaseException, old: ServingEngine) -> None:
         # (1) harvest everything that finished before the crash
         for res in old.take_results():
             self._collect(res)
@@ -303,7 +343,9 @@ class ServingSupervisor:
                     input_ids=np.concatenate(
                         [req.input_ids, np.asarray(st.tokens, np.int32)]),
                     max_new_tokens=req.max_new_tokens - len(st.tokens))
-                new.submit(replay)
+                with trace_span("serve.replay", rid=req.rid,
+                                generated=len(st.tokens)):
+                    new.submit(replay)
                 replayed.append((req.rid, list(st.tokens)))
             for req in waiting:
                 new.submit(req)
@@ -313,6 +355,7 @@ class ServingSupervisor:
         # failed restart never double-counts replay tokens
         for rid, tokens in replayed:
             self._prefix[rid] = self._prefix.get(rid, []) + tokens
+            self._replay_count[rid] = self._replay_count.get(rid, 0) + 1
         self._shed_base += old.shed_count
         self._deadline_base += old.deadline_count
         self._quarantined_slots_lifetime += int(old._quarantined.sum())

@@ -8,7 +8,9 @@ backends degrade to warnings when unavailable.
 from __future__ import annotations
 
 import os
-from typing import List, Optional, Tuple
+import threading
+from collections import deque
+from typing import Deque, List, Optional, Tuple
 
 from ..utils.logging import logger
 
@@ -109,28 +111,68 @@ class InMemoryMonitor(Monitor):
 
     Used by the serving engine's tests/tools to assert on the gauge stream
     (TTFT, tokens/sec, queue depth, slot occupancy — serving.py writes
-    ``serve/*`` events every tick) without filesystem or backend setup."""
+    ``serve/*`` events every tick) without filesystem or backend setup.
 
-    def __init__(self, monitor_config=None):
+    **Bounded**: the serving loop emits ~10 gauges per working tick, so an
+    unbounded list leaks memory linearly under a soak.  ``events`` is a
+    ring of the newest ``max_events`` records; evictions are counted on
+    ``dropped_events`` (visible to the Prometheus exporter) rather than
+    silent.  ``series()``/``latest()`` semantics are unchanged over the
+    retained window.
+
+    **Thread-safe**: watchdog / supervisor / async-checkpoint threads emit
+    concurrently with the serving loop; writes and snapshot reads hold one
+    lock (reads copy, so iteration never races an append)."""
+
+    DEFAULT_MAX_EVENTS = 65536
+    DEFAULT_MAX_REPORTS = 256   # reports carry multi-KB flight dumps
+
+    def __init__(self, monitor_config=None, max_events: Optional[int] = None,
+                 max_reports: Optional[int] = None):
         super().__init__(monitor_config)
-        self.events: List[Event] = []
-        self.reports: List[Tuple[str, str]] = []
+        if max_events is None:
+            max_events = self.DEFAULT_MAX_EVENTS
+        if max_events < 1:
+            raise ValueError(f"max_events={max_events} must be >= 1")
+        self.max_events = int(max_events)
+        self.max_reports = int(max_reports if max_reports is not None
+                               else self.DEFAULT_MAX_REPORTS)
+        self.events: Deque[Event] = deque(maxlen=self.max_events)
+        self.reports: Deque[Tuple[str, str]] = deque(maxlen=self.max_reports)
+        self.dropped_events = 0
+        self.dropped_reports = 0
+        self._lock = threading.Lock()
 
     def write_events(self, event_list: List[Event]) -> None:
-        self.events.extend(event_list)
+        with self._lock:
+            for ev in event_list:
+                if len(self.events) == self.max_events:
+                    self.dropped_events += 1
+                self.events.append(ev)
 
     def write_report(self, name: str, text: str) -> None:
-        self.reports.append((name, text))
+        with self._lock:
+            if len(self.reports) == self.max_reports:
+                self.dropped_reports += 1
+            self.reports.append((name, text))
+
+    def events_snapshot(self) -> List[Event]:
+        """Locked copy of the retained events — what an exporter on another
+        thread must read instead of iterating ``events`` directly."""
+        with self._lock:
+            return list(self.events)
 
     def series(self, name: str) -> List[Tuple[int, float]]:
-        """[(step, value)] of every event with this name, in write order."""
-        return [(step, value) for (n, value, step) in self.events
-                if n == name]
+        """[(step, value)] of every retained event with this name, in
+        write order."""
+        snapshot = self.events_snapshot()
+        return [(step, value) for (n, value, step) in snapshot if n == name]
 
     def latest(self, name: str) -> Optional[float]:
         """Most recent value of a gauge, or None if it never fired —
         what a health/readiness assertion usually wants."""
-        for n, value, _step in reversed(self.events):
+        snapshot = self.events_snapshot()
+        for n, value, _step in reversed(snapshot):
             if n == name:
                 return value
         return None

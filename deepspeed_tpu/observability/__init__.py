@@ -1,0 +1,28 @@
+"""Observability subsystem: structured tracing, flight recorder, exporters.
+
+The reference stack's ``deepspeed/profiling`` + ``monitor/`` +
+``utils/timer.py`` triad, redesigned as one layer (docs/OBSERVABILITY.md):
+
+- :mod:`.trace` — nested-span tracer with thread-local context, monotonic
+  clocks, optional ``block_until_ready`` sync points, and a process-global
+  instance instrumentation sites reach without plumbing.  Disabled cost is
+  one attribute check (``tools/trace_smoke.py`` measures it).
+- :mod:`.flight_recorder` — bounded ring of completed spans + counter
+  events; crash paths (``HangWatchdog``, ``elasticity.Supervisor``,
+  ``ServingSupervisor``) dump it so every exit-85 and warm restart ships
+  with the last seconds of scheduler history.
+- :mod:`.export` — Chrome/Perfetto trace-event JSON and Prometheus text
+  exposition of monitor gauges + span aggregates.
+
+Instrumented sites: ``train.batch``/``train.data``/``train.step`` (plus the
+reference-shaped ``train.forward``/``train.backward``), ``ckpt.save``/
+``ckpt.load``/``ckpt.finalize``, ``serve.tick``/``serve.admit``/
+``serve.prefill``/``serve.decode``, ``serve.restart``/``serve.replay``.
+"""
+from .flight_recorder import (CounterEvent, DEFAULT_CAPACITY,  # noqa: F401
+                              FlightRecorder)
+from .trace import (Span, TRACE_CAPACITY_ENV, TRACE_ENV,  # noqa: F401
+                    Tracer, configure_tracer, flight_dump, get_tracer,
+                    trace_count, trace_span)
+from .export import (chrome_trace_events, prometheus_text,  # noqa: F401
+                     write_chrome_trace)

@@ -1,0 +1,147 @@
+"""Trace exporters: Chrome/Perfetto trace-event JSON + Prometheus text.
+
+Two render targets for the same recorded data:
+
+- :func:`chrome_trace_events` / :func:`write_chrome_trace` — the Trace Event
+  Format (``chrome://tracing`` / https://ui.perfetto.dev): each completed
+  span becomes one ``"ph": "X"`` complete event (µs timestamps on the
+  process monotonic clock), each counter a ``"ph": "C"`` event, plus ``M``
+  metadata naming threads.  ``tools/serve_bench.py --trace out.json`` and
+  ``tools/chaos_soak.py --trace out.json`` emit this for a measured run.
+- :func:`prometheus_text` — the Prometheus exposition format: the latest
+  value of every monitor gauge (anything with an ``events`` stream of
+  ``(name, value, step)``, e.g. :class:`~..monitor.InMemoryMonitor`) plus
+  the tracer's span aggregates as ``_count``/``_seconds_total`` pairs —
+  what a scrape endpoint or a textfile collector would serve.
+
+Exporters read; they never mutate recorder state, so exporting mid-run is
+safe (the snapshot is taken under the recorder lock).
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional
+
+
+def chrome_trace_events(records: List[Any]) -> List[Dict[str, Any]]:
+    """Render recorder records (spans + counters) as trace-event dicts."""
+    pid = os.getpid()
+    events: List[Dict[str, Any]] = []
+    threads: Dict[int, str] = {}
+    for r in records:
+        if hasattr(r, "t0"):      # Span
+            if r.dur_s is None:   # open span: renderable as a zero-dur mark
+                continue
+            # overwrite, not setdefault: a counter event seen first leaves
+            # "" for this tid and must not block the thread_name metadata
+            threads[r.tid] = r.thread
+            ev: Dict[str, Any] = {
+                "name": r.name,
+                "cat": r.name.split(".", 1)[0],
+                "ph": "X",
+                "ts": r.t0 * 1e6,
+                "dur": r.dur_s * 1e6,
+                "pid": pid,
+                "tid": r.tid,
+            }
+            args = dict(r.attrs) if r.attrs else {}
+            if r.error:
+                args["error"] = r.error
+            if args:
+                ev["args"] = args
+            events.append(ev)
+        else:                     # CounterEvent
+            threads.setdefault(r.tid, "")
+            events.append({
+                "name": r.name,
+                "ph": "C",
+                "ts": r.t * 1e6,
+                "pid": pid,
+                "tid": r.tid,
+                "args": {"value": r.value},
+            })
+    for tid, name in threads.items():
+        if name:
+            events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                           "tid": tid, "args": {"name": name}})
+    return events
+
+
+def write_chrome_trace(path: str, records: Optional[List[Any]] = None,
+                       metadata: Optional[Dict[str, Any]] = None) -> str:
+    """Write a complete Chrome/Perfetto trace JSON.  ``records`` defaults
+    to the global tracer's full recorder snapshot."""
+    if records is None:
+        from .trace import get_tracer
+
+        records = get_tracer().recorder.snapshot()
+    doc: Dict[str, Any] = {
+        "traceEvents": chrome_trace_events(records),
+        "displayTimeUnit": "ms",
+    }
+    if metadata:
+        doc["otherData"] = metadata
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)   # a torn trace file is worse than none
+    return path
+
+
+# ------------------------------------------------------------- prometheus
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str, prefix: str = "dstpu_") -> str:
+    n = prefix + _PROM_BAD.sub("_", name)
+    return "_" + n if n[0].isdigit() else n
+
+
+def prometheus_text(monitor=None, tracer=None) -> str:
+    """Prometheus exposition of monitor gauges + tracer span aggregates.
+
+    ``monitor`` contributes the latest value per distinct event name (its
+    ``events`` stream holds ``(name, value, step)`` — ``serve/*`` gauges,
+    ``Train/Samples/*``); ``tracer`` (default: the global one) contributes
+    ``dstpu_span_count`` / ``dstpu_span_seconds_total`` per span name and
+    ring-drop accounting."""
+    lines: List[str] = []
+    if monitor is not None:
+        # use the monitor's locked snapshot when it has one — iterating a
+        # live deque would race the serving loop's per-tick gauge appends
+        snap_fn = getattr(monitor, "events_snapshot", None)
+        events = snap_fn() if snap_fn is not None else getattr(
+            monitor, "events", None)
+        if events is not None:
+            latest: Dict[str, float] = {}
+            for name, value, _step in list(events):
+                latest[name] = value
+            for name in sorted(latest):
+                pname = _prom_name(name)
+                lines.append(f"# TYPE {pname} gauge")
+                lines.append(f"{pname} {latest[name]:g}")
+        dropped = getattr(monitor, "dropped_events", None)
+        if dropped is not None:
+            lines.append("# TYPE dstpu_monitor_dropped_events_total counter")
+            lines.append(f"dstpu_monitor_dropped_events_total {dropped}")
+    if tracer is None:
+        from .trace import get_tracer
+
+        tracer = get_tracer()
+    agg = tracer.aggregates()
+    if agg:
+        lines.append("# TYPE dstpu_span_count counter")
+        lines.append("# TYPE dstpu_span_seconds_total counter")
+        for name in sorted(agg):
+            count, total = agg[name]
+            label = name.replace("\\", "\\\\").replace('"', '\\"')
+            lines.append(f'dstpu_span_count{{span="{label}"}} {count}')
+            lines.append(
+                f'dstpu_span_seconds_total{{span="{label}"}} {total:.9f}')
+    lines.append("# TYPE dstpu_flight_recorder_dropped_total counter")
+    lines.append(
+        f"dstpu_flight_recorder_dropped_total {tracer.recorder.dropped}")
+    return "\n".join(lines) + "\n"

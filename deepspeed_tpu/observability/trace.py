@@ -1,0 +1,365 @@
+"""Low-overhead structured span tracer (the observability substrate).
+
+The reference stack treats profiling as a first-class layer (a dedicated
+``deepspeed/profiling`` tree plus ``utils/timer.py``); this is the TPU-native
+redesign around two constraints the reference never had:
+
+- **async dispatch**: a jitted call returns before the device finishes, so a
+  naive ``perf_counter`` pair measures Python dispatch, not the step.  Span
+  contexts accept an optional *sync point* (:meth:`_SpanCtx.sync`) — on exit
+  the tracer runs ``jax.block_until_ready`` on the registered pytree before
+  stamping the end time, the same discipline ``utils/timer.py`` uses.  Sync
+  points only ever run when tracing is enabled, so production hot paths keep
+  their async pipelining when the tracer is off.
+- **hot-path cost**: instrumentation sites sit on the serving tick loop and
+  the train step.  A disabled tracer's ``span()`` is one attribute check
+  returning a shared singleton whose ``__enter__``/``__exit__`` do nothing —
+  sub-microsecond, measured by ``tools/trace_smoke.py`` and reported as
+  ``disabled_span_ns`` (docs/OBSERVABILITY.md).
+
+Spans are **nested per thread** (a thread-local stack assigns depth and
+parent), stamped with monotonic clocks, and fed on completion into a bounded
+:class:`~.flight_recorder.FlightRecorder` ring — the recorder, not the
+tracer, is the retention policy.  A span that unwinds on an exception is
+still recorded, carrying the exception type — that is what lets a
+flight-recorder dump "cover the poisoned tick" after an injected fault.
+
+A **process-global tracer** (:func:`get_tracer` / :func:`configure_tracer` /
+:func:`trace_span` / :func:`trace_count`) is the instrumentation surface:
+sites anywhere in the tree reach it without plumbing a tracer handle through
+every constructor.  ``DS_TPU_TRACE=1`` enables it at import;
+``DS_TPU_TRACE=/path/out.json`` additionally writes a Chrome/Perfetto trace
+at interpreter exit (``DS_TPU_TRACE_CAPACITY`` sizes the ring).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .flight_recorder import CounterEvent, FlightRecorder
+
+
+class Span:
+    """One completed (or still-open) traced section.
+
+    ``t0`` is ``time.monotonic()`` at entry; ``dur_s`` is ``None`` while the
+    span is open.  ``depth``/``parent`` come from the owning thread's span
+    stack; ``error`` is the exception type name when the section unwound."""
+
+    __slots__ = ("name", "t0", "dur_s", "tid", "thread", "depth", "parent",
+                 "attrs", "error")
+
+    def __init__(self, name: str, t0: float, tid: int, thread: str,
+                 depth: int, parent: Optional[str],
+                 attrs: Optional[Dict[str, Any]]):
+        self.name = name
+        self.t0 = t0
+        self.dur_s: Optional[float] = None
+        self.tid = tid
+        self.thread = thread
+        self.depth = depth
+        self.parent = parent
+        self.attrs = attrs
+        self.error: Optional[str] = None
+
+    def __repr__(self):
+        dur = f"{self.dur_s * 1e3:.3f}ms" if self.dur_s is not None else "open"
+        return (f"Span({self.name!r}, {dur}, depth={self.depth}"
+                + (f", error={self.error}" if self.error else "") + ")")
+
+
+class _NullSpan:
+    """Shared do-nothing context returned by a disabled tracer.  ``sync``
+    and ``set`` are no-ops so instrumentation sites never branch on the
+    tracer state themselves."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+    def sync(self, tree: Any) -> None:
+        pass
+
+    def set(self, **attrs) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _SpanCtx:
+    """Live span context: pushes onto the owning thread's stack on enter,
+    stamps duration (after an optional ``block_until_ready`` sync point) and
+    feeds the recorder on exit — including exception unwinds."""
+
+    __slots__ = ("_tracer", "_span", "_sync_tree")
+
+    def __init__(self, tracer: "Tracer", name: str,
+                 attrs: Optional[Dict[str, Any]]):
+        self._tracer = tracer
+        self._sync_tree = None
+        stack = tracer._thread_stack()
+        parent = stack[-1].name if stack else None
+        self._span = Span(name, 0.0, threading.get_ident(),
+                          threading.current_thread().name, len(stack),
+                          parent, attrs)
+
+    def sync(self, tree: Any) -> None:
+        """Register a pytree to ``jax.block_until_ready`` before the end
+        stamp — the TPU analogue of a CUDA event sync (utils/timer.py)."""
+        self._sync_tree = tree
+
+    def set(self, **attrs) -> None:
+        """Attach attributes discovered mid-span (e.g. tokens decoded)."""
+        if self._span.attrs is None:
+            self._span.attrs = attrs
+        else:
+            self._span.attrs.update(attrs)
+
+    def __enter__(self):
+        self._tracer._thread_stack().append(self._span)
+        self._span.t0 = time.monotonic()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._sync_tree is not None:
+            try:
+                import jax
+
+                jax.block_until_ready(self._sync_tree)
+            except Exception:   # a poisoned tree must not mask the real exc
+                pass
+        sp = self._span
+        sp.dur_s = time.monotonic() - sp.t0
+        if exc_type is not None:
+            sp.error = exc_type.__name__
+        stack = self._tracer._thread_stack()
+        if stack and stack[-1] is sp:
+            stack.pop()
+        else:   # unbalanced exit (generator abandoned mid-span): best effort
+            try:
+                stack.remove(sp)
+            except ValueError:
+                pass
+        self._tracer._record(sp)
+        return False
+
+
+class Tracer:
+    """Span tracer + counter sink over a :class:`FlightRecorder` ring.
+
+    ::
+
+        tracer = Tracer(enabled=True)
+        with tracer.span("serve.tick", tick=7):
+            with tracer.span("serve.decode") as sp:
+                out = decode_program(...)
+                sp.sync(out)          # stamp AFTER the device finishes
+        tracer.count("serve.tokens", 4)
+
+    Thread model: span nesting is tracked per thread (thread-local stacks);
+    completion feeds one shared recorder.  The per-thread stacks are also
+    registered in a process-wide map so :meth:`open_spans` (and through it
+    the flight-recorder dump) can show what every thread was *inside* at
+    dump time — the hung section is exactly the span that never completed.
+    """
+
+    def __init__(self, enabled: bool = False,
+                 recorder: Optional[FlightRecorder] = None):
+        self.enabled = bool(enabled)
+        self.recorder = recorder if recorder is not None else FlightRecorder()
+        self._tls = threading.local()
+        # tid -> (thread name, live stack).  The lock guards only REGISTRY
+        # mutation (once per thread) and the open_spans snapshot — never the
+        # per-span hot path; it keeps the crash-dump read safe against a
+        # brand-new thread registering mid-dump (and free-threaded builds).
+        self._open: Dict[int, Tuple[str, List[Span]]] = {}
+        self._open_lock = threading.Lock()
+        self._agg: Dict[str, List[float]] = {}   # name -> [count, total_s]
+        self._agg_lock = threading.Lock()
+
+    # ------------------------------------------------------------ recording
+
+    def span(self, name: str, **attrs) -> Any:
+        """Context manager for one traced section.  Disabled: returns the
+        shared null span (no allocation, no clock read)."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _SpanCtx(self, name, attrs or None)
+
+    def count(self, name: str, value: float = 1.0, **attrs) -> None:
+        """Record a counter event (monotonic-stamped) into the recorder."""
+        if not self.enabled:
+            return
+        self.recorder.add(CounterEvent(name, time.monotonic(), float(value),
+                                       threading.get_ident(), attrs or None))
+
+    def _thread_stack(self) -> List[Span]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+            with self._open_lock:
+                self._open[threading.get_ident()] = (
+                    threading.current_thread().name, stack)
+        return stack
+
+    def _record(self, span: Span) -> None:
+        self.recorder.add(span)
+        with self._agg_lock:
+            agg = self._agg.get(span.name)
+            if agg is None:
+                self._agg[span.name] = [1.0, span.dur_s]
+            else:
+                agg[0] += 1.0
+                agg[1] += span.dur_s
+
+    # ----------------------------------------------------------- inspection
+
+    def aggregates(self) -> Dict[str, Tuple[int, float]]:
+        """name -> (completed count, total seconds), since construction /
+        :meth:`reset` — retention-independent (survives ring eviction)."""
+        with self._agg_lock:
+            return {k: (int(v[0]), v[1]) for k, v in self._agg.items()}
+
+    def open_spans(self) -> List[Span]:
+        """Spans currently on ANY thread's stack, outermost first — what
+        each thread is inside right now (``dur_s`` still ``None``).  Also
+        prunes registry entries of exited threads with empty stacks, so
+        thousands of short-lived traced threads (async-checkpoint commits)
+        cannot grow the map unboundedly; a dead thread that ABANDONED an
+        open span is kept — that is exactly what a dump should show."""
+        with self._open_lock:
+            live = {t.ident for t in threading.enumerate()}
+            for tid in [tid for tid, (_n, st) in self._open.items()
+                        if not st and tid not in live]:
+                del self._open[tid]
+            stacks = list(self._open.values())
+        out: List[Span] = []
+        for _name, stack in stacks:
+            out.extend(list(stack))
+        return out
+
+    def flight_dump(self, reason: str, last_s: Optional[float] = None) -> str:
+        """Formatted flight-recorder dump: completed spans + counters from
+        the ring (optionally only the trailing ``last_s`` seconds) plus an
+        open-spans section per thread.  See ``FlightRecorder.dump``."""
+        return self.recorder.dump(reason, last_s=last_s,
+                                  open_spans=self.open_spans())
+
+    def reset(self) -> None:
+        """Drop recorded history + aggregates (open stacks are untouched —
+        they belong to live ``with`` blocks)."""
+        self.recorder.clear()
+        with self._agg_lock:
+            self._agg.clear()
+
+
+# --------------------------------------------------------------- global hook
+#
+# Instrumentation sites reach the tracer through these module functions —
+# no handle plumbing, and the disabled fast path stays one attribute check.
+
+_GLOBAL = Tracer(enabled=False)
+
+
+def get_tracer() -> Tracer:
+    return _GLOBAL
+
+
+def configure_tracer(enabled: Optional[bool] = None,
+                     capacity: Optional[int] = None) -> Tracer:
+    """Reconfigure the process-global tracer in place (the instance is
+    shared by reference, so instrumentation sites see the change
+    immediately).  ``capacity`` rebuilds the ring, dropping history."""
+    if capacity is not None:
+        _GLOBAL.recorder = FlightRecorder(capacity=capacity)
+    if enabled is not None:
+        _GLOBAL.enabled = bool(enabled)
+    return _GLOBAL
+
+
+def trace_span(name: str, **attrs) -> Any:
+    """``get_tracer().span(...)`` — the one-liner instrumentation sites use."""
+    if not _GLOBAL.enabled:
+        return _NULL_SPAN
+    return _SpanCtx(_GLOBAL, name, attrs or None)
+
+
+def trace_count(name: str, value: float = 1.0, **attrs) -> None:
+    if _GLOBAL.enabled:
+        _GLOBAL.count(name, value, **attrs)
+
+
+# trailing window crash paths dump by default: bounds a dump to the recent
+# past even when the ring is configured huge (chaos soak uses 1<<17 records
+# — serializing all of it per failed round would swamp the report stream)
+DEFAULT_DUMP_WINDOW_S = 60.0
+
+
+def flight_dump(reason: str, monitor=None,
+                last_s: Optional[float] = None) -> Optional[str]:
+    """Dump the global tracer's flight recorder, or ``None`` when there is
+    nothing to show (tracer never enabled / nothing recorded) — callers on
+    crash paths can unconditionally call this and skip on ``None``.
+
+    With ``monitor``, the dump is also shipped through
+    ``monitor.write_report("flight_recorder/<reason>", text)`` so it lands
+    next to the training/serving metrics (csv backends persist it;
+    ``InMemoryMonitor`` captures it for tests).
+
+    Requires the tracer to be CURRENTLY enabled: a crash after tracing was
+    switched off must not ship a stale ring from an unrelated earlier
+    traced pass as its post-mortem (call ``Tracer.flight_dump`` directly to
+    dump retained history explicitly)."""
+    t = _GLOBAL
+    if not t.enabled:
+        return None
+    if not t.recorder.record_count() and not t.open_spans():
+        return None
+    text = t.flight_dump(reason, last_s=last_s)
+    if monitor is not None:
+        try:
+            monitor.write_report(f"flight_recorder/{reason}", text)
+        except Exception:
+            pass   # a dump must never mask the fault being diagnosed
+    return text
+
+
+# env hook: DS_TPU_TRACE=1 enables; DS_TPU_TRACE=/path.json also registers
+# an atexit Chrome-trace export; DS_TPU_TRACE_CAPACITY sizes the ring
+TRACE_ENV = "DS_TPU_TRACE"
+TRACE_CAPACITY_ENV = "DS_TPU_TRACE_CAPACITY"
+
+_env_spec = os.environ.get(TRACE_ENV, "").strip()
+if _env_spec and _env_spec.lower() not in ("0", "false", "off", "no"):
+    _cap = os.environ.get(TRACE_CAPACITY_ENV)
+    try:
+        _cap_n = int(_cap) if _cap else None
+    except ValueError:
+        # a malformed capacity must degrade, not make the library
+        # unimportable — observability never gates the product
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "ignoring malformed $%s=%r (want an int)",
+            TRACE_CAPACITY_ENV, _cap)
+        _cap_n = None
+    configure_tracer(enabled=True, capacity=_cap_n)
+    if _env_spec.lower() not in ("1", "true", "on", "yes"):
+        import atexit
+
+        def _export_at_exit(path=_env_spec):
+            from .export import write_chrome_trace
+
+            try:
+                write_chrome_trace(path)
+            except Exception:
+                pass
+
+        atexit.register(_export_at_exit)

@@ -120,6 +120,22 @@ class HangWatchdog:
                 continue
             self.fired = True
             report = format_stack_report(label or "<unlabelled>", timeout)
+            # append the flight recorder (when tracing is on): the stack
+            # says WHERE the hang is, the span history says what the last
+            # N seconds were doing — and the hung section itself shows up
+            # as an open span (docs/OBSERVABILITY.md)
+            try:
+                from ..observability.trace import (DEFAULT_DUMP_WINDOW_S,
+                                                   flight_dump)
+
+                fr = flight_dump(f"watchdog {label or '<unlabelled>'}",
+                                 last_s=DEFAULT_DUMP_WINDOW_S)
+            except Exception as e:
+                logger.warning("watchdog: flight dump failed (%s: %s)",
+                               type(e).__name__, e)
+                fr = None
+            if fr:
+                report = report + "\n" + fr
             logger.error(report)
             try:
                 if self.monitor is not None:

@@ -20,6 +20,7 @@ from typing import Any, Optional
 
 from .checkpoint_engine import CheckpointEngine
 from .orbax_engine import LATEST_FILE, OrbaxCheckpointEngine
+from ...observability.trace import trace_span
 from ...resilience.fault_injection import SITE_LATEST_PUBLISH, maybe_fire
 from ...resilience.integrity import write_manifest
 from ...utils.logging import log_dist, logger
@@ -74,19 +75,24 @@ def async_save_engine_checkpoint(engine, save_dir: str, ckpt_dir: str,
     ce: AsyncOrbaxCheckpointEngine = engine._async_ckpt_engine
 
     def finalize():
+        # runs on the commit thread: the ckpt.commit span lands in the
+        # flight recorder under THIS thread's name, so a dump during a
+        # wedged finalize shows the open span next to the main thread's
         try:
-            ce.commit(tag)
-            import jax
+            with trace_span("ckpt.commit", tag=str(tag)):
+                ce.commit(tag)
+                import jax
 
-            if jax.process_index() == 0:
-                if manifest is not None:
-                    # after commit: the payload listing must see the
-                    # durable orbax files
-                    write_manifest(ckpt_dir, manifest)
-                if save_latest:
-                    maybe_fire(SITE_LATEST_PUBLISH, path=save_dir, tag=tag)
-                    with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-                        f.write(str(tag))
+                if jax.process_index() == 0:
+                    if manifest is not None:
+                        # after commit: the payload listing must see the
+                        # durable orbax files
+                        write_manifest(ckpt_dir, manifest)
+                    if save_latest:
+                        maybe_fire(SITE_LATEST_PUBLISH, path=save_dir, tag=tag)
+                        with open(os.path.join(save_dir, LATEST_FILE),
+                                  "w") as f:
+                            f.write(str(tag))
         except Exception as e:   # surface on wait; never publish latest
             engine._async_ckpt_error = e
             logger.error(f"async checkpoint {tag} failed: {e}")

@@ -45,6 +45,7 @@ from .offload import (resolve_offload_mode, apply_streamed_placement,
                       HostSteppedOffload)
 from .features import (wire_compression, wire_progressive_layer_drop,
                        wire_curriculum, wire_random_ltd, wire_flops_profiler)
+from ..observability.trace import trace_span
 from ..parallel.mesh import (dp_world_size, resolve_engine_mesh,
                              BATCH_AXES, ZERO_AXES)
 from ..utils.logging import logger, log_dist
@@ -1102,15 +1103,22 @@ class DeepSpeedEngine:
         entry, and the hang watchdog (config ``resilience.watchdog``) is
         armed for the step's duration — a step wedged inside a collective
         becomes a stack report + supervisor-recyclable exit instead of a
-        silent forever-hang."""
+        silent forever-hang.
+
+        Observability: the whole call runs under a ``train.batch`` span
+        (with ``train.data``/``train.step`` children in the fused path) on
+        the process-global tracer — no-op when tracing is disabled
+        (docs/OBSERVABILITY.md)."""
         from ..resilience.fault_injection import SITE_TRAIN_STEP, maybe_fire
 
-        if self._watchdog is None:
-            maybe_fire(SITE_TRAIN_STEP, step=self.global_steps + 1)
-            return self._train_batch_impl(data_iter=data_iter, batch=batch)
-        with self._watchdog.armed(f"train_batch step {self.global_steps + 1}"):
-            maybe_fire(SITE_TRAIN_STEP, step=self.global_steps + 1)
-            return self._train_batch_impl(data_iter=data_iter, batch=batch)
+        with trace_span("train.batch", step=self.global_steps + 1):
+            if self._watchdog is None:
+                maybe_fire(SITE_TRAIN_STEP, step=self.global_steps + 1)
+                return self._train_batch_impl(data_iter=data_iter, batch=batch)
+            with self._watchdog.armed(
+                    f"train_batch step {self.global_steps + 1}"):
+                maybe_fire(SITE_TRAIN_STEP, step=self.global_steps + 1)
+                return self._train_batch_impl(data_iter=data_iter, batch=batch)
 
     def _train_batch_impl(self, data_iter=None, batch=None) -> jnp.ndarray:
         if batch is None:
@@ -1124,7 +1132,8 @@ class DeepSpeedEngine:
                     self._data_iterator = iter(RepeatingLoader(self.training_dataloader))
                 data_iter = self._data_iterator
             batch = data_iter
-        global_batch = self._collect_global_batch(batch)
+        with trace_span("train.data"):
+            global_batch = self._collect_global_batch(batch)
         global_batch = self._inject_pld_theta(global_batch, shape=(self.gas,))
         if self._curriculum_seqlen:
             # legacy seqlen curriculum: truncate the window's sequence dim;
@@ -1159,7 +1168,13 @@ class DeepSpeedEngine:
             jax.block_until_ready(self.state.params)
             self.flops_profiler.start_profile()
         self.tput_timer.start()
-        self.state, metrics = self._compiled_train_step(self.state, global_batch)
+        # the sync point only runs when tracing is enabled: a traced step
+        # measures device time (block_until_ready on the loss), an untraced
+        # one keeps its async dispatch pipelining
+        with trace_span("train.step", step=self.global_steps + 1) as _sp:
+            self.state, metrics = self._compiled_train_step(self.state,
+                                                            global_batch)
+            _sp.sync(metrics["loss"])
         if profiling:
             from ..profiling.flops_profiler import cost_analysis_of
 
@@ -1353,8 +1368,10 @@ class DeepSpeedEngine:
         micro = self._inject_pld_theta(micro, shape=())
         if self._accum_count == 0:
             self.tput_timer.start()
-        loss, self._accum_grads, rng = self._compiled_micro_grad(
-            self.state, micro, self._accum_grads)
+        with trace_span("train.forward", micro=self._accum_count) as _sp:
+            loss, self._accum_grads, rng = self._compiled_micro_grad(
+                self.state, micro, self._accum_grads)
+            _sp.sync(loss)
         self.state = dataclasses.replace(self.state, rng=rng)
         self._window_losses.append(loss)
         self._backward_pending = True
@@ -1364,9 +1381,13 @@ class DeepSpeedEngine:
         """Bank the gradients computed by the matching forward()."""
         assert getattr(self, "_backward_pending", False), \
             "backward() without a preceding forward()"
-        self._backward_pending = False
-        self._accum_count += 1
-        self.micro_steps += 1
+        # the fused fwd+bwd already ran under train.forward; this span marks
+        # the accumulation bookkeeping so the reference-shaped loop's
+        # timeline still shows all three phases
+        with trace_span("train.backward", micro=self._accum_count):
+            self._backward_pending = False
+            self._accum_count += 1
+            self.micro_steps += 1
         return loss
 
     def step(self):
@@ -1380,7 +1401,10 @@ class DeepSpeedEngine:
             return None
         if self._compiled_apply_step is None:
             self._compiled_apply_step = self._make_apply_step()
-        self.state, metrics = self._compiled_apply_step(self.state, self._accum_grads)
+        with trace_span("train.step", step=self.global_steps + 1) as _sp:
+            self.state, metrics = self._compiled_apply_step(self.state,
+                                                            self._accum_grads)
+            _sp.sync(metrics["grad_norm"])
         self._accum_grads = None
         self._accum_count = 0
         self.global_steps += 1
@@ -1473,8 +1497,12 @@ class DeepSpeedEngine:
         the orbax tree (reference swap_tensor/optimizer_utils.py)."""
         from .checkpoint_engine.orbax_engine import save_engine_checkpoint
 
-        return save_engine_checkpoint(self, save_dir, tag=tag, client_state=client_state,
-                                      save_latest=save_latest)
+        with trace_span("ckpt.save",
+                        tag=str(tag) if tag is not None else
+                        f"global_step{self.global_steps}"):
+            return save_engine_checkpoint(self, save_dir, tag=tag,
+                                          client_state=client_state,
+                                          save_latest=save_latest)
 
     def wait_for_checkpoint(self):
         """Block until an in-flight async save (checkpoint.async_save) is
@@ -1485,15 +1513,19 @@ class DeepSpeedEngine:
         restartable exit, never a hung shutdown."""
         from .checkpoint_engine.async_engine import wait_for_pending_checkpoint
 
-        if self._watchdog is None:
-            return wait_for_pending_checkpoint(self)
-        with self._watchdog.armed("async-checkpoint finalize"):
-            return wait_for_pending_checkpoint(self)
+        with trace_span("ckpt.finalize"):
+            if self._watchdog is None:
+                return wait_for_pending_checkpoint(self)
+            with self._watchdog.armed("async-checkpoint finalize"):
+                return wait_for_pending_checkpoint(self)
 
     def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
                         load_lr_scheduler_states=True, load_module_only=False):
         from .checkpoint_engine.orbax_engine import load_engine_checkpoint
 
-        return load_engine_checkpoint(self, load_dir, tag=tag,
-                                      load_optimizer_states=load_optimizer_states,
-                                      load_module_only=load_module_only)
+        with trace_span("ckpt.load",
+                        tag=str(tag) if tag is not None else "latest"):
+            return load_engine_checkpoint(
+                self, load_dir, tag=tag,
+                load_optimizer_states=load_optimizer_states,
+                load_module_only=load_module_only)

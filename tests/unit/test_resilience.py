@@ -566,3 +566,82 @@ def test_agent_prunes_old_generations(tmp_path):
     tags = candidate_tags(str(tmp_path))
     assert tags == ["global_step5", "global_step4"]
     assert (tmp_path / "latest").read_text() == "global_step5"
+
+
+# ------------------------------------------------- flight recorder (ISSUE 4)
+@pytest.mark.chaos
+def test_watchdog_report_includes_flight_recorder_spans():
+    """A hang report must carry the flight recorder: completed spans from
+    just before the deadline AND the hung section itself (open at dump
+    time), so an exit-85 ships with history, not just stacks."""
+    import time
+
+    from deepspeed_tpu.observability import configure_tracer, trace_span
+
+    tracer = configure_tracer(enabled=True, capacity=256)
+    tracer.reset()
+    hangs = []
+    wd = HangWatchdog(timeout_s=0.2, on_hang=hangs.append, poll_s=0.02)
+    try:
+        with trace_span("warmup.step", step=41):
+            pass                                  # completed: in the ring
+        with trace_span("poison.batch", step=42):
+            with wd.armed("hung step 42"):
+                with trace_span("poison.step"):   # open when the dump fires
+                    time.sleep(0.6)
+    finally:
+        wd.stop()
+        configure_tracer(enabled=False)
+        tracer.reset()
+    assert len(hangs) == 1
+    report = hangs[0]
+    assert "hung step 42" in report               # the stack half
+    assert "FLIGHT RECORDER DUMP" in report       # the history half
+    assert "warmup.step" in report
+    assert "open spans at dump time" in report
+    assert "poison.step" in report and "poison.batch" in report
+
+
+def test_supervisor_failed_round_ships_flight_dump():
+    """Every failed supervisor round dumps the attempt's span history via
+    the monitor (when tracing is on), before the next attempt overwrites
+    the ring."""
+    from deepspeed_tpu.monitor import InMemoryMonitor
+    from deepspeed_tpu.observability import configure_tracer, trace_span
+
+    tracer = configure_tracer(enabled=True, capacity=256)
+    tracer.reset()
+    mon = InMemoryMonitor()
+
+    def attempt(restarts):
+        with trace_span("attempt.work", restarts=restarts):
+            pass
+        return 1 if restarts == 0 else 0   # fail once, then complete
+
+    sup = Supervisor(attempt, max_restarts=3, backoff_s=0, monitor=mon)
+    try:
+        rc = sup.run()
+    finally:
+        configure_tracer(enabled=False)
+        tracer.reset()
+    assert rc == 0
+    assert sup.last_flight_dump is not None
+    assert "attempt.work" in sup.last_flight_dump
+    reports = [n for n, _ in mon.reports]
+    assert any(n.startswith("flight_recorder/supervisor.round")
+               for n in reports)
+
+
+def test_supervisor_dump_is_none_when_tracing_disabled():
+    """The dump path must be inert (None, no report) with the tracer off —
+    crash handling never depends on observability being enabled."""
+    from deepspeed_tpu.monitor import InMemoryMonitor
+    from deepspeed_tpu.observability import get_tracer
+
+    get_tracer().reset()   # stale history from other tests would still dump
+    mon = InMemoryMonitor()
+    sup = Supervisor(lambda r: 1 if r == 0 else 0, max_restarts=3,
+                     backoff_s=0, monitor=mon)
+    assert sup.run() == 0
+    assert sup.last_flight_dump is None
+    assert not mon.reports

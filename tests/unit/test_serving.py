@@ -365,3 +365,22 @@ def test_serve_smoke_tool():
     assert out["ok"], out
     assert out["steady_state_compiles"] == 0
     assert out["first_run_compiles"] <= out["compile_budget"]
+
+
+def test_request_timeline_fields(tiny_engine, tiny_serve):
+    """ISSUE 4: RequestResult carries a consistent per-request timeline —
+    queued_s / ttft_s / decode_ticks / replays (docs/OBSERVABILITY.md)."""
+    reqs = _stream(4, seed=21)
+    for i, r in enumerate(reqs):
+        r.arrival_time = 0.01 * i
+    results = tiny_serve.run(list(reqs))
+    assert len(results) == 4
+    for r in results:
+        # monotone stamps: arrival <= admit <= first token <= finish
+        assert r.arrival_s <= r.admit_s <= r.first_token_s <= r.finish_s
+        assert r.queued_s >= 0
+        assert r.ttft_s >= r.queued_s          # first token needs admission
+        assert r.latency_s >= r.ttft_s
+        # the prefill emits tokens[0]; every other token is one decode tick
+        assert r.decode_ticks == len(r.output_ids) - 1
+        assert r.replays == 0                  # no supervisor, no restarts

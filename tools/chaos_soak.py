@@ -281,7 +281,16 @@ def main(argv=None) -> int:
                     help="base seed; soak i uses seed+i")
     ap.add_argument("--keep-dirs", action="store_true",
                     help="keep the per-soak checkpoint dirs for inspection")
+    ap.add_argument("--trace", default=None, metavar="OUT.json",
+                    help="trace the whole soak and write a Chrome/Perfetto "
+                         "artifact (spans from every round, incl. failed "
+                         "attempts + warm restarts)")
     args = ap.parse_args(argv)
+
+    if args.trace:
+        from deepspeed_tpu.observability import configure_tracer
+
+        configure_tracer(enabled=True, capacity=1 << 17)
 
     failures = 0
     for i in range(args.soaks):
@@ -307,6 +316,15 @@ def main(argv=None) -> int:
         finally:
             if not args.keep_dirs:
                 shutil.rmtree(ckpt_dir, ignore_errors=True)
+    if args.trace:
+        from deepspeed_tpu.observability import (configure_tracer,
+                                                 write_chrome_trace)
+
+        configure_tracer(enabled=False)
+        write_chrome_trace(args.trace, metadata={
+            "tool": "chaos_soak", "mode": args.mode, "seed": args.seed,
+            "soaks": args.soaks})
+        print(f"trace artifact -> {args.trace}")
     print(f"chaos soak ({args.mode}): "
           f"{args.soaks - failures}/{args.soaks} converged")
     return 1 if failures else 0

@@ -66,7 +66,7 @@ def _pct(xs, q):
 def run_serve_bench(model_name: str = "llama-374m", b_slots: int = 8,
                     n_requests: int = 32, seed: int = 0,
                     rate_rps: float = 0.0, page_size: int = 128,
-                    max_model_len: int = 0) -> dict:
+                    max_model_len: int = 0, trace: str = None) -> dict:
     import numpy as np
 
     import jax
@@ -148,11 +148,30 @@ def run_serve_bench(model_name: str = "llama-374m", b_slots: int = 8,
     # latency/TTFT under load: from the Poisson-gated stream when a rate is
     # set (open-loop arrivals), else from the saturated pass
     lat_results = sup.run(list(stream)) if rate_rps > 0 else results
+    # snapshot the robustness counters BEFORE any extra traced pass, so
+    # --trace runs stay counter-comparable to plain runs of the same config
+    health = sup.health()
+    restarts = sup.restarts
+
+    # --trace: one EXTRA traced pass (the measured pass above stays
+    # untraced so the throughput number keeps the production overhead
+    # profile), exported as a Chrome/Perfetto artifact
+    if trace:
+        from deepspeed_tpu.observability import (configure_tracer,
+                                                 write_chrome_trace)
+
+        configure_tracer(enabled=True, capacity=1 << 17)
+        try:
+            sup.run(list(stripped))
+        finally:
+            configure_tracer(enabled=False)
+        write_chrome_trace(trace, metadata={
+            "tool": "serve_bench", "model": model_name, "seed": seed,
+            "b_slots": b_slots, "n_requests": n_requests})
     lat = [r.latency_s for r in lat_results]
     ttft = [r.ttft_s for r in lat_results]
     serve_tps = total_tokens / serve_dt
     base_tps = total_tokens / base_dt
-    health = sup.health()
     return {
         "metric": "serve-throughput",
         "value": round(serve_tps, 1),
@@ -178,10 +197,11 @@ def run_serve_bench(model_name: str = "llama-374m", b_slots: int = 8,
             # robustness counters (ISSUE 3): the bench runs the supervised
             # path, so regressions in the resilience layer show up here as
             # nonzero restarts/sheds alongside any throughput cost
-            "restarts": sup.restarts,
+            "restarts": restarts,
             "shed_total": health["shed_total"],
             "deadline_expired_total": health["deadline_expired_total"],
             "quarantined_slots_lifetime": health["quarantined_slots_lifetime"],
+            "trace_artifact": trace,
         },
     }
 
@@ -197,10 +217,13 @@ def main(argv=None) -> int:
     ap.add_argument("--page_size", type=int, default=128)
     ap.add_argument("--max_model_len", type=int, default=0)
     ap.add_argument("--out", default=None, help="also write the JSON here")
+    ap.add_argument("--trace", default=None, metavar="OUT.json",
+                    help="emit a Chrome/Perfetto trace of one extra traced "
+                         "pass (the measured pass stays untraced)")
     args = ap.parse_args(argv)
     result = run_serve_bench(args.model, args.b_slots, args.n_requests,
                              args.seed, args.rate_rps, args.page_size,
-                             args.max_model_len)
+                             args.max_model_len, trace=args.trace)
     line = json.dumps(result)
     print(line)
     if args.out:
