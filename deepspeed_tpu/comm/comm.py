@@ -155,6 +155,24 @@ def get_axis_size(axis: AxisName) -> int:
 # ---------------------------------------------------------------------------
 _initialized = False
 
+# the pod supervisor exports the membership epoch it (re-)formed the job
+# under (elasticity/pod_agent.py, launcher --pod_coord_dir); control-plane
+# sync points scope their rendezvous names by it so a stale host from a
+# previous incarnation can never complete a barrier with the new round
+POD_GENERATION_ENV = "DS_TPU_POD_GENERATION"
+
+
+def get_pod_generation() -> int:
+    """The pod membership generation this process was launched under
+    (0 when not running under a pod supervisor / malformed env)."""
+    raw = os.environ.get(POD_GENERATION_ENV, "").strip()
+    try:
+        return int(raw) if raw else 0
+    except ValueError:
+        logger.warning("ignoring malformed $%s=%r (want an int)",
+                       POD_GENERATION_ENV, raw)
+        return 0
+
 
 def is_initialized() -> bool:
     return _initialized
@@ -175,6 +193,9 @@ def init_distributed(dist_backend: str = "xla", auto_mpi_discovery: bool = True,
         return
     import jax
 
+    if verbose and get_pod_generation():
+        log_dist(f"init_distributed: pod generation {get_pod_generation()}",
+                 [0])
     coord = os.environ.get("COORDINATOR_ADDRESS") or os.environ.get("MASTER_ADDR")
     nprocs = world_size if world_size > 0 else int(
         os.environ.get("NUM_PROCESSES", os.environ.get("WORLD_SIZE", "0")) or 0)
@@ -219,13 +240,17 @@ def get_local_rank() -> int:
 
 
 def barrier() -> None:
-    """Cross-host sync barrier (reference comm.py:398 monitored_barrier)."""
+    """Cross-host sync barrier (reference comm.py:398 monitored_barrier).
+    The sync name is scoped by the pod generation: a host left over from a
+    previous membership epoch blocks on a DIFFERENT name and times out in
+    the runtime instead of silently pairing with the re-formed job."""
     import jax
 
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices("deepspeed_tpu_barrier")
+        multihost_utils.sync_global_devices(
+            f"deepspeed_tpu_barrier/gen{get_pod_generation()}")
     else:
         jax.block_until_ready(jax.numpy.zeros(()))
 

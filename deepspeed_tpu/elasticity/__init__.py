@@ -19,3 +19,32 @@ from .elastic_agent import (  # noqa: F401
     resolve_plan_for_current_world,
 )
 from .supervisor import RC_COMPLETE, RC_INTERRUPT, Supervisor  # noqa: F401
+from .coordination import (  # noqa: F401
+    CoordinationStore,
+    FileCoordinationStore,
+    HeartbeatWatchdog,
+    HostLease,
+    PodCoordinationError,
+    PodRendezvousTimeout,
+    RC_POD_PEER_LOST,
+    beat,
+    bump_generation,
+    clear_dead,
+    dead_hosts,
+    dead_set,
+    lease_table,
+    read_generation,
+    record_dead,
+    rendezvous,
+)
+from .pod_agent import (  # noqa: F401
+    PodContext,
+    PodElasticAgent,
+    PodPeerLost,
+    PodRound,
+    PodSupervisor,
+    RC_POD_UNRECOVERABLE,
+    pending_commit,
+    save_pod_checkpoint,
+    shrink_to_healthy,
+)

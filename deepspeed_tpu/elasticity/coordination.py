@@ -1,0 +1,419 @@
+"""Pod coordination: heartbeat leases, generation counter, rendezvous.
+
+Multi-host TPU training has no failure story without a side channel: a host
+that dies mid-collective leaves its peers wedged in native code with no
+exception, and the launcher's supervisor cannot tell a transient crash from
+a permanently lost host.  This module is that side channel — a tiny
+coordination layer in the spirit of Bamboo (NSDI '23) and Oobleck
+(SOSP '23)-style elastic recovery, built on one deliberately small
+abstraction:
+
+:class:`CoordinationStore`
+    A namespaced key -> JSON-document store with atomic replace.  The
+    production deployment backs it with storage every host already shares
+    (the checkpoint filesystem / a coordinator-host export); tests and
+    single-node soaks use the same :class:`FileCoordinationStore` on a
+    tmpdir.  Nothing here imports jax — the layer must stay usable from
+    the launcher before any device runtime exists.
+
+On top of it, three protocols:
+
+- **Heartbeats with leases** (:func:`beat` / :func:`lease_table` /
+  :func:`dead_hosts`): each host renews a lease document stamped with the
+  store clock; a host whose newest beat is older than ``miss_limit``
+  lease periods is *dead by lease*.  :class:`HeartbeatWatchdog` runs the
+  renew/scan loop on a daemon thread and reports the first dead peer so
+  the training process can exit with :data:`RC_POD_PEER_LOST` instead of
+  hanging in the next collective.
+- **Pod generation** (:func:`read_generation` / :func:`bump_generation`):
+  a monotonically increasing integer identifying one membership epoch.
+  Every relaunch round bumps it; heartbeats, rendezvous records, dead-host
+  markers and pod checkpoint manifests all carry it, so state from a
+  previous incarnation can never be mistaken for the current round's.
+- **Rendezvous** (:func:`rendezvous`): hosts of a generation register and
+  wait until the expected membership is present (or a timeout raises
+  :class:`PodRendezvousTimeout`) — the barrier the pod supervisor uses to
+  re-form the job after a shrink.
+
+Fault sites ``pod.heartbeat`` and ``pod.rendezvous`` hook the two live
+paths so chaos tests can kill leases and wedge rendezvous deterministically
+(resilience/fault_injection.py).  See docs/POD.md.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from ..resilience.fault_injection import (SITE_POD_HEARTBEAT,
+                                          SITE_POD_RENDEZVOUS, maybe_fire)
+from ..utils.logging import logger
+
+# exit code a host uses when the heartbeat watchdog declares a peer dead:
+# distinct from RC_HANG (85, watchdog) so the supervisor can tell "my own
+# step wedged" from "a peer's lease expired and I exited to re-form"
+RC_POD_PEER_LOST = 87
+
+
+class PodCoordinationError(RuntimeError):
+    """Base error for the pod coordination layer."""
+
+
+class PodRendezvousTimeout(PodCoordinationError):
+    """Rendezvous did not reach the expected membership in time."""
+
+
+class CoordinationStore:
+    """Namespaced key -> JSON document store with atomic replace.
+
+    Keys are ``/``-separated paths (``heartbeat/host3``,
+    ``rendezvous/gen2/host0``).  Semantics the protocols rely on:
+
+    - :meth:`put` replaces atomically — a reader never observes a torn
+      document;
+    - :meth:`list` returns the child names directly under a prefix;
+    - there is no watch/subscribe: every consumer polls, which keeps the
+      file backend honest and the test clock injectable.
+    """
+
+    def put(self, key: str, value: Dict) -> None:
+        raise NotImplementedError
+
+    def get(self, key: str) -> Optional[Dict]:
+        raise NotImplementedError
+
+    def list(self, prefix: str) -> List[str]:
+        raise NotImplementedError
+
+    def delete(self, key: str) -> None:
+        raise NotImplementedError
+
+    def now(self) -> float:
+        """The store clock — ``time.time`` by default so stamps are
+        comparable across hosts sharing the backend; tests inject a fake
+        clock for deterministic lease-expiry coverage."""
+        return time.time()
+
+
+class FileCoordinationStore(CoordinationStore):
+    """File-backed store: one JSON file per key under ``root``.
+
+    Deployment target is storage all hosts of the pod already mount (the
+    checkpoint filesystem or a coordinator-host export); tests point it at
+    a tmpdir.  Atomicity is write-to-tmp + ``os.replace`` — the same
+    discipline as the checkpoint manifests.  The tmp name carries pid and
+    thread id so concurrent writers (simulated hosts are threads) never
+    collide on it.
+    """
+
+    def __init__(self, root: str, clock: Optional[Callable[[], float]] = None):
+        self.root = str(root)
+        os.makedirs(self.root, exist_ok=True)
+        self._clock = clock
+
+    def _path(self, key: str) -> str:
+        key = key.strip("/")
+        if not key or ".." in key.split("/"):
+            raise ValueError(f"bad coordination key {key!r}")
+        return os.path.join(self.root, *key.split("/"))
+
+    def put(self, key: str, value: Dict) -> None:
+        from ..resilience.integrity import _atomic_write_json
+
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        _atomic_write_json(path, value)
+
+    def get(self, key: str) -> Optional[Dict]:
+        path = self._path(key)   # key validation errors must not be eaten
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+        except (OSError, ValueError) as e:
+            # a half-visible write on flaky network storage reads as absent,
+            # not as a crash — callers poll and will see the committed value
+            logger.warning("coordination store: unreadable key %s (%s)",
+                           key, e)
+            return None
+
+    def list(self, prefix: str) -> List[str]:
+        try:
+            names = os.listdir(self._path(prefix))
+        except (FileNotFoundError, NotADirectoryError):
+            return []
+        return sorted(n for n in names if ".tmp." not in n)
+
+    def delete(self, key: str) -> None:
+        try:
+            os.remove(self._path(key))
+        except FileNotFoundError:
+            pass
+
+    def now(self) -> float:
+        return self._clock() if self._clock is not None else time.time()
+
+
+# --------------------------------------------------------------- heartbeats
+
+@dataclass(frozen=True)
+class HostLease:
+    """One host's newest heartbeat as seen through the store."""
+    host_id: str
+    generation: int
+    beat_t: float          # store-clock stamp of the newest beat
+    lease_s: float         # the period the host promised to renew within
+    attrs: Dict
+
+    def age(self, now: float) -> float:
+        return max(0.0, now - self.beat_t)
+
+    def missed(self, now: float) -> float:
+        """Lease periods elapsed since the newest beat (0.0 = fresh)."""
+        return self.age(now) / self.lease_s if self.lease_s > 0 else 0.0
+
+
+def beat(store: CoordinationStore, host_id: str, generation: int,
+         lease_s: float, **attrs) -> None:
+    """Renew ``host_id``'s lease for ``generation``.  ``attrs`` ride along
+    (e.g. ``step=`` so peers and the supervisor can observe progress)."""
+    maybe_fire(SITE_POD_HEARTBEAT, host=host_id, generation=generation)
+    store.put(f"heartbeat/{host_id}", {
+        "host_id": host_id, "generation": int(generation),
+        "beat_t": store.now(), "lease_s": float(lease_s), "attrs": attrs})
+
+
+def lease_table(store: CoordinationStore) -> Dict[str, HostLease]:
+    """Every host's newest lease, regardless of generation or freshness."""
+    out: Dict[str, HostLease] = {}
+    for name in store.list("heartbeat"):
+        doc = store.get(f"heartbeat/{name}")
+        if doc is None:
+            continue
+        out[doc["host_id"]] = HostLease(
+            host_id=doc["host_id"], generation=int(doc["generation"]),
+            beat_t=float(doc["beat_t"]), lease_s=float(doc["lease_s"]),
+            attrs=doc.get("attrs", {}))
+    return out
+
+
+def dead_hosts(store: CoordinationStore, generation: int, miss_limit: int,
+               expected: Optional[List[str]] = None) -> List[str]:
+    """Hosts of ``generation`` whose lease has lapsed ``miss_limit`` times
+    — plus, when ``expected`` is given, hosts that never reached this
+    generation at all (no lease, or one stuck at an OLDER generation: a
+    host that died before its first renewal is just as dead).  A lease
+    from a NEWER generation is proof of life, never death — a stale
+    watchdog still scanning for its old generation must not dead-mark the
+    healthy hosts that re-formed without it."""
+    now = store.now()
+    table = lease_table(store)
+    dead = []
+    for host, lease in table.items():
+        if lease.generation == generation and lease.missed(now) >= miss_limit:
+            dead.append(host)
+    for host in expected or []:
+        lease = table.get(host)
+        if lease is None or lease.generation < generation:
+            dead.append(host)
+    return sorted(set(dead))
+
+
+def record_dead(store: CoordinationStore, host_id: str, generation: int,
+                reported_by: str) -> None:
+    """Durable dead-host marker: once ANY peer declares a host dead for a
+    generation, every later supervisor round excludes it until an operator
+    (or a re-registering host) clears the marker."""
+    store.put(f"dead/{host_id}", {
+        "host_id": host_id, "generation": int(generation),
+        "reported_by": reported_by, "t": store.now()})
+
+
+def dead_set(store: CoordinationStore) -> List[str]:
+    return [name for name in store.list("dead")
+            if store.get(f"dead/{name}") is not None]
+
+
+def clear_dead(store: CoordinationStore, host_id: str) -> None:
+    """A replaced/recovered host re-admits itself by clearing its marker
+    (the next supervisor round then counts it healthy again)."""
+    store.delete(f"dead/{host_id}")
+
+
+# --------------------------------------------------------------- generation
+
+def read_generation(store: CoordinationStore) -> int:
+    doc = store.get("generation")
+    return int(doc["generation"]) if doc else 0
+
+
+def bump_generation(store: CoordinationStore) -> int:
+    """Advance the pod generation and return the new value.  Single-writer
+    by contract: only the supervisor round (one process) bumps."""
+    gen = read_generation(store) + 1
+    store.put("generation", {"generation": gen, "t": store.now()})
+    return gen
+
+
+# --------------------------------------------------------------- rendezvous
+
+def rendezvous(store: CoordinationStore, host_id: str, generation: int,
+               expected_hosts: List[str], timeout_s: float = 60.0,
+               poll_s: float = 0.02) -> List[str]:
+    """Register for ``generation`` and wait until every expected host has.
+
+    Returns the sorted member list (rank = index of ``host_id`` in it).
+    Registration is idempotent; a stale registration from a previous
+    generation is invisible (records are keyed by generation).  Raises
+    :class:`PodRendezvousTimeout` with the missing hosts after
+    ``timeout_s`` — the supervisor treats that as a failed round and
+    re-plans against the hosts that did show up.
+    """
+    maybe_fire(SITE_POD_RENDEZVOUS, host=host_id, generation=generation)
+    store.put(f"rendezvous/gen{generation}/{host_id}",
+              {"host_id": host_id, "t": store.now()})
+    expected = sorted(set(expected_hosts))
+    deadline = time.monotonic() + timeout_s
+    while True:
+        present = set(store.list(f"rendezvous/gen{generation}"))
+        if all(h in present for h in expected):
+            return expected
+        if time.monotonic() >= deadline:
+            missing = sorted(set(expected) - present)
+            raise PodRendezvousTimeout(
+                f"rendezvous gen{generation}: host {host_id!r} waited "
+                f"{timeout_s:.1f}s; missing {missing} "
+                f"(present: {sorted(present)})")
+        time.sleep(poll_s)
+
+
+# ----------------------------------------------------------- the watchdog
+
+class HeartbeatWatchdog:
+    """Daemon thread that renews this host's lease and scans its peers.
+
+    The first peer whose lease lapses ``miss_limit`` periods (or that never
+    beat at all once ``grace_beats`` of our own renewals have happened) is
+    recorded in the store (:func:`record_dead`) and reported through
+    ``on_peer_dead(host_id)``.  The default action exits the process with
+    :data:`RC_POD_PEER_LOST` via ``os._exit`` — the same rationale as the
+    hang watchdog: this thread may be the only one NOT wedged inside a
+    native collective, so a clean exception cannot be relied on to
+    propagate.  Tests pass an ``on_peer_dead`` observer instead.
+
+    One watchdog per host per generation; scanning stops after the first
+    detection (``dead`` keeps the list) so a cascade of expiring peers —
+    everyone else exiting after the same detection — produces one exit
+    cause, not ``n`` races.
+    """
+
+    def __init__(self, store: CoordinationStore, host_id: str,
+                 generation: int, peers: List[str], lease_s: float = 5.0,
+                 miss_limit: int = 3,
+                 on_peer_dead: Optional[Callable[[str], None]] = None,
+                 monitor=None, grace_beats: int = 3,
+                 renew_s: Optional[float] = None):
+        self.store = store
+        self.host_id = host_id
+        self.generation = int(generation)
+        self.peers = [p for p in peers if p != host_id]
+        self.lease_s = float(lease_s)
+        self.miss_limit = int(miss_limit)
+        self.on_peer_dead = on_peer_dead
+        self.monitor = monitor
+        self.grace_beats = int(grace_beats)
+        # wall-clock renew cadence; defaults to a third of the lease.  Kept
+        # separate so stores with an injected (test) clock can renew on real
+        # time while lease expiry is judged on the store clock.
+        self.renew_s = (float(renew_s) if renew_s is not None
+                        else max(self.lease_s / 3.0, 1e-3))
+        self.dead: List[str] = []
+        self.beats = 0
+        self._attrs: Dict = {}
+        self._started_at: Optional[float] = None   # store clock, at start()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def set_attrs(self, **attrs) -> None:
+        """Attach attributes to the next beats (e.g. ``step=N`` so peers
+        and the supervisor can watch progress through the store)."""
+        self._attrs.update(attrs)
+
+    def start(self) -> "HeartbeatWatchdog":
+        beat(self.store, self.host_id, self.generation, self.lease_s,
+             **self._attrs)   # first lease lands before start() returns
+        self.beats = 1
+        self._started_at = self.store.now()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"pod-heartbeat[{self.host_id}]",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def beat_once(self) -> None:
+        """Renew synchronously (the thread also renews on its own cadence;
+        call this from the step loop to piggyback fresh attrs)."""
+        beat(self.store, self.host_id, self.generation, self.lease_s,
+             **self._attrs)
+        self.beats += 1
+
+    def _loop(self) -> None:
+        # renew well inside the lease so one slow write never costs it
+        while not self._stop.wait(self.renew_s):
+            try:
+                self.beat_once()
+                if not self.dead:
+                    self._scan()
+            except Exception as e:   # the watchdog must outlive flaky storage
+                logger.warning("pod heartbeat: %s: %s", type(e).__name__, e)
+
+    def _scan(self) -> None:
+        # the "never beat at all" check needs BOTH grace gates: our own
+        # renewal count AND miss_limit lease periods of STORE-CLOCK time
+        # since start() — a peer still inside device init (its watchdog not
+        # started yet) must get the same allowance a lease expiry would,
+        # or a fast starter would durably dead-mark a healthy slow one
+        elapsed = (self.store.now() - self._started_at
+                   if self._started_at is not None else 0.0)
+        expected = (self.peers
+                    if (self.beats >= self.grace_beats
+                        and elapsed >= self.miss_limit * self.lease_s)
+                    else None)
+        dead = dead_hosts(self.store, self.generation, self.miss_limit,
+                          expected=expected)
+        dead = [h for h in dead if h in self.peers]
+        if self.monitor is not None:
+            # emitted on the detection scan too: the drop from full
+            # membership is exactly the transition this gauge exists for
+            self.monitor.write_events([
+                ("pod/live_hosts",
+                 float(len(self.peers) + 1 - len(dead)), self.beats),
+                ("pod/generation", float(self.generation), self.beats)])
+        if not dead:
+            return
+        self.dead = dead
+        for host in dead:
+            record_dead(self.store, host, self.generation, self.host_id)
+        logger.error(
+            "pod heartbeat: host(s) %s missed %d lease(s) of %.3fs in "
+            "generation %d — declaring dead; peers should exit %d and let "
+            "the pod supervisor re-form at the healthy slice",
+            dead, self.miss_limit, self.lease_s, self.generation,
+            RC_POD_PEER_LOST)
+        if self.monitor is not None:
+            self.monitor.write_events([
+                ("pod/dead_hosts", float(len(dead)), self.beats)])
+        if self.on_peer_dead is not None:
+            self.on_peer_dead(dead[0])
+        else:   # pragma: no cover - exercised only in real pod deployments
+            os._exit(RC_POD_PEER_LOST)

@@ -113,6 +113,10 @@ class ElasticAgent:
         for tag in candidate_tags(self.ckpt_dir):
             tag_dir = os.path.join(self.ckpt_dir, tag)
             try:
+                # subclass hook (pod agent): extra commit-scope verification
+                # BEFORE any engine state is touched — a failure here
+                # quarantines and falls back exactly like a load failure
+                self._pre_load_verify(tag_dir)
                 try:
                     self.engine.load_checkpoint(self.ckpt_dir, tag=tag)
                 except KeyboardInterrupt:
@@ -170,7 +174,7 @@ class ElasticAgent:
             at_interval = self.ckpt_every and (step + 1) % self.ckpt_every == 0
             if at_interval or self.guard.should_stop:
                 try:
-                    self.engine.save_checkpoint(self.ckpt_dir, tag=self.tag)
+                    self._save()
                     if self.guard.should_stop:
                         # about to exit: an async save's commit runs on a
                         # daemon thread that dies with the process — join it
@@ -195,12 +199,21 @@ class ElasticAgent:
                          f"(signal {self.guard.received})", ranks=[0])
                 return step + 1
         if saved_at != total_steps:
-            self.engine.save_checkpoint(self.ckpt_dir, tag=self.tag)
+            self._save()
             self._join_pending_save()
             self._prune_generations()
         else:
             self._join_pending_save()
         return total_steps
+
+    def _save(self) -> None:
+        """One checkpoint save at the agent's tag policy; the pod agent
+        overrides this with the pod-scope commit protocol."""
+        self.engine.save_checkpoint(self.ckpt_dir, tag=self.tag)
+
+    def _pre_load_verify(self, tag_dir: str) -> None:
+        """Commit-scope verification hook run before a tag is loaded (the
+        base agent relies on the engine's per-host manifest check)."""
 
     def _join_pending_save(self) -> None:
         """Commit barrier before the process may exit (no-op for sync
@@ -226,11 +239,17 @@ class ElasticAgent:
         import shutil
 
         committed = [t for t in candidate_tags(self.ckpt_dir)
-                     if os.path.exists(os.path.join(self.ckpt_dir, t,
-                                                    MANIFEST_FILE))]
+                     if self._tag_committed(os.path.join(self.ckpt_dir, t))]
         for old in committed[self.keep:]:
             shutil.rmtree(os.path.join(self.ckpt_dir, old),
                           ignore_errors=True)
+
+    def _tag_committed(self, tag_dir: str) -> bool:
+        """Commit test used for prune candidacy AND the keep-newest count;
+        the pod agent tightens it to pod-committed so a torn pod tag can
+        neither be deleted under a late writer nor crowd a real fallback
+        generation out of the keep window."""
+        return os.path.exists(os.path.join(tag_dir, MANIFEST_FILE))
 
 
 def resolve_plan_for_current_world(config, dp_world_size: int,

@@ -1,0 +1,353 @@
+"""Pod-level fault tolerance: all-hosts checkpoint commit + shrink-to-healthy.
+
+This wires the pieces PRs 1-4 left disconnected into one recovery path
+(docs/POD.md):
+
+- :func:`save_pod_checkpoint` extends the per-host manifest commit (PR 1)
+  to pod scope: every host lands its shard and a per-host manifest, the
+  coordinator publishes ``pod_manifest.json`` only after *all* hosts of the
+  generation reported, and only then does the ``latest`` pointer move.  A
+  crash anywhere in between leaves a TORN pod tag that the restore walk
+  quarantines.
+- :class:`PodElasticAgent` is :class:`~.elastic_agent.ElasticAgent` with
+  pod-scope commit on save and pod-scope verification on restore; its
+  restore walk falls back by generation across *pod sizes* — orbax restores
+  global arrays onto whatever mesh the resumed world builds, so a pod
+  checkpoint written at 4 hosts restores at 2 (the reshard/``sharded_load``
+  path does the same for inference checkpoints).
+- :class:`PodSupervisor` is the round driver: each round it reads the
+  coordination store's dead-host markers, shrinks the job to the largest
+  healthy slice :func:`~.elasticity.compute_elastic_config` admits, bumps
+  the pod generation, and hands the resulting :class:`PodRound` (hosts +
+  batch triad) to the caller's attempt.  A round that exits
+  :data:`~.coordination.RC_POD_PEER_LOST` (a peer's lease expired) is the
+  expected shrink signal, not a crash loop.
+
+Simulated pods (tests, ``tools/chaos_soak.py --mode pod``) drive hosts as
+threads against a :class:`~.coordination.FileCoordinationStore`; the
+coordinator host owns the real engine (a single CPU process owns the whole
+virtual mesh) and peers exercise the protocol half: heartbeats, shard
+writes, host manifests, rendezvous.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from .coordination import (CoordinationStore, HeartbeatWatchdog,
+                           RC_POD_PEER_LOST, bump_generation, dead_set)
+from .elastic_agent import ElasticAgent
+from .elasticity import (ElasticPlan, ElasticityIncompatibleWorldSize,
+                         compute_elastic_config)
+from .supervisor import Supervisor
+from ..observability.trace import trace_span
+from ..resilience.fault_injection import SITE_LATEST_PUBLISH, maybe_fire
+from ..resilience.integrity import (LATEST_FILE, commit_pod_manifest,
+                                    verify_pod_checkpoint_dir,
+                                    write_host_manifest)
+from ..utils.logging import log_dist, logger
+
+# a healthy slice below the elastic plan's floor cannot run the job at the
+# planned batch — permanent until hosts come back; distinct from
+# RC_POD_PEER_LOST (87, transient membership loss) and RC_HANG (85)
+RC_POD_UNRECOVERABLE = 86
+
+
+class PodPeerLost(RuntimeError):
+    """Raised inside the step loop when the heartbeat watchdog declared a
+    peer dead: the round must exit (code :data:`RC_POD_PEER_LOST`) so the
+    supervisor can re-form at the healthy slice."""
+
+    def __init__(self, host: str):
+        super().__init__(f"pod peer {host!r} declared dead by lease; "
+                         f"exiting round for re-formation")
+        self.host = host
+
+
+# --------------------------------------------------------- pod-scope commit
+
+def save_pod_checkpoint(engine, save_dir: str, ctx: "PodContext",
+                        tag: Optional[str] = None,
+                        client_state: Optional[dict] = None) -> str:
+    """One pod-scope checkpoint from this host's perspective.
+
+    In a real multi-host job every host calls this collectively (the orbax
+    save inside ``engine.save_checkpoint`` already coordinates shard
+    writes); on a simulated pod only the coordinator holds an engine and
+    peers pass ``engine=None``, exercising just the commit protocol.
+
+    Order per host: engine save (``save_latest=False`` — the pointer must
+    not move before the POD commit) -> this host's extra shard files
+    (``ctx.shard_writer``) -> per-host manifest (the ``ckpt.shard_commit``
+    fault unit).  Coordinator then: wait for every host manifest of this
+    generation, publish ``pod_manifest.json``, and only then ``latest``.
+    """
+    if tag is None:
+        if engine is None:
+            raise ValueError("peers without an engine must be given the tag")
+        tag = f"global_step{engine.global_steps}"
+    ckpt_dir = os.path.join(save_dir, str(tag))
+    with trace_span("ckpt.pod_save", tag=str(tag), host=ctx.host_id,
+                    generation=ctx.generation):
+        if engine is not None:
+            engine.save_checkpoint(save_dir, tag=tag,
+                                   client_state=client_state,
+                                   save_latest=False)
+            wait = getattr(engine, "wait_for_checkpoint", None)
+            if wait is not None:
+                wait()   # the host manifest must list DURABLE files
+        os.makedirs(ckpt_dir, exist_ok=True)
+        if ctx.is_coordinator:
+            # announce the pending commit through the store, scoped by
+            # generation: host-side shard writers key on THIS record (never
+            # on directory names, which recur across rounds — a re-saved
+            # step after a torn tag's quarantine reuses the tag name)
+            ctx.store.put(f"commit/gen{ctx.generation}",
+                          {"tag": str(tag), "t": ctx.store.now()})
+        shard_files: List[str] = []
+        if ctx.shard_writer is not None:
+            shard_files = list(ctx.shard_writer(ckpt_dir, ctx.host_id))
+        step = int(engine.global_steps) if engine is not None else -1
+        write_host_manifest(ckpt_dir, ctx.host_id, ctx.generation, step,
+                            files=shard_files)
+        if ctx.is_coordinator:
+            commit_pod_manifest(ckpt_dir, ctx.generation,
+                                expected_hosts=ctx.hosts,
+                                timeout_s=ctx.commit_timeout_s)
+            maybe_fire(SITE_LATEST_PUBLISH, path=save_dir, tag=str(tag))
+            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+                f.write(str(tag))
+            log_dist(f"pod checkpoint {tag} committed by all "
+                     f"{len(ctx.hosts)} host(s) of generation "
+                     f"{ctx.generation} -> {ckpt_dir}", ranks=[0])
+    return ckpt_dir
+
+
+def pending_commit(store: CoordinationStore,
+                   generation: int) -> Optional[str]:
+    """The tag the coordinator most recently announced for commit under
+    ``generation`` (None before the first save of the round).  Host-side
+    shard writers poll this instead of scanning tag directories."""
+    doc = store.get(f"commit/gen{generation}")
+    return str(doc["tag"]) if doc else None
+
+
+@dataclasses.dataclass
+class PodContext:
+    """One host's view of the pod for one generation."""
+    store: CoordinationStore
+    host_id: str
+    hosts: List[str]                  # sorted membership of this generation
+    generation: int
+    lease_s: float = 5.0
+    miss_limit: int = 3
+    commit_timeout_s: float = 120.0
+    # optional extra shard files a host contributes to the tag before its
+    # manifest lands: fn(ckpt_dir, host_id) -> [relative paths].  Real jobs
+    # leave it None (orbax wrote the shards inside the engine save);
+    # simulated pods use it so torn-checkpoint coverage has real files.
+    shard_writer: Optional[Callable[[str, str], Sequence[str]]] = None
+
+    @property
+    def is_coordinator(self) -> bool:
+        return bool(self.hosts) and self.host_id == self.hosts[0]
+
+    @property
+    def rank(self) -> int:
+        return self.hosts.index(self.host_id)
+
+
+class PodElasticAgent(ElasticAgent):
+    """Elastic agent whose commit and restore are pod-scope.
+
+    Saves run the all-hosts commit protocol; the restore walk additionally
+    requires :func:`~..resilience.integrity.verify_pod_checkpoint_dir` to
+    pass, so a torn pod tag (one host's shard/manifest missing) is
+    quarantined and the walk falls back a generation — across pod sizes,
+    since nothing in the tag binds it to a world size (global-array orbax
+    payloads plus per-host attestations).
+
+    With a ``watchdog`` (:class:`~.coordination.HeartbeatWatchdog`), the
+    step loop raises :class:`PodPeerLost` as soon as a peer is declared
+    dead, so this host exits the round at a step boundary instead of
+    wedging in the next collective.
+    """
+
+    def __init__(self, engine, ckpt_dir: str, ctx: PodContext,
+                 watchdog: Optional[HeartbeatWatchdog] = None, **kw):
+        super().__init__(engine, ckpt_dir, **kw)
+        self.ctx = ctx
+        self.watchdog = watchdog
+
+    def _save(self) -> None:
+        save_pod_checkpoint(self.engine, self.ckpt_dir, self.ctx,
+                            tag=self.tag)
+
+    def _pre_load_verify(self, tag_dir: str) -> None:
+        verify_pod_checkpoint_dir(tag_dir)
+
+    def _tag_committed(self, tag_dir: str) -> bool:
+        from ..resilience.integrity import pod_committed
+
+        return super()._tag_committed(tag_dir) and pod_committed(tag_dir)
+
+    def restore_if_present(self) -> int:
+        self._sweep_torn_pod_tags()
+        return super().restore_if_present()
+
+    def _sweep_torn_pod_tags(self) -> None:
+        """Quarantine every tag that never pod-committed BEFORE the walk.
+        The base walk only quarantines tags it visits, and a torn pod tag
+        can sit AHEAD of ``latest`` (its writer died before the pointer
+        moved) where the walk never reaches it — but a later save of the
+        same step would silently mix generations into it.  Coordinator
+        only: one renamer per pod, same as the base agent's process-0
+        rule.  No pod save is in flight at restore time (pod saves join
+        their commit before returning), so every uncommitted tag here is
+        genuinely torn."""
+        if not self.ctx.is_coordinator or not os.path.isdir(self.ckpt_dir):
+            return
+        from ..resilience.integrity import (candidate_tags, pod_committed,
+                                            quarantine_tag)
+
+        for tag in candidate_tags(self.ckpt_dir):
+            tag_dir = os.path.join(self.ckpt_dir, tag)
+            if pod_committed(tag_dir):
+                continue
+            logger.error(
+                "pod restore: tag %s has no pod manifest (a host died "
+                "before its shard committed); quarantining the torn pod "
+                "checkpoint", tag_dir)
+            try:
+                quarantine_tag(self.ckpt_dir, tag)
+            except OSError as e:
+                logger.error("pod restore: quarantine of %s failed (%s); "
+                             "skipping", tag_dir, e)
+
+    def run(self, train_step_fn: Callable, total_steps: int) -> int:
+        def stepped(engine, step):
+            if self.watchdog is not None and self.watchdog.dead:
+                raise PodPeerLost(self.watchdog.dead[0])
+            out = train_step_fn(engine, step)
+            if self.watchdog is not None:
+                # progress rides the lease so peers + supervisor can watch
+                self.watchdog.set_attrs(step=step + 1)
+            return out
+
+        return super().run(stepped, total_steps)
+
+
+# ------------------------------------------------------- shrink-to-healthy
+
+def shrink_to_healthy(elastic_config, healthy_hosts: Sequence[str],
+                      chips_per_host: int = 1,
+                      model_parallel_size: int = 1
+                      ) -> Tuple[List[str], ElasticPlan]:
+    """The largest slice the elastic plan admits within the healthy hosts.
+
+    Device counts come from the same :func:`compute_elastic_config` plan
+    the runtime binds to, so the shrunken job trains the SAME global batch
+    with a re-derived (micro, gradient-accumulation) pair.  Raises
+    :class:`ElasticityIncompatibleWorldSize` when even the smallest valid
+    count needs more hosts than are healthy.
+    """
+    healthy = sorted(healthy_hosts)
+    plan0 = compute_elastic_config(elastic_config, 0, chips_per_host,
+                                   model_parallel_size)
+    avail_devices = len(healthy) * chips_per_host
+    fits = [c for c in plan0.valid_device_counts if c <= avail_devices]
+    if not fits:
+        raise ElasticityIncompatibleWorldSize(
+            f"{len(healthy)} healthy host(s) x {chips_per_host} chip(s) = "
+            f"{avail_devices} devices cannot run any elastic-compatible "
+            f"count {list(plan0.valid_device_counts)}")
+    best = max(fits)
+    n_hosts = -(-best // chips_per_host)   # ceil
+    plan = compute_elastic_config(elastic_config, best, chips_per_host,
+                                  model_parallel_size)
+    return healthy[:n_hosts], plan
+
+
+@dataclasses.dataclass(frozen=True)
+class PodRound:
+    """What one supervisor round hands the attempt: the generation it must
+    heartbeat/rendezvous/commit under, the member hosts (coordinator
+    first), and the batch triad the shrunken world trains with."""
+    generation: int
+    hosts: Tuple[str, ...]
+    plan: ElasticPlan
+
+    @property
+    def n_hosts(self) -> int:
+        return len(self.hosts)
+
+
+class PodSupervisor(Supervisor):
+    """Round-based pod re-formation on top of the hardened Supervisor.
+
+    ``attempt(round: PodRound) -> int`` runs one full training round at the
+    round's membership (launch/fan-out, rendezvous, heartbeats, pod
+    checkpoints) and returns the job's exit code.  Before every round the
+    supervisor re-reads the coordination store's dead-host markers (written
+    by whichever peer's :class:`~.coordination.HeartbeatWatchdog` detected
+    the miss), re-plans via :func:`shrink_to_healthy`, and bumps the pod
+    generation — so a round after a host loss automatically re-forms at
+    the largest healthy slice with the plan's batch triad, and a stale
+    host from the previous incarnation can never rendezvous into it
+    (records are generation-keyed).
+
+    Exit semantics: :data:`RC_POD_PEER_LOST` is an ordinary failed round
+    (the designed shrink path — backoff, budget, progress accounting all
+    apply); an unshrinkable pod returns :data:`RC_POD_UNRECOVERABLE`,
+    which is terminal.
+    """
+
+    def __init__(self, store: CoordinationStore, elastic_config,
+                 attempt: Callable[[PodRound], int], hosts: Sequence[str],
+                 chips_per_host: int = 1, model_parallel_size: int = 1,
+                 monitor=None, **supervisor_kw):
+        self.store = store
+        self.elastic_config = elastic_config
+        self.pod_attempt = attempt
+        self.all_hosts = sorted(hosts)
+        self.chips_per_host = int(chips_per_host)
+        self.model_parallel_size = int(model_parallel_size)
+        self.rounds: List[PodRound] = []
+        supervisor_kw.setdefault("terminal_rcs", (RC_POD_UNRECOVERABLE,))
+        super().__init__(self._pod_round, monitor=monitor, **supervisor_kw)
+
+    def healthy_hosts(self) -> List[str]:
+        dead = set(dead_set(self.store))
+        return [h for h in self.all_hosts if h not in dead]
+
+    def _pod_round(self, _restarts: int) -> int:
+        healthy = self.healthy_hosts()
+        try:
+            members, plan = shrink_to_healthy(
+                self.elastic_config, healthy, self.chips_per_host,
+                self.model_parallel_size)
+        except ElasticityIncompatibleWorldSize as e:
+            self.diagnosis = (
+                f"pod unrecoverable: {e} — waiting for replacement hosts "
+                "will not help this supervisor; clear the dead-host markers "
+                "once capacity returns and relaunch")
+            logger.error("pod supervisor: %s", self.diagnosis)
+            return RC_POD_UNRECOVERABLE
+        gen = bump_generation(self.store)
+        rnd = PodRound(generation=gen, hosts=tuple(members), plan=plan)
+        self.rounds.append(rnd)
+        if len(members) < len(self.all_hosts):
+            logger.warning(
+                "pod supervisor: generation %d re-forms at %d/%d host(s) "
+                "(dead: %s) with batch triad %s", gen, len(members),
+                len(self.all_hosts),
+                sorted(set(self.all_hosts) - set(members)), plan.as_triad())
+        if self.monitor is not None:
+            self.monitor.write_events([
+                ("pod/generation", float(gen), gen),
+                ("pod/round_hosts", float(len(members)), gen),
+                ("pod/dead_hosts",
+                 float(len(self.all_hosts) - len(healthy)), gen)])
+        with trace_span("pod.round", generation=gen, hosts=len(members)):
+            return self.pod_attempt(rnd)

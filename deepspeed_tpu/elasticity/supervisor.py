@@ -31,7 +31,7 @@ from __future__ import annotations
 
 import time
 from random import Random
-from typing import Callable, Optional
+from typing import Callable, Optional, Sequence
 
 from ..resilience.fault_injection import SITE_SUPERVISOR_ATTEMPT, maybe_fire
 from ..utils.logging import logger
@@ -67,8 +67,13 @@ class Supervisor:
                  backoff_mult: float = 2.0, backoff_max_s: float = 60.0,
                  jitter: float = 0.25,
                  progress_fn: Optional[Callable[[], int]] = None,
-                 zero_progress_limit: int = 0, seed: int = 0, monitor=None):
+                 zero_progress_limit: int = 0, seed: int = 0, monitor=None,
+                 terminal_rcs: Sequence[int] = ()):
         self.attempt = attempt
+        # exit codes that are PERMANENT no matter the budget (e.g. the pod
+        # supervisor's "healthy slice below the elastic floor") — relaunching
+        # cannot change them, so retrying only burns the backoff schedule
+        self.terminal_rcs = frozenset(terminal_rcs)
         self.max_restarts = max_restarts
         self.backoff_s = backoff_s
         self.on_round = on_round
@@ -122,6 +127,13 @@ class Supervisor:
                 return 0
             if rc == RC_INTERRUPT:
                 logger.info("elastic supervisor: interrupted; not restarting")
+                return rc
+            if rc in self.terminal_rcs:
+                if self.diagnosis is None:
+                    self.diagnosis = (f"terminal exit code {rc}: the failure "
+                                      "is permanent by contract; not "
+                                      "relaunching")
+                logger.error("elastic supervisor: %s", self.diagnosis)
                 return rc
             consecutive += 1
             # failed round: capture the attempt's span history before the

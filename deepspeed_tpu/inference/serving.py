@@ -121,6 +121,12 @@ class Request:
     eos_token_id: Optional[int] = None
     arrival_time: float = 0.0
     deadline_s: Optional[float] = None
+    # absolute time.monotonic() stamp of when the request FIRST became
+    # available, stamped by ServingSupervisor._rebase across a warm restart
+    # (None = derive from this engine's clock).  Keeps queued-age gauges,
+    # arrival_s/ttft_s stamps and retry hints anchored to the true arrival
+    # instead of the replacement engine's reset clock (docs/SERVING.md).
+    arrival_epoch_s: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -187,7 +193,8 @@ class ServingEngine:
                  page_size: int = PAGE_SIZE, num_pages: Optional[int] = None,
                  max_model_len: Optional[int] = None, monitor=None,
                  watchdog=None, dtype=None, mesh=None,
-                 max_queue: Optional[int] = None, quarantine_limit: int = 2):
+                 max_queue: Optional[int] = None, quarantine_limit: int = 2,
+                 probe_after_ticks: Optional[int] = None):
         if not hasattr(model, "apply_paged"):
             raise ValueError(
                 "ServingEngine needs a model with the paged decode contract "
@@ -268,6 +275,21 @@ class ServingEngine:
         self._quarantined = np.zeros((self.b_slots,), bool)
         self._quarantined_pages: List[int] = []   # leaked-and-accounted
         self._slot_failures = np.zeros((self.b_slots,), np.int64)
+        # background probe/unfence: after `probe_after_ticks` clean ticks
+        # (no slot-attributable failure anywhere on the fleet) a fenced
+        # slot gets ONE canary prefill; success restores the slot AND its
+        # quarantined pages.  None = fenced slots only recover via a full
+        # engine rebuild (the pre-probe behavior).
+        self.probe_after_ticks = (int(probe_after_ticks)
+                                  if probe_after_ticks is not None else None)
+        if self.probe_after_ticks is not None and self.probe_after_ticks < 1:
+            raise ValueError(
+                f"probe_after_ticks={self.probe_after_ticks} must be >= 1")
+        self._quarantine_pages_by_slot: Dict[int, List[int]] = {}
+        self._fence_tick: Dict[int, int] = {}
+        self._last_failure_tick = 0
+        self.probe_count = 0
+        self.unfence_count = 0
         self._draining = False
         # deadline-bearing requests currently waiting (queue + pending):
         # lets _expire skip its O(backlog) queue scan entirely in the
@@ -331,6 +353,16 @@ class ServingEngine:
     def _pages_needed(self, req: Request) -> int:
         return -(-(len(req.input_ids) + req.max_new_tokens) // self.page_size)
 
+    def _arrival_abs(self, req: Request) -> float:
+        """Absolute arrival stamp: the rebased epoch when the request rode
+        across a warm restart, else this engine's clock.  Everything
+        REPORTING an arrival (gauges, RequestResult stamps) reads this;
+        admission gating and deadline expiry stay on the engine-relative
+        ``arrival_time``/``deadline_s`` pair the supervisor rebases."""
+        if req.arrival_epoch_s is not None:
+            return req.arrival_epoch_s
+        return self._t0 + req.arrival_time
+
     def _usable_slots(self) -> int:
         return int(self.b_slots - self._quarantined.sum())
 
@@ -381,7 +413,7 @@ class ServingEngine:
                         rid=req.rid, input_ids=req.input_ids,
                         output_ids=np.zeros((0,), np.int32),
                         finish_reason="deadline", prefill_bucket=0,
-                        arrival_s=self._t0 + req.arrival_time, admit_s=t,
+                        arrival_s=self._arrival_abs(req), admit_s=t,
                         first_token_s=t, finish_s=t,
                         retry_after_s=self._retry_after_hint())
                     self._finished_order.append(req.rid)
@@ -508,11 +540,16 @@ class ServingEngine:
                     self._free_pages.extend(pages)
                     raise
                 self._slot_failures[slot] += 1
+                self._last_failure_tick = self._tick
                 fails = int(self._slot_failures[slot])
                 fenced = fails >= self.quarantine_limit
                 if fenced:
                     self._quarantined[slot] = True
                     self._quarantined_pages.extend(pages)
+                    # remembered per slot so a later successful canary
+                    # probe can hand exactly these pages back to the pool
+                    self._quarantine_pages_by_slot[slot] = list(pages)
+                    self._fence_tick[slot] = self._tick
                     logger.error(
                         "serve: slot %d quarantined after %d consecutive "
                         "prefill failures; %d page(s) leaked-and-"
@@ -553,7 +590,7 @@ class ServingEngine:
         self._slot_failures[slot] = 0   # quarantine counts CONSECUTIVE fails
         self._slots[slot] = _Slot(
             request=req, pages=pages, tokens=[tok], bucket=s_pad,
-            arrival_s=self._t0 + req.arrival_time, admit_s=self._t0 + now,
+            arrival_s=self._arrival_abs(req), admit_s=self._t0 + now,
             first_token_s=t)
         self._lengths[slot] = S
         self._last_tok[slot] = tok
@@ -561,8 +598,7 @@ class ServingEngine:
         self._tokens_out += 1
         if self.monitor is not None:
             self.monitor.write_events([
-                ("serve/ttft_s", t - (self._t0 + req.arrival_time),
-                 self._tick)])
+                ("serve/ttft_s", t - self._arrival_abs(req), self._tick)])
         if req.eos_token_id is not None and tok == req.eos_token_id:
             self._finish(slot, "eos")
         elif req.max_new_tokens == 1:
@@ -629,6 +665,81 @@ class ServingEngine:
         self._last_tok[slot] = 0
         self._page_table[slot, :] = 0
 
+    # ----------------------------------------------------- probe / unfence
+
+    def _probe_quarantined(self) -> None:
+        """Background unfence path: for each fenced slot, once
+        ``probe_after_ticks`` ticks have passed with no slot-attributable
+        failure anywhere (clean ticks — a fleet still throwing faults must
+        not be probed into), run one canary prefill on the slot.  Success
+        restores the slot and returns its quarantined pages to the free
+        pool (free + quarantined == pool stays exact); failure re-fences
+        and restarts the clean-tick clock."""
+        for slot in np.flatnonzero(self._quarantined):
+            slot = int(slot)
+            since = self._tick - max(self._fence_tick.get(slot, 0),
+                                     self._last_failure_tick)
+            if since >= self.probe_after_ticks:
+                self._probe_slot(slot)
+
+    def _probe_slot(self, slot: int) -> None:
+        pages = self._quarantine_pages_by_slot.get(slot)
+        if not pages:
+            return   # fenced without a page record (defensive): stay fenced
+        self.probe_count += 1
+        s_pad = _bucket(1)
+        prog = self._prefill_progs.get(s_pad)
+        if prog is None:
+            prog = self._prefill_progs[s_pad] = self._build_prefill(s_pad)
+        # one-token canary through the slot's own quarantined pages: the
+        # same program shape real admissions use, against the same page row
+        toks = np.zeros((1, s_pad), np.int32)
+        self._page_table[slot, :] = 0
+        self._page_table[slot, :len(pages)] = pages
+        try:
+            with trace_span("serve.probe", slot=slot):
+                maybe_fire(SITE_SERVE_PREFILL, rid="__canary__", slot=slot)
+                with self._armed(f"serve.probe slot={slot}"):
+                    nxt, self._kpool, self._vpool = prog(
+                        self.params, self._kpool, self._vpool,
+                        jnp.asarray(self._page_table[slot:slot + 1]),
+                        jnp.asarray(toks), jnp.int32(1))
+                    int(nxt)   # host fetch: the probe must really complete
+        except BaseException as e:
+            self._page_table[slot, :] = 0
+            self._fence_tick[slot] = self._tick
+            self._last_failure_tick = self._tick
+            if not isinstance(e, Exception):
+                raise   # operator interrupt, not a probe verdict
+            logger.warning(
+                "serve: canary probe of quarantined slot %d failed "
+                "(%s: %s); slot stays fenced", slot, type(e).__name__, e)
+            if not self.pool_alive():
+                # with donation enabled the failed probe ALSO consumed the
+                # pool: abort THIS tick — letting it continue into _admit
+                # would feed deleted arrays to a healthy slot's prefill and
+                # misattribute the failure to it.  The supervisor rebuilds,
+                # the right escalation for a fault that still reproduces
+                # after probe_after_ticks.
+                raise PoolConsumedError(
+                    f"KV pool consumed by the failed canary probe of "
+                    f"quarantined slot {slot}; rebuild the engine "
+                    "(ServingSupervisor automates this)") from e
+            return
+        self._page_table[slot, :] = 0
+        self._quarantined[slot] = False
+        self._slot_failures[slot] = 0
+        self._fence_tick.pop(slot, None)
+        self._quarantine_pages_by_slot.pop(slot, None)
+        for p in pages:
+            self._quarantined_pages.remove(p)
+        self._free_pages.extend(pages)
+        self.unfence_count += 1
+        logger.info(
+            "serve: slot %d passed its canary probe after quarantine; "
+            "restored with %d page(s) (%d slot(s) usable)", slot,
+            len(pages), self._usable_slots())
+
     # ------------------------------------------------------------ the loop
 
     def pool_alive(self) -> bool:
@@ -657,6 +768,9 @@ class ServingEngine:
             if now is None:
                 now = time.monotonic() - self._t0
             self._expire(now)
+            if (self.probe_after_ticks is not None and not self._draining
+                    and self._quarantined.any()):
+                self._probe_quarantined()
             if not self._draining:
                 self._admit(now)
             if self._active.any():
@@ -760,9 +874,9 @@ class ServingEngine:
         queue is FIFO (head oldest) and ``_pending`` is sorted by arrival."""
         arrivals = [st.arrival_s for st in self._slots if st is not None]
         if self._queue:
-            arrivals.append(self._t0 + self._queue[0].arrival_time)
+            arrivals.append(self._arrival_abs(self._queue[0]))
         if self._pending:
-            arrivals.append(self._t0 + self._pending[0].arrival_time)
+            arrivals.append(self._arrival_abs(self._pending[0]))
         return max(0.0, now_abs - min(arrivals)) if arrivals else 0.0
 
     def health(self) -> Dict[str, Any]:
@@ -782,6 +896,8 @@ class ServingEngine:
             "quarantined_pages": len(self._quarantined_pages),
             "shed_total": self.shed_count,
             "deadline_expired_total": self.deadline_count,
+            "probes_total": self.probe_count,
+            "unfenced_total": self.unfence_count,
             "oldest_request_age_s": round(self._oldest_age_s(now), 4),
             "retry_after_hint_s": self._retry_after_hint(),
             "unclaimed_results": len(self._finished_order),
@@ -830,6 +946,8 @@ class ServingEngine:
              self._tick),
             ("serve/quarantined_pages", float(len(self._quarantined_pages)),
              self._tick),
+            ("serve/probes_total", float(self.probe_count), self._tick),
+            ("serve/unfenced_total", float(self.unfence_count), self._tick),
             ("serve/oldest_request_age_s",
              self._oldest_age_s(time.monotonic()), self._tick),
         ])

@@ -89,6 +89,8 @@ class ServingSupervisor:
         # the supervisor)
         self._shed_base = 0
         self._deadline_base = 0
+        self._probe_base = 0
+        self._unfence_base = 0
         self._quarantined_slots_lifetime = 0
         self._quarantined_pages_lifetime = 0
         # rid -> original request (result stitching + drain hand-off)
@@ -216,6 +218,8 @@ class ServingSupervisor:
         h = self.engine.health()
         h["shed_total"] += self._shed_base
         h["deadline_expired_total"] += self._deadline_base
+        h["probes_total"] += self._probe_base
+        h["unfenced_total"] += self._unfence_base
         h["quarantined_slots_lifetime"] = (self._quarantined_slots_lifetime
                                            + h["quarantined_slots"])
         h["quarantined_pages_lifetime"] = (self._quarantined_pages_lifetime
@@ -318,19 +322,27 @@ class ServingSupervisor:
         inflight = sorted((st for st in old._slots if st is not None),
                           key=lambda st: st.admit_s)
         elapsed = time.monotonic() - old._t0
-        waiting = [self._rebase(r, elapsed) for r in old._queue]
+        waiting = [self._rebase(r, elapsed, old._t0) for r in old._queue]
+        # pending requests whose arrival offset already elapsed (the crash
+        # beat the _admit that would have promoted them) have ARRIVED just
+        # like the queue — rebase them too so their epoch survives; only
+        # genuinely future arrivals keep their remaining offset
         waiting.extend(
-            dataclasses.replace(r, arrival_time=max(
-                0.0, r.arrival_time - elapsed))
+            self._rebase(r, elapsed, old._t0) if r.arrival_time <= elapsed
+            else dataclasses.replace(r, arrival_time=r.arrival_time - elapsed)
             for r in old._pending)
         # (3) the replay fault site fires BEFORE any state is mutated, so a
         # raise here leaves the dead engine intact for the retried restart
         for st in inflight:
             maybe_fire(SITE_SERVE_REPLAY, rid=st.request.rid,
                        generated=len(st.tokens))
-        # (4) fresh pool, warm programs
+        # (4) fresh pool, warm programs.  The observed-service-time EMA
+        # rides along so the very first retry_after_s hints out of the
+        # replacement engine reflect reality, not the cold-start floor.
         new = self.engine_factory()
         reused = self._adopt_programs(new, old)
+        if old._ema_service_s is not None and new._ema_service_s is None:
+            new._ema_service_s = old._ema_service_s
         # (5) replay.  Admission control is suspended: a request the old
         # engine already accepted must never be shed by its own recovery.
         saved_max_queue, new.max_queue = new.max_queue, None
@@ -339,7 +351,7 @@ class ServingSupervisor:
             for st in inflight:
                 req = st.request
                 replay = dataclasses.replace(
-                    self._rebase(req, elapsed),
+                    self._rebase(req, elapsed, old._t0),
                     input_ids=np.concatenate(
                         [req.input_ids, np.asarray(st.tokens, np.int32)]),
                     max_new_tokens=req.max_new_tokens - len(st.tokens))
@@ -358,6 +370,8 @@ class ServingSupervisor:
             self._replay_count[rid] = self._replay_count.get(rid, 0) + 1
         self._shed_base += old.shed_count
         self._deadline_base += old.deadline_count
+        self._probe_base += old.probe_count
+        self._unfence_base += old.unfence_count
         self._quarantined_slots_lifetime += int(old._quarantined.sum())
         self._quarantined_pages_lifetime += len(old._quarantined_pages)
         self.engine = new
@@ -380,17 +394,25 @@ class ServingSupervisor:
             f"programs {'reused' if reused else 'rebuilt'}", ranks=[0])
 
     @staticmethod
-    def _rebase(req: Request, elapsed: float) -> Request:
+    def _rebase(req: Request, elapsed: float, t0: float) -> Request:
         """An already-arrived request re-anchored to the new engine's
         clock: arrival becomes 0, and a deadline keeps only its remaining
         budget (floored at an epsilon so an already-expired request still
-        flows through the normal expiry path to a terminal result)."""
+        flows through the normal expiry path to a terminal result).  The
+        ORIGINAL arrival is preserved as ``arrival_epoch_s`` so queued-age
+        gauges, ``arrival_s``/``ttft_s`` stamps and retry hints keep
+        referencing the true arrival rather than the replacement engine's
+        reset clock (docs/SERVING.md)."""
         deadline = req.deadline_s
         if deadline is not None:
             deadline = max(1e-6, deadline
                            - max(0.0, elapsed - req.arrival_time))
+        epoch = req.arrival_epoch_s
+        if epoch is None:
+            epoch = t0 + max(0.0, req.arrival_time)
         return dataclasses.replace(req, arrival_time=0.0,
-                                   deadline_s=deadline)
+                                   deadline_s=deadline,
+                                   arrival_epoch_s=epoch)
 
     @staticmethod
     def _adopt_programs(new: ServingEngine, old: ServingEngine) -> bool:

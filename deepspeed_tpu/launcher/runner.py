@@ -101,6 +101,20 @@ def parse_args(args=None):
                    help="checkpoint dir the training script writes; lets "
                         "the supervisor track committed-step progress so "
                         "productive restarts refresh the restart budget")
+    p.add_argument("--pod_coord_dir", default="",
+                   help="pod coordination store root (storage every host "
+                        "mounts, e.g. next to the checkpoint dir): enables "
+                        "pod-level fault tolerance — heartbeat leases, "
+                        "dead-host exclusion, and a monotonically bumped "
+                        "pod generation exported to every round as "
+                        "DS_TPU_POD_GENERATION (docs/POD.md)")
+    p.add_argument("--pod_lease", type=float, default=5.0,
+                   help="heartbeat lease period in seconds (hosts renew at "
+                        "lease/3; a host is dead after pod_miss_limit "
+                        "missed leases)")
+    p.add_argument("--pod_miss_limit", type=int, default=3,
+                   help="missed leases before a host is declared dead and "
+                        "peers exit 87 for pod re-formation")
     p.add_argument("--force_multi", action="store_true",
                    help="use the multinode path even for a single local host")
     p.add_argument("user_script", help="training script (or module with --module)")
@@ -342,18 +356,94 @@ def main(args=None) -> int:
 
         progress_fn = None
         if args.elastic_ckpt_dir:
-            from ..resilience import checkpoint_progress_fn
+            if args.pod_coord_dir:
+                # pod mode: only ALL-HOSTS-committed tags count as progress
+                # (a host-committed tag without a pod manifest is exactly
+                # the state the restore path rejects)
+                from ..resilience import pod_checkpoint_progress_fn
 
-            progress_fn = checkpoint_progress_fn(args.elastic_ckpt_dir)
+                progress_fn = pod_checkpoint_progress_fn(args.elastic_ckpt_dir)
+            else:
+                from ..resilience import checkpoint_progress_fn
+
+                progress_fn = checkpoint_progress_fn(args.elastic_ckpt_dir)
         # every attempt re-runs _dispatch, i.e. re-reads the hostfile /
         # re-discovers the pod — a resized slice relaunches at its new size
-        return Supervisor(lambda _round: _dispatch(args),
+        attempt = (_pod_attempt(args) if args.pod_coord_dir
+                   else lambda _round: _dispatch(args))
+        terminal_rcs = ()
+        if args.pod_coord_dir:
+            # exit 86 = healthy slice below the elastic floor: permanent by
+            # contract (pod_agent.RC_POD_UNRECOVERABLE) — relaunching only
+            # burns the backoff schedule and bumps generations pointlessly
+            from ..elasticity.pod_agent import RC_POD_UNRECOVERABLE
+
+            terminal_rcs = (RC_POD_UNRECOVERABLE,)
+        return Supervisor(attempt,
                           max_restarts=args.elastic_restarts,
                           backoff_s=args.elastic_backoff,
                           backoff_max_s=args.elastic_backoff_max,
                           progress_fn=progress_fn,
-                          zero_progress_limit=args.elastic_zero_progress).run()
+                          zero_progress_limit=args.elastic_zero_progress,
+                          terminal_rcs=terminal_rcs).run()
     return _dispatch(args)
+
+
+def _pod_attempt(args):
+    """Pod-aware round wrapper: every relaunch bumps the pod generation in
+    the coordination store and exports the membership epoch + heartbeat
+    contract to the children (docs/POD.md) — training scripts build their
+    HeartbeatWatchdog / PodContext from these."""
+    from ..elasticity.coordination import FileCoordinationStore, bump_generation
+
+    store = FileCoordinationStore(args.pod_coord_dir)
+
+    def attempt(_round: int) -> int:
+        gen = bump_generation(store)
+        os.environ["DS_TPU_POD_GENERATION"] = str(gen)
+        os.environ["DS_TPU_POD_COORD_DIR"] = args.pod_coord_dir
+        os.environ["DS_TPU_POD_LEASE"] = str(args.pod_lease)
+        os.environ["DS_TPU_POD_MISS_LIMIT"] = str(args.pod_miss_limit)
+        logger.info("launcher: pod generation %d (coordination store %s)",
+                    gen, args.pod_coord_dir)
+        return _dispatch(args)
+
+    return attempt
+
+
+def _shrink_to_admitted(active: "OrderedDict[str, List[int]]"
+                        ) -> "OrderedDict[str, List[int]]":
+    """Pod mode: when the scheduler snapshotted the elastic envelope
+    (``DEEPSPEED_ELASTICITY_CONFIG``), trim the healthy pool to the largest
+    host count the plan admits BEFORE launching — otherwise an inadmissible
+    count (e.g. 3 healthy of a {1,2,4} plan) makes every child fail
+    ``ElasticityIncompatibleWorldSize`` and the supervisor crash-loops the
+    identical launch.  Without the env var the pool is launched as-is (the
+    training script owns the config and the in-job PodSupervisor path does
+    its own shrink)."""
+    raw = os.environ.get("DEEPSPEED_ELASTICITY_CONFIG")
+    if not raw or len(active) <= 1:
+        return active
+    try:
+        from ..elasticity.pod_agent import shrink_to_healthy
+        from ..runtime.config import ElasticityConfig
+
+        members, plan = shrink_to_healthy(ElasticityConfig(**json.loads(raw)),
+                                          list(active))
+    except Exception as e:
+        logger.warning("launcher: DEEPSPEED_ELASTICITY_CONFIG unusable for "
+                       "pool shrinking (%s: %s); launching every healthy "
+                       "host", type(e).__name__, e)
+        return active
+    if len(members) < len(active):
+        # keep the pool's own ordering (coordinator = first ACTIVE host)
+        kept = list(active)[:len(members)]
+        logger.warning(
+            "launcher: elastic plan admits %d of %d healthy host(s) "
+            "(valid counts %s); launching %s", len(members), len(active),
+            list(plan.valid_device_counts), kept)
+        return OrderedDict((h, active[h]) for h in kept)
+    return active
 
 
 def _dispatch(args) -> int:
@@ -397,11 +487,38 @@ def _dispatch(args) -> int:
                 "host filters given but no hostfile found at "
                 f"{args.hostfile!r} (single-host fallback has no pool)")
         pool = OrderedDict([("localhost", args.num_chips if args.num_chips > 0 else 1)])
+    if args.pod_coord_dir:
+        # shrink-to-healthy at the pool level: hosts a HeartbeatWatchdog
+        # declared dead (durable `dead/<host>` markers) are excluded from
+        # every later round until cleared (elasticity.clear_dead)
+        from ..elasticity.coordination import FileCoordinationStore, dead_set
+
+        dead = set(dead_set(FileCoordinationStore(args.pod_coord_dir)))
+        if dead & set(pool):
+            logger.warning(
+                "launcher: excluding dead host(s) %s from the pool "
+                "(pod coordination store %s)", sorted(dead & set(pool)),
+                args.pod_coord_dir)
+            pool = OrderedDict((h, s) for h, s in pool.items()
+                               if h not in dead)
+            if not pool:
+                # permanent until an operator intervenes: exit with the
+                # terminal code so the supervisor stops instead of burning
+                # the restart budget re-discovering the same dead pool
+                from ..elasticity.pod_agent import RC_POD_UNRECOVERABLE
+
+                logger.error(
+                    "every host in the pool is marked dead in the pod "
+                    "coordination store — clear the markers once capacity "
+                    "returns (elasticity.clear_dead)")
+                return RC_POD_UNRECOVERABLE
     active = parse_resource_filter(pool, args.include, args.exclude)
     if args.num_nodes > 0:
         active = OrderedDict(list(active.items())[:args.num_nodes])
     if args.num_chips > 0:
         active = OrderedDict((h, s[:args.num_chips]) for h, s in active.items())
+    if args.pod_coord_dir:
+        active = _shrink_to_admitted(active)
     if not active:
         raise ValueError("resource filters selected zero hosts")
 
@@ -422,6 +539,22 @@ def _dispatch(args) -> int:
         "NUM_PROCESSES": str(len(hosts)),
         "DS_TPU_WORLD_INFO": encode_world_info(active),
     }
+    if args.pod_coord_dir:
+        # the pod contract must reach REMOTE children too (the supervisor
+        # wrapper only set os.environ on the launcher host).  Without
+        # --elastic_restarts no wrapper bumped the generation: fall back to
+        # the store's current value rather than a silent 0.
+        gen = os.environ.get("DS_TPU_POD_GENERATION")
+        if not gen:
+            from ..elasticity.coordination import (FileCoordinationStore,
+                                                   read_generation)
+
+            gen = str(read_generation(
+                FileCoordinationStore(args.pod_coord_dir)))
+        base_env["DS_TPU_POD_COORD_DIR"] = args.pod_coord_dir
+        base_env["DS_TPU_POD_GENERATION"] = gen
+        base_env["DS_TPU_POD_LEASE"] = str(args.pod_lease)
+        base_env["DS_TPU_POD_MISS_LIMIT"] = str(args.pod_miss_limit)
     if args.launcher == "pod":
         runner = PodRunner(args, active, base_env, pool=pool, info=pod_info)
     elif args.launcher == "slurm":

@@ -24,5 +24,7 @@ from .flight_recorder import (CounterEvent, DEFAULT_CAPACITY,  # noqa: F401
 from .trace import (Span, TRACE_CAPACITY_ENV, TRACE_ENV,  # noqa: F401
                     Tracer, configure_tracer, flight_dump, get_tracer,
                     trace_count, trace_span)
-from .export import (chrome_trace_events, prometheus_text,  # noqa: F401
+from .export import (METRICS_PORT_ENV, MetricsServer,  # noqa: F401
+                     chrome_trace_events, maybe_start_metrics_server,
+                     prometheus_text, start_metrics_server,
                      write_chrome_trace)

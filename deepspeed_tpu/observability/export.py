@@ -145,3 +145,112 @@ def prometheus_text(monitor=None, tracer=None) -> str:
     lines.append(
         f"dstpu_flight_recorder_dropped_total {tracer.recorder.dropped}")
     return "\n".join(lines) + "\n"
+
+
+# ------------------------------------------------------- /metrics endpoint
+
+METRICS_PORT_ENV = "DS_TPU_METRICS_PORT"
+# bind address for the env-gated endpoint; default reaches an external
+# prometheus, set 127.0.0.1 to keep the gauges loopback-only
+METRICS_HOST_ENV = "DS_TPU_METRICS_HOST"
+
+
+class MetricsServer:
+    """Serve :func:`prometheus_text` from a stdlib ``/metrics`` endpoint.
+
+    A daemon-threaded ``ThreadingHTTPServer`` — no dependency beyond the
+    standard library, cheap enough to leave running for the lifetime of a
+    pod host so every scrape sees the live monitor gauges (``serve/*``,
+    ``pod/*``, ``Train/*``) and span aggregates.  The handler renders at
+    request time; the exporters only read under their own locks, so a
+    scrape mid-run is safe.  ``port=0`` binds an ephemeral port (tests),
+    readable on :attr:`port` after construction.
+    """
+
+    def __init__(self, port: int = 0, monitor=None, tracer=None,
+                 host: str = "0.0.0.0"):
+        import http.server
+        import threading
+
+        self.monitor = monitor
+        self.tracer = tracer
+        server = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):          # noqa: N802 (stdlib handler contract)
+                if self.path.split("?")[0] not in ("/metrics", "/"):
+                    self.send_error(404)
+                    return
+                try:
+                    body = prometheus_text(monitor=server.monitor,
+                                           tracer=server.tracer).encode()
+                except Exception as e:   # a scrape must never crash the job
+                    self.send_error(500, str(e))
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):   # scrapes are not log events
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer((host, int(port)),
+                                                      Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="dstpu-metrics", daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+
+_METRICS_SERVER: Optional[MetricsServer] = None
+
+
+def start_metrics_server(port: int = 0, monitor=None,
+                         tracer=None) -> MetricsServer:
+    """Explicitly start a /metrics endpoint (caller owns ``close()``)."""
+    return MetricsServer(port=port, monitor=monitor, tracer=tracer)
+
+
+def maybe_start_metrics_server(monitor=None) -> Optional[MetricsServer]:
+    """Opt-in process-global endpoint: starts once when
+    ``DS_TPU_METRICS_PORT`` is set (``0`` = ephemeral), else ``None``.
+    Later calls return the running server, re-pointing it at the newest
+    ``monitor`` (latest wins: after an in-process engine rebuild the
+    scrape must show the LIVE engine's gauges, not the dead one's) — the
+    engine calls this at init so a pod run is scrapeable with nothing but
+    the env var (docs/OBSERVABILITY.md)."""
+    global _METRICS_SERVER
+    raw = os.environ.get(METRICS_PORT_ENV, "").strip()
+    if not raw:
+        return None
+    if _METRICS_SERVER is not None:
+        if monitor is not None:
+            _METRICS_SERVER.monitor = monitor
+        return _METRICS_SERVER
+    from ..utils.logging import logger
+
+    try:
+        port = int(raw)
+    except ValueError:
+        logger.warning("ignoring malformed $%s=%r (want an int port)",
+                       METRICS_PORT_ENV, raw)
+        return None
+    host = os.environ.get(METRICS_HOST_ENV, "").strip() or "0.0.0.0"
+    try:
+        _METRICS_SERVER = MetricsServer(port=port, monitor=monitor, host=host)
+    except OSError as e:   # port taken: observability never gates the job
+        logger.warning("metrics endpoint on %s:%d unavailable (%s); "
+                       "continuing without", host, port, e)
+        return None
+    logger.info("metrics endpoint serving on %s:%d/metrics", host,
+                _METRICS_SERVER.port)
+    return _METRICS_SERVER

@@ -22,11 +22,14 @@ from .fault_injection import (  # noqa: F401
     SITE_CKPT_LOAD,
     SITE_CKPT_SAVE,
     SITE_LATEST_PUBLISH,
+    SITE_POD_HEARTBEAT,
+    SITE_POD_RENDEZVOUS,
     SITE_SERVE_ADMIT,
     SITE_SERVE_DECODE,
     SITE_SERVE_PREFILL,
     SITE_SERVE_REPLAY,
     SITE_SERVE_TICK,
+    SITE_SHARD_COMMIT,
     SITE_SUPERVISOR_ATTEMPT,
     SITE_TRAIN_STEP,
     clear_injector,
@@ -37,10 +40,19 @@ from .fault_injection import (  # noqa: F401
 from .integrity import (  # noqa: F401
     CheckpointIntegrityError,
     MANIFEST_FILE,
+    POD_MANIFEST_FILE,
+    PodCommitTimeout,
     build_manifest,
     candidate_tags,
+    commit_pod_manifest,
+    pod_checkpoint_progress_fn,
+    pod_committed,
     quarantine_tag,
+    read_host_manifests,
+    read_pod_manifest,
     verify_checkpoint_dir,
+    verify_pod_checkpoint_dir,
+    write_host_manifest,
     write_manifest,
 )
 from .watchdog import HangWatchdog  # noqa: F401

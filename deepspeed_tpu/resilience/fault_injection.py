@@ -25,6 +25,12 @@ Sites wired into the tree:
                           before the decode device call (fleet-wide)
 ``serve.replay``          inside ``ServingSupervisor`` warm restart, before
                           each in-flight request is re-submitted for replay
+``pod.heartbeat``         inside ``coordination.beat`` before a host's lease
+                          is renewed in the coordination store
+``pod.rendezvous``        entry of ``coordination.rendezvous`` (before the
+                          host registers itself for the generation)
+``ckpt.shard_commit``     inside ``write_host_manifest`` before a host's
+                          shard manifest lands (the pod-commit unit)
 ========================  ====================================================
 
 Fault kinds: ``raise`` (raise :class:`InjectedFault`), ``delay`` (sleep
@@ -65,11 +71,15 @@ SITE_SERVE_ADMIT = "serve.admit"
 SITE_SERVE_PREFILL = "serve.prefill"
 SITE_SERVE_DECODE = "serve.decode"
 SITE_SERVE_REPLAY = "serve.replay"
+SITE_POD_HEARTBEAT = "pod.heartbeat"
+SITE_POD_RENDEZVOUS = "pod.rendezvous"
+SITE_SHARD_COMMIT = "ckpt.shard_commit"
 
 SITES = (SITE_CKPT_SAVE, SITE_CKPT_LOAD, SITE_LATEST_PUBLISH,
          SITE_TRAIN_STEP, SITE_SUPERVISOR_ATTEMPT, SITE_SERVE_TICK,
          SITE_SERVE_ADMIT, SITE_SERVE_PREFILL, SITE_SERVE_DECODE,
-         SITE_SERVE_REPLAY)
+         SITE_SERVE_REPLAY, SITE_POD_HEARTBEAT, SITE_POD_RENDEZVOUS,
+         SITE_SHARD_COMMIT)
 KINDS = ("raise", "delay", "corrupt", "sigterm")
 
 FAULTS_ENV = "DS_TPU_FAULTS"

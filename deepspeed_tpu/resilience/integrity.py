@@ -26,6 +26,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import time
 from typing import Dict, List, Optional
 
 from ..utils.logging import logger
@@ -33,6 +34,12 @@ from ..utils.logging import logger
 MANIFEST_FILE = "manifest.json"
 MANIFEST_VERSION = 1
 CORRUPT_SUFFIX = ".corrupt"
+# ---- pod-scope commit (docs/POD.md): each host of a generation writes its
+# shard plus a per-host manifest under host_manifests/; the pod manifest is
+# published only after every host reported — ITS presence is the pod-level
+# commit marker, exactly as manifest.json is the per-host one
+POD_MANIFEST_FILE = "pod_manifest.json"
+HOST_MANIFEST_DIR = "host_manifests"
 # the newest-committed-tag pointer (single source; orbax_engine re-exports)
 LATEST_FILE = "latest"
 # dropped at the start of a save, removed when the manifest lands: its
@@ -123,11 +130,8 @@ def write_manifest(ckpt_dir: str, manifest: Dict) -> str:
             files[name] = {"sha256": _sha256(p), "size": os.path.getsize(p)}
     manifest["files"] = files
     manifest["payload"] = _payload_listing(ckpt_dir)
-    path = os.path.join(ckpt_dir, MANIFEST_FILE)
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(manifest, f, indent=2)
-    os.replace(tmp, path)   # the manifest itself must never be torn
+    # the manifest itself must never be torn
+    path = _atomic_write_json(os.path.join(ckpt_dir, MANIFEST_FILE), manifest)
     marker = os.path.join(ckpt_dir, INCOMPLETE_MARKER)
     if os.path.exists(marker):
         os.remove(marker)   # commit: the tag is now complete AND marked so
@@ -229,6 +233,219 @@ def candidate_tags(save_dir: str) -> List[str]:
             tags.remove(latest)
             tags.insert(0, latest)
     return tags
+
+
+# ------------------------------------------------------- pod-scope commit
+#
+# A POD checkpoint is committed only when every host of the writing
+# generation has durably landed its shard: host k writes its files, then
+# host_manifests/host<k>.json (listing them with sizes + sha256); the
+# coordinator waits for all expected host manifests and only then publishes
+# pod_manifest.json (atomic).  A pod tag without pod_manifest.json is TORN
+# (some host never reported) and must never be restored from — the
+# pod-aware restore walk quarantines it and falls back a generation, the
+# same contract verify_checkpoint_dir enforces per host.
+
+def write_host_manifest(ckpt_dir: str, host_id: str, generation: int,
+                        global_steps: int,
+                        files: Optional[List[str]] = None) -> str:
+    """Land one host's shard manifest: relative ``files`` (the shard files
+    THIS host wrote, already durable) with size + sha256.  Fires the
+    ``ckpt.shard_commit`` fault site before writing — the commit unit chaos
+    tests kill to produce torn pod checkpoints."""
+    from .fault_injection import SITE_SHARD_COMMIT, maybe_fire
+
+    maybe_fire(SITE_SHARD_COMMIT, path=ckpt_dir, host=host_id,
+               generation=generation)
+    listing = {}
+    for rel in files or []:
+        p = os.path.join(ckpt_dir, rel)
+        listing[rel] = {"size": os.path.getsize(p), "sha256": _sha256(p)}
+    doc = {"host_id": str(host_id), "generation": int(generation),
+           "global_steps": int(global_steps), "files": listing}
+    mdir = os.path.join(ckpt_dir, HOST_MANIFEST_DIR)
+    os.makedirs(mdir, exist_ok=True)
+    return _atomic_write_json(os.path.join(mdir, f"host{host_id}.json"), doc)
+
+
+def _atomic_write_json(path: str, doc: Dict) -> str:
+    """The one atomic-JSON-commit idiom every manifest writer shares: dump
+    to a tmp sibling, ``os.replace`` into place — a reader never observes a
+    torn document.  The tmp name carries pid + thread id so concurrent
+    writers (simulated pod hosts are threads) never collide on it."""
+    import threading
+
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2)
+    os.replace(tmp, path)
+    return path
+
+
+def read_host_manifests(ckpt_dir: str, strict: bool = True) -> Dict[str, Dict]:
+    """host_id -> per-host manifest currently present under the tag.
+    ``strict=False`` (the commit poll loop) treats an unreadable manifest as
+    not-yet-present — a peer's ``os.replace`` may be mid-visibility on
+    network storage and the poller will simply see it next round; at
+    verify time unreadable means corrupt and raises."""
+    mdir = os.path.join(ckpt_dir, HOST_MANIFEST_DIR)
+    out: Dict[str, Dict] = {}
+    if not os.path.isdir(mdir):
+        return out
+    for name in sorted(os.listdir(mdir)):
+        if not name.endswith(".json") or ".tmp." in name:
+            continue
+        try:
+            with open(os.path.join(mdir, name)) as f:
+                doc = json.load(f)
+            out[str(doc["host_id"])] = doc
+        except (OSError, ValueError, KeyError) as e:
+            if strict:
+                raise CheckpointIntegrityError(
+                    f"unreadable host manifest {os.path.join(mdir, name)}: "
+                    f"{e}") from e
+            logger.warning("pod commit: host manifest %s unreadable (%s); "
+                           "treating as not yet present", name, e)
+    return out
+
+
+class PodCommitTimeout(RuntimeError):
+    """Not every expected host reported its shard manifest in time: the pod
+    checkpoint stays UNcommitted (torn) and the round should fail so the
+    supervisor can re-form.  Deliberately not a CheckpointIntegrityError —
+    nothing on disk is corrupt, a writer is missing."""
+
+    def __init__(self, msg: str, missing: List[str]):
+        super().__init__(msg)
+        self.missing = missing
+
+
+def commit_pod_manifest(ckpt_dir: str, generation: int,
+                        expected_hosts: List[str], timeout_s: float = 120.0,
+                        poll_s: float = 0.25) -> str:
+    """Coordinator half of the pod commit: wait until every expected host's
+    manifest (of THIS generation) is present and its listed files verify,
+    then atomically publish ``pod_manifest.json``.  Raises
+    :class:`PodCommitTimeout` when a host never reports — the tag is left
+    torn (no pod manifest) and the pod-aware restore path will quarantine
+    it.  Call BEFORE the ``latest`` pointer moves."""
+    expected = sorted(set(str(h) for h in expected_hosts))
+    deadline = time.monotonic() + timeout_s
+    while True:
+        manifests = read_host_manifests(ckpt_dir, strict=False)
+        present = [h for h in expected
+                   if manifests.get(h, {}).get("generation") == generation]
+        if len(present) == len(expected):
+            break
+        if time.monotonic() >= deadline:
+            missing = sorted(set(expected) - set(present))
+            raise PodCommitTimeout(
+                f"pod commit of {ckpt_dir} (generation {generation}) timed "
+                f"out after {timeout_s:.1f}s: host(s) {missing} never "
+                "reported a shard manifest — the tag stays uncommitted",
+                missing)
+        time.sleep(poll_s)
+    # verify every reported shard before declaring the pod commit: a host
+    # that reported but whose file tore is a torn pod checkpoint NOW, not
+    # at restore time generations later
+    problems: List[str] = []
+    for host in expected:
+        for rel, meta in manifests[host].get("files", {}).items():
+            p = os.path.join(ckpt_dir, rel)
+            if not os.path.exists(p):
+                problems.append(f"host{host}:{rel}: missing")
+            elif os.path.getsize(p) != meta["size"]:
+                problems.append(f"host{host}:{rel}: size mismatch")
+            elif _sha256(p) != meta["sha256"]:
+                # same-size in-place corruption must fail the COMMIT, not
+                # surface generations later at restore when the fallback
+                # may already be pruned
+                problems.append(f"host{host}:{rel}: checksum mismatch")
+    if problems:
+        raise CheckpointIntegrityError(
+            f"pod commit of {ckpt_dir} refused: " + "; ".join(problems[:8]))
+    doc = {"manifest_version": MANIFEST_VERSION, "generation": int(generation),
+           "hosts": expected,
+           "global_steps": max((int(m.get("global_steps", -1))
+                                for m in manifests.values()), default=-1)}
+    # the pod commit marker must never be torn
+    return _atomic_write_json(os.path.join(ckpt_dir, POD_MANIFEST_FILE), doc)
+
+
+def read_pod_manifest(ckpt_dir: str) -> Optional[Dict]:
+    path = os.path.join(ckpt_dir, POD_MANIFEST_FILE)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointIntegrityError(
+            f"unreadable pod manifest {path}: {e}") from e
+
+
+def verify_pod_checkpoint_dir(ckpt_dir: str) -> Dict:
+    """Verify a tag as a POD checkpoint: the pod manifest must be present
+    (else the tag is torn/uncommitted), every host it names must have a
+    matching per-host manifest, and every listed shard file must exist with
+    the recorded size and checksum.  Per-host engine-state verification
+    (``verify_checkpoint_dir``) is separate and still runs on load."""
+    pod = read_pod_manifest(ckpt_dir)
+    if pod is None:
+        raise CheckpointIntegrityError(
+            f"checkpoint {ckpt_dir} has no {POD_MANIFEST_FILE}: the pod "
+            "commit never completed (a host died before reporting its "
+            "shard) — torn pod checkpoint")
+    manifests = read_host_manifests(ckpt_dir)
+    problems: List[str] = []
+    for host in pod.get("hosts", []):
+        m = manifests.get(str(host))
+        if m is None:
+            problems.append(f"host{host}: manifest missing")
+            continue
+        if int(m.get("generation", -1)) != int(pod["generation"]):
+            problems.append(f"host{host}: generation "
+                            f"{m.get('generation')} != {pod['generation']}")
+        for rel, meta in m.get("files", {}).items():
+            p = os.path.join(ckpt_dir, rel)
+            if not os.path.exists(p):
+                problems.append(f"host{host}:{rel}: missing")
+            elif os.path.getsize(p) != meta["size"]:
+                problems.append(
+                    f"host{host}:{rel}: size {os.path.getsize(p)} != "
+                    f"{meta['size']}")
+            elif _sha256(p) != meta["sha256"]:
+                problems.append(f"host{host}:{rel}: checksum mismatch")
+    if problems:
+        raise CheckpointIntegrityError(
+            f"pod checkpoint {ckpt_dir} failed verification: "
+            + "; ".join(problems[:8])
+            + (f" (+{len(problems) - 8} more)" if len(problems) > 8 else ""))
+    return pod
+
+
+def pod_committed(ckpt_dir: str) -> bool:
+    return os.path.exists(os.path.join(ckpt_dir, POD_MANIFEST_FILE))
+
+
+def pod_checkpoint_progress_fn(ckpt_dir: str):
+    """Pod analogue of ``checkpoint_progress_fn``: the newest POD-committed
+    global step (-1 while nothing is pod-committed).  Tags that are only
+    host-committed (manifest.json but no pod manifest) do not count — the
+    pod restore path rejects them, so counting them would refresh the
+    restart budget off unreachable state."""
+    def progress() -> int:
+        if not os.path.isdir(ckpt_dir):
+            return -1
+        best = -1
+        for tag in candidate_tags(ckpt_dir):
+            tag_dir = os.path.join(ckpt_dir, tag)
+            if not pod_committed(tag_dir):
+                continue
+            best = max(best, read_tag_step(tag_dir))
+        return best
+
+    return progress
 
 
 def quarantine_tag(save_dir: str, tag: str) -> str:

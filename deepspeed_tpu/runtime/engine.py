@@ -377,6 +377,11 @@ class DeepSpeedEngine:
         wire_flops_profiler(self)
         self.training_dataloader = self._build_dataloader(training_data)
         self.monitor = self._build_monitor()
+        # opt-in /metrics scrape endpoint (DS_TPU_METRICS_PORT): no-op
+        # without the env var, so engine init never binds a socket unasked
+        from ..observability.export import maybe_start_metrics_server
+
+        maybe_start_metrics_server(self.monitor)
         self._watchdog = self._build_watchdog()
         log_dist(
             f"engine ready: params={self.param_count:,} zero_stage={self.zero_stage} "

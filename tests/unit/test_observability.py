@@ -315,3 +315,61 @@ def test_trace_smoke_tool(tmp_path):
     assert out["disabled_span_ns"] < 5000
     # the global tracer was restored to disabled
     assert not get_tracer().enabled
+
+
+# ------------------------------------------------- /metrics endpoint (ISSUE 5)
+def test_metrics_endpoint_serves_prometheus_text():
+    """The stdlib /metrics server renders the live monitor + tracer state
+    per scrape; non-metrics paths 404 (observability/export.py)."""
+    import urllib.error
+    import urllib.request
+
+    from deepspeed_tpu.observability import start_metrics_server
+
+    mon = InMemoryMonitor()
+    mon.write_events([("pod/generation", 3.0, 1),
+                      ("serve/queue_depth", 2.0, 1)])
+    srv = start_metrics_server(port=0, monitor=mon)
+    try:
+        url = f"http://127.0.0.1:{srv.port}/metrics"
+        with urllib.request.urlopen(url) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            body = r.read().decode()
+        assert "dstpu_pod_generation 3" in body
+        assert "dstpu_serve_queue_depth 2" in body
+        # live view: a later event is visible on the next scrape
+        mon.write_events([("pod/generation", 4.0, 2)])
+        with urllib.request.urlopen(url) as r:
+            assert "dstpu_pod_generation 4" in r.read().decode()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/nope")
+        assert ei.value.code == 404
+    finally:
+        srv.close()
+
+
+def test_maybe_start_metrics_server_is_env_gated(monkeypatch):
+    import urllib.request
+
+    from deepspeed_tpu.observability import maybe_start_metrics_server
+    from deepspeed_tpu.observability import export as export_mod
+
+    monkeypatch.delenv("DS_TPU_METRICS_PORT", raising=False)
+    assert maybe_start_metrics_server() is None
+    monkeypatch.setenv("DS_TPU_METRICS_PORT", "not-a-port")
+    assert maybe_start_metrics_server() is None
+    monkeypatch.setenv("DS_TPU_METRICS_PORT", "0")
+    monkeypatch.setattr(export_mod, "_METRICS_SERVER", None)
+    srv = maybe_start_metrics_server()
+    try:
+        assert srv is not None
+        # second call returns the running server and attaches the monitor
+        mon = InMemoryMonitor()
+        mon.write_events([("pod/live_hosts", 4.0, 1)])
+        assert maybe_start_metrics_server(mon) is srv
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics").read().decode()
+        assert "dstpu_pod_live_hosts 4" in body
+    finally:
+        srv.close()
+        monkeypatch.setattr(export_mod, "_METRICS_SERVER", None)

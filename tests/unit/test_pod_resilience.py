@@ -1,0 +1,603 @@
+"""Pod-level fault tolerance — coordination store, heartbeat leases,
+rendezvous, all-hosts checkpoint commit, shrink-to-healthy supervision
+(docs/POD.md).
+
+Deterministic throughout: lease expiry runs on injected store clocks, fault
+sites fire from seeded injectors at exact call counts, and the acceptance
+scenario drives the same simulated-pod harness as
+``tools/chaos_soak.py --mode pod`` at a pinned seed."""
+import json
+import os
+import threading
+import time
+
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.elasticity import (
+    ElasticityIncompatibleWorldSize,
+    FileCoordinationStore,
+    HeartbeatWatchdog,
+    PodContext,
+    PodElasticAgent,
+    PodRendezvousTimeout,
+    PodSupervisor,
+    RC_POD_UNRECOVERABLE,
+    beat,
+    bump_generation,
+    clear_dead,
+    compute_elastic_config,
+    dead_hosts,
+    dead_set,
+    lease_table,
+    pending_commit,
+    read_generation,
+    record_dead,
+    rendezvous,
+    save_pod_checkpoint,
+    shrink_to_healthy,
+)
+from deepspeed_tpu.parallel import mesh as mesh_mod
+from deepspeed_tpu.resilience import (
+    CheckpointIntegrityError,
+    FaultInjector,
+    InjectedFault,
+    PodCommitTimeout,
+    SITE_POD_HEARTBEAT,
+    SITE_POD_RENDEZVOUS,
+    SITE_SHARD_COMMIT,
+    candidate_tags,
+    clear_injector,
+    commit_pod_manifest,
+    install_injector,
+    pod_checkpoint_progress_fn,
+    pod_committed,
+    verify_pod_checkpoint_dir,
+    write_host_manifest,
+)
+from deepspeed_tpu.resilience.fault_injection import corrupt_file
+from deepspeed_tpu.runtime.config import ElasticityConfig
+
+from .simple_model import SimpleModel, make_config, random_batch
+
+HID = 16
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    clear_injector()
+    yield
+    clear_injector()
+
+
+def _store(tmp_path, clock=None):
+    return FileCoordinationStore(str(tmp_path / "coord"), clock=clock)
+
+
+def _ec(n_hosts=4):
+    return ElasticityConfig(enabled=True, max_train_batch_size=16,
+                            micro_batch_sizes=[2, 4], min_gpus=1,
+                            max_gpus=n_hosts)
+
+
+def _engine(**extra):
+    mesh_mod.reset_mesh()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(HID), config=make_config(batch_size=16, **extra))
+    return engine
+
+
+# --------------------------------------------------------------- the store
+def test_store_put_get_list_delete(tmp_path):
+    s = _store(tmp_path)
+    assert s.get("heartbeat/h0") is None
+    s.put("heartbeat/h0", {"a": 1})
+    s.put("heartbeat/h1", {"a": 2})
+    assert s.get("heartbeat/h0") == {"a": 1}
+    assert s.list("heartbeat") == ["h0", "h1"]
+    assert s.list("nope") == []
+    s.delete("heartbeat/h0")
+    assert s.get("heartbeat/h0") is None
+    s.delete("heartbeat/h0")              # idempotent
+
+
+def test_store_rejects_traversal_keys(tmp_path):
+    s = _store(tmp_path)
+    with pytest.raises(ValueError):
+        s.put("../escape", {})
+    with pytest.raises(ValueError):
+        s.get("")
+
+
+# ----------------------------------------------------------- leases + clock
+def test_lease_expiry_on_injected_clock(tmp_path):
+    clock = [100.0]
+    s = _store(tmp_path, clock=lambda: clock[0])
+    beat(s, "h0", generation=1, lease_s=1.0, step=7)
+    beat(s, "h1", generation=1, lease_s=1.0)
+    table = lease_table(s)
+    assert table["h0"].attrs["step"] == 7
+    assert dead_hosts(s, 1, miss_limit=2) == []
+    clock[0] = 101.5                      # 1.5 leases: not dead at limit 2
+    assert dead_hosts(s, 1, miss_limit=2) == []
+    clock[0] = 102.0                      # exactly 2 missed leases
+    beat(s, "h1", generation=1, lease_s=1.0)   # h1 renews, h0 does not
+    assert dead_hosts(s, 1, miss_limit=2) == ["h0"]
+    # generation-scoped: the stale lease is invisible to generation 2
+    assert dead_hosts(s, 2, miss_limit=2) == []
+
+
+def test_dead_hosts_counts_never_beaten_expected(tmp_path):
+    clock = [0.0]
+    s = _store(tmp_path, clock=lambda: clock[0])
+    beat(s, "h0", generation=3, lease_s=1.0)
+    assert dead_hosts(s, 3, 2, expected=["h0", "h9"]) == ["h9"]
+    # a lease stuck at an OLDER generation = never reached this one = dead;
+    # a NEWER one is proof of life (a stale watchdog scanning for its old
+    # generation must not dead-mark the hosts that re-formed without it)
+    beat(s, "h1", generation=2, lease_s=1.0)
+    beat(s, "h2", generation=4, lease_s=1.0)
+    assert dead_hosts(s, 3, 2, expected=["h0", "h1", "h2"]) == ["h1"]
+
+
+def test_dead_markers_roundtrip(tmp_path):
+    s = _store(tmp_path)
+    assert dead_set(s) == []
+    record_dead(s, "h2", generation=4, reported_by="h0")
+    assert dead_set(s) == ["h2"]
+    clear_dead(s, "h2")
+    assert dead_set(s) == []
+
+
+def test_generation_monotonic(tmp_path):
+    s = _store(tmp_path)
+    assert read_generation(s) == 0
+    assert bump_generation(s) == 1
+    assert bump_generation(s) == 2
+    assert read_generation(s) == 2
+
+
+# --------------------------------------------------------------- rendezvous
+def test_rendezvous_completes_and_is_generation_scoped(tmp_path):
+    s = _store(tmp_path)
+    got = {}
+    t = threading.Thread(target=lambda: got.setdefault(
+        "h1", rendezvous(s, "h1", 1, ["h0", "h1"], timeout_s=5.0,
+                         poll_s=0.005)), daemon=True)
+    t.start()
+    members = rendezvous(s, "h0", 1, ["h0", "h1"], timeout_s=5.0,
+                         poll_s=0.005)
+    t.join(timeout=5.0)
+    assert members == ["h0", "h1"] and got["h1"] == ["h0", "h1"]
+    # gen-1 registrations are invisible to generation 2
+    with pytest.raises(PodRendezvousTimeout, match=r"missing \['h1'\]"):
+        rendezvous(s, "h0", 2, ["h0", "h1"], timeout_s=0.1, poll_s=0.005)
+
+
+# ------------------------------------------------------ heartbeat watchdog
+@pytest.mark.chaos
+def test_watchdog_declares_silent_peer_dead_and_records_marker(tmp_path):
+    s = _store(tmp_path)
+    dead = []
+    wd = HeartbeatWatchdog(s, "h0", generation=1, peers=["h0", "h1"],
+                           lease_s=0.05, miss_limit=2, renew_s=0.01,
+                           on_peer_dead=dead.append, grace_beats=10 ** 6)
+    beat(s, "h1", generation=1, lease_s=0.05)   # h1 beats once, then dies
+    wd.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while not wd.dead and time.monotonic() < deadline:
+            time.sleep(0.01)
+    finally:
+        wd.stop()
+    assert dead == ["h1"]
+    assert dead_set(s) == ["h1"]                # durable marker for re-plan
+
+
+def test_watchdog_quiet_while_peers_renew(tmp_path):
+    s = _store(tmp_path)
+    stop = threading.Event()
+
+    def renew():
+        while not stop.is_set():
+            beat(s, "h1", generation=1, lease_s=0.05)
+            time.sleep(0.01)
+
+    t = threading.Thread(target=renew, daemon=True)
+    t.start()
+    wd = HeartbeatWatchdog(s, "h0", generation=1, peers=["h1"],
+                           lease_s=0.05, miss_limit=2, renew_s=0.01,
+                           on_peer_dead=lambda h: None)
+    wd.start()
+    try:
+        time.sleep(0.3)
+        assert wd.dead == []
+    finally:
+        wd.stop()
+        stop.set()
+        t.join()
+
+
+# -------------------------------------------------------------- fault sites
+@pytest.mark.chaos
+def test_pod_fault_sites_fire(tmp_path):
+    inj = install_injector(FaultInjector())
+    inj.add(site=SITE_POD_HEARTBEAT, kind="raise", at_call=1)
+    inj.add(site=SITE_POD_RENDEZVOUS, kind="raise", at_call=1)
+    inj.add(site=SITE_SHARD_COMMIT, kind="raise", at_call=1)
+    s = _store(tmp_path)
+    with pytest.raises(InjectedFault):
+        beat(s, "h0", 1, 1.0)
+    with pytest.raises(InjectedFault):
+        rendezvous(s, "h0", 1, ["h0"], timeout_s=1.0)
+    with pytest.raises(InjectedFault):
+        write_host_manifest(str(tmp_path), "h0", 1, 0, files=[])
+    assert [e["site"] for e in inj.log] == [
+        SITE_POD_HEARTBEAT, SITE_POD_RENDEZVOUS, SITE_SHARD_COMMIT]
+
+
+# ------------------------------------------------------ pod commit protocol
+def _write_shard(tag_dir, host):
+    rel = os.path.join("shards", f"{host}.bin")
+    path = os.path.join(tag_dir, rel)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(f"shard of {host}".encode() * 4)
+    return [rel]
+
+
+def test_pod_commit_waits_for_all_hosts_then_publishes(tmp_path):
+    tag_dir = str(tmp_path / "global_step3")
+    os.makedirs(tag_dir)
+    for h in ("h0", "h1"):
+        write_host_manifest(tag_dir, h, generation=2, global_steps=3,
+                            files=_write_shard(tag_dir, h))
+    assert not pod_committed(tag_dir)
+    commit_pod_manifest(tag_dir, 2, expected_hosts=["h0", "h1"],
+                        timeout_s=1.0)
+    assert pod_committed(tag_dir)
+    pod = verify_pod_checkpoint_dir(tag_dir)
+    assert pod["hosts"] == ["h0", "h1"]
+    assert pod["global_steps"] == 3
+
+
+def test_pod_commit_times_out_on_missing_host(tmp_path):
+    tag_dir = str(tmp_path / "global_step3")
+    os.makedirs(tag_dir)
+    write_host_manifest(tag_dir, "h0", generation=1, global_steps=3,
+                        files=_write_shard(tag_dir, "h0"))
+    with pytest.raises(PodCommitTimeout) as ei:
+        commit_pod_manifest(tag_dir, 1, expected_hosts=["h0", "h1"],
+                            timeout_s=0.1, poll_s=0.01)
+    assert ei.value.missing == ["h1"]
+    assert not pod_committed(tag_dir)     # the tag stays torn
+    with pytest.raises(CheckpointIntegrityError, match="torn"):
+        verify_pod_checkpoint_dir(tag_dir)
+
+
+def test_pod_commit_ignores_stale_generation_manifests(tmp_path):
+    """A manifest left by a previous generation's torn commit must not
+    satisfy the new generation's commit."""
+    tag_dir = str(tmp_path / "global_step3")
+    os.makedirs(tag_dir)
+    write_host_manifest(tag_dir, "h1", generation=1, global_steps=3,
+                        files=_write_shard(tag_dir, "h1"))
+    write_host_manifest(tag_dir, "h0", generation=2, global_steps=3,
+                        files=_write_shard(tag_dir, "h0"))
+    with pytest.raises(PodCommitTimeout) as ei:
+        commit_pod_manifest(tag_dir, 2, expected_hosts=["h0", "h1"],
+                            timeout_s=0.1, poll_s=0.01)
+    assert ei.value.missing == ["h1"]
+
+
+@pytest.mark.chaos
+def test_pod_verify_catches_missing_and_corrupt_shards(tmp_path):
+    tag_dir = str(tmp_path / "global_step5")
+    os.makedirs(tag_dir)
+    for h in ("h0", "h1"):
+        write_host_manifest(tag_dir, h, generation=1, global_steps=5,
+                            files=_write_shard(tag_dir, h))
+    commit_pod_manifest(tag_dir, 1, expected_hosts=["h0", "h1"],
+                        timeout_s=1.0)
+    # bit-rot one host's shard: size unchanged, checksum drifts
+    corrupt_file(os.path.join(tag_dir, "shards", "h1.bin"))
+    with pytest.raises(CheckpointIntegrityError, match="checksum"):
+        verify_pod_checkpoint_dir(tag_dir)
+    # a host manifest vanishing entirely is just as fatal
+    os.remove(os.path.join(tag_dir, "host_manifests", "hosth1.json"))
+    with pytest.raises(CheckpointIntegrityError, match="manifest missing"):
+        verify_pod_checkpoint_dir(tag_dir)
+
+
+def test_pod_progress_fn_counts_only_pod_committed(tmp_path):
+    fn = pod_checkpoint_progress_fn(str(tmp_path))
+    assert fn() == -1
+    # host-committed but not pod-committed: invisible to pod progress
+    tag_dir = str(tmp_path / "global_step4")
+    os.makedirs(tag_dir)
+    (tmp_path / "global_step4" / "client_state.json").write_text(
+        json.dumps({"global_steps": 4}))
+    assert fn() == -1
+    write_host_manifest(tag_dir, "h0", generation=1, global_steps=4)
+    commit_pod_manifest(tag_dir, 1, expected_hosts=["h0"], timeout_s=1.0)
+    assert fn() == 4
+
+
+# --------------------------------------------------------- shrink planning
+def test_shrink_to_healthy_picks_largest_admitted_slice():
+    ec = _ec(4)
+    hosts4 = [f"host{i}" for i in range(4)]
+    members, plan = shrink_to_healthy(ec, hosts4)
+    assert len(members) == 4 and plan.as_triad() == (16, 4, 1)
+    # one host lost: 3 healthy, largest valid count is 2
+    members, plan = shrink_to_healthy(ec, hosts4[:3])
+    assert members == ["host0", "host1"]
+    assert plan.as_triad() == (16, 4, 2)
+    assert plan.as_triad() == compute_elastic_config(ec, 2).as_triad()
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        shrink_to_healthy(ec, [])
+
+
+# ---------------------------------------------------------- pod supervisor
+def test_pod_supervisor_reforms_after_recorded_death(tmp_path):
+    s = _store(tmp_path)
+    hosts = [f"host{i}" for i in range(4)]
+    seen = []
+
+    def attempt(rnd):
+        seen.append(rnd)
+        if len(seen) == 1:
+            # a peer's watchdog records host3 dead mid-round; round fails
+            record_dead(s, "host3", rnd.generation, "host0")
+            return 87
+        return 0
+
+    sup = PodSupervisor(s, _ec(4), attempt, hosts, backoff_s=0,
+                        max_restarts=4)
+    assert sup.run() == 0
+    assert [r.n_hosts for r in seen] == [4, 2]
+    assert seen[0].generation == 1 and seen[1].generation == 2
+    assert "host3" not in seen[1].hosts
+    assert seen[1].plan.as_triad() == (16, 4, 2)
+
+
+def test_pod_supervisor_unrecoverable_is_terminal(tmp_path):
+    s = _store(tmp_path)
+    for h in ("host0", "host1"):
+        record_dead(s, h, 1, "op")
+    calls = []
+    sup = PodSupervisor(s, _ec(2), lambda rnd: calls.append(rnd) or 0,
+                        ["host0", "host1"], backoff_s=0, max_restarts=5)
+    assert sup.run() == RC_POD_UNRECOVERABLE
+    assert calls == []                      # never launched an impossible round
+    assert "unrecoverable" in sup.diagnosis
+    # clearing the markers re-admits the hosts
+    clear_dead(s, "host0")
+    clear_dead(s, "host1")
+    sup2 = PodSupervisor(s, _ec(2), lambda rnd: 0, ["host0", "host1"],
+                         backoff_s=0, max_restarts=5)
+    assert sup2.run() == 0
+
+
+# ----------------------------------- pod checkpoints with a real engine
+def _peer_commit_thread(store, ckpt_dir, host, generation, stop_evt):
+    """Minimal simulated peer: write shard + manifest for every announced
+    commit of this generation."""
+    handled = set()
+
+    def loop():
+        while not stop_evt.is_set():
+            tag = pending_commit(store, generation)
+            if tag is not None and tag not in handled:
+                handled.add(tag)
+                tag_dir = os.path.join(ckpt_dir, tag)
+                write_host_manifest(tag_dir, host, generation,
+                                    int(tag.replace("global_step", "")),
+                                    files=_write_shard(tag_dir, host))
+            time.sleep(0.005)
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    return t
+
+
+@pytest.mark.chaos
+def test_pod_save_commits_only_after_all_hosts(tmp_path):
+    engine = _engine()
+    for _ in range(2):
+        engine.train_batch(batch=random_batch(16, HID, seed=0))
+    store = _store(tmp_path)
+    ckpt = str(tmp_path / "ckpt")
+    ctx = PodContext(store, "host0", ["host0", "host1"], generation=1,
+                     commit_timeout_s=5.0, shard_writer=_write_shard)
+    stop = threading.Event()
+    t = _peer_commit_thread(store, ckpt, "host1", 1, stop)
+    try:
+        tag_dir = save_pod_checkpoint(engine, ckpt, ctx)
+    finally:
+        stop.set()
+        t.join(timeout=5.0)
+    pod = verify_pod_checkpoint_dir(tag_dir)
+    assert pod["hosts"] == ["host0", "host1"]
+    assert (tmp_path / "ckpt" / "latest").read_text() == "global_step2"
+    # and with the peer gone, the same save TEARS instead of committing
+    engine.train_batch(batch=random_batch(16, HID, seed=1))
+    ctx2 = PodContext(store, "host0", ["host0", "host1"], generation=2,
+                      commit_timeout_s=0.3, shard_writer=_write_shard)
+    with pytest.raises(PodCommitTimeout):
+        save_pod_checkpoint(engine, ckpt, ctx2)
+    assert (tmp_path / "ckpt" / "latest").read_text() == "global_step2"
+    assert not pod_committed(str(tmp_path / "ckpt" / "global_step3"))
+
+
+@pytest.mark.chaos
+def test_torn_pod_tag_quarantined_and_fallback_crosses_pod_sizes(tmp_path):
+    """The satellite contract: a torn pod checkpoint (one host's manifest
+    missing) is never selected for restore, lands in ``<tag>.corrupt``, and
+    the walk falls back to a generation written by a DIFFERENT pod size."""
+    engine = _engine()
+    store = _store(tmp_path)
+    ckpt = str(tmp_path / "ckpt")
+    # generation 1, 2-host pod: fully committed at step 1
+    engine.train_batch(batch=random_batch(16, HID, seed=0))
+    ctx1 = PodContext(store, "host0", ["host0", "host1"], generation=1,
+                      commit_timeout_s=5.0, shard_writer=_write_shard)
+    stop = threading.Event()
+    t = _peer_commit_thread(store, ckpt, "host1", 1, stop)
+    try:
+        save_pod_checkpoint(engine, ckpt, ctx1)
+    finally:
+        stop.set()
+        t.join(timeout=5.0)
+    # generation 2: host1 died mid-commit -> torn tag at step 2
+    engine.train_batch(batch=random_batch(16, HID, seed=1))
+    ctx2 = PodContext(store, "host0", ["host0", "host1"], generation=2,
+                      commit_timeout_s=0.2, shard_writer=_write_shard)
+    with pytest.raises(PodCommitTimeout):
+        save_pod_checkpoint(engine, ckpt, ctx2)
+    # generation 3 re-forms at ONE host and restores
+    ctx3 = PodContext(store, "host0", ["host0"], generation=3,
+                      commit_timeout_s=5.0, shard_writer=_write_shard)
+    agent = PodElasticAgent(engine, ckpt, ctx3)
+    try:
+        resumed = agent.restore_if_present()
+    finally:
+        agent.guard.uninstall()
+    assert resumed == 1                      # the 2-host committed generation
+    assert engine.global_steps == 1
+    assert (tmp_path / "ckpt" / "global_step2.corrupt").is_dir()
+    assert not (tmp_path / "ckpt" / "global_step2").exists()
+    assert candidate_tags(ckpt) == ["global_step1"]
+    # and the 1-host pod can carry the lineage forward
+    engine.train_batch(batch=random_batch(16, HID, seed=1))
+    tag_dir = save_pod_checkpoint(engine, ckpt, ctx3)
+    assert verify_pod_checkpoint_dir(tag_dir)["hosts"] == ["host0"]
+    assert pod_checkpoint_progress_fn(ckpt)() == 2
+
+
+@pytest.mark.chaos
+def test_pod_prune_skips_torn_tags_and_keeps_pod_committed(tmp_path):
+    """Prune candidacy is pod-scope for the pod agent: a torn pod tag
+    (host-committed, no pod manifest) neither counts toward the keep
+    window nor gets deleted — it is left for the quarantine sweep, and the
+    keep-newest window holds only generations the restore path accepts."""
+    engine = _engine()
+    store = _store(tmp_path)
+    ckpt = tmp_path / "ckpt"
+    for step, torn in ((2, False), (4, True), (6, False), (8, False)):
+        d = ckpt / f"global_step{step}"
+        d.mkdir(parents=True)
+        (d / "manifest.json").write_text(json.dumps({"global_steps": step}))
+        (d / "client_state.json").write_text(
+            json.dumps({"global_steps": step}))
+        if not torn:
+            write_host_manifest(str(d), "host0", 1, step)
+            commit_pod_manifest(str(d), 1, ["host0"], timeout_s=1.0)
+    ctx = PodContext(store, "host0", ["host0"], 1)
+    agent = PodElasticAgent(engine, str(ckpt), ctx, keep=2)
+    try:
+        agent._prune_generations()
+    finally:
+        agent.guard.uninstall()
+    assert not (ckpt / "global_step2").exists()       # 3rd-newest committed
+    assert (ckpt / "global_step4").is_dir()           # torn: never rmtree'd
+    assert (ckpt / "global_step6").is_dir()
+    assert (ckpt / "global_step8").is_dir()
+
+
+# ------------------------------------------------- launcher + comm wiring
+def test_launcher_pod_attempt_bumps_generation_and_env(tmp_path, monkeypatch):
+    from deepspeed_tpu.launcher import runner as runner_mod
+
+    coord = str(tmp_path / "coord")
+    args = runner_mod.parse_args(["--pod_coord_dir", coord,
+                                  "--pod_lease", "2.5",
+                                  "--elastic_restarts", "3", "train.py"])
+    assert args.pod_coord_dir == coord and args.pod_lease == 2.5
+    dispatched = []
+    monkeypatch.setattr(runner_mod, "_dispatch",
+                        lambda a: dispatched.append(
+                            os.environ["DS_TPU_POD_GENERATION"]) or 0)
+    attempt = runner_mod._pod_attempt(args)
+    assert attempt(0) == 0
+    assert attempt(1) == 0
+    assert dispatched == ["1", "2"]
+    assert os.environ["DS_TPU_POD_COORD_DIR"] == coord
+    assert os.environ["DS_TPU_POD_LEASE"] == "2.5"
+    assert read_generation(FileCoordinationStore(coord)) == 2
+    # _pod_attempt writes os.environ directly (monkeypatch would restore
+    # the leaked values at teardown instead of clearing them)
+    for key in ("DS_TPU_POD_GENERATION", "DS_TPU_POD_COORD_DIR",
+                "DS_TPU_POD_LEASE", "DS_TPU_POD_MISS_LIMIT"):
+        os.environ.pop(key, None)
+
+
+def test_comm_pod_generation_env(monkeypatch):
+    from deepspeed_tpu.comm.comm import get_pod_generation
+
+    assert get_pod_generation() == 0
+    monkeypatch.setenv("DS_TPU_POD_GENERATION", "7")
+    assert get_pod_generation() == 7
+    monkeypatch.setenv("DS_TPU_POD_GENERATION", "junk")
+    assert get_pod_generation() == 0
+
+
+# ----------------------------------------- acceptance: simulated pod chaos
+@pytest.mark.chaos
+def test_pod_chaos_kill_reforms_and_restores(tmp_path):
+    """ISSUE 5 acceptance: a simulated 4-host run killed at a seeded point
+    (this seed: a mid-commit host death) auto-detects the loss, re-forms at
+    2 hosts with the ``compute_elastic_config`` triad, quarantines the torn
+    pod tag, restores the committed generation and converges with loss
+    continuity.  Same harness as ``tools/chaos_soak.py --mode pod``."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    os.pardir, os.pardir, "tools"))
+    from chaos_soak import run_pod_soak
+
+    stats = run_pod_soak(seed=5, total_steps=12, ckpt_every=2,
+                         ckpt_dir=str(tmp_path / "ckpt"),
+                         coord_dir=str(tmp_path / "coord"), verbose=False)
+    assert stats["kill_mode"] == "mid_commit"
+    assert stats["final_hosts"] == 2
+    assert stats["final_triad"] == (16, 4, 2)
+    assert stats["final_step"] == 12
+    assert stats["quarantined"]              # the torn tag ended .corrupt
+    assert stats["continuity_checked"] >= 1
+
+
+@pytest.mark.chaos
+def test_pod_chaos_step_kill_detected_by_leases(tmp_path):
+    """Second deterministic seed: a silent mid-step death (the lease just
+    stops renewing) detected by the heartbeat watchdog."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    os.pardir, os.pardir, "tools"))
+    from chaos_soak import run_pod_soak
+
+    stats = run_pod_soak(seed=6, total_steps=12, ckpt_every=2,
+                         ckpt_dir=str(tmp_path / "ckpt"),
+                         coord_dir=str(tmp_path / "coord"), verbose=False)
+    assert stats["kill_mode"] == "step"
+    assert stats["final_hosts"] == 2
+    assert stats["final_triad"] == (16, 4, 2)
+    assert stats["final_step"] == 12
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_pod_chaos_soak_multiseed(tmp_path):
+    """Long-form randomized variant (tools/chaos_soak.py --mode pod)."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    os.pardir, os.pardir, "tools"))
+    from chaos_soak import run_pod_soak
+
+    for seed in (0, 1, 2, 3):
+        root = tmp_path / f"s{seed}"
+        run_pod_soak(seed=seed, total_steps=12, ckpt_every=2,
+                     ckpt_dir=str(root / "ckpt"),
+                     coord_dir=str(root / "coord"), verbose=False)

@@ -375,21 +375,27 @@ def test_rebase_carries_remaining_deadline_budget():
     only the unspent budget survives the re-anchor."""
     req = Request(rid=0, input_ids=np.array([1], np.int32),
                   max_new_tokens=2, arrival_time=0.0, deadline_s=1.0)
-    rebased = ServingSupervisor._rebase(req, elapsed=0.75)
+    rebased = ServingSupervisor._rebase(req, elapsed=0.75, t0=100.0)
     assert rebased.arrival_time == 0.0
     assert abs(rebased.deadline_s - 0.25) < 1e-9
+    # the ORIGINAL arrival survives the re-anchor as the epoch stamp (and
+    # a second rebase keeps the first epoch, not the second engine's clock)
+    assert rebased.arrival_epoch_s == pytest.approx(100.0)
+    again = ServingSupervisor._rebase(rebased, elapsed=0.1, t0=200.0)
+    assert again.arrival_epoch_s == pytest.approx(100.0)
     # already expired: floored at an epsilon so the normal expiry path
     # still produces a terminal "deadline" result
-    expired = ServingSupervisor._rebase(req, elapsed=5.0)
+    expired = ServingSupervisor._rebase(req, elapsed=5.0, t0=100.0)
     assert 0 < expired.deadline_s <= 1e-6
     # no deadline stays no deadline; pending offset spent counts from arrival
     free = Request(rid=1, input_ids=np.array([1], np.int32),
                    max_new_tokens=2, arrival_time=0.5, deadline_s=1.0)
-    assert ServingSupervisor._rebase(free, elapsed=0.7).deadline_s == \
-        pytest.approx(0.8)
+    reb = ServingSupervisor._rebase(free, elapsed=0.7, t0=100.0)
+    assert reb.deadline_s == pytest.approx(0.8)
+    assert reb.arrival_epoch_s == pytest.approx(100.5)
     assert ServingSupervisor._rebase(
         Request(rid=2, input_ids=np.array([1], np.int32), max_new_tokens=2),
-        elapsed=9.0).deadline_s is None
+        elapsed=9.0, t0=0.0).deadline_s is None
 
 
 def test_supervised_drain_returns_original_requests(tiny_engine):
@@ -523,3 +529,124 @@ def test_restart_dump_none_when_tracing_disabled(tiny_engine):
     assert sup.restarts == 1
     assert len(results) == 3
     assert sup.last_flight_dump is None
+
+
+# ---------------------------------------------- probe / unfence (ISSUE 5)
+@pytest.mark.chaos
+def test_quarantined_slot_probed_and_unfenced(tiny_engine):
+    """After probe_after_ticks clean ticks a fenced slot gets one canary
+    prefill; success restores the slot WITH its pages, keeping the
+    free + quarantined == pool invariant exact."""
+    serve = tiny_engine.serving(**SERVE_KW, quarantine_limit=2,
+                                probe_after_ticks=3)
+    inj = install_injector(FaultInjector())
+    # two raises at the same slot: the failed admission retries the queue
+    # head on the same (first-free) slot, so both land on slot 0 -> fence
+    inj.add(site=SITE_SERVE_PREFILL, kind="raise", at_call=1)
+    inj.add(site=SITE_SERVE_PREFILL, kind="raise", at_call=1)
+    fenced = False
+    for r in _stream(5, seed=21):
+        serve.submit(r)
+    while True:
+        try:
+            if serve.step() == 0:
+                break
+        except SlotPrefillError as e:
+            fenced = fenced or e.quarantined
+    h = serve.health()
+    assert fenced                            # the slot really was fenced
+    assert h["quarantined_slots"] == 0       # ...and probed back into service
+    assert h["quarantined_pages"] == 0
+    assert h["probes_total"] >= 1 and h["unfenced_total"] == 1
+    assert h["free_pages"] == serve.num_pages - 1
+    results = serve.take_results()
+    assert sorted(r.rid for r in results) == list(range(5))
+    assert all(r.finish_reason in ("eos", "length") for r in results)
+
+
+@pytest.mark.chaos
+def test_failed_probe_keeps_slot_fenced_until_a_clean_canary(tiny_engine):
+    """A canary that still fails re-fences the slot and restarts the
+    clean-tick clock; a later clean canary restores it.  Long prompts keep
+    real prefills in the 32-bucket, so the planted broken 16-bucket
+    program is hit ONLY by the one-token canary."""
+    serve = tiny_engine.serving(**SERVE_KW, quarantine_limit=2,
+                                probe_after_ticks=2)
+    inj = install_injector(FaultInjector())
+    inj.add(site=SITE_SERVE_PREFILL, kind="raise", at_call=1)  # fence slot 0
+    inj.add(site=SITE_SERVE_PREFILL, kind="raise", at_call=1)
+
+    def broken_canary(*args, **kwargs):
+        raise RuntimeError("canary boom")
+
+    serve._prefill_progs[16] = broken_canary
+    for r in _stream(6, seed=22, smin=17, smax=30):
+        serve.submit(r)
+    fenced_again = False
+    while True:
+        try:
+            n = serve.step()
+        except SlotPrefillError:
+            continue
+        if serve.probe_count >= 1 and serve.unfence_count == 0:
+            # the first canary failed: still fenced, clock restarted
+            fenced_again = True
+            assert serve.health()["quarantined_slots"] == 1
+            serve._prefill_progs.pop(16, None)   # next canary rebuilds clean
+        if n == 0:
+            break
+    h = serve.health()
+    assert fenced_again
+    assert h["probes_total"] >= 2            # first canary failed, later won
+    assert h["unfenced_total"] == 1
+    assert h["quarantined_slots"] == 0
+    assert h["free_pages"] + h["quarantined_pages"] == serve.num_pages - 1
+    assert len(serve.take_results()) == 6
+
+
+def test_probe_disabled_by_default_keeps_slot_fenced(tiny_engine):
+    serve = tiny_engine.serving(**SERVE_KW, quarantine_limit=1)
+    inj = install_injector(FaultInjector())
+    inj.add(site=SITE_SERVE_PREFILL, kind="raise", at_call=1)
+    for r in _stream(4, seed=23):
+        serve.submit(r)
+    while True:
+        try:
+            if serve.step() == 0:
+                break
+        except SlotPrefillError:
+            pass
+    h = serve.health()
+    assert h["quarantined_slots"] == 1       # no background unfence path
+    assert h["probes_total"] == 0
+    assert h["free_pages"] + h["quarantined_pages"] == serve.num_pages - 1
+
+
+# ------------------------------------- arrival epoch across warm restarts
+def test_warm_restart_preserves_queued_age_and_service_ema(tiny_engine):
+    """The replacement engine's gauges and retry hints must reference the
+    TRUE arrival epoch and the observed service EMA, not its own freshly
+    reset clock (ISSUE 5 satellite; was a documented ROADMAP gap)."""
+    import time as _time
+
+    sup = tiny_engine.supervised_serving(**SERVE_KW, max_restarts=3)
+    # season the service-time EMA with a fault-free mini-stream
+    sup.run(_stream(2, seed=24), max_ticks=500)
+    ema = sup.engine._ema_service_s
+    assert ema is not None
+    old = sup.engine
+    for r in _stream(3, seed=25):
+        sup.submit(r)
+    _time.sleep(0.15)                        # the requests age while queued
+    sup._restart(RuntimeError("forced-for-test"))
+    assert sup.engine is not old
+    # EMA carried: hints from the fresh engine reflect observed service time
+    assert sup.engine._ema_service_s == pytest.approx(ema)
+    # queued age measured from the ORIGINAL arrival, not the restart
+    h = sup.health()
+    assert h["queue_depth"] == 3
+    assert h["oldest_request_age_s"] >= 0.14
+    results = sup.run([], max_ticks=2000)
+    assert sorted(r.rid for r in results) == [0, 1, 2]
+    # result stamps keep the pre-restart arrival: queueing time is visible
+    assert all(r.queued_s >= 0.14 for r in results)

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Chaos soak: N supervised sessions under seeded random fault injection.
 
-Two modes (``--mode train`` is the default):
+Three modes (``--mode train`` is the default):
 
 - **train**: supervised elastic training rounds — preemption SIGTERMs,
   checkpoint-write failures, corruption of the newest generation — must
@@ -11,7 +11,14 @@ Two modes (``--mode train`` is the default):
   kills plus bounded-queue shedding and a dead-on-arrival deadline — every
   request must reach a terminal result, completed outputs must be
   token-identical to a fault-free reference run, and page accounting must
-  balance after drain (pool pages = free + quarantined).
+  balance after drain (pool pages = free + quarantined);
+- **pod**: a simulated multi-host run (peer hosts as threads over a
+  file-backed coordination store, the coordinator owning a real engine on
+  the virtual CPU mesh) with a seeded host kill — mid-step or mid-commit —
+  that must be detected by missed leases, re-form at the largest healthy
+  slice ``compute_elastic_config`` admits, restore the last *committed*
+  pod checkpoint (torn pod tags quarantined), and converge with loss
+  continuity (docs/POD.md).
 
 Each soak round draws a fault mix from a seeded PRNG — preemption SIGTERMs
 at random steps, checkpoint-write failures, corruption of the newest
@@ -42,6 +49,8 @@ import os
 import shutil
 import sys
 import tempfile
+import threading
+import time
 from random import Random
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -264,19 +273,259 @@ def run_serve_soak(seed: int, n_requests: int = 8, b_slots: int = 3,
     return stats
 
 
+def run_pod_soak(seed: int, total_steps: int = 12, ckpt_every: int = 2,
+                 ckpt_dir: str = "", coord_dir: str = "", n_hosts: int = 4,
+                 verbose: bool = True) -> dict:
+    """One simulated pod session under a seeded host kill (docs/POD.md).
+
+    The coordinator ("host0") runs in the calling thread with a REAL engine
+    on the virtual CPU mesh under a :class:`PodElasticAgent`; peer hosts
+    are threads that rendezvous, heartbeat, and take part in the all-hosts
+    checkpoint commit (shard file + per-host manifest).  Lease expiry runs
+    on an injected store clock advanced one tick per training step, so
+    detection latency is measured in *steps*, deterministic across
+    machines.  The seed draws the victim host, the kill step, and the kill
+    mode:
+
+    - ``step``: the victim silently stops heartbeating at a step — peers
+      detect ``miss_limit`` missed leases and exit for re-formation;
+    - ``mid_commit``: the victim dies during a pod checkpoint after its
+      shard but before its manifest — the pod commit times out, the tag
+      stays TORN, and the next round must quarantine it and fall back.
+
+    Invariants asserted: the supervisor converges (rc 0) at a SHRUNKEN
+    slice whose batch triad matches ``compute_elastic_config`` for the
+    healthy host count; the final checkpoint is pod-committed and
+    verifies; every surviving tag is pod-committed (torn ones quarantined,
+    when the kill produced one); re-executed steps reproduce their
+    original losses (continuity).
+    """
+    import numpy as np
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import deepspeed_tpu
+    from deepspeed_tpu.elasticity import (FileCoordinationStore,
+                                          HeartbeatWatchdog, PodContext,
+                                          PodElasticAgent, PodPeerLost,
+                                          PodSupervisor, compute_elastic_config,
+                                          lease_table, pending_commit,
+                                          record_dead, rendezvous)
+    from deepspeed_tpu.parallel import mesh as mesh_mod
+    from deepspeed_tpu.resilience import (PodCommitTimeout,
+                                          pod_checkpoint_progress_fn,
+                                          pod_committed, candidate_tags,
+                                          verify_pod_checkpoint_dir,
+                                          write_host_manifest)
+    from deepspeed_tpu.runtime.config import ElasticityConfig
+    from unit.simple_model import SimpleModel, make_config, random_batch
+
+    rng = Random(seed)
+    hosts = [f"host{i}" for i in range(n_hosts)]
+    victim = hosts[rng.randrange(1, n_hosts)]   # host0 owns the engine
+    kill_mode = rng.choice(("step", "mid_commit"))
+    kill_step = rng.randint(ckpt_every, max(ckpt_every, total_steps - 6))
+    kill_commit = rng.randint(1, 2)
+    # commit timeout 2s: peers respond in ~10ms, so 200x margin, and the
+    # torn-commit rounds (which always burn the full timeout) stay cheap
+    # enough for the tier-1 seeds that import this harness
+    LEASE_S, MISS, COMMIT_TIMEOUT = 1.0, 2, 2.0
+
+    clock_box = [0.0]   # fake store clock: +1 per coordinator train step
+    store = FileCoordinationStore(coord_dir, clock=lambda: clock_box[0])
+    ec = ElasticityConfig(enabled=True, max_train_batch_size=16,
+                          micro_batch_sizes=[2, 4], min_gpus=1,
+                          max_gpus=n_hosts)
+
+    def shard_writer(tag_dir, host_id):
+        rel = os.path.join("shards", f"{host_id}.bin")
+        path = os.path.join(tag_dir, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(f"{host_id} shard of {os.path.basename(tag_dir)}\n"
+                    .encode() * 8)
+        return [rel]
+
+    loss_log: dict = {}
+    continuity = {"checked": 0}
+    killed = {"done": False}
+    torn_tags: list = []
+
+    def peer_main(host, members, gen, stop_evt):
+        """One simulated peer host: rendezvous, heartbeat, commit shards
+        for every tag the coordinator announces for this generation."""
+        dead_flag: list = []
+        # grace disabled: detection in the sim is lease EXPIRY on the fake
+        # clock, never "host absent" races during real-time round setup
+        wd = HeartbeatWatchdog(store, host, gen, list(members),
+                               lease_s=LEASE_S, miss_limit=MISS,
+                               on_peer_dead=dead_flag.append, renew_s=0.01,
+                               grace_beats=10 ** 6)
+        rendezvous(store, host, gen, list(members), timeout_s=10.0)
+        wd.start()
+        handled: set = set()
+        try:
+            while not stop_evt.is_set() and not dead_flag:
+                if (kill_mode == "step" and host == victim
+                        and not killed["done"]):
+                    lease = lease_table(store).get("host0")
+                    if lease and lease.attrs.get("step", 0) >= kill_step:
+                        killed["done"] = True
+                        return   # silent death: the lease just stops
+                tag = pending_commit(store, gen)
+                if tag is not None and tag not in handled:
+                    handled.add(tag)
+                    tag_dir = os.path.join(ckpt_dir, tag)
+                    files = shard_writer(tag_dir, host)
+                    if (kill_mode == "mid_commit" and host == victim
+                            and len(handled) >= kill_commit
+                            and not killed["done"]):
+                        # die after the shard, before the manifest: the
+                        # pod commit of this tag can never complete
+                        killed["done"] = True
+                        torn_tags.append(tag)
+                        return
+                    step = int(tag.replace("global_step", "") or -1) \
+                        if tag.startswith("global_step") else -1
+                    write_host_manifest(tag_dir, host, gen, step,
+                                        files=files)
+                time.sleep(0.005)
+        finally:
+            wd.stop()
+
+    def attempt(rnd):
+        members = list(rnd.hosts)
+        stop_evt = threading.Event()
+        peers = [threading.Thread(target=peer_main, name=f"pod-sim-{h}",
+                                  args=(h, members, rnd.generation, stop_evt),
+                                  daemon=True)
+                 for h in members if h != "host0"]
+        for t in peers:
+            t.start()
+        mesh_mod.reset_mesh()
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=SimpleModel(16), config=make_config(batch_size=16))
+        dead_seen: list = []
+        wd0 = HeartbeatWatchdog(store, "host0", rnd.generation, members,
+                                lease_s=LEASE_S, miss_limit=MISS,
+                                on_peer_dead=dead_seen.append, renew_s=0.01,
+                                grace_beats=10 ** 6)
+        ctx = PodContext(store, "host0", members, rnd.generation,
+                         lease_s=LEASE_S, miss_limit=MISS,
+                         commit_timeout_s=COMMIT_TIMEOUT,
+                         shard_writer=shard_writer)
+        agent = PodElasticAgent(engine, ckpt_dir, ctx, watchdog=wd0,
+                                ckpt_every=ckpt_every)
+
+        def step_fn(eng, i):
+            loss = float(eng.train_batch(batch=random_batch(16, 16, seed=i)))
+            if i in loss_log:
+                assert abs(loss - loss_log[i]) < 1e-4, \
+                    f"pod soak seed={seed}: loss continuity broken at " \
+                    f"step {i}: {loss} != {loss_log[i]}"
+                continuity["checked"] += 1
+            loss_log[i] = loss
+            clock_box[0] += 1.0   # one store-clock tick per step
+            time.sleep(0.03)      # give peer scans real time to observe
+
+        try:
+            rendezvous(store, "host0", rnd.generation, members,
+                       timeout_s=10.0)
+            wd0.start()
+            last = agent.run(step_fn, total_steps)
+            return 0 if last >= total_steps else 75
+        except PodPeerLost:
+            return 87
+        except PodCommitTimeout as e:
+            # the store clock is frozen while we block in the commit wait
+            # (it only advances on train steps), so lease expiry cannot
+            # flag the dead writer here — but the commit protocol itself
+            # just did: the host that never reported its shard within the
+            # (generous) timeout is the casualty.  Record it for the next
+            # round's re-plan.
+            for h in e.missing:
+                if h != "host0":
+                    record_dead(store, h, rnd.generation, "host0")
+            return 87
+        finally:
+            wd0.stop()
+            agent.guard.uninstall()
+            stop_evt.set()
+            for t in peers:
+                t.join(timeout=10.0)
+
+    sup = PodSupervisor(store, ec, attempt, hosts, max_restarts=8,
+                        backoff_s=0,
+                        progress_fn=pod_checkpoint_progress_fn(ckpt_dir),
+                        zero_progress_limit=4, seed=seed)
+    rc = sup.run()
+
+    assert rc == 0, f"pod soak seed={seed}: supervisor exited rc={rc} " \
+                    f"(diagnosis: {sup.diagnosis})"
+    progress = pod_checkpoint_progress_fn(ckpt_dir)()
+    assert progress == total_steps, \
+        f"pod soak seed={seed}: pod-committed step {progress}, " \
+        f"wanted {total_steps}"
+    # the job shrank to the largest healthy slice and its planned triad
+    assert len(sup.rounds) >= 2, "the kill never forced a re-formation"
+    final = sup.rounds[-1]
+    assert victim not in final.hosts
+    expect_hosts, expect_plan = len(final.hosts), final.plan
+    ref_plan = compute_elastic_config(ec, expect_hosts)
+    assert expect_plan.as_triad() == ref_plan.as_triad()
+    # every surviving tag is pod-committed; torn tags ended quarantined
+    newest = candidate_tags(ckpt_dir)[0]
+    verify_pod_checkpoint_dir(os.path.join(ckpt_dir, newest))
+    for tag in candidate_tags(ckpt_dir):
+        assert pod_committed(os.path.join(ckpt_dir, tag)), \
+            f"pod soak seed={seed}: uncommitted tag {tag} survived"
+    quarantined = sorted(d for d in os.listdir(ckpt_dir) if ".corrupt" in d)
+    for torn in torn_tags:
+        # the torn incarnation was quarantined by the next round's sweep;
+        # the tag NAME may exist again only as a fresh pod-committed
+        # re-save of the same step
+        p = os.path.join(ckpt_dir, torn)
+        assert not os.path.isdir(p) or pod_committed(p), \
+            f"pod soak seed={seed}: torn tag {torn} survived uncommitted"
+    if torn_tags:
+        assert quarantined, \
+            f"pod soak seed={seed}: torn tag(s) {torn_tags} never quarantined"
+    stats = {
+        "seed": seed, "victim": victim, "kill_mode": kill_mode,
+        "kill_step": kill_step, "kill_commit": kill_commit,
+        "rounds": len(sup.rounds), "final_hosts": expect_hosts,
+        "final_triad": expect_plan.as_triad(),
+        "continuity_checked": continuity["checked"],
+        "quarantined": quarantined, "final_step": progress,
+    }
+    if verbose:
+        print(f"  seed={seed}: OK — killed {victim} ({kill_mode}), "
+              f"{stats['rounds']} round(s), re-formed at "
+              f"{expect_hosts} host(s) triad={stats['final_triad']}, "
+              f"{len(quarantined)} quarantined, "
+              f"{continuity['checked']} continuity check(s)")
+    return stats
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="randomized fault-injection soak for the resilience "
                     "subsystem")
-    ap.add_argument("--mode", choices=("train", "serve"), default="train",
+    ap.add_argument("--mode", choices=("train", "serve", "pod"),
+                    default="train",
                     help="train: supervised elastic rounds; serve: "
-                         "ServingSupervisor kill/replay soak")
+                         "ServingSupervisor kill/replay soak; pod: "
+                         "simulated multi-host kill + shrink-to-healthy "
+                         "re-formation")
     ap.add_argument("--soaks", type=int, default=3,
                     help="number of supervised sessions to soak")
     ap.add_argument("--total-steps", type=int, default=8)
     ap.add_argument("--ckpt-every", type=int, default=2)
     ap.add_argument("--requests", type=int, default=8,
                     help="serve mode: requests per soak stream")
+    ap.add_argument("--hosts", type=int, default=4,
+                    help="pod mode: simulated hosts per soak")
     ap.add_argument("--seed", type=int, default=0,
                     help="base seed; soak i uses seed+i")
     ap.add_argument("--keep-dirs", action="store_true",
@@ -305,6 +554,22 @@ def main(argv=None) -> int:
             except Exception as e:
                 failures += 1
                 print(f"  FAILED ({type(e).__name__}): {e}", file=sys.stderr)
+            continue
+        if args.mode == "pod":
+            root = tempfile.mkdtemp(prefix=f"chaos_pod_{seed}_")
+            print(f"pod soak {i + 1}/{args.soaks} (seed={seed}) -> {root}")
+            try:
+                run_pod_soak(seed, total_steps=args.total_steps,
+                             ckpt_every=args.ckpt_every,
+                             ckpt_dir=os.path.join(root, "ckpt"),
+                             coord_dir=os.path.join(root, "coord"),
+                             n_hosts=args.hosts)
+            except Exception as e:
+                failures += 1
+                print(f"  FAILED ({type(e).__name__}): {e}", file=sys.stderr)
+            finally:
+                if not args.keep_dirs:
+                    shutil.rmtree(root, ignore_errors=True)
             continue
         ckpt_dir = tempfile.mkdtemp(prefix=f"chaos_soak_{seed}_")
         print(f"soak {i + 1}/{args.soaks} (seed={seed}) -> {ckpt_dir}")
