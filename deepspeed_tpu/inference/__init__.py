@@ -4,6 +4,7 @@ engine (``serving.ServingEngine``), and its warm-restart wrapper
 (``serving_supervisor.ServingSupervisor``)."""
 from .config import DeepSpeedInferenceConfig  # noqa: F401
 from .engine import InferenceEngine  # noqa: F401
+from .prefix_cache import PrefixIndex, PrefixMatch  # noqa: F401
 from .serving import (  # noqa: F401
     PoolConsumedError,
     Request,

@@ -128,15 +128,29 @@ class InferenceEngine:
             inner_apply = self.apply_fn
             self.apply_fn = lambda p, *a, **k: inner_apply(
                 dequantize_params(p), *a, **k)
-            if self._model is not None and hasattr(self._model, "apply_cached"):
+            if self._model is not None and (
+                    hasattr(self._model, "apply_cached")
+                    or hasattr(self._model, "apply_paged")):
                 # generate()'s decode programs call model.apply_cached —
-                # shim it so the cache loop reads int8 weights every step
+                # shim it so the cache loop reads int8 weights every step.
+                # The paged serving contract (apply_paged) gets the same
+                # treatment: ServingEngine's prefill/decode programs then
+                # dequantize at entry, so a quantized engine serves through
+                # the ordinary paged path (init_paged_cache itself never
+                # touches params — the pool stays compute-dtype).  Each shim
+                # installs on its own hasattr: a model exposing only one of
+                # the two contracts still gets that one dequantized.
                 import copy
 
                 shim = copy.copy(self._model)
-                inner_cached = self._model.apply_cached
-                shim.apply_cached = lambda p, *a, **k: inner_cached(
-                    dequantize_params(p), *a, **k)
+                if hasattr(self._model, "apply_cached"):
+                    inner_cached = self._model.apply_cached
+                    shim.apply_cached = lambda p, *a, **k: inner_cached(
+                        dequantize_params(p), *a, **k)
+                if hasattr(self._model, "apply_paged"):
+                    inner_paged = self._model.apply_paged
+                    shim.apply_paged = lambda p, *a, **k: inner_paged(
+                        dequantize_params(p), *a, **k)
                 self._model = shim
         self._forward = jax.jit(self.apply_fn)
         log_dist(f"inference engine ready: tp={tp} dtype={self._config.dtype}"
@@ -151,11 +165,10 @@ class InferenceEngine:
     def serving(self, **kwargs):
         """A continuous-batching :class:`~.serving.ServingEngine` sharing
         this engine's model and (cast/sharded) params, so serving numerics
-        are identical to :meth:`generate`.  See docs/SERVING.md."""
-        if self._quant:
-            raise NotImplementedError(
-                "serving on a quantized engine: the paged decode path has "
-                "no dequantize shim yet")
+        are identical to :meth:`generate`.  On a quantized engine the
+        shimmed ``apply_paged`` dequantizes at program entry, so serving
+        reads the same int8/int4 weights as quantized ``generate()`` and
+        stays token-identical to it.  See docs/SERVING.md."""
         if self._model is None or not hasattr(self._model, "apply_paged"):
             raise ValueError(
                 "serving() needs a model with the paged decode contract "
@@ -163,6 +176,12 @@ class InferenceEngine:
         from .serving import ServingEngine
 
         kwargs.setdefault("mesh", self.mesh)
+        if self._quant and kwargs.get("dtype") is None:
+            # the serving KV pool is compute-dtype regardless of weight
+            # quantization; pin it explicitly (also over an explicit
+            # dtype=None) so the pool never allocates pages in the
+            # weights' storage dtype
+            kwargs["dtype"] = self._config.compute_jnp_dtype
         return ServingEngine(self._model, self.params, **kwargs)
 
     def supervised_serving(self, max_restarts: int = 5, **kwargs):

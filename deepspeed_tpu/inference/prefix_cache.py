@@ -1,0 +1,251 @@
+"""Prefix index for cross-request KV reuse (vLLM-style prefix caching).
+
+At production scale most traffic shares a system prompt, yet a paged serving
+engine that always prefills from token 0 recomputes the same K/V into
+private pages for every request.  The block-paged pool already has the right
+indirection for sharing (PagedAttention, SOSP '23): a physical page holding
+the K/V of tokens ``[i*page, (i+1)*page)`` of some prefix is valid for EVERY
+request whose prompt starts with that exact token prefix — K/V at position
+``t`` is a pure function of tokens ``0..t`` (causal), independent of the
+requests that happen to read it.
+
+:class:`PrefixIndex` maps *page-aligned token chunks* to the physical page
+holding their K/V, keyed by a rolling (chained) hash over the whole prefix:
+
+- **full chunks** — ``key_i = hash((key_{i-1}, chunk_i_tokens))`` with
+  ``key_{-1}`` a fixed root seed.  A key therefore commits to the ENTIRE
+  prefix, not just one chunk, and lookup walks chunk by chunk from token 0,
+  verifying the stored chunk tokens exactly at every step (a hash collision
+  degrades to a miss, never to wrong tokens).  Only pages that are
+  *prefix-complete and immutable* are published: a page whose whole
+  ``page_size`` token span lies inside the prompt is never written again by
+  its owner (decode writes land at positions ``>= len(prompt)``).
+- **partial boundary chunks** — a prompt that ends mid-page publishes its
+  boundary page under ``(prev_key, partial_tokens)``.  The page is still
+  mutable (its owner keeps appending generated tokens to later rows), so a
+  matching request never maps it directly: it **copy-on-writes** the page
+  into a private page of its own (``ServingEngine._cow_prog``) and
+  overwrites every row past the matched prefix itself before causality can
+  expose it.  Matching is longest-common-prefix, so a partial entry also
+  serves requests that diverge inside the chunk.
+
+The index does not own device memory; it hands page ids back to the engine,
+which holds one refcount per live entry (see ``ServingEngine``).  Entries
+are LRU-ordered; :meth:`evict` releases the oldest so the engine can reclaim
+cached-but-idle pages under pool pressure.  Evicting a full entry may orphan
+deeper entries (their chain key becomes unreachable until re-published) —
+they stay valid, age out by LRU, and can even be re-reached through a fresh
+donor's re-published parent chunks, because chain keys depend only on token
+content, never on which physical pages carried it.
+"""
+from __future__ import annotations
+
+import dataclasses
+from collections import OrderedDict
+from typing import Dict, List, Optional, Set, Tuple
+
+__all__ = ["PrefixIndex", "PrefixMatch"]
+
+# chain-root seed (arbitrary odd 64-bit constant): the hash "prefix" of the
+# empty token sequence, so chunk 0 keys differ from raw tuple hashes
+_ROOT = 0x9E3779B97F4A7C15
+
+
+@dataclasses.dataclass
+class PrefixMatch:
+    """Result of a :meth:`PrefixIndex.lookup`.
+
+    ``pages`` are fully-shared immutable pages to map read-only (the caller
+    takes a refcount on each); ``cow_src`` (when set) is a partially-valid
+    boundary page whose first ``cow_valid`` rows match the prompt — the
+    caller must snapshot it into a private page before writing.
+    ``n_tokens == len(pages) * page_size + cow_valid`` is how much prefill
+    the match saves."""
+    pages: List[int]
+    n_tokens: int
+    cow_src: Optional[int] = None
+    cow_valid: int = 0
+
+
+@dataclasses.dataclass
+class _Entry:
+    page: int
+    tokens: Tuple[int, ...]   # this chunk's tokens (len == page_size if full)
+    prev: int                 # chain key of the preceding prefix
+    full: bool
+
+
+class PrefixIndex:
+    """Chained-hash prefix index: page-aligned token chunks → physical page.
+
+    Pure host-side bookkeeping (no device state).  One physical page holds
+    at most one entry at a time: a page is published once, during its
+    owner's prefill, and cannot be recycled while the entry lives (the
+    engine's refcount pins it), so entry↔page is one-to-one.
+    """
+
+    def __init__(self, page_size: int, max_entries: int = 4096):
+        self.page_size = int(page_size)
+        self.max_entries = int(max_entries)
+        if self.max_entries < 1:
+            raise ValueError(f"max_entries={max_entries} must be >= 1")
+        self._entries: "OrderedDict[object, _Entry]" = OrderedDict()
+        # prev chain key -> keys of partial boundary entries published under
+        # it (candidates for the longest-common-prefix boundary match)
+        self._children: Dict[int, Set[object]] = {}
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def pages(self) -> List[int]:
+        """All physical pages currently pinned by index entries (each holds
+        one engine refcount) — the 'cached' component of the pool
+        invariant."""
+        return [e.page for e in self._entries.values()]
+
+    @staticmethod
+    def _chain(prev: int, chunk: Tuple[int, ...]) -> int:
+        return hash((prev, chunk))
+
+    # ----------------------------------------------------------- lookup
+
+    def lookup(self, ids, limit: int) -> PrefixMatch:
+        """Longest resident prefix of ``ids[:limit]``.
+
+        ``limit`` caps the match (the engine passes ``len(prompt) - 1`` so
+        at least one token always goes through prefill — the first
+        generated token is read off the last real prefill position).
+        Matched entries are LRU-touched.  Exact: every matched chunk's
+        stored tokens are compared verbatim, so a chain-hash collision is a
+        miss, never a wrong page."""
+        tup = tuple(int(t) for t in ids[:max(0, int(limit))])
+        ps = self.page_size
+        h = _ROOT
+        pages: List[int] = []
+        n = 0
+        while n + ps <= len(tup):
+            chunk = tup[n:n + ps]
+            key = self._chain(h, chunk)
+            e = self._entries.get(key)
+            if e is None or not e.full or e.prev != h or e.tokens != chunk:
+                break
+            pages.append(e.page)
+            self._entries.move_to_end(key)
+            h, n = key, n + ps
+        # boundary: the partial entry under this chain with the longest
+        # common prefix against the remaining tokens (COW candidates)
+        rem = tup[n:]
+        best_j, best_key, best_page = 0, None, None
+        for pk in self._children.get(h, ()):
+            e = self._entries.get(pk)
+            if e is None:
+                continue
+            j = 0
+            for a, b in zip(e.tokens, rem):
+                if a != b:
+                    break
+                j += 1
+            if j > best_j:
+                best_j, best_key, best_page = j, pk, e.page
+        if best_key is not None:
+            self._entries.move_to_end(best_key)
+            return PrefixMatch(pages=pages, n_tokens=n + best_j,
+                               cow_src=best_page, cow_valid=best_j)
+        return PrefixMatch(pages=pages, n_tokens=n)
+
+    # ---------------------------------------------------------- publish
+
+    def publish(self, ids, pages: List[int]) -> Tuple[List[int], List[int]]:
+        """Register the prompt ``ids`` whose logical pages are ``pages``
+        (physical ids, chunk order — the slot's page-table row).
+
+        Full chunks (entirely inside the prompt → immutable) register under
+        their chain key; a trailing partial chunk registers as a COW
+        boundary entry.  Existing identical entries are LRU-touched, not
+        replaced (their page already serves lookups; churning refs for an
+        equal mapping buys nothing).  Returns ``(newly, released)`` page
+        lists: the engine acquires one refcount per ``newly`` page and
+        drops one per ``released`` page (collision replacements and
+        LRU-cap evictions)."""
+        tup = tuple(int(t) for t in ids)
+        ps = self.page_size
+        newly: List[int] = []
+        released: List[int] = []
+        h = _ROOT
+        i = 0
+        while (i + 1) * ps <= len(tup):
+            chunk = tup[i * ps:(i + 1) * ps]
+            key = self._chain(h, chunk)
+            e = self._entries.get(key)
+            if e is not None and e.prev == h and e.tokens == chunk:
+                self._entries.move_to_end(key)
+            else:
+                if e is not None:
+                    # chain-hash collision: replace outright — INCLUDING
+                    # every entry published under the collided key's chain
+                    # (deeper full chunks and partial boundary children).
+                    # They describe a DIFFERENT prefix; left reachable, the
+                    # new chain would verify their per-chunk tokens yet map
+                    # K/V computed under the old prefix — the one way a
+                    # collision could serve wrong pages instead of a miss.
+                    released.extend(self._remove_subtree(key))
+                self._entries[key] = _Entry(page=pages[i], tokens=chunk,
+                                            prev=h, full=True)
+                newly.append(pages[i])
+            h, i = key, i + 1
+        part = tup[i * ps:]
+        if part:
+            pk = ("p", h, part)
+            if pk in self._entries:
+                self._entries.move_to_end(pk)
+            else:
+                self._entries[pk] = _Entry(page=pages[i], tokens=part,
+                                           prev=h, full=False)
+                self._children.setdefault(h, set()).add(pk)
+                newly.append(pages[i])
+        while len(self._entries) > self.max_entries:
+            released.extend(self.evict(1))
+        return newly, released
+
+    # ----------------------------------------------------------- evict
+
+    def _remove(self, key) -> int:
+        e = self._entries.pop(key)
+        if not e.full:
+            kids = self._children.get(e.prev)
+            if kids is not None:
+                kids.discard(key)
+                if not kids:
+                    del self._children[e.prev]
+        return e.page
+
+    def _remove_subtree(self, key) -> List[int]:
+        """Remove the entry at ``key`` plus every descendant chained under
+        it (deeper full chunks and partial boundary children); returns
+        their pages.  Only the collision-replacement path calls this, so
+        the O(entries) scan per level never runs in practice."""
+        pages = [self._remove(key)]
+        stack = [key]
+        while stack:
+            h = stack.pop()
+            for pk in list(self._children.get(h, ())):
+                pages.append(self._remove(pk))
+            kids = [k for k, e in self._entries.items()
+                    if e.full and e.prev == h]
+            for k in kids:
+                pages.append(self._remove(k))
+            stack.extend(kids)
+        return pages
+
+    def evict(self, n: int = 1) -> List[int]:
+        """Drop the ``n`` least-recently-used entries; returns their pages
+        (one engine refcount each to release).  A released page only
+        becomes reusable once every OTHER reference (a slot still decoding
+        through it) is gone — the engine's refcount arbitrates."""
+        released: List[int] = []
+        for _ in range(min(n, len(self._entries))):
+            key = next(iter(self._entries))
+            released.append(self._remove(key))
+            self.evictions += 1
+        return released

@@ -28,8 +28,23 @@ Scheduling policy (documented, deliberately simple): FIFO admission with
 head-of-line blocking (no request skipping, so no starvation), and pages for
 the whole request (prompt + max_new) are reserved at admission — a running
 slot can never run out of pages mid-flight, so there is no preemption/swap
-path to get wrong.  Lazy page allocation + preemption is future work (see
-docs/SERVING.md).
+path to get wrong.
+
+Cross-request KV reuse (docs/SERVING.md "Cross-request KV reuse"): physical
+pages are REFCOUNTED and immutable-once-full, and a prefix index
+(``prefix_cache.PrefixIndex``: rolling hash over page-aligned token chunks →
+physical page) lets a request whose prompt prefix is already resident map
+the shared pages into its page table and prefill only the unshared tail —
+copy-on-write applies to the one partial boundary page (a fixed-shape
+snapshot program; see ``models.transformer.cow_copy_page``).  Admission
+reserves only unshared pages; retirement, expiry and quarantine DROP
+refcounts instead of freeing, and the index holds one refcount per cached
+page so hot prefixes survive their donors.  The pool invariant becomes
+``free + quarantined + referenced == num_pages - 1``
+(:meth:`ServingEngine.page_accounting`).  Sharing is pure page-table
+indirection: the program inventory is unchanged at steady state and
+shared-prefix outputs stay token-exact with the unshared path (K/V at
+position ``t`` is a pure function of tokens ``0..t``).
 
 Generation is greedy (the continuous-batching contract is token-identical
 outputs to per-request ``generate(greedy=True)``; per-slot sampling state is
@@ -73,14 +88,26 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..models.transformer import PAGE_SIZE
+from ..models.transformer import PAGE_SIZE, cow_copy_page
 from ..observability.trace import trace_count, trace_span
 from ..resilience import (SITE_SERVE_ADMIT, SITE_SERVE_DECODE,
                           SITE_SERVE_PREFILL, SITE_SERVE_TICK, maybe_fire)
 from ..utils.logging import log_dist, logger
 from .engine import InferenceEngine
+from .prefix_cache import PrefixIndex, PrefixMatch
 
 _bucket = InferenceEngine._bucket   # shared prompt-length bucketing (pow2>=16)
+
+# process-global COW page-copy programs, keyed by donation (jax.jit caches on
+# argument avals, so every engine with the same pool shape/dtype — notably a
+# warm-restart replacement — shares ONE compile per process)
+_COW_PROGS: Dict[bool, Any] = {}
+
+# a COW boundary match must save at least this much prefill to be worth a
+# cross-layer page snapshot — a 1-token match (first tokens coinciding by
+# chance, ~1/vocab per prompt pair) would pay a pool-shaped copy to skip one
+# token of prefill
+MIN_COW_TOKENS = 2
 
 
 class ServeTimeout(RuntimeError):
@@ -153,6 +180,13 @@ class RequestResult:
     # (shed / queue-expired) carry 0/0.
     decode_ticks: int = 0
     replays: int = 0
+    # prompt tokens served from the prefix index at admission (shared full
+    # pages + the COW boundary) instead of being re-prefilled — 0 on a cold
+    # admission or when prefix caching is disabled.  For a replayed request
+    # this is the LAST incarnation's share (its replay prompt includes the
+    # already-generated tokens, which often re-share against the rebuilt
+    # index).
+    shared_prefix_tokens: int = 0
 
     @property
     def ttft_s(self) -> float:
@@ -172,12 +206,13 @@ class RequestResult:
 @dataclasses.dataclass
 class _Slot:
     request: Request
-    pages: List[int]
+    pages: List[int]            # shared prefix pages first, then private
     tokens: List[int]
     bucket: int
     arrival_s: float
     admit_s: float
     first_token_s: float
+    shared_tokens: int = 0      # prompt tokens mapped from the prefix index
 
 
 class ServingEngine:
@@ -194,7 +229,9 @@ class ServingEngine:
                  max_model_len: Optional[int] = None, monitor=None,
                  watchdog=None, dtype=None, mesh=None,
                  max_queue: Optional[int] = None, quarantine_limit: int = 2,
-                 probe_after_ticks: Optional[int] = None):
+                 probe_after_ticks: Optional[int] = None,
+                 prefix_cache: bool = True,
+                 prefix_index_entries: int = 4096):
         if not hasattr(model, "apply_paged"):
             raise ValueError(
                 "ServingEngine needs a model with the paged decode contract "
@@ -255,6 +292,21 @@ class ServingEngine:
             self._kpool = jax.device_put(cache["k"], cache["k"].sharding)
             self._vpool = jax.device_put(cache["v"], cache["v"].sharding)
         self._free_pages: List[int] = list(range(self.num_pages - 1, 0, -1))
+        # per-page reference counts (page 0, the trash page, is never
+        # counted): 0 = free or quarantined, >0 = held by slots and/or the
+        # prefix index.  Pages return to the free list only at refcount 0,
+        # so an indexed page's contents can never be recycled under a
+        # reader (docs/SERVING.md "Cross-request KV reuse").
+        self._refcount = np.zeros((self.num_pages,), np.int64)
+        self._prefix = (PrefixIndex(self.page_size,
+                                    max_entries=prefix_index_entries)
+                        if prefix_cache else None)
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_shared_tokens = 0
+        self.prefix_pages_shared = 0   # full pages mapped instead of prefilled
+        self.cow_copies = 0
+        self._pages_hwm = 0            # high-water mark of occupied pages
         self._page_table = np.zeros((self.b_slots, self.pages_per_slot),
                                     np.int32)
         self._lengths = np.zeros((self.b_slots,), np.int32)
@@ -305,6 +357,13 @@ class ServingEngine:
         self._donate = (1, 2) if jax.default_backend() != "cpu" else ()
         self._decode_prog = self._build_decode()
         self._prefill_progs: Dict[int, Any] = {}
+        self._cow_prog = self._build_cow() if prefix_cache else None
+        if self._cow_prog is not None:
+            # pre-warm the one COW program shape with a trash-page self-copy
+            # so its single compile lands at init, never during admission —
+            # the zero-recompile steady state must hold from the first tick
+            self._kpool, self._vpool = self._cow_prog(
+                self._kpool, self._vpool, jnp.int32(0), jnp.int32(0))
         log_dist(
             f"serving engine ready: b_slots={self.b_slots} "
             f"pages={self.num_pages}x{self.page_size} "
@@ -329,29 +388,137 @@ class ServingEngine:
     def _build_prefill(self, s_pad: int):
         apply_paged = self.model.apply_paged
 
-        def prog(params, kpool, vpool, pt_row, tokens, n_real):
+        def prog(params, kpool, vpool, pt_row, tokens, n_real, start):
             # tokens [1, s_pad] right-padded; only the first n_real K/V are
             # written (pads go to the trash page); the first generated token
-            # is argmax of the last REAL position's logits
+            # is argmax of the last REAL position's logits.  `start` is the
+            # slot position of tokens[:, 0] — 0 for a cold prefill, the
+            # shared-prefix length for a tail prefill (the gather still
+            # covers the whole page-table row, so queries attend to the
+            # shared pages through the ordinary causal mask).  A traced
+            # scalar: every start shares ONE program per bucket.
             seq_mask = (jnp.arange(s_pad, dtype=jnp.int32) < n_real)[None, :]
             cache = {"k": kpool, "v": vpool}
             logits, cache = apply_paged(params, tokens, cache, pt_row,
-                                        jnp.zeros((1,), jnp.int32), seq_mask)
+                                        start[None], seq_mask)
             nxt = jnp.argmax(logits[0, n_real - 1, :], axis=-1)
             return nxt.astype(jnp.int32), cache["k"], cache["v"]
 
         return jax.jit(prog, donate_argnums=self._donate)
 
+    def _build_cow(self):
+        # process-global jit (see _COW_PROGS): a replacement engine's init
+        # prewarm then hits the jit cache on the same pool avals instead of
+        # recompiling a fresh closure inside the warm-restart critical path
+        donate = jax.default_backend() != "cpu"
+        prog = _COW_PROGS.get(donate)
+        if prog is None:
+            prog = _COW_PROGS[donate] = jax.jit(
+                cow_copy_page, donate_argnums=(0, 1) if donate else ())
+        return prog
+
     def program_inventory(self) -> Dict[str, Any]:
         """The full set of program shapes this engine has built: one decode
-        step + one prefill per prompt bucket.  Constant at steady state —
-        admission never grows it beyond the bucket set."""
-        return {"decode": 1, "prefill_buckets": sorted(self._prefill_progs)}
+        step + one prefill per prompt bucket (+ the one fixed-shape COW
+        page copy when prefix caching is on, compiled at init).  Constant
+        at steady state — admission never grows it beyond the bucket set."""
+        inv = {"decode": 1, "prefill_buckets": sorted(self._prefill_progs)}
+        if self._cow_prog is not None:
+            inv["cow"] = 1
+        return inv
 
     # ---------------------------------------------------------- scheduling
 
     def _pages_needed(self, req: Request) -> int:
         return -(-(len(req.input_ids) + req.max_new_tokens) // self.page_size)
+
+    # ------------------------------------------------- page refcounting
+
+    def _alloc_pages(self, n: int) -> List[int]:
+        """Pop ``n`` free pages and take the first reference on each."""
+        pages = [self._free_pages.pop() for _ in range(n)]
+        for p in pages:
+            self._refcount[p] = 1
+        occupied = (self.num_pages - 1) - len(self._free_pages)
+        if occupied > self._pages_hwm:
+            self._pages_hwm = occupied
+        return pages
+
+    def _share_page(self, p: int) -> None:
+        self._refcount[p] += 1
+
+    def _drop_page(self, p: int) -> None:
+        """Release one reference; the last reference frees the page.  A
+        negative count means a double-free — fail loudly, the pool can no
+        longer be trusted."""
+        c = int(self._refcount[p]) - 1
+        if c < 0:
+            raise RuntimeError(
+                f"page {p} dropped below zero references — double-free "
+                "(page accounting is corrupt; rebuild the engine)")
+        self._refcount[p] = c
+        if c == 0:
+            self._free_pages.append(p)
+
+    def _leak_pages(self, pages: List[int]) -> None:
+        """Quarantine path: zero the refs WITHOUT freeing — suspect
+        contents are leaked-and-accounted, never recycled."""
+        for p in pages:
+            self._refcount[p] = 0
+        self._quarantined_pages.extend(pages)
+
+    def page_accounting(self) -> Dict[str, Any]:
+        """The refcount pool invariant, one call: every page (minus the
+        trash page) is exactly one of free, quarantined, or referenced
+        (held by slots and/or the prefix index).  ``balanced`` is what the
+        chaos tests assert after every kill; ``cached`` counts pages the
+        prefix index pins (a subset of ``referenced``)."""
+        referenced = int((self._refcount[1:] > 0).sum())
+        free = len(self._free_pages)
+        quarantined = len(self._quarantined_pages)
+        return {
+            "free": free,
+            "quarantined": quarantined,
+            "referenced": referenced,
+            # entry↔page is one-to-one (PrefixIndex pins each published
+            # page until its entry dies), so the entry count IS the
+            # distinct-page count — O(1), and health() polls this per
+            # request.  A one-to-one violation still trips the chaos
+            # audits: duplicate entries would push cached ABOVE the
+            # quiescent referenced count.
+            "cached": len(self._prefix) if self._prefix is not None else 0,
+            "total": self.num_pages - 1,
+            "balanced": free + quarantined + referenced
+            == self.num_pages - 1,
+        }
+
+    def _prefix_lookup(self, req: Request) -> PrefixMatch:
+        """Longest resident prefix for ``req`` (capped at prompt-1 so at
+        least one token always goes through prefill — the first generated
+        token reads off the last real prefill position)."""
+        if self._prefix is None or len(self._prefix) == 0:
+            return PrefixMatch(pages=[], n_tokens=0)
+        with trace_span("serve.prefix_match", rid=req.rid):
+            m = self._prefix.lookup(req.input_ids,
+                                    limit=len(req.input_ids) - 1)
+        if m.cow_src is not None and m.cow_valid < MIN_COW_TOKENS:
+            # not worth a pool-shaped page snapshot: keep the full-page
+            # share, prefill the boundary tokens like any other tail
+            return PrefixMatch(pages=m.pages,
+                               n_tokens=len(m.pages) * self.page_size)
+        return m
+
+    def _reclaim_cached(self, n_pages: int) -> None:
+        """Pool pressure: evict LRU prefix entries until ``n_pages`` more
+        pages are actually free (an evicted page still held by a decoding
+        slot frees nothing yet — keep going) or the index is exhausted."""
+        freed = 0
+        while freed < n_pages and self._prefix is not None \
+                and len(self._prefix):
+            before = len(self._free_pages)
+            for p in self._prefix.evict(1):
+                self._drop_page(p)
+            freed += len(self._free_pages) - before
 
     def _arrival_abs(self, req: Request) -> float:
         """Absolute arrival stamp: the rebased epoch when the request rode
@@ -496,48 +663,95 @@ class ServingEngine:
                             and not self._quarantined[i])
             except StopIteration:
                 break
-            need = self._pages_needed(req)
-            if len(self._free_pages) < need:
-                break   # FIFO head-of-line blocking: wait for retirements
-            with trace_span("serve.admit", rid=req.rid, slot=slot):
-                self._admit_one(req, slot, need, now)
+            match = self._prefix_lookup(req)
+            # pin the matched pages (incl. the COW source) for the span of
+            # this admission: reclaim below — or a concurrent eviction by
+            # the index's own LRU cap — must never free a matched page
+            # back into the pool it is about to be mapped from
+            pinned = list(match.pages)
+            if match.cow_src is not None:
+                pinned.append(match.cow_src)
+            for p in pinned:
+                self._share_page(p)
+            admitted = freed_pins = False
+            try:
+                need = self._pages_needed(req) - len(match.pages)
+                if len(self._free_pages) < need:
+                    # evict cached-but-idle prefix pages before blocking:
+                    # a cache must never starve admission
+                    self._reclaim_cached(need - len(self._free_pages))
+                if len(self._free_pages) >= need:
+                    with trace_span("serve.admit", rid=req.rid, slot=slot):
+                        self._admit_one(req, slot, match, need, now)
+                    admitted = True
+            finally:
+                # the slot takes its own references inside _admit_one; the
+                # lookup pins existed only to survive reclaim.  If reclaim
+                # evicted the head's OWN matched entries, our pins are now
+                # the last references — dropping them frees the pages.
+                if not admitted:
+                    freed_pins = any(self._refcount[p] == 1 for p in pinned)
+                for p in pinned:
+                    self._drop_page(p)
+            if admitted:
+                continue
+            if freed_pins:
+                # pool pressure evicted the head's own matched prefix from
+                # the index, and the pages came free the instant the pins
+                # dropped — retry the head with a fresh (smaller) lookup
+                # instead of misreading this as head-of-line blocking.
+                # Terminates: each retry means the index strictly shrank.
+                continue
+            break   # head-of-line: wait for retirements
 
-    def _admit_one(self, req: Request, slot: int, need: int,
-                   now: float) -> None:
-        """Pop the queue head into ``slot`` and prefill it (one admission —
-        the ``serve.admit`` span/fault unit)."""
+    def _admit_one(self, req: Request, slot: int, match: PrefixMatch,
+                   need: int, now: float) -> None:
+        """Pop the queue head into ``slot`` and prefill its unshared tail
+        (one admission — the ``serve.admit`` span/fault unit).  ``match``
+        is the resident prefix (``need`` excludes its full pages): the
+        slot takes one reference per shared page and allocates only the
+        private remainder."""
         # fire BEFORE the pop: a raise-kind injected fault must leave the
         # request queued (recoverable), not silently dropped
         maybe_fire(SITE_SERVE_ADMIT, rid=req.rid, slot=slot)
         self._queue.popleft()
         if req.deadline_s is not None:
             self._waiting_deadlines -= 1
-        pages = [self._free_pages.pop() for _ in range(need)]
+        shared = list(match.pages)
+        for p in shared:
+            self._share_page(p)
+        pages = self._alloc_pages(need)
         try:
-            self._prefill(slot, req, pages, now)
+            self._prefill(slot, req, shared, pages, match, now)
         except BaseException as e:
             # a failed prefill (transient device error, injected fault)
             # must not leak its reservation or drop the request.  If the
             # slot never registered, unwind — request back at the head —
             # and count the failure against the slot: quarantine_limit
-            # consecutive failures fence it, with THIS attempt's pages
-            # leaked into the quarantine account (suspect contents are
-            # never recycled) and scheduling continuing on the rest of
-            # the fleet.  If the slot did register (failure in the
-            # post-launch bookkeeping), it owns the pages and the next
-            # run continues it.  NOTE: with donation enabled a failed
-            # DEVICE call also consumes the pool — step() then refuses
-            # with PoolConsumedError; the unwind still leaves the queue
+            # consecutive failures fence it, with THIS attempt's PRIVATE
+            # pages leaked into the quarantine account (suspect contents
+            # are never recycled) and scheduling continuing on the rest
+            # of the fleet.  Shared pages were read-only in the attempt
+            # and other slots may be decoding through them right now —
+            # they are never quarantined, their references just drop.
+            # If the slot did register (failure in the post-launch
+            # bookkeeping), it owns the pages and the next run continues
+            # it.  NOTE: with donation enabled a failed DEVICE call also
+            # consumes the pool — step() then refuses with
+            # PoolConsumedError; the unwind still leaves the queue
             # replayable (ServingSupervisor rebuilds + replays).
             if self._slots[slot] is None:
                 self._page_table[slot, :] = 0
                 self._queue.appendleft(req)
                 if req.deadline_s is not None:
                     self._waiting_deadlines += 1
+                for p in shared:
+                    self._drop_page(p)
                 if not isinstance(e, Exception):
                     # KeyboardInterrupt/SystemExit is the operator, not
                     # the slot: plain unwind, no quarantine accounting
-                    self._free_pages.extend(pages)
+                    for p in pages:
+                        self._drop_page(p)
                     raise
                 self._slot_failures[slot] += 1
                 self._last_failure_tick = self._tick
@@ -545,7 +759,7 @@ class ServingEngine:
                 fenced = fails >= self.quarantine_limit
                 if fenced:
                     self._quarantined[slot] = True
-                    self._quarantined_pages.extend(pages)
+                    self._leak_pages(pages)
                     # remembered per slot so a later successful canary
                     # probe can hand exactly these pages back to the pool
                     self._quarantine_pages_by_slot[slot] = list(pages)
@@ -556,7 +770,8 @@ class ServingEngine:
                         "accounted, %d slot(s) remain", slot, fails,
                         len(pages), self._usable_slots())
                 else:
-                    self._free_pages.extend(pages)
+                    for p in pages:
+                        self._drop_page(p)
                 raise SlotPrefillError(
                     f"prefill failed in slot {slot} for request "
                     f"{req.rid!r} (failure {fails}/"
@@ -566,36 +781,75 @@ class ServingEngine:
                     quarantined=fenced) from e
             raise
 
-    def _prefill(self, slot: int, req: Request, pages: List[int],
-                 now: float) -> None:
+    def _prefill(self, slot: int, req: Request, shared: List[int],
+                 private: List[int], match: PrefixMatch, now: float) -> None:
+        """Prefill ``req`` into ``slot``: the page-table row maps the
+        shared prefix pages first, then the private allocation; only the
+        UNSHARED tail of the prompt runs through the prefill program
+        (``start`` = shared token count), attending to the shared pages
+        through the ordinary causal gather.  When the match ends mid-page,
+        the donor's partial boundary page is first snapshotted into this
+        slot's own boundary page (copy-on-write)."""
         S = len(req.input_ids)
-        s_pad = _bucket(S)
+        n_shared = match.n_tokens
+        pages = shared + private
+        tail = req.input_ids[n_shared:]
+        S_tail = len(tail)   # >= 1: lookup is capped at prompt-1
+        s_pad = _bucket(S_tail)
         prog = self._prefill_progs.get(s_pad)
         if prog is None:
             prog = self._prefill_progs[s_pad] = self._build_prefill(s_pad)
         self._page_table[slot, :] = 0
         self._page_table[slot, :len(pages)] = pages
         toks = np.zeros((1, s_pad), np.int32)
-        toks[0, :S] = req.input_ids
+        toks[0, :S_tail] = tail
         with trace_span("serve.prefill", rid=req.rid, slot=slot,
-                        bucket=s_pad):
+                        bucket=s_pad, shared_tokens=n_shared):
             maybe_fire(SITE_SERVE_PREFILL, rid=req.rid, slot=slot)
             with self._armed(f"serve.prefill rid={req.rid!r}"):
+                if match.cow_src is not None:
+                    # COW the partial boundary page: private[0] is the
+                    # boundary logical page (shared full pages cover
+                    # exactly len(shared) logical pages before it).  Rows
+                    # past cow_valid in the snapshot are donor garbage the
+                    # tail prefill/decode overwrites before causality can
+                    # expose them.
+                    self._kpool, self._vpool = self._cow_prog(
+                        self._kpool, self._vpool,
+                        jnp.int32(match.cow_src), jnp.int32(private[0]))
+                    self.cow_copies += 1
                 nxt, self._kpool, self._vpool = prog(
                     self.params, self._kpool, self._vpool,
                     jnp.asarray(self._page_table[slot:slot + 1]),
-                    jnp.asarray(toks), jnp.int32(S))
+                    jnp.asarray(toks), jnp.int32(S_tail),
+                    jnp.int32(n_shared))
                 tok = int(nxt)   # host fetch inside the watchdog window
         t = time.monotonic()
         self._slot_failures[slot] = 0   # quarantine counts CONSECUTIVE fails
         self._slots[slot] = _Slot(
             request=req, pages=pages, tokens=[tok], bucket=s_pad,
             arrival_s=self._arrival_abs(req), admit_s=self._t0 + now,
-            first_token_s=t)
+            first_token_s=t, shared_tokens=n_shared)
         self._lengths[slot] = S
         self._last_tok[slot] = tok
         self._active[slot] = True
         self._tokens_out += 1
+        if self._prefix is not None:
+            if n_shared > 0:
+                self.prefix_hits += 1
+                self.prefix_shared_tokens += n_shared
+                self.prefix_pages_shared += len(shared)
+            else:
+                self.prefix_misses += 1
+            # publish this prompt's chunks (full pages + the partial
+            # boundary) so later requests can share them; the index takes
+            # one reference per new entry.  Shared chunks just LRU-touch
+            # their existing entries.
+            newly, released = self._prefix.publish(req.input_ids, pages)
+            for p in newly:
+                self._share_page(p)
+            for p in released:
+                self._drop_page(p)
         if self.monitor is not None:
             self.monitor.write_events([
                 ("serve/ttft_s", t - self._arrival_abs(req), self._tick)])
@@ -647,7 +901,8 @@ class ServingEngine:
             first_token_s=st.first_token_s, finish_s=time.monotonic(),
             # the prefill produced tokens[0]; every later token is one
             # decode-program invocation (the request's timeline tick count)
-            decode_ticks=len(st.tokens) - 1)
+            decode_ticks=len(st.tokens) - 1,
+            shared_prefix_tokens=st.shared_tokens)
         if reason == "deadline":
             self.deadline_count += 1
         else:
@@ -658,7 +913,11 @@ class ServingEngine:
                                    else 0.8 * self._ema_service_s + 0.2 * dt)
         self._results[st.request.rid] = result
         self._finished_order.append(st.request.rid)
-        self._free_pages.extend(st.pages)
+        # drop one reference per page — shared pages stay resident for
+        # their other readers (and the prefix index), private pages whose
+        # last reference this was return to the free list
+        for p in st.pages:
+            self._drop_page(p)
         self._slots[slot] = None
         self._active[slot] = False
         self._lengths[slot] = 0
@@ -703,7 +962,7 @@ class ServingEngine:
                     nxt, self._kpool, self._vpool = prog(
                         self.params, self._kpool, self._vpool,
                         jnp.asarray(self._page_table[slot:slot + 1]),
-                        jnp.asarray(toks), jnp.int32(1))
+                        jnp.asarray(toks), jnp.int32(1), jnp.int32(0))
                     int(nxt)   # host fetch: the probe must really complete
         except BaseException as e:
             self._page_table[slot, :] = 0
@@ -845,15 +1104,17 @@ class ServingEngine:
                             "the engine (ServingSupervisor restarts + "
                             "replays automatically)")
                     # the step above ended with every usable slot free and
-                    # STILL could not admit the head: the pool genuinely
-                    # cannot hold it — quarantined slots leaked enough
-                    # pages, or (a bug) pages leaked silently
+                    # STILL could not admit the head (after prefix-cache
+                    # reclaim): the pool genuinely cannot hold it —
+                    # quarantined slots leaked enough pages, or (a bug)
+                    # references leaked silently
                     req = self._queue[0]
+                    acct = self.page_accounting()
                     raise RuntimeError(
                         f"admission deadlock: request {req.rid!r} needs "
                         f"{self._pages_needed(req)} pages, "
-                        f"{len(self._free_pages)} free "
-                        f"({len(self._quarantined_pages)} quarantined) "
+                        f"{acct['free']} free ({acct['quarantined']} "
+                        f"quarantined, {acct['referenced']} referenced) "
                         f"with no slot active")
         return self.take_results()
 
@@ -884,6 +1145,7 @@ class ServingEngine:
         balancer / readiness probe polls.  Mirrors the ``serve/*`` gauges
         plus the resilience counters and page accounting."""
         now = time.monotonic()
+        acct = self.page_accounting()
         return {
             "tick": self._tick,
             "pool_alive": self.pool_alive(),
@@ -892,12 +1154,27 @@ class ServingEngine:
             "active_slots": int(self._active.sum()),
             "usable_slots": self._usable_slots(),
             "quarantined_slots": int(self._quarantined.sum()),
-            "free_pages": len(self._free_pages),
-            "quarantined_pages": len(self._quarantined_pages),
+            "free_pages": acct["free"],
+            "quarantined_pages": acct["quarantined"],
+            # occupancy for capacity sizing: current referenced pages and
+            # the high-water mark — operators size num_pages off these
+            # (surfaced on /metrics via the serve/* gauges too)
+            "referenced_pages": acct["referenced"],
+            "cached_pages": acct["cached"],
+            "pages_hwm": self._pages_hwm,
             "shed_total": self.shed_count,
             "deadline_expired_total": self.deadline_count,
             "probes_total": self.probe_count,
             "unfenced_total": self.unfence_count,
+            "prefix_hits_total": self.prefix_hits,
+            "prefix_misses_total": self.prefix_misses,
+            "prefix_shared_tokens_total": self.prefix_shared_tokens,
+            "prefix_pages_shared_total": self.prefix_pages_shared,
+            "prefix_evictions_total": (self._prefix.evictions
+                                       if self._prefix is not None else 0),
+            "prefix_index_entries": (len(self._prefix)
+                                     if self._prefix is not None else 0),
+            "cow_copies_total": self.cow_copies,
             "oldest_request_age_s": round(self._oldest_age_s(now), 4),
             "retry_after_hint_s": self._retry_after_hint(),
             "unclaimed_results": len(self._finished_order),
@@ -948,6 +1225,21 @@ class ServingEngine:
              self._tick),
             ("serve/probes_total", float(self.probe_count), self._tick),
             ("serve/unfenced_total", float(self.unfence_count), self._tick),
+            ("serve/referenced_pages",
+             float((self._refcount[1:] > 0).sum()), self._tick),
+            ("serve/pages_hwm", float(self._pages_hwm), self._tick),
+            ("serve/prefix_hits_total", float(self.prefix_hits), self._tick),
+            ("serve/prefix_misses_total", float(self.prefix_misses),
+             self._tick),
+            ("serve/prefix_shared_tokens_total",
+             float(self.prefix_shared_tokens), self._tick),
+            ("serve/prefix_index_entries",
+             float(len(self._prefix) if self._prefix is not None else 0),
+             self._tick),
+            ("serve/prefix_evictions_total",
+             float(self._prefix.evictions if self._prefix is not None
+                   else 0), self._tick),
+            ("serve/cow_copies_total", float(self.cow_copies), self._tick),
             ("serve/oldest_request_age_s",
              self._oldest_age_s(time.monotonic()), self._tick),
         ])

@@ -27,6 +27,23 @@ caller-supplied factory and drives the same ``run``/``submit``/``health``/
    suspended during replay: a request the engine already accepted is never
    shed by its own recovery).
 
+The replacement engine starts with an EMPTY prefix index (the dead pool's
+pages are gone) — replay rebuilds it organically: replayed requests are
+submitted in admission order, so the first re-prefill of each shared
+prefix re-publishes its pages and every later replay (and re-queued
+request) re-shares against them before prefilling only its tail.  No
+special-casing: re-sharing IS the normal admission path.
+
+A fault **mid-``drain()``** used to hand the affected in-flight requests
+back unserved, discarding their partial progress.  Now the supervisor
+warm-restarts, finishes the replayed in-flight requests on the replacement
+engine (drain's contract is "finish in-flight work"), and hands back only
+the requests that were never served — already-generated tokens are never
+thrown away, and the stitched results stay token-exact.  This holds across
+stacked mid-drain faults: a replay merely QUEUED on the replacement engine
+at the next fault (re-queued by a prefill unwind, or waiting for a slot)
+re-queues again rather than being demoted to "unserved".
+
 Slot-attributable prefill failures (``SlotPrefillError``) with a live pool
 do NOT restart — the engine already unwound the reservation, re-queued the
 request and counted the failure toward slot quarantine; the supervisor just
@@ -91,8 +108,20 @@ class ServingSupervisor:
         self._deadline_base = 0
         self._probe_base = 0
         self._unfence_base = 0
+        self._prefix_hits_base = 0
+        self._prefix_misses_base = 0
+        self._prefix_tokens_base = 0
+        self._prefix_pages_base = 0
+        self._prefix_evictions_base = 0
+        self._cow_base = 0
+        self._pages_hwm_base = 0
         self._quarantined_slots_lifetime = 0
         self._quarantined_pages_lifetime = 0
+        # mid-drain fault recovery: waiting requests stashed for hand-back
+        # (never re-served) + a flag that the replacement engine still owes
+        # the replayed in-flight requests a run to completion
+        self._drain_stash: List[Request] = []
+        self._drain_finish_pending = False
         # rid -> original request (result stitching + drain hand-off)
         self._orig: Dict[Any, Request] = {}
         # rid -> tokens decoded in previous engine incarnations; replay
@@ -125,6 +154,14 @@ class ServingSupervisor:
         results harvested across a restart keep their original order)."""
         for req in requests or []:
             self.submit(req)
+        if self._drain_stash:
+            # a drain abandoned mid-recovery (its ServeTimeout propagated
+            # before the hand-back) left never-served requests stashed;
+            # run()'s contract is completion, so it serves them instead of
+            # orphaning them with no terminal result
+            stash, self._drain_stash = self._drain_stash, []
+            for req in stash:
+                self.engine.submit(req)
         budget = max_ticks       # spent across ALL continuations/restarts —
         resume = False           # a repeating fault cannot stretch the bound
         while True:
@@ -157,6 +194,9 @@ class ServingSupervisor:
                 continue
             for res in finished:
                 self._collect(res)
+            # a successful run finished every queued replay, so a later
+            # drain() has no mid-drain recovery left to resume
+            self._drain_finish_pending = False
             order, self._order = self._order, []
             return [self._collected.pop(rid) for rid in order]
 
@@ -174,28 +214,60 @@ class ServingSupervisor:
 
     def drain(self, max_ticks: Optional[int] = None) -> List[Request]:
         """Stop admission and finish in-flight work; returns the ORIGINAL
-        request objects that were never served, for hand-off.  A fault
-        mid-drain warm-restarts and hands the affected requests back
-        unserved (their partial progress is discarded — the hand-off target
-        re-serves from the original prompt)."""
+        request objects that were never served, for hand-off.
+
+        A fault mid-drain warm-restarts and FINISHES the replayed in-flight
+        requests on the replacement engine — drain's contract is "finish
+        in-flight work", so partial progress is preserved and the stitched
+        results (already-generated tokens + the replayed continuation) stay
+        token-exact and claimable via :meth:`take_results`.  Only requests
+        that were still WAITING at the fault are handed back unserved
+        (``max_ticks`` bounds each recovery phase, like each drain
+        attempt)."""
+        resume = False
         while True:
             try:
+                if self._drain_finish_pending:
+                    # the mid-drain restart replayed in-flight work onto
+                    # the replacement engine (waiting requests sit in the
+                    # stash): run it to completion before closing admission.
+                    # run() CLAIMS its finished results — collect them here
+                    # or the stitched in-flight outputs are lost.
+                    for res in self.engine.run([], max_ticks=max_ticks,
+                                               resume=resume):
+                        self._collect(res)
+                    self._drain_finish_pending = False
+                    resume = False
                 unserved = self.engine.drain(max_ticks=max_ticks)
             except KeyboardInterrupt:
                 raise
             except ServeTimeout:
                 raise
+            except SlotPrefillError as e:
+                if self.engine.pool_alive():
+                    # the engine unwound and re-queued it — keep going on
+                    # the same pool (mirrors run(); resume keeps the
+                    # continued clock un-re-anchored)
+                    logger.warning("serve supervisor: continuing drain "
+                                   "past %s", e)
+                    resume = True
+                    continue
+                self._safe_restart(e, drain=True)
+                resume = False
+                continue
             except Exception as e:
-                self._safe_restart(e)
-                # the replacement engine holds the replays in its queue;
-                # draining it hands them back rather than re-serving them
-                self.engine._draining = True
+                self._safe_restart(e, drain=True)
+                resume = False
                 continue
             for res in self.engine.take_results():
                 self._collect(res)
             # hand back the ORIGINAL requests and release their tracking —
-            # the hand-off target owns them now
+            # the hand-off target owns them now.  Stashed requests (waiting
+            # at a mid-drain fault) follow the engine's unserved queue in
+            # admission order.
+            stash, self._drain_stash = self._drain_stash, []
             handed = [self._orig.pop(r.rid, r) for r in unserved]
+            handed.extend(self._orig.pop(r.rid, r) for r in stash)
             for r in handed:
                 self._prefix.pop(r.rid, None)
                 self._replay_count.pop(r.rid, None)
@@ -220,6 +292,13 @@ class ServingSupervisor:
         h["deadline_expired_total"] += self._deadline_base
         h["probes_total"] += self._probe_base
         h["unfenced_total"] += self._unfence_base
+        h["prefix_hits_total"] += self._prefix_hits_base
+        h["prefix_misses_total"] += self._prefix_misses_base
+        h["prefix_shared_tokens_total"] += self._prefix_tokens_base
+        h["prefix_pages_shared_total"] += self._prefix_pages_base
+        h["prefix_evictions_total"] += self._prefix_evictions_base
+        h["cow_copies_total"] += self._cow_base
+        h["pages_hwm"] = max(h["pages_hwm"], self._pages_hwm_base)
         h["quarantined_slots_lifetime"] = (self._quarantined_slots_lifetime
                                            + h["quarantined_slots"])
         h["quarantined_pages_lifetime"] = (self._quarantined_pages_lifetime
@@ -259,13 +338,15 @@ class ServingSupervisor:
         self._collected[res.rid] = res
         self._order.append(res.rid)
 
-    def _safe_restart(self, cause: BaseException) -> None:
+    def _safe_restart(self, cause: BaseException, drain: bool = False) -> None:
         """Restart until one succeeds; the budget check inside ``_restart``
         bounds the loop (restart-path faults, e.g. an injected
-        ``serve.replay`` raise, count a restart and are retried)."""
+        ``serve.replay`` raise, count a restart and are retried).
+        ``drain=True`` stashes waiting requests for hand-back instead of
+        re-queueing them (mid-``drain()`` recovery)."""
         while True:
             try:
-                self._restart(cause)
+                self._restart(cause, drain=drain)
                 return
             except KeyboardInterrupt:
                 raise
@@ -276,7 +357,7 @@ class ServingSupervisor:
                                "(%s: %s); retrying", type(e).__name__, e)
                 cause = e
 
-    def _restart(self, cause: BaseException) -> None:
+    def _restart(self, cause: BaseException, drain: bool = False) -> None:
         # post-mortem FIRST, before any state is touched: the flight
         # recorder still holds the failed attempt's spans (the poisoned
         # tick's serve.tick/serve.decode carry the exception type) plus
@@ -303,9 +384,10 @@ class ServingSupervisor:
         old = self.engine
         with trace_span("serve.restart", restart=self.restarts,
                         cause=type(cause).__name__):
-            self._restart_body(cause, old)
+            self._restart_body(cause, old, drain=drain)
 
-    def _restart_body(self, cause: BaseException, old: ServingEngine) -> None:
+    def _restart_body(self, cause: BaseException, old: ServingEngine,
+                      drain: bool = False) -> None:
         # (1) harvest everything that finished before the crash
         for res in old.take_results():
             self._collect(res)
@@ -359,8 +441,27 @@ class ServingSupervisor:
                                 generated=len(st.tokens)):
                     new.submit(replay)
                 replayed.append((req.rid, list(st.tokens)))
-            for req in waiting:
-                new.submit(req)
+            if drain:
+                # mid-drain recovery: never-served waiting requests are
+                # handed back, not re-served — stash them.  But a QUEUED
+                # request that carries replay state is an in-flight-origin
+                # replay from an EARLIER mid-drain restart (re-queued by a
+                # prefill unwind, or still waiting for a slot): its prompt
+                # embeds tokens generated before that restart, and drain's
+                # contract says those are never thrown away — it goes back
+                # on the replacement engine to finish.
+                stashed = 0
+                for req in waiting:
+                    if req.rid in self._prefix:
+                        new.submit(req)
+                    else:
+                        self._drain_stash.append(req)
+                        stashed += 1
+                self._drain_finish_pending = True
+            else:
+                stashed = 0
+                for req in waiting:
+                    new.submit(req)
         finally:
             new.max_queue = saved_max_queue
         # (6) commit: prefixes only once every submission landed, so a
@@ -372,6 +473,14 @@ class ServingSupervisor:
         self._deadline_base += old.deadline_count
         self._probe_base += old.probe_count
         self._unfence_base += old.unfence_count
+        self._prefix_hits_base += old.prefix_hits
+        self._prefix_misses_base += old.prefix_misses
+        self._prefix_tokens_base += old.prefix_shared_tokens
+        self._prefix_pages_base += old.prefix_pages_shared
+        self._prefix_evictions_base += (old._prefix.evictions
+                                        if old._prefix is not None else 0)
+        self._cow_base += old.cow_copies
+        self._pages_hwm_base = max(self._pages_hwm_base, old._pages_hwm)
         self._quarantined_slots_lifetime += int(old._quarantined.sum())
         self._quarantined_pages_lifetime += len(old._quarantined_pages)
         self.engine = new
@@ -379,7 +488,15 @@ class ServingSupervisor:
             "restart": self.restarts,
             "cause": f"{type(cause).__name__}: {cause}",
             "replayed_inflight": len(replayed),
-            "requeued": len(waiting),
+            # in drain mode never-served waiting requests are STASHED for
+            # hand-back; queued in-flight-origin replays still re-queue
+            "requeued": len(waiting) - stashed,
+            "stashed": stashed,
+            "mid_drain": drain,
+            # index entries lost with the dead pool; replay re-publishes
+            # organically through the normal admission path
+            "prefix_entries_dropped": (len(old._prefix)
+                                       if old._prefix is not None else 0),
             "programs_reused": reused,
             "at_tick": old._tick,
         }
@@ -390,7 +507,8 @@ class ServingSupervisor:
         log_dist(
             f"serve supervisor: warm restart {self.restarts}/"
             f"{self.max_restarts} after {entry['cause']} — replayed "
-            f"{len(replayed)} in-flight, re-queued {len(waiting)}, "
+            f"{len(replayed)} in-flight, re-queued {len(waiting) - stashed}, "
+            f"stashed {stashed}, "
             f"programs {'reused' if reused else 'rebuilt'}", ranks=[0])
 
     @staticmethod
@@ -428,5 +546,7 @@ class ServingSupervisor:
                 and new._donate == old._donate):
             new._decode_prog = old._decode_prog
             new._prefill_progs.update(old._prefill_progs)
+            # _cow_prog needs no adoption: it is the process-global
+            # _COW_PROGS jit, already shared by both engines
             return True
         return False

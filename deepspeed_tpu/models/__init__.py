@@ -13,15 +13,15 @@ import os
 import jax.numpy as jnp
 
 from .transformer import (CONFIGS, PAGE_SIZE, TransformerConfig, cache_specs,
-                          cross_entropy_loss, forward, forward_cached,
-                          forward_paged, get_config, has_moe, init_cache,
-                          init_paged_cache, init_params, paged_cache_specs,
-                          param_specs)
+                          cow_copy_page, cross_entropy_loss, forward,
+                          forward_cached, forward_paged, get_config, has_moe,
+                          init_cache, init_paged_cache, init_params,
+                          paged_cache_specs, param_specs)
 
 __all__ = ["CausalLM", "TransformerConfig", "CONFIGS", "get_config", "forward",
            "forward_cached", "forward_paged", "init_cache", "init_paged_cache",
            "cache_specs", "paged_cache_specs", "init_params", "param_specs",
-           "cross_entropy_loss", "PAGE_SIZE"]
+           "cross_entropy_loss", "PAGE_SIZE", "cow_copy_page"]
 
 
 class CausalLM:

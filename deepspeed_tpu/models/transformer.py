@@ -1500,6 +1500,18 @@ def init_paged_cache(cfg: TransformerConfig, num_pages: int,
     unallocated entries — its slot-indices always sit beyond every real
     query position, so the causal mask keeps it out of attention.  The
     serving engine hands out pages 1..num_pages-1.
+
+    Sharing contract (cross-request KV reuse): pages are **immutable once
+    full**.  A slot only ever writes at its own current position, which
+    advances monotonically, so a page whose whole ``page_size`` token span
+    lies behind the owner's position is never written again — its contents
+    are a pure function of the token prefix it holds (K/V at position ``t``
+    depends only on tokens ``0..t``), making it safe to map read-only into
+    any other slot whose prompt starts with the same tokens.  Sharing is
+    pure page-table indirection: no program here changes shape for it.  The
+    one mutable case — a *partial* boundary page the owner is still
+    appending to — is shared by value instead: :func:`cow_copy_page`
+    snapshots it into the reader's own page (copy-on-write).
     """
     dtype = dtype or cfg.dtype
     kv = (cfg.num_layers, num_pages, page_size, cfg.kv_heads,
@@ -1512,6 +1524,25 @@ def paged_cache_specs(cfg: TransformerConfig) -> Dict[str, P]:
     (any slot on any data shard may own any page)."""
     kv = P(None, None, None, "model", None)
     return {"k": kv, "v": kv}
+
+
+def cow_copy_page(k: jax.Array, v: jax.Array, src: jax.Array,
+                  dst: jax.Array):
+    """Copy-on-write primitive: snapshot physical page ``src`` onto ``dst``
+    across every layer of the ``[L, P, page, Hkv, hd]`` pools.
+
+    Used when a new request's prompt extends partway into a donor's
+    *partial* boundary page: the donor keeps appending to its own page, so
+    the sharer takes a value snapshot into a private page and overwrites
+    every row past the matched prefix itself before its query positions can
+    reach them (slot-index == position, so a row is causally invisible
+    until the sharer has written it).  ``src``/``dst`` are traced int32
+    scalars — ONE fixed program shape regardless of which pages move, so
+    the zero-recompile serving contract is untouched.  ``dst == src`` (or
+    the trash page 0 onto itself, used to pre-warm the compile) is a
+    harmless self-copy.
+    """
+    return k.at[:, dst].set(k[:, src]), v.at[:, dst].set(v[:, src])
 
 
 def _attention_paged(cfg, q, ck, cv, q_pos):

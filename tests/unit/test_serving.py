@@ -89,9 +89,12 @@ def test_serving_parity_mixed_length_stream(tiny_engine, tiny_serve):
     reqs = _stream(8, seed=1)
     results = tiny_serve.run(list(reqs))
     _assert_parity(tiny_engine, results, reqs)
-    # slots + every page returned
+    # slots returned; every page is free or pinned by the prefix index
+    # (refcount pool invariant — pages linger as cache, never leak)
     assert not tiny_serve._active.any()
-    assert len(tiny_serve._free_pages) == tiny_serve.num_pages - 1
+    acct = tiny_serve.page_accounting()
+    assert acct["balanced"], acct
+    assert acct["referenced"] == acct["cached"]   # only the index holds refs
 
 
 def test_serving_parity_gqa():
@@ -126,7 +129,7 @@ def test_serving_eos_retires_slot(tiny_engine, tiny_serve):
     assert len(res.output_ids) <= 8
     _assert_parity(tiny_engine, [res], [req])
     assert not tiny_serve._active.any()
-    assert len(tiny_serve._free_pages) == tiny_serve.num_pages - 1
+    assert tiny_serve.page_accounting()["balanced"]
 
 
 def test_serving_zero_recompile_admission(tiny_engine, tiny_serve):
@@ -187,8 +190,7 @@ def test_serving_prefill_failure_unwinds_reservation(tiny_engine, tiny_serve):
     """A prefill that dies on the device call must not leak pages or drop
     the request: the reservation unwinds and the request stays at the
     queue head for a retry."""
-    free_before = len(tiny_serve._free_pages)
-    real_prog = tiny_serve._prefill_progs[16]
+    real_prog = tiny_serve._prefill_progs.get(16)
 
     def boom(*a, **k):
         raise RuntimeError("injected prefill failure")
@@ -200,11 +202,16 @@ def test_serving_prefill_failure_unwinds_reservation(tiny_engine, tiny_serve):
     try:
         with pytest.raises(RuntimeError, match="injected prefill"):
             tiny_serve.step()
-        assert len(tiny_serve._free_pages) == free_before   # pages returned
+        # pages returned: the unwind may also have RECLAIMED cached-but-idle
+        # prefix pages (free can grow), but nothing may leak
+        assert tiny_serve.page_accounting()["balanced"]
         assert tiny_serve._queue[0].rid == "pf"             # still queued
         assert not tiny_serve._active.any()
     finally:
-        tiny_serve._prefill_progs[16] = real_prog
+        if real_prog is None:
+            del tiny_serve._prefill_progs[16]
+        else:
+            tiny_serve._prefill_progs[16] = real_prog
     (res,) = tiny_serve.run([])                             # retry succeeds
     assert res.rid == "pf" and len(res.output_ids) == 3
 
@@ -228,7 +235,191 @@ def test_serving_chaos_admission_delay_no_deadlock(tiny_engine, tiny_serve):
         clear_injector()
     assert len(inj.log) >= 4
     _assert_parity(tiny_engine, results, reqs)
-    assert len(tiny_serve._free_pages) == tiny_serve.num_pages - 1
+    assert tiny_serve.page_accounting()["balanced"]
+
+
+# ----------------------------------------------- cross-request KV reuse
+
+
+def _shared_stream(n, seed, sys_len=21, tail_rng=(2, 6), max_new=5,
+                   vocab=250, rid0=0):
+    """Seeded stream of requests sharing one system prompt + unique tails.
+    ``sys_len=21`` with page_size 8 = 2 full shared pages + a 5-token COW
+    boundary; tails of 2-5 keep the boundary inside the partial page."""
+    rng = np.random.default_rng(seed)
+    system = rng.integers(1, vocab, sys_len).astype(np.int32)
+    return [Request(rid=rid0 + i,
+                    input_ids=np.concatenate(
+                        [system, rng.integers(1, vocab,
+                                              int(rng.integers(*tail_rng))
+                                              ).astype(np.int32)]),
+                    max_new_tokens=max_new)
+            for i in range(n)]
+
+
+def test_prefix_sharing_token_exact_with_cow(tiny_engine):
+    """Tentpole acceptance: requests sharing a system prompt map resident
+    pages (incl. a copy-on-write boundary page) and stay token-exact with
+    a no-sharing engine; the pool invariant holds and the program
+    inventory never grows past the cold run's."""
+    reqs = _shared_stream(6, seed=31)
+    cold = tiny_engine.serving(b_slots=2, page_size=8, max_model_len=64,
+                               prefix_cache=False)
+    ref = {r.rid: r.output_ids for r in cold.run(
+        [Request(rid=r.rid, input_ids=r.input_ids,
+                 max_new_tokens=r.max_new_tokens) for r in reqs])}
+    assert cold.prefix_hits == 0 and "cow" not in cold.program_inventory()
+
+    serve = tiny_engine.serving(b_slots=2, page_size=8, max_model_len=64)
+    results = serve.run(list(reqs))
+    for r in results:
+        np.testing.assert_array_equal(r.output_ids, ref[r.rid])
+    # the donor was cold; request 1 shares the 2 full pages (the donor's
+    # page 3 is FULL — the 21-token boundary only becomes a COW entry once
+    # request 1 publishes its own partial page); every later request then
+    # shares full pages + the COW boundary = the whole 21-token system
+    # prompt
+    shared = {r.rid: r.shared_prefix_tokens for r in results}
+    assert shared[reqs[0].rid] == 0
+    assert shared[reqs[1].rid] == 16                # full-page granularity
+    assert all(v >= 21 for k, v in shared.items()
+               if k not in (reqs[0].rid, reqs[1].rid))
+    assert serve.prefix_hits == 5 and serve.prefix_misses == 1
+    assert serve.cow_copies == 4
+    assert serve.prefix_pages_shared == 10          # 2 full pages x 5 hits
+    assert serve.prefix_shared_tokens == sum(shared.values())
+    acct = serve.page_accounting()
+    assert acct["balanced"] and acct["referenced"] == acct["cached"]
+    inv = serve.program_inventory()
+    assert inv["cow"] == 1
+    # a second shared batch admits with ZERO inventory growth
+    results2 = serve.run(_shared_stream(4, seed=31, rid0=100))
+    assert serve.program_inventory() == inv
+    assert all(r.shared_prefix_tokens >= 21 for r in results2)
+
+
+def test_prefix_sharing_identical_prompts_cap_at_prompt_minus_one(
+        tiny_engine):
+    """An identical prompt shares at most L-1 tokens — the last prompt
+    token always prefills so the first generated token has real logits."""
+    serve = tiny_engine.serving(b_slots=2, page_size=8, max_model_len=64)
+    prompt = np.arange(1, 18, dtype=np.int32)       # 17 tokens
+    reqs = [Request(rid=i, input_ids=prompt.copy(), max_new_tokens=4)
+            for i in range(3)]
+    base = np.asarray(tiny_engine.generate(prompt[None],
+                                           max_new_tokens=4))[0, 17:]
+    results = serve.run(reqs)
+    for r in results:
+        np.testing.assert_array_equal(r.output_ids, base)
+    assert {r.shared_prefix_tokens for r in results} == {0, 16}
+
+
+def test_prefix_index_eviction_under_pool_pressure(tiny_engine):
+    """Cached-but-idle pages must be reclaimed (LRU) when admission needs
+    them — a full index never starves or deadlocks the pool."""
+    # pool of 8 usable pages, 1 slot; each request needs 2-3 pages and
+    # publishes entries that pin pages after retirement
+    serve = tiny_engine.serving(b_slots=1, page_size=8, max_model_len=24,
+                                num_pages=9)
+    reqs = _stream(8, seed=33, smin=9, smax=14, new_choices=(4,))
+    results = serve.run(list(reqs))
+    assert len(results) == 8
+    assert serve._prefix.evictions > 0              # pressure really evicted
+    acct = serve.page_accounting()
+    assert acct["balanced"] and acct["referenced"] == acct["cached"]
+
+
+def test_prefix_index_unit():
+    """PrefixIndex semantics: exact chunk verification, longest-common-
+    prefix COW boundary, the L-1 cap via `limit`, and LRU eviction."""
+    from deepspeed_tpu.inference.prefix_cache import PrefixIndex
+
+    idx = PrefixIndex(page_size=4, max_entries=8)
+    ids = np.array([1, 2, 3, 4, 5, 6, 7, 8, 9, 10], np.int32)
+    newly, released = idx.publish(ids, [11, 12, 13])   # 2 full + partial(2)
+    assert newly == [11, 12, 13] and released == []
+    assert sorted(idx.pages()) == [11, 12, 13]
+
+    # full + boundary match, capped by limit
+    m = idx.lookup(ids, limit=9)
+    assert m.pages == [11, 12] and m.n_tokens == 9
+    assert m.cow_src == 13 and m.cow_valid == 1     # limit clips the second
+    # divergent second chunk: only chunk 0 matches, no boundary under h1'
+    other = np.array([1, 2, 3, 4, 9, 9, 9, 9], np.int32)
+    m = idx.lookup(other, limit=7)
+    assert m.pages == [11] and m.n_tokens == 4 and m.cow_src is None
+    # divergence INSIDE the partial chunk: longest common prefix wins
+    part = np.array([1, 2, 3, 4, 5, 6, 7, 8, 9, 77, 77], np.int32)
+    m = idx.lookup(part, limit=10)
+    assert m.pages == [11, 12] and m.cow_src == 13 and m.cow_valid == 1
+    # re-publishing the identical prefix touches, never re-refs
+    newly, released = idx.publish(ids, [11, 12, 13])
+    assert newly == [] and released == []
+
+    # LRU eviction returns pages for deref, oldest first
+    for i in range(6):
+        prompt = np.array([50 + i] * 5, np.int32)
+        idx.publish(prompt, [20 + 2 * i, 21 + 2 * i])
+    assert len(idx) <= 8
+    assert idx.evictions > 0
+    evicted = idx.evict(2)
+    assert len(evicted) == 2
+    assert all(p not in idx.pages() for p in evicted)
+
+
+def test_prefix_collision_replacement_drops_stale_descendants(monkeypatch):
+    """A chain-hash collision replaces the collided entry AND everything
+    published under its chain (deeper full chunks + partial boundaries) —
+    stale descendants verified against the new chain would otherwise map
+    K/V computed under a different prefix."""
+    from deepspeed_tpu.inference.prefix_cache import _ROOT, PrefixIndex
+
+    idx = PrefixIndex(page_size=4, max_entries=16)
+    a = np.array([1, 2, 3, 4, 5, 6, 7, 8, 9], np.int32)
+    idx.publish(a, [11, 12, 13])        # 2 full + partial(1)
+    key0 = PrefixIndex._chain(_ROOT, (1, 2, 3, 4))
+
+    def fake_chain(prev, chunk):        # simulated 64-bit collision:
+        if prev == _ROOT and chunk == (9, 9, 9, 9):
+            return key0                 # B's chunk 0 lands on A's key
+        return hash((prev, chunk))
+
+    monkeypatch.setattr(PrefixIndex, "_chain", staticmethod(fake_chain))
+    newly, released = idx.publish(np.array([9, 9, 9, 9], np.int32), [20])
+    assert newly == [20]
+    assert sorted(released) == [11, 12, 13]   # A's whole subtree released
+    m = idx.lookup(a, limit=9)                # degraded to a miss, not a
+    assert m.pages == [] and m.cow_src is None  # wrong-page match
+
+
+def test_head_matching_own_cached_prefix_admits_under_pressure(tiny_engine):
+    """The queue head's own matched prefix being the only reclaimable
+    cache must not read as an admission deadlock: reclaim evicts the
+    entries, the admission pins were the last references, and the head
+    retries with a fresh lookup against the freed pool."""
+    serve = tiny_engine.serving(b_slots=1, page_size=8, max_model_len=40,
+                                num_pages=6)        # 5 usable = one request
+    prompt = np.arange(1, 21, dtype=np.int32)       # 2 full pages + 4
+    (a,) = serve.run([Request(rid="a", input_ids=prompt,
+                              max_new_tokens=20)])
+    (b,) = serve.run([Request(rid="b", input_ids=prompt.copy(),
+                              max_new_tokens=20)])  # needs ALL 5 pages
+    np.testing.assert_array_equal(b.output_ids, a.output_ids)
+    assert serve.page_accounting()["balanced"]
+
+
+def test_one_token_boundary_match_skips_cow(tiny_engine):
+    """A boundary match below MIN_COW_TOKENS (e.g. two prompts sharing
+    only their first token by chance) is not worth a pool-shaped page
+    snapshot — the engine prefills the tail instead of COWing."""
+    serve = tiny_engine.serving(b_slots=1, page_size=8, max_model_len=64)
+    serve.run([Request(rid="d", input_ids=np.array([7, 1, 2], np.int32),
+                       max_new_tokens=2)])
+    (res,) = serve.run([Request(rid="f",
+                                input_ids=np.array([7, 9, 9, 9], np.int32),
+                                max_new_tokens=2)])
+    assert serve.cow_copies == 0
+    assert res.shared_prefix_tokens == 0
 
 
 # ---------------------------------------------------------------- satellites
@@ -347,6 +538,30 @@ def test_eos_sentinel_never_emits_token_zero(tiny_engine):
     out_none = np.asarray(tiny_engine.generate(prompt, max_new_tokens=6,
                                                eos_token_id=None))
     np.testing.assert_array_equal(out_none, ref)
+
+
+def test_quantized_engine_serving_parity():
+    """Satellite (docs/SERVING.md carried item): a weight-quantized engine
+    now serves through the paged path — the shimmed ``apply_paged``
+    dequantizes at program entry, so serving is token-identical to
+    quantized ``generate()`` (NOT to the fp32 engine: int8 weights round).
+    """
+    model = CausalLM("tiny", dtype=jnp.float32, attn_impl="xla")
+    params = model.init_fn(jax.random.PRNGKey(3))
+    qengine = deepspeed_tpu.init_inference(
+        model=model, config={"dtype": "float32",
+                             "quant": {"enabled": True, "num_bits": 8}},
+        params=params)
+    from deepspeed_tpu.inference.quantization import QuantizedWeight
+    assert any(isinstance(leaf, QuantizedWeight)
+               for leaf in jax.tree_util.tree_leaves(
+                   qengine.params,
+                   is_leaf=lambda x: isinstance(x, QuantizedWeight)))
+    serve = qengine.serving(b_slots=2, page_size=8, max_model_len=64)
+    reqs = _stream(4, seed=41, new_choices=(4, 6))
+    results = serve.run(list(reqs))
+    _assert_parity(qengine, results, reqs)   # vs the QUANTIZED generate()
+    assert serve.page_accounting()["balanced"]
 
 
 def test_serve_smoke_tool():

@@ -99,7 +99,7 @@ def test_deadline_expires_queued_request(tiny_engine):
     assert d.output_ids.size == 0
     assert d.retry_after_s is not None and d.retry_after_s > 0
     assert serve.deadline_count == 1
-    assert len(serve._free_pages) == serve.num_pages - 1
+    assert serve.page_accounting()["balanced"]
 
 
 def test_deadline_expires_inflight_request_and_frees_pages(tiny_engine):
@@ -114,7 +114,7 @@ def test_deadline_expires_inflight_request_and_frees_pages(tiny_engine):
     assert res.output_ids.size >= 1          # partial progress returned
     assert len(res.output_ids) < 50
     assert not serve._active.any()
-    assert len(serve._free_pages) == serve.num_pages - 1
+    assert serve.page_accounting()["balanced"]
     # the freed slot serves the next request normally
     (res2,) = serve.run([Request(rid="next",
                                  input_ids=np.array([1, 2], np.int32),
@@ -200,8 +200,10 @@ def test_repeated_prefill_failure_quarantines_slot(tiny_engine):
     h = sup.health()
     assert h["quarantined_slots"] == 1
     assert h["usable_slots"] == SERVE_KW["b_slots"] - 1
-    # leaked pages are accounted, never recycled
-    assert h["free_pages"] + h["quarantined_pages"] == eng.num_pages - 1
+    # leaked pages are accounted, never recycled (referenced = index cache)
+    assert h["free_pages"] + h["quarantined_pages"] + h["referenced_pages"] \
+        == eng.num_pages - 1
+    assert eng.page_accounting()["balanced"]
     assert mon.latest("serve/quarantined_slots") == 1.0
 
 
@@ -302,8 +304,8 @@ def test_chaos_decode_kill_at_random_tick_replays_token_exact(tiny_engine,
                 r.output_ids, ref[r.rid],
                 err_msg=f"seed={seed} kill_tick={kill_tick} rid={r.rid}")
         h = sup.health()
-        assert h["free_pages"] + h["quarantined_pages"] == \
-            sup.engine.num_pages - 1
+        assert h["free_pages"] + h["quarantined_pages"] \
+            + h["referenced_pages"] == sup.engine.num_pages - 1
 
 
 # ---------------------------------------------------------- health / drain
@@ -340,7 +342,7 @@ def test_drain_finishes_inflight_and_hands_back_queue(tiny_engine):
     results = serve.take_results()
     assert sorted(r.rid for r in results) == [0, 1]
     assert all(r.finish_reason == "length" for r in results)
-    assert len(serve._free_pages) == serve.num_pages - 1
+    assert serve.page_accounting()["balanced"]
     assert serve.health()["draining"] is True
     # admission is closed: later submissions shed (typed, not dropped)
     serve.submit(Request(rid="late", input_ids=np.array([1], np.int32),
@@ -396,6 +398,128 @@ def test_rebase_carries_remaining_deadline_budget():
     assert ServingSupervisor._rebase(
         Request(rid=2, input_ids=np.array([1], np.int32), max_new_tokens=2),
         elapsed=9.0, t0=0.0).deadline_s is None
+
+
+def test_mid_drain_fault_preserves_partial_progress(tiny_engine, reference):
+    """Carried PR 3 gap (ISSUE 6 satellite): a ``serve.decode`` fault
+    injected MID-drain used to hand the in-flight requests back unserved,
+    discarding their generated tokens.  Now the supervisor warm-restarts,
+    finishes the replayed in-flight work token-exactly (drain's contract is
+    'finish in-flight work'), and hands back only the waiting queue."""
+    reqs, ref = reference
+    sup = tiny_engine.supervised_serving(b_slots=2, page_size=8,
+                                         max_model_len=64)
+    for r in _copies(reqs):
+        sup.submit(r)
+    sup.engine.step()                        # 2 in flight, 4 waiting
+    inflight = sorted(st.request.rid for st in sup.engine._slots
+                      if st is not None)
+    assert len(inflight) == 2
+    pre_tokens = {st.request.rid: len(st.tokens)
+                  for st in sup.engine._slots if st is not None}
+    inj = install_injector(FaultInjector())
+    inj.add(site=SITE_SERVE_DECODE, kind="raise", at_call=2)
+    unserved = sup.drain(max_ticks=500)
+    assert sup.restarts == 1
+    assert sup.restart_log[0]["mid_drain"] is True
+    assert sup.restart_log[0]["stashed"] == 4
+    # waiting requests hand back as ORIGINALS, in order, never served
+    assert [r.rid for r in unserved] == [r for r in sorted(ref)
+                                         if r not in inflight]
+    assert all(isinstance(r, Request) for r in unserved)
+    # the in-flight pair FINISHED with partial progress preserved: their
+    # stitched outputs are token-exact vs the fault-free oracle, and the
+    # replay really continued (replays stamped, prefix tokens kept)
+    results = {r.rid: r for r in sup.take_results()}
+    assert sorted(results) == inflight
+    for rid in inflight:
+        np.testing.assert_array_equal(results[rid].output_ids, ref[rid])
+        assert results[rid].replays == 1
+        assert len(results[rid].output_ids) > pre_tokens[rid]
+    assert sup.engine.page_accounting()["balanced"]
+
+
+def test_second_mid_drain_fault_keeps_queued_replay_progress(tiny_engine):
+    """A SECOND fault mid-drain must not demote a queued in-flight-origin
+    replay to 'never served': a replay re-queued on the replacement engine
+    (here: its first prefill fails and quarantines the slot, so it waits
+    behind one usable slot) carries already-generated tokens in its replay
+    prompt — the next restart re-queues it instead of stashing it, and its
+    stitched output stays token-exact."""
+    # max_new=8 throughout: the replays must NOT finish at their replay
+    # prefill, or the freed slot absorbs the queue and nothing is waiting
+    # at the second fault
+    reqs = _stream(6, seed=4, new_choices=(8,))
+    ref = {r.rid: r.output_ids
+           for r in tiny_engine.serving(**SERVE_KW).run(_copies(reqs))}
+    sup = tiny_engine.supervised_serving(b_slots=2, page_size=8,
+                                         max_model_len=64,
+                                         quarantine_limit=1)
+    for r in _copies(reqs):
+        sup.submit(r)
+    sup.engine.step()                        # 2 in flight, 4 waiting
+    inflight = sorted(st.request.rid for st in sup.engine._slots
+                      if st is not None)
+    # NOTE: injector call counters start HERE — the pre-install step()'s
+    # prefill/decode calls are not counted
+    inj = install_injector(FaultInjector())
+    # fault 1: kill an early drain decode tick -> restart 1 replays the
+    # in-flight pair (4 waiting requests stashed)
+    inj.add(site=SITE_SERVE_DECODE, kind="raise", at_call=2)
+    # fault 2: the first replay PREFILL on the replacement engine fails ->
+    # quarantine_limit=1 fences the slot, that replay re-queues, and the
+    # second replay now waits behind ONE usable slot
+    inj.add(site=SITE_SERVE_PREFILL, kind="raise", at_call=1)
+    # fault 3: kill the next decode tick while one replay is still QUEUED
+    inj.add(site=SITE_SERVE_DECODE, kind="raise", at_call=3)
+    unserved = sup.drain(max_ticks=500)
+    assert sup.restarts == 2
+    assert sup.restart_log[1]["mid_drain"] is True
+    assert sup.restart_log[1]["stashed"] == 0     # nothing demoted...
+    assert sup.restart_log[1]["requeued"] >= 1    # ...the replay re-queued
+    # the 4 never-served requests still hand back as originals, in order
+    assert [r.rid for r in unserved] == [r for r in sorted(ref)
+                                         if r not in inflight]
+    # BOTH in-flight requests finished token-exact across two restarts
+    results = {r.rid: r for r in sup.take_results()}
+    assert sorted(results) == inflight
+    for rid in inflight:
+        np.testing.assert_array_equal(results[rid].output_ids, ref[rid])
+        assert results[rid].replays >= 1
+    assert sup.engine.page_accounting()["balanced"]
+
+
+def test_abandoned_drain_stash_served_by_run(tiny_engine):
+    """A drain abandoned mid-recovery (its ``ServeTimeout`` propagates
+    before the hand-back) leaves never-served requests in the supervisor's
+    drain stash; a subsequent ``run()`` must serve them instead of
+    orphaning them with no terminal result."""
+    reqs = _stream(6, seed=4, new_choices=(16,))
+    ref = {r.rid: r.output_ids
+           for r in tiny_engine.serving(**SERVE_KW).run(_copies(reqs))}
+    sup = tiny_engine.supervised_serving(b_slots=2, page_size=8,
+                                         max_model_len=64)
+    for r in _copies(reqs):
+        sup.submit(r)
+    sup.engine.step()                        # 2 in flight, 4 waiting
+    inj = install_injector(FaultInjector())
+    inj.add(site=SITE_SERVE_DECODE, kind="raise", at_call=2)
+    # tick budget reaches the fault (decode call 2) but falls far short of
+    # the replayed max_new=16 decodes, so the mid-drain recovery times out
+    # AFTER the restart stashed the 4 waiting requests
+    with pytest.raises(ServeTimeout):
+        sup.drain(max_ticks=4)
+    assert sup.restarts == 1
+    assert sup.restart_log[0]["stashed"] == 4
+    # the caller falls back to run(): EVERY submitted request — replayed
+    # in-flight pair AND formerly-stashed queue — reaches a terminal,
+    # token-exact result, and the stash is empty
+    results = {r.rid: r for r in sup.run(max_ticks=500)}
+    assert sorted(results) == sorted(ref)
+    for rid, out in ref.items():
+        np.testing.assert_array_equal(results[rid].output_ids, out)
+    assert sup._drain_stash == []
+    assert sup.engine.page_accounting()["balanced"]
 
 
 def test_supervised_drain_returns_original_requests(tiny_engine):
@@ -558,7 +682,10 @@ def test_quarantined_slot_probed_and_unfenced(tiny_engine):
     assert h["quarantined_slots"] == 0       # ...and probed back into service
     assert h["quarantined_pages"] == 0
     assert h["probes_total"] >= 1 and h["unfenced_total"] == 1
-    assert h["free_pages"] == serve.num_pages - 1
+    # the restored pages are free or cached by the prefix index — nothing
+    # stays quarantined
+    assert serve.page_accounting()["balanced"]
+    assert h["free_pages"] + h["referenced_pages"] == serve.num_pages - 1
     results = serve.take_results()
     assert sorted(r.rid for r in results) == list(range(5))
     assert all(r.finish_reason in ("eos", "length") for r in results)
@@ -600,7 +727,7 @@ def test_failed_probe_keeps_slot_fenced_until_a_clean_canary(tiny_engine):
     assert h["probes_total"] >= 2            # first canary failed, later won
     assert h["unfenced_total"] == 1
     assert h["quarantined_slots"] == 0
-    assert h["free_pages"] + h["quarantined_pages"] == serve.num_pages - 1
+    assert serve.page_accounting()["balanced"]
     assert len(serve.take_results()) == 6
 
 
@@ -619,7 +746,7 @@ def test_probe_disabled_by_default_keeps_slot_fenced(tiny_engine):
     h = serve.health()
     assert h["quarantined_slots"] == 1       # no background unfence path
     assert h["probes_total"] == 0
-    assert h["free_pages"] + h["quarantined_pages"] == serve.num_pages - 1
+    assert serve.page_accounting()["balanced"]
 
 
 # ------------------------------------- arrival epoch across warm restarts

@@ -165,9 +165,13 @@ def run_serve_soak(seed: int, n_requests: int = 8, b_slots: int = 3,
     - every submitted request reaches a terminal ``RequestResult``
       (completed / ``"deadline"`` / ``"shed"`` — none lost);
     - completed outputs are token-identical to a fault-free reference run
-      of the same stream (greedy decode makes supervisor replay exact);
-    - after ``drain()`` the page accounting balances:
-      pool pages = free + quarantined.
+      of the same stream (greedy decode makes supervisor replay exact —
+      including requests admitted through shared prefix pages: half the
+      stream shares a seeded system prompt, so kills land mid-prefill and
+      mid-decode on REFCOUNTED shared pages);
+    - the refcount pool invariant holds after every kill and after
+      ``drain()``: pool pages = free + quarantined + referenced, with no
+      page leaked or double-freed (a double-free raises inside the engine).
     """
     import numpy as np
 
@@ -191,10 +195,20 @@ def run_serve_soak(seed: int, n_requests: int = 8, b_slots: int = 3,
         model=model, config={"dtype": "float32"}, params=params)
 
     nprng = np.random.default_rng(seed)
-    base = [Request(rid=i,
-                    input_ids=nprng.integers(
-                        1, model.config.vocab_size,
-                        int(nprng.integers(3, 14))).astype(np.int32),
+    # half the stream shares a seeded system prompt (long enough for one
+    # full 8-token page + a COW boundary), so the kill schedule hits
+    # refcounted shared pages mid-prefill/mid-decode; the rest stay unique
+    system = nprng.integers(1, model.config.vocab_size, 11).astype(np.int32)
+
+    def prompt(i):
+        if i % 2 == 0:
+            uniq = nprng.integers(1, model.config.vocab_size,
+                                  int(nprng.integers(2, 6))).astype(np.int32)
+            return np.concatenate([system, uniq])
+        return nprng.integers(1, model.config.vocab_size,
+                              int(nprng.integers(3, 14))).astype(np.int32)
+
+    base = [Request(rid=i, input_ids=prompt(i),
                     max_new_tokens=int(nprng.choice((4, 6, 8))))
             for i in range(n_requests)]
 
@@ -246,13 +260,21 @@ def run_serve_soak(seed: int, n_requests: int = 8, b_slots: int = 3,
             parity_checked += 1
         else:
             assert res.finish_reason in ("deadline", "shed"), res.finish_reason
-    # invariant: page accounting balances after drain
+    # invariant: the refcount pool accounting balances after drain — every
+    # page is exactly one of free / quarantined / referenced (referenced =
+    # prefix-index cache + any surviving slot refs; no leak, no double-free)
     unserved = sup.drain(max_ticks=500)
     assert not unserved, f"serve soak seed={seed}: {len(unserved)} unserved"
     h = sup.health()
-    assert h["free_pages"] + h["quarantined_pages"] == \
-        sup.engine.num_pages - 1, \
+    acct = sup.engine.page_accounting()
+    assert acct["balanced"], \
+        f"serve soak seed={seed}: page accounting broken: {acct} / {h}"
+    assert h["free_pages"] + h["quarantined_pages"] + h["referenced_pages"] \
+        == sup.engine.num_pages - 1, \
         f"serve soak seed={seed}: page accounting broken: {h}"
+    # after drain no slot is active: every referenced page is index-cached
+    assert acct["referenced"] == acct["cached"], \
+        f"serve soak seed={seed}: leaked slot reference: {acct}"
     stats = {
         "seed": seed,
         "submitted": len(base),
@@ -264,6 +286,8 @@ def run_serve_soak(seed: int, n_requests: int = 8, b_slots: int = 3,
         "shed": h["shed_total"],
         "deadline_expired": h["deadline_expired_total"],
         "quarantined_slots": h["quarantined_slots"],
+        "prefix_hits": h["prefix_hits_total"],
+        "cow_copies": h["cow_copies_total"],
     }
     if verbose:
         print(f"  seed={seed}: OK — {stats['faults_fired']} fault(s) fired, "

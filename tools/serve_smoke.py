@@ -5,8 +5,13 @@ ACTUAL XLA compiles via ``jax.monitoring`` (the
 ``/jax/core/compile/backend_compile_duration`` event fires once per backend
 compile).  The first stream may compile at most the static program inventory
 (1 decode step + 1 prefill per prompt bucket + the argmax/bookkeeping those
-wrap); the second stream — different lengths, same buckets — must compile
-NOTHING.  Exits nonzero on violation.
+wrap; the COW page-copy program compiles at engine INIT, before counting
+starts).  The second stream — different lengths, same buckets — must compile
+NOTHING.  A third phase asserts the cross-request KV reuse contract
+(ISSUE 6): two batches sharing a system prompt are admitted through the
+prefix index, and ``program_inventory()`` is IDENTICAL before and after the
+shared-prefix batch, with zero compiles — sharing is pure page-table
+indirection, never a new program shape.  Exits nonzero on violation.
 
 Wired into tier-1 via tests/unit/test_serving.py::test_serve_smoke_tool
 (non-slow, in-process).
@@ -49,13 +54,38 @@ def run_smoke(n_requests: int = 5, b_slots: int = 2, seed: int = 0) -> dict:
     base = count()
     serve.run(stream(seed))
     inventory = serve.program_inventory()
-    # budget: the decode program + one prefill per bucket (each is ONE jit)
+    # budget: the decode program + one prefill per bucket (each is ONE jit;
+    # the COW copy program compiled at engine init, outside this window)
     budget = inventory["decode"] + len(inventory["prefill_buckets"])
     first_run = count() - base
 
     base = count()
     results = serve.run(stream(seed + 1))
     steady = count() - base
+
+    # ---- shared-prefix phase (ISSUE 6 acceptance): batch A donates a
+    # system prompt (and compiles its prompt bucket if new); batch B shares
+    # it — the admissions map resident pages + COW the boundary, compile
+    # NOTHING, and leave the program inventory bit-identical
+    rng = np.random.default_rng(seed + 2)
+    system = rng.integers(1, 250, 37).astype(np.int32)   # 2 full pages + 5
+
+    def shared_stream(tag, n):
+        return [Request(rid=f"{tag}{i}",
+                        input_ids=np.concatenate(
+                            [system,
+                             rng.integers(1, 250, int(rng.integers(2, 6))
+                                          ).astype(np.int32)]),
+                        max_new_tokens=int(rng.integers(3, 7)))
+                for i in range(n)]
+
+    serve.run(shared_stream("a", n_requests))      # donor batch (warm)
+    inv_before = serve.program_inventory()
+    base = count()
+    shared_results = serve.run(shared_stream("b", n_requests))
+    shared_compiles = count() - base
+    inv_after = serve.program_inventory()
+    hits_b = sum(r.shared_prefix_tokens > 0 for r in shared_results)
 
     out = {
         "metric": "serve-smoke",
@@ -64,8 +94,14 @@ def run_smoke(n_requests: int = 5, b_slots: int = 2, seed: int = 0) -> dict:
         "steady_state_compiles": steady,
         "program_inventory": inventory,
         "requests_served": len(results),
+        "shared_prefix_compiles": shared_compiles,
+        "shared_prefix_hits": hits_b,
+        "inventory_stable_across_sharing": bool(inv_before == inv_after),
         "ok": bool(first_run <= budget and steady == 0
-                   and len(results) == n_requests),
+                   and len(results) == n_requests
+                   and shared_compiles == 0
+                   and inv_before == inv_after
+                   and hits_b == n_requests),
     }
     return out
 
@@ -78,7 +114,9 @@ def main(argv=None) -> int:
     print(json.dumps(result))
     if not result["ok"]:
         print("serve smoke FAILED: compile count exceeded the static "
-              "program inventory (admission recompiled?)", file=sys.stderr)
+              "program inventory (admission recompiled?) or the "
+              "shared-prefix batch changed the inventory / missed the "
+              "prefix index", file=sys.stderr)
         return 1
     return 0
 
