@@ -21,6 +21,7 @@ from .elastic_agent import (  # noqa: F401
 from .supervisor import RC_COMPLETE, RC_INTERRUPT, Supervisor  # noqa: F401
 from .coordination import (  # noqa: F401
     CoordinationStore,
+    CoordinatorLease,
     FileCoordinationStore,
     HeartbeatWatchdog,
     HostLease,
@@ -32,10 +33,13 @@ from .coordination import (  # noqa: F401
     clear_dead,
     dead_hosts,
     dead_set,
+    elect_coordinator,
     lease_table,
+    read_coordinator,
     read_generation,
     record_dead,
     rendezvous,
+    resign_coordinator,
 )
 from .pod_agent import (  # noqa: F401
     PodContext,

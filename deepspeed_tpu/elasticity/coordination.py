@@ -9,14 +9,15 @@ coordination layer in the spirit of Bamboo (NSDI '23) and Oobleck
 abstraction:
 
 :class:`CoordinationStore`
-    A namespaced key -> JSON-document store with atomic replace.  The
-    production deployment backs it with storage every host already shares
+    A namespaced key -> JSON-document store with atomic replace and an
+    atomic :meth:`~CoordinationStore.compare_and_swap`.  The production
+    deployment backs it with storage every host already shares
     (the checkpoint filesystem / a coordinator-host export); tests and
     single-node soaks use the same :class:`FileCoordinationStore` on a
     tmpdir.  Nothing here imports jax — the layer must stay usable from
     the launcher before any device runtime exists.
 
-On top of it, three protocols:
+On top of it, four protocols:
 
 - **Heartbeats with leases** (:func:`beat` / :func:`lease_table` /
   :func:`dead_hosts`): each host renews a lease document stamped with the
@@ -30,10 +31,27 @@ On top of it, three protocols:
   Every relaunch round bumps it; heartbeats, rendezvous records, dead-host
   markers and pod checkpoint manifests all carry it, so state from a
   previous incarnation can never be mistaken for the current round's.
+  The bump is a compare-and-swap loop: concurrent bumpers (two supervisor
+  rounds racing, a deposed coordinator racing its successor) each win a
+  distinct round — no lost update, no torn document.
 - **Rendezvous** (:func:`rendezvous`): hosts of a generation register and
   wait until the expected membership is present (or a timeout raises
   :class:`PodRendezvousTimeout`) — the barrier the pod supervisor uses to
   re-form the job after a shrink.
+- **Coordinator election** (:func:`elect_coordinator` /
+  :func:`read_coordinator`): a lease-based leader lock, CAS on one
+  coordinator key.  A candidate acquires a vacant or LAPSED lease with a
+  bumped ``term``, and the incumbent renews by CAS-ing its own document —
+  so exactly one leader holds any term, and losing the coordinator only
+  costs one lease worth of time before a standby takes over.  This is
+  what removes the "coordinator host is never failed over" gap: the pod
+  supervisor round and the serving fleet router
+  (``inference/fleet.py``) both run under it.
+
+The lease/dead-marker helpers take a ``prefix`` so independent tiers share
+one implementation without sharing a namespace: pods lease under
+``heartbeat/`` + ``dead/`` (the defaults), serving-fleet engines under
+``fleet/heartbeat`` + ``fleet/dead``.
 
 Fault sites ``pod.heartbeat`` and ``pod.rendezvous`` hook the two live
 paths so chaos tests can kill leases and wedge rendezvous deterministically
@@ -74,6 +92,9 @@ class CoordinationStore:
 
     - :meth:`put` replaces atomically — a reader never observes a torn
       document;
+    - :meth:`compare_and_swap` replaces atomically ONLY when the current
+      document equals ``expected`` (``None`` = key absent) — the primitive
+      the generation bump, dead markers and coordinator election build on;
     - :meth:`list` returns the child names directly under a prefix;
     - there is no watch/subscribe: every consumer polls, which keeps the
       file backend honest and the test clock injectable.
@@ -85,10 +106,23 @@ class CoordinationStore:
     def get(self, key: str) -> Optional[Dict]:
         raise NotImplementedError
 
-    def list(self, prefix: str) -> List[str]:
-        raise NotImplementedError
+    def compare_and_swap(self, key: str, expected: Optional[Dict],
+                         new: Dict) -> bool:
+        """Write ``new`` iff the current document equals ``expected``
+        (``None`` = the key must be absent); returns whether the swap won.
+        This base implementation is a plain read-compare-write — correct
+        only under a single writer.  Real backends MUST override it with
+        an atomic version (``FileCoordinationStore`` locks per key); it
+        exists so a minimal duck-typed store still runs the protocols."""
+        if self.get(key) != expected:
+            return False
+        self.put(key, new)
+        return True
 
     def delete(self, key: str) -> None:
+        raise NotImplementedError
+
+    def list(self, prefix: str) -> List[str]:
         raise NotImplementedError
 
     def now(self) -> float:
@@ -107,12 +141,27 @@ class FileCoordinationStore(CoordinationStore):
     discipline as the checkpoint manifests.  The tmp name carries pid and
     thread id so concurrent writers (simulated hosts are threads) never
     collide on it.
+
+    :meth:`compare_and_swap` serializes writers through a per-key
+    ``<key>.lock`` file created ``O_CREAT|O_EXCL`` — atomic on every
+    filesystem the store targets, across threads AND processes.  A lock
+    orphaned by a writer that died mid-CAS is broken after
+    ``lock_stale_s`` (the readers-never-block property is preserved:
+    ``get``/``list`` ignore locks entirely).
     """
 
-    def __init__(self, root: str, clock: Optional[Callable[[], float]] = None):
+    def __init__(self, root: str, clock: Optional[Callable[[], float]] = None,
+                 cas_timeout_s: float = 10.0, lock_stale_s: float = 5.0):
         self.root = str(root)
         os.makedirs(self.root, exist_ok=True)
         self._clock = clock
+        # the CAS wait must be able to OUTLIVE the stale-lock window, or a
+        # lock orphaned by a SIGKILLed writer turns every later CAS on the
+        # key into a timeout error instead of one stolen lock (the breaker
+        # would be unreachable within a single call)
+        self.cas_timeout_s = max(float(cas_timeout_s),
+                                 float(lock_stale_s) + 1.0)
+        self.lock_stale_s = float(lock_stale_s)
 
     def _path(self, key: str) -> str:
         key = key.strip("/")
@@ -141,12 +190,78 @@ class FileCoordinationStore(CoordinationStore):
                            key, e)
             return None
 
+    def compare_and_swap(self, key: str, expected: Optional[Dict],
+                         new: Dict) -> bool:
+        from ..resilience.integrity import _atomic_write_json
+
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        lock = path + ".lock"
+        deadline = time.monotonic() + self.cas_timeout_s
+        while True:
+            try:
+                fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                my_ino = os.fstat(fd).st_ino
+                break
+            except FileExistsError:
+                try:
+                    # break a lock orphaned by a writer that died holding
+                    # it (wall-clock mtime: the injectable store clock must
+                    # not make a live lock look ancient).  The steal is an
+                    # atomic RENAME to a waiter-unique name: of N waiters
+                    # that all observed the same stale lock, exactly one
+                    # rename succeeds — a bare remove here would let a
+                    # second waiter delete the FIRST waiter's freshly
+                    # re-created lock and put two writers inside the
+                    # critical section (split-brain CAS).
+                    if time.time() - os.path.getmtime(lock) > self.lock_stale_s:
+                        stolen = (f"{lock}.stale.{os.getpid()}"
+                                  f".{threading.get_ident()}")
+                        os.rename(lock, stolen)
+                        os.remove(stolen)
+                        continue
+                except OSError:
+                    pass   # the holder released it (or another waiter
+                           # stole it) between the two calls
+                if time.monotonic() >= deadline:
+                    raise PodCoordinationError(
+                        f"compare_and_swap({key!r}): lock {lock} held for "
+                        f"over {self.cas_timeout_s:.1f}s — a writer is "
+                        "wedged or the stale-lock breaker is disabled")
+                time.sleep(0.001)
+        try:
+            if self.get(key) != expected:
+                return False
+            _atomic_write_json(path, new)
+            return True
+        finally:
+            os.close(fd)
+            try:
+                # ownership-checked release: if a waiter stale-stole OUR
+                # lock (we stalled past lock_stale_s inside this critical
+                # section), the file at `lock` is now the stealer's —
+                # removing it blindly would admit yet another writer.  The
+                # stale threshold (seconds) vs the ms-long critical section
+                # makes a steal-from-live vanishingly rare, but the release
+                # must not widen it into a cascade.
+                if os.stat(lock).st_ino == my_ino:
+                    os.remove(lock)
+            except OSError:   # pragma: no cover - breaker raced us
+                pass
+
     def list(self, prefix: str) -> List[str]:
         try:
             names = os.listdir(self._path(prefix))
         except (FileNotFoundError, NotADirectoryError):
             return []
-        return sorted(n for n in names if ".tmp." not in n)
+        # tmp siblings and CAS lock files (incl. `<key>.lock.stale.*`
+        # rename-steal remnants of a waiter that died mid-steal) are
+        # write-protocol artifacts, never documents.  Match the exact
+        # artifact shapes, not a bare ".lock" substring — a legitimate id
+        # like "db.lockhart-3" must stay visible to lease/dead scans.
+        return sorted(n for n in names
+                      if ".tmp." not in n and not n.endswith(".lock")
+                      and ".lock.stale." not in n)
 
     def delete(self, key: str) -> None:
         try:
@@ -178,20 +293,23 @@ class HostLease:
 
 
 def beat(store: CoordinationStore, host_id: str, generation: int,
-         lease_s: float, **attrs) -> None:
+         lease_s: float, prefix: str = "heartbeat", **attrs) -> None:
     """Renew ``host_id``'s lease for ``generation``.  ``attrs`` ride along
-    (e.g. ``step=`` so peers and the supervisor can observe progress)."""
+    (e.g. ``step=`` so peers and the supervisor can observe progress).
+    ``prefix`` namespaces the lease tier (pods default to ``heartbeat``;
+    serving-fleet engines lease under ``fleet/heartbeat``)."""
     maybe_fire(SITE_POD_HEARTBEAT, host=host_id, generation=generation)
-    store.put(f"heartbeat/{host_id}", {
+    store.put(f"{prefix}/{host_id}", {
         "host_id": host_id, "generation": int(generation),
         "beat_t": store.now(), "lease_s": float(lease_s), "attrs": attrs})
 
 
-def lease_table(store: CoordinationStore) -> Dict[str, HostLease]:
+def lease_table(store: CoordinationStore,
+                prefix: str = "heartbeat") -> Dict[str, HostLease]:
     """Every host's newest lease, regardless of generation or freshness."""
     out: Dict[str, HostLease] = {}
-    for name in store.list("heartbeat"):
-        doc = store.get(f"heartbeat/{name}")
+    for name in store.list(prefix):
+        doc = store.get(f"{prefix}/{name}")
         if doc is None:
             continue
         out[doc["host_id"]] = HostLease(
@@ -202,7 +320,8 @@ def lease_table(store: CoordinationStore) -> Dict[str, HostLease]:
 
 
 def dead_hosts(store: CoordinationStore, generation: int, miss_limit: int,
-               expected: Optional[List[str]] = None) -> List[str]:
+               expected: Optional[List[str]] = None,
+               prefix: str = "heartbeat") -> List[str]:
     """Hosts of ``generation`` whose lease has lapsed ``miss_limit`` times
     — plus, when ``expected`` is given, hosts that never reached this
     generation at all (no lease, or one stuck at an OLDER generation: a
@@ -211,7 +330,7 @@ def dead_hosts(store: CoordinationStore, generation: int, miss_limit: int,
     watchdog still scanning for its old generation must not dead-mark the
     healthy hosts that re-formed without it."""
     now = store.now()
-    table = lease_table(store)
+    table = lease_table(store, prefix=prefix)
     dead = []
     for host, lease in table.items():
         if lease.generation == generation and lease.missed(now) >= miss_limit:
@@ -224,39 +343,146 @@ def dead_hosts(store: CoordinationStore, generation: int, miss_limit: int,
 
 
 def record_dead(store: CoordinationStore, host_id: str, generation: int,
-                reported_by: str) -> None:
+                reported_by: str, prefix: str = "dead") -> None:
     """Durable dead-host marker: once ANY peer declares a host dead for a
     generation, every later supervisor round excludes it until an operator
-    (or a re-registering host) clears the marker."""
-    store.put(f"dead/{host_id}", {
-        "host_id": host_id, "generation": int(generation),
-        "reported_by": reported_by, "t": store.now()})
+    (or a re-registering host) clears the marker.  CAS-written so racing
+    reporters commit exactly one marker per generation — the FIRST
+    reporter wins, and a marker from an equal-or-newer generation is never
+    clobbered by a stale scanner still looking at an old epoch."""
+    doc = {"host_id": host_id, "generation": int(generation),
+           "reported_by": reported_by, "t": store.now()}
+    while True:
+        cur = store.get(f"{prefix}/{host_id}")
+        if cur is not None and int(cur.get("generation", -1)) >= int(generation):
+            return
+        if store.compare_and_swap(f"{prefix}/{host_id}", cur, doc):
+            return
 
 
-def dead_set(store: CoordinationStore) -> List[str]:
-    return [name for name in store.list("dead")
-            if store.get(f"dead/{name}") is not None]
+def dead_set(store: CoordinationStore, prefix: str = "dead") -> List[str]:
+    return [name for name in store.list(prefix)
+            if store.get(f"{prefix}/{name}") is not None]
 
 
-def clear_dead(store: CoordinationStore, host_id: str) -> None:
+def clear_dead(store: CoordinationStore, host_id: str,
+               prefix: str = "dead") -> None:
     """A replaced/recovered host re-admits itself by clearing its marker
     (the next supervisor round then counts it healthy again)."""
-    store.delete(f"dead/{host_id}")
+    store.delete(f"{prefix}/{host_id}")
 
 
 # --------------------------------------------------------------- generation
 
-def read_generation(store: CoordinationStore) -> int:
-    doc = store.get("generation")
+def read_generation(store: CoordinationStore, key: str = "generation") -> int:
+    doc = store.get(key)
     return int(doc["generation"]) if doc else 0
 
 
-def bump_generation(store: CoordinationStore) -> int:
-    """Advance the pod generation and return the new value.  Single-writer
-    by contract: only the supervisor round (one process) bumps."""
-    gen = read_generation(store) + 1
-    store.put("generation", {"generation": gen, "t": store.now()})
-    return gen
+def bump_generation(store: CoordinationStore, key: str = "generation") -> int:
+    """Advance the generation and return the value THIS caller committed.
+    A CAS loop: each concurrent bumper wins exactly one distinct round —
+    two supervisor processes racing (or a deposed coordinator racing its
+    successor) can no longer lose an update or tear the counter.  The
+    returned value is strictly monotonic across all winners."""
+    while True:
+        doc = store.get(key)
+        gen = int(doc["generation"]) if doc else 0
+        if store.compare_and_swap(key, doc,
+                                  {"generation": gen + 1, "t": store.now()}):
+            return gen + 1
+
+
+# ----------------------------------------------------- coordinator election
+
+@dataclass(frozen=True)
+class CoordinatorLease:
+    """The coordinator lock document: who leads, under which term, renewed
+    when.  ``term`` increments on every leadership CHANGE (never on a
+    renewal), so any two leaders are ordered and a fenced-out old leader
+    can recognize its own deposition."""
+    leader_id: str
+    term: int
+    t: float               # store-clock stamp of the newest acquire/renewal
+    lease_s: float
+
+    def age(self, now: float) -> float:
+        return max(0.0, now - self.t)
+
+    def expired(self, now: float) -> bool:
+        return self.age(now) >= self.lease_s
+
+
+def _coordinator_doc(doc: Optional[Dict]) -> Optional[CoordinatorLease]:
+    if doc is None:
+        return None
+    return CoordinatorLease(
+        leader_id=doc["leader_id"], term=int(doc["term"]),
+        t=float(doc["t"]), lease_s=float(doc["lease_s"]))
+
+
+def read_coordinator(store: CoordinationStore,
+                     key: str = "coordinator") -> Optional[CoordinatorLease]:
+    return _coordinator_doc(store.get(key))
+
+
+def elect_coordinator(store: CoordinationStore, candidate_id: str,
+                      lease_s: float,
+                      key: str = "coordinator") -> Optional[CoordinatorLease]:
+    """One election round for ``candidate_id``: returns the lease it holds
+    after this call, or ``None`` when someone else leads.
+
+    Exactly one CAS attempt — callers poll this every scheduler round, so
+    a lost race just retries on the next poll:
+
+    - vacant key  -> acquire at term 1;
+    - own lease   -> renew (same term, fresh stamp);
+    - LAPSED peer -> take over at ``term + 1`` (re-elect on lease lapse);
+    - live peer   -> ``None`` (a healthy leader is never stolen from).
+
+    The CAS is what makes a split-brain impossible: two standbys seeing
+    the same lapsed lease both attempt ``term + 1``, and the store admits
+    exactly one — the loser observes the new document and stands down.
+    """
+    doc = store.get(key)
+    now = store.now()
+    if doc is None:
+        new = {"leader_id": candidate_id, "term": 1, "t": now,
+               "lease_s": float(lease_s)}
+        return _coordinator_doc(new) \
+            if store.compare_and_swap(key, None, new) else None
+    cur = _coordinator_doc(doc)
+    if cur.leader_id == candidate_id:
+        new = {"leader_id": candidate_id, "term": cur.term, "t": now,
+               "lease_s": float(lease_s)}
+        # a failed renewal means a standby deposed us between our beats —
+        # report not-leader so the caller stops driving immediately
+        return _coordinator_doc(new) \
+            if store.compare_and_swap(key, doc, new) else None
+    if cur.expired(now):
+        new = {"leader_id": candidate_id, "term": cur.term + 1, "t": now,
+               "lease_s": float(lease_s)}
+        if store.compare_and_swap(key, doc, new):
+            logger.info("coordinator election: %r takes term %d from "
+                        "lapsed %r (lease age %.3fs)", candidate_id,
+                        cur.term + 1, cur.leader_id, cur.age(now))
+            return _coordinator_doc(new)
+    return None
+
+
+def resign_coordinator(store: CoordinationStore, candidate_id: str,
+                       key: str = "coordinator") -> bool:
+    """Voluntarily lapse the candidate's own lease (planned hand-off: the
+    next ``elect_coordinator`` poll by any standby wins immediately
+    instead of waiting out the lease).  CAS-guarded so resigning can never
+    clobber a successor that already took over."""
+    doc = store.get(key)
+    cur = _coordinator_doc(doc)
+    if cur is None or cur.leader_id != candidate_id:
+        return False
+    lapsed = {"leader_id": candidate_id, "term": cur.term,
+              "t": cur.t - cur.lease_s, "lease_s": cur.lease_s}
+    return store.compare_and_swap(key, doc, lapsed)
 
 
 # --------------------------------------------------------------- rendezvous

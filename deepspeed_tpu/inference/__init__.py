@@ -1,9 +1,16 @@
 """Inference subsystem: the one-shot engine (``engine.InferenceEngine``,
 built by ``deepspeed_tpu.init_inference``), the continuous-batching serving
-engine (``serving.ServingEngine``), and its warm-restart wrapper
-(``serving_supervisor.ServingSupervisor``)."""
+engine (``serving.ServingEngine``), its warm-restart wrapper
+(``serving_supervisor.ServingSupervisor``), and the leased multi-engine
+fleet tier (``fleet.FleetRouter``)."""
 from .config import DeepSpeedInferenceConfig  # noqa: F401
 from .engine import InferenceEngine  # noqa: F401
+from .fleet import (  # noqa: F401
+    EngineDead,
+    FleetMember,
+    FleetRouter,
+    FleetUnrecoverable,
+)
 from .prefix_cache import PrefixIndex, PrefixMatch  # noqa: F401
 from .serving import (  # noqa: F401
     PoolConsumedError,

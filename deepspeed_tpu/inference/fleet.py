@@ -1,0 +1,836 @@
+"""Serving fleet tier: leased engines, coordinator election, request failover.
+
+One :class:`~.serving_supervisor.ServingSupervisor`-wrapped engine (PRs 2-6)
+warm-restarts its way through pool poisonings and slot quarantines, but it is
+still a single point of failure: lose the process and every queued and
+in-flight request is gone, lose the host and nothing re-routes.  This module
+closes that gap the same way ``elasticity/pod_agent.py`` closed it for
+training pods — by leaning on the :class:`~..elasticity.coordination
+.CoordinationStore` the repo already trusts for leases, generations and
+(now) compare-and-swap:
+
+- :class:`FleetMember` — one supervised engine of the fleet.  It renews a
+  heartbeat lease under ``fleet/heartbeat/<engine_id>`` and advertises its
+  ``health()`` snapshot (queue depth, usable slots, bound /metrics port,
+  flight-recorder drop counters) under ``fleet/engines/<engine_id>`` every
+  scheduler round.  Faults inside the engine stay the member's business:
+  the wrapped supervisor warm-restarts and replays token-exactly as before;
+  only a member whose restart budget exhausts (its "process" is gone) stops
+  renewing and writes a durable ``fleet/dead`` marker as a dying breath.
+- :class:`FleetRouter` — the fleet front-end, elected by CAS on
+  ``fleet/coordinator`` (:func:`~..elasticity.coordination
+  .elect_coordinator`).  The coordinator admits each request to the
+  least-loaded live engine, sheds by FLEET-wide queue depth with a typed
+  ``"shed"`` result, journals every assignment under ``fleet/requests/``
+  (prompt + budget + arrival epoch — everything failover needs), and scans
+  member leases every round.  A lapsed lease (or a dead marker) fails the
+  engine's queued AND in-flight requests over to survivors: re-prefill from
+  the ORIGINAL prompt — the "drop refcount, re-prefill" contract of
+  docs/SERVING.md, which greedy decoding makes token-exact — with
+  ``arrival_epoch_s`` preserved so TTFT, queued-age gauges and remaining
+  deadline budgets stay anchored to the TRUE arrival, never the failover
+  instant.  Failed-over results carry ``RequestResult.failovers``.
+- **Coordinator failover** — a standby router polls the same election; when
+  the leader's lease lapses it takes the next term, bumps the fleet
+  generation (a CAS loop — exactly one bump even if a deposed leader
+  races), and adopts the request journal from the store, so requests
+  dispatched by the dead coordinator are tracked, failed over and completed
+  by its successor.  Requests live on the coordination store, not in any
+  single router's memory.
+- **Rolling restarts** (:meth:`FleetRouter.rolling_restart`) — one engine
+  at a time: stop routing to it, ``drain()`` (finishes in-flight work,
+  token-exact mid-drain recovery included), redistribute the unserved
+  hand-back to the rest of the fleet, then
+  :meth:`~.serving_supervisor.ServingSupervisor.recycle` a fresh engine
+  without spending the fault-restart budget.
+
+The in-process harness (tests, ``tools/chaos_soak.py --mode fleet``,
+``tools/serve_bench.py --mode fleet``) drives members cooperatively — one
+``pump()`` per router round — so chaos schedules stay deterministic; the
+production shape is one member per process with the router polling the same
+store keys.  Fleet rollup gauges (``fleet/engines_live``,
+``fleet/queue_depth``, ``fleet/failovers_total``, ``fleet/flight_dropped_
+total``, ...) land on the router's monitor and therefore on the Prometheus
+exposition.  See docs/FLEET.md.
+"""
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import hashlib
+import os
+import re
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..elasticity.coordination import (CoordinationStore, beat,
+                                       bump_generation, dead_set,
+                                       elect_coordinator, lease_table,
+                                       read_generation, record_dead)
+from ..observability.trace import get_tracer, trace_span
+from ..utils.logging import log_dist, logger
+from .serving import Request, RequestResult, ServeTimeout, SlotPrefillError
+from .serving_supervisor import RestartBudgetExhausted, ServingSupervisor
+
+__all__ = ["EngineDead", "FleetMember", "FleetRouter", "FleetUnrecoverable"]
+
+# store namespaces of the fleet tier (the pod tier keeps heartbeat/, dead/,
+# generation — one store can carry both without key collisions)
+FLEET_HEARTBEAT_PREFIX = "fleet/heartbeat"
+FLEET_DEAD_PREFIX = "fleet/dead"
+FLEET_ENGINES_PREFIX = "fleet/engines"
+FLEET_REQUESTS_PREFIX = "fleet/requests"
+FLEET_COORDINATOR_KEY = "fleet/coordinator"
+FLEET_GENERATION_KEY = "fleet/generation"
+
+
+class EngineDead(RuntimeError):
+    """The member's engine process is gone (simulated kill, or a restart
+    budget exhausted) — its host-side state is unreachable and recovery is
+    the ROUTER's job (lease-lapse failover), not the supervisor's."""
+
+
+class FleetUnrecoverable(RuntimeError):
+    """No live engine remains to fail requests over to."""
+
+
+def _rid_key(rid: Any) -> str:
+    """Store-key-safe encoding of a request id (journal entries live at
+    ``fleet/requests/<key>``).  Type-prefixed so int 7 and str "7" cannot
+    collide; non-key-safe or long rids get a stable content hash suffix."""
+    raw = f"{'i' if isinstance(rid, int) else 's'}{rid}"
+    safe = re.sub(r"[^A-Za-z0-9_.-]", "_", raw)
+    if safe != raw or len(safe) > 80 or ".lock" in safe or ".tmp." in safe:
+        # ".lock"/".tmp." would collide with the store's write-protocol
+        # artifacts and be FILTERED from list() — a journal entry a
+        # successor coordinator could never see
+        safe = re.sub(r"[^A-Za-z0-9_-]", "_", safe[:64])
+        safe = f"{safe}-{hashlib.sha1(raw.encode()).hexdigest()[:10]}"
+    return safe
+
+
+class FleetMember:
+    """One leased engine of the fleet: a :class:`ServingSupervisor` plus
+    the store-facing lease/advertisement surface.
+
+    ``metrics_port`` (optional) starts a per-member /metrics endpoint on
+    the member's monitor — pass ``0`` for an ephemeral bind so N members
+    on one host never collide; a taken FIXED port also falls back to
+    ephemeral instead of failing the member (the advertisement carries the
+    ACTUAL bound port either way).
+    """
+
+    def __init__(self, engine_id: str, supervisor: ServingSupervisor,
+                 store: CoordinationStore, lease_s: float = 5.0,
+                 metrics_port: Optional[int] = None):
+        self.engine_id = str(engine_id)
+        self.sup = supervisor
+        self.store = store
+        self.lease_s = float(lease_s)
+        self.generation = 0          # stamped by the router before each beat
+        self.alive = True
+        self.routable = True         # False while a rolling restart drains it
+        self.death_cause: Optional[BaseException] = None
+        self.last_advert: Optional[Dict[str, Any]] = None
+        self._last_beat_t: Optional[float] = None   # store clock
+        self.metrics_server = None
+        if metrics_port is not None:
+            # N engines sharing a host with one configured port: the shared
+            # fallback policy binds the latecomers ephemerally instead of
+            # crashing them at init (export.bind_metrics_server)
+            from ..observability.export import bind_metrics_server
+
+            self.metrics_server = bind_metrics_server(
+                int(metrics_port), monitor=supervisor.monitor,
+                label=f"fleet[{self.engine_id}] metrics endpoint")
+
+    @property
+    def metrics_port(self) -> Optional[int]:
+        """The member's OWN endpoint when it runs one, else the engine's
+        env-gated process-global port (both None = no endpoint)."""
+        if self.metrics_server is not None:
+            return self.metrics_server.port
+        return self.sup.engine.metrics_port
+
+    def outstanding(self) -> int:
+        eng = self.sup.engine
+        return int(eng._active.sum()) + len(eng._queue) + len(eng._pending)
+
+    def backlog(self) -> int:
+        """Waiting (not yet decoding) requests — the shed/routing signal."""
+        eng = self.sup.engine
+        return len(eng._queue) + len(eng._pending)
+
+    def submit(self, request: Request) -> Any:
+        return self.sup.submit(request)
+
+    def take_results(self) -> List[RequestResult]:
+        if not self.alive:
+            return []   # a dead process's unclaimed results are gone
+        return self.sup.take_results()
+
+    # ------------------------------------------------- lease + advertisement
+
+    def advertisement(self) -> Dict[str, Any]:
+        """The health snapshot the router reads back through the store —
+        routing load, capacity, the bound /metrics port, and the
+        observability drop counters PR 4 left per-process (the router
+        rolls them up fleet-wide)."""
+        h = self.sup.health()
+        mon = self.sup.monitor
+        return {
+            "engine_id": self.engine_id,
+            "generation": int(self.generation),
+            "t": self.store.now(),
+            "queue_depth": h["queue_depth"],
+            "active_slots": h["active_slots"],
+            "usable_slots": h["usable_slots"],
+            "free_pages": h["free_pages"],
+            "draining": h["draining"],
+            "restarts": h["restarts"],
+            "shed_total": h["shed_total"],
+            "deadline_expired_total": h["deadline_expired_total"],
+            "oldest_request_age_s": h["oldest_request_age_s"],
+            "metrics_port": self.metrics_port,
+            # per-engine flight-dump aggregation keys: the ring and monitor
+            # drop counts this process would otherwise only expose locally.
+            # The source ids scope each counter to its PROCESS-level object
+            # — the tracer ring is a process singleton and in-process fleet
+            # members may share a monitor, so a rollup summing N identical
+            # advertisements would overcount N-fold without them.
+            "flight_dropped": int(get_tracer().recorder.dropped),
+            "flight_src": f"{os.getpid()}",
+            "monitor_dropped": int(getattr(mon, "dropped_events", 0) or 0),
+            "monitor_src": f"{os.getpid()}.{id(mon)}",
+            "last_restart_cause": h["last_restart_cause"],
+        }
+
+    def beat(self, force: bool = False) -> None:
+        """Renew the engine lease and refresh the advertisement (a dead
+        member renews nothing — that silence IS the failure signal).
+        Renewals are rate-limited to a third of the lease on the store
+        clock: the router calls this every scheduler tick, and a per-tick
+        write pair per engine would hammer a network-filesystem store for
+        leases that only need renewal every ``lease_s/3``.  ``force``
+        bypasses the limit (first beat after a recycle, takeover)."""
+        if not self.alive:
+            return
+        now = self.store.now()
+        if not force and self._last_beat_t is not None \
+                and now - self._last_beat_t < self.lease_s / 3.0:
+            return
+        self._last_beat_t = now
+        beat(self.store, self.engine_id, self.generation, self.lease_s,
+             prefix=FLEET_HEARTBEAT_PREFIX, backlog=self.backlog())
+        ad = self.advertisement()
+        self.store.put(f"{FLEET_ENGINES_PREFIX}/{self.engine_id}", ad)
+        # in-process readers (the router's gauge rollup) reuse what was
+        # just written instead of re-reading the file every tick
+        self.last_advert = ad
+
+    # --------------------------------------------------------------- pumping
+
+    def pump(self) -> int:
+        """One engine scheduler tick under the warm-restart contract (the
+        cooperative-harness equivalent of the supervisor's run loop):
+        slot-attributable prefill failures with a live pool keep serving,
+        anything else warm-restarts with token-exact replay, and an
+        exhausted restart budget kills the member."""
+        if not self.alive:
+            raise EngineDead(f"engine {self.engine_id} is dead")
+        sup = self.sup
+        try:
+            return sup.engine.step()
+        except (KeyboardInterrupt, ServeTimeout):
+            raise
+        except SlotPrefillError as e:
+            if sup.engine.pool_alive():
+                logger.warning("fleet[%s]: continuing past %s",
+                               self.engine_id, e)
+                return self.outstanding()
+            return self._recover(e)
+        except Exception as e:
+            return self._recover(e)
+
+    def _recover(self, cause: BaseException) -> int:
+        try:
+            self.sup._safe_restart(cause)
+        except RestartBudgetExhausted as e:
+            # the member process would crash here.  Dying breath: a durable
+            # CAS-written dead marker so the router fails over NOW instead
+            # of waiting out the lease (a hard kill still relies on lapse).
+            self.alive = False
+            self.death_cause = e
+            try:
+                record_dead(self.store, self.engine_id, self.generation,
+                            self.engine_id, prefix=FLEET_DEAD_PREFIX)
+            except Exception:   # pragma: no cover - the store died with us
+                pass
+            raise EngineDead(
+                f"engine {self.engine_id} exhausted its restart budget: "
+                f"{e}") from e
+        return self.outstanding()
+
+    def recycle(self) -> bool:
+        """Rolling-restart hand-off: fresh engine, no budget spent."""
+        return self.sup.recycle()
+
+    def kill(self) -> None:
+        """Test/chaos hook simulating process death: the lease silently
+        stops renewing and the engine's host-side state (queue, slots,
+        unclaimed results) becomes unreachable.  Detection is the ROUTER's
+        lease scan — nothing is drained or handed back."""
+        self.alive = False
+
+
+class FleetRouter:
+    """The elected fleet front-end (see the module docstring).
+
+    One router instance is one COORDINATOR CANDIDATE: every :meth:`step`
+    polls the election, and only the current leader drives the fleet —
+    standbys idle until the leader's lease lapses, then take over with the
+    journal.  ``store.now()`` is the lease/election clock (injectable for
+    deterministic chaos); engine scheduling stays on the host monotonic
+    clock.
+    """
+
+    def __init__(self, store: CoordinationStore,
+                 members: List[FleetMember], router_id: str = "router0",
+                 lease_s: float = 5.0, miss_limit: int = 3,
+                 max_fleet_queue: Optional[int] = None, monitor=None,
+                 election_key: str = FLEET_COORDINATOR_KEY,
+                 generation_key: str = FLEET_GENERATION_KEY):
+        self.store = store
+        self.members: Dict[str, FleetMember] = {}
+        for m in members:
+            if m.engine_id in self.members:
+                raise ValueError(f"duplicate engine_id {m.engine_id!r}")
+            self.members[m.engine_id] = m
+        self.router_id = str(router_id)
+        self.lease_s = float(lease_s)
+        self.miss_limit = int(miss_limit)
+        self.max_fleet_queue = (int(max_fleet_queue)
+                                if max_fleet_queue is not None else None)
+        if self.max_fleet_queue is not None and self.max_fleet_queue < 1:
+            raise ValueError(
+                f"max_fleet_queue={self.max_fleet_queue} must be >= 1")
+        self.monitor = monitor
+        self.election_key = election_key
+        self.generation_key = generation_key
+        self.generation = read_generation(store, key=generation_key)
+        self.alive = True
+        self.is_coordinator = False
+        self.term = 0                    # the term this router leads under
+        self._tick = 0
+        self._t0 = time.monotonic()
+        self._later: List[Request] = []  # router-gated future arrivals
+        self._requests: Dict[Any, Request] = {}   # rid -> ORIGINAL request
+        self._owner: Dict[Any, str] = {}          # rid -> engine_id
+        self._failed_over: Dict[Any, int] = {}
+        self._failed_engines: set = set()
+        self._last_scan_t: Optional[float] = None   # store clock
+        self._lead_since: Optional[float] = None    # store clock, takeover
+        self._results: Dict[Any, RequestResult] = {}
+        self._order: List[Any] = []
+        self.failovers_total = 0
+        self.shed_total = 0
+        self.elections_total = 0
+        self.rolling_restarts_total = 0
+        self.tokens_by_engine: Dict[str, int] = {
+            m.engine_id: 0 for m in members}
+
+    # ------------------------------------------------------------ admission
+
+    def fleet_queue_depth(self) -> int:
+        """Fleet-wide WAITING depth: every live engine's queue + pending,
+        plus arrivals the router has not dispatched yet."""
+        depth = len(self._later)
+        for m in self.members.values():
+            if m.alive:
+                depth += m.backlog()
+        return depth
+
+    def submit(self, request: Request) -> Any:
+        """Accept a request into the fleet.  Arrival offsets are measured
+        from the ROUTER clock (the router owns admission gating so routing
+        decisions see the load at dispatch time, not submission time); the
+        absolute arrival epoch is stamped here and preserved across every
+        failover.  Rids must be JSON scalars — the journal is how a
+        successor coordinator reconstructs the request."""
+        ids = np.asarray(request.input_ids, np.int32).reshape(-1)
+        request = dataclasses.replace(request, input_ids=ids)
+        rid = request.rid
+        if not isinstance(rid, (str, int)) or isinstance(rid, bool):
+            raise ValueError(
+                f"fleet request ids must be str or int (got {type(rid)}): "
+                "the store journal must reconstruct them on coordinator "
+                "failover")
+        if rid in self._requests or rid in self._results:
+            raise ValueError(
+                f"request id {rid!r} is already tracked by the fleet — "
+                "rids must be unique")
+        if request.arrival_epoch_s is None:
+            request = dataclasses.replace(
+                request,
+                arrival_epoch_s=self._t0 + max(0.0, request.arrival_time))
+        self._requests[rid] = request
+        if request.arrival_time > 0:
+            # journal BEFORE parking (engine=None: accepted, not yet
+            # dispatched) — a future arrival must survive coordinator
+            # death like any dispatched request, or the standby would
+            # adopt an empty journal and silently drop it
+            self._journal(rid, request, None)
+            bisect.insort(self._later, request, key=lambda r: r.arrival_time)
+            return rid
+        self._route(request)
+        return rid
+
+    def _remaining_deadline(self, req: Request) -> Optional[float]:
+        """Deadline budget left, measured from the TRUE arrival epoch —
+        idempotent across failovers (always derived from the original
+        deadline, never from a previously-reduced copy), and floored at an
+        epsilon so an already-dead request still flows through the
+        engine's typed expiry path."""
+        if req.deadline_s is None:
+            return None
+        elapsed = max(0.0, time.monotonic() - req.arrival_epoch_s)
+        return max(1e-6, req.deadline_s - elapsed)
+
+    def _pick_engine(self) -> Optional[str]:
+        """Least-loaded live routable engine (waiting + decoding count).
+        Read from the live member handle — the store advertisement carries
+        the SAME queue_depth/active_slots numbers for cross-process
+        consumers, but it is refreshed once per round and several
+        dispatches can land within one, so routing must see each dispatch
+        it just made.  engine_id breaks ties deterministically."""
+        best = None
+        best_load = None
+        for eid in sorted(self.members):
+            m = self.members[eid]
+            if not (m.alive and m.routable):
+                continue
+            load = m.outstanding()
+            if best_load is None or load < best_load:
+                best, best_load = eid, load
+        return best
+
+    def _route(self, request: Request, requeue: bool = False) -> None:
+        """Dispatch to the least-loaded engine (or shed).  ``requeue`` is
+        the failover/redistribution path: work the fleet ALREADY accepted
+        is never shed by its own recovery — the same contract the serving
+        supervisor holds for replays."""
+        rid = request.rid
+        if not requeue and self.max_fleet_queue is not None \
+                and self.fleet_queue_depth() >= self.max_fleet_queue:
+            self._shed(request, "fleet queue full")
+            return
+        target = self._pick_engine()
+        if target is None:
+            if requeue:
+                raise FleetUnrecoverable(
+                    f"no live engine remains to fail request {rid!r} over "
+                    "to — the whole fleet is dead")
+            self._shed(request, "no live engines")
+            return
+        member = self.members[target]
+        sub = dataclasses.replace(
+            request,
+            # engine-relative arrival: "now" on the target's clock, so its
+            # deadline/queued-age math starts at dispatch while the epoch
+            # stamp keeps reporting anchored to the true arrival
+            arrival_time=max(0.0,
+                             time.monotonic() - member.sup.engine._t0),
+            deadline_s=self._remaining_deadline(request))
+        member.submit(sub)
+        self._owner[rid] = target
+        self._journal(rid, request, target)
+
+    def _shed(self, request: Request, why: str) -> None:
+        t = time.monotonic()
+        target = self._pick_engine()
+        hint = (self.members[target].sup.engine._retry_after_hint()
+                if target is not None else 1.0)
+        rid = request.rid
+        self._results[rid] = RequestResult(
+            rid=rid, input_ids=request.input_ids,
+            output_ids=np.zeros((0,), np.int32), finish_reason="shed",
+            prefill_bucket=0,
+            arrival_s=request.arrival_epoch_s or t, admit_s=t,
+            first_token_s=t, finish_s=t, retry_after_s=hint)
+        self._order.append(rid)
+        self._requests.pop(rid, None)
+        # a shed request may have been journaled at submit (future
+        # arrival): its terminal result is decided here, so the journal
+        # entry must not outlive it (delete is idempotent)
+        self.store.delete(f"{FLEET_REQUESTS_PREFIX}/{_rid_key(rid)}")
+        self.shed_total += 1
+        logger.warning("fleet: shed request %r (%s); retry_after=%.3fs",
+                       rid, why, hint)
+
+    def _journal(self, rid: Any, request: Request,
+                 engine_id: Optional[str]) -> None:
+        """Durable assignment record: everything a SUCCESSOR coordinator
+        needs to re-own (and, if the engine dies, re-prefill) the request.
+        ``engine_id=None`` = accepted but not yet dispatched (a future
+        arrival parked at the router).  Deleted when the result is
+        collected (or the request is shed)."""
+        self.store.put(f"{FLEET_REQUESTS_PREFIX}/{_rid_key(rid)}", {
+            "rid": rid,
+            "engine": engine_id,
+            "input_ids": [int(x) for x in request.input_ids],
+            "max_new_tokens": int(request.max_new_tokens),
+            "eos_token_id": (int(request.eos_token_id)
+                             if request.eos_token_id is not None else None),
+            "deadline_s": request.deadline_s,
+            "arrival_epoch_s": request.arrival_epoch_s,
+            "failovers": self._failed_over.get(rid, 0),
+            "t": self.store.now()})
+
+    # ------------------------------------------------------------- the loop
+
+    def step(self) -> int:
+        """One fleet round: poll the election; as coordinator, renew
+        member leases + advertisements, promote due arrivals, pump every
+        live engine one tick, harvest results, scan for lapsed leases /
+        dead markers (failover), and write the fleet gauges.  A standby
+        router does nothing but poll.  Returns the outstanding request
+        count this router tracks."""
+        if not self.alive:
+            raise RuntimeError(f"router {self.router_id} is dead")
+        lease = elect_coordinator(self.store, self.router_id, self.lease_s,
+                                  key=self.election_key)
+        if lease is None:
+            self.is_coordinator = False
+            return self.outstanding()
+        if not self.is_coordinator or lease.term != self.term:
+            self._take_over(lease)
+        self._tick += 1
+        with trace_span("fleet.tick", tick=self._tick):
+            for eid in sorted(self.members):
+                m = self.members[eid]
+                if m.alive:
+                    m.generation = self.generation
+                    m.beat()
+            now = time.monotonic() - self._t0
+            k = bisect.bisect_right(self._later, now,
+                                    key=lambda r: r.arrival_time)
+            for req in self._later[:k]:
+                self._route(req)
+            del self._later[:k]
+            for eid in sorted(self.members):
+                m = self.members[eid]
+                if not m.alive:
+                    continue
+                try:
+                    m.pump()
+                except EngineDead:
+                    # handled below: the dead marker / lapsed lease is the
+                    # router-visible form of this death
+                    pass
+                self._collect(m)
+            self._scan_leases()
+            self._write_gauges()
+        return self.outstanding()
+
+    def outstanding(self) -> int:
+        return len(self._requests)
+
+    def run(self, requests: Optional[List[Request]] = None,
+            max_ticks: Optional[int] = None,
+            on_tick=None) -> List[RequestResult]:
+        """Serve ``requests`` (plus anything already tracked) to terminal
+        results.  ``on_tick(router, round)`` runs after every round — the
+        chaos harness uses it to advance injected store clocks and land
+        kills at exact rounds.  ``max_ticks`` bounds the LOOP (election
+        polls included), raising :class:`~.serving.ServeTimeout` like the
+        engine's own run()."""
+        for req in requests or []:
+            self.submit(req)
+        rounds = 0
+        while True:
+            pending = self.step()
+            rounds += 1
+            if on_tick is not None:
+                on_tick(self, rounds)
+            if pending == 0:
+                # a STANDBY tracks nothing until it wins the election and
+                # adopts the journal — it must keep polling while journaled
+                # work exists on the store (either the live coordinator
+                # finishes it, emptying the journal, or its lease lapses
+                # and this router takes over); exiting here would abandon
+                # requests a dead coordinator dispatched
+                if self.is_coordinator \
+                        or not self.store.list(FLEET_REQUESTS_PREFIX):
+                    return self.take_results()
+            if max_ticks is not None and rounds >= max_ticks:
+                raise ServeTimeout(
+                    f"fleet loop exceeded max_ticks={max_ticks} with "
+                    f"{pending} request(s) outstanding "
+                    f"(coordinator={self.is_coordinator})")
+
+    def take_results(self) -> List[RequestResult]:
+        """Claim collected results (completion order; shed results appear
+        where they were decided)."""
+        order, self._order = self._order, []
+        return [self._results.pop(rid) for rid in order]
+
+    def _collect(self, member: FleetMember) -> None:
+        for res in member.take_results():
+            rid = res.rid
+            fo = self._failed_over.pop(rid, 0)
+            if fo:
+                res = dataclasses.replace(res, failovers=fo)
+            self._results[rid] = res
+            self._order.append(rid)
+            self._owner.pop(rid, None)
+            self._requests.pop(rid, None)
+            self.tokens_by_engine[member.engine_id] = (
+                self.tokens_by_engine.get(member.engine_id, 0)
+                + len(res.output_ids))
+            self.store.delete(f"{FLEET_REQUESTS_PREFIX}/{_rid_key(rid)}")
+
+    # ------------------------------------------------------------- failover
+
+    def _scan_leases(self) -> None:
+        """Detect dead engines: a durable ``fleet/dead`` marker (dying
+        breath of a budget-exhausted member) fails over immediately; a
+        silently-killed member is declared once its lease has lapsed
+        ``miss_limit`` periods on the store clock; a member that died
+        BEFORE its first beat (no lease at all) is caught via the local
+        ``alive`` flag or, cross-process, after the same grace a lease
+        expiry would get.  Store reads are rate-limited to a third of the
+        shortest member lease — scanning every scheduler tick buys no
+        detection latency (the threshold is ``miss_limit * lease_s``) —
+        EXCEPT when this process already knows a member died and owes it a
+        failover."""
+        now = self.store.now()
+        urgent = any(not m.alive and eid not in self._failed_engines
+                     for eid, m in self.members.items())
+        min_lease = min((m.lease_s for m in self.members.values()),
+                        default=self.lease_s)
+        if not urgent and self._last_scan_t is not None \
+                and now - self._last_scan_t < min_lease / 3.0:
+            return
+        self._last_scan_t = now
+        table = lease_table(self.store, prefix=FLEET_HEARTBEAT_PREFIX)
+        marked = set(dead_set(self.store, prefix=FLEET_DEAD_PREFIX))
+        for eid in sorted(self.members):
+            if eid in self._failed_engines:
+                continue
+            m = self.members[eid]
+            lease = table.get(eid)
+            if lease is None:
+                lapsed = (not m.alive
+                          or (self._lead_since is not None
+                              and now - self._lead_since
+                              >= self.miss_limit * m.lease_s))
+                desc = "never leased"
+            else:
+                lapsed = lease.missed(now) >= self.miss_limit
+                desc = f"lease lapsed {lease.missed(now):.1f}x"
+            if eid in marked or lapsed:
+                self._failover(eid, "dead marker" if eid in marked else desc)
+
+    def _failover(self, engine_id: str, why: str) -> None:
+        m = self.members.get(engine_id)
+        if m is not None:
+            m.alive = False
+        self._failed_engines.add(engine_id)
+        record_dead(self.store, engine_id, self.generation, self.router_id,
+                    prefix=FLEET_DEAD_PREFIX)
+        victims = [rid for rid, owner in self._owner.items()
+                   if owner == engine_id]
+        log_dist(
+            f"fleet: engine {engine_id} declared dead ({why}); failing "
+            f"{len(victims)} request(s) over to "
+            f"{sum(mm.alive for mm in self.members.values())} survivor(s)",
+            ranks=[0])
+        for rid in victims:
+            req = self._requests[rid]
+            self._owner.pop(rid)
+            self.failovers_total += 1
+            self._failed_over[rid] = self._failed_over.get(rid, 0) + 1
+            with trace_span("fleet.failover", rid=rid,
+                            from_engine=engine_id):
+                # re-prefill from the ORIGINAL prompt on a survivor: the
+                # dead engine's KV pages (and any partial tokens) are gone
+                # with its process — greedy decode makes the re-served
+                # output token-exact, and the preserved epoch keeps
+                # deadline/TTFT accounting honest
+                self._route(req, requeue=True)
+
+    # ----------------------------------------------------- coordinator side
+
+    def _take_over(self, lease) -> None:
+        """This router just became (or re-confirmed as) the leader: bump
+        the fleet generation (CAS — a deposed leader racing its successor
+        cannot tear or double-apply it) and adopt the request journal, so
+        work dispatched by the previous coordinator is tracked, failed
+        over and completed by this one."""
+        with trace_span("fleet.election", router=self.router_id,
+                        term=lease.term):
+            self.is_coordinator = True
+            self.term = lease.term
+            self.elections_total += 1
+            self._lead_since = self.store.now()
+            self.generation = bump_generation(self.store,
+                                              key=self.generation_key)
+            adopted = 0
+            for name in self.store.list(FLEET_REQUESTS_PREFIX):
+                rec = self.store.get(f"{FLEET_REQUESTS_PREFIX}/{name}")
+                if rec is None:
+                    continue
+                rid = rec["rid"]
+                if rid in self._requests or rid in self._results:
+                    continue
+                req = Request(
+                    rid=rid,
+                    input_ids=np.asarray(rec["input_ids"], np.int32),
+                    max_new_tokens=int(rec["max_new_tokens"]),
+                    eos_token_id=rec["eos_token_id"],
+                    deadline_s=rec["deadline_s"],
+                    arrival_epoch_s=rec["arrival_epoch_s"])
+                self._requests[rid] = req
+                if rec.get("failovers"):
+                    self._failed_over[rid] = int(rec["failovers"])
+                if rec["engine"] is None:
+                    # accepted but never dispatched (a future arrival
+                    # parked at the dead coordinator): keep the remaining
+                    # delay on OUR clock, or route now when already due
+                    remaining = max(0.0, (req.arrival_epoch_s or 0.0)
+                                    - time.monotonic())
+                    if remaining > 0:
+                        req = dataclasses.replace(
+                            req, arrival_time=(time.monotonic() - self._t0
+                                               + remaining))
+                        self._requests[rid] = req
+                        bisect.insort(self._later, req,
+                                      key=lambda r: r.arrival_time)
+                    else:
+                        self._route(req)
+                else:
+                    self._owner[rid] = rec["engine"]
+                adopted += 1
+            log_dist(
+                f"fleet: router {self.router_id} leads term {self.term} "
+                f"(generation {self.generation}, adopted {adopted} "
+                f"journaled request(s))", ranks=[0])
+
+    def kill(self) -> None:
+        """Test/chaos hook simulating coordinator process death: the
+        election lease stops renewing and this router never steps again —
+        a standby takes the next term once the lease lapses."""
+        self.alive = False
+
+    # ------------------------------------------------------ rolling restart
+
+    def rolling_restart(self, max_ticks: Optional[int] = None) -> List[str]:
+        """Restart the fleet one engine at a time, never dropping a
+        request: stop routing to the engine, ``drain()`` it (in-flight
+        work finishes, token-exact even across a mid-drain fault),
+        redistribute the unserved hand-back across the rest of the fleet,
+        and :meth:`~FleetMember.recycle` a fresh engine.  The fleet keeps
+        serving on the other engines throughout.  Returns the engine ids
+        restarted."""
+        if not self.is_coordinator:
+            raise RuntimeError(
+                "rolling_restart is a coordinator action — step() until "
+                "this router holds the lease")
+        restarted = []
+        for eid in sorted(self.members):
+            m = self.members[eid]
+            if not m.alive:
+                continue
+            m.routable = False
+            unserved: List[Request] = []
+            try:
+                with trace_span("fleet.rolling_restart", engine=eid):
+                    unserved = m.sup.drain(max_ticks=max_ticks)
+                    self._collect(m)
+                    m.recycle()
+            finally:
+                m.routable = True
+                # redistribute AFTER the member is routable again: on a
+                # single-engine fleet the recycled member itself is the
+                # only legal target — draining it must never read as
+                # "whole fleet dead" (and the hand-back must re-enter an
+                # engine even when recycle() raised)
+                for req in unserved:
+                    orig = self._requests.get(req.rid, req)
+                    self._owner.pop(req.rid, None)
+                    self._route(orig, requeue=True)
+            m.beat(force=True)   # advertise the FRESH engine immediately
+            self.rolling_restarts_total += 1
+            restarted.append(eid)
+            log_dist(f"fleet: rolling restart of {eid} complete "
+                     f"({len(restarted)}/{sum(mm.alive for mm in self.members.values())})",
+                     ranks=[0])
+        return restarted
+
+    # -------------------------------------------------------- health/gauges
+
+    def health(self) -> Dict[str, Any]:
+        """Fleet rollup + per-engine advertisements (as last written to
+        the store) — what an external balancer or dashboard polls."""
+        ads = {eid: self.store.get(f"{FLEET_ENGINES_PREFIX}/{eid}")
+               for eid in sorted(self.members)}
+        live = [eid for eid, m in self.members.items() if m.alive]
+        return {
+            "router_id": self.router_id,
+            "is_coordinator": self.is_coordinator,
+            "term": self.term,
+            "generation": self.generation,
+            "tick": self._tick,
+            "engines_total": len(self.members),
+            "engines_live": len(live),
+            "queue_depth": self.fleet_queue_depth(),
+            "outstanding": self.outstanding(),
+            "failovers_total": self.failovers_total,
+            "shed_total": self.shed_total,
+            "elections_total": self.elections_total,
+            "rolling_restarts_total": self.rolling_restarts_total,
+            "tokens_by_engine": dict(self.tokens_by_engine),
+            "engines": ads,
+        }
+
+    def _write_gauges(self) -> None:
+        if self.monitor is None:
+            return
+        live = sum(m.alive for m in self.members.values())
+        # drop counters are per SOURCE (process ring / monitor object), not
+        # per member: members sharing a source advertise the same value and
+        # must be counted once, or an in-process fleet overcounts N-fold
+        flight_by_src: Dict[str, int] = {}
+        monitor_by_src: Dict[str, int] = {}
+        for eid, m in self.members.items():
+            # the beat this same round stashed what it wrote; fall back to
+            # the store only for a member this router never beat (e.g.
+            # adopted after a takeover, before its first beat here)
+            ad = (m.last_advert if m.last_advert is not None
+                  else self.store.get(f"{FLEET_ENGINES_PREFIX}/{eid}"))
+            if ad is not None:
+                flight_by_src[str(ad.get("flight_src", eid))] = \
+                    int(ad.get("flight_dropped", 0))
+                monitor_by_src[str(ad.get("monitor_src", eid))] = \
+                    int(ad.get("monitor_dropped", 0))
+        flight = sum(flight_by_src.values())
+        monitor_drops = sum(monitor_by_src.values())
+        self.monitor.write_events([
+            ("fleet/engines_live", float(live), self._tick),
+            ("fleet/queue_depth", float(self.fleet_queue_depth()),
+             self._tick),
+            ("fleet/outstanding", float(self.outstanding()), self._tick),
+            ("fleet/failovers_total", float(self.failovers_total),
+             self._tick),
+            ("fleet/shed_total", float(self.shed_total), self._tick),
+            ("fleet/elections_total", float(self.elections_total),
+             self._tick),
+            ("fleet/rolling_restarts_total",
+             float(self.rolling_restarts_total), self._tick),
+            ("fleet/generation", float(self.generation), self._tick),
+            ("fleet/flight_dropped_total", float(flight), self._tick),
+            ("fleet/monitor_dropped_total", float(monitor_drops),
+             self._tick),
+        ])

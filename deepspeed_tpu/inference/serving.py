@@ -187,6 +187,12 @@ class RequestResult:
     # already-generated tokens, which often re-share against the rebuilt
     # index).
     shared_prefix_tokens: int = 0
+    # times a fleet router re-routed this request to a surviving engine
+    # after its assigned engine's lease lapsed (inference/fleet.py) —
+    # distinct from `replays`, which counts SAME-engine warm-restart
+    # re-prefills: a failover re-prefills from the ORIGINAL prompt on a
+    # different engine, so no partial tokens are stitched.
+    failovers: int = 0
 
     @property
     def ttft_s(self) -> float:
@@ -350,6 +356,15 @@ class ServingEngine:
         self.shed_count = 0
         self.deadline_count = 0
         self._ema_service_s: Optional[float] = None   # drives retry hints
+
+        # env-gated /metrics endpoint (DS_TPU_METRICS_PORT): process-global,
+        # and a taken fixed port falls back to an ephemeral bind instead of
+        # failing the Nth engine on a shared host — the ACTUAL bound port is
+        # what health() (and the fleet store advertisement) reports
+        from ..observability.export import maybe_start_metrics_server
+
+        srv = maybe_start_metrics_server(monitor)
+        self.metrics_port = srv.port if srv is not None else None
 
         # donation: each tick consumes and reproduces the pool — donate the
         # buffers so the pool exists once in HBM, not twice (CPU has no
@@ -1178,6 +1193,11 @@ class ServingEngine:
             "oldest_request_age_s": round(self._oldest_age_s(now), 4),
             "retry_after_hint_s": self._retry_after_hint(),
             "unclaimed_results": len(self._finished_order),
+            # the bound /metrics port (None = endpoint not enabled): with N
+            # engines on one host each process binds its OWN port (ephemeral
+            # fallback), so a scraper discovers endpoints from health/fleet
+            # advertisements instead of assuming the configured port
+            "metrics_port": self.metrics_port,
         }
 
     def drain(self, max_ticks: Optional[int] = None) -> List[Request]:

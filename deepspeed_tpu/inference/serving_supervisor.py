@@ -469,20 +469,7 @@ class ServingSupervisor:
         for rid, tokens in replayed:
             self._prefix[rid] = self._prefix.get(rid, []) + tokens
             self._replay_count[rid] = self._replay_count.get(rid, 0) + 1
-        self._shed_base += old.shed_count
-        self._deadline_base += old.deadline_count
-        self._probe_base += old.probe_count
-        self._unfence_base += old.unfence_count
-        self._prefix_hits_base += old.prefix_hits
-        self._prefix_misses_base += old.prefix_misses
-        self._prefix_tokens_base += old.prefix_shared_tokens
-        self._prefix_pages_base += old.prefix_pages_shared
-        self._prefix_evictions_base += (old._prefix.evictions
-                                        if old._prefix is not None else 0)
-        self._cow_base += old.cow_copies
-        self._pages_hwm_base = max(self._pages_hwm_base, old._pages_hwm)
-        self._quarantined_slots_lifetime += int(old._quarantined.sum())
-        self._quarantined_pages_lifetime += len(old._quarantined_pages)
+        self._carry_counters(old)
         self.engine = new
         entry = {
             "restart": self.restarts,
@@ -510,6 +497,55 @@ class ServingSupervisor:
             f"{len(replayed)} in-flight, re-queued {len(waiting) - stashed}, "
             f"stashed {stashed}, "
             f"programs {'reused' if reused else 'rebuilt'}", ranks=[0])
+
+    def _carry_counters(self, old: ServingEngine) -> None:
+        """Fold a retiring incarnation's counters into the bases so the
+        supervisor-level ``*_total`` numbers stay cumulative."""
+        self._shed_base += old.shed_count
+        self._deadline_base += old.deadline_count
+        self._probe_base += old.probe_count
+        self._unfence_base += old.unfence_count
+        self._prefix_hits_base += old.prefix_hits
+        self._prefix_misses_base += old.prefix_misses
+        self._prefix_tokens_base += old.prefix_shared_tokens
+        self._prefix_pages_base += old.prefix_pages_shared
+        self._prefix_evictions_base += (old._prefix.evictions
+                                        if old._prefix is not None else 0)
+        self._cow_base += old.cow_copies
+        self._pages_hwm_base = max(self._pages_hwm_base, old._pages_hwm)
+        self._quarantined_slots_lifetime += int(old._quarantined.sum())
+        self._quarantined_pages_lifetime += len(old._quarantined_pages)
+
+    # ----------------------------------------------------- rolling restart
+
+    def recycle(self) -> bool:
+        """Rolling-restart hand-off (``FleetRouter.rolling_restart``):
+        replace a DRAINED/idle engine with a fresh one — fresh KV pool,
+        adopted compiled programs, counters carried — WITHOUT spending the
+        restart budget.  This is maintenance, not fault recovery: the
+        budget exists to bound *fault* loops, and a planned recycle must
+        not eat into it.  Refuses while work is queued or in flight (drain
+        first — recycling would throw live KV state away); returns whether
+        the compiled programs were reused."""
+        old = self.engine
+        if (old._active.any() or old._queue or old._pending
+                or self._drain_finish_pending):
+            raise RuntimeError(
+                "recycle() needs a drained engine: "
+                f"{int(old._active.sum())} slot(s) active, "
+                f"{len(old._queue) + len(old._pending)} request(s) waiting "
+                "— call drain() first")
+        for res in old.take_results():
+            self._collect(res)
+        new = self.engine_factory()
+        reused = self._adopt_programs(new, old)
+        if old._ema_service_s is not None and new._ema_service_s is None:
+            new._ema_service_s = old._ema_service_s
+        self._carry_counters(old)
+        self.engine = new
+        log_dist(f"serve supervisor: engine recycled (programs "
+                 f"{'reused' if reused else 'rebuilt'})", ranks=[0])
+        return reused
 
     @staticmethod
     def _rebase(req: Request, elapsed: float, t0: float) -> Request:

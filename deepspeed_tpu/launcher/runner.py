@@ -115,6 +115,23 @@ def parse_args(args=None):
     p.add_argument("--pod_miss_limit", type=int, default=3,
                    help="missed leases before a host is declared dead and "
                         "peers exit 87 for pod re-formation")
+    p.add_argument("--fleet", type=int, default=0, metavar="N",
+                   help="serving fleet tier: export DS_TPU_FLEET_SIZE=N "
+                        "plus the fleet lease contract so the serving "
+                        "script builds N leased engines and a FleetRouter "
+                        "on the coordination store — one binary, train or "
+                        "serve, elastic either way (docs/FLEET.md)")
+    p.add_argument("--fleet_coord_dir", default="",
+                   help="fleet coordination store root (defaults to "
+                        "--pod_coord_dir): engines lease under fleet/*, "
+                        "the router is elected by CAS on fleet/coordinator")
+    p.add_argument("--fleet_lease", type=float, default=5.0,
+                   help="fleet engine lease period in seconds; the router "
+                        "fails an engine's requests over to survivors "
+                        "after fleet_miss_limit missed leases")
+    p.add_argument("--fleet_miss_limit", type=int, default=3,
+                   help="missed leases before the router declares an "
+                        "engine dead and fails its requests over")
     p.add_argument("--force_multi", action="store_true",
                    help="use the multinode path even for a single local host")
     p.add_argument("user_script", help="training script (or module with --module)")
@@ -125,7 +142,29 @@ def parse_args(args=None):
         # job would crash-loop through the whole restart budget undiagnosed
         p.error("--elastic_zero_progress needs --elastic_ckpt_dir (the "
                 "breaker tracks committed checkpoint steps)")
+    if parsed.fleet:
+        if parsed.fleet < 1:
+            p.error(f"--fleet {parsed.fleet}: need at least one engine")
+        if not (parsed.fleet_coord_dir or parsed.pod_coord_dir):
+            p.error("--fleet needs a coordination store: pass "
+                    "--fleet_coord_dir (or --pod_coord_dir, which it "
+                    "defaults to) — engine leases and the coordinator "
+                    "election live there")
     return parsed
+
+
+def fleet_env(args) -> dict:
+    """The fleet contract exported to every child process: size, store
+    root, and lease cadence — ``InferenceEngine.serving_fleet`` consumers
+    read these to build their members (docs/FLEET.md)."""
+    if not args.fleet:
+        return {}
+    return {
+        "DS_TPU_FLEET_SIZE": str(args.fleet),
+        "DS_TPU_FLEET_COORD_DIR": args.fleet_coord_dir or args.pod_coord_dir,
+        "DS_TPU_FLEET_LEASE": str(args.fleet_lease),
+        "DS_TPU_FLEET_MISS_LIMIT": str(args.fleet_miss_limit),
+    }
 
 
 def fetch_hostfile(path: str) -> "OrderedDict[str, int]":
@@ -254,6 +293,7 @@ def _build_user_cmd(args) -> List[str]:
 def _run_local_single(args, active) -> int:
     env = dict(os.environ)
     env.pop("COORDINATOR_ADDRESS", None)  # single-process mode
+    env.update(fleet_env(args))
     cmd = _build_user_cmd(args)
     logger.info("launcher: single-host local exec: %s", shlex.join(cmd))
     return subprocess.call(cmd, env=env)
@@ -555,6 +595,7 @@ def _dispatch(args) -> int:
         base_env["DS_TPU_POD_GENERATION"] = gen
         base_env["DS_TPU_POD_LEASE"] = str(args.pod_lease)
         base_env["DS_TPU_POD_MISS_LIMIT"] = str(args.pod_miss_limit)
+    base_env.update(fleet_env(args))
     if args.launcher == "pod":
         runner = PodRunner(args, active, base_env, pool=pool, info=pod_info)
     elif args.launcher == "slurm":

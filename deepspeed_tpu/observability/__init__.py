@@ -25,6 +25,7 @@ from .trace import (Span, TRACE_CAPACITY_ENV, TRACE_ENV,  # noqa: F401
                     Tracer, configure_tracer, flight_dump, get_tracer,
                     trace_count, trace_span)
 from .export import (METRICS_PORT_ENV, MetricsServer,  # noqa: F401
-                     chrome_trace_events, maybe_start_metrics_server,
+                     chrome_trace_events, get_metrics_server,
+                     maybe_start_metrics_server,
                      prometheus_text, start_metrics_server,
                      write_chrome_trace)

@@ -220,6 +220,38 @@ def start_metrics_server(port: int = 0, monitor=None,
     return MetricsServer(port=port, monitor=monitor, tracer=tracer)
 
 
+def bind_metrics_server(port: int, monitor=None, tracer=None,
+                        host: str = "0.0.0.0",
+                        label: str = "metrics endpoint"
+                        ) -> Optional[MetricsServer]:
+    """Bind a /metrics server with the shared fallback policy: a taken
+    FIXED port degrades to an ephemeral bind (the Nth engine on a host
+    must neither crash at init nor silently lose its endpoint — the bound
+    port is advertised, not assumed), and ``None`` is returned only when
+    even the ephemeral bind fails.  One implementation for the env-gated
+    process server AND per-fleet-member endpoints, so the policy cannot
+    drift between them."""
+    from ..utils.logging import logger
+
+    try:
+        return MetricsServer(port=int(port), monitor=monitor, tracer=tracer,
+                             host=host)
+    except OSError as e:
+        if int(port) == 0:
+            logger.warning("%s on %s (ephemeral) unavailable (%s); "
+                           "continuing without", label, host, e)
+            return None
+        logger.warning("%s port %d taken (%s); binding an ephemeral port "
+                       "instead", label, int(port), e)
+        try:
+            return MetricsServer(port=0, monitor=monitor, tracer=tracer,
+                                 host=host)
+        except OSError as e2:   # pragma: no cover - no ports at all
+            logger.warning("%s on %s unavailable (%s); continuing without",
+                           label, host, e2)
+            return None
+
+
 def maybe_start_metrics_server(monitor=None) -> Optional[MetricsServer]:
     """Opt-in process-global endpoint: starts once when
     ``DS_TPU_METRICS_PORT`` is set (``0`` = ephemeral), else ``None``.
@@ -245,12 +277,18 @@ def maybe_start_metrics_server(monitor=None) -> Optional[MetricsServer]:
                        METRICS_PORT_ENV, raw)
         return None
     host = os.environ.get(METRICS_HOST_ENV, "").strip() or "0.0.0.0"
-    try:
-        _METRICS_SERVER = MetricsServer(port=port, monitor=monitor, host=host)
-    except OSError as e:   # port taken: observability never gates the job
-        logger.warning("metrics endpoint on %s:%d unavailable (%s); "
-                       "continuing without", host, port, e)
+    # observability never gates the job: a taken port falls back to an
+    # ephemeral bind (the ACTUAL port is advertised via
+    # ServingEngine.health() and the fleet store advertisement —
+    # docs/FLEET.md), and total failure degrades to a warning
+    _METRICS_SERVER = bind_metrics_server(port, monitor=monitor, host=host)
+    if _METRICS_SERVER is None:
         return None
     logger.info("metrics endpoint serving on %s:%d/metrics", host,
                 _METRICS_SERVER.port)
+    return _METRICS_SERVER
+
+
+def get_metrics_server() -> Optional[MetricsServer]:
+    """The process-global env-gated server, if one is running."""
     return _METRICS_SERVER

@@ -1,0 +1,560 @@
+"""Serving fleet tier (ISSUE 7): CAS-hardened coordination store,
+lease-based coordinator election, and a FleetRouter failing requests over
+between leased engines (docs/FLEET.md).
+
+Deterministic throughout: lease expiry and elections run on injected store
+clocks, kills land at exact router rounds (the cooperative pump makes a
+round a deterministic unit), and the acceptance scenarios drive the same
+harness as ``tools/chaos_soak.py --mode fleet`` at pinned seeds.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.elasticity import (
+    FileCoordinationStore,
+    bump_generation,
+    dead_set,
+    elect_coordinator,
+    read_coordinator,
+    read_generation,
+    record_dead,
+    resign_coordinator,
+)
+from deepspeed_tpu.inference.fleet import FleetMember, FleetRouter
+from deepspeed_tpu.inference.serving import Request
+from deepspeed_tpu.models import CausalLM
+from deepspeed_tpu.monitor import InMemoryMonitor
+from deepspeed_tpu.resilience import (FaultInjector, SITE_SERVE_DECODE,
+                                      clear_injector, install_injector)
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    clear_injector()
+    yield
+    clear_injector()
+
+
+def _store(tmp_path, clock=None):
+    return FileCoordinationStore(str(tmp_path / "coord"), clock=clock)
+
+
+# ------------------------------------------------------- compare-and-swap
+
+def test_cas_create_and_swap(tmp_path):
+    s = _store(tmp_path)
+    assert s.compare_and_swap("k", None, {"v": 1})       # create-if-absent
+    assert not s.compare_and_swap("k", None, {"v": 9})   # exists now
+    assert not s.compare_and_swap("k", {"v": 0}, {"v": 9})   # stale expected
+    assert s.compare_and_swap("k", {"v": 1}, {"v": 2})
+    assert s.get("k") == {"v": 2}
+
+
+def test_cas_lock_files_invisible_to_list_and_get(tmp_path):
+    s = _store(tmp_path)
+    s.compare_and_swap("dead/h0", None, {"v": 1})
+    # a concurrent writer's lock must never read as a document
+    open(s._path("dead/h1") + ".lock", "w").close()
+    assert s.list("dead") == ["h0"]
+
+
+def test_cas_concurrent_exactly_one_winner(tmp_path):
+    s = _store(tmp_path)
+    s.put("k", {"v": 0})
+    outcomes = []
+    barrier = threading.Barrier(4)
+
+    def racer(i):
+        barrier.wait()
+        outcomes.append(s.compare_and_swap("k", {"v": 0}, {"v": i + 1}))
+
+    ts = [threading.Thread(target=racer, args=(i,)) for i in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert sum(outcomes) == 1                      # exactly one swap won
+    assert s.get("k")["v"] in (1, 2, 3, 4)
+
+
+def test_bump_generation_concurrent_no_lost_update(tmp_path):
+    """The ISSUE 7 CAS regression: two threads bump concurrently — every
+    bump wins exactly one distinct round (no lost update, no torn bump)."""
+    s = _store(tmp_path)
+    wins = []
+    lock = threading.Lock()
+
+    def bumper():
+        for _ in range(10):
+            g = bump_generation(s)
+            with lock:
+                wins.append(g)
+
+    ts = [threading.Thread(target=bumper) for _ in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert sorted(wins) == list(range(1, 21))      # 20 bumps, 20 distinct
+    assert read_generation(s) == 20
+
+
+def test_record_dead_first_reporter_wins(tmp_path):
+    s = _store(tmp_path)
+    record_dead(s, "h1", generation=3, reported_by="h0")
+    record_dead(s, "h1", generation=3, reported_by="h2")   # late duplicate
+    assert s.get("dead/h1")["reported_by"] == "h0"
+    # an older-generation scanner can never clobber a newer marker
+    record_dead(s, "h1", generation=1, reported_by="stale")
+    assert s.get("dead/h1")["generation"] == 3
+    # a genuinely newer generation replaces it
+    record_dead(s, "h1", generation=5, reported_by="h3")
+    assert s.get("dead/h1")["reported_by"] == "h3"
+
+
+# ------------------------------------------------------------ elections
+
+def test_election_acquire_renew_and_no_steal(tmp_path):
+    clock = [0.0]
+    s = _store(tmp_path, clock=lambda: clock[0])
+    lease = elect_coordinator(s, "r0", lease_s=5.0)
+    assert lease.leader_id == "r0" and lease.term == 1
+    assert elect_coordinator(s, "r1", lease_s=5.0) is None   # live leader
+    clock[0] = 4.0
+    renewed = elect_coordinator(s, "r0", lease_s=5.0)        # renewal
+    assert renewed.term == 1 and renewed.t == 4.0
+    assert read_coordinator(s).leader_id == "r0"
+
+
+def test_election_reelects_on_lapse_with_monotonic_terms(tmp_path):
+    clock = [0.0]
+    s = _store(tmp_path, clock=lambda: clock[0])
+    elect_coordinator(s, "r0", lease_s=5.0)
+    clock[0] = 5.0                                           # exactly lapsed
+    taken = elect_coordinator(s, "r1", lease_s=5.0)
+    assert taken.leader_id == "r1" and taken.term == 2
+    # the deposed leader discovers it is no longer coordinator
+    assert elect_coordinator(s, "r0", lease_s=5.0) is None
+    clock[0] = 20.0
+    assert elect_coordinator(s, "r0", lease_s=5.0).term == 3
+
+
+def test_election_concurrent_exactly_one_winner(tmp_path):
+    clock = [100.0]
+    s = _store(tmp_path, clock=lambda: clock[0])
+    elect_coordinator(s, "dead", lease_s=1.0)
+    clock[0] = 200.0                                         # long lapsed
+    winners = []
+    barrier = threading.Barrier(4)
+
+    def racer(i):
+        barrier.wait()
+        winners.append(elect_coordinator(s, f"r{i}", lease_s=5.0))
+
+    ts = [threading.Thread(target=racer, args=(i,)) for i in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    won = [w for w in winners if w is not None]
+    assert len(won) == 1 and won[0].term == 2
+    assert read_coordinator(s).leader_id == won[0].leader_id
+
+
+def test_election_resign_hands_off_immediately(tmp_path):
+    clock = [0.0]
+    s = _store(tmp_path, clock=lambda: clock[0])
+    elect_coordinator(s, "r0", lease_s=50.0)
+    assert resign_coordinator(s, "r0")
+    assert not resign_coordinator(s, "r1")       # only the holder resigns
+    nxt = elect_coordinator(s, "r1", lease_s=50.0)   # no lease wait needed
+    assert nxt.leader_id == "r1" and nxt.term == 2
+
+
+# ------------------------------------------------------------- the fleet
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    model = CausalLM("tiny", dtype=jnp.float32, attn_impl="xla")
+    params = model.init_fn(jax.random.PRNGKey(3))
+    return deepspeed_tpu.init_inference(
+        model=model, config={"dtype": "float32"}, params=params)
+
+
+SERVE_KW = dict(b_slots=2, page_size=8, max_model_len=64)
+
+
+def _stream(n, seed=0, new_choices=(4, 6, 8)):
+    rng = np.random.default_rng(seed)
+    return [Request(rid=i,
+                    input_ids=rng.integers(1, 250,
+                                           int(rng.integers(3, 12))
+                                           ).astype(np.int32),
+                    max_new_tokens=int(rng.choice(new_choices)))
+            for i in range(n)]
+
+
+def _copies(reqs):
+    return [Request(rid=r.rid, input_ids=r.input_ids,
+                    max_new_tokens=r.max_new_tokens,
+                    eos_token_id=r.eos_token_id,
+                    arrival_time=r.arrival_time, deadline_s=r.deadline_s)
+            for r in reqs]
+
+
+@pytest.fixture(scope="module")
+def reference(tiny_engine):
+    """Fault-free single-engine outputs for the seed-7 stream — greedy
+    decode makes them the parity oracle for every fleet run (outputs are
+    engine-independent)."""
+    reqs = _stream(9, seed=7)
+    serve = tiny_engine.serving(b_slots=3, page_size=8, max_model_len=64)
+    return reqs, {r.rid: r.output_ids for r in serve.run(_copies(reqs))}
+
+
+def _fleet(tiny_engine, tmp_path, n=3, clock=None, monitor=None,
+           router_lease=100.0, member_lease=100.0, miss_limit=3,
+           max_fleet_queue=None, max_restarts=5):
+    # the default member lease is generous: real-clock tests must never
+    # see a lapse from first-round compile pauses — lease-lapse scenarios
+    # inject a store clock and pass member_lease=1.0 explicitly
+    store = FileCoordinationStore(str(tmp_path / "coord"), clock=clock)
+    members = [FleetMember(f"engine{i}",
+                           tiny_engine.supervised_serving(
+                               max_restarts=max_restarts, **SERVE_KW),
+                           store, lease_s=member_lease)
+               for i in range(n)]
+    return store, FleetRouter(store, members, lease_s=router_lease,
+                              miss_limit=miss_limit, monitor=monitor,
+                              max_fleet_queue=max_fleet_queue)
+
+
+def test_fleet_serves_stream_distributed_and_token_exact(
+        tiny_engine, reference, tmp_path):
+    reqs, ref = reference
+    mon = InMemoryMonitor()
+    store, router = _fleet(tiny_engine, tmp_path, monitor=mon)
+    results = router.run(_copies(reqs), max_ticks=500)
+    by = {r.rid: r for r in results}
+    assert sorted(by) == sorted(r.rid for r in reqs)
+    for rid, r in by.items():
+        assert r.finish_reason in ("eos", "length")
+        assert np.array_equal(r.output_ids, ref[rid]), rid
+        assert r.failovers == 0
+    h = router.health()
+    assert h["engines_live"] == 3 and h["failovers_total"] == 0
+    # least-loaded admission spread the stream over the fleet
+    assert sum(1 for v in h["tokens_by_engine"].values() if v > 0) >= 2
+    names = {e[0] for e in mon.events_snapshot()}
+    assert {"fleet/engines_live", "fleet/queue_depth",
+            "fleet/failovers_total", "fleet/flight_dropped_total"} <= names
+
+
+def test_fleet_member_advertises_health_through_store(tiny_engine, tmp_path):
+    store, router = _fleet(tiny_engine, tmp_path, n=2)
+    router.submit(Request(rid=0, input_ids=np.array([5, 6, 7], np.int32),
+                          max_new_tokens=3))
+    router.step()
+    ad = store.get("fleet/engines/engine0")
+    assert ad is not None
+    for key in ("queue_depth", "active_slots", "usable_slots",
+                "metrics_port", "flight_dropped", "monitor_dropped",
+                "restarts", "draining"):
+        assert key in ad, key
+    assert store.get("fleet/heartbeat/engine0") is not None
+    router.run([], max_ticks=200)
+
+
+def test_fleet_sheds_by_fleet_queue_depth(tiny_engine, tmp_path):
+    store, router = _fleet(tiny_engine, tmp_path, n=2, max_fleet_queue=2)
+    reqs = _stream(12, seed=3, new_choices=(4,))
+    results = router.run(_copies(reqs), max_ticks=500)
+    by = {r.rid: r for r in results}
+    assert sorted(by) == list(range(12))           # shed results are typed
+    shed = [r for r in by.values() if r.finish_reason == "shed"]
+    assert shed and router.shed_total == len(shed)
+    assert all(r.retry_after_s and r.retry_after_s > 0 for r in shed)
+    done = [r for r in by.values() if r.finish_reason in ("eos", "length")]
+    assert done                                    # the fleet still served
+
+
+def test_rid_keys_never_collide_with_store_artifacts():
+    """Journal keys must never contain the substrings the store's list()
+    filters as write-protocol artifacts — such an entry would be invisible
+    to a successor coordinator and its request silently lost."""
+    from deepspeed_tpu.inference.fleet import _rid_key
+
+    for rid in ("job.tmp.1", "x.lock", "a.lock.stale.1", "weird/../rid",
+                "plain", 7, -3):
+        key = _rid_key(rid)
+        assert ".tmp." not in key and ".lock" not in key, (rid, key)
+        assert "/" not in key and ".." not in key.split("/"), (rid, key)
+    assert _rid_key(7) != _rid_key("7")           # type-prefixed
+    assert _rid_key("job.tmp.1") != _rid_key("job.tmp.2")
+
+
+@pytest.mark.chaos
+def test_fleet_future_arrival_survives_coordinator_death(tiny_engine,
+                                                         tmp_path):
+    """A request accepted but not yet due (parked at the router) is
+    journaled at submit with engine=None, so a successor coordinator
+    adopts and eventually serves it — not just dispatched work."""
+    clock = [0.0]
+    store, router = _fleet(tiny_engine, tmp_path, n=2,
+                           clock=lambda: clock[0], router_lease=5.0)
+    rid = router.submit(Request(rid="late",
+                                input_ids=np.array([3, 4, 5], np.int32),
+                                max_new_tokens=3, arrival_time=0.05))
+    assert store.get("fleet/requests/slate")["engine"] is None
+    router.step()                                  # leads, arrival not due
+    router.kill()
+    clock[0] += 60.0
+    standby = FleetRouter(store, list(router.members.values()),
+                          router_id="router1", lease_s=5.0)
+    time.sleep(0.1)                                # the arrival comes due
+    results = standby.run([], max_ticks=300)
+    (res,) = [r for r in results if r.rid == rid]
+    assert res.finish_reason in ("eos", "length")
+    assert store.get("fleet/requests/slate") is None   # journal cleaned
+
+
+def test_fleet_rejects_unjournalable_and_duplicate_rids(tiny_engine,
+                                                        tmp_path):
+    store, router = _fleet(tiny_engine, tmp_path, n=2)
+    with pytest.raises(ValueError, match="str or int"):
+        router.submit(Request(rid=(1, 2),
+                              input_ids=np.array([1], np.int32)))
+    router.submit(Request(rid="a", input_ids=np.array([1, 2], np.int32),
+                          max_new_tokens=2))
+    with pytest.raises(ValueError, match="unique"):
+        router.submit(Request(rid="a", input_ids=np.array([3], np.int32),
+                              max_new_tokens=2))
+    router.run([], max_ticks=200)
+
+
+@pytest.mark.chaos
+def test_fleet_engine_kill_fails_over_none_lost(tiny_engine, reference,
+                                                tmp_path):
+    """ISSUE 7 acceptance: 3 engines, kill one mid-stream — the router
+    detects the lapsed lease, fails queued + in-flight requests over to
+    the survivors (re-prefill from the original prompt), and every request
+    ends finished token-exact — none lost, arrival epochs preserved."""
+    reqs, ref = reference
+    clock = [0.0]
+    store, router = _fleet(tiny_engine, tmp_path,
+                           clock=lambda: clock[0], member_lease=1.0)
+    kill_t = []
+
+    def on_tick(r, rounds):
+        clock[0] += 1.0       # lease lapse: 3 missed 1.0s periods
+        if rounds == 2:
+            r.members["engine0"].kill()
+            kill_t.append(time.monotonic())
+
+    results = router.run(_copies(reqs), max_ticks=500, on_tick=on_tick)
+    by = {r.rid: r for r in results}
+    assert sorted(by) == sorted(r.rid for r in reqs)      # none lost
+    for rid, r in by.items():
+        assert r.finish_reason in ("eos", "length")
+        assert np.array_equal(r.output_ids, ref[rid]), rid   # token-exact
+    assert "engine0" in router._failed_engines
+    assert router.failovers_total > 0
+    failed_over = [r for r in by.values() if r.failovers > 0]
+    assert len(failed_over) == router.failovers_total
+    # TTFT stays anchored to the TRUE arrival, not the failover instant:
+    # the failed-over results' arrival stamps predate the kill
+    assert all(r.arrival_s <= kill_t[0] for r in failed_over)
+    # the dead engine is visible through the store (marker written by the
+    # router once it declared the lapse)
+    assert "engine0" in dead_set(store, prefix="fleet/dead")
+    h = router.health()
+    assert h["engines_live"] == 2
+    # survivors' page accounting still balances after absorbing the work
+    for eid, m in router.members.items():
+        if m.alive:
+            assert m.sup.engine.page_accounting()["balanced"], eid
+
+
+@pytest.mark.chaos
+def test_fleet_budget_exhaustion_writes_dead_marker(tiny_engine, reference,
+                                                    tmp_path):
+    """An engine whose restart budget exhausts 'crashes': its dying breath
+    is a durable CAS-written fleet/dead marker, and failover is immediate
+    (no lease wait)."""
+    reqs, ref = reference
+    store, router = _fleet(tiny_engine, tmp_path, max_restarts=0)
+    inj = FaultInjector()
+    inj.add(site=SITE_SERVE_DECODE, kind="raise", at_call=2)
+    install_injector(inj)
+    try:
+        results = router.run(_copies(reqs), max_ticks=500)
+    finally:
+        clear_injector()
+    by = {r.rid: r for r in results}
+    assert sorted(by) == sorted(r.rid for r in reqs)
+    for rid, r in by.items():
+        assert np.array_equal(r.output_ids, ref[rid]), rid
+    assert len(router._failed_engines) == 1
+    (dead,) = router._failed_engines
+    marker = store.get(f"fleet/dead/{dead}")
+    assert marker is not None and marker["reported_by"] == dead
+    assert router.failovers_total > 0
+
+
+@pytest.mark.chaos
+def test_fleet_coordinator_kill_election_converges(tiny_engine, reference,
+                                                   tmp_path):
+    """ISSUE 7 acceptance: kill the coordinator mid-stream — the standby
+    wins the next term through the CAS election, bumps the fleet
+    generation (monotonic, no torn bump), adopts the request journal, and
+    finishes the stream."""
+    reqs, ref = reference
+    clock = [0.0]
+    store, router = _fleet(tiny_engine, tmp_path, clock=lambda: clock[0],
+                           router_lease=30.0)
+    standby = FleetRouter(store, [m for m in router.members.values()],
+                          router_id="router1", lease_s=30.0, miss_limit=3)
+    for req in _copies(reqs):
+        router.submit(req)
+    gens = [read_generation(store, key=router.generation_key)]
+    for _ in range(3):
+        router.step()
+        clock[0] += 1.0
+        standby.step()
+        gens.append(read_generation(store, key=router.generation_key))
+        assert not standby.is_coordinator       # a live leader is not stolen
+    done_before = {r.rid: r for r in router.take_results()}
+    router.kill()
+    clock[0] += 60.0                            # the leader's lease lapses
+    results = standby.run([], max_ticks=500)
+    by = {r.rid: r for r in results}
+    by.update(done_before)
+    assert sorted(by) == sorted(r.rid for r in reqs)      # none lost
+    for rid, r in by.items():
+        assert np.array_equal(r.output_ids, ref[rid]), rid
+    assert standby.is_coordinator and standby.term == 2
+    gens.append(read_generation(store, key=router.generation_key))
+    assert all(b >= a for a, b in zip(gens, gens[1:]))    # monotonic
+    assert gens[-1] > gens[0]                             # takeover bumped
+
+
+def test_fleet_rolling_restart_never_drops_requests(tiny_engine, reference,
+                                                    tmp_path):
+    reqs, ref = reference
+    store, router = _fleet(tiny_engine, tmp_path)
+    for req in _copies(reqs):
+        router.submit(req)
+    for _ in range(2):
+        router.step()
+    restarted = router.rolling_restart(max_ticks=500)
+    assert restarted == ["engine0", "engine1", "engine2"]
+    assert router.rolling_restarts_total == 3
+    h = router.health()
+    assert h["engines_live"] == 3                 # nothing died: maintenance
+    results = router.run([], max_ticks=500)
+    by = {r.rid: r for r in results}
+    assert sorted(by) == sorted(r.rid for r in reqs)
+    for rid, r in by.items():
+        assert np.array_equal(r.output_ids, ref[rid]), rid
+
+
+def test_serving_fleet_reads_launcher_env_contract(tiny_engine, tmp_path,
+                                                   monkeypatch):
+    """`deepspeed-tpu --fleet N` exports DS_TPU_FLEET_*; serving_fleet
+    must consume the WHOLE contract (size + lease cadence + store), with
+    explicit arguments winning."""
+    monkeypatch.setenv("DS_TPU_FLEET_SIZE", "3")
+    monkeypatch.setenv("DS_TPU_FLEET_COORD_DIR", str(tmp_path / "env_coord"))
+    monkeypatch.setenv("DS_TPU_FLEET_LEASE", "2.5")
+    monkeypatch.setenv("DS_TPU_FLEET_MISS_LIMIT", "4")
+    router = tiny_engine.serving_fleet(**SERVE_KW)
+    assert len(router.members) == 3
+    assert router.miss_limit == 4
+    assert all(m.lease_s == 2.5 for m in router.members.values())
+    router2 = tiny_engine.serving_fleet(
+        n_engines=2, miss_limit=5, coord_dir=str(tmp_path / "c2"),
+        **SERVE_KW)
+    assert len(router2.members) == 2 and router2.miss_limit == 5
+
+
+def test_recycle_refuses_undrained_engine(tiny_engine):
+    sup = tiny_engine.supervised_serving(**SERVE_KW)
+    sup.submit(Request(rid=0, input_ids=np.array([1, 2, 3], np.int32),
+                       max_new_tokens=4))
+    with pytest.raises(RuntimeError, match="drained"):
+        sup.recycle()
+    sup.run([], max_ticks=200)
+    assert sup.recycle() in (True, False)         # idle engine recycles
+    assert sup.restarts == 0                      # maintenance, not a fault
+
+
+def test_fleet_gauges_reach_prometheus_exposition(tiny_engine, tmp_path):
+    from deepspeed_tpu.observability import prometheus_text
+
+    mon = InMemoryMonitor()
+    store, router = _fleet(tiny_engine, tmp_path, n=2, monitor=mon)
+    router.run(_stream(4, seed=5), max_ticks=500)
+    text = prometheus_text(monitor=mon)
+    for gauge in ("dstpu_fleet_engines_live", "dstpu_fleet_queue_depth",
+                  "dstpu_fleet_failovers_total",
+                  "dstpu_fleet_flight_dropped_total"):
+        assert gauge in text, gauge
+
+
+# --------------------------------- acceptance: the chaos_soak fleet harness
+
+@pytest.mark.chaos
+def test_fleet_chaos_soak_deterministic_lease_seed(tmp_path):
+    """Pinned seed of ``tools/chaos_soak.py --mode fleet``: silent engine
+    kill + coordinator kill in one stream (seed 1 draws both)."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    os.pardir, os.pardir, "tools"))
+    from chaos_soak import run_fleet_soak
+
+    stats = run_fleet_soak(seed=1, coord_dir=str(tmp_path / "coord"),
+                           n_requests=8, verbose=False)
+    assert stats["kill_mode"] == "lease" and stats["killed_coordinator"]
+    assert stats["terminal"] == 8
+    assert stats["final_term"] == 2
+    assert stats["dead_engines"] == ["engine0"]
+
+
+@pytest.mark.chaos
+def test_fleet_chaos_soak_deterministic_budget_seed(tmp_path):
+    """Pinned seed 4: fault-injected restart-budget exhaustion — the dead
+    marker path, no coordinator kill."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    os.pardir, os.pardir, "tools"))
+    from chaos_soak import run_fleet_soak
+
+    stats = run_fleet_soak(seed=4, coord_dir=str(tmp_path / "coord"),
+                           n_requests=8, verbose=False)
+    assert stats["kill_mode"] == "budget" and not stats["killed_coordinator"]
+    assert stats["terminal"] == 8 and stats["failovers"] > 0
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_fleet_chaos_soak_multiseed(tmp_path):
+    """Long-form randomized variant (tools/chaos_soak.py --mode fleet)."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    os.pardir, os.pardir, "tools"))
+    from chaos_soak import run_fleet_soak
+
+    for seed in (0, 1, 2, 3, 4, 5):
+        run_fleet_soak(seed=seed, coord_dir=str(tmp_path / f"c{seed}"),
+                       n_requests=8, verbose=False)

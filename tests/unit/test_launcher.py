@@ -116,6 +116,30 @@ def test_parse_args_remainder():
     assert args.num_nodes == 2
 
 
+def test_fleet_flags_export_env_contract(tmp_path):
+    """--fleet N exports the DS_TPU_FLEET_* contract to children (ISSUE 7:
+    one binary, train or serve); it requires a coordination store and
+    defaults its dir to --pod_coord_dir."""
+    from deepspeed_tpu.launcher.runner import fleet_env
+
+    args = parse_args(["--fleet", "3", "--fleet_coord_dir",
+                       str(tmp_path / "coord"), "--fleet_lease", "2.5",
+                       "serve.py"])
+    env = fleet_env(args)
+    assert env == {"DS_TPU_FLEET_SIZE": "3",
+                   "DS_TPU_FLEET_COORD_DIR": str(tmp_path / "coord"),
+                   "DS_TPU_FLEET_LEASE": "2.5",
+                   "DS_TPU_FLEET_MISS_LIMIT": "3"}
+    # defaults to the pod store when only that is given
+    args = parse_args(["--fleet", "2", "--pod_coord_dir",
+                       str(tmp_path / "pod"), "serve.py"])
+    assert fleet_env(args)["DS_TPU_FLEET_COORD_DIR"] == str(tmp_path / "pod")
+    # no fleet -> no exports; fleet without a store is an arg error
+    assert fleet_env(parse_args(["train.py"])) == {}
+    with pytest.raises(SystemExit):
+        parse_args(["--fleet", "2", "serve.py"])
+
+
 def test_ssh_runner_env_contract():
     from deepspeed_tpu.launcher.multinode_runner import SSHRunner
 

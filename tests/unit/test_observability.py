@@ -373,3 +373,61 @@ def test_maybe_start_metrics_server_is_env_gated(monkeypatch):
     finally:
         srv.close()
         monkeypatch.setattr(export_mod, "_METRICS_SERVER", None)
+
+
+def test_metrics_port_collision_falls_back_to_ephemeral(monkeypatch):
+    """ISSUE 7 satellite: with N engines sharing a host, the second
+    process finding DS_TPU_METRICS_PORT already bound must neither crash
+    at init nor silently lose its endpoint — it binds an ephemeral port
+    and reports the ACTUAL port (get_metrics_server / health())."""
+    from deepspeed_tpu.observability import (MetricsServer,
+                                             get_metrics_server,
+                                             maybe_start_metrics_server)
+    from deepspeed_tpu.observability import export as export_mod
+
+    first = MetricsServer(port=0, monitor=None)   # "the first process"
+    try:
+        monkeypatch.setenv("DS_TPU_METRICS_PORT", str(first.port))
+        monkeypatch.setattr(export_mod, "_METRICS_SERVER", None)
+        srv = maybe_start_metrics_server()        # "the second process"
+        try:
+            assert srv is not None
+            assert srv.port != first.port and srv.port > 0
+            assert get_metrics_server() is srv
+        finally:
+            if srv is not None:
+                srv.close()
+    finally:
+        first.close()
+        monkeypatch.setattr(export_mod, "_METRICS_SERVER", None)
+
+
+def test_serving_engine_health_reports_bound_metrics_port(monkeypatch):
+    """The serving engine wires the env-gated endpoint at init and
+    health() exposes the bound port (the fleet advertisement reads the
+    same field) — None when the endpoint is not enabled."""
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM
+    from deepspeed_tpu.observability import export as export_mod
+
+    model = CausalLM("tiny", dtype=jnp.float32, attn_impl="xla")
+    params = model.init_fn(jax.random.PRNGKey(0))
+    engine = deepspeed_tpu.init_inference(
+        model=model, config={"dtype": "float32"}, params=params)
+    monkeypatch.delenv("DS_TPU_METRICS_PORT", raising=False)
+    serve = engine.serving(b_slots=1, page_size=8, max_model_len=32)
+    assert serve.health()["metrics_port"] is None
+    monkeypatch.setenv("DS_TPU_METRICS_PORT", "0")
+    monkeypatch.setattr(export_mod, "_METRICS_SERVER", None)
+    try:
+        serve2 = engine.serving(b_slots=1, page_size=8, max_model_len=32)
+        port = serve2.health()["metrics_port"]
+        assert isinstance(port, int) and port > 0
+    finally:
+        srv = export_mod._METRICS_SERVER
+        if srv is not None:
+            srv.close()
+        monkeypatch.setattr(export_mod, "_METRICS_SERVER", None)

@@ -18,7 +18,17 @@ Three modes (``--mode train`` is the default):
   that must be detected by missed leases, re-form at the largest healthy
   slice ``compute_elastic_config`` admits, restore the last *committed*
   pod checkpoint (torn pod tags quarantined), and converge with loss
-  continuity (docs/POD.md).
+  continuity (docs/POD.md);
+- **fleet**: a 3-engine serving fleet on a file-backed coordination store
+  (injected store clock, one router round per clock tick) under a seeded
+  random ENGINE kill — silent lease lapse or fault-injected restart-budget
+  exhaustion — plus, half the time, a coordinator kill with a standby
+  router taking the next election term.  Every request must reach a
+  terminal result, completed outputs must be token-identical to a
+  fault-free single-engine reference, each SURVIVING engine's page
+  accounting must balance, the dead engine must carry a lapsed lease or a
+  durable ``fleet/dead`` marker, and the fleet generation must bump
+  monotonically across coordinator terms (docs/FLEET.md).
 
 Each soak round draws a fault mix from a seeded PRNG — preemption SIGTERMs
 at random steps, checkpoint-write failures, corruption of the newest
@@ -297,6 +307,214 @@ def run_serve_soak(seed: int, n_requests: int = 8, b_slots: int = 3,
     return stats
 
 
+def run_fleet_soak(seed: int, coord_dir: str, n_requests: int = 10,
+                   n_engines: int = 3, verbose: bool = True) -> dict:
+    """One serving-fleet session under a seeded random kill (docs/FLEET.md).
+
+    The seed draws the victim engine, the router round it dies at, and the
+    kill mode — ``lease`` (silent process kill: the lease just stops
+    renewing, detection is ``miss_limit`` missed periods on the injected
+    store clock) or ``budget`` (injected ``serve.decode`` faults exhaust
+    the member's restart budget: it writes a durable ``fleet/dead`` marker
+    as a dying breath and failover is immediate).  Half the time a standby
+    router is registered and the COORDINATOR is killed a few rounds later:
+    the standby must win the next election term, bump the fleet generation
+    through the CAS store, adopt the request journal, and finish the
+    stream.
+
+    Invariants asserted: every submitted request reaches a terminal result
+    (none lost); completed outputs are token-identical to a fault-free
+    single-engine reference run; every surviving engine's refcount page
+    accounting balances; the dead engine is visibly dead through the store
+    (lapsed lease or dead marker); the fleet generation is strictly
+    monotonic across coordinator terms.
+    """
+    import numpy as np
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.elasticity import (FileCoordinationStore, dead_set,
+                                          lease_table, read_generation)
+    from deepspeed_tpu.inference.fleet import FleetMember, FleetRouter
+    from deepspeed_tpu.inference.serving import Request
+    from deepspeed_tpu.models import CausalLM
+    from deepspeed_tpu.resilience import (FaultInjector, clear_injector,
+                                          install_injector)
+    from deepspeed_tpu.resilience.fault_injection import SITE_SERVE_DECODE
+
+    rng = Random(seed)
+    model = CausalLM("tiny", dtype=jnp.float32, attn_impl="xla")
+    params = model.init_fn(jax.random.PRNGKey(0))
+    engine = deepspeed_tpu.init_inference(
+        model=model, config={"dtype": "float32"}, params=params)
+
+    nprng = np.random.default_rng(seed)
+    # half the stream shares a seeded system prompt so kills land on
+    # refcounted shared pages (the per-engine prefix index path)
+    system = nprng.integers(1, model.config.vocab_size, 11).astype(np.int32)
+
+    def prompt(i):
+        if i % 2 == 0:
+            uniq = nprng.integers(1, model.config.vocab_size,
+                                  int(nprng.integers(2, 6))).astype(np.int32)
+            return np.concatenate([system, uniq])
+        return nprng.integers(1, model.config.vocab_size,
+                              int(nprng.integers(3, 14))).astype(np.int32)
+
+    base = [Request(rid=i, input_ids=prompt(i),
+                    max_new_tokens=int(nprng.choice((4, 6, 8))))
+            for i in range(n_requests)]
+
+    def copies():
+        return [Request(rid=r.rid, input_ids=r.input_ids,
+                        max_new_tokens=r.max_new_tokens) for r in base]
+
+    # fault-free single-engine reference (greedy => engine-independent)
+    ref_serve = engine.serving(b_slots=3, page_size=8, max_model_len=64)
+    ref = {r.rid: r.output_ids for r in ref_serve.run(copies())}
+    del ref_serve
+
+    victim = f"engine{rng.randrange(n_engines)}"
+    kill_mode = rng.choice(("lease", "budget"))
+    kill_round = rng.randint(2, 6)
+    kill_coordinator = rng.random() < 0.5
+    coord_kill_round = kill_round + rng.randint(1, 3)
+
+    LEASE_S, MISS = 1.0, 3
+    clock_box = [0.0]
+    store = FileCoordinationStore(coord_dir, clock=lambda: clock_box[0])
+
+    serve_kw = dict(b_slots=2, page_size=8, max_model_len=64)
+    members = [FleetMember(f"engine{i}",
+                           engine.supervised_serving(
+                               max_restarts=0 if kill_mode == "budget"
+                               else 5, **serve_kw),
+                           store, lease_s=LEASE_S)
+               for i in range(n_engines)]
+    # the router election lease rides the same injected clock: long enough
+    # that +1/round clock ticks never depose a LIVE router (it renews every
+    # round), short enough that a killed one is succeeded within the soak
+    ROUTER_LEASE = 30.0
+    router = FleetRouter(store, members, router_id="router0",
+                         lease_s=ROUTER_LEASE, miss_limit=MISS)
+    standby = (FleetRouter(store, members, router_id="router1",
+                           lease_s=ROUTER_LEASE, miss_limit=MISS)
+               if kill_coordinator else None)
+
+    inj = FaultInjector()
+    if kill_mode == "budget":
+        # with max_restarts=0, the first decode fault on the victim's turn
+        # exhausts its budget — the seed picks WHEN, scheduling picks whom
+        # (attributed post-hoc below)
+        inj.add(site=SITE_SERVE_DECODE, kind="raise",
+                at_call=rng.randint(3, 3 * n_engines))
+    install_injector(inj)
+
+    gens = []
+    state = {"victim_killed": False}
+
+    def on_tick(r, rounds):
+        clock_box[0] += 1.0
+        gens.append(read_generation(store, key=r.generation_key))
+        if kill_mode == "lease" and rounds == kill_round \
+                and not state["victim_killed"]:
+            r.members[victim].kill()
+            state["victim_killed"] = True
+        if kill_coordinator and rounds == coord_kill_round and r.alive \
+                and r is router:
+            r.kill()
+
+    try:
+        try:
+            results = router.run(copies(), max_ticks=4000, on_tick=on_tick)
+        except RuntimeError:
+            # the coordinator was killed mid-run (its own step() raising is
+            # the in-process stand-in for the process dying): the standby
+            # must win the next term and converge the stream
+            if not (kill_coordinator and not router.alive):
+                raise
+            results = list(router.take_results())
+            results += standby.run([], max_ticks=4000, on_tick=on_tick)
+    finally:
+        clear_injector()
+
+    live_router = standby if (standby is not None
+                              and standby.is_coordinator) else router
+    # invariant: none lost — a terminal result per submitted rid
+    by_rid = {r.rid: r for r in results}
+    assert sorted(by_rid) == sorted(r.rid for r in base), \
+        f"fleet soak seed={seed}: lost requests " \
+        f"{sorted(set(r.rid for r in base) - set(by_rid))}"
+    # invariant: completed outputs token-identical to the reference
+    parity_checked = 0
+    for rid, res in by_rid.items():
+        if res.finish_reason in ("eos", "length"):
+            assert np.array_equal(res.output_ids, ref[rid]), \
+                f"fleet soak seed={seed}: rid {rid} diverged after failover"
+            parity_checked += 1
+        else:
+            assert res.finish_reason in ("deadline", "shed"), \
+                res.finish_reason
+    # invariant: surviving engines' page accounting balances
+    for eid, m in live_router.members.items():
+        if m.alive:
+            acct = m.sup.engine.page_accounting()
+            assert acct["balanced"], \
+                f"fleet soak seed={seed}: {eid} accounting broken: {acct}"
+    # invariant: the dead engine is visibly dead through the store
+    dead_ids = live_router._failed_engines
+    if kill_mode == "budget":
+        assert dead_ids, f"fleet soak seed={seed}: budget kill never landed"
+    for eid in dead_ids:
+        marked = eid in dead_set(store, prefix="fleet/dead")
+        lease = lease_table(store, prefix="fleet/heartbeat").get(eid)
+        lapsed = lease is None or lease.missed(clock_box[0]) >= MISS
+        assert marked or lapsed, \
+            f"fleet soak seed={seed}: {eid} failed over while visibly alive"
+    if kill_mode == "lease":
+        assert victim in dead_ids, \
+            f"fleet soak seed={seed}: killed {victim} never declared dead"
+    if not kill_coordinator:
+        # one router saw every failover, so its counter must equal the sum
+        # of the per-result stamps (across a takeover the stamps survive
+        # via the journal but the counter is per-router, so the equality
+        # only holds when the coordinator survived)
+        assert router.failovers_total == \
+            sum(r.failovers for r in by_rid.values()), \
+            f"fleet soak seed={seed}: failover accounting mismatch"
+    # invariant: fleet generation monotonic across coordinator terms
+    assert all(b >= a for a, b in zip(gens, gens[1:])), \
+        f"fleet soak seed={seed}: generation not monotonic: {gens}"
+    if kill_coordinator:
+        assert standby.is_coordinator and standby.term == 2, \
+            f"fleet soak seed={seed}: election never converged " \
+            f"(term {standby.term})"
+    stats = {
+        "seed": seed,
+        "submitted": len(base),
+        "terminal": len(by_rid),
+        "parity_checked": parity_checked,
+        "kill_mode": kill_mode,
+        "victim": victim,
+        "killed_coordinator": kill_coordinator,
+        "dead_engines": sorted(dead_ids),
+        "failovers": live_router.failovers_total,
+        "faults_fired": len(inj.log),
+        "final_term": live_router.term,
+        "final_generation": live_router.generation,
+    }
+    if verbose:
+        print(f"  seed={seed}: OK — kill={kill_mode}({victim}"
+              f"{'+coordinator' if kill_coordinator else ''}), "
+              f"{stats['failovers']} failover(s), term {stats['final_term']}"
+              f", {parity_checked} parity-checked")
+    return stats
+
+
 def run_pod_soak(seed: int, total_steps: int = 12, ckpt_every: int = 2,
                  ckpt_dir: str = "", coord_dir: str = "", n_hosts: int = 4,
                  verbose: bool = True) -> dict:
@@ -536,12 +754,13 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="randomized fault-injection soak for the resilience "
                     "subsystem")
-    ap.add_argument("--mode", choices=("train", "serve", "pod"),
+    ap.add_argument("--mode", choices=("train", "serve", "pod", "fleet"),
                     default="train",
                     help="train: supervised elastic rounds; serve: "
                          "ServingSupervisor kill/replay soak; pod: "
                          "simulated multi-host kill + shrink-to-healthy "
-                         "re-formation")
+                         "re-formation; fleet: serving-fleet engine + "
+                         "coordinator kills with store-lease failover")
     ap.add_argument("--soaks", type=int, default=3,
                     help="number of supervised sessions to soak")
     ap.add_argument("--total-steps", type=int, default=8)
@@ -578,6 +797,19 @@ def main(argv=None) -> int:
             except Exception as e:
                 failures += 1
                 print(f"  FAILED ({type(e).__name__}): {e}", file=sys.stderr)
+            continue
+        if args.mode == "fleet":
+            root = tempfile.mkdtemp(prefix=f"chaos_fleet_{seed}_")
+            print(f"fleet soak {i + 1}/{args.soaks} (seed={seed}) -> {root}")
+            try:
+                run_fleet_soak(seed, coord_dir=os.path.join(root, "coord"),
+                               n_requests=args.requests)
+            except Exception as e:
+                failures += 1
+                print(f"  FAILED ({type(e).__name__}): {e}", file=sys.stderr)
+            finally:
+                if not args.keep_dirs:
+                    shutil.rmtree(root, ignore_errors=True)
             continue
         if args.mode == "pod":
             root = tempfile.mkdtemp(prefix=f"chaos_pod_{seed}_")

@@ -243,6 +243,134 @@ def run_prefix_bench(model_name: str = "llama-374m", b_slots: int = 4,
     }
 
 
+def run_fleet_bench(model_name: str = "llama-374m", n_engines: int = 3,
+                    b_slots: int = 4, n_requests: int = 36, seed: int = 0,
+                    page_size: int = 128, max_model_len: int = 0,
+                    kill_engine: bool = False) -> dict:
+    """Fleet-tier serving benchmark (ISSUE 7): the seeded mixed stream
+    through ``n_engines`` leased engines behind a :class:`FleetRouter` on a
+    file-backed coordination store.  Reports fleet throughput, PER-ENGINE
+    throughput (``tokens_by_engine`` over the measured wall time), fleet
+    TTFT/latency p50/p99, and the failover count — ``--kill_engine`` kills
+    one engine a few rounds into the measured pass so the failover path's
+    cost lands in the numbers instead of only in the chaos suite."""
+    import tempfile
+
+    import numpy as np
+
+    import jax
+
+    from deepspeed_tpu.elasticity import FileCoordinationStore
+    from deepspeed_tpu.inference.fleet import FleetMember, FleetRouter
+
+    on_tpu = jax.devices()[0].platform not in ("cpu",)
+    if not on_tpu:
+        model_name, prompt_rng = "serve-fleet(cpu)", (3, 14)
+        new_choices = (16, 24, 32)
+        base_cfg = "tiny"
+    else:
+        prompt_rng, new_choices = (4, 48), (32, 64, 96)
+        base_cfg = model_name
+    max_model_len = max_model_len or (64 if not on_tpu else 2048)
+    page_size = min(page_size, max_model_len)
+    model, engine = _build_bench_engine(base_cfg, max_model_len, on_tpu)
+    stream = build_stream(model.config.vocab_size, n_requests, seed,
+                          0.0, prompt_rng, new_choices)
+
+    def copies():
+        return [type(r)(rid=r.rid, input_ids=r.input_ids,
+                        max_new_tokens=r.max_new_tokens) for r in stream]
+
+    # single-engine reference: the parity oracle AND the scale-out baseline
+    ref_sup = engine.supervised_serving(
+        b_slots=b_slots, page_size=page_size, max_model_len=max_model_len)
+    ref_sup.run(copies())                            # warm
+    t0 = time.perf_counter()
+    ref_results = ref_sup.run(copies())              # measured
+    single_dt = time.perf_counter() - t0
+    ref = {r.rid: r.output_ids for r in ref_results}
+    del ref_sup, ref_results   # release the reference KV pool
+
+    import shutil
+
+    coord_dir = tempfile.mkdtemp(prefix="fleet_bench_")
+    try:
+        store = FileCoordinationStore(coord_dir)
+        serve_kw = dict(b_slots=b_slots, page_size=page_size,
+                        max_model_len=max_model_len)
+        members = [FleetMember(f"engine{i}",
+                               engine.supervised_serving(**serve_kw), store)
+                   for i in range(n_engines)]
+        router = FleetRouter(store, members)
+        router.run(copies(), max_ticks=100000)       # warm all members
+        # counter snapshots: tokens_by_engine / shed_total are cumulative
+        # over the router's lifetime — the measured numbers must not
+        # include the warm pass
+        warm_tokens = dict(router.tokens_by_engine)
+        warm_shed = router.shed_total
+
+        def on_tick(r, rounds):
+            if kill_engine and rounds == 3 and r.members["engine0"].alive:
+                r.members["engine0"].kill()
+                # a bench must not wait out real lease time: lapse it now
+                r._failover("engine0", "bench kill")
+
+        t0 = time.perf_counter()
+        results = router.run(copies(), max_ticks=100000, on_tick=on_tick)
+        fleet_dt = time.perf_counter() - t0
+        h = router.health()     # snapshot while the store still exists
+    finally:
+        shutil.rmtree(coord_dir, ignore_errors=True)
+
+    total_tokens = sum(len(r.output_ids) for r in results)
+    parity = all(np.array_equal(r.output_ids, ref[r.rid]) for r in results
+                 if r.finish_reason in ("eos", "length"))
+    none_lost = sorted(r.rid for r in results) == sorted(
+        r.rid for r in stream)
+    ttft = [r.ttft_s for r in results]
+    lat = [r.latency_s for r in results]
+    per_engine = {eid: round((tok - warm_tokens.get(eid, 0)) / fleet_dt, 1)
+                  for eid, tok in router.tokens_by_engine.items()}
+    return {
+        "metric": "serve-fleet",
+        "value": round(total_tokens / fleet_dt, 1),
+        "unit": "tokens/sec",
+        "vs_single_engine": round(single_dt / fleet_dt, 3),
+        "detail": {
+            "model": model_name,
+            "platform": jax.devices()[0].platform,
+            "n_engines": n_engines,
+            "b_slots_per_engine": b_slots,
+            "page_size": page_size,
+            "n_requests": n_requests,
+            "seed": seed,
+            "total_tokens": total_tokens,
+            "single_engine_tokens_per_sec": round(
+                total_tokens / single_dt, 1),
+            "tokens_per_sec_by_engine": per_engine,
+            "ttft_p50_s": round(_pct(ttft, 0.50), 4),
+            "ttft_p99_s": round(_pct(ttft, 0.99), 4),
+            "p50_latency_s": round(_pct(lat, 0.50), 4),
+            "p99_latency_s": round(_pct(lat, 0.99), 4),
+            "failovers_total": router.failovers_total,
+            "engines_live": h["engines_live"],
+            # measured pass only (the warm pass ran clean, but keep the
+            # accounting honest if that ever changes)
+            "shed_total": h["shed_total"] - warm_shed,
+            "elections_total": h["elections_total"],
+            "generation": h["generation"],
+            "killed_engine": bool(kill_engine),
+            "parity_with_single_engine": parity,
+            "none_lost": none_lost,
+            # the CPU harness pumps members cooperatively in ONE thread, so
+            # fleet throughput here measures the ROUTER path (admission,
+            # leases, failover), not scale-out — production members run one
+            # per process/host (docs/FLEET.md)
+            "harness": "cooperative-in-process",
+        },
+    }
+
+
 def run_serve_bench(model_name: str = "llama-374m", b_slots: int = 8,
                     n_requests: int = 32, seed: int = 0,
                     rate_rps: float = 0.0, page_size: int = 128,
@@ -371,6 +499,17 @@ def run_serve_bench(model_name: str = "llama-374m", b_slots: int = 8,
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="llama-374m")
+    ap.add_argument("--mode", choices=("engine", "fleet"), default="engine",
+                    help="engine: one (supervised) serving engine; fleet: "
+                         "N leased engines behind a FleetRouter on a "
+                         "coordination store (ISSUE 7) — reports failover "
+                         "count, per-engine throughput, fleet TTFT")
+    ap.add_argument("--n_engines", type=int, default=3,
+                    help="fleet mode: engines behind the router")
+    ap.add_argument("--kill_engine", action="store_true",
+                    help="fleet mode: kill engine0 a few rounds into the "
+                         "measured pass so failover cost lands in the "
+                         "numbers")
     ap.add_argument("--workload", choices=("mixed", "prefix"),
                     default="mixed",
                     help="mixed: ragged stream vs sequential generate(); "
@@ -394,6 +533,30 @@ def main(argv=None) -> int:
                     help="emit a Chrome/Perfetto trace of one extra traced "
                          "pass (the measured pass stays untraced)")
     args = ap.parse_args(argv)
+    if args.mode == "fleet":
+        if args.workload != "mixed":
+            ap.error("--mode fleet runs the mixed stream (prefix reuse is "
+                     "per-engine; bench it with --workload prefix)")
+        if args.trace or args.rate_rps:
+            ap.error("--trace/--rate_rps are not supported with --mode "
+                     "fleet (the router owns arrival gating)")
+        result = run_fleet_bench(
+            args.model, n_engines=args.n_engines,
+            b_slots=args.b_slots if args.b_slots is not None else 4,
+            n_requests=(args.n_requests
+                        if args.n_requests is not None else 36),
+            seed=args.seed,
+            page_size=args.page_size if args.page_size is not None else 128,
+            max_model_len=args.max_model_len, kill_engine=args.kill_engine)
+        line = json.dumps(result)
+        print(line)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        d = result["detail"]
+        ok = (d["parity_with_single_engine"] and d["none_lost"]
+              and (d["failovers_total"] > 0) == d["killed_engine"])
+        return 0 if ok else 1
     if args.workload == "prefix":
         if args.trace:
             ap.error("--trace is not supported with --workload prefix "
