@@ -64,7 +64,7 @@ import os
 import threading
 import time
 from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..resilience.fault_injection import (SITE_POD_HEARTBEAT,
                                           SITE_POD_RENDEZVOUS, maybe_fire)
@@ -372,6 +372,126 @@ def clear_dead(store: CoordinationStore, host_id: str,
     store.delete(f"{prefix}/{host_id}")
 
 
+# ------------------------------------------------------ host advertisements
+
+# pod-side analogue of the serving fleet's ``fleet/engines`` advertisements
+# (inference/fleet.py): each host publishes its per-process observability
+# counters so any host (or an external scraper) gets ONE cross-host view
+# through the store instead of N per-process /metrics endpoints
+POD_HOSTS_PREFIX = "pod/hosts"
+
+
+_PROCESS_SRC: Optional[str] = None
+
+
+def process_src() -> str:
+    """Machine-unique PROCESS identity for advertisement dedup keys.  A
+    bare pid is not unique across the machines of a real pod (containers
+    commonly all run as pid 1, which would silently merge distinct hosts'
+    counters in a rollup), so the hostname rides along; simulated hosts
+    (threads of one process) still share one src and dedup to a single
+    count, which is the point of the key.  Cached — it sits on every
+    heartbeat/advertisement path and cannot change within a process."""
+    global _PROCESS_SRC
+    if _PROCESS_SRC is None:
+        import socket
+
+        _PROCESS_SRC = f"{socket.gethostname()}.{os.getpid()}"
+    return _PROCESS_SRC
+
+
+def advertise_host(store: CoordinationStore, host_id: str, generation: int,
+                   monitor=None, prefix: str = POD_HOSTS_PREFIX,
+                   **attrs) -> Dict:
+    """Publish this host's observability snapshot under
+    ``pod/hosts/<host_id>``: the flight-recorder ring's drop counter and
+    the monitor ring's drop counter PR 4 left per-process, plus caller
+    attrs (``step=`` etc.).  The ``*_src`` ids scope each counter to its
+    process-level object — the tracer ring is a process singleton and
+    simulated hosts (threads) share a process, so a rollup summing N
+    identical advertisements would overcount N-fold without them (same
+    contract as the fleet advertisements)."""
+    from ..observability.trace import get_tracer
+
+    src = process_src()
+    ad = {
+        "host_id": str(host_id),
+        "generation": int(generation),
+        "t": store.now(),
+        "flight_dropped": int(get_tracer().recorder.dropped),
+        "flight_src": src,
+        "monitor_dropped": int(getattr(monitor, "dropped_events", 0) or 0),
+        "monitor_src": f"{src}.{id(monitor)}",
+        "attrs": attrs,
+    }
+    store.put(f"{prefix}/{host_id}", ad)
+    return ad
+
+
+def host_advertisements(store: CoordinationStore,
+                        prefix: str = POD_HOSTS_PREFIX) -> Dict[str, Dict]:
+    """host_id -> newest advertisement (the cross-host /metrics view)."""
+    out: Dict[str, Dict] = {}
+    for name in store.list(prefix):
+        doc = store.get(f"{prefix}/{name}")
+        if doc is not None:
+            out[str(doc.get("host_id", name))] = doc
+    return out
+
+
+def dedup_drop_totals(ads: Dict[str, Dict]) -> Tuple[int, int]:
+    """Fold advertisements into (flight_dropped, monitor_dropped) totals,
+    deduplicated by source id: advertisers sharing a process ring (the
+    ``*_src`` keys) are counted once, not once per advertisement.  The ONE
+    implementation of the fold — the pod watchdog rollup and the fleet
+    router's gauge rollup (inference/fleet.py) both call it, so the dedup
+    contract cannot drift between tiers."""
+    flight_by_src: Dict[str, int] = {}
+    monitor_by_src: Dict[str, int] = {}
+    for key, ad in ads.items():
+        # max, not last-iterated: advertisers sharing a src write on
+        # independent cadences, and the counters are monotonic — a stale
+        # advertisement must never mask a fresher, higher count (listing
+        # order is arbitrary)
+        fsrc = str(ad.get("flight_src", key))
+        flight_by_src[fsrc] = max(flight_by_src.get(fsrc, 0),
+                                  int(ad.get("flight_dropped", 0)))
+        msrc = str(ad.get("monitor_src", key))
+        monitor_by_src[msrc] = max(monitor_by_src.get(msrc, 0),
+                                   int(ad.get("monitor_dropped", 0)))
+    return sum(flight_by_src.values()), sum(monitor_by_src.values())
+
+
+def rollup_host_gauges(store: CoordinationStore, monitor, tick: int = 0,
+                       prefix: str = POD_HOSTS_PREFIX,
+                       max_age_s: Optional[float] = None) -> Dict[str, float]:
+    """Fold every host's advertisement into pod-scope monitor gauges
+    (``pod/flight_dropped_total``, ``pod/monitor_dropped_total``,
+    ``pod/hosts_advertised``) — deduplicated by source id, so hosts
+    sharing a process ring are counted once.  ``max_age_s`` drops
+    advertisements older than that on the store clock (advertisements are
+    never deleted, so without an age bound a permanently dead host's last
+    snapshot would inflate the gauges forever — the watchdog passes its
+    own dead-by-lease threshold).  Returns the gauge values; writes them
+    when ``monitor`` is not None (they then reach the Prometheus
+    exposition like every other gauge)."""
+    ads = host_advertisements(store, prefix=prefix)
+    if max_age_s is not None:
+        now = store.now()
+        ads = {h: ad for h, ad in ads.items()
+               if now - float(ad.get("t", 0.0)) <= max_age_s}
+    flight, monitor_drops = dedup_drop_totals(ads)
+    gauges = {
+        "pod/flight_dropped_total": float(flight),
+        "pod/monitor_dropped_total": float(monitor_drops),
+        "pod/hosts_advertised": float(len(ads)),
+    }
+    if monitor is not None:
+        monitor.write_events([(name, val, tick)
+                              for name, val in sorted(gauges.items())])
+    return gauges
+
+
 # --------------------------------------------------------------- generation
 
 def read_generation(store: CoordinationStore, key: str = "generation") -> int:
@@ -542,7 +662,7 @@ class HeartbeatWatchdog:
                  miss_limit: int = 3,
                  on_peer_dead: Optional[Callable[[str], None]] = None,
                  monitor=None, grace_beats: int = 3,
-                 renew_s: Optional[float] = None):
+                 renew_s: Optional[float] = None, advertise: bool = True):
         self.store = store
         self.host_id = host_id
         self.generation = int(generation)
@@ -557,6 +677,12 @@ class HeartbeatWatchdog:
         # time while lease expiry is judged on the store clock.
         self.renew_s = (float(renew_s) if renew_s is not None
                         else max(self.lease_s / 3.0, 1e-3))
+        # publish a pod/hosts/<host> observability advertisement with every
+        # renewal (flight-recorder + monitor drop counters; see
+        # advertise_host) so the pod has one cross-host /metrics view
+        self.advertise = bool(advertise)
+        self._last_rollup_t: Optional[float] = None   # store clock
+        self._last_advert_t: Optional[float] = None   # store clock
         self.dead: List[str] = []
         self.beats = 0
         self._attrs: Dict = {}
@@ -591,6 +717,17 @@ class HeartbeatWatchdog:
         call this from the step loop to piggyback fresh attrs)."""
         beat(self.store, self.host_id, self.generation, self.lease_s,
              **self._attrs)
+        if self.advertise:
+            # once per lease, not per renewal: the advertisement's only
+            # consumer (rollup_host_gauges) is itself rate-limited to once
+            # per lease, so renewing it 3x as often just doubles the
+            # store's write volume for an identical cross-host view
+            now = self.store.now()
+            if self._last_advert_t is None \
+                    or now - self._last_advert_t >= self.lease_s:
+                self._last_advert_t = now
+                advertise_host(self.store, self.host_id, self.generation,
+                               monitor=self.monitor, **self._attrs)
         self.beats += 1
 
     def _loop(self) -> None:
@@ -625,6 +762,22 @@ class HeartbeatWatchdog:
                 ("pod/live_hosts",
                  float(len(self.peers) + 1 - len(dead)), self.beats),
                 ("pod/generation", float(self.generation), self.beats)])
+            if self.advertise:
+                # fold every host's pod/hosts advertisement into pod-scope
+                # gauges so THIS host's /metrics shows the cross-host view
+                # (staleness bound = our own dead-by-lease threshold, so a
+                # lost host ages out of the rollup when it ages out of the
+                # pod).  Rate-limited to once per lease on the store clock:
+                # the rollup reads every host's advertisement, and N hosts
+                # doing that every scan would put O(N^2) reads per renew
+                # interval on the store for byte-identical gauge values.
+                now = self.store.now()
+                if self._last_rollup_t is None \
+                        or now - self._last_rollup_t >= self.lease_s:
+                    self._last_rollup_t = now
+                    rollup_host_gauges(
+                        self.store, self.monitor, tick=self.beats,
+                        max_age_s=self.miss_limit * self.lease_s)
         if not dead:
             return
         self.dead = dead

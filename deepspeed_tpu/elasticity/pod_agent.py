@@ -33,14 +33,16 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import time
 from typing import Callable, List, Optional, Sequence, Tuple
 
 from .coordination import (CoordinationStore, HeartbeatWatchdog,
-                           RC_POD_PEER_LOST, bump_generation, dead_set)
+                           RC_POD_PEER_LOST, bump_generation, dead_set,
+                           elect_coordinator, read_generation)
 from .elastic_agent import ElasticAgent
 from .elasticity import (ElasticPlan, ElasticityIncompatibleWorldSize,
                          compute_elastic_config)
-from .supervisor import Supervisor
+from .supervisor import Supervisor, SupervisorStandDown
 from ..observability.trace import trace_span
 from ..resilience.fault_injection import SITE_LATEST_PUBLISH, maybe_fire
 from ..resilience.integrity import (LATEST_FILE, commit_pod_manifest,
@@ -52,6 +54,11 @@ from ..utils.logging import log_dist, logger
 # planned batch — permanent until hosts come back; distinct from
 # RC_POD_PEER_LOST (87, transient membership loss) and RC_HANG (85)
 RC_POD_UNRECOVERABLE = 86
+
+# the training-pod coordinator election key (the serving fleet elects under
+# fleet/coordinator on the same store — namespaced so the tiers never race
+# each other's leases)
+POD_COORDINATOR_KEY = "pod/coordinator"
 
 
 class PodPeerLost(RuntimeError):
@@ -108,6 +115,27 @@ def save_pod_checkpoint(engine, save_dir: str, ctx: "PodContext",
         shard_files: List[str] = []
         if ctx.shard_writer is not None:
             shard_files = list(ctx.shard_writer(ckpt_dir, ctx.host_id))
+        if engine is not None:
+            # attest the REAL payload files this process wrote (orbax
+            # shards + sidecars): the host manifest lists them with sizes
+            # and checksums, so verify_pod_checkpoint_dir catches a
+            # missing/torn shard FILE, not just a missing manifest.  The
+            # attribution index is the JAX process index — the one that
+            # names ocdbt.process_<k> payload paths — NOT ctx.rank, whose
+            # lexicographic host ordering diverges from it past 10 hosts
+            # (attesting another process's still-being-written files would
+            # record torn checksums and quarantine good checkpoints).
+            from ..resilience.integrity import host_payload_files
+
+            try:
+                import jax
+
+                proc = int(jax.process_index())
+            except Exception:   # pragma: no cover - no device runtime
+                proc = ctx.rank
+            shard_files.extend(
+                f for f in host_payload_files(ckpt_dir, process_index=proc)
+                if f not in shard_files)
         step = int(engine.global_steps) if engine is not None else -1
         write_host_manifest(ckpt_dir, ctx.host_id, ctx.generation, step,
                             files=shard_files)
@@ -297,16 +325,37 @@ class PodSupervisor(Supervisor):
     host from the previous incarnation can never rendezvous into it
     (records are generation-keyed).
 
+    **Standby takeover** (``supervisor_id=``): the round loop runs under
+    :func:`~.coordination.elect_coordinator` on ``pod/coordinator`` — the
+    SAME lease protocol the serving-fleet router uses, with the same
+    exactly-one-winner CAS proof under racing standbys.  A supervisor that
+    does not hold the lease stands by (polls, drives nothing); when the
+    leader's lease lapses, exactly one standby takes the next term, adopts
+    the CURRENT pod generation and dead-host set from the store (both
+    already live there — :func:`bump_generation` continues the monotonic
+    counter, :meth:`healthy_hosts` re-reads the markers), and continues
+    rounds where the dead leader stopped.  Long rounds must renew via
+    :meth:`renew_coordinator` from the step loop (the runbook in
+    docs/POD.md); a renewal returning False means a standby deposed us —
+    stop driving.  ``supervisor_id=None`` (default) keeps the PR 5
+    single-supervisor behavior: no election, rounds drive unconditionally.
+
     Exit semantics: :data:`RC_POD_PEER_LOST` is an ordinary failed round
     (the designed shrink path — backoff, budget, progress accounting all
     apply); an unshrinkable pod returns :data:`RC_POD_UNRECOVERABLE`,
-    which is terminal.
+    which is terminal; a standby that never wins within
+    ``standby_max_wait_s`` stands down cleanly (no budget burned).
     """
 
     def __init__(self, store: CoordinationStore, elastic_config,
                  attempt: Callable[[PodRound], int], hosts: Sequence[str],
                  chips_per_host: int = 1, model_parallel_size: int = 1,
-                 monitor=None, **supervisor_kw):
+                 monitor=None, supervisor_id: Optional[str] = None,
+                 election_key: str = POD_COORDINATOR_KEY,
+                 coordinator_lease_s: float = 5.0,
+                 standby_poll_s: float = 0.05,
+                 standby_max_wait_s: Optional[float] = None,
+                 **supervisor_kw):
         self.store = store
         self.elastic_config = elastic_config
         self.pod_attempt = attempt
@@ -314,6 +363,17 @@ class PodSupervisor(Supervisor):
         self.chips_per_host = int(chips_per_host)
         self.model_parallel_size = int(model_parallel_size)
         self.rounds: List[PodRound] = []
+        self.supervisor_id = (str(supervisor_id)
+                              if supervisor_id is not None else None)
+        self.election_key = election_key
+        self.coordinator_lease_s = float(coordinator_lease_s)
+        self.standby_poll_s = float(standby_poll_s)
+        self.standby_max_wait_s = (float(standby_max_wait_s)
+                                   if standby_max_wait_s is not None
+                                   else None)
+        self.is_coordinator = self.supervisor_id is None
+        self.term = 0
+        self.elections_total = 0
         supervisor_kw.setdefault("terminal_rcs", (RC_POD_UNRECOVERABLE,))
         super().__init__(self._pod_round, monitor=monitor, **supervisor_kw)
 
@@ -321,7 +381,66 @@ class PodSupervisor(Supervisor):
         dead = set(dead_set(self.store))
         return [h for h in self.all_hosts if h not in dead]
 
+    # ------------------------------------------------------------- election
+
+    def renew_coordinator(self) -> bool:
+        """Renew (or re-confirm) this supervisor's coordinator lease.
+        Long training rounds call this from their step loop so the lease
+        never lapses under a healthy driver; ``False`` means a standby
+        deposed us — the caller must stop driving the round (the deposer
+        adopted the store state and is re-driving).  Always ``True`` when
+        elections are disabled (``supervisor_id=None``)."""
+        if self.supervisor_id is None:
+            return True
+        lease = elect_coordinator(self.store, self.supervisor_id,
+                                  self.coordinator_lease_s,
+                                  key=self.election_key)
+        self.is_coordinator = lease is not None
+        if lease is not None:
+            self.term = lease.term
+        return lease is not None
+
+    def _await_leadership(self) -> None:
+        """Block until this supervisor holds the coordinator lease: the
+        standby loop.  Exactly one of N racing candidates wins each term
+        (the election CAS); a winner that TAKES OVER a lapsed term adopts
+        the store's current pod generation and dead-host set — both are
+        re-read from the store every round anyway, so adoption is just
+        logging what the next round will naturally see."""
+        if self.supervisor_id is None:
+            return
+        deadline = (time.monotonic() + self.standby_max_wait_s
+                    if self.standby_max_wait_s is not None else None)
+        while True:
+            lease = elect_coordinator(self.store, self.supervisor_id,
+                                      self.coordinator_lease_s,
+                                      key=self.election_key)
+            if lease is not None:
+                if lease.term != self.term or not self.is_coordinator:
+                    self.elections_total += 1
+                    gen = read_generation(self.store)
+                    dead = dead_set(self.store)
+                    with trace_span("pod.election",
+                                    supervisor=self.supervisor_id,
+                                    term=lease.term):
+                        log_dist(
+                            f"pod supervisor {self.supervisor_id!r} leads "
+                            f"term {lease.term} (adopting pod generation "
+                            f"{gen}, {len(dead)} dead-host marker(s))",
+                            ranks=[0])
+                self.is_coordinator = True
+                self.term = lease.term
+                return
+            self.is_coordinator = False
+            if deadline is not None and time.monotonic() >= deadline:
+                raise SupervisorStandDown(
+                    f"pod supervisor {self.supervisor_id!r} stood by "
+                    f"{self.standby_max_wait_s:.1f}s without the leader's "
+                    "lease lapsing — the pod has a healthy driver")
+            time.sleep(self.standby_poll_s)
+
     def _pod_round(self, _restarts: int) -> int:
+        self._await_leadership()
         healthy = self.healthy_hosts()
         try:
             members, plan = shrink_to_healthy(
@@ -348,6 +467,7 @@ class PodSupervisor(Supervisor):
                 ("pod/generation", float(gen), gen),
                 ("pod/round_hosts", float(len(members)), gen),
                 ("pod/dead_hosts",
-                 float(len(self.all_hosts) - len(healthy)), gen)])
+                 float(len(self.all_hosts) - len(healthy)), gen),
+                ("pod/coordinator_term", float(self.term), gen)])
         with trace_span("pod.round", generation=gen, hosts=len(members)):
             return self.pod_attempt(rnd)

@@ -41,6 +41,20 @@ RC_COMPLETE = 0          # training finished
 RC_INTERRUPT = 130       # operator ^C through the launcher
 
 
+class SupervisorStandDown(Exception):
+    """An attempt determined this supervisor should stop cleanly WITHOUT
+    consuming restart budget or backoff: it is not the driver and never
+    will be within its wait bound (e.g. an elected standby pod supervisor
+    whose leader stayed healthy past ``standby_max_wait_s``).  ``rc`` is
+    what :meth:`Supervisor.run` returns — standing down is not a failed
+    round, so the default is success."""
+
+    def __init__(self, reason: str, rc: int = RC_COMPLETE):
+        super().__init__(reason)
+        self.reason = reason
+        self.rc = int(rc)
+
+
 class Supervisor:
     """Relaunch loop around a launch attempt.
 
@@ -109,6 +123,12 @@ class Supervisor:
                 rc = self.attempt(restarts)
             except KeyboardInterrupt:
                 raise
+            except SupervisorStandDown as e:
+                # not a failed round: another supervisor is (and stays) the
+                # driver — exit without burning budget or backoff
+                self.diagnosis = f"stand-down: {e.reason}"
+                logger.info("elastic supervisor: %s", self.diagnosis)
+                return e.rc
             except Exception as e:
                 # a transient discovery failure (e.g. pod metadata absent
                 # WHILE the preempted slice is being recreated) must consume

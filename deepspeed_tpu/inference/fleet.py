@@ -24,12 +24,25 @@ training pods — by leaning on the :class:`~..elasticity.coordination
   ``"shed"`` result, journals every assignment under ``fleet/requests/``
   (prompt + budget + arrival epoch — everything failover needs), and scans
   member leases every round.  A lapsed lease (or a dead marker) fails the
-  engine's queued AND in-flight requests over to survivors: re-prefill from
-  the ORIGINAL prompt — the "drop refcount, re-prefill" contract of
-  docs/SERVING.md, which greedy decoding makes token-exact — with
+  engine's queued AND in-flight requests over to survivors with
   ``arrival_epoch_s`` preserved so TTFT, queued-age gauges and remaining
   deadline budgets stay anchored to the TRUE arrival, never the failover
   instant.  Failed-over results carry ``RequestResult.failovers``.
+- **Token journaling / mid-stream resume** — every ``journal_every_k``
+  router rounds the coordinator CAS-appends each in-flight stream's tokens
+  generated so far into its ``fleet/requests`` entry (size-capped at
+  ``max_journal_tokens`` tokens; the CAS makes an append racing a standby
+  takeover lose cleanly instead of clobbering the successor's journal).
+  Failover re-prefills ``prompt + journaled_tokens`` on a survivor as pure
+  KV reconstruction and **resumes decoding after the last journaled
+  token** — no journaled token is ever re-decoded or re-emitted, at most
+  the un-flushed tail (< K ticks of decode) is re-decoded, and a journal
+  that already holds the whole stream (eos hit / budget spent)
+  short-circuits straight to a terminal result with no decode at all.
+  Resumed results carry ``RequestResult.resumed_tokens``; with nothing
+  journaled the failover falls back to the PR 7 contract (re-prefill from
+  the ORIGINAL prompt — the "drop refcount, re-prefill" contract of
+  docs/SERVING.md; greedy decoding makes it token-exact either way).
 - **Coordinator failover** — a standby router polls the same election; when
   the leader's lease lapses it takes the next term, bumps the fleet
   generation (a CAS loop — exactly one bump even if a deposed leader
@@ -58,7 +71,7 @@ from __future__ import annotations
 import bisect
 import dataclasses
 import hashlib
-import os
+import json
 import re
 import time
 from typing import Any, Dict, List, Optional
@@ -67,7 +80,8 @@ import numpy as np
 
 from ..elasticity.coordination import (CoordinationStore, beat,
                                        bump_generation, dead_set,
-                                       elect_coordinator, lease_table,
+                                       dedup_drop_totals, elect_coordinator,
+                                       lease_table, process_src,
                                        read_generation, record_dead)
 from ..observability.trace import get_tracer, trace_span
 from ..utils.logging import log_dist, logger
@@ -109,6 +123,15 @@ def _rid_key(rid: Any) -> str:
         safe = re.sub(r"[^A-Za-z0-9_-]", "_", safe[:64])
         safe = f"{safe}-{hashlib.sha1(raw.encode()).hexdigest()[:10]}"
     return safe
+
+
+def _doc_bytes(doc: Dict[str, Any]) -> int:
+    """Serialized size of a journal document — feeds the
+    ``fleet/journal_bytes`` gauge without re-reading the store."""
+    try:
+        return len(json.dumps(doc))
+    except (TypeError, ValueError):   # pragma: no cover - defensive
+        return 0
 
 
 class FleetMember:
@@ -171,6 +194,15 @@ class FleetMember:
             return []   # a dead process's unclaimed results are gone
         return self.sup.take_results()
 
+    def stream_progress(self) -> Dict[Any, List[int]]:
+        """rid -> tokens generated so far on THIS member (across its
+        warm-restart incarnations) — what the router's token journal
+        flushes.  A dead member reports nothing: its host-side state is
+        unreachable, which is exactly why the journal exists."""
+        if not self.alive:
+            return {}
+        return self.sup.inflight_progress()
+
     # ------------------------------------------------- lease + advertisement
 
     def advertisement(self) -> Dict[str, Any]:
@@ -180,6 +212,7 @@ class FleetMember:
         rolls them up fleet-wide)."""
         h = self.sup.health()
         mon = self.sup.monitor
+        src = process_src()
         return {
             "engine_id": self.engine_id,
             "generation": int(self.generation),
@@ -201,9 +234,9 @@ class FleetMember:
             # members may share a monitor, so a rollup summing N identical
             # advertisements would overcount N-fold without them.
             "flight_dropped": int(get_tracer().recorder.dropped),
-            "flight_src": f"{os.getpid()}",
+            "flight_src": src,
             "monitor_dropped": int(getattr(mon, "dropped_events", 0) or 0),
-            "monitor_src": f"{os.getpid()}.{id(mon)}",
+            "monitor_src": f"{src}.{id(mon)}",
             "last_restart_cause": h["last_restart_cause"],
         }
 
@@ -301,7 +334,9 @@ class FleetRouter:
                  lease_s: float = 5.0, miss_limit: int = 3,
                  max_fleet_queue: Optional[int] = None, monitor=None,
                  election_key: str = FLEET_COORDINATOR_KEY,
-                 generation_key: str = FLEET_GENERATION_KEY):
+                 generation_key: str = FLEET_GENERATION_KEY,
+                 journal_every_k: Optional[int] = 8,
+                 max_journal_tokens: int = 4096):
         self.store = store
         self.members: Dict[str, FleetMember] = {}
         for m in members:
@@ -329,6 +364,31 @@ class FleetRouter:
         self._requests: Dict[Any, Request] = {}   # rid -> ORIGINAL request
         self._owner: Dict[Any, str] = {}          # rid -> engine_id
         self._failed_over: Dict[Any, int] = {}
+        # ---- token journaling (mid-stream durability).  journal_every_k:
+        # router rounds between token flushes (None disables mid-stream
+        # appends — the PR 7 assignment-only journal); max_journal_tokens
+        # caps the per-request token list so one very long stream cannot
+        # grow its store document unboundedly (the tail past the cap is
+        # re-decoded on failover — bounded, documented loss).
+        self.journal_every_k = (int(journal_every_k)
+                                if journal_every_k is not None else None)
+        if self.journal_every_k is not None and self.journal_every_k < 1:
+            raise ValueError(
+                f"journal_every_k={self.journal_every_k} must be >= 1")
+        self.max_journal_tokens = int(max_journal_tokens)
+        if self.max_journal_tokens < 0:
+            raise ValueError(
+                f"max_journal_tokens={self.max_journal_tokens} must be >= 0")
+        # rid -> tokens RESUMED from the journal at the last failover: they
+        # are baked into the live assignment's prompt (KV reconstruction),
+        # so collected outputs are stitched back behind them
+        self._resumed: Dict[Any, List[int]] = {}
+        # rid -> the journal document as last written/read by THIS router:
+        # the CAS `expected` for the next append, and the byte-accounting
+        # source for the fleet/journal_bytes gauge
+        self._journal_docs: Dict[Any, Dict[str, Any]] = {}
+        self._journal_sizes: Dict[Any, int] = {}
+        self.resumed_tokens_total = 0
         self._failed_engines: set = set()
         self._last_scan_t: Optional[float] = None   # store clock
         self._lead_since: Optional[float] = None    # store clock, takeover
@@ -381,7 +441,7 @@ class FleetRouter:
             # dispatched) — a future arrival must survive coordinator
             # death like any dispatched request, or the standby would
             # adopt an empty journal and silently drop it
-            self._journal(rid, request, None)
+            self._journal(rid, request, None, create=True)
             bisect.insort(self._later, request, key=lambda r: r.arrival_time)
             return rid
         self._route(request)
@@ -435,17 +495,40 @@ class FleetRouter:
             self._shed(request, "no live engines")
             return
         member = self.members[target]
+        resumed = self._resumed.get(rid) or []
+        sub_ids = request.input_ids
+        if resumed:
+            # mid-stream resume: the journaled tokens ride the PROMPT (pure
+            # KV reconstruction — the prefill recomputes their K/V, emits
+            # nothing) and the new-token budget shrinks by exactly the
+            # resumed count, so decoding continues AFTER the last journaled
+            # token and no journaled token is ever re-emitted
+            sub_ids = np.concatenate(
+                [np.asarray(request.input_ids, np.int32),
+                 np.asarray(resumed, np.int32)])
         sub = dataclasses.replace(
             request,
+            input_ids=sub_ids,
+            max_new_tokens=request.max_new_tokens - len(resumed),
             # engine-relative arrival: "now" on the target's clock, so its
             # deadline/queued-age math starts at dispatch while the epoch
             # stamp keeps reporting anchored to the true arrival
             arrival_time=max(0.0,
                              time.monotonic() - member.sup.engine._t0),
             deadline_s=self._remaining_deadline(request))
+        # journal BEFORE dispatch: a failover/redistribution write that
+        # loses its CAS means a successor coordinator owns this request —
+        # submitting it here anyway would re-serve a stream the successor
+        # is already completing (duplicate terminal result).  Only a
+        # non-requeue dispatch (fresh submission / adopted parked arrival)
+        # may CREATE the journal entry.
+        if not self._journal(rid, request, target, create=not requeue):
+            logger.warning(
+                "fleet: skipping dispatch of %r — journal ownership lost "
+                "to a successor coordinator, which now drives it", rid)
+            return
         member.submit(sub)
         self._owner[rid] = target
-        self._journal(rid, request, target)
 
     def _shed(self, request: Request, why: str) -> None:
         t = time.monotonic()
@@ -464,19 +547,34 @@ class FleetRouter:
         # a shed request may have been journaled at submit (future
         # arrival): its terminal result is decided here, so the journal
         # entry must not outlive it (delete is idempotent)
-        self.store.delete(f"{FLEET_REQUESTS_PREFIX}/{_rid_key(rid)}")
+        self._journal_delete(rid)
         self.shed_total += 1
         logger.warning("fleet: shed request %r (%s); retry_after=%.3fs",
                        rid, why, hint)
 
     def _journal(self, rid: Any, request: Request,
-                 engine_id: Optional[str]) -> None:
+                 engine_id: Optional[str], create: bool = False) -> bool:
         """Durable assignment record: everything a SUCCESSOR coordinator
-        needs to re-own (and, if the engine dies, re-prefill) the request.
-        ``engine_id=None`` = accepted but not yet dispatched (a future
-        arrival parked at the router).  Deleted when the result is
-        collected (or the request is shed)."""
-        self.store.put(f"{FLEET_REQUESTS_PREFIX}/{_rid_key(rid)}", {
+        needs to re-own (and, if the engine dies, resume or re-prefill)
+        the request.  ``engine_id=None`` = accepted but not yet dispatched
+        (a future arrival parked at the router).  ``tokens`` holds the
+        journaled stream so far (grown by :meth:`_flush_token_journal`);
+        ``resumed`` counts how many of them are baked into the CURRENT
+        assignment's prompt, so a successor can stitch collected outputs
+        without having watched the dispatch.  Deleted when the result is
+        collected (or the request is shed).
+
+        The write is a compare-and-swap against this router's mirror of
+        the entry (``None`` = creating a fresh submission), NOT a blind
+        put: a deposed leader stalled mid-step can reach here after its
+        successor already collected the result and GC'd the entry, and a
+        put would resurrect the finished request for the next takeover to
+        re-serve.  Losing the CAS means we are no longer the journal's
+        owner — drop the mirror and stand down on this entry.  Returns
+        whether OUR document landed (False = ownership lost; the caller
+        must not dispatch the request either)."""
+        resumed = self._resumed.get(rid) or []
+        doc = {
             "rid": rid,
             "engine": engine_id,
             "input_ids": [int(x) for x in request.input_ids],
@@ -486,7 +584,141 @@ class FleetRouter:
             "deadline_s": request.deadline_s,
             "arrival_epoch_s": request.arrival_epoch_s,
             "failovers": self._failed_over.get(rid, 0),
-            "t": self.store.now()})
+            "tokens": [int(t) for t in resumed],
+            "resumed": len(resumed),
+            "t": self.store.now()}
+        key = f"{FLEET_REQUESTS_PREFIX}/{_rid_key(rid)}"
+        expected = self._journal_docs.get(rid)
+        if expected is None and create:
+            # SUBMISSION-time write of a rid this router just accepted
+            # from the caller: no successor can know it, so a pre-existing
+            # document can only be an orphan of a crashed previous run —
+            # adopting it (or giving up) would poison a later resume with
+            # a foreign stream's tokens or leave an accepted request
+            # un-journaled (flush never creates).  Retry the create
+            # against each freshly read value until our document lands
+            # (same loop shape as bump_generation; contention here can
+            # only be the dying orphan writer's last flushes).
+            while True:
+                cur = self.store.get(key)
+                if self.store.compare_and_swap(key, cur, doc):
+                    if cur is not None:
+                        logger.warning(
+                            "fleet: journal entry for %r was an orphan of "
+                            "a previous run; overwritten with the fresh "
+                            "submission", rid)
+                    self._journal_docs[rid] = doc
+                    self._journal_sizes[rid] = _doc_bytes(doc)
+                    return True
+        if expected is None:
+            # DISPATCH-time write (failover/redistribution) with no
+            # mirror: this router lost journal ownership earlier (a lost
+            # CAS dropped the mirror).  Writing anything here would either
+            # resurrect a GC'd entry (key absent) or clobber a successor's
+            # appends (key rewritten) — the exact fence the create path is
+            # scoped to preserve.  Re-sync the mirror and stand down.
+            cur = self.store.get(key)
+        elif self.store.compare_and_swap(key, expected, doc):
+            self._journal_docs[rid] = doc
+            self._journal_sizes[rid] = _doc_bytes(doc)
+            return True
+        else:
+            # stale mirror: this router journaled the rid before and lost
+            # ownership mid-stream — re-sync to whatever the successor
+            # left, or forget a GC'd entry entirely
+            cur = self.store.get(key)
+        if cur is None:
+            self._journal_docs.pop(rid, None)
+            self._journal_sizes.pop(rid, None)
+        else:
+            self._journal_docs[rid] = cur
+            self._journal_sizes[rid] = _doc_bytes(cur)
+        logger.warning(
+            "fleet: journal write for %r lost its CAS (a successor "
+            "coordinator owns the entry now); standing down on it", rid)
+        return False
+
+    def _journal_delete(self, rid: Any) -> None:
+        """GC one journal entry (idempotent): the store document AND this
+        router's mirrors — runs for every terminal result, including ones
+        collected by a freshly elected standby that never dispatched the
+        request.
+
+        Known residual window (documented, not guarded): a leader that
+        confirms its lease at the top of step(), then stalls past the
+        election lease MID-step, can reach this delete after a successor
+        adopted the entry.  The store API has no compare-and-delete, so
+        the delete cannot be fenced the way the CAS'd writes are — but in
+        that scenario the deposed router also CLAIMED the result from the
+        (in-process) member, so keeping the entry would only make the
+        successor re-serve a request whose result was already returned.
+        The window is one stalled step; the deposed router discovers its
+        deposition at the next election poll and stops collecting."""
+        self.store.delete(f"{FLEET_REQUESTS_PREFIX}/{_rid_key(rid)}")
+        self._journal_docs.pop(rid, None)
+        self._journal_sizes.pop(rid, None)
+        self._resumed.pop(rid, None)
+
+    def journal_bytes(self) -> int:
+        """Approximate bytes of journal entries this coordinator currently
+        maintains on the store (serialized-document sizes; the
+        ``fleet/journal_bytes`` gauge)."""
+        return sum(self._journal_sizes.values())
+
+    def _journaled_tokens(self, rid: Any) -> List[int]:
+        """The durably journaled stream for ``rid`` — the router's mirror,
+        falling back to a store read for an entry adopted but never
+        re-written by this router."""
+        doc = self._journal_docs.get(rid)
+        if doc is None:
+            doc = self.store.get(f"{FLEET_REQUESTS_PREFIX}/{_rid_key(rid)}")
+        return [int(t) for t in (doc or {}).get("tokens") or []]
+
+    def _flush_token_journal(self) -> None:
+        """Batched token append: fold every live member's in-flight stream
+        progress into the journal.  Each append is ONE compare-and-swap
+        against the document this router last saw — a takeover mid-append
+        is safe: the successor rewrote the document, our stale ``expected``
+        loses, and we drop the mirror so the next flush re-reads instead of
+        fighting.  Appends never CREATE an entry (a missing document means
+        the request was collected or shed — recreating it would resurrect
+        a finished request on the next takeover)."""
+        for eid in sorted(self.members):
+            m = self.members[eid]
+            if not m.alive:
+                continue
+            for rid, toks in m.stream_progress().items():
+                if rid not in self._requests:
+                    continue   # already terminal (unclaimed result)
+                base = self._resumed.get(rid) or []
+                total = ([int(t) for t in base] + [int(t) for t in toks])
+                total = total[:self.max_journal_tokens]
+                key = f"{FLEET_REQUESTS_PREFIX}/{_rid_key(rid)}"
+                cur = self._journal_docs.get(rid)
+                if cur is None:
+                    cur = self.store.get(key)
+                    if cur is None:
+                        continue   # collected/shed elsewhere: never recreate
+                    # re-cache what we just read: without this, an entry
+                    # whose mirror was dropped (lost CAS) pays a store read
+                    # EVERY flush for the rest of its stream, and falls out
+                    # of the journal_bytes gauge while still on the store
+                    self._journal_docs[rid] = cur
+                    self._journal_sizes[rid] = _doc_bytes(cur)
+                if len(cur.get("tokens") or ()) >= len(total):
+                    continue       # nothing new to make durable
+                new = dict(cur)
+                new["tokens"] = total
+                new["resumed"] = len(base)
+                new["t"] = self.store.now()
+                if self.store.compare_and_swap(key, cur, new):
+                    self._journal_docs[rid] = new
+                    self._journal_sizes[rid] = _doc_bytes(new)
+                else:
+                    # a successor (or concurrent writer) owns the entry
+                    # now; stand down on this rid until we re-read it
+                    self._journal_docs.pop(rid, None)
+                    self._journal_sizes.pop(rid, None)
 
     # ------------------------------------------------------------- the loop
 
@@ -530,6 +762,11 @@ class FleetRouter:
                     # router-visible form of this death
                     pass
                 self._collect(m)
+            if self.journal_every_k is not None \
+                    and self._tick % self.journal_every_k == 0:
+                # flush BEFORE the lease scan: tokens decoded this round go
+                # durable before any failover decision can need them
+                self._flush_token_journal()
             self._scan_leases()
             self._write_gauges()
         return self.outstanding()
@@ -580,16 +817,35 @@ class FleetRouter:
         for res in member.take_results():
             rid = res.rid
             fo = self._failed_over.pop(rid, 0)
+            resumed = self._resumed.get(rid) or []
+            if resumed:
+                # the member served prompt+resumed and its output is the
+                # continuation: stitch the caller-facing result back to the
+                # ORIGINAL request's frame.  Resumed tokens were journaled
+                # decode output, never re-emitted — they are prepended, not
+                # counted as this engine's decode ticks.
+                orig = self._requests.get(rid)
+                res = dataclasses.replace(
+                    res,
+                    input_ids=(orig.input_ids if orig is not None
+                               else res.input_ids[:len(res.input_ids)
+                                                  - len(resumed)]),
+                    output_ids=np.concatenate(
+                        [np.asarray(resumed, np.int32), res.output_ids]),
+                    resumed_tokens=len(resumed))
             if fo:
                 res = dataclasses.replace(res, failovers=fo)
             self._results[rid] = res
             self._order.append(rid)
             self._owner.pop(rid, None)
             self._requests.pop(rid, None)
+            # per-engine credit counts tokens THIS engine decoded: resumed
+            # tokens were decoded by the dead engine and merely re-prefilled
+            # here (resumed_tokens_total tracks them fleet-wide)
             self.tokens_by_engine[member.engine_id] = (
                 self.tokens_by_engine.get(member.engine_id, 0)
-                + len(res.output_ids))
-            self.store.delete(f"{FLEET_REQUESTS_PREFIX}/{_rid_key(rid)}")
+                + len(res.output_ids) - res.resumed_tokens)
+            self._journal_delete(rid)
 
     # ------------------------------------------------------------- failover
 
@@ -652,14 +908,68 @@ class FleetRouter:
             self._owner.pop(rid)
             self.failovers_total += 1
             self._failed_over[rid] = self._failed_over.get(rid, 0) + 1
+            journaled = self._journaled_tokens(rid)
             with trace_span("fleet.failover", rid=rid,
-                            from_engine=engine_id):
-                # re-prefill from the ORIGINAL prompt on a survivor: the
-                # dead engine's KV pages (and any partial tokens) are gone
-                # with its process — greedy decode makes the re-served
-                # output token-exact, and the preserved epoch keeps
-                # deadline/TTFT accounting honest
+                            from_engine=engine_id,
+                            journaled=len(journaled)):
+                # the dead engine's KV pages are gone with its process, but
+                # journaled tokens are DURABLE decode output: resume the
+                # stream after the last journaled token (prompt+journaled
+                # re-prefilled as pure KV reconstruction) instead of
+                # re-decoding it.  Only the un-flushed tail (< K ticks) is
+                # re-decoded; with nothing journaled this is the PR 7
+                # re-prefill-from-original-prompt path.  Greedy decode
+                # keeps either path token-exact, and the preserved epoch
+                # keeps deadline/TTFT accounting honest.
+                if journaled:
+                    self._seed_resumed(rid, journaled)
+                    if self._maybe_finish_from_journal(rid, req, journaled):
+                        continue
                 self._route(req, requeue=True)
+
+    def _seed_resumed(self, rid: Any, journaled: List[int]) -> None:
+        """Adopt ``journaled`` as the rid's resume state.  The counter
+        advances by the NEWLY-durable tokens only — a request failing over
+        twice resumes the same prefix twice but those tokens were saved
+        from re-decode once, and the gauge exists to measure exactly that
+        saving."""
+        have = len(self._resumed.get(rid) or [])
+        if len(journaled) > have:
+            self.resumed_tokens_total += len(journaled) - have
+            self._resumed[rid] = journaled
+
+    def _maybe_finish_from_journal(self, rid: Any, req: Request,
+                                   journaled: List[int]) -> bool:
+        """When the journal already holds the WHOLE stream (the engine
+        finished between its last flush and its death, the result
+        unclaimed), short-circuit to a terminal result — zero decode
+        work.  Returns whether the request was finished."""
+        done_eos = (req.eos_token_id is not None and journaled
+                    and journaled[-1] == req.eos_token_id)
+        if not journaled or not (done_eos
+                                 or len(journaled) >= req.max_new_tokens):
+            return False
+        self._finish_from_journal(rid, req, journaled,
+                                  "eos" if done_eos else "length")
+        return True
+
+    def _finish_from_journal(self, rid: Any, req: Request,
+                             journaled: List[int], reason: str) -> None:
+        t = time.monotonic()
+        self._results[rid] = RequestResult(
+            rid=rid, input_ids=req.input_ids,
+            output_ids=np.asarray(journaled, np.int32),
+            finish_reason=reason, prefill_bucket=0,
+            arrival_s=req.arrival_epoch_s or t, admit_s=t,
+            first_token_s=t, finish_s=t,
+            resumed_tokens=len(journaled),
+            failovers=self._failed_over.pop(rid, 0))
+        self._order.append(rid)
+        self._requests.pop(rid, None)
+        self._journal_delete(rid)
+        logger.info("fleet: request %r finished straight from the journal "
+                    "(%d token(s), %s) — its engine died with the stream "
+                    "already complete", rid, len(journaled), reason)
 
     # ----------------------------------------------------- coordinator side
 
@@ -683,7 +993,27 @@ class FleetRouter:
                 if rec is None:
                     continue
                 rid = rec["rid"]
-                if rid in self._requests or rid in self._results:
+                if rid in self._results:
+                    continue   # terminal here; the caller will claim it
+                if rid in self._requests:
+                    # deposed-and-RE-elected: a successor may have failed
+                    # this rid over while we were stalled — rewriting its
+                    # tokens/resumed/engine.  Re-sync every mirror to the
+                    # store's truth, or collect-time stitching would use
+                    # our stale pre-deposition state (e.g. dropping the
+                    # successor's resumed prefix from the output).
+                    self._journal_docs[rid] = rec
+                    self._journal_sizes[rid] = _doc_bytes(rec)
+                    if rec.get("resumed"):
+                        self._resumed[rid] = [
+                            int(t) for t in
+                            (rec.get("tokens") or [])[:int(rec["resumed"])]]
+                    else:
+                        self._resumed.pop(rid, None)
+                    if rec.get("failovers"):
+                        self._failed_over[rid] = int(rec["failovers"])
+                    if rec["engine"] is not None:
+                        self._owner[rid] = rec["engine"]
                     continue
                 req = Request(
                     rid=rid,
@@ -695,6 +1025,17 @@ class FleetRouter:
                 self._requests[rid] = req
                 if rec.get("failovers"):
                     self._failed_over[rid] = int(rec["failovers"])
+                # adopt the token-journal state: the document is the CAS
+                # base for this router's future appends, and `resumed`
+                # tokens are baked into the LIVE assignment's prompt — the
+                # successor must stitch collected outputs exactly as the
+                # dispatching router would have
+                self._journal_docs[rid] = rec
+                self._journal_sizes[rid] = _doc_bytes(rec)
+                if rec.get("resumed"):
+                    self._resumed[rid] = [
+                        int(t) for t in
+                        (rec.get("tokens") or [])[:int(rec["resumed"])]]
                 if rec["engine"] is None:
                     # accepted but never dispatched (a future arrival
                     # parked at the dead coordinator): keep the remaining
@@ -760,6 +1101,18 @@ class FleetRouter:
                 for req in unserved:
                     orig = self._requests.get(req.rid, req)
                     self._owner.pop(req.rid, None)
+                    # a handed-back request can carry journaled progress
+                    # its drained engine never re-admitted (a warm-restart
+                    # replay still queued when admission closed): seed the
+                    # resume state from the journal, exactly as failover
+                    # does, so the target continues after the last
+                    # journaled token instead of re-decoding it
+                    self._seed_resumed(req.rid,
+                                       self._journaled_tokens(req.rid))
+                    res_toks = self._resumed.get(req.rid) or []
+                    if self._maybe_finish_from_journal(req.rid, orig,
+                                                       res_toks):
+                        continue   # defensive: should have been collected
                     self._route(orig, requeue=True)
             m.beat(force=True)   # advertise the FRESH engine immediately
             self.rolling_restarts_total += 1
@@ -791,6 +1144,9 @@ class FleetRouter:
             "shed_total": self.shed_total,
             "elections_total": self.elections_total,
             "rolling_restarts_total": self.rolling_restarts_total,
+            "resumed_tokens_total": self.resumed_tokens_total,
+            "journal_entries": len(self._journal_sizes),
+            "journal_bytes": self.journal_bytes(),
             "tokens_by_engine": dict(self.tokens_by_engine),
             "engines": ads,
         }
@@ -802,8 +1158,9 @@ class FleetRouter:
         # drop counters are per SOURCE (process ring / monitor object), not
         # per member: members sharing a source advertise the same value and
         # must be counted once, or an in-process fleet overcounts N-fold
-        flight_by_src: Dict[str, int] = {}
-        monitor_by_src: Dict[str, int] = {}
+        # (dedup_drop_totals is the one shared fold — the pod watchdog
+        # rollup uses the same implementation)
+        ads: Dict[str, Dict[str, Any]] = {}
         for eid, m in self.members.items():
             # the beat this same round stashed what it wrote; fall back to
             # the store only for a member this router never beat (e.g.
@@ -811,12 +1168,8 @@ class FleetRouter:
             ad = (m.last_advert if m.last_advert is not None
                   else self.store.get(f"{FLEET_ENGINES_PREFIX}/{eid}"))
             if ad is not None:
-                flight_by_src[str(ad.get("flight_src", eid))] = \
-                    int(ad.get("flight_dropped", 0))
-                monitor_by_src[str(ad.get("monitor_src", eid))] = \
-                    int(ad.get("monitor_dropped", 0))
-        flight = sum(flight_by_src.values())
-        monitor_drops = sum(monitor_by_src.values())
+                ads[eid] = ad
+        flight, monitor_drops = dedup_drop_totals(ads)
         self.monitor.write_events([
             ("fleet/engines_live", float(live), self._tick),
             ("fleet/queue_depth", float(self.fleet_queue_depth()),
@@ -832,5 +1185,9 @@ class FleetRouter:
             ("fleet/generation", float(self.generation), self._tick),
             ("fleet/flight_dropped_total", float(flight), self._tick),
             ("fleet/monitor_dropped_total", float(monitor_drops),
+             self._tick),
+            ("fleet/journal_bytes", float(self.journal_bytes()),
+             self._tick),
+            ("fleet/resumed_tokens_total", float(self.resumed_tokens_total),
              self._tick),
         ])

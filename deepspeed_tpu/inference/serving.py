@@ -190,9 +190,17 @@ class RequestResult:
     # times a fleet router re-routed this request to a surviving engine
     # after its assigned engine's lease lapsed (inference/fleet.py) —
     # distinct from `replays`, which counts SAME-engine warm-restart
-    # re-prefills: a failover re-prefills from the ORIGINAL prompt on a
-    # different engine, so no partial tokens are stitched.
+    # re-prefills: a failover re-prefills the journaled stream (or, with
+    # no journal, the ORIGINAL prompt) on a different engine.
     failovers: int = 0
+    # tokens of this output that were RESUMED from the fleet token journal
+    # after a failover rather than decoded by the engine that finished the
+    # request: the replacement re-prefilled prompt + journaled tokens as
+    # pure KV reconstruction and resumed decoding AFTER the last journaled
+    # token, so these tokens were never re-emitted (inference/fleet.py).
+    # They contribute no decode_ticks (decode_ticks counts the finishing
+    # stream's own decode-program invocations).  0 = no mid-stream resume.
+    resumed_tokens: int = 0
 
     @property
     def ttft_s(self) -> float:

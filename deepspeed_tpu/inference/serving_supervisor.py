@@ -280,6 +280,23 @@ class ServingSupervisor:
         order, self._order = self._order, []
         return [self._collected.pop(rid) for rid in order]
 
+    def inflight_progress(self) -> Dict[Any, List[int]]:
+        """rid -> every token generated so far (across incarnations) for
+        each request this supervisor still owes a terminal result: tokens
+        carried from dead incarnations (``_prefix``) plus the live slot's
+        own tokens.  Queued in-flight-origin replays report their carried
+        tokens alone.  This is the host-side stream state a fleet router
+        journals (``inference/fleet.py``) so a REPLACEMENT engine can
+        re-prefill ``prompt + journaled`` and resume decoding after the
+        last durable token instead of re-decoding the whole stream."""
+        out: Dict[Any, List[int]] = {rid: [int(t) for t in toks]
+                                     for rid, toks in self._prefix.items()}
+        for st in self.engine._slots:
+            if st is not None:
+                rid = st.request.rid
+                out[rid] = out.get(rid, []) + [int(t) for t in st.tokens]
+        return out
+
     def health(self) -> Dict[str, Any]:
         """Engine health snapshot plus supervisor restart counters.  The
         ``*_total`` counters are cumulative across restarts (a fresh engine

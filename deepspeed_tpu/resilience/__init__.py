@@ -45,6 +45,7 @@ from .integrity import (  # noqa: F401
     build_manifest,
     candidate_tags,
     commit_pod_manifest,
+    host_payload_files,
     pod_checkpoint_progress_fn,
     pod_committed,
     quarantine_tag,

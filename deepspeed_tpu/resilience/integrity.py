@@ -26,6 +26,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import re
 import time
 from typing import Dict, List, Optional
 
@@ -245,6 +246,40 @@ def candidate_tags(save_dir: str) -> List[str]:
 # (some host never reported) and must never be restored from — the
 # pod-aware restore walk quarantines it and falls back a generation, the
 # same contract verify_checkpoint_dir enforces per host.
+
+# path-component shapes that attribute a payload file to one process of a
+# multi-host save: orbax OCDBT's `ocdbt.process_<k>`, plus `process_<k>` /
+# `process<k>` variants other layouts use
+_PROCESS_COMPONENT = re.compile(r"(?:^|[._-])process[._-]?(\d+)(?:$|[._-])")
+
+
+def host_payload_files(ckpt_dir: str, process_index: int = 0) -> List[str]:
+    """The payload files (``state/``, ``offload_optimizer/``) attributable
+    to one process of a multi-host save — what that host's shard manifest
+    attests so :func:`verify_pod_checkpoint_dir` can detect a MISSING shard
+    file, not just a missing manifest.
+
+    Attribution: a path component naming a process (orbax OCDBT writes
+    ``ocdbt.process_<k>/``; other layouts use ``process_<k>`` or
+    ``process<k>``) assigns the file to that process; every file no
+    process component claims (single-process saves, shared metadata like
+    ``_METADATA``/zarray sidecars) is attested by process 0, so the union
+    over all processes covers the ENTIRE payload listing and any file lost
+    in transit fails the pod commit/restore verification.
+    """
+    mine: List[str] = []
+    for rel in sorted(_payload_listing(ckpt_dir)):
+        owner = None
+        for comp in rel.replace(os.sep, "/").split("/"):
+            m = _PROCESS_COMPONENT.search(comp)
+            if m is not None:
+                owner = int(m.group(1))
+                break
+        if owner == int(process_index) or (owner is None
+                                           and int(process_index) == 0):
+            mine.append(rel)
+    return mine
+
 
 def write_host_manifest(ckpt_dir: str, host_id: str, generation: int,
                         global_steps: int,

@@ -446,6 +446,267 @@ def test_fleet_coordinator_kill_election_converges(tiny_engine, reference,
     assert gens[-1] > gens[0]                             # takeover bumped
 
 
+# ------------------------------------------- token journaling (ISSUE 8)
+
+@pytest.mark.chaos
+def test_fleet_midstream_kill_resumes_after_last_journaled_token(
+        tiny_engine, reference, tmp_path):
+    """ISSUE 8 acceptance: with token journaling on, killing an engine
+    mid-stream makes the replacement re-prefill prompt + journaled tokens
+    and RESUME decoding after the last journaled token — outputs stay
+    token-exact vs the fault-free run (zero duplicated emissions, zero
+    lost tokens), results carry ``resumed_tokens``, and every journal
+    entry is GC'd once collected."""
+    reqs, ref = reference
+    clock = [0.0]
+    mon = InMemoryMonitor()
+    store = FileCoordinationStore(str(tmp_path / "coord"),
+                                  clock=lambda: clock[0])
+    members = [FleetMember(f"engine{i}",
+                           tiny_engine.supervised_serving(max_restarts=5,
+                                                          **SERVE_KW),
+                           store, lease_s=1.0)
+               for i in range(3)]
+    router = FleetRouter(store, members, lease_s=100.0, miss_limit=3,
+                         monitor=mon, journal_every_k=1)
+
+    def on_tick(r, rounds):
+        clock[0] += 1.0
+        if rounds == 3:                # several journal flushes have landed
+            r.members["engine0"].kill()
+
+    results = router.run(_copies(reqs), max_ticks=500, on_tick=on_tick)
+    by = {r.rid: r for r in results}
+    assert sorted(by) == sorted(r.rid for r in reqs)          # none lost
+    for rid, r in by.items():
+        assert r.finish_reason in ("eos", "length")
+        assert np.array_equal(r.output_ids, ref[rid]), rid    # no dup/loss
+    resumed = [r for r in by.values() if r.resumed_tokens > 0]
+    assert router.failovers_total > 0 and resumed
+    assert router.resumed_tokens_total >= sum(r.resumed_tokens
+                                              for r in resumed)
+    for r in resumed:
+        # the resumed prefix IS the journaled decode output: it must be a
+        # strict prefix of the fault-free stream, with the continuation
+        # decoded (not re-emitted) after it
+        assert r.failovers > 0
+        assert np.array_equal(r.output_ids[:r.resumed_tokens],
+                              ref[r.rid][:r.resumed_tokens])
+        assert r.resumed_tokens <= len(r.output_ids)
+    # journal GC: the stream is done, no entry may outlive its result
+    assert store.list("fleet/requests") == []
+    assert router.journal_bytes() == 0
+    h = router.health()
+    assert h["journal_entries"] == 0
+    assert h["resumed_tokens_total"] == router.resumed_tokens_total
+    names = {e[0] for e in mon.events_snapshot()}
+    assert {"fleet/journal_bytes", "fleet/resumed_tokens_total"} <= names
+
+
+def test_fleet_journal_cap_bounds_resume(tiny_engine, tmp_path):
+    """max_journal_tokens caps the per-request journal: the resume carries
+    at most the cap (the tail past it is re-decoded) and the output stays
+    token-exact."""
+    reqs = _stream(4, seed=11, new_choices=(8,))
+    serve = tiny_engine.serving(b_slots=3, page_size=8, max_model_len=64)
+    ref = {r.rid: r.output_ids for r in serve.run(_copies(reqs))}
+    clock = [0.0]
+    store = FileCoordinationStore(str(tmp_path / "coord"),
+                                  clock=lambda: clock[0])
+    members = [FleetMember(f"engine{i}",
+                           tiny_engine.supervised_serving(max_restarts=5,
+                                                          **SERVE_KW),
+                           store, lease_s=1.0)
+               for i in range(2)]
+    router = FleetRouter(store, members, lease_s=100.0, miss_limit=3,
+                         journal_every_k=1, max_journal_tokens=3)
+
+    def on_tick(r, rounds):
+        clock[0] += 1.0
+        if rounds == 5:                # > cap tokens decoded by now
+            r.members["engine0"].kill()
+
+    results = router.run(_copies(reqs), max_ticks=500, on_tick=on_tick)
+    by = {r.rid: r for r in results}
+    assert sorted(by) == sorted(r.rid for r in reqs)
+    for rid, r in by.items():
+        assert np.array_equal(r.output_ids, ref[rid]), rid
+        assert r.resumed_tokens <= 3                  # never past the cap
+    assert any(r.resumed_tokens for r in by.values())
+    # stored documents respected the cap too (mirror of the store bound)
+    assert store.list("fleet/requests") == []
+
+
+def test_fleet_finish_straight_from_journal(tiny_engine, tmp_path):
+    """A journal that already holds the complete stream (the engine died
+    between its last flush and collection) short-circuits failover to a
+    terminal result — zero decode work, nothing re-emitted."""
+    store, router = _fleet(tiny_engine, tmp_path, n=2)
+    req = Request(rid="done", input_ids=np.array([5, 6, 7], np.int32),
+                  max_new_tokens=3)
+    router.submit(Request(rid="done", input_ids=req.input_ids,
+                          max_new_tokens=3))
+    router.step()                                      # dispatched
+    ref = tiny_engine.serving(b_slots=2, page_size=8, max_model_len=64)
+    full = [int(t) for t in
+            ref.run([Request(rid="done", input_ids=req.input_ids,
+                             max_new_tokens=3)])[0].output_ids]
+    # simulate: the full stream was journaled, then the engine died before
+    # the router collected the result
+    owner = router._owner["done"]
+    key = "fleet/requests/sdone"
+    doc = dict(store.get(key))
+    doc["tokens"] = full
+    store.put(key, doc)
+    router._journal_docs["done"] = doc
+    router._failover(owner, "test kill")
+    (res,) = [r for r in router.take_results() if r.rid == "done"]
+    assert res.finish_reason == "length"
+    assert [int(t) for t in res.output_ids] == full
+    assert res.resumed_tokens == len(full) and res.failovers == 1
+    assert store.get(key) is None                      # GC'd
+    # drain the surviving member's copy of nothing: the fleet is idle
+    assert router.outstanding() == 0
+
+
+@pytest.mark.chaos
+def test_fleet_journal_gc_by_freshly_elected_standby(tiny_engine, tmp_path):
+    """The collection that deletes a journal entry may run on a router
+    that never dispatched the request: a standby that took over mid-stream
+    must GC adopted entries when it collects their results (the PR 7 gap
+    ISSUE 8 closes — only the assigning router's happy path was
+    exercised)."""
+    reqs = _stream(6, seed=13)
+    clock = [0.0]
+    store = FileCoordinationStore(str(tmp_path / "coord"),
+                                  clock=lambda: clock[0])
+    members = [FleetMember(f"engine{i}",
+                           tiny_engine.supervised_serving(max_restarts=5,
+                                                          **SERVE_KW),
+                           store, lease_s=100.0)
+               for i in range(2)]
+    router = FleetRouter(store, members, lease_s=5.0, journal_every_k=1)
+    standby = FleetRouter(store, members, router_id="router1",
+                          lease_s=5.0, journal_every_k=1)
+    for r in _copies(reqs):
+        router.submit(r)
+    for _ in range(2):
+        router.step()
+        clock[0] += 1.0
+    assert store.list("fleet/requests")        # journaled, streams live
+    router.kill()
+    clock[0] += 60.0
+    results = list(router.take_results()) + standby.run([], max_ticks=500)
+    assert sorted(r.rid for r in results) == sorted(r.rid for r in reqs)
+    assert standby.is_coordinator and standby.term == 2
+    # the standby adopted, collected, and GC'd — no entry survives
+    assert store.list("fleet/requests") == []
+    assert standby.journal_bytes() == 0
+
+
+def test_fleet_fresh_submit_overwrites_orphaned_journal_entry(
+        tiny_engine, tmp_path):
+    """A journal entry orphaned by a crashed PREVIOUS run (same store dir,
+    same rid) must not poison a fresh submission: no successor can know a
+    rid first submitted here, so the stale document is overwritten — a
+    failover then resumes the FRESH stream's tokens, never the orphan's."""
+    clock = [0.0]
+    store = FileCoordinationStore(str(tmp_path / "coord"),
+                                  clock=lambda: clock[0])
+    store.put("fleet/requests/i0", {
+        "rid": 0, "engine": "engine9", "input_ids": [9, 9, 9],
+        "max_new_tokens": 30, "eos_token_id": None, "deadline_s": None,
+        "arrival_epoch_s": 1.0, "failovers": 3,
+        "tokens": [7] * 30, "resumed": 0, "t": 0.0})
+    members = [FleetMember(f"engine{i}",
+                           tiny_engine.supervised_serving(max_restarts=5,
+                                                          **SERVE_KW),
+                           store, lease_s=1.0)
+               for i in range(2)]
+    router = FleetRouter(store, members, lease_s=100.0, miss_limit=3,
+                         journal_every_k=1)
+    req = Request(rid=0, input_ids=np.array([4, 5, 6], np.int32),
+                  max_new_tokens=6)
+    ref = tiny_engine.serving(b_slots=2, page_size=8, max_model_len=64).run(
+        [Request(rid=0, input_ids=req.input_ids, max_new_tokens=6)])
+    router.submit(req)
+    doc = store.get("fleet/requests/i0")
+    assert doc["input_ids"] == [4, 5, 6] and doc["failovers"] == 0  # healed
+
+    def on_tick(r, rounds):
+        clock[0] += 1.0
+        if rounds == 2:
+            r.members[r._owner[0]].kill()
+
+    (res,) = router.run([], max_ticks=300, on_tick=on_tick)
+    assert np.array_equal(res.output_ids, ref[0].output_ids)
+    assert res.resumed_tokens < 30          # never the orphan's stream
+    assert store.list("fleet/requests") == []
+
+
+def test_fleet_journal_write_never_resurrects_collected_entry(tiny_engine,
+                                                              tmp_path):
+    """A deposed leader stalled mid-step can reach _journal after its
+    successor collected the result and GC'd the entry: the CAS write must
+    stand down instead of resurrecting a finished request for the next
+    takeover to re-serve."""
+    store, router = _fleet(tiny_engine, tmp_path, n=2)
+    router.submit(Request(rid="r", input_ids=np.array([2, 3, 4], np.int32),
+                          max_new_tokens=4))
+    router.step()
+    key = "fleet/requests/sr"
+    assert store.get(key) is not None
+    store.delete(key)          # the successor collected + GC'd behind us
+    router._journal("r", router._requests["r"], "engine0")
+    assert store.get(key) is None            # never resurrected
+    assert "r" not in router._journal_docs   # mirror dropped too
+    # the nastier variant: the mirror is ALREADY gone (a lost flush CAS
+    # dropped it) when a failover-path write arrives — a blind create
+    # would resurrect the entry through the expected=None path
+    router._journal("r", router._requests["r"], "engine1")
+    assert store.get(key) is None
+    # ...and if the successor REWROTE the entry instead, the deposed
+    # router must not clobber the successor's appends
+    successor_doc = {"rid": "r", "engine": "engine1", "input_ids": [2, 3],
+                     "max_new_tokens": 4, "eos_token_id": None,
+                     "deadline_s": None, "arrival_epoch_s": 1.0,
+                     "failovers": 1, "tokens": [5, 6], "resumed": 0,
+                     "t": 2.0}
+    store.put(key, successor_doc)
+    router._journal_docs.pop("r", None)
+    router._journal("r", router._requests["r"], "engine0")
+    assert store.get(key) == successor_doc   # untouched
+    router.run([], max_ticks=300)            # the stream still completes
+
+
+def test_fleet_reelected_leader_resyncs_tracked_rids(tiny_engine, tmp_path):
+    """A deposed-and-RE-elected leader must re-adopt journal state for
+    rids it already tracks: a successor may have failed them over with
+    resumed tokens while this router was stalled, and collecting with the
+    stale pre-deposition mirrors would drop the resumed prefix from the
+    stitched output."""
+    from deepspeed_tpu.elasticity import CoordinatorLease
+
+    store, router = _fleet(tiny_engine, tmp_path, n=2)
+    router.submit(Request(rid="x", input_ids=np.array([5, 6], np.int32),
+                          max_new_tokens=8))
+    router.step()                               # leads term 1, dispatches x
+    assert router._resumed.get("x") is None
+    # while we were stalled, a successor failed x over: 3 tokens resumed,
+    # re-dispatched to the OTHER engine, journal rewritten
+    other = next(e for e in router.members if e != router._owner["x"])
+    key = "fleet/requests/sx"
+    doc = dict(store.get(key))
+    doc.update(tokens=[11, 12, 13], resumed=3, engine=other, failovers=1)
+    store.put(key, doc)
+    router._take_over(CoordinatorLease(leader_id="router0", term=2,
+                                       t=router.store.now(), lease_s=100.0))
+    assert router._resumed["x"] == [11, 12, 13]
+    assert router._journal_docs["x"] == doc
+    assert router._failed_over["x"] == 1
+    assert router._owner["x"] == other
+
+
 def test_fleet_rolling_restart_never_drops_requests(tiny_engine, reference,
                                                     tmp_path):
     reqs, ref = reference
@@ -505,7 +766,9 @@ def test_fleet_gauges_reach_prometheus_exposition(tiny_engine, tmp_path):
     text = prometheus_text(monitor=mon)
     for gauge in ("dstpu_fleet_engines_live", "dstpu_fleet_queue_depth",
                   "dstpu_fleet_failovers_total",
-                  "dstpu_fleet_flight_dropped_total"):
+                  "dstpu_fleet_flight_dropped_total",
+                  "dstpu_fleet_journal_bytes",
+                  "dstpu_fleet_resumed_tokens_total"):
         assert gauge in text, gauge
 
 
@@ -543,6 +806,27 @@ def test_fleet_chaos_soak_deterministic_budget_seed(tmp_path):
                            n_requests=8, verbose=False)
     assert stats["kill_mode"] == "budget" and not stats["killed_coordinator"]
     assert stats["terminal"] == 8 and stats["failovers"] > 0
+
+
+@pytest.mark.chaos
+def test_fleet_chaos_soak_deterministic_midstream_seed(tmp_path):
+    """Pinned seed 3 (ISSUE 8): a silent lease kill lands mid-stream with
+    journaled batches outstanding — failover RESUMES after the last
+    journaled token (resumed tokens > 0), outputs stay token-exact (no
+    duplicated, no lost tokens — the soak asserts parity per rid) and the
+    journal ends empty."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    os.pardir, os.pardir, "tools"))
+    from chaos_soak import run_fleet_soak
+
+    stats = run_fleet_soak(seed=3, coord_dir=str(tmp_path / "coord"),
+                           n_requests=8, verbose=False)
+    assert stats["kill_mode"] == "lease" and not stats["killed_coordinator"]
+    assert stats["terminal"] == 8
+    assert stats["failovers"] > 0
+    assert stats["resumed_results"] > 0 and stats["resumed_tokens"] > 0
 
 
 @pytest.mark.slow

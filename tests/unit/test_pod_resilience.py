@@ -23,17 +23,22 @@ from deepspeed_tpu.elasticity import (
     PodRendezvousTimeout,
     PodSupervisor,
     RC_POD_UNRECOVERABLE,
+    SupervisorStandDown,
+    advertise_host,
     beat,
     bump_generation,
     clear_dead,
     compute_elastic_config,
     dead_hosts,
     dead_set,
+    host_advertisements,
     lease_table,
     pending_commit,
+    read_coordinator,
     read_generation,
     record_dead,
     rendezvous,
+    rollup_host_gauges,
     save_pod_checkpoint,
     shrink_to_healthy,
 )
@@ -309,6 +314,66 @@ def test_pod_verify_catches_missing_and_corrupt_shards(tmp_path):
         verify_pod_checkpoint_dir(tag_dir)
 
 
+def test_host_payload_files_partition_covers_every_file(tmp_path):
+    """Per-process payload attribution (ISSUE 8 satellite): files under a
+    process-named component go to that process, everything unclaimed to
+    process 0 — the union covers the whole payload listing, so every
+    shard file is attested by exactly one host."""
+    from deepspeed_tpu.resilience import host_payload_files
+
+    tag = tmp_path / "global_step3"
+    layout = [
+        "state/ocdbt.process_0/d/data0",         # orbax OCDBT shard, p0
+        "state/ocdbt.process_1/d/data1",         # p1
+        "state/params.leaf/process_1/shard.bin",  # bare process dir, p1
+        "state/_METADATA",                        # shared metadata -> p0
+        "state/zarray.json",                     # unclaimed -> p0
+        "offload_optimizer/step.bin",            # unclaimed -> p0
+    ]
+    for rel in layout:
+        p = tag / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_bytes(rel.encode())
+    p0 = host_payload_files(str(tag), process_index=0)
+    p1 = host_payload_files(str(tag), process_index=1)
+    assert sorted(p0 + p1) == sorted(layout)          # full cover
+    assert not set(p0) & set(p1)                      # no double-claim
+    assert "state/ocdbt.process_1/d/data1" in p1
+    assert "state/params.leaf/process_1/shard.bin" in p1
+    assert "state/_METADATA" in p0
+    # a legit name containing "process" but no index stays unclaimed -> p0
+    extra = tag / "state" / "processing_notes.txt"
+    extra.write_bytes(b"x")
+    assert "state/processing_notes.txt" in host_payload_files(str(tag), 0)
+    assert "state/processing_notes.txt" not in host_payload_files(str(tag), 1)
+
+
+@pytest.mark.chaos
+def test_pod_save_attests_payload_files_and_detects_missing_shard(tmp_path):
+    """The ISSUE 8 satellite closing PR 5's gap: host manifests list the
+    REAL orbax payload files (not just the simulated shard_writer files),
+    so verify_pod_checkpoint_dir detects a missing shard FILE — not just a
+    missing manifest."""
+    engine = _engine()
+    engine.train_batch(batch=random_batch(16, HID, seed=0))
+    store = _store(tmp_path)
+    ckpt = str(tmp_path / "ckpt")
+    ctx = PodContext(store, "host0", ["host0"], generation=1,
+                     commit_timeout_s=5.0)
+    tag_dir = save_pod_checkpoint(engine, ckpt, ctx)
+    from deepspeed_tpu.resilience import read_host_manifests
+
+    listed = read_host_manifests(tag_dir)["host0"]["files"]
+    payload = [rel for rel in listed if rel.startswith("state")]
+    assert payload, listed        # the orbax payload really is attested
+    verify_pod_checkpoint_dir(tag_dir)
+    # lose one attested payload file: the pod verify must catch it
+    victim = os.path.join(tag_dir, payload[0])
+    os.remove(victim)
+    with pytest.raises(CheckpointIntegrityError, match="missing"):
+        verify_pod_checkpoint_dir(tag_dir)
+
+
 def test_pod_progress_fn_counts_only_pod_committed(tmp_path):
     fn = pod_checkpoint_progress_fn(str(tmp_path))
     assert fn() == -1
@@ -377,6 +442,280 @@ def test_pod_supervisor_unrecoverable_is_terminal(tmp_path):
     sup2 = PodSupervisor(s, _ec(2), lambda rnd: 0, ["host0", "host1"],
                          backoff_s=0, max_restarts=5)
     assert sup2.run() == 0
+
+
+# --------------------------- elected pod supervisor (ISSUE 8 tentpole)
+
+def test_pod_supervisor_election_standby_takeover(tmp_path):
+    """The PodSupervisor round loop runs under ``elect_coordinator``: a
+    standby takes over a LAPSED term, adopts the current pod generation
+    and dead-host set from the store, and continues rounds — the same
+    protocol (and exactly-one-driver CAS proof) the FleetRouter uses."""
+    clock = [0.0]
+    s = _store(tmp_path, clock=lambda: clock[0])
+    hosts = [f"host{i}" for i in range(4)]
+    drivers = []
+
+    def mk(name, rcs):
+        it = iter(rcs)
+
+        def attempt(rnd):
+            drivers.append((name, rnd.generation))
+            return next(it)
+
+        return PodSupervisor(s, _ec(4), attempt, hosts, backoff_s=0,
+                             max_restarts=4, supervisor_id=name,
+                             coordinator_lease_s=5.0, standby_poll_s=0.001)
+
+    sup_a = mk("supA", [87, 0])
+    assert sup_a.run() == 0
+    assert sup_a.is_coordinator and sup_a.term == 1
+    gen_a = read_generation(s)
+    assert gen_a == 2                       # one bump per driven round
+    # supA's process is gone: a peer recorded a death, the lease lapses,
+    # and the standby must adopt BOTH facts on takeover
+    record_dead(s, "host3", generation=gen_a, reported_by="host0")
+    clock[0] += 60.0
+    sup_b = mk("supB", [0])
+    assert sup_b.run() == 0
+    assert sup_b.term == 2 and sup_b.elections_total == 1
+    assert read_generation(s) == gen_a + 1  # monotonic across takeover
+    assert "host3" not in sup_b.rounds[-1].hosts
+    assert [d[0] for d in drivers] == ["supA", "supA", "supB"]
+    gens = [d[1] for d in drivers]
+    assert gens == sorted(gens) and len(set(gens)) == len(gens)
+
+
+def test_pod_supervisor_standby_stands_down_under_live_leader(tmp_path):
+    """A standby whose leader stays healthy past ``standby_max_wait_s``
+    stands down CLEANLY (SupervisorStandDown: no budget burned, no backoff
+    loop) without ever driving a round."""
+    clock = [0.0]
+    s = _store(tmp_path, clock=lambda: clock[0])
+    hosts = ["host0", "host1"]
+    driven = []
+    leader = PodSupervisor(s, _ec(2), lambda rnd: driven.append(rnd) or 0,
+                           hosts, backoff_s=0, supervisor_id="leader",
+                           coordinator_lease_s=100.0)
+    assert leader.run() == 0 and len(driven) == 1
+    standby = PodSupervisor(s, _ec(2),
+                            lambda rnd: driven.append(rnd) or 0, hosts,
+                            backoff_s=0, supervisor_id="standby",
+                            coordinator_lease_s=100.0,
+                            standby_poll_s=0.001, standby_max_wait_s=0.1)
+    assert standby.run() == 0
+    assert standby.elections_total == 0 and len(driven) == 1
+    assert "stand-down" in standby.diagnosis
+    assert read_coordinator(s, key=standby.election_key).leader_id == "leader"
+
+
+def test_pod_supervisor_racing_standbys_exactly_one_drives(tmp_path):
+    """Two standbys racing the same lapsed lease: the CAS admits exactly
+    one — the loser stands down having driven nothing."""
+    clock = [0.0]
+    s = _store(tmp_path, clock=lambda: clock[0])
+    hosts = ["host0", "host1"]
+    dead = PodSupervisor(s, _ec(2), lambda rnd: 0, hosts, backoff_s=0,
+                         supervisor_id="dead", coordinator_lease_s=5.0)
+    assert dead.run() == 0
+    clock[0] += 60.0                        # the dead leader's lease lapses
+    drivers = []
+    outcomes = {}
+    barrier = threading.Barrier(2)
+
+    def racer(name):
+        sup = PodSupervisor(
+            s, _ec(2), lambda rnd: drivers.append((name, rnd)) or 0, hosts,
+            backoff_s=0, supervisor_id=name, coordinator_lease_s=100.0,
+            standby_poll_s=0.001, standby_max_wait_s=1.0)
+        barrier.wait()
+        outcomes[name] = (sup.run(), sup.elections_total, sup.term)
+
+    ts = [threading.Thread(target=racer, args=(n,)) for n in ("rA", "rB")]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    winners = [n for n, (rc, won, _) in outcomes.items() if won]
+    assert len(winners) == 1, outcomes
+    assert len(drivers) == 1 and drivers[0][0] == winners[0]
+    assert outcomes[winners[0]][2] == 2     # took the next term
+    assert all(rc == 0 for rc, _, _ in outcomes.values())
+
+
+def test_pod_renew_coordinator_reports_deposition(tmp_path):
+    """Long rounds renew mid-round: a renewal returning False means a
+    standby deposed us and the round must stop driving."""
+    clock = [0.0]
+    s = _store(tmp_path, clock=lambda: clock[0])
+    sup = PodSupervisor(s, _ec(2), lambda rnd: 0, ["host0", "host1"],
+                        backoff_s=0, supervisor_id="supA",
+                        coordinator_lease_s=5.0)
+    assert sup.run() == 0
+    assert sup.renew_coordinator()          # healthy leader renews freely
+    clock[0] += 60.0                        # ...then wedges past its lease
+    usurper = PodSupervisor(s, _ec(2), lambda rnd: 0, ["host0", "host1"],
+                            backoff_s=0, supervisor_id="supB",
+                            coordinator_lease_s=5.0)
+    assert usurper.run() == 0               # takes term 2
+    assert not sup.renew_coordinator()      # the old leader must stand down
+    assert not sup.is_coordinator
+
+
+@pytest.mark.chaos
+def test_pod_supervisor_standby_takeover_training_continuity(tmp_path):
+    """ISSUE 8 acceptance (pod half): supervisor A drives real training
+    rounds and dies mid-job; standby B takes the next term, restores the
+    last pod-committed checkpoint, and re-executed steps reproduce their
+    original losses — generation monotonic, exactly one driver per round."""
+    clock = [0.0]
+    s = _store(tmp_path, clock=lambda: clock[0])
+    ckpt = str(tmp_path / "ckpt")
+    loss_log = {}
+    continuity = {"checked": 0}
+    drivers = []
+    TOTAL = 8
+
+    class _SupervisorDied(RuntimeError):
+        pass
+
+    def make_attempt(name, die_at=None):
+        def attempt(rnd):
+            drivers.append((name, rnd.generation))
+            engine = _engine()
+            ctx = PodContext(s, "host0", list(rnd.hosts), rnd.generation,
+                             commit_timeout_s=5.0)
+            agent = PodElasticAgent(engine, ckpt, ctx, ckpt_every=2)
+
+            def step_fn(eng, i):
+                if die_at is not None and i >= die_at:
+                    raise _SupervisorDied(f"{name} killed at step {i}")
+                loss = float(eng.train_batch(
+                    batch=random_batch(16, HID, seed=i)))
+                if i in loss_log:
+                    assert abs(loss - loss_log[i]) < 1e-4, \
+                        f"loss continuity broken at step {i}"
+                    continuity["checked"] += 1
+                loss_log[i] = loss
+                clock[0] += 1.0
+
+            try:
+                last = agent.run(step_fn, TOTAL)
+            finally:
+                agent.guard.uninstall()
+            return 0 if last >= TOTAL else 75
+
+        return attempt
+
+    sup_a = PodSupervisor(s, _ec(1), make_attempt("supA", die_at=5),
+                          ["host0"], backoff_s=0, max_restarts=0,
+                          supervisor_id="supA", coordinator_lease_s=5.0,
+                          standby_poll_s=0.001)
+    with pytest.raises(_SupervisorDied):
+        sup_a._pod_round(0)                 # the whole PROCESS dies mid-round
+    assert sup_a.term == 1
+    clock[0] += 60.0                        # its lease lapses
+    sup_b = PodSupervisor(s, _ec(1), make_attempt("supB"), ["host0"],
+                          backoff_s=0, max_restarts=4,
+                          supervisor_id="supB", coordinator_lease_s=5.0,
+                          standby_poll_s=0.001)
+    assert sup_b.run() == 0
+    assert sup_b.term == 2
+    assert pod_checkpoint_progress_fn(ckpt)() == TOTAL
+    assert continuity["checked"] >= 1       # re-executed steps reproduced
+    assert [d[0] for d in drivers] == ["supA", "supB"]
+    gens = [d[1] for d in drivers]
+    assert gens == sorted(gens) and len(set(gens)) == len(gens)
+
+
+# ---------------------- pod/hosts advertisements (ISSUE 8 satellite)
+
+def test_host_advertisements_roundtrip_and_rollup(tmp_path):
+    from deepspeed_tpu.monitor import InMemoryMonitor
+
+    s = _store(tmp_path)
+    mon = InMemoryMonitor()
+    advertise_host(s, "host0", 3, monitor=mon, step=7)
+    advertise_host(s, "host1", 3, step=7)
+    ads = host_advertisements(s)
+    assert set(ads) == {"host0", "host1"}
+    assert ads["host0"]["attrs"]["step"] == 7
+    for key in ("flight_dropped", "flight_src", "monitor_dropped",
+                "monitor_src", "generation"):
+        assert key in ads["host0"], key
+    g = rollup_host_gauges(s, mon, tick=1)
+    assert g["pod/hosts_advertised"] == 2.0
+    names = {e[0] for e in mon.events_snapshot()}
+    assert {"pod/flight_dropped_total", "pod/monitor_dropped_total",
+            "pod/hosts_advertised"} <= names
+    # dedup keys carry a machine identity, not a bare pid: containerized
+    # pods commonly run every host as pid 1, which would silently merge
+    # distinct hosts' counters
+    from deepspeed_tpu.elasticity.coordination import process_src
+
+    assert ads["host0"]["flight_src"] == process_src()
+    assert "." in ads["host0"]["flight_src"]
+
+
+def test_rollup_ages_out_dead_hosts_advertisements(tmp_path):
+    """Advertisements are never deleted, so the rollup must age them out:
+    a host lost generations ago may not inflate the pod gauges forever."""
+    clock = [0.0]
+    s = _store(tmp_path, clock=lambda: clock[0])
+    advertise_host(s, "dead_host", 1, step=1)
+    clock[0] = 100.0
+    advertise_host(s, "live_host", 2, step=9)
+    g = rollup_host_gauges(s, None, max_age_s=15.0)
+    assert g["pod/hosts_advertised"] == 1.0
+    # without the bound, both still show (full history on demand)
+    assert rollup_host_gauges(s, None)["pod/hosts_advertised"] == 2.0
+
+
+def test_watchdog_advertises_and_rolls_up_cross_host_view(tmp_path):
+    """Each host's HeartbeatWatchdog publishes its pod/hosts advertisement
+    with every renewal and (with a monitor) folds the fleet of
+    advertisements into pod-scope gauges — one cross-host /metrics view,
+    mirroring the serving fleet's fleet/engines rollup."""
+    from deepspeed_tpu.monitor import InMemoryMonitor
+    from deepspeed_tpu.observability import prometheus_text
+
+    s = _store(tmp_path)
+    mon = InMemoryMonitor()
+    wd0 = HeartbeatWatchdog(s, "host0", 1, ["host0", "host1"], lease_s=5.0,
+                            monitor=mon, renew_s=0.01,
+                            on_peer_dead=lambda h: None)
+    wd1 = HeartbeatWatchdog(s, "host1", 1, ["host0", "host1"], lease_s=5.0,
+                            renew_s=0.01, on_peer_dead=lambda h: None)
+    wd0.set_attrs(step=3)
+    try:
+        wd0.start()
+        wd1.start()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            ads = host_advertisements(s)
+            names = {e[0] for e in mon.events_snapshot()}
+            if (set(ads) >= {"host0", "host1"}
+                    and "pod/hosts_advertised" in names):
+                break
+            time.sleep(0.01)
+    finally:
+        wd0.stop()
+        wd1.stop()
+    ads = host_advertisements(s)
+    assert set(ads) >= {"host0", "host1"}
+    assert ads["host0"]["attrs"].get("step") == 3
+    names = {e[0] for e in mon.events_snapshot()}
+    assert {"pod/hosts_advertised", "pod/flight_dropped_total",
+            "pod/monitor_dropped_total"} <= names
+    # the rollup reaches the Prometheus exposition like every other gauge
+    text = prometheus_text(monitor=mon)
+    assert "dstpu_pod_hosts_advertised" in text
+    # a disabled watchdog stays store-silent
+    s2 = _store(tmp_path / "quiet")
+    wd2 = HeartbeatWatchdog(s2, "host0", 1, ["host0"], advertise=False,
+                            on_peer_dead=lambda h: None)
+    wd2.beat_once()
+    assert host_advertisements(s2) == {}
 
 
 # ----------------------------------- pod checkpoints with a real engine

@@ -23,12 +23,17 @@ Three modes (``--mode train`` is the default):
   (injected store clock, one router round per clock tick) under a seeded
   random ENGINE kill — silent lease lapse or fault-injected restart-budget
   exhaustion — plus, half the time, a coordinator kill with a standby
-  router taking the next election term.  Every request must reach a
-  terminal result, completed outputs must be token-identical to a
-  fault-free single-engine reference, each SURVIVING engine's page
-  accounting must balance, the dead engine must carry a lapsed lease or a
-  durable ``fleet/dead`` marker, and the fleet generation must bump
-  monotonically across coordinator terms (docs/FLEET.md).
+  router taking the next election term.  Token journaling runs hot
+  (``journal_every_k=2``), so kills land MID-STREAM with journaled
+  batches outstanding: failover must RESUME after the last journaled
+  token.  Every request must reach a terminal result, completed outputs
+  must be token-identical to a fault-free single-engine reference (no
+  token duplicated, none lost — resumed streams included), each SURVIVING
+  engine's page accounting must balance, the dead engine must carry a
+  lapsed lease or a durable ``fleet/dead`` marker, every journal entry
+  must be GC'd by the collecting router (original or standby), and the
+  fleet generation must bump monotonically across coordinator terms
+  (docs/FLEET.md).
 
 Each soak round draws a fault mix from a seeded PRNG — preemption SIGTERMs
 at random steps, checkpoint-write failures, corruption of the newest
@@ -322,12 +327,20 @@ def run_fleet_soak(seed: int, coord_dir: str, n_requests: int = 10,
     through the CAS store, adopt the request journal, and finish the
     stream.
 
+    Token journaling runs at ``journal_every_k=2`` so the seeded kill lands
+    mid-stream with journaled batches outstanding and failover exercises
+    the resume path (ISSUE 8): the replacement re-prefills
+    ``prompt + journaled`` and continues AFTER the last journaled token.
+
     Invariants asserted: every submitted request reaches a terminal result
     (none lost); completed outputs are token-identical to a fault-free
-    single-engine reference run; every surviving engine's refcount page
-    accounting balances; the dead engine is visibly dead through the store
-    (lapsed lease or dead marker); the fleet generation is strictly
-    monotonic across coordinator terms.
+    single-engine reference run — for resumed streams this proves zero
+    duplicated emissions and zero lost tokens; every surviving engine's
+    refcount page accounting balances; the dead engine is visibly dead
+    through the store (lapsed lease or dead marker); every journal entry
+    is GC'd once its result is collected (even by a freshly elected
+    standby); the fleet generation is strictly monotonic across
+    coordinator terms.
     """
     import numpy as np
 
@@ -399,10 +412,14 @@ def run_fleet_soak(seed: int, coord_dir: str, n_requests: int = 10,
     # that +1/round clock ticks never depose a LIVE router (it renews every
     # round), short enough that a killed one is succeeded within the soak
     ROUTER_LEASE = 30.0
+    # journal every 2 rounds: the kill (rounds 2-6) lands with journaled
+    # batches outstanding, so failover must RESUME, not re-decode
     router = FleetRouter(store, members, router_id="router0",
-                         lease_s=ROUTER_LEASE, miss_limit=MISS)
+                         lease_s=ROUTER_LEASE, miss_limit=MISS,
+                         journal_every_k=2)
     standby = (FleetRouter(store, members, router_id="router1",
-                           lease_s=ROUTER_LEASE, miss_limit=MISS)
+                           lease_s=ROUTER_LEASE, miss_limit=MISS,
+                           journal_every_k=2)
                if kill_coordinator else None)
 
     inj = FaultInjector()
@@ -449,13 +466,19 @@ def run_fleet_soak(seed: int, coord_dir: str, n_requests: int = 10,
     assert sorted(by_rid) == sorted(r.rid for r in base), \
         f"fleet soak seed={seed}: lost requests " \
         f"{sorted(set(r.rid for r in base) - set(by_rid))}"
-    # invariant: completed outputs token-identical to the reference
-    parity_checked = 0
+    # invariant: completed outputs token-identical to the reference — for
+    # resumed streams (journaled prefix + decoded continuation) equality
+    # proves no token was duplicated at the stitch and none was lost
+    parity_checked = resumed_results = resumed_tokens = 0
     for rid, res in by_rid.items():
         if res.finish_reason in ("eos", "length"):
             assert np.array_equal(res.output_ids, ref[rid]), \
                 f"fleet soak seed={seed}: rid {rid} diverged after failover"
             parity_checked += 1
+            if res.resumed_tokens:
+                resumed_results += 1
+                resumed_tokens += res.resumed_tokens
+                assert res.resumed_tokens <= len(res.output_ids), res
         else:
             assert res.finish_reason in ("deadline", "shed"), \
                 res.finish_reason
@@ -493,6 +516,12 @@ def run_fleet_soak(seed: int, coord_dir: str, n_requests: int = 10,
         assert standby.is_coordinator and standby.term == 2, \
             f"fleet soak seed={seed}: election never converged " \
             f"(term {standby.term})"
+    # invariant: every journal entry was GC'd once its result was
+    # collected — including by a freshly elected standby (the stream is
+    # done, so a surviving entry would be a leak the next takeover adopts)
+    leftover = store.list("fleet/requests")
+    assert not leftover, \
+        f"fleet soak seed={seed}: journal entries leaked: {leftover}"
     stats = {
         "seed": seed,
         "submitted": len(base),
@@ -503,6 +532,8 @@ def run_fleet_soak(seed: int, coord_dir: str, n_requests: int = 10,
         "killed_coordinator": kill_coordinator,
         "dead_engines": sorted(dead_ids),
         "failovers": live_router.failovers_total,
+        "resumed_results": resumed_results,
+        "resumed_tokens": resumed_tokens,
         "faults_fired": len(inj.log),
         "final_term": live_router.term,
         "final_generation": live_router.generation,
@@ -510,8 +541,9 @@ def run_fleet_soak(seed: int, coord_dir: str, n_requests: int = 10,
     if verbose:
         print(f"  seed={seed}: OK — kill={kill_mode}({victim}"
               f"{'+coordinator' if kill_coordinator else ''}), "
-              f"{stats['failovers']} failover(s), term {stats['final_term']}"
-              f", {parity_checked} parity-checked")
+              f"{stats['failovers']} failover(s), "
+              f"{resumed_tokens} resumed token(s), "
+              f"term {stats['final_term']}, {parity_checked} parity-checked")
     return stats
 
 

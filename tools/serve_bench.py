@@ -246,14 +246,20 @@ def run_prefix_bench(model_name: str = "llama-374m", b_slots: int = 4,
 def run_fleet_bench(model_name: str = "llama-374m", n_engines: int = 3,
                     b_slots: int = 4, n_requests: int = 36, seed: int = 0,
                     page_size: int = 128, max_model_len: int = 0,
-                    kill_engine: bool = False) -> dict:
-    """Fleet-tier serving benchmark (ISSUE 7): the seeded mixed stream
+                    kill_engine: bool = False,
+                    journal_every_k: int = 4) -> dict:
+    """Fleet-tier serving benchmark (ISSUE 7/8): the seeded mixed stream
     through ``n_engines`` leased engines behind a :class:`FleetRouter` on a
     file-backed coordination store.  Reports fleet throughput, PER-ENGINE
     throughput (``tokens_by_engine`` over the measured wall time), fleet
     TTFT/latency p50/p99, and the failover count — ``--kill_engine`` kills
     one engine a few rounds into the measured pass so the failover path's
-    cost lands in the numbers instead of only in the chaos suite."""
+    cost lands in the numbers instead of only in the chaos suite.  With
+    token journaling on (``journal_every_k``), the kill report splits the
+    dead engine's decode work into RESUMED tokens (journaled — replayed as
+    pure KV reconstruction, never re-decoded) vs RE-DECODED tokens (the
+    un-flushed tail plus anything past the journal cap), so the failover-
+    cost win of ISSUE 8's mid-stream journal is directly measurable."""
     import tempfile
 
     import numpy as np
@@ -301,16 +307,30 @@ def run_fleet_bench(model_name: str = "llama-374m", n_engines: int = 3,
         members = [FleetMember(f"engine{i}",
                                engine.supervised_serving(**serve_kw), store)
                    for i in range(n_engines)]
-        router = FleetRouter(store, members)
+        router = FleetRouter(store, members,
+                             journal_every_k=journal_every_k)
         router.run(copies(), max_ticks=100000)       # warm all members
         # counter snapshots: tokens_by_engine / shed_total are cumulative
         # over the router's lifetime — the measured numbers must not
         # include the warm pass
         warm_tokens = dict(router.tokens_by_engine)
         warm_shed = router.shed_total
+        warm_resumed = router.resumed_tokens_total
+        tokens_at_kill = {}
+
+        # land the kill just AFTER a journal flush so the measured pass
+        # shows the resumed-vs-re-decoded split (a kill before the first
+        # flush would measure only the no-journal fallback)
+        kill_round = max(3, (journal_every_k or 0) + 2)
 
         def on_tick(r, rounds):
-            if kill_engine and rounds == 3 and r.members["engine0"].alive:
+            if kill_engine and rounds == kill_round \
+                    and r.members["engine0"].alive:
+                # the victim's decode progress at the kill instant: the
+                # resumed-vs-re-decoded split below is measured against it
+                tokens_at_kill.update(
+                    {rid: len(toks) for rid, toks
+                     in r.members["engine0"].stream_progress().items()})
                 r.members["engine0"].kill()
                 # a bench must not wait out real lease time: lapse it now
                 r._failover("engine0", "bench kill")
@@ -319,6 +339,7 @@ def run_fleet_bench(model_name: str = "llama-374m", n_engines: int = 3,
         results = router.run(copies(), max_ticks=100000, on_tick=on_tick)
         fleet_dt = time.perf_counter() - t0
         h = router.health()     # snapshot while the store still exists
+        resumed_total = router.resumed_tokens_total - warm_resumed
     finally:
         shutil.rmtree(coord_dir, ignore_errors=True)
 
@@ -327,6 +348,13 @@ def run_fleet_bench(model_name: str = "llama-374m", n_engines: int = 3,
                  if r.finish_reason in ("eos", "length"))
     none_lost = sorted(r.rid for r in results) == sorted(
         r.rid for r in stream)
+    # failover decode-work split: of the tokens the dead engine had
+    # decoded at the kill, `resumed` came back from the journal (KV
+    # reconstruction only) and the rest had to be RE-decoded on survivors
+    by_rid = {r.rid: r for r in results}
+    redecoded_total = sum(
+        max(0, n_at_kill - by_rid[rid].resumed_tokens)
+        for rid, n_at_kill in tokens_at_kill.items() if rid in by_rid)
     ttft = [r.ttft_s for r in results]
     lat = [r.latency_s for r in results]
     per_engine = {eid: round((tok - warm_tokens.get(eid, 0)) / fleet_dt, 1)
@@ -353,6 +381,14 @@ def run_fleet_bench(model_name: str = "llama-374m", n_engines: int = 3,
             "p50_latency_s": round(_pct(lat, 0.50), 4),
             "p99_latency_s": round(_pct(lat, 0.99), 4),
             "failovers_total": router.failovers_total,
+            "journal_every_k": journal_every_k,
+            # mid-stream durability split (ISSUE 8): tokens the victim had
+            # decoded when it was killed, how many a survivor RESUMED from
+            # the journal (never re-decoded/re-emitted) and how many had
+            # to be re-decoded (the un-flushed tail)
+            "tokens_decoded_at_kill": sum(tokens_at_kill.values()),
+            "resumed_tokens_total": resumed_total,
+            "redecoded_tokens_total": redecoded_total,
             "engines_live": h["engines_live"],
             # measured pass only (the warm pass ran clean, but keep the
             # accounting honest if that ever changes)
@@ -509,7 +545,10 @@ def main(argv=None) -> int:
     ap.add_argument("--kill_engine", action="store_true",
                     help="fleet mode: kill engine0 a few rounds into the "
                          "measured pass so failover cost lands in the "
-                         "numbers")
+                         "numbers (reports resumed vs re-decoded tokens)")
+    ap.add_argument("--journal_every_k", type=int, default=4,
+                    help="fleet mode: router rounds between token-journal "
+                         "flushes (mid-stream durability; 0 disables)")
     ap.add_argument("--workload", choices=("mixed", "prefix"),
                     default="mixed",
                     help="mixed: ragged stream vs sequential generate(); "
@@ -547,7 +586,8 @@ def main(argv=None) -> int:
                         if args.n_requests is not None else 36),
             seed=args.seed,
             page_size=args.page_size if args.page_size is not None else 128,
-            max_model_len=args.max_model_len, kill_engine=args.kill_engine)
+            max_model_len=args.max_model_len, kill_engine=args.kill_engine,
+            journal_every_k=args.journal_every_k or None)
         line = json.dumps(result)
         print(line)
         if args.out:
