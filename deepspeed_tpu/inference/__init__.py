@@ -1,8 +1,9 @@
 """Inference subsystem: the one-shot engine (``engine.InferenceEngine``,
 built by ``deepspeed_tpu.init_inference``), the continuous-batching serving
 engine (``serving.ServingEngine``), its warm-restart wrapper
-(``serving_supervisor.ServingSupervisor``), and the leased multi-engine
-fleet tier (``fleet.FleetRouter``)."""
+(``serving_supervisor.ServingSupervisor``), the leased multi-engine
+fleet tier (``fleet.FleetRouter``), and the sampling/speculative subsystem
+(``sampling.SamplingParams``, ``speculative.SpeculativeConfig``)."""
 from .config import DeepSpeedInferenceConfig  # noqa: F401
 from .engine import InferenceEngine  # noqa: F401
 from .fleet import (  # noqa: F401
@@ -12,6 +13,8 @@ from .fleet import (  # noqa: F401
     FleetUnrecoverable,
 )
 from .prefix_cache import PrefixIndex, PrefixMatch  # noqa: F401
+from .sampling import SamplingParams  # noqa: F401
+from .speculative import SpeculativeConfig, SpeculativeDecoder  # noqa: F401
 from .serving import (  # noqa: F401
     PoolConsumedError,
     Request,

@@ -292,29 +292,18 @@ class InferenceEngine:
             def sample(lg, key):
                 if greedy:
                     return jnp.argmax(lg, axis=-1).astype(jnp.int32)
-                lg = lg.astype(jnp.float32) / temperature
-                if top_p < 1.0:
-                    # ONE descending sort serves both filters (a per-token
-                    # full-vocab sort inside the decode scan is the cost)
-                    sorted_lg = jnp.sort(lg, axis=-1)[:, ::-1]
-                    if top_k:
-                        kth = sorted_lg[:, top_k - 1][:, None]
-                        lg = jnp.where(lg < kth, -jnp.inf, lg)
-                        sorted_lg = jnp.where(
-                            jnp.arange(sorted_lg.shape[-1])[None] < top_k,
-                            sorted_lg, -jnp.inf)
-                    probs = jax.nn.softmax(sorted_lg, axis=-1)
-                    cum = jnp.cumsum(probs, axis=-1)
-                    # keep the smallest prefix with mass >= top_p
-                    cutoff_idx = jnp.sum(cum < top_p, axis=-1)      # [B]
-                    cutoff = jnp.take_along_axis(
-                        sorted_lg, cutoff_idx[:, None], axis=-1)    # [B,1]
-                    lg = jnp.where(lg < cutoff, -jnp.inf, lg)
-                elif top_k:
-                    # partial selection, no full sort
-                    kth = jax.lax.top_k(lg, top_k)[0][:, -1][:, None]
-                    lg = jnp.where(lg < kth, -jnp.inf, lg)
-                return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
+                # the shared sampling subsystem (inference/sampling.py):
+                # one full sort serves top-k and top-p, temperature <= 0
+                # folds to argmax in-graph (never a division by zero), and
+                # top_k >= vocab / top_k == 0 disable the k-filter — the
+                # ISSUE 9 edge cases, fixed once for generate() and serving
+                from .sampling import sample_tokens
+
+                return sample_tokens(
+                    lg, jnp.broadcast_to(temperature, (B,)),
+                    jnp.full((B,), top_k, jnp.int32),
+                    jnp.full((B,), top_p, jnp.float32),
+                    jax.random.split(key, B))
 
             def step(carry, _):
                 cache, lg, pos, done, key = carry
@@ -338,16 +327,70 @@ class InferenceEngine:
 
         return jax.jit(prog, static_argnames=())
 
+    def _generate_lanes_program(self, model, B, S_pad, max_new):
+        """The per-row RNG-lane twin of :meth:`_generate_program`
+        (``generate(sampling=...)``): temperature/top-k/top-p/seed are
+        TRACED per-row vectors, greedy rows fold to argmax in-graph, and
+        the key for the token at stream position ``p`` of row ``b`` is
+        ``fold_in(PRNGKey(seed_b), p)`` — exactly the schedule the serving
+        engine's per-slot lanes use, which is what makes serving output
+        token-identical to this path under the same seed/params
+        (docs/SERVING.md "Sampling").  One program per (B, S_pad, max_new)
+        regardless of the parameter mix."""
+        from .sampling import position_keys, sample_tokens
+
+        cfg = model.config
+        T_cache = -(-(S_pad + max_new) // 128) * 128
+
+        def prog(params, tokens, input_mask, positions, eos_id,
+                 temp, top_k, top_p, seeds):
+            cache = model.init_cache(B, T_cache, dtype=cfg.dtype)
+            logits, cache = model.apply_cached(params, tokens, cache,
+                                               positions, input_mask)
+            lengths = input_mask.sum(-1).astype(jnp.int32)           # [B]
+            last = jnp.take_along_axis(
+                logits, (lengths - 1)[:, None, None], axis=1)[:, 0]  # [B,V]
+
+            def step(carry, _):
+                cache, lg, pos, done = carry
+                # `pos` is the stream position the sampled token will
+                # occupy (starts at the prompt length) — the lane counter
+                tok = sample_tokens(lg, temp, top_k, top_p,
+                                    position_keys(seeds, pos))
+                tok = jnp.where(done, eos_id, tok)
+                done = done | (tok == eos_id)
+                lg2, cache = model.apply_cached(
+                    params, tok[:, None], cache, pos[:, None],
+                    ~done[:, None])
+                return (cache, lg2[:, 0], pos + 1, done), tok
+
+            done0 = jnp.zeros((B,), jnp.bool_)
+            (_, _, _, _), toks = jax.lax.scan(
+                step, (cache, last, lengths, done0), None, length=max_new)
+            return toks.T  # [B, max_new]
+
+        return jax.jit(prog, static_argnames=())
+
     def generate(self, input_ids, max_new_tokens: int = 32, eos_token_id: Optional[int] = None,
                  greedy: bool = True, rng: Optional[jax.Array] = None, temperature: float = 1.0,
                  top_k: int = 0, top_p: float = 1.0,
-                 attention_mask=None, model=None, params=None):
+                 attention_mask=None, model=None, params=None,
+                 sampling=None):
         """KV-cached autoregressive generation under jit.
 
         Prompts may be right-padded ragged rows (pass ``attention_mask``); pad
         slots are written to the cache but masked from attention.  Returns the
         original ids with ``max_new_tokens`` generated tokens appended (rows
         that hit ``eos_token_id`` repeat it).
+
+        ``sampling`` — a :class:`~.sampling.SamplingParams` (or one per
+        row) switches to the per-row RNG-lane path: temperature/top-k/
+        top-p/seed become TRACED vectors (any mix shares one program) and
+        keys are counter-based (``fold_in(PRNGKey(seed), position)``), so
+        the output is token-identical to a :class:`~.serving.ServingEngine`
+        request carrying the same params — the sampled parity contract
+        (docs/SERVING.md "Sampling").  Mutually exclusive with the legacy
+        ``greedy``/``rng``/``temperature``/``top_k``/``top_p`` knobs.
         """
         if (model is not None and model is not self._model
                 and self._quant and params is None):
@@ -357,6 +400,25 @@ class InferenceEngine:
                 "model's apply_cached cannot consume (the engine's own "
                 "model is shimmed to dequantize)")
         model = model or self._model
+        if sampling is not None:
+            if rng is not None:
+                raise ValueError(
+                    "generate(sampling=...) uses counter-based lane keys "
+                    "derived from SamplingParams.seed — rng= would be "
+                    "silently ignored; pass one or the other")
+            if not greedy or temperature != 1.0 or top_k or top_p < 1.0:
+                raise ValueError(
+                    "generate(sampling=...) is mutually exclusive with the "
+                    "legacy greedy/temperature/top_k/top_p knobs — they "
+                    "would be silently ignored; put them in SamplingParams")
+            if model is None or not hasattr(model, "apply_cached"):
+                raise NotImplementedError(
+                    "generate(sampling=...) requires a KV-cache-capable "
+                    "model (apply_cached); the full-recompute fallback "
+                    "has no lane path")
+            return self._generate_lanes(model, input_ids, max_new_tokens,
+                                        eos_token_id, sampling,
+                                        attention_mask, params)
         if model is None or not hasattr(model, "apply_cached"):
             if attention_mask is not None:
                 raise NotImplementedError(
@@ -370,49 +432,95 @@ class InferenceEngine:
                     "full distribution")
             return self._generate_uncached(input_ids, max_new_tokens, eos_token_id,
                                            greedy, rng, temperature, params=params)
-        ids = np.asarray(input_ids)
-        if ids.ndim == 1:
-            ids = ids[None, :]
-        B, S = ids.shape
-        mask = (np.ones_like(ids, dtype=bool) if attention_mask is None
-                else np.asarray(attention_mask, dtype=bool))
-        S_pad = self._bucket(S)
-        toks = np.zeros((B, S_pad), ids.dtype)
-        toks[:, :S] = ids
-        mpad = np.zeros((B, S_pad), bool)
-        mpad[:, :S] = mask
-        # positions: cumulative index of real tokens (pads repeat the last)
-        pos = np.maximum(np.cumsum(mpad, axis=1) - 1, 0).astype(np.int32)
-
-        # weakref-held model identity: id(model) can be REUSED after GC and
-        # would then serve a stale program compiled for a different model.
-        # A weakref compares by referent identity while alive and can never
-        # equal a ref to a new object once dead — stale entries are inert
-        # and age out of the LRU below.  (Either way the cached program's
-        # closure pins the model while its entry lives, so an id in a live
-        # key can never be recycled; eviction releases the pin.)
-        try:
-            mkey: Any = weakref.ref(model)
-            hash(mkey)   # a ref hashes via its referent — an unhashable
-        except TypeError:          # or weakref-less adapter falls back:
-            mkey = (id(model),)    # id is safe while the entry (and its
-                                   # closure pin on the model) lives
-        key = (mkey, B, S_pad, max_new_tokens, greedy, top_k, top_p)
-        prog = self._gen_cache.get(key)
-        if prog is None:
-            prog = self._gen_cache[key] = self._generate_program(
-                model, B, S_pad, max_new_tokens, greedy,
-                top_k=top_k, top_p=top_p)
-            while len(self._gen_cache) > self.GEN_CACHE_MAX:
-                self._gen_cache.popitem(last=False)
-        else:
-            self._gen_cache.move_to_end(key)
+        ids, toks, mpad, pos, B, S_pad = self._pad_prompt(input_ids,
+                                                          attention_mask)
+        prog = self._cached_program(
+            model, (B, S_pad, max_new_tokens, greedy, top_k, top_p),
+            lambda: self._generate_program(model, B, S_pad, max_new_tokens,
+                                           greedy, top_k=top_k, top_p=top_p))
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         eos = jnp.int32(-1 if eos_token_id is None else eos_token_id)
         new = prog(
             self.params if params is None else params,
             jnp.asarray(toks), jnp.asarray(mpad), jnp.asarray(pos),
             rng, eos, jnp.float32(temperature))
+        return jnp.concatenate([jnp.asarray(ids), new], axis=1)
+
+    @staticmethod
+    def _pad_prompt(input_ids, attention_mask):
+        """Shared generate() host prep: right-pad the (possibly ragged)
+        prompt to its pow2 bucket and derive the cumulative positions
+        (pads repeat the last real index)."""
+        ids = np.asarray(input_ids)
+        if ids.ndim == 1:
+            ids = ids[None, :]
+        B, S = ids.shape
+        mask = (np.ones_like(ids, dtype=bool) if attention_mask is None
+                else np.asarray(attention_mask, dtype=bool))
+        S_pad = InferenceEngine._bucket(S)
+        toks = np.zeros((B, S_pad), ids.dtype)
+        toks[:, :S] = ids
+        mpad = np.zeros((B, S_pad), bool)
+        mpad[:, :S] = mask
+        pos = np.maximum(np.cumsum(mpad, axis=1) - 1, 0).astype(np.int32)
+        return ids, toks, mpad, pos, B, S_pad
+
+    def _cached_program(self, model, key_tail, builder):
+        """LRU-cached generate program lookup.  Model identity is held by
+        weakref: id(model) can be REUSED after GC and would then serve a
+        stale program compiled for a different model; a weakref compares
+        by referent identity while alive and can never equal a ref to a
+        new object once dead — stale entries are inert and age out of the
+        LRU.  (Either way the cached program's closure pins the model
+        while its entry lives, so an id in a live key can never be
+        recycled; eviction releases the pin.)"""
+        try:
+            mkey: Any = weakref.ref(model)
+            hash(mkey)   # a ref hashes via its referent — an unhashable
+        except TypeError:          # or weakref-less adapter falls back:
+            mkey = (id(model),)    # id is safe while the entry (and its
+                                   # closure pin on the model) lives
+        key = (mkey,) + tuple(key_tail)
+        prog = self._gen_cache.get(key)
+        if prog is None:
+            prog = self._gen_cache[key] = builder()
+            while len(self._gen_cache) > self.GEN_CACHE_MAX:
+                self._gen_cache.popitem(last=False)
+        else:
+            self._gen_cache.move_to_end(key)
+        return prog
+
+    def _generate_lanes(self, model, input_ids, max_new_tokens,
+                        eos_token_id, sampling, attention_mask, params):
+        """Host side of ``generate(sampling=...)``: normalize the per-row
+        :class:`~.sampling.SamplingParams`, pad/bucket the prompt exactly
+        like the legacy path, and run the lane program (cached per
+        (model, B, S_pad, max_new) — the params are traced, so every
+        parameter mix is a cache hit)."""
+        from .sampling import SamplingParams
+
+        ids, toks, mpad, pos, B, S_pad = self._pad_prompt(input_ids,
+                                                          attention_mask)
+        lanes = ([sampling] * B if isinstance(sampling, SamplingParams)
+                 else list(sampling))
+        if len(lanes) != B:
+            raise ValueError(
+                f"sampling: got {len(lanes)} SamplingParams for a batch "
+                f"of {B} rows (pass one, or one per row)")
+        for sp in lanes:
+            sp.validate()
+        prog = self._cached_program(
+            model, (B, S_pad, max_new_tokens, "lanes"),
+            lambda: self._generate_lanes_program(model, B, S_pad,
+                                                 max_new_tokens))
+        eos = jnp.int32(-1 if eos_token_id is None else eos_token_id)
+        new = prog(
+            self.params if params is None else params,
+            jnp.asarray(toks), jnp.asarray(mpad), jnp.asarray(pos), eos,
+            jnp.asarray([sp.temperature for sp in lanes], jnp.float32),
+            jnp.asarray([sp.top_k for sp in lanes], jnp.int32),
+            jnp.asarray([sp.top_p for sp in lanes], jnp.float32),
+            jnp.asarray([sp.seed for sp in lanes], jnp.uint32))
         return jnp.concatenate([jnp.asarray(ids), new], axis=1)
 
     def _generate_uncached(self, input_ids, max_new_tokens: int = 32,
@@ -474,7 +582,10 @@ class InferenceEngine:
                             "using the exact per-step path, which "
                             "retraces every new length")
                         next_logits = exact
-            if greedy:
+            if greedy or temperature <= 0:
+                # temperature <= 0 folds to greedy (dividing logits by it
+                # would be a silent NaN factory) — same guard the shared
+                # sampling subsystem applies in-graph
                 nxt = jnp.argmax(next_logits, axis=-1)
             else:
                 rng, sub = jax.random.split(rng)

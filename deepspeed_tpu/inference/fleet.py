@@ -42,7 +42,11 @@ training pods — by leaning on the :class:`~..elasticity.coordination
   Resumed results carry ``RequestResult.resumed_tokens``; with nothing
   journaled the failover falls back to the PR 7 contract (re-prefill from
   the ORIGINAL prompt — the "drop refcount, re-prefill" contract of
-  docs/SERVING.md; greedy decoding makes it token-exact either way).
+  docs/SERVING.md).  Both paths are token-exact for greedy AND sampled
+  streams: journal entries carry the RNG lane (``sampling`` params incl.
+  seed + ``lane_counter``), and the per-slot lanes key on
+  ``fold_in(PRNGKey(seed), position)`` — the survivor re-derives the
+  identical key at every continuation position (``inference/sampling.py``).
 - **Coordinator failover** — a standby router polls the same election; when
   the leader's lease lapses it takes the next term, bumps the fleet
   generation (a CAS loop — exactly one bump even if a deposed leader
@@ -85,6 +89,7 @@ from ..elasticity.coordination import (CoordinationStore, beat,
                                        read_generation, record_dead)
 from ..observability.trace import get_tracer, trace_span
 from ..utils.logging import log_dist, logger
+from .sampling import SamplingParams
 from .serving import Request, RequestResult, ServeTimeout, SlotPrefillError
 from .serving_supervisor import RestartBudgetExhausted, ServingSupervisor
 
@@ -586,6 +591,15 @@ class FleetRouter:
             "failovers": self._failed_over.get(rid, 0),
             "tokens": [int(t) for t in resumed],
             "resumed": len(resumed),
+            # RNG lane state (docs/FLEET.md): the sampling params (seed
+            # included) plus the lane counter — the stream position of the
+            # next token, prompt + journaled.  Keys are counter-based
+            # (fold_in(PRNGKey(seed), position)), so a successor that
+            # re-prefills prompt+journaled re-derives the lane at exactly
+            # this counter and the resumed sampled stream is token-exact.
+            "sampling": (dataclasses.asdict(request.sampling)
+                         if request.sampling is not None else None),
+            "lane_counter": len(request.input_ids) + len(resumed),
             "t": self.store.now()}
         key = f"{FLEET_REQUESTS_PREFIX}/{_rid_key(rid)}"
         expected = self._journal_docs.get(rid)
@@ -710,6 +724,10 @@ class FleetRouter:
                 new = dict(cur)
                 new["tokens"] = total
                 new["resumed"] = len(base)
+                # the lane counter advances with the journaled stream: the
+                # position of the NEXT token a resume would decode
+                new["lane_counter"] = (len(cur.get("input_ids") or ())
+                                       + len(total))
                 new["t"] = self.store.now()
                 if self.store.compare_and_swap(key, cur, new):
                     self._journal_docs[rid] = new
@@ -1021,7 +1039,14 @@ class FleetRouter:
                     max_new_tokens=int(rec["max_new_tokens"]),
                     eos_token_id=rec["eos_token_id"],
                     deadline_s=rec["deadline_s"],
-                    arrival_epoch_s=rec["arrival_epoch_s"])
+                    arrival_epoch_s=rec["arrival_epoch_s"],
+                    # re-derive the RNG lane from the journaled seed/params
+                    # — counter-based keys make the adopted stream's
+                    # continuation token-exact (the counter is implicit in
+                    # prompt + journaled length; `lane_counter` documents
+                    # it for operators and cross-implementations)
+                    sampling=(SamplingParams(**rec["sampling"])
+                              if rec.get("sampling") else None))
                 self._requests[rid] = req
                 if rec.get("failovers"):
                     self._failed_over[rid] = int(rec["failovers"])

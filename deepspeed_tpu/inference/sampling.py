@@ -1,0 +1,181 @@
+"""Sampling subsystem: per-slot RNG lanes as fixed-shape traced ops.
+
+The serving engine's decode program runs ONE fixed shape over all slots, so
+per-request sampling (temperature / top-k / top-p / seed) must be expressed
+as *traced per-slot parameter vectors*, never as program structure — a
+request mix of greedy, hot-temperature and tight-nucleus slots has to share
+the same compiled program or the zero-recompile admission contract
+(docs/SERVING.md) dies the moment real traffic arrives.  This module is
+that expression, shared by ``InferenceEngine.generate()`` and
+``ServingEngine`` so the two paths are token-identical under the same
+seed/params (the sampled analogue of the greedy parity contract):
+
+- :class:`SamplingParams` — the per-request knobs.  ``temperature <= 0``
+  means greedy and is folded IN-GRAPH (``jnp.where`` on the argmax), so
+  "greedy" is just a lane value, not a different program (and the
+  divide-by-zero of naive ``logits / temperature`` can never happen).
+- :func:`filter_logits` / :func:`sample_tokens` / :func:`sampling_probs` —
+  dynamic top-k *and* top-p from ONE full descending sort plus per-slot
+  masks.  ``top_k <= 0`` or ``top_k >= vocab`` disables the k-filter,
+  ``top_p >= 1`` disables the nucleus filter, all per row, all traced.
+- **Counter-based keys** — the key for the token at absolute stream
+  position ``p`` is ``fold_in(PRNGKey(seed), p)`` (:func:`position_keys`).
+  No split-chain state: a replayed or failed-over stream that re-prefills
+  ``prompt + generated`` re-derives the SAME key at every continuation
+  position, which is what keeps ``ServingSupervisor`` replay and fleet
+  mid-stream resume token-exact under sampling (docs/FLEET.md journals the
+  lane seed + counter for exactly this).  Speculative decoding salts these
+  keys per role (``inference/speculative.py``).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SamplingParams", "filter_logits", "position_keys",
+           "sample_tokens", "sampling_probs"]
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling lane.  The defaults ARE greedy decoding — a
+    request without sampling params behaves exactly as before this
+    subsystem existed.
+
+    ``temperature``: softmax temperature; ``<= 0`` folds to greedy
+    in-graph.  ``top_k``: keep the k highest logits (``0`` or ``>= vocab``
+    = no filter).  ``top_p``: keep the smallest prefix of the (top-k
+    filtered) distribution with mass ``>= top_p`` (``1.0`` = no filter).
+    ``seed``: the lane seed — the key for the token at stream position
+    ``p`` is ``fold_in(PRNGKey(seed), p)``, so equal (seed, params, model)
+    ⇒ equal tokens on any engine, any replay, any failover resume."""
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature <= 0.0
+
+    def validate(self) -> "SamplingParams":
+        if not (0.0 < self.top_p <= 1.0):
+            raise ValueError(
+                f"top_p={self.top_p} must be in (0, 1] (1.0 disables the "
+                "nucleus filter; <= 0 would keep an empty support)")
+        if self.top_k < 0:
+            raise ValueError(
+                f"top_k={self.top_k} must be >= 0 (0 disables the filter)")
+        if self.seed < 0:
+            raise ValueError(
+                f"seed={self.seed} must be >= 0 (lane seeds are journaled "
+                "as unsigned ints)")
+        return self
+
+
+GREEDY = SamplingParams()
+
+
+def position_keys(seeds: jax.Array, positions: jax.Array,
+                  salt: Optional[int] = None) -> jax.Array:
+    """The counter-based lane schedule: key for the token at absolute
+    position ``p`` of lane ``seed`` is ``fold_in(PRNGKey(seed), p)`` —
+    with an optional role ``salt`` folded on top (speculative decoding
+    derives draft/accept/resample randomness at one position).  Both
+    array args ``[B]``; returns ``[B, 2]`` keys.  Pure function of
+    (seed, position, salt) — replay/failover at any position re-derives
+    it.  This is the ONE spelling of the schedule; every consumer must
+    come through here or replay-exactness silently forks."""
+    def one(s, p):
+        k = jax.random.fold_in(jax.random.PRNGKey(s), p)
+        return jax.random.fold_in(k, salt) if salt is not None else k
+
+    return jax.vmap(one)(seeds, positions)
+
+
+def filter_logits(logits: jax.Array, temperature: jax.Array,
+                  top_k: jax.Array, top_p: jax.Array) -> jax.Array:
+    """Temper + filter ``[B, V]`` logits with per-row params (all ``[B]``),
+    returning float32 logits with ``-inf`` outside the kept support.
+
+    ONE full descending sort serves both filters (dynamic per-row k/p make
+    ``lax.top_k``'s static k unusable): the k-th sorted value thresholds
+    top-k, and the nucleus cutoff is read off the cumulative softmax of the
+    k-masked sorted row — the smallest prefix with mass ``>= top_p`` stays.
+    ``top_k <= 0`` / ``>= V`` and ``top_p >= 1`` disable their filter per
+    row; ``temperature <= 0`` rows pass through unscaled (the samplers fold
+    them to argmax — never a division by zero)."""
+    lg = logits.astype(jnp.float32)
+    V = lg.shape[-1]
+    greedy = temperature <= 0.0
+    lg = lg / jnp.where(greedy, 1.0, temperature).astype(jnp.float32)[:, None]
+    sorted_lg = jnp.sort(lg, axis=-1)[:, ::-1]
+    k_eff = jnp.where((top_k <= 0) | (top_k >= V), V,
+                      top_k).astype(jnp.int32)
+    kth = jnp.take_along_axis(sorted_lg, (k_eff - 1)[:, None], axis=-1)
+    keep = lg >= kth
+    sorted_masked = jnp.where(
+        jnp.arange(V, dtype=jnp.int32)[None, :] < k_eff[:, None],
+        sorted_lg, -jnp.inf)
+    probs = jax.nn.softmax(sorted_masked, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep the smallest prefix with mass >= top_p (same boundary the
+    # pre-subsystem generate() used: the cutoff entry itself is kept)
+    cutoff_idx = jnp.minimum(jnp.sum(cum < top_p[:, None], axis=-1), V - 1)
+    cutoff = jnp.take_along_axis(sorted_masked, cutoff_idx[:, None], axis=-1)
+    keep &= (lg >= cutoff) | (top_p >= 1.0)[:, None]
+    return jnp.where(keep, lg, -jnp.inf)
+
+
+def sample_tokens(logits: jax.Array, temperature: jax.Array,
+                  top_k: jax.Array, top_p: jax.Array,
+                  keys: jax.Array) -> jax.Array:
+    """Sample one token per row: ``[B, V]`` logits, ``[B]`` param lanes,
+    ``[B, 2]`` per-row keys -> ``[B]`` int32.  Greedy rows (``temperature
+    <= 0``) take the raw argmax in-graph — one program serves any mix.
+
+    An ALL-greedy call (the default serving workload: nobody passed
+    SamplingParams) must not pay for the lane machinery: ``lax.cond``
+    executes only the taken branch, so a tick with no sampled lane costs
+    one argmax plus a scalar predicate — the pre-subsystem decode cost —
+    while still being the same compiled program a mixed tick runs.
+    ``keys`` may be a zero-arg callable returning the keys: it is invoked
+    INSIDE the sampled branch, so per-row key derivation (threefry is not
+    cheap) is also skipped on all-greedy ticks."""
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def drawn(_):
+        k = keys() if callable(keys) else keys
+        filtered = filter_logits(logits, temperature, top_k, top_p)
+        sampled = jax.vmap(jax.random.categorical)(k, filtered)
+        return jnp.where(temperature <= 0.0, greedy_tok,
+                         sampled).astype(jnp.int32)
+
+    return jax.lax.cond(jnp.any(temperature > 0.0), drawn,
+                        lambda _: greedy_tok, None)
+
+
+def sampling_probs(logits: jax.Array, temperature: jax.Array,
+                   top_k: jax.Array, top_p: jax.Array) -> jax.Array:
+    """The normalized distribution :func:`sample_tokens` draws from, as
+    explicit ``[B, V]`` float32 probs (greedy rows are one-hot at the raw
+    argmax).  Speculative decoding needs it on both sides of the
+    accept test: draft proposal probs ``q`` and target probs ``p``
+    (``inference/speculative.py``)."""
+    filtered = filter_logits(logits, temperature, top_k, top_p)
+    probs = jax.nn.softmax(filtered, axis=-1)
+    onehot = jax.nn.one_hot(jnp.argmax(logits, axis=-1), logits.shape[-1],
+                            dtype=jnp.float32)
+    return jnp.where((temperature <= 0.0)[:, None], onehot, probs)
+
+
+def as_lanes(sampling: Optional[SamplingParams]):
+    """``(temperature, top_k, top_p, seed)`` scalar lane values for one
+    request (``None`` = the greedy lane) — what the serving engine writes
+    into its per-slot state arrays at admission."""
+    sp = sampling if sampling is not None else GREEDY
+    return (float(sp.temperature), int(sp.top_k), float(sp.top_p),
+            int(sp.seed))

@@ -46,10 +46,23 @@ indirection: the program inventory is unchanged at steady state and
 shared-prefix outputs stay token-exact with the unshared path (K/V at
 position ``t`` is a pure function of tokens ``0..t``).
 
-Generation is greedy (the continuous-batching contract is token-identical
-outputs to per-request ``generate(greedy=True)``; per-slot sampling state is
-future work).  The loop is host-driven and synchronous: one device program +
-one [B_slots] token fetch per tick.
+Generation runs per-slot RNG lanes (docs/SERVING.md "Sampling"): each
+request may carry a :class:`~.sampling.SamplingParams` (temperature /
+top-k / top-p / seed) and the ONE decode program samples with *traced*
+per-slot parameter vectors — greedy is just the ``temperature <= 0`` lane
+value, so any mix of greedy and sampled slots shares the same compiled
+program and admission never recompiles.  Keys are counter-based
+(``fold_in(PRNGKey(seed), position)``), which makes sampled streams
+engine-independent and replay/failover-exact, and keeps the parity
+contract: same seed/params ⇒ serving output token-identical to
+``generate(sampling=...)``.  With ``speculative=``
+(:class:`~.speculative.SpeculativeConfig`) a small draft model decodes k
+candidates per tick against its own mirrored paged pool and the target
+verifies all k in one fixed-shape pass — 1..k tokens per slot per tick,
+target distribution preserved by in-graph rejection sampling, greedy
+speculative token-exact vs non-speculative greedy.  The loop is
+host-driven and synchronous: one device program + one [B_slots] token
+fetch per tick (k+1 programs per tick under speculation).
 
 Resilience (docs/SERVING.md "Failure handling"): per-request deadlines and a
 bounded admission queue with explicit load shedding — expired or shed
@@ -95,6 +108,8 @@ from ..resilience import (SITE_SERVE_ADMIT, SITE_SERVE_DECODE,
 from ..utils.logging import log_dist, logger
 from .engine import InferenceEngine
 from .prefix_cache import PrefixIndex, PrefixMatch
+from .sampling import SamplingParams, as_lanes, position_keys, sample_tokens
+from .speculative import SpeculativeConfig, SpeculativeDecoder
 
 _bucket = InferenceEngine._bucket   # shared prompt-length bucketing (pow2>=16)
 
@@ -154,6 +169,12 @@ class Request:
     # arrival_s/ttft_s stamps and retry hints anchored to the true arrival
     # instead of the replacement engine's reset clock (docs/SERVING.md).
     arrival_epoch_s: Optional[float] = None
+    # per-request sampling lane (None = greedy, the historical contract).
+    # Counter-based keys (fold_in(PRNGKey(seed), position)) make the
+    # sampled stream a pure function of (seed, params, model), so replay,
+    # failover resume and cross-engine parity with generate(sampling=...)
+    # all stay token-exact (docs/SERVING.md "Sampling").
+    sampling: Optional[SamplingParams] = None
 
 
 @dataclasses.dataclass
@@ -227,6 +248,10 @@ class _Slot:
     admit_s: float
     first_token_s: float
     shared_tokens: int = 0      # prompt tokens mapped from the prefix index
+    # decode-program invocations that fed this slot (the prefill token is
+    # not one).  Without speculation this is len(tokens) - 1; a speculative
+    # verify tick emits 1..k+1 tokens per invocation, so it can be less.
+    decode_ticks: int = 0
 
 
 class ServingEngine:
@@ -245,7 +270,8 @@ class ServingEngine:
                  max_queue: Optional[int] = None, quarantine_limit: int = 2,
                  probe_after_ticks: Optional[int] = None,
                  prefix_cache: bool = True,
-                 prefix_index_entries: int = 4096):
+                 prefix_index_entries: int = 4096,
+                 speculative: Optional[SpeculativeConfig] = None):
         if not hasattr(model, "apply_paged"):
             raise ValueError(
                 "ServingEngine needs a model with the paged decode contract "
@@ -326,6 +352,21 @@ class ServingEngine:
         self._lengths = np.zeros((self.b_slots,), np.int32)
         self._last_tok = np.zeros((self.b_slots,), np.int32)
         self._active = np.zeros((self.b_slots,), bool)
+        # per-slot RNG lanes (docs/SERVING.md "Sampling"): traced parameter
+        # vectors the ONE decode program samples with — greedy is just the
+        # temperature<=0 lane value, so a heterogeneous request mix never
+        # changes program shape.  The seed lane + the slot's position
+        # counter (== _lengths) fully determine every sampled token.
+        self._lane_temp = np.zeros((self.b_slots,), np.float32)
+        self._lane_top_k = np.zeros((self.b_slots,), np.int32)
+        self._lane_top_p = np.ones((self.b_slots,), np.float32)
+        self._lane_seed = np.zeros((self.b_slots,), np.uint32)
+        # device copy of the lane vectors, rebuilt only when a lane
+        # changes (admission / retirement) — unlike lengths/last_tok the
+        # lanes are constant across a request's whole decode, so the
+        # per-tick call must not pay 4 host->device transfers for them
+        self._lanes_device = None
+        self.sampled_admissions = 0   # non-greedy requests admitted
         self._slots: List[Optional[_Slot]] = [None] * self.b_slots
         self._queue: Deque[Request] = deque()
         self._pending: List[Request] = []   # arrival-gated, sorted by time
@@ -387,6 +428,22 @@ class ServingEngine:
             # the zero-recompile steady state must hold from the first tick
             self._kpool, self._vpool = self._cow_prog(
                 self._kpool, self._vpool, jnp.int32(0), jnp.int32(0))
+        # speculative decoding (docs/SERVING.md "Speculative decoding"): a
+        # draft model over its OWN pool with the same page geometry,
+        # indexed by the same per-slot page tables — admission prefills
+        # both pools, COW snapshots both, page accounting stays the
+        # engine's.  Draft decode + verify compile here, at init.
+        self._spec: Optional[SpeculativeDecoder] = None
+        if speculative is not None:
+            speculative.validate(model, self.max_model_len)
+            self._spec = SpeculativeDecoder(
+                speculative, model, self.num_pages, self.page_size,
+                self.b_slots, dtype=dtype, mesh=mesh,
+                donate=bool(self._donate))
+            if self._cow_prog is not None:
+                # pre-warm the COW jit on the DRAFT pool aval too: a
+                # boundary COW at admission must never compile
+                self._spec.cow(self._cow_prog, 0, 0)
         log_dist(
             f"serving engine ready: b_slots={self.b_slots} "
             f"pages={self.num_pages}x{self.page_size} "
@@ -397,13 +454,20 @@ class ServingEngine:
     def _build_decode(self):
         apply_paged = self.model.apply_paged
 
-        def prog(params, kpool, vpool, page_table, lengths, last_tok, active):
+        def prog(params, kpool, vpool, page_table, lengths, last_tok, active,
+                 temp, top_k, top_p, seeds):
             # write each slot's last token at position `lengths`, read the
-            # next-token logits; inactive slots write to the trash page
+            # next-token logits; inactive slots write to the trash page.
+            # The sampled token will sit at stream position `lengths + 1`,
+            # so its lane key folds that position — the same counter
+            # generate(sampling=...) and a replay/failover re-prefill
+            # derive, which is what keeps sampled streams engine-
+            # independent and resume-exact (docs/SERVING.md "Sampling").
             cache = {"k": kpool, "v": vpool}
             logits, cache = apply_paged(params, last_tok[:, None], cache,
                                         page_table, lengths, active[:, None])
-            nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+            nxt = sample_tokens(logits[:, -1, :], temp, top_k, top_p,
+                                lambda: position_keys(seeds, lengths + 1))
             return nxt, cache["k"], cache["v"]
 
         return jax.jit(prog, donate_argnums=self._donate)
@@ -411,21 +475,30 @@ class ServingEngine:
     def _build_prefill(self, s_pad: int):
         apply_paged = self.model.apply_paged
 
-        def prog(params, kpool, vpool, pt_row, tokens, n_real, start):
+        def prog(params, kpool, vpool, pt_row, tokens, n_real, start,
+                 temp, top_k, top_p, seed):
             # tokens [1, s_pad] right-padded; only the first n_real K/V are
             # written (pads go to the trash page); the first generated token
-            # is argmax of the last REAL position's logits.  `start` is the
-            # slot position of tokens[:, 0] — 0 for a cold prefill, the
-            # shared-prefix length for a tail prefill (the gather still
-            # covers the whole page-table row, so queries attend to the
-            # shared pages through the ordinary causal mask).  A traced
-            # scalar: every start shares ONE program per bucket.
+            # samples the last REAL position's logits under the request's
+            # lane ([1]-shaped traced params — greedy folds to argmax
+            # in-graph, so the historical greedy contract is bit-identical).
+            # `start` is the slot position of tokens[:, 0] — 0 for a cold
+            # prefill, the shared-prefix length for a tail prefill (the
+            # gather still covers the whole page-table row, so queries
+            # attend to the shared pages through the ordinary causal mask).
+            # A traced scalar: every start shares ONE program per bucket.
             seq_mask = (jnp.arange(s_pad, dtype=jnp.int32) < n_real)[None, :]
             cache = {"k": kpool, "v": vpool}
             logits, cache = apply_paged(params, tokens, cache, pt_row,
                                         start[None], seq_mask)
-            nxt = jnp.argmax(logits[0, n_real - 1, :], axis=-1)
-            return nxt.astype(jnp.int32), cache["k"], cache["v"]
+            lg = logits[0, n_real - 1, :][None]        # [1, V]
+            # the emitted token will sit at stream position S = start +
+            # n_real — the counter-based key generate(sampling=...) and
+            # every replay/failover resume re-derive for the same position
+            nxt = sample_tokens(
+                lg, temp, top_k, top_p,
+                lambda: position_keys(seed, (start + n_real)[None]))[0]
+            return nxt, cache["k"], cache["v"]
 
         return jax.jit(prog, donate_argnums=self._donate)
 
@@ -448,6 +521,11 @@ class ServingEngine:
         inv = {"decode": 1, "prefill_buckets": sorted(self._prefill_progs)}
         if self._cow_prog is not None:
             inv["cow"] = 1
+        if self._spec is not None:
+            # draft decode + verify compile at init; draft prefills track
+            # the target's bucket set — admission (greedy, sampled or
+            # speculative mix) never grows any of it
+            inv["speculative"] = self._spec.program_inventory()
         return inv
 
     # ---------------------------------------------------------- scheduling
@@ -652,6 +730,8 @@ class ServingEngine:
             raise ValueError(
                 f"request {request.rid!r}: deadline_s={request.deadline_s} "
                 "must be > 0 (measured from arrival)")
+        if request.sampling is not None:
+            request.sampling.validate()
         rid = request.rid
         if rid in self._live_rids:
             raise ValueError(
@@ -826,6 +906,7 @@ class ServingEngine:
         self._page_table[slot, :len(pages)] = pages
         toks = np.zeros((1, s_pad), np.int32)
         toks[0, :S_tail] = tail
+        lane_t, lane_k, lane_p, lane_s = as_lanes(req.sampling)
         with trace_span("serve.prefill", rid=req.rid, slot=slot,
                         bucket=s_pad, shared_tokens=n_shared):
             maybe_fire(SITE_SERVE_PREFILL, rid=req.rid, slot=slot)
@@ -841,12 +922,30 @@ class ServingEngine:
                         self._kpool, self._vpool,
                         jnp.int32(match.cow_src), jnp.int32(private[0]))
                     self.cow_copies += 1
+                    if self._spec is not None:
+                        # mirror the snapshot in the draft pool — the
+                        # sharer's draft-side boundary must hold the same
+                        # donor prefix its target-side boundary does
+                        self._spec.cow(self._cow_prog, match.cow_src,
+                                       private[0])
+                pt_row = jnp.asarray(self._page_table[slot:slot + 1])
+                toks_j = jnp.asarray(toks)
+                # lanes ride as numpy arrays: jit device-puts them without
+                # compiling the tiny list->array convert programs a
+                # jnp.asarray of a Python list would cost on first use
                 nxt, self._kpool, self._vpool = prog(
                     self.params, self._kpool, self._vpool,
-                    jnp.asarray(self._page_table[slot:slot + 1]),
-                    jnp.asarray(toks), jnp.int32(S_tail),
-                    jnp.int32(n_shared))
+                    pt_row, toks_j, jnp.int32(S_tail), jnp.int32(n_shared),
+                    np.asarray([lane_t], np.float32),
+                    np.asarray([lane_k], np.int32),
+                    np.asarray([lane_p], np.float32),
+                    np.asarray([lane_s], np.uint32))
                 tok = int(nxt)   # host fetch inside the watchdog window
+                if self._spec is not None:
+                    # draft-pool prefill of the same tail (same bucket,
+                    # page-table row, start) — the draft emits nothing
+                    self._spec.prefill(s_pad, pt_row, toks_j, S_tail,
+                                       n_shared)
         t = time.monotonic()
         self._slot_failures[slot] = 0   # quarantine counts CONSECUTIVE fails
         self._slots[slot] = _Slot(
@@ -856,6 +955,13 @@ class ServingEngine:
         self._lengths[slot] = S
         self._last_tok[slot] = tok
         self._active[slot] = True
+        self._lane_temp[slot] = lane_t
+        self._lane_top_k[slot] = lane_k
+        self._lane_top_p[slot] = lane_p
+        self._lane_seed[slot] = lane_s
+        self._lanes_device = None
+        if req.sampling is not None and not req.sampling.greedy:
+            self.sampled_admissions += 1
         self._tokens_out += 1
         if self._prefix is not None:
             if n_shared > 0:
@@ -890,14 +996,27 @@ class ServingEngine:
 
         return contextlib.nullcontext()
 
+    def _lanes_jnp(self):
+        if self._lanes_device is None:
+            self._lanes_device = (jnp.asarray(self._lane_temp),
+                                  jnp.asarray(self._lane_top_k),
+                                  jnp.asarray(self._lane_top_p),
+                                  jnp.asarray(self._lane_seed))
+        return self._lanes_device
+
     def _decode_tick(self) -> None:
+        if self._spec is not None:
+            self._spec_tick()
+            return
+        lanes = self._lanes_jnp()
         with trace_span("serve.decode", tick=self._tick):
             maybe_fire(SITE_SERVE_DECODE, tick=self._tick)
             with self._armed(f"serve.decode tick {self._tick}"):
                 nxt, self._kpool, self._vpool = self._decode_prog(
                     self.params, self._kpool, self._vpool,
                     jnp.asarray(self._page_table), jnp.asarray(self._lengths),
-                    jnp.asarray(self._last_tok), jnp.asarray(self._active))
+                    jnp.asarray(self._last_tok), jnp.asarray(self._active),
+                    *lanes)
                 nxt = np.asarray(nxt)   # host fetch = device sync
         active_slots = np.flatnonzero(self._active)
         trace_count("serve.tokens", float(len(active_slots)))
@@ -906,6 +1025,7 @@ class ServingEngine:
             req = st.request
             tok = int(nxt[slot])
             st.tokens.append(tok)
+            st.decode_ticks += 1
             self._lengths[slot] += 1
             self._last_tok[slot] = tok
             self._tokens_out += 1
@@ -913,6 +1033,48 @@ class ServingEngine:
                 self._finish(slot, "eos")
             elif len(st.tokens) >= req.max_new_tokens:
                 self._finish(slot, "length")
+
+    def _spec_tick(self) -> None:
+        """Speculative decode tick: k draft proposals + one verify-k pass,
+        then per-slot host bookkeeping consuming 1..k emitted tokens
+        (truncated by the slot's own eos / remaining budget — rejected or
+        over-budget draft K/V past the consumed length is causally
+        invisible garbage the next tick's writes overwrite)."""
+        with trace_span("serve.decode", tick=self._tick,
+                        speculative=self._spec.k):
+            maybe_fire(SITE_SERVE_DECODE, tick=self._tick)
+            with self._armed(f"serve.decode tick {self._tick} "
+                             f"(speculative k={self._spec.k})"):
+                emitted, n_emit, self._kpool, self._vpool = self._spec.tick(
+                    self.params, self._kpool, self._vpool,
+                    self._page_table, self._lengths, self._last_tok,
+                    self._active, *self._lanes_jnp())
+        active_slots = np.flatnonzero(self._active)
+        total = 0
+        for slot in active_slots:
+            st = self._slots[slot]
+            req = st.request
+            consumed = 0
+            finish = None
+            for j in range(int(n_emit[slot])):
+                tok = int(emitted[slot, j])
+                st.tokens.append(tok)
+                consumed += 1
+                self._tokens_out += 1
+                if req.eos_token_id is not None and tok == req.eos_token_id:
+                    finish = "eos"
+                    break
+                if len(st.tokens) >= req.max_new_tokens:
+                    finish = "length"
+                    break
+            st.decode_ticks += 1
+            total += consumed
+            self._spec.emitted_tokens += consumed
+            self._lengths[slot] += consumed
+            self._last_tok[slot] = st.tokens[-1]
+            if finish is not None:
+                self._finish(slot, finish)
+        trace_count("serve.tokens", float(total))
 
     def _finish(self, slot: int, reason: str) -> None:
         st = self._slots[slot]
@@ -922,9 +1084,10 @@ class ServingEngine:
             finish_reason=reason, prefill_bucket=st.bucket,
             arrival_s=st.arrival_s, admit_s=st.admit_s,
             first_token_s=st.first_token_s, finish_s=time.monotonic(),
-            # the prefill produced tokens[0]; every later token is one
-            # decode-program invocation (the request's timeline tick count)
-            decode_ticks=len(st.tokens) - 1,
+            # the prefill produced tokens[0]; every later token came from a
+            # decode-program invocation (== len(tokens) - 1 without
+            # speculation; a speculative verify tick emits several)
+            decode_ticks=st.decode_ticks,
             shared_prefix_tokens=st.shared_tokens)
         if reason == "deadline":
             self.deadline_count += 1
@@ -946,6 +1109,11 @@ class ServingEngine:
         self._lengths[slot] = 0
         self._last_tok[slot] = 0
         self._page_table[slot, :] = 0
+        self._lane_temp[slot] = 0.0
+        self._lane_top_k[slot] = 0
+        self._lane_top_p[slot] = 1.0
+        self._lane_seed[slot] = 0
+        self._lanes_device = None
 
     # ----------------------------------------------------- probe / unfence
 
@@ -985,7 +1153,11 @@ class ServingEngine:
                     nxt, self._kpool, self._vpool = prog(
                         self.params, self._kpool, self._vpool,
                         jnp.asarray(self._page_table[slot:slot + 1]),
-                        jnp.asarray(toks), jnp.int32(1), jnp.int32(0))
+                        jnp.asarray(toks), jnp.int32(1), jnp.int32(0),
+                        np.zeros((1,), np.float32),        # greedy canary
+                        np.zeros((1,), np.int32),          # lane: the same
+                        np.ones((1,), np.float32),         # program shape
+                        np.zeros((1,), np.uint32))         # admissions use
                     int(nxt)   # host fetch: the probe must really complete
         except BaseException as e:
             self._page_table[slot, :] = 0
@@ -1026,9 +1198,13 @@ class ServingEngine:
 
     def pool_alive(self) -> bool:
         """False once a failed donated device call consumed the pool
-        buffers — the engine can no longer decode and must be rebuilt."""
+        buffers (the speculative draft pool counts: a consumed draft pool
+        poisons every subsequent verify) — the engine can no longer decode
+        and must be rebuilt."""
         dead = getattr(self._kpool, "is_deleted", None)
-        return not (dead and self._kpool.is_deleted())
+        if dead and self._kpool.is_deleted():
+            return False
+        return self._spec is None or self._spec.pool_alive()
 
     def step(self, now: Optional[float] = None) -> int:
         """One scheduler tick: expire dead deadlines, admit into free
@@ -1198,6 +1374,22 @@ class ServingEngine:
             "prefix_index_entries": (len(self._prefix)
                                      if self._prefix is not None else 0),
             "cow_copies_total": self.cow_copies,
+            # sampling / speculative (docs/SERVING.md): non-greedy
+            # admissions, and — with a draft configured — the verify-tick
+            # economics operators size k from (mean accepted length > 1
+            # means the draft pays for itself)
+            "sampled_admissions_total": self.sampled_admissions,
+            "speculative_k": self._spec.k if self._spec is not None else 0,
+            "spec_verify_slot_ticks_total": (self._spec.verify_slot_ticks
+                                             if self._spec is not None
+                                             else 0),
+            "spec_emitted_tokens_total": (self._spec.emitted_tokens
+                                          if self._spec is not None else 0),
+            "spec_drafted_tokens_total": (self._spec.drafted_tokens
+                                          if self._spec is not None else 0),
+            "spec_mean_accepted_len": round(
+                self._spec.mean_accepted_len(), 4) if self._spec is not None
+            else 0.0,
             "oldest_request_age_s": round(self._oldest_age_s(now), 4),
             "retry_after_hint_s": self._retry_after_hint(),
             "unclaimed_results": len(self._finished_order),
@@ -1268,6 +1460,15 @@ class ServingEngine:
              float(self._prefix.evictions if self._prefix is not None
                    else 0), self._tick),
             ("serve/cow_copies_total", float(self.cow_copies), self._tick),
+            ("serve/sampled_admissions_total",
+             float(self.sampled_admissions), self._tick),
             ("serve/oldest_request_age_s",
              self._oldest_age_s(time.monotonic()), self._tick),
         ])
+        if self._spec is not None:
+            self.monitor.write_events([
+                ("serve/spec_emitted_tokens_total",
+                 float(self._spec.emitted_tokens), self._tick),
+                ("serve/spec_mean_accepted_len",
+                 self._spec.mean_accepted_len(), self._tick),
+            ])

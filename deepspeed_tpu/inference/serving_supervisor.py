@@ -21,8 +21,12 @@ caller-supplied factory and drives the same ``run``/``submit``/``health``/
    re-init, not recompilation;
 3. replays in-flight requests by re-prefilling ``prompt + tokens generated
    so far`` with the remaining token budget — greedy decoding makes the
-   continuation **token-exact**, so a replayed request's stitched output is
-   identical to a fault-free run (the chaos tests assert this);
+   continuation **token-exact**, and sampled requests stay token-exact too:
+   their RNG lanes are counter-based (``fold_in(PRNGKey(seed), position)``,
+   see ``inference/sampling.py``), so the replacement engine re-derives the
+   identical key at every continuation position — a replayed request's
+   stitched output is identical to a fault-free run (the chaos tests assert
+   this);
 4. re-queues everything that was still waiting (bounded-queue shedding is
    suspended during replay: a request the engine already accepted is never
    shed by its own recovery).
@@ -114,6 +118,10 @@ class ServingSupervisor:
         self._prefix_pages_base = 0
         self._prefix_evictions_base = 0
         self._cow_base = 0
+        self._sampled_base = 0
+        self._spec_ticks_base = 0
+        self._spec_emitted_base = 0
+        self._spec_drafted_base = 0
         self._pages_hwm_base = 0
         self._quarantined_slots_lifetime = 0
         self._quarantined_pages_lifetime = 0
@@ -315,6 +323,14 @@ class ServingSupervisor:
         h["prefix_pages_shared_total"] += self._prefix_pages_base
         h["prefix_evictions_total"] += self._prefix_evictions_base
         h["cow_copies_total"] += self._cow_base
+        h["sampled_admissions_total"] += self._sampled_base
+        h["spec_verify_slot_ticks_total"] += self._spec_ticks_base
+        h["spec_emitted_tokens_total"] += self._spec_emitted_base
+        h["spec_drafted_tokens_total"] += self._spec_drafted_base
+        if h["spec_verify_slot_ticks_total"]:
+            h["spec_mean_accepted_len"] = round(
+                h["spec_emitted_tokens_total"]
+                / h["spec_verify_slot_ticks_total"], 4)
         h["pages_hwm"] = max(h["pages_hwm"], self._pages_hwm_base)
         h["quarantined_slots_lifetime"] = (self._quarantined_slots_lifetime
                                            + h["quarantined_slots"])
@@ -529,6 +545,11 @@ class ServingSupervisor:
         self._prefix_evictions_base += (old._prefix.evictions
                                         if old._prefix is not None else 0)
         self._cow_base += old.cow_copies
+        self._sampled_base += old.sampled_admissions
+        if old._spec is not None:
+            self._spec_ticks_base += old._spec.verify_slot_ticks
+            self._spec_emitted_base += old._spec.emitted_tokens
+            self._spec_drafted_base += old._spec.drafted_tokens
         self._pages_hwm_base = max(self._pages_hwm_base, old._pages_hwm)
         self._quarantined_slots_lifetime += int(old._quarantined.sum())
         self._quarantined_pages_lifetime += len(old._quarantined_pages)
@@ -601,5 +622,9 @@ class ServingSupervisor:
             new._prefill_progs.update(old._prefill_progs)
             # _cow_prog needs no adoption: it is the process-global
             # _COW_PROGS jit, already shared by both engines
+            if new._spec is not None and new._spec.compatible(old._spec):
+                # same draft model/k/pool geometry: the speculative
+                # programs are cache hits on the fresh draft pool's avals
+                new._spec.adopt_programs(old._spec)
             return True
         return False

@@ -1635,9 +1635,17 @@ def forward_paged(cfg: TransformerConfig, params: Dict[str, Any],
     False tokens' K/V are redirected to the trash page and their logits are
     garbage (the caller reads logits only at real positions).
 
-    One function, two static shapes at steady state — bucketed prefill
-    ``[1, S_pad]`` and fleet decode ``[B_slots, 1]`` — so admission into a
-    running batch never recompiles.  Returns ``(logits [B,S,V], new_cache)``.
+    One function, three static shapes at steady state — bucketed prefill
+    ``[1, S_pad]``, fleet decode ``[B_slots, 1]``, and (with speculative
+    decoding) the verify-k block ``[B_slots, k+1]`` that writes the pending
+    token plus k draft proposals and returns all k+1 next-token
+    distributions in one traversal (``inference/speculative.py``) — so
+    admission into a running batch never recompiles.  Positions past the
+    slot's page table (a verify block straddling the reserved region, or a
+    rejected-draft tail near ``max_model_len``) write to the trash page
+    rather than wrapping into the clamped last page, so multi-token decode
+    can never corrupt live K/V; their logits are garbage the caller never
+    reads.  Returns ``(logits [B,S,V], new_cache)``.
     """
     assert cfg.pipeline_stages == 1, "paged decode requires pipeline_stages=1"
     if not cfg.causal:
@@ -1657,12 +1665,17 @@ def forward_paged(cfg: TransformerConfig, params: Dict[str, Any],
     maxp = page_table.shape[1]
 
     positions = start[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
-    page_idx = jnp.minimum(positions // ps, maxp - 1)
+    raw_idx = positions // ps
+    page_idx = jnp.minimum(raw_idx, maxp - 1)
     phys = jnp.take_along_axis(page_table, page_idx, axis=1)       # [B,S]
     flat = phys * ps + positions % ps
-    # masked tokens write to the trash page (page 0, offset 0): the scatter
-    # keeps its static shape and real pages are never corrupted
-    write_idx = jnp.where(seq_mask, flat, 0).reshape(B * S)
+    # masked tokens AND positions past the page table write to the trash
+    # page (page 0, offset 0): the scatter keeps its static shape and real
+    # pages are never corrupted — without the in-table guard a verify-k
+    # block past the table end would silently wrap into the clamped last
+    # page and overwrite confirmed K/V
+    write_idx = jnp.where(seq_mask & (raw_idx < maxp),
+                          flat, 0).reshape(B * S)
     gather_idx = (page_table[:, :, None] * ps
                   + jnp.arange(ps, dtype=jnp.int32)[None, None, :]
                   ).reshape(B, maxp * ps)

@@ -503,6 +503,75 @@ def test_fleet_midstream_kill_resumes_after_last_journaled_token(
     assert {"fleet/journal_bytes", "fleet/resumed_tokens_total"} <= names
 
 
+@pytest.mark.chaos
+def test_fleet_sampled_midstream_resume_token_exact(tiny_engine, tmp_path):
+    """ISSUE 9 acceptance: a SAMPLED stream killed mid-flight resumes
+    token-exact.  The journal carries the RNG lane (sampling params incl.
+    seed + lane_counter), the survivor re-prefills prompt+journaled and —
+    because lane keys are counter-based — re-derives the identical key at
+    every continuation position: the resumed sampled output equals the
+    fault-free run, not merely its distribution."""
+    from deepspeed_tpu.inference.sampling import SamplingParams
+
+    rng = np.random.default_rng(17)
+    reqs = [Request(rid=i,
+                    input_ids=rng.integers(1, 250,
+                                           int(rng.integers(3, 12))
+                                           ).astype(np.int32),
+                    max_new_tokens=8,
+                    sampling=SamplingParams(temperature=0.9, top_k=20,
+                                            top_p=0.9, seed=700 + i))
+            for i in range(6)]
+
+    def copies():
+        return [Request(rid=r.rid, input_ids=r.input_ids,
+                        max_new_tokens=r.max_new_tokens,
+                        sampling=r.sampling) for r in reqs]
+
+    # fault-free reference: sampled outputs are engine-independent (the
+    # lane is a pure function of seed + position)
+    serve = tiny_engine.serving(b_slots=3, page_size=8, max_model_len=64)
+    ref = {r.rid: r.output_ids for r in serve.run(copies())}
+    clock = [0.0]
+    store = FileCoordinationStore(str(tmp_path / "coord"),
+                                  clock=lambda: clock[0])
+    members = [FleetMember(f"engine{i}",
+                           tiny_engine.supervised_serving(max_restarts=5,
+                                                          **SERVE_KW),
+                           store, lease_s=1.0)
+               for i in range(3)]
+    router = FleetRouter(store, members, lease_s=100.0, miss_limit=3,
+                         journal_every_k=1)
+    lane_docs = []
+
+    def on_tick(r, rounds):
+        clock[0] += 1.0
+        if rounds == 3:
+            # journal entries must already carry the RNG-lane fields the
+            # successor needs (sampling params + the lane counter)
+            for name in store.list("fleet/requests"):
+                doc = store.get(f"fleet/requests/{name}")
+                if doc and doc.get("tokens"):
+                    lane_docs.append(doc)
+            r.members["engine0"].kill()
+
+    results = router.run(copies(), max_ticks=500, on_tick=on_tick)
+    assert lane_docs, "no journaled streams at the kill"
+    for doc in lane_docs:
+        assert doc["sampling"]["seed"] >= 700
+        assert doc["sampling"]["temperature"] == 0.9
+        assert doc["lane_counter"] == (len(doc["input_ids"])
+                                       + len(doc["tokens"]))
+    by = {r.rid: r for r in results}
+    assert sorted(by) == sorted(r.rid for r in reqs)
+    for rid, r in by.items():
+        assert r.finish_reason in ("eos", "length")
+        np.testing.assert_array_equal(r.output_ids, ref[rid])
+    resumed = [r for r in by.values() if r.resumed_tokens > 0]
+    assert router.failovers_total > 0 and resumed
+    assert store.list("fleet/requests") == []
+
+
 def test_fleet_journal_cap_bounds_resume(tiny_engine, tmp_path):
     """max_journal_tokens caps the per-request journal: the resume carries
     at most the cap (the tail past it is re-decoded) and the output stays
@@ -827,6 +896,29 @@ def test_fleet_chaos_soak_deterministic_midstream_seed(tmp_path):
     assert stats["terminal"] == 8
     assert stats["failovers"] > 0
     assert stats["resumed_results"] > 0 and stats["resumed_tokens"] > 0
+
+
+@pytest.mark.chaos
+def test_fleet_chaos_soak_deterministic_sampled_seed(tmp_path):
+    """Pinned seed 7 (ISSUE 9): the soak's stream is one-third sampled,
+    and at this seed a lease kill lands with SAMPLED journaled streams
+    outstanding — the resume must be token-exact (the soak asserts parity
+    per rid against the fault-free sampled reference) with
+    resumed_tokens > 0 on sampled results."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    os.pardir, os.pardir, "tools"))
+    from chaos_soak import run_fleet_soak
+
+    stats = run_fleet_soak(seed=7, coord_dir=str(tmp_path / "coord"),
+                           n_requests=8, verbose=False)
+    assert stats["kill_mode"] == "lease" and not stats["killed_coordinator"]
+    assert stats["terminal"] == 8
+    assert stats["failovers"] > 0
+    assert stats["resumed_results"] > 0 and stats["resumed_tokens"] > 0
+    assert stats["sampled_parity_checked"] > 0
+    assert stats["sampled_resumed_results"] > 0
 
 
 @pytest.mark.slow

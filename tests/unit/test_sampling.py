@@ -1,0 +1,412 @@
+"""Sampling subsystem + speculative decoding tests (ISSUE 9 tentpole).
+
+Covers: the traced per-slot sampling math (greedy fold, one-sort top-k/
+top-p, the generate() edge cases ISSUE 9 names), the sampled parity
+contract (ServingEngine output token-identical to ``generate(sampling=...)``
+under the same seed/params), zero-recompile admission of heterogeneous
+parameter mixes, warm-restart replay exactness under sampling, and
+speculative decoding (greedy token-exactness vs non-speculative, sampled
+determinism, budget/eos truncation mid-verify-block, pool accounting).
+
+Compile discipline (single-core CI): one module-scoped tiny engine, ONE
+shared plain serving shape and ONE speculative shape; streams draw from a
+single prompt bucket and a small max_new choice set.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.inference.sampling import (SamplingParams, filter_logits,
+                                              position_keys, sample_tokens,
+                                              sampling_probs)
+from deepspeed_tpu.inference.serving import Request
+from deepspeed_tpu.inference.speculative import (SpeculativeConfig,
+                                                 layer_skip_draft,
+                                                 perturbed_draft)
+from deepspeed_tpu.models import CausalLM
+from deepspeed_tpu.resilience import (FaultInjector, SITE_SERVE_DECODE,
+                                      clear_injector, install_injector)
+from deepspeed_tpu.utils.compile_counter import compile_counter
+
+_count = compile_counter()
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    clear_injector()
+    yield
+    clear_injector()
+
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    model = CausalLM("tiny", dtype=jnp.float32, attn_impl="xla")
+    params = model.init_fn(jax.random.PRNGKey(3))
+    return deepspeed_tpu.init_inference(
+        model=model, config={"dtype": "float32"}, params=params)
+
+
+@pytest.fixture(scope="module")
+def tiny_serve(tiny_engine):
+    return tiny_engine.serving(b_slots=3, page_size=8, max_model_len=64)
+
+
+@pytest.fixture(scope="module")
+def spec_serve(tiny_engine):
+    dm, dp = layer_skip_draft(tiny_engine.model, tiny_engine.params, 1)
+    return tiny_engine.serving(
+        b_slots=3, page_size=8, max_model_len=64,
+        speculative=SpeculativeConfig(draft_model=dm, draft_params=dp, k=3))
+
+
+def _mixed_lane(i, seed_base=100):
+    """Rotating greedy / temperature / top-k / combined parameter mix."""
+    return [None,
+            SamplingParams(temperature=0.8, seed=seed_base + i),
+            SamplingParams(temperature=1.3, top_k=9, seed=seed_base + i),
+            SamplingParams(temperature=1.0, top_k=40, top_p=0.9,
+                           seed=seed_base + i)][i % 4]
+
+
+def _stream(n, seed=0, new_choices=(4, 6, 8), sampled=True, eos=None,
+            rid_prefix=""):
+    rng = np.random.default_rng(seed)
+    return [Request(rid=f"{rid_prefix}{i}",
+                    input_ids=rng.integers(1, 250,
+                                           int(rng.integers(3, 14))
+                                           ).astype(np.int32),
+                    max_new_tokens=int(rng.choice(new_choices)),
+                    eos_token_id=eos,
+                    sampling=_mixed_lane(i) if sampled else None)
+            for i in range(n)]
+
+
+def _copies(reqs, rid_prefix=""):
+    return [Request(rid=f"{rid_prefix}{r.rid}", input_ids=r.input_ids,
+                    max_new_tokens=r.max_new_tokens,
+                    eos_token_id=r.eos_token_id, sampling=r.sampling)
+            for r in reqs]
+
+
+# ----------------------------------------------------- the sampling math
+
+def test_sample_tokens_greedy_fold_and_topk_edges():
+    """temperature<=0 folds to argmax in-graph (never a div-by-zero NaN);
+    top_k=1 is argmax; top_k=0 and top_k>=vocab are both 'no filter'."""
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(4, 32)), jnp.float32)
+    keys = position_keys(jnp.asarray([1, 2, 3, 4], jnp.uint32),
+                         jnp.asarray([5, 6, 7, 8], jnp.int32))
+    argmax = np.asarray(jnp.argmax(logits, axis=-1))
+
+    greedy = sample_tokens(logits, jnp.zeros(4), jnp.zeros(4, jnp.int32),
+                           jnp.ones(4), keys)
+    np.testing.assert_array_equal(np.asarray(greedy), argmax)
+    assert not np.isnan(np.asarray(greedy)).any()
+
+    k1 = sample_tokens(logits, jnp.ones(4), jnp.full((4,), 1, jnp.int32),
+                       jnp.ones(4), keys)
+    np.testing.assert_array_equal(np.asarray(k1), argmax)
+
+    # top_k >= vocab must behave exactly like top_k = 0 (filter off)
+    k_off = sample_tokens(logits, jnp.ones(4), jnp.zeros(4, jnp.int32),
+                          jnp.ones(4), keys)
+    k_big = sample_tokens(logits, jnp.ones(4), jnp.full((4,), 999,
+                                                        jnp.int32),
+                          jnp.ones(4), keys)
+    np.testing.assert_array_equal(np.asarray(k_off), np.asarray(k_big))
+
+
+def test_filter_logits_topk_topp_combination_boundary():
+    """Combined top-k+top-p: the nucleus applies to the k-masked
+    distribution; the cutoff entry itself is kept (mass >= top_p)."""
+    logits = jnp.log(jnp.asarray([[0.4, 0.3, 0.2, 0.1]], jnp.float32))
+    # top_p=0.7: {0.4, 0.3} is the smallest prefix with mass >= 0.7
+    f = filter_logits(logits, jnp.ones(1), jnp.zeros(1, jnp.int32),
+                      jnp.asarray([0.7], jnp.float32))
+    kept = np.isfinite(np.asarray(f))[0]
+    np.testing.assert_array_equal(kept, [True, True, False, False])
+    # top_k=3 first, then top_p=0.99 over the renormalized top-3: every
+    # surviving token is within the top-3 — index 3 can never survive
+    f = filter_logits(logits, jnp.ones(1), jnp.full((1,), 3, jnp.int32),
+                      jnp.asarray([0.99], jnp.float32))
+    assert not np.isfinite(np.asarray(f))[0, 3]
+    # per-row heterogeneity in ONE call: row 0 greedy-lane passthrough,
+    # row 1 top-k=1
+    two = jnp.concatenate([logits, logits])
+    f = filter_logits(two, jnp.asarray([0.0, 1.0]),
+                      jnp.asarray([0, 1], jnp.int32),
+                      jnp.asarray([1.0, 1.0]))
+    assert np.isfinite(np.asarray(f)[0]).all()          # no filter applied
+    assert np.isfinite(np.asarray(f)[1]).sum() == 1     # only the argmax
+
+
+def test_sampling_probs_matches_filter_and_one_hot_greedy():
+    rng = np.random.default_rng(1)
+    logits = jnp.asarray(rng.normal(size=(2, 16)), jnp.float32)
+    p = np.asarray(sampling_probs(logits, jnp.asarray([0.0, 1.0]),
+                                  jnp.asarray([0, 4], jnp.int32),
+                                  jnp.asarray([1.0, 1.0])))
+    # greedy row: one-hot at argmax
+    assert p[0].max() == 1.0 and p[0].argmax() == int(jnp.argmax(logits[0]))
+    # sampled row: normalized, support == top-4
+    assert abs(p[1].sum() - 1.0) < 1e-5
+    assert (p[1] > 0).sum() == 4
+
+
+# --------------------------------------------- generate() edge cases
+
+def test_generate_temperature_zero_is_greedy_not_nan(tiny_engine):
+    """ISSUE 9 satellite: temperature<=0 used to divide logits by zero."""
+    prompt = np.ones((2, 8), np.int32)
+    greedy = np.asarray(tiny_engine.generate(prompt, max_new_tokens=5))
+    t0 = np.asarray(tiny_engine.generate(prompt, max_new_tokens=5,
+                                         greedy=False, temperature=0.0,
+                                         rng=jax.random.PRNGKey(2)))
+    np.testing.assert_array_equal(greedy, t0)
+
+
+def test_generate_topk_ge_vocab_and_combined_boundary(tiny_engine):
+    """top_k >= vocab must disable the filter (not crash / not clamp to a
+    wrong kth threshold), and combined top_k+top_p keeps every sampled
+    token inside the top-k support."""
+    prompt = np.ones((2, 8), np.int32)
+    vocab = tiny_engine.model.config.vocab_size
+    big = np.asarray(tiny_engine.generate(
+        prompt, max_new_tokens=3, greedy=False, top_k=vocab + 7,
+        rng=jax.random.PRNGKey(3)))
+    off = np.asarray(tiny_engine.generate(
+        prompt, max_new_tokens=3, greedy=False, top_k=0,
+        rng=jax.random.PRNGKey(3)))
+    np.testing.assert_array_equal(big, off)
+    sampled = np.asarray(tiny_engine.generate(
+        prompt, max_new_tokens=1, greedy=False, top_k=8, top_p=0.95,
+        rng=jax.random.PRNGKey(5)))
+    logits = np.asarray(tiny_engine.forward(jnp.asarray(prompt)))[:, -1]
+    top8 = np.argsort(logits, axis=-1)[:, -8:]
+    for b in range(2):
+        assert sampled[b, -1] in top8[b]
+
+
+def test_sampling_params_validation(tiny_serve):
+    with pytest.raises(ValueError, match="top_p"):
+        SamplingParams(top_p=0.0).validate()
+    with pytest.raises(ValueError, match="top_k"):
+        SamplingParams(top_k=-1).validate()
+    with pytest.raises(ValueError, match="seed"):
+        SamplingParams(seed=-2).validate()
+    with pytest.raises(ValueError, match="top_p"):
+        tiny_serve.submit(Request(rid="bad",
+                                  input_ids=np.array([1, 2], np.int32),
+                                  max_new_tokens=2,
+                                  sampling=SamplingParams(top_p=-1.0)))
+
+
+# ------------------------------------------------- parity + recompiles
+
+def test_sampled_serving_parity_with_generate(tiny_engine, tiny_serve):
+    """ISSUE 9 acceptance: per request, ServingEngine output under
+    SamplingParams(seed, T, top_k, top_p) is token-identical to
+    generate(sampling=...) — same counter-based lane, two engines."""
+    reqs = _stream(8, seed=21)
+    results = tiny_serve.run(_copies(reqs))
+    by_rid = {r.rid: r for r in reqs}
+    for res in results:
+        req = by_rid[res.rid]
+        sp = req.sampling or SamplingParams()
+        base = np.asarray(tiny_engine.generate(
+            req.input_ids[None], max_new_tokens=req.max_new_tokens,
+            sampling=sp))[0, len(req.input_ids):]
+        np.testing.assert_array_equal(res.output_ids, base)
+    assert tiny_serve.sampled_admissions >= 6
+    assert tiny_serve.page_accounting()["balanced"]
+
+
+def test_heterogeneous_sampling_admission_zero_recompile(tiny_serve):
+    """Admitting a greedy/temperature/top-k/top-p mix (fresh seeds) into a
+    warm engine compiles NOTHING and leaves the inventory bit-identical —
+    sampling is lane state, not program structure."""
+    tiny_serve.run(_stream(4, seed=22, rid_prefix="w"))     # warm buckets
+    inv = tiny_serve.program_inventory()
+    base = _count()
+    tiny_serve.run(_stream(8, seed=23, rid_prefix="z"))
+    assert _count() - base == 0
+    assert tiny_serve.program_inventory() == inv
+
+
+def test_generate_lanes_rejects_rng_and_bad_batch(tiny_engine):
+    prompt = np.ones((2, 8), np.int32)
+    with pytest.raises(ValueError, match="rng"):
+        tiny_engine.generate(prompt, max_new_tokens=2,
+                             sampling=SamplingParams(temperature=1.0),
+                             rng=jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="batch"):
+        tiny_engine.generate(prompt, max_new_tokens=2,
+                             sampling=[SamplingParams()])
+
+
+def test_generate_lanes_per_row_params(tiny_engine):
+    """A per-row SamplingParams list: the greedy row must match plain
+    greedy generate() while the sampled row follows its own lane."""
+    prompt = np.ones((2, 8), np.int32)
+    lanes = [SamplingParams(),                       # greedy lane
+             SamplingParams(temperature=1.1, top_k=13, seed=42)]
+    out = np.asarray(tiny_engine.generate(prompt, max_new_tokens=5,
+                                          sampling=lanes))
+    greedy = np.asarray(tiny_engine.generate(prompt, max_new_tokens=5))
+    np.testing.assert_array_equal(out[0], greedy[0])
+    # the sampled row is deterministic under its seed
+    out2 = np.asarray(tiny_engine.generate(prompt, max_new_tokens=5,
+                                           sampling=lanes))
+    np.testing.assert_array_equal(out, out2)
+
+
+# --------------------------------------------------- replay under sampling
+
+@pytest.mark.chaos
+def test_sampled_replay_token_exact(tiny_engine):
+    """Warm-restart replay of an in-flight SAMPLED stream re-prefills
+    prompt+generated and, because lane keys are counter-based, continues
+    with the identical tokens — stitched output equals the fault-free
+    run."""
+    reqs = _stream(6, seed=31, new_choices=(8,))
+    ref_sup = tiny_engine.supervised_serving(b_slots=3, page_size=8,
+                                             max_model_len=64)
+    ref = {r.rid: r.output_ids for r in ref_sup.run(_copies(reqs))}
+    sup = tiny_engine.supervised_serving(b_slots=3, page_size=8,
+                                         max_model_len=64)
+    inj = FaultInjector()
+    inj.add(site=SITE_SERVE_DECODE, kind="raise", at_call=3)
+    install_injector(inj)
+    try:
+        results = sup.run(_copies(reqs))
+    finally:
+        clear_injector()
+    assert sup.restarts == 1
+    replayed = 0
+    for r in results:
+        np.testing.assert_array_equal(r.output_ids, ref[r.rid])
+        replayed += r.replays
+    assert replayed > 0
+
+
+# -------------------------------------------------------- speculative
+
+def test_speculative_greedy_token_exact(tiny_engine, tiny_serve,
+                                        spec_serve):
+    """ISSUE 9 acceptance: greedy speculative decode is token-exact vs
+    non-speculative greedy (rejection sampling degenerates to argmax
+    agreement), accounting balances, and the inventory carries the
+    speculative programs from init."""
+    reqs = _stream(6, seed=41, new_choices=(8, 12), sampled=False)
+    ref = {r.rid: r.output_ids
+           for r in tiny_serve.run(_copies(reqs, rid_prefix="r"))}
+    results = spec_serve.run(_copies(reqs))
+    for r in results:
+        np.testing.assert_array_equal(r.output_ids, ref[f"r{r.rid}"])
+    h = spec_serve.health()
+    assert h["speculative_k"] == 3
+    assert h["spec_emitted_tokens_total"] > 0
+    assert h["spec_mean_accepted_len"] >= 1.0
+    assert spec_serve.page_accounting()["balanced"]
+    inv = spec_serve.program_inventory()
+    assert inv["speculative"]["draft_decode"] == 1
+    assert inv["speculative"]["verify"] == 1
+
+
+def test_speculative_admission_zero_recompile(spec_serve):
+    inv = spec_serve.program_inventory()
+    base = _count()
+    spec_serve.run(_stream(6, seed=42, sampled=True, rid_prefix="s"))
+    assert _count() - base == 0
+    assert spec_serve.program_inventory() == inv
+
+
+def test_speculative_sampled_deterministic(tiny_engine, spec_serve):
+    """Sampled speculative streams are deterministic under their lane
+    seeds (salted counter-based keys): the same stream twice is
+    token-identical — the property replay/failover exactness builds on."""
+    reqs = _stream(6, seed=43)
+    a = {r.rid: r.output_ids
+         for r in spec_serve.run(_copies(reqs, rid_prefix="a"))}
+    b = {r.rid: r.output_ids
+         for r in spec_serve.run(_copies(reqs, rid_prefix="b"))}
+    for r in reqs:
+        np.testing.assert_array_equal(a[f"a{r.rid}"], b[f"b{r.rid}"])
+
+
+def test_speculative_eos_and_budget_truncate_verify_block(tiny_engine,
+                                                          tiny_serve,
+                                                          spec_serve):
+    """A verify block can overshoot eos or the token budget mid-block: the
+    host consumes only up to the stop, the result matches non-speculative
+    greedy (which stops identically), and pages free."""
+    probe = _stream(1, seed=44, new_choices=(8,), sampled=False)[0]
+    base = np.asarray(tiny_engine.generate(probe.input_ids[None],
+                                           max_new_tokens=8))[0]
+    eos = int(base[len(probe.input_ids) + 2])      # 3rd generated token
+    req = Request(rid="se", input_ids=probe.input_ids, max_new_tokens=8,
+                  eos_token_id=eos)
+    (ref,) = tiny_serve.run([Request(rid="se", input_ids=probe.input_ids,
+                                     max_new_tokens=8, eos_token_id=eos)])
+    (res,) = spec_serve.run([req])
+    assert res.finish_reason == ref.finish_reason == "eos"
+    np.testing.assert_array_equal(res.output_ids, ref.output_ids)
+    # budget truncation: max_new smaller than a full verify block
+    (r2,) = spec_serve.run([Request(rid="sb", input_ids=probe.input_ids,
+                                    max_new_tokens=2)])
+    assert r2.finish_reason == "length" and len(r2.output_ids) == 2
+    assert spec_serve.page_accounting()["balanced"]
+
+
+@pytest.mark.chaos
+def test_speculative_replay_token_exact(tiny_engine):
+    """A warm restart mid-speculative-stream replays prompt+generated and
+    the speculative continuation stays token-exact (greedy), with the
+    speculative programs adopted instead of recompiled."""
+    dm, dp = layer_skip_draft(tiny_engine.model, tiny_engine.params, 1)
+    spec = SpeculativeConfig(draft_model=dm, draft_params=dp, k=3)
+    reqs = _stream(5, seed=45, new_choices=(10,), sampled=False)
+    ref_sup = tiny_engine.supervised_serving(b_slots=2, page_size=8,
+                                             max_model_len=64,
+                                             speculative=spec)
+    ref = {r.rid: r.output_ids for r in ref_sup.run(_copies(reqs))}
+    sup = tiny_engine.supervised_serving(b_slots=2, page_size=8,
+                                         max_model_len=64,
+                                         speculative=spec)
+    inj = FaultInjector()
+    inj.add(site=SITE_SERVE_DECODE, kind="raise", at_call=2)
+    install_injector(inj)
+    old_engine = sup.engine
+    try:
+        results = sup.run(_copies(reqs))
+    finally:
+        clear_injector()
+    assert sup.restarts == 1
+    for r in results:
+        np.testing.assert_array_equal(r.output_ids, ref[r.rid])
+    # the replacement engine ADOPTED the dead one's speculative programs
+    # (same draft/k/pool geometry) instead of rebuilding them
+    assert sup.engine is not old_engine
+    assert sup.engine._spec._verify_prog is old_engine._spec._verify_prog
+    assert sup.engine._spec._draft_prog is old_engine._spec._draft_prog
+
+
+def test_speculative_config_validation(tiny_engine):
+    model = tiny_engine.model
+    with pytest.raises(ValueError, match="k="):
+        SpeculativeConfig(draft_model=model, draft_params=None,
+                          k=0).validate(model, 64)
+    other = CausalLM("tiny", vocab_size=128)
+    with pytest.raises(ValueError, match="vocab"):
+        SpeculativeConfig(draft_model=other,
+                          draft_params=None).validate(model, 64)
+    with pytest.raises(ValueError, match="num_layers"):
+        layer_skip_draft(model, tiny_engine.params,
+                         model.config.num_layers)
+    # perturbed_draft keeps the architecture and perturbs floats only
+    dm, dp = perturbed_draft(model, tiny_engine.params, scale=1e-3)
+    assert dm.config.num_layers == model.config.num_layers

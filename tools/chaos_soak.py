@@ -332,10 +332,20 @@ def run_fleet_soak(seed: int, coord_dir: str, n_requests: int = 10,
     the resume path (ISSUE 8): the replacement re-prefills
     ``prompt + journaled`` and continues AFTER the last journaled token.
 
+    A third of the stream is SAMPLED (ISSUE 9: per-request temperature/
+    top-k/top-p lanes with per-request seeds) so kills land on stochastic
+    streams too: the journal carries the RNG lane (sampling params +
+    counter) and the counter-based key schedule
+    (``fold_in(PRNGKey(seed), position)``) must make the resumed sampled
+    stream token-identical to the fault-free reference — not merely
+    distribution-equal.
+
     Invariants asserted: every submitted request reaches a terminal result
     (none lost); completed outputs are token-identical to a fault-free
     single-engine reference run — for resumed streams this proves zero
-    duplicated emissions and zero lost tokens; every surviving engine's
+    duplicated emissions and zero lost tokens, and for sampled resumed
+    streams that the journaled lane re-derived the identical key at every
+    continuation position; every surviving engine's
     refcount page accounting balances; the dead engine is visibly dead
     through the store (lapsed lease or dead marker); every journal entry
     is GC'd once its result is collected (even by a freshly elected
@@ -378,15 +388,31 @@ def run_fleet_soak(seed: int, coord_dir: str, n_requests: int = 10,
         return nprng.integers(1, model.config.vocab_size,
                               int(nprng.integers(3, 14))).astype(np.int32)
 
+    from deepspeed_tpu.inference.sampling import SamplingParams
+
+    def lane(i):
+        # every third request is sampled: per-request seed, rotating
+        # temperature/top-k/top-p mix — kills must land on stochastic
+        # streams with journaled RNG-lane state outstanding
+        if i % 3 != 1:
+            return None
+        return SamplingParams(temperature=0.8 if i % 2 else 1.2,
+                              top_k=0 if i % 6 == 1 else 12,
+                              top_p=0.9, seed=500 + i)
+
     base = [Request(rid=i, input_ids=prompt(i),
-                    max_new_tokens=int(nprng.choice((4, 6, 8))))
+                    max_new_tokens=int(nprng.choice((4, 6, 8))),
+                    sampling=lane(i))
             for i in range(n_requests)]
 
     def copies():
         return [Request(rid=r.rid, input_ids=r.input_ids,
-                        max_new_tokens=r.max_new_tokens) for r in base]
+                        max_new_tokens=r.max_new_tokens,
+                        sampling=r.sampling) for r in base]
 
-    # fault-free single-engine reference (greedy => engine-independent)
+    # fault-free single-engine reference (greedy AND sampled outputs are
+    # engine-independent: counter-based lane keys are pure functions of
+    # (seed, position), so one reference serves every failover schedule)
     ref_serve = engine.serving(b_slots=3, page_size=8, max_model_len=64)
     ref = {r.rid: r.output_ids for r in ref_serve.run(copies())}
     del ref_serve
@@ -470,14 +496,20 @@ def run_fleet_soak(seed: int, coord_dir: str, n_requests: int = 10,
     # resumed streams (journaled prefix + decoded continuation) equality
     # proves no token was duplicated at the stitch and none was lost
     parity_checked = resumed_results = resumed_tokens = 0
+    sampled_parity_checked = sampled_resumed_results = 0
+    sampled_rids = {r.rid for r in base if r.sampling is not None}
     for rid, res in by_rid.items():
         if res.finish_reason in ("eos", "length"):
             assert np.array_equal(res.output_ids, ref[rid]), \
                 f"fleet soak seed={seed}: rid {rid} diverged after failover"
             parity_checked += 1
+            if rid in sampled_rids:
+                sampled_parity_checked += 1
             if res.resumed_tokens:
                 resumed_results += 1
                 resumed_tokens += res.resumed_tokens
+                if rid in sampled_rids:
+                    sampled_resumed_results += 1
                 assert res.resumed_tokens <= len(res.output_ids), res
         else:
             assert res.finish_reason in ("deadline", "shed"), \
@@ -534,6 +566,8 @@ def run_fleet_soak(seed: int, coord_dir: str, n_requests: int = 10,
         "failovers": live_router.failovers_total,
         "resumed_results": resumed_results,
         "resumed_tokens": resumed_tokens,
+        "sampled_parity_checked": sampled_parity_checked,
+        "sampled_resumed_results": sampled_resumed_results,
         "faults_fired": len(inj.log),
         "final_term": live_router.term,
         "final_generation": live_router.generation,

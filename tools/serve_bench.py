@@ -28,6 +28,16 @@ cross-request KV reuse layer: ``prefix_hit_rate``, shared-vs-cold TTFT
 p50/p99, pages served from the index, and token-exactness of shared
 outputs against a no-sharing run of the same stream
 (``tools/artifacts/serve_prefix_r9.json`` is the seeded CPU reference).
+
+``--workload sampled`` (ISSUE 9) drives a heterogeneous sampling-params
+stream (greedy / temperature / top-k / top-p lanes, per-request seeds)
+through the serving engine and checks PER-REQUEST parity against
+``generate(sampling=...)`` under the shared counter-based RNG lanes, plus
+the zero-recompile contract for the mixed admission.  ``--speculative``
+adds the verify-k section: a layer-skip draft (``--draft_layers``)
+proposing ``--spec_k`` tokens per tick — reports mean accepted length,
+speculative-vs-plain throughput, and a greedy token-exactness verdict
+(``tools/artifacts/serve_sampled_r12.json`` is the seeded CPU reference).
 """
 from __future__ import annotations
 
@@ -91,6 +101,39 @@ def build_prefix_stream(vocab: int, n_requests: int, seed: int,
                                       ).astype(np.int32)]),
                     max_new_tokens=int(rng.choice(new_choices)))
             for i in range(n_requests)]
+
+
+def build_sampled_stream(vocab: int, n_requests: int, seed: int,
+                         prompt_rng=(4, 48), new_choices=(8, 16, 24)):
+    """Seeded heterogeneous-sampling stream: a rotating mix of greedy,
+    temperature-only, temperature+top-k and top-p lanes with per-request
+    seeds — the shape real traffic sends, and exactly the mix the
+    zero-recompile contract must absorb into ONE decode program."""
+    import numpy as np
+
+    from deepspeed_tpu.inference.sampling import SamplingParams
+    from deepspeed_tpu.inference.serving import Request
+
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for i in range(n_requests):
+        kind = i % 4
+        sp = (None if kind == 0
+              else SamplingParams(temperature=0.8, seed=1000 + i)
+              if kind == 1
+              else SamplingParams(temperature=1.2,
+                                  top_k=int(rng.integers(4, 64)),
+                                  seed=1000 + i)
+              if kind == 2
+              else SamplingParams(temperature=1.0, top_p=0.9,
+                                  seed=1000 + i))
+        reqs.append(Request(
+            rid=i,
+            input_ids=rng.integers(1, vocab,
+                                   int(rng.integers(*prompt_rng))
+                                   ).astype(np.int32),
+            max_new_tokens=int(rng.choice(new_choices)), sampling=sp))
+    return reqs
 
 
 # mid-size CPU bench regime shared by BOTH benches: big enough that batched
@@ -407,6 +450,172 @@ def run_fleet_bench(model_name: str = "llama-374m", n_engines: int = 3,
     }
 
 
+def run_sampled_bench(model_name: str = "llama-374m", b_slots: int = 8,
+                      n_requests: int = 32, seed: int = 0,
+                      page_size: int = 128, max_model_len: int = 0,
+                      speculative: bool = False, spec_k: int = 3,
+                      draft_layers: int = 1) -> dict:
+    """Sampled-serving benchmark (ISSUE 9 acceptance): a heterogeneous
+    sampling-params stream through the supervised serving engine, with a
+    per-request parity oracle of ``generate(sampling=...)`` — same seed,
+    same counter-based RNG lane, token-identical output — and the
+    zero-recompile contract checked on the mixed admission.
+
+    ``speculative=True`` adds the verify-k section: a layer-skip draft
+    (the target's first ``draft_layers`` blocks — zero extra weights)
+    proposes ``spec_k`` tokens per tick.  Greedy speculative output must
+    be token-identical to the plain engine (rejection sampling degenerates
+    to argmax agreement), and the JSON reports mean accepted length (> 1
+    = the draft pays for itself) plus speculative-vs-plain throughput on
+    the greedy stream.
+    """
+    import numpy as np
+
+    import jax
+
+    from deepspeed_tpu.inference.sampling import SamplingParams
+    from deepspeed_tpu.utils.compile_counter import compile_counter
+
+    on_tpu = jax.devices()[0].platform not in ("cpu",)
+    if not on_tpu:
+        model_name, prompt_rng = "serve-sampled(cpu)", (3, 14)
+        new_choices = (16, 24, 32)
+        base_cfg = "tiny"
+    else:
+        prompt_rng, new_choices = (4, 48), (32, 64, 96)
+        base_cfg = model_name
+    max_model_len = max_model_len or (64 if not on_tpu else 2048)
+    page_size = min(page_size, max_model_len)
+    model, engine = _build_bench_engine(base_cfg, max_model_len, on_tpu)
+    stream = build_sampled_stream(model.config.vocab_size, n_requests,
+                                  seed, prompt_rng, new_choices)
+    count = compile_counter()
+
+    def copies(sampled=True):
+        return [type(r)(rid=r.rid, input_ids=r.input_ids,
+                        max_new_tokens=r.max_new_tokens,
+                        sampling=(r.sampling if sampled else None))
+                for r in stream]
+
+    # ---- parity oracle: per-request generate(sampling=...) through the
+    # same counter-based lanes (greedy requests ride the greedy lane)
+    def oracle():
+        outs = {}
+        for req in stream:
+            sp = req.sampling or SamplingParams()
+            out = np.asarray(engine.generate(
+                req.input_ids[None], max_new_tokens=req.max_new_tokens,
+                sampling=sp))
+            outs[req.rid] = out[0, len(req.input_ids):]
+        return outs
+
+    base_outs = oracle()                             # compiles
+    t0 = time.perf_counter()
+    base_outs = oracle()                             # measured
+    base_dt = time.perf_counter() - t0
+
+    sup = engine.supervised_serving(b_slots=b_slots, page_size=page_size,
+                                    max_model_len=max_model_len)
+    sup.run(copies())                                # warm
+    inventory = sup.engine.program_inventory()
+    n_before = count()
+    t0 = time.perf_counter()
+    results = sup.run(copies())                      # measured
+    serve_dt = time.perf_counter() - t0
+    measured_compiles = count() - n_before
+    parity = all(np.array_equal(r.output_ids, base_outs[r.rid])
+                 for r in results)
+    total_tokens = sum(len(r.output_ids) for r in results)
+    ttft = [r.ttft_s for r in results]
+    lat = [r.latency_s for r in results]
+    h = sup.health()
+
+    # plain greedy reference for the speculative exactness check (and the
+    # plain-engine throughput the speculative section compares against)
+    t0 = time.perf_counter()
+    greedy_ref = {r.rid: r.output_ids for r in sup.run(copies(False))}
+    greedy_dt = time.perf_counter() - t0
+    restarts = sup.restarts
+
+    spec_detail = {}
+    if speculative:
+        from deepspeed_tpu.inference.speculative import (SpeculativeConfig,
+                                                         layer_skip_draft)
+
+        del sup                  # release the plain pool before the spec
+        import gc                # engine allocates target + draft pools
+        gc.collect()
+        dm, dp = layer_skip_draft(model, engine.params, draft_layers)
+        spec_sup = engine.supervised_serving(
+            b_slots=b_slots, page_size=page_size,
+            max_model_len=max_model_len,
+            speculative=SpeculativeConfig(draft_model=dm, draft_params=dp,
+                                          k=spec_k))
+        spec_sup.run(copies(False))                  # warm
+        n0 = count()
+        t0 = time.perf_counter()
+        spec_greedy = spec_sup.run(copies(False))    # measured (greedy)
+        spec_greedy_dt = time.perf_counter() - t0
+        spec_compiles = count() - n0
+        spec_exact = all(np.array_equal(r.output_ids, greedy_ref[r.rid])
+                         for r in spec_greedy)
+        t0 = time.perf_counter()
+        spec_sampled = spec_sup.run(copies())        # sampled spec pass
+        spec_sampled_dt = time.perf_counter() - t0
+        sh = spec_sup.health()
+        spec_detail = {
+            "speculative_k": spec_k,
+            "draft_layers": draft_layers,
+            "mean_accepted_len": sh["spec_mean_accepted_len"],
+            "spec_greedy_token_exact": spec_exact,
+            "spec_compiles_during_measured_run": spec_compiles,
+            "spec_tokens_per_sec_greedy": round(
+                sum(len(r.output_ids) for r in spec_greedy)
+                / spec_greedy_dt, 1),
+            "plain_tokens_per_sec_greedy": round(
+                sum(len(v) for v in greedy_ref.values()) / greedy_dt, 1),
+            "spec_vs_plain_greedy": round(greedy_dt / spec_greedy_dt, 3),
+            "spec_tokens_per_sec_sampled": round(
+                sum(len(r.output_ids) for r in spec_sampled)
+                / spec_sampled_dt, 1),
+            "spec_program_inventory": spec_sup.engine.program_inventory()
+            .get("speculative"),
+        }
+
+    serve_tps = total_tokens / serve_dt
+    return {
+        "metric": "serve-sampled",
+        "value": round(serve_tps, 1),
+        "unit": "tokens/sec",
+        "vs_sequential_generate": round(serve_tps
+                                        / (total_tokens / base_dt), 3),
+        "detail": {
+            "model": model_name,
+            "platform": jax.devices()[0].platform,
+            "b_slots": b_slots,
+            "page_size": page_size,
+            "n_requests": n_requests,
+            "seed": seed,
+            "total_tokens": total_tokens,
+            "sampled_requests": sum(r.sampling is not None for r in stream),
+            "sampled_admissions_total": h["sampled_admissions_total"],
+            "sequential_generate_tokens_per_sec": round(
+                total_tokens / base_dt, 1),
+            "ttft_p50_s": round(_pct(ttft, 0.50), 4),
+            "ttft_p99_s": round(_pct(ttft, 0.99), 4),
+            "p50_latency_s": round(_pct(lat, 0.50), 4),
+            "p99_latency_s": round(_pct(lat, 0.99), 4),
+            "program_inventory": inventory,
+            "compiles_during_measured_run": measured_compiles,
+            # the ISSUE 9 parity acceptance: every request token-identical
+            # to generate() under the same seed/params lane
+            "parity_with_generate_sampled": parity,
+            "restarts": restarts,
+            **spec_detail,
+        },
+    }
+
+
 def run_serve_bench(model_name: str = "llama-374m", b_slots: int = 8,
                     n_requests: int = 32, seed: int = 0,
                     rate_rps: float = 0.0, page_size: int = 128,
@@ -549,11 +758,22 @@ def main(argv=None) -> int:
     ap.add_argument("--journal_every_k", type=int, default=4,
                     help="fleet mode: router rounds between token-journal "
                          "flushes (mid-stream durability; 0 disables)")
-    ap.add_argument("--workload", choices=("mixed", "prefix"),
+    ap.add_argument("--workload", choices=("mixed", "prefix", "sampled"),
                     default="mixed",
                     help="mixed: ragged stream vs sequential generate(); "
                          "prefix: shared-system-prompt stream, sharing vs "
-                         "cold engine (ISSUE 6 acceptance)")
+                         "cold engine (ISSUE 6 acceptance); sampled: "
+                         "heterogeneous sampling-params stream with a "
+                         "generate(sampling=...) parity oracle (ISSUE 9)")
+    ap.add_argument("--speculative", action="store_true",
+                    help="sampled workload: add the verify-k section "
+                         "(layer-skip draft) — mean accepted length, "
+                         "greedy token-exactness, spec-vs-plain throughput")
+    ap.add_argument("--spec_k", type=int, default=3,
+                    help="speculative: draft tokens proposed per tick")
+    ap.add_argument("--draft_layers", type=int, default=1,
+                    help="speculative: target layers the layer-skip draft "
+                         "keeps")
     ap.add_argument("--b_slots", type=int, default=None,
                     help="default: 8 (mixed) / 4 (prefix)")
     ap.add_argument("--n_requests", type=int, default=None,
@@ -597,6 +817,36 @@ def main(argv=None) -> int:
         ok = (d["parity_with_single_engine"] and d["none_lost"]
               and (d["failovers_total"] > 0) == d["killed_engine"])
         return 0 if ok else 1
+    if args.workload == "sampled":
+        if args.trace or args.rate_rps:
+            ap.error("--trace/--rate_rps are not supported with "
+                     "--workload sampled")
+        result = run_sampled_bench(
+            args.model,
+            b_slots=args.b_slots if args.b_slots is not None else 8,
+            n_requests=(args.n_requests
+                        if args.n_requests is not None else 32),
+            seed=args.seed,
+            page_size=args.page_size if args.page_size is not None else 128,
+            max_model_len=args.max_model_len,
+            speculative=args.speculative, spec_k=args.spec_k,
+            draft_layers=args.draft_layers)
+        line = json.dumps(result)
+        print(line)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        d = result["detail"]
+        ok = (d["parity_with_generate_sampled"]
+              and d["compiles_during_measured_run"] == 0)
+        if args.speculative:
+            ok = ok and (d["spec_greedy_token_exact"]
+                         and d["mean_accepted_len"] > 1.0
+                         and d["spec_compiles_during_measured_run"] == 0)
+        return 0 if ok else 1
+    if args.speculative:
+        ap.error("--speculative is a sampled-workload flag "
+                 "(--workload sampled)")
     if args.workload == "prefix":
         if args.trace:
             ap.error("--trace is not supported with --workload prefix "

@@ -11,7 +11,15 @@ NOTHING.  A third phase asserts the cross-request KV reuse contract
 (ISSUE 6): two batches sharing a system prompt are admitted through the
 prefix index, and ``program_inventory()`` is IDENTICAL before and after the
 shared-prefix batch, with zero compiles — sharing is pure page-table
-indirection, never a new program shape.  Exits nonzero on violation.
+indirection, never a new program shape.  A fourth phase (ISSUE 9) admits a
+HETEROGENEOUS sampling-params mix (greedy + temperature + top-k + top-p
+lanes, per-request seeds) into the same engine: sampling is traced per-slot
+lane state, so the mix compiles NOTHING and the inventory stays
+bit-identical.  A fifth phase runs the same greedy streams through a
+SPECULATIVE engine (layer-skip draft, verify-k): admission again compiles
+nothing beyond the init/bucket set, the inventory is stable across
+admissions, and greedy speculative outputs are token-identical to the plain
+engine's.  Exits nonzero on violation.
 
 Wired into tier-1 via tests/unit/test_serving.py::test_serve_smoke_tool
 (non-slow, in-process).
@@ -87,6 +95,57 @@ def run_smoke(n_requests: int = 5, b_slots: int = 2, seed: int = 0) -> dict:
     inv_after = serve.program_inventory()
     hits_b = sum(r.shared_prefix_tokens > 0 for r in shared_results)
 
+    # ---- mixed-sampling phase (ISSUE 9): greedy + hot-temperature +
+    # top-k + combined top-k/top-p lanes with per-request seeds, admitted
+    # into the SAME engine — sampling is traced per-slot lane state, never
+    # a program shape: zero compiles, inventory bit-identical
+    from deepspeed_tpu.inference.sampling import SamplingParams
+
+    def sampled_stream(tag, n, sseed):
+        rng = np.random.default_rng(sseed)
+        lanes = [None,
+                 SamplingParams(temperature=0.8, seed=11),
+                 SamplingParams(temperature=1.3, top_k=9, seed=12),
+                 SamplingParams(temperature=1.0, top_k=4096, top_p=0.85,
+                                seed=13)]   # top_k >= vocab: filter off
+        return [Request(rid=f"{tag}{i}",
+                        input_ids=rng.integers(
+                            1, 250, int(rng.integers(3, 14))).astype(np.int32),
+                        max_new_tokens=int(rng.integers(3, 9)),
+                        sampling=lanes[i % len(lanes)])
+                for i in range(n)]
+
+    inv_pre_sampled = serve.program_inventory()
+    base = count()
+    sampled_results = serve.run(sampled_stream("s", n_requests, seed + 3))
+    sampled_compiles = count() - base
+    inv_sampled_ok = serve.program_inventory() == inv_pre_sampled
+
+    # ---- speculative phase (ISSUE 9): same greedy streams through a
+    # verify-k engine over a layer-skip draft sharing the target's first
+    # block.  Init + the first stream build the whole speculative
+    # inventory; the second stream compiles NOTHING, the inventory is
+    # stable across admissions, and greedy speculative decode is
+    # token-identical to the plain engine (rejection sampling degenerates
+    # to argmax agreement).
+    from deepspeed_tpu.inference.speculative import (SpeculativeConfig,
+                                                     layer_skip_draft)
+
+    draft_model, draft_params = layer_skip_draft(model, params, 1)
+    spec = engine.serving(
+        b_slots=b_slots, page_size=16, max_model_len=64,
+        speculative=SpeculativeConfig(draft_model=draft_model,
+                                      draft_params=draft_params, k=2))
+    spec.run(stream(seed))                     # warm (buckets compile)
+    spec_inv = spec.program_inventory()
+    base = count()
+    spec_results = spec.run(stream(seed + 1))  # same stream as phase 2
+    spec_compiles = count() - base
+    spec_inv_ok = spec.program_inventory() == spec_inv
+    plain_by_rid = {r.rid: r.output_ids for r in results}
+    spec_exact = all(np.array_equal(r.output_ids, plain_by_rid[r.rid])
+                     for r in spec_results)
+
     out = {
         "metric": "serve-smoke",
         "first_run_compiles": first_run,
@@ -97,11 +156,21 @@ def run_smoke(n_requests: int = 5, b_slots: int = 2, seed: int = 0) -> dict:
         "shared_prefix_compiles": shared_compiles,
         "shared_prefix_hits": hits_b,
         "inventory_stable_across_sharing": bool(inv_before == inv_after),
+        "sampled_mix_compiles": sampled_compiles,
+        "inventory_stable_across_sampling": bool(inv_sampled_ok),
+        "sampled_served": len(sampled_results),
+        "speculative_steady_compiles": spec_compiles,
+        "inventory_stable_across_speculative": bool(spec_inv_ok),
+        "speculative_greedy_token_exact": bool(spec_exact),
+        "speculative_inventory": spec_inv.get("speculative"),
         "ok": bool(first_run <= budget and steady == 0
                    and len(results) == n_requests
                    and shared_compiles == 0
                    and inv_before == inv_after
-                   and hits_b == n_requests),
+                   and hits_b == n_requests
+                   and sampled_compiles == 0 and inv_sampled_ok
+                   and len(sampled_results) == n_requests
+                   and spec_compiles == 0 and spec_inv_ok and spec_exact),
     }
     return out
 
@@ -114,9 +183,11 @@ def main(argv=None) -> int:
     print(json.dumps(result))
     if not result["ok"]:
         print("serve smoke FAILED: compile count exceeded the static "
-              "program inventory (admission recompiled?) or the "
+              "program inventory (admission recompiled?), the "
               "shared-prefix batch changed the inventory / missed the "
-              "prefix index", file=sys.stderr)
+              "prefix index, the mixed-sampling batch compiled or changed "
+              "the inventory, or speculative greedy decode diverged from "
+              "the plain engine", file=sys.stderr)
         return 1
     return 0
 
