@@ -1,11 +1,14 @@
 """Inference subsystem: the one-shot engine (``engine.InferenceEngine``,
 built by ``deepspeed_tpu.init_inference``), the continuous-batching serving
-engine (``serving.ServingEngine``), its warm-restart wrapper
+engine (``serving.ServingEngine``) over its mesh-wide execution tier
+(``execution.MeshExecutor`` — the tensor-sharded paged pool + program
+inventory), its warm-restart wrapper
 (``serving_supervisor.ServingSupervisor``), the leased multi-engine
 fleet tier (``fleet.FleetRouter``), and the sampling/speculative subsystem
 (``sampling.SamplingParams``, ``speculative.SpeculativeConfig``)."""
 from .config import DeepSpeedInferenceConfig  # noqa: F401
 from .engine import InferenceEngine  # noqa: F401
+from .execution import MeshExecutor  # noqa: F401
 from .fleet import (  # noqa: F401
     EngineDead,
     FleetMember,

@@ -1,0 +1,332 @@
+"""Mesh-wide execution tier of the serving engine.
+
+``serving.py`` used to own BOTH halves of the serving loop: per-request
+host scheduling (admission, page tables, the prefix index, deadlines,
+journaling hooks) AND the device-facing state (the paged KV pool and the
+jitted fixed-shape programs).  The multi-chip refactor splits them:
+:class:`~.serving.ServingEngine` keeps scheduling — pure Python over
+numpy page tables — and :class:`MeshExecutor` owns everything that
+touches a device: the pool and its :class:`~jax.sharding.NamedSharding`
+placement, the decode / bucketed-prefill / COW programs, and the device
+copy of the per-slot sampling lanes.  Page-table scatter/gather,
+copy-on-write, sampling lanes and the speculative draft pool all ride
+the sharded programs unchanged, because they only ever see this surface.
+
+Sharding layout (GSPMD over the ``parallel/mesh.py`` named mesh — the
+same NamedSharding/PartitionSpec pattern training and ``generate()``
+already use):
+
+- **KV pool** ``[L, P, page, Hkv, hd]``: KV heads over ``'model'``
+  (:func:`~..models.transformer.paged_cache_specs`), pages replicated —
+  any slot on any data shard may own any page.  Per-device pool bytes
+  shrink ~1/tp, which is what lets one engine's pool span a slice's HBM.
+- **Attention/MLP weights**: :func:`~.engine.auto_tp_specs` over
+  ``'model'`` — the exact specs ``InferenceEngine`` serves ``generate()``
+  with, so serving numerics stay identical to the one-shot path.
+- **Host scheduling arrays** (page tables, lengths, last tokens, lanes):
+  replicated.  They are tiny per-tick scheduling state; XLA routes the
+  per-axis collectives the sharded einsums need.
+- **Outputs**: sampled tokens replicated, pools pinned back to their
+  canonical sharding via ``out_shardings`` so placement can never drift
+  across ticks (a drifted pool would silently re-shard every tick).
+
+With ``mesh=None`` the programs are the same jits without sharding
+annotations — single-chip serving is the degenerate case, not a separate
+code path.  Develop and gate multi-chip on CPU via
+``XLA_FLAGS=--xla_force_host_platform_device_count=N``
+(:func:`~..parallel.mesh.initialize_serving_mesh`); the compiled
+programs are real SPMD partitions either way (docs/SERVING.md
+"Multi-chip serving").
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..models.transformer import cow_copy_page
+from .sampling import position_keys, sample_tokens
+
+__all__ = ["MeshExecutor", "place_params", "pool_jit", "pool_bytes"]
+
+# process-global COW page-copy programs, keyed by donation (jax.jit caches
+# on argument avals INCLUDING shardings, so every engine with the same pool
+# shape/dtype/placement — notably a warm-restart replacement — shares ONE
+# compile per process, and meshed/unmeshed pools each get their own
+# specialization of the same jit)
+_COW_PROGS: Dict[bool, Any] = {}
+
+
+def pool_jit(fn, donate, mesh, kv_spec: P, n_leading: int):
+    """jit a pool-consuming program.  On a mesh, pin the outputs:
+    ``n_leading`` replicated leading outputs (tokens/counts) followed by
+    the k/v pools on their canonical sharding — without ``out_shardings``
+    GSPMD is free to pick a different pool placement per program and the
+    donated buffers would reshard every tick."""
+    if mesh is None:
+        return jax.jit(fn, donate_argnums=donate)
+    rep = NamedSharding(mesh, P())
+    kv = NamedSharding(mesh, kv_spec)
+    return jax.jit(fn, donate_argnums=donate,
+                   out_shardings=tuple([rep] * n_leading) + (kv, kv))
+
+
+def place_params(params, mesh):
+    """Commit a param tree to its auto-TP shardings on ``mesh`` (reuses
+    :func:`~.engine.auto_tp_specs` — the same Megatron-style split
+    ``generate()`` runs with).  Params already committed to this mesh
+    (the ``InferenceEngine.serving()`` path) pass through untouched; a
+    raw host tree (standalone ``ServingEngine(..., mesh=...)``) is
+    sharded here.  ``mesh=None`` or a tp=1 mesh is a no-op."""
+    if mesh is None or mesh.shape.get("model", 1) == 1:
+        return params
+    leaves = jax.tree_util.tree_leaves(params)
+    if leaves and isinstance(getattr(leaves[0], "sharding", None),
+                             NamedSharding) \
+            and leaves[0].sharding.mesh == mesh:
+        return params
+    from .engine import auto_tp_specs
+
+    specs = auto_tp_specs(params, mesh)
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P))
+    return jax.device_put(params, shardings)
+
+
+def pool_bytes(kpool, vpool) -> Dict[str, int]:
+    """Total and per-device bytes of a (possibly sharded) k/v pool pair.
+    ``per_device`` is the MAX across devices (capacity planning reads the
+    worst shard); on a tp-sharded pool it is ~``total / tp``."""
+    total = int(kpool.nbytes) + int(vpool.nbytes)
+    per: Dict[Any, int] = {}
+    try:
+        for arr in (kpool, vpool):
+            for s in arr.addressable_shards:
+                per[s.device] = per.get(s.device, 0) + int(s.data.nbytes)
+    except Exception:   # duck-typed arrays without shard metadata
+        return {"total": total, "per_device": total}
+    return {"total": total,
+            "per_device": max(per.values()) if per else total}
+
+
+class MeshExecutor:
+    """The device half of a serving engine: paged KV pool + fixed-shape
+    programs, optionally tensor-sharded over a named device mesh.
+
+    The host half (:class:`~.serving.ServingEngine`) calls exactly four
+    program entry points — :meth:`decode`, :meth:`prefill`, :meth:`cow`
+    and the lane cache — and never touches a device array directly, so
+    the whole fleet of programs can move between a single chip and a
+    mesh without the scheduler noticing.
+    """
+
+    def __init__(self, model, params, num_pages: int, page_size: int,
+                 b_slots: int, dtype=None, mesh=None,
+                 prefix_cache: bool = True):
+        self.model = model
+        self.mesh = mesh
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.b_slots = int(b_slots)
+        cfg = model.config
+        self.tp = 1
+        if mesh is not None:
+            if "model" not in mesh.axis_names:
+                raise ValueError(
+                    "serving mesh must carry a 'model' axis (build it with "
+                    "parallel.mesh.initialize_mesh / "
+                    "initialize_serving_mesh), got axes "
+                    f"{tuple(mesh.axis_names)}")
+            self.tp = int(mesh.shape["model"])
+            if self.tp > 1 and cfg.kv_heads % self.tp != 0:
+                raise ValueError(
+                    f"kv_heads={cfg.kv_heads} not divisible by the mesh's "
+                    f"model axis ({self.tp}): the paged KV pool shards its "
+                    "head dim over 'model' (paged_cache_specs) — pick tp "
+                    "dividing kv_heads or replicate with tp=1")
+        # params ride the same auto-TP shardings generate() uses; already-
+        # committed trees (InferenceEngine.serving()) pass through
+        self.params = place_params(params, mesh)
+        cache = model.init_paged_cache(self.num_pages, self.page_size,
+                                       dtype=dtype)
+        self._kv_spec = model.paged_cache_specs()["k"]
+        # commit the fresh pool to its placement: a jit caches on the arg's
+        # committed-ness, so an UNcommitted initial pool would cost each
+        # program one extra compile when the second call arrives holding
+        # committed program outputs.  On a mesh the pool must live on the
+        # same device set as the (sharded) params — KV heads over 'model'.
+        if mesh is not None:
+            sh = NamedSharding(mesh, self._kv_spec)
+            self.kpool = jax.device_put(cache["k"], sh)
+            self.vpool = jax.device_put(cache["v"], sh)
+        else:
+            self.kpool = jax.device_put(cache["k"], cache["k"].sharding)
+            self.vpool = jax.device_put(cache["v"], cache["v"].sharding)
+        # donation: each tick consumes and reproduces the pool — donate the
+        # buffers so the pool exists once in HBM, not twice (CPU has no
+        # donation support and would warn every compile)
+        self._donate = (1, 2) if jax.default_backend() != "cpu" else ()
+        self._decode_prog = self._build_decode()
+        self._prefill_progs: Dict[int, Any] = {}
+        self._cow_prog = self._build_cow() if prefix_cache else None
+        if self._cow_prog is not None:
+            # pre-warm the one COW program shape with a trash-page self-copy
+            # so its single compile lands at init, never during admission —
+            # the zero-recompile steady state must hold from the first tick
+            self.kpool, self.vpool = self._cow_prog(
+                self.kpool, self.vpool, jnp.int32(0), jnp.int32(0))
+        # constant for the engine's lifetime (the pool never reallocates):
+        # health()/gauges read these per tick, so compute them once
+        self.pool_bytes = pool_bytes(self.kpool, self.vpool)
+        # device copy of the lane vectors, rebuilt only when a lane
+        # changes (admission / retirement) — unlike lengths/last_tok the
+        # lanes are constant across a request's whole decode, so the
+        # per-tick call must not pay 4 host->device transfers for them
+        self._lanes_device = None
+
+    # ------------------------------------------------------------ programs
+
+    def _build_decode(self):
+        apply_paged = self.model.apply_paged
+
+        def prog(params, kpool, vpool, page_table, lengths, last_tok, active,
+                 temp, top_k, top_p, seeds):
+            # write each slot's last token at position `lengths`, read the
+            # next-token logits; inactive slots write to the trash page.
+            # The sampled token will sit at stream position `lengths + 1`,
+            # so its lane key folds that position — the same counter
+            # generate(sampling=...) and a replay/failover re-prefill
+            # derive, which is what keeps sampled streams engine-
+            # independent and resume-exact (docs/SERVING.md "Sampling").
+            cache = {"k": kpool, "v": vpool}
+            logits, cache = apply_paged(params, last_tok[:, None], cache,
+                                        page_table, lengths, active[:, None])
+            nxt = sample_tokens(logits[:, -1, :], temp, top_k, top_p,
+                                lambda: position_keys(seeds, lengths + 1))
+            return nxt, cache["k"], cache["v"]
+
+        return pool_jit(prog, self._donate, self.mesh, self._kv_spec, 1)
+
+    def _build_prefill(self, s_pad: int):
+        apply_paged = self.model.apply_paged
+
+        def prog(params, kpool, vpool, pt_row, tokens, n_real, start,
+                 temp, top_k, top_p, seed):
+            # tokens [1, s_pad] right-padded; only the first n_real K/V are
+            # written (pads go to the trash page); the first generated token
+            # samples the last REAL position's logits under the request's
+            # lane ([1]-shaped traced params — greedy folds to argmax
+            # in-graph, so the historical greedy contract is bit-identical).
+            # `start` is the slot position of tokens[:, 0] — 0 for a cold
+            # prefill, the shared-prefix length for a tail prefill (the
+            # gather still covers the whole page-table row, so queries
+            # attend to the shared pages through the ordinary causal mask).
+            # A traced scalar: every start shares ONE program per bucket.
+            seq_mask = (jnp.arange(s_pad, dtype=jnp.int32) < n_real)[None, :]
+            cache = {"k": kpool, "v": vpool}
+            logits, cache = apply_paged(params, tokens, cache, pt_row,
+                                        start[None], seq_mask)
+            lg = logits[0, n_real - 1, :][None]        # [1, V]
+            # the emitted token will sit at stream position S = start +
+            # n_real — the counter-based key generate(sampling=...) and
+            # every replay/failover resume re-derive for the same position
+            nxt = sample_tokens(
+                lg, temp, top_k, top_p,
+                lambda: position_keys(seed, (start + n_real)[None]))[0]
+            return nxt, cache["k"], cache["v"]
+
+        return pool_jit(prog, self._donate, self.mesh, self._kv_spec, 1)
+
+    def _build_cow(self):
+        # process-global jit (see _COW_PROGS): a replacement engine's init
+        # prewarm then hits the jit cache on the same pool avals instead of
+        # recompiling a fresh closure inside the warm-restart critical
+        # path.  No out_shardings: the in-place page update propagates the
+        # input pools' sharding verbatim, so one jit serves meshed and
+        # unmeshed pools alike.
+        donate = jax.default_backend() != "cpu"
+        prog = _COW_PROGS.get(donate)
+        if prog is None:
+            prog = _COW_PROGS[donate] = jax.jit(
+                cow_copy_page, donate_argnums=(0, 1) if donate else ())
+        return prog
+
+    # ---------------------------------------------------------- entry points
+
+    def decode(self, page_table, lengths, last_tok, active, lanes):
+        """One fixed-shape decode step over all slots; returns the sampled
+        [B_slots] token vector (device array — the caller fetches inside
+        its watchdog window) and updates the pools in place."""
+        nxt, self.kpool, self.vpool = self._decode_prog(
+            self.params, self.kpool, self.vpool,
+            jnp.asarray(page_table), jnp.asarray(lengths),
+            jnp.asarray(last_tok), jnp.asarray(active), *lanes)
+        return nxt
+
+    def prefill(self, s_pad: int, pt_row, tokens, n_real, start,
+                lane_t, lane_k, lane_p, lane_s):
+        """One bucketed prefill ([1, s_pad]); returns the first sampled
+        token (device scalar) and updates the pools.  Builds the bucket's
+        program on first use — the bucket set IS the program inventory."""
+        prog = self._prefill_progs.get(s_pad)
+        if prog is None:
+            prog = self._prefill_progs[s_pad] = self._build_prefill(s_pad)
+        # lanes ride as numpy arrays: jit device-puts them without
+        # compiling the tiny list->array convert programs a jnp.asarray
+        # of a Python list would cost on first use
+        nxt, self.kpool, self.vpool = prog(
+            self.params, self.kpool, self.vpool, pt_row, tokens,
+            jnp.int32(n_real), jnp.int32(start),
+            np.asarray([lane_t], np.float32),
+            np.asarray([lane_k], np.int32),
+            np.asarray([lane_p], np.float32),
+            np.asarray([lane_s], np.uint32))
+        return nxt
+
+    def cow(self, src: int, dst: int) -> None:
+        """Snapshot physical page ``src`` onto ``dst`` across all layers
+        (copy-on-write boundary page; one fixed program shape)."""
+        self.kpool, self.vpool = self._cow_prog(
+            self.kpool, self.vpool, jnp.int32(src), jnp.int32(dst))
+
+    def lanes(self, temp, top_k, top_p, seeds):
+        """Cached device copy of the per-slot lane vectors; the engine
+        invalidates on admission/retirement (lane membership changed)."""
+        if self._lanes_device is None:
+            self._lanes_device = (jnp.asarray(temp), jnp.asarray(top_k),
+                                  jnp.asarray(top_p), jnp.asarray(seeds))
+        return self._lanes_device
+
+    def invalidate_lanes(self) -> None:
+        self._lanes_device = None
+
+    # ------------------------------------------------------------- health
+
+    def pool_alive(self) -> bool:
+        dead = getattr(self.kpool, "is_deleted", None)
+        return not (dead and self.kpool.is_deleted())
+
+    def mesh_info(self) -> Dict[str, Any]:
+        """Static mesh facts for health()/gauges: device count and the
+        non-trivial axis sizes (``{}`` / 1 device when unmeshed)."""
+        if self.mesh is None:
+            return {"mesh_devices": 1, "mesh_axes": {}}
+        return {"mesh_devices": int(self.mesh.size),
+                "mesh_axes": {a: int(self.mesh.shape[a])
+                              for a in self.mesh.axis_names
+                              if int(self.mesh.shape[a]) > 1}}
+
+    # ----------------------------------------------------------- adoption
+
+    def adopt_programs(self, old: "MeshExecutor") -> None:
+        """Warm-restart/recycle path: carry the dead executor's compiled
+        programs — jax.jit caches on avals INCLUDING shardings, and the
+        fresh pool has the same shape/dtype/placement, so every adopted
+        program is a cache hit instead of a recompile."""
+        self._decode_prog = old._decode_prog
+        self._prefill_progs.update(old._prefill_progs)

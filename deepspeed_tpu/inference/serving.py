@@ -24,6 +24,20 @@ mixed-length request streams.
 Decode math stays on the XLA einsum path — the Pallas decode kernel was
 retired in round 5 on an honest A/B; this win is scheduling, not kernels.
 
+Multi-chip serving (docs/SERVING.md "Multi-chip serving"): the engine is
+split into a HOST scheduling half (this class — admission, page tables,
+prefix index, deadlines; pure Python over numpy) and a mesh-wide
+execution half (:class:`~.execution.MeshExecutor` — the paged KV pool,
+its NamedSharding placement, and every jitted fixed-shape program).
+With ``mesh=`` the pool shards its KV-head dim over the mesh's
+``'model'`` axis and the weights ride the same auto-TP specs
+``generate()`` uses, so every steady-state program — decode tick,
+bucketed prefill, COW snapshot, speculative draft/verify — is ONE GSPMD
+program spanning the whole mesh, token-exact with the unsharded engine,
+and per-device KV bytes shrink ~1/tp.  The zero-recompile inventory,
+warm-restart program adoption and all the resilience paths below are
+mesh-agnostic: they live on the host side of the split.
+
 Scheduling policy (documented, deliberately simple): FIFO admission with
 head-of-line blocking (no request skipping, so no starvation), and pages for
 the whole request (prompt + max_new) are reserved at admission — a running
@@ -98,25 +112,20 @@ from typing import Any, Deque, Dict, List, Optional
 
 import numpy as np
 
-import jax
 import jax.numpy as jnp
 
-from ..models.transformer import PAGE_SIZE, cow_copy_page
+from ..models.transformer import PAGE_SIZE
 from ..observability.trace import trace_count, trace_span
 from ..resilience import (SITE_SERVE_ADMIT, SITE_SERVE_DECODE,
                           SITE_SERVE_PREFILL, SITE_SERVE_TICK, maybe_fire)
 from ..utils.logging import log_dist, logger
 from .engine import InferenceEngine
+from .execution import MeshExecutor
 from .prefix_cache import PrefixIndex, PrefixMatch
-from .sampling import SamplingParams, as_lanes, position_keys, sample_tokens
+from .sampling import SamplingParams, as_lanes
 from .speculative import SpeculativeConfig, SpeculativeDecoder
 
 _bucket = InferenceEngine._bucket   # shared prompt-length bucketing (pow2>=16)
-
-# process-global COW page-copy programs, keyed by donation (jax.jit caches on
-# argument avals, so every engine with the same pool shape/dtype — notably a
-# warm-restart replacement — shares ONE compile per process)
-_COW_PROGS: Dict[bool, Any] = {}
 
 # a COW boundary match must save at least this much prefill to be worth a
 # cross-layer page snapshot — a 1-token match (first tokens coinciding by
@@ -312,25 +321,16 @@ class ServingEngine:
             raise ValueError(
                 f"quarantine_limit={self.quarantine_limit} must be >= 1")
 
-        cache = model.init_paged_cache(self.num_pages, self.page_size,
-                                       dtype=dtype)
-        # commit the fresh pool to its placement: a jit caches on the arg's
-        # committed-ness, so an UNcommitted initial pool would cost each
-        # program one extra compile when the second call arrives holding
-        # committed program outputs.  On a mesh the pool must live on the
-        # same device set as the (sharded) params — KV heads over 'model'
-        # per paged_cache_specs.
-        if mesh is not None:
-            from jax.sharding import NamedSharding
-
-            specs = model.paged_cache_specs()
-            self._kpool = jax.device_put(cache["k"],
-                                         NamedSharding(mesh, specs["k"]))
-            self._vpool = jax.device_put(cache["v"],
-                                         NamedSharding(mesh, specs["v"]))
-        else:
-            self._kpool = jax.device_put(cache["k"], cache["k"].sharding)
-            self._vpool = jax.device_put(cache["v"], cache["v"].sharding)
+        # ---- the device half (docs/SERVING.md "Multi-chip serving"): pool
+        # placement, auto-TP param sharding, program construction and the
+        # zero-recompile inventory live in the MeshExecutor — the scheduling
+        # code below never touches a device array directly, so the same
+        # loop drives one chip or a tensor-sharded mesh unchanged.
+        self.mesh = mesh
+        self._exec = MeshExecutor(model, params, self.num_pages,
+                                  self.page_size, self.b_slots, dtype=dtype,
+                                  mesh=mesh, prefix_cache=prefix_cache)
+        self.params = self._exec.params   # auto-TP-sharded on a mesh
         self._free_pages: List[int] = list(range(self.num_pages - 1, 0, -1))
         # per-page reference counts (page 0, the trash page, is never
         # counted): 0 = free or quarantined, >0 = held by slots and/or the
@@ -361,11 +361,6 @@ class ServingEngine:
         self._lane_top_k = np.zeros((self.b_slots,), np.int32)
         self._lane_top_p = np.ones((self.b_slots,), np.float32)
         self._lane_seed = np.zeros((self.b_slots,), np.uint32)
-        # device copy of the lane vectors, rebuilt only when a lane
-        # changes (admission / retirement) — unlike lengths/last_tok the
-        # lanes are constant across a request's whole decode, so the
-        # per-tick call must not pay 4 host->device transfers for them
-        self._lanes_device = None
         self.sampled_admissions = 0   # non-greedy requests admitted
         self._slots: List[Optional[_Slot]] = [None] * self.b_slots
         self._queue: Deque[Request] = deque()
@@ -415,19 +410,21 @@ class ServingEngine:
         srv = maybe_start_metrics_server(monitor)
         self.metrics_port = srv.port if srv is not None else None
 
-        # donation: each tick consumes and reproduces the pool — donate the
-        # buffers so the pool exists once in HBM, not twice (CPU has no
-        # donation support and would warn every compile)
-        self._donate = (1, 2) if jax.default_backend() != "cpu" else ()
-        self._decode_prog = self._build_decode()
-        self._prefill_progs: Dict[int, Any] = {}
-        self._cow_prog = self._build_cow() if prefix_cache else None
-        if self._cow_prog is not None:
-            # pre-warm the one COW program shape with a trash-page self-copy
-            # so its single compile lands at init, never during admission —
-            # the zero-recompile steady state must hold from the first tick
-            self._kpool, self._vpool = self._cow_prog(
-                self._kpool, self._vpool, jnp.int32(0), jnp.int32(0))
+        # multi-chip gauges are CONSTANT for the engine's lifetime (the
+        # pool never reallocates, the mesh never changes) — write them once
+        # at init; the Prometheus exposition serves the latest value per
+        # name, so /metrics carries them from the first scrape
+        info = self._exec.mesh_info()
+        if self.monitor is not None:
+            pb = self._exec.pool_bytes
+            self.monitor.write_events(
+                [("serve/mesh_devices", float(info["mesh_devices"]), 0),
+                 ("serve/kv_pool_bytes_total", float(pb["total"]), 0),
+                 ("serve/kv_pool_bytes_per_device",
+                  float(pb["per_device"]), 0)]
+                + [(f"serve/mesh_axis_{a}", float(s), 0)
+                   for a, s in info["mesh_axes"].items()])
+
         # speculative decoding (docs/SERVING.md "Speculative decoding"): a
         # draft model over its OWN pool with the same page geometry,
         # indexed by the same per-slot page tables — admission prefills
@@ -447,71 +444,47 @@ class ServingEngine:
         log_dist(
             f"serving engine ready: b_slots={self.b_slots} "
             f"pages={self.num_pages}x{self.page_size} "
-            f"(max_model_len={self.max_model_len})", ranks=[0])
+            f"(max_model_len={self.max_model_len})"
+            + (f" mesh={info['mesh_devices']}dev {info['mesh_axes']}"
+               if mesh is not None else ""), ranks=[0])
 
-    # ------------------------------------------------------------ programs
+    # ---------------------------------------------- device-half delegation
+    # The executor owns the pool, the compiled programs and the donation
+    # policy (inference/execution.py).  These views exist for the
+    # supervisor's adoption checks, the probe/canary tests that swap a
+    # bucket's program, and the speculative tick's pool handoff.
 
-    def _build_decode(self):
-        apply_paged = self.model.apply_paged
+    @property
+    def _kpool(self):
+        return self._exec.kpool
 
-        def prog(params, kpool, vpool, page_table, lengths, last_tok, active,
-                 temp, top_k, top_p, seeds):
-            # write each slot's last token at position `lengths`, read the
-            # next-token logits; inactive slots write to the trash page.
-            # The sampled token will sit at stream position `lengths + 1`,
-            # so its lane key folds that position — the same counter
-            # generate(sampling=...) and a replay/failover re-prefill
-            # derive, which is what keeps sampled streams engine-
-            # independent and resume-exact (docs/SERVING.md "Sampling").
-            cache = {"k": kpool, "v": vpool}
-            logits, cache = apply_paged(params, last_tok[:, None], cache,
-                                        page_table, lengths, active[:, None])
-            nxt = sample_tokens(logits[:, -1, :], temp, top_k, top_p,
-                                lambda: position_keys(seeds, lengths + 1))
-            return nxt, cache["k"], cache["v"]
+    @_kpool.setter
+    def _kpool(self, value):
+        self._exec.kpool = value
 
-        return jax.jit(prog, donate_argnums=self._donate)
+    @property
+    def _vpool(self):
+        return self._exec.vpool
 
-    def _build_prefill(self, s_pad: int):
-        apply_paged = self.model.apply_paged
+    @_vpool.setter
+    def _vpool(self, value):
+        self._exec.vpool = value
 
-        def prog(params, kpool, vpool, pt_row, tokens, n_real, start,
-                 temp, top_k, top_p, seed):
-            # tokens [1, s_pad] right-padded; only the first n_real K/V are
-            # written (pads go to the trash page); the first generated token
-            # samples the last REAL position's logits under the request's
-            # lane ([1]-shaped traced params — greedy folds to argmax
-            # in-graph, so the historical greedy contract is bit-identical).
-            # `start` is the slot position of tokens[:, 0] — 0 for a cold
-            # prefill, the shared-prefix length for a tail prefill (the
-            # gather still covers the whole page-table row, so queries
-            # attend to the shared pages through the ordinary causal mask).
-            # A traced scalar: every start shares ONE program per bucket.
-            seq_mask = (jnp.arange(s_pad, dtype=jnp.int32) < n_real)[None, :]
-            cache = {"k": kpool, "v": vpool}
-            logits, cache = apply_paged(params, tokens, cache, pt_row,
-                                        start[None], seq_mask)
-            lg = logits[0, n_real - 1, :][None]        # [1, V]
-            # the emitted token will sit at stream position S = start +
-            # n_real — the counter-based key generate(sampling=...) and
-            # every replay/failover resume re-derive for the same position
-            nxt = sample_tokens(
-                lg, temp, top_k, top_p,
-                lambda: position_keys(seed, (start + n_real)[None]))[0]
-            return nxt, cache["k"], cache["v"]
+    @property
+    def _decode_prog(self):
+        return self._exec._decode_prog
 
-        return jax.jit(prog, donate_argnums=self._donate)
+    @property
+    def _prefill_progs(self) -> Dict[int, Any]:
+        return self._exec._prefill_progs
 
-    def _build_cow(self):
-        # process-global jit (see _COW_PROGS): a replacement engine's init
-        # prewarm then hits the jit cache on the same pool avals instead of
-        # recompiling a fresh closure inside the warm-restart critical path
-        donate = jax.default_backend() != "cpu"
-        prog = _COW_PROGS.get(donate)
-        if prog is None:
-            prog = _COW_PROGS[donate] = jax.jit(
-                cow_copy_page, donate_argnums=(0, 1) if donate else ())
-        return prog
+    @property
+    def _cow_prog(self):
+        return self._exec._cow_prog
+
+    @property
+    def _donate(self):
+        return self._exec._donate
 
     def program_inventory(self) -> Dict[str, Any]:
         """The full set of program shapes this engine has built: one decode
@@ -899,9 +872,6 @@ class ServingEngine:
         tail = req.input_ids[n_shared:]
         S_tail = len(tail)   # >= 1: lookup is capped at prompt-1
         s_pad = _bucket(S_tail)
-        prog = self._prefill_progs.get(s_pad)
-        if prog is None:
-            prog = self._prefill_progs[s_pad] = self._build_prefill(s_pad)
         self._page_table[slot, :] = 0
         self._page_table[slot, :len(pages)] = pages
         toks = np.zeros((1, s_pad), np.int32)
@@ -918,9 +888,7 @@ class ServingEngine:
                     # past cow_valid in the snapshot are donor garbage the
                     # tail prefill/decode overwrites before causality can
                     # expose them.
-                    self._kpool, self._vpool = self._cow_prog(
-                        self._kpool, self._vpool,
-                        jnp.int32(match.cow_src), jnp.int32(private[0]))
+                    self._exec.cow(match.cow_src, private[0])
                     self.cow_copies += 1
                     if self._spec is not None:
                         # mirror the snapshot in the draft pool — the
@@ -930,17 +898,10 @@ class ServingEngine:
                                        private[0])
                 pt_row = jnp.asarray(self._page_table[slot:slot + 1])
                 toks_j = jnp.asarray(toks)
-                # lanes ride as numpy arrays: jit device-puts them without
-                # compiling the tiny list->array convert programs a
-                # jnp.asarray of a Python list would cost on first use
-                nxt, self._kpool, self._vpool = prog(
-                    self.params, self._kpool, self._vpool,
-                    pt_row, toks_j, jnp.int32(S_tail), jnp.int32(n_shared),
-                    np.asarray([lane_t], np.float32),
-                    np.asarray([lane_k], np.int32),
-                    np.asarray([lane_p], np.float32),
-                    np.asarray([lane_s], np.uint32))
-                tok = int(nxt)   # host fetch inside the watchdog window
+                tok = int(self._exec.prefill(
+                    s_pad, pt_row, toks_j, S_tail, n_shared,
+                    lane_t, lane_k, lane_p, lane_s))
+                # host fetch above lands inside the watchdog window
                 if self._spec is not None:
                     # draft-pool prefill of the same tail (same bucket,
                     # page-table row, start) — the draft emits nothing
@@ -959,7 +920,7 @@ class ServingEngine:
         self._lane_top_k[slot] = lane_k
         self._lane_top_p[slot] = lane_p
         self._lane_seed[slot] = lane_s
-        self._lanes_device = None
+        self._exec.invalidate_lanes()
         if req.sampling is not None and not req.sampling.greedy:
             self.sampled_admissions += 1
         self._tokens_out += 1
@@ -997,12 +958,8 @@ class ServingEngine:
         return contextlib.nullcontext()
 
     def _lanes_jnp(self):
-        if self._lanes_device is None:
-            self._lanes_device = (jnp.asarray(self._lane_temp),
-                                  jnp.asarray(self._lane_top_k),
-                                  jnp.asarray(self._lane_top_p),
-                                  jnp.asarray(self._lane_seed))
-        return self._lanes_device
+        return self._exec.lanes(self._lane_temp, self._lane_top_k,
+                                self._lane_top_p, self._lane_seed)
 
     def _decode_tick(self) -> None:
         if self._spec is not None:
@@ -1012,11 +969,8 @@ class ServingEngine:
         with trace_span("serve.decode", tick=self._tick):
             maybe_fire(SITE_SERVE_DECODE, tick=self._tick)
             with self._armed(f"serve.decode tick {self._tick}"):
-                nxt, self._kpool, self._vpool = self._decode_prog(
-                    self.params, self._kpool, self._vpool,
-                    jnp.asarray(self._page_table), jnp.asarray(self._lengths),
-                    jnp.asarray(self._last_tok), jnp.asarray(self._active),
-                    *lanes)
+                nxt = self._exec.decode(self._page_table, self._lengths,
+                                        self._last_tok, self._active, lanes)
                 nxt = np.asarray(nxt)   # host fetch = device sync
         active_slots = np.flatnonzero(self._active)
         trace_count("serve.tokens", float(len(active_slots)))
@@ -1113,7 +1067,7 @@ class ServingEngine:
         self._lane_top_k[slot] = 0
         self._lane_top_p[slot] = 1.0
         self._lane_seed[slot] = 0
-        self._lanes_device = None
+        self._exec.invalidate_lanes()
 
     # ----------------------------------------------------- probe / unfence
 
@@ -1138,9 +1092,6 @@ class ServingEngine:
             return   # fenced without a page record (defensive): stay fenced
         self.probe_count += 1
         s_pad = _bucket(1)
-        prog = self._prefill_progs.get(s_pad)
-        if prog is None:
-            prog = self._prefill_progs[s_pad] = self._build_prefill(s_pad)
         # one-token canary through the slot's own quarantined pages: the
         # same program shape real admissions use, against the same page row
         toks = np.zeros((1, s_pad), np.int32)
@@ -1150,15 +1101,11 @@ class ServingEngine:
             with trace_span("serve.probe", slot=slot):
                 maybe_fire(SITE_SERVE_PREFILL, rid="__canary__", slot=slot)
                 with self._armed(f"serve.probe slot={slot}"):
-                    nxt, self._kpool, self._vpool = prog(
-                        self.params, self._kpool, self._vpool,
-                        jnp.asarray(self._page_table[slot:slot + 1]),
-                        jnp.asarray(toks), jnp.int32(1), jnp.int32(0),
-                        np.zeros((1,), np.float32),        # greedy canary
-                        np.zeros((1,), np.int32),          # lane: the same
-                        np.ones((1,), np.float32),         # program shape
-                        np.zeros((1,), np.uint32))         # admissions use
-                    int(nxt)   # host fetch: the probe must really complete
+                    # greedy lane — the same program shape admissions use;
+                    # the host fetch means the probe must really complete
+                    int(self._exec.prefill(
+                        s_pad, jnp.asarray(self._page_table[slot:slot + 1]),
+                        jnp.asarray(toks), 1, 0, 0.0, 0, 1.0, 0))
         except BaseException as e:
             self._page_table[slot, :] = 0
             self._fence_tick[slot] = self._tick
@@ -1201,8 +1148,7 @@ class ServingEngine:
         buffers (the speculative draft pool counts: a consumed draft pool
         poisons every subsequent verify) — the engine can no longer decode
         and must be rebuilt."""
-        dead = getattr(self._kpool, "is_deleted", None)
-        if dead and self._kpool.is_deleted():
+        if not self._exec.pool_alive():
             return False
         return self._spec is None or self._spec.pool_alive()
 
@@ -1345,9 +1291,22 @@ class ServingEngine:
         plus the resilience counters and page accounting."""
         now = time.monotonic()
         acct = self.page_accounting()
+        info = self._exec.mesh_info()
+        pb = self._exec.pool_bytes
         return {
             "tick": self._tick,
             "pool_alive": self.pool_alive(),
+            # multi-chip serving (docs/SERVING.md): the mesh this engine's
+            # programs span, and the per-device KV-pool footprint — on a
+            # tp-sharded mesh bytes_per_device is ~total/tp (heads over
+            # 'model'), the number HBM capacity planning reads
+            "mesh_devices": info["mesh_devices"],
+            "mesh_axes": info["mesh_axes"],
+            "kv_pool_bytes_total": pb["total"],
+            "kv_pool_bytes_per_device": pb["per_device"],
+            "draft_pool_bytes_per_device": (
+                self._spec.pool_bytes["per_device"]
+                if self._spec is not None else 0),
             "draining": self._draining,
             "queue_depth": len(self._queue) + len(self._pending),
             "active_slots": int(self._active.sum()),

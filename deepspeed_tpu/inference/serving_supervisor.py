@@ -609,17 +609,20 @@ class ServingSupervisor:
     @staticmethod
     def _adopt_programs(new: ServingEngine, old: ServingEngine) -> bool:
         """Carry the compiled decode/prefill programs across a restart when
-        the fleet shape matches — jax.jit caches on argument avals, and the
-        fresh pool has the same shape/dtype, so every adopted program is a
-        cache hit instead of a recompile."""
+        the fleet shape matches — jax.jit caches on argument avals
+        INCLUDING shardings, and the fresh pool has the same shape/dtype
+        AND the same mesh placement (the factory re-creates it with the
+        same NamedShardings), so every adopted program is a cache hit
+        instead of a recompile.  A mesh mismatch (resized slice) rebuilds:
+        programs compiled for one device set cannot serve another."""
         if (new.model is old.model
                 and new.b_slots == old.b_slots
                 and new.page_size == old.page_size
                 and new.num_pages == old.num_pages
                 and new.max_model_len == old.max_model_len
-                and new._donate == old._donate):
-            new._decode_prog = old._decode_prog
-            new._prefill_progs.update(old._prefill_progs)
+                and new._donate == old._donate
+                and new.mesh == old.mesh):
+            new._exec.adopt_programs(old._exec)
             # _cow_prog needs no adoption: it is the process-global
             # _COW_PROGS jit, already shared by both engines
             if new._spec is not None and new._spec.compatible(old._spec):

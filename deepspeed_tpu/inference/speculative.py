@@ -173,27 +173,40 @@ class SpeculativeDecoder:
     def __init__(self, config: SpeculativeConfig, target_model,
                  num_pages: int, page_size: int, b_slots: int,
                  dtype=None, mesh=None, donate: bool = False):
+        from .execution import place_params, pool_bytes
+
         self.config = config
         self.k = int(config.k)
         self.draft_model = config.draft_model
-        self.draft_params = config.draft_params
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
         self.b_slots = int(b_slots)
         self._donate = bool(donate)
+        self._mesh = mesh
+        tp = int(mesh.shape.get("model", 1)) if mesh is not None else 1
+        if tp > 1 and self.draft_model.config.kv_heads % tp != 0:
+            raise ValueError(
+                f"draft kv_heads={self.draft_model.config.kv_heads} not "
+                f"divisible by the mesh's model axis ({tp}): the draft "
+                "pool shards its head dim over 'model' exactly like the "
+                "target's (paged_cache_specs)")
+        # the draft's weights ride the same auto-TP shardings as the
+        # target's (a layer-skip draft SHARES the target's leaves, so this
+        # is a no-op for it; a standalone draft tree gets sharded here)
+        self.draft_params = place_params(config.draft_params, mesh)
         cache = self.draft_model.init_paged_cache(num_pages, page_size,
                                                   dtype=dtype)
+        self._kv_spec = self.draft_model.paged_cache_specs()["k"]
         if mesh is not None:
             from jax.sharding import NamedSharding
 
-            specs = self.draft_model.paged_cache_specs()
-            self._dkpool = jax.device_put(cache["k"],
-                                          NamedSharding(mesh, specs["k"]))
-            self._dvpool = jax.device_put(cache["v"],
-                                          NamedSharding(mesh, specs["v"]))
+            sh = NamedSharding(mesh, self._kv_spec)
+            self._dkpool = jax.device_put(cache["k"], sh)
+            self._dvpool = jax.device_put(cache["v"], sh)
         else:
             self._dkpool = jax.device_put(cache["k"], cache["k"].sharding)
             self._dvpool = jax.device_put(cache["v"], cache["v"].sharding)
+        self.pool_bytes = pool_bytes(self._dkpool, self._dvpool)
         dn = (1, 2) if donate else ()
         self._draft_prog = self._build_draft(dn)
         self._verify_prog = self._build_verify(target_model, dn)
@@ -223,7 +236,9 @@ class SpeculativeDecoder:
             q = sampling_probs(lg, temp, top_k, top_p)
             return d_tok, q, cache["k"], cache["v"]
 
-        return jax.jit(prog, donate_argnums=donate)
+        from .execution import pool_jit
+
+        return pool_jit(prog, donate, self._mesh, self._kv_spec, 2)
 
     def _build_draft_prefill(self, s_pad: int):
         draft_apply = self.draft_model.apply_paged
@@ -236,8 +251,10 @@ class SpeculativeDecoder:
                                    start[None], seq_mask)
             return cache["k"], cache["v"]
 
-        return jax.jit(prog,
-                       donate_argnums=(1, 2) if self._donate else ())
+        from .execution import pool_jit
+
+        return pool_jit(prog, (1, 2) if self._donate else (), self._mesh,
+                        self._kv_spec, 0)
 
     def _build_verify(self, target_model, donate):
         target_apply = target_model.apply_paged
@@ -307,7 +324,13 @@ class SpeculativeDecoder:
             n_emit = jnp.minimum(n_acc + 1, k).astype(jnp.int32)
             return emitted, n_emit, cache["k"], cache["v"]
 
-        return jax.jit(prog, donate_argnums=donate)
+        from .execution import pool_jit
+
+        # the verify pass consumes and reproduces the TARGET pool: its
+        # output pools pin to the target's canonical sharding, same as the
+        # plain decode tick's
+        return pool_jit(prog, donate, self._mesh, target_model
+                        .paged_cache_specs()["k"], 2)
 
     def program_inventory(self) -> Dict[str, Any]:
         return {"k": self.k, "draft_decode": 1, "verify": 1,

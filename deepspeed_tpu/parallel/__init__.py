@@ -1,3 +1,4 @@
-from .mesh import (MeshLayout, build_mesh, initialize_mesh, get_mesh, get_layout,
+from .mesh import (MeshLayout, build_mesh, initialize_mesh,
+                   initialize_serving_mesh, get_mesh, get_layout,
                    reset_mesh, batch_pspec, replicated_pspec, dp_world_size,
                    ProcessTopology, topology_from_mesh, MESH_AXES, ZERO_AXES, BATCH_AXES)

@@ -136,6 +136,33 @@ def initialize_mesh(layout: Optional[MeshLayout] = None,
     return _GLOBAL_MESH
 
 
+def initialize_serving_mesh(tp: int = 1, n_devices: Optional[int] = None,
+                            dp: Optional[int] = None) -> Mesh:
+    """The multi-chip serving recipe (docs/SERVING.md "Multi-chip
+    serving"): install a ``('data', 'model')``-shaped global mesh over the
+    first ``n_devices`` devices with the model axis = ``tp`` — the KV pool
+    shards its head dim over 'model' and the remaining degree lands on
+    'data'.  On CPU, force the virtual devices BEFORE jax initializes::
+
+        XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+    and this builds the same SPMD partitions a TPU slice compiles.  The
+    returned mesh is also installed as the process-global mesh, so the
+    model's internal sharding constraints and the serving programs agree
+    on one device set (pass it to ``init_inference(mesh=...)`` /
+    ``ServingEngine(mesh=...)``)."""
+    devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"n_devices={n_devices} exceeds the {len(devices)} visible "
+                "device(s) — on CPU, set XLA_FLAGS="
+                "--xla_force_host_platform_device_count before jax starts")
+        devices = devices[:n_devices]
+    layout = MeshLayout.from_world(len(devices), tp=tp, dp=dp)
+    return initialize_mesh(layout, devices=devices)
+
+
 def get_mesh() -> Mesh:
     if _GLOBAL_MESH is None:
         initialize_mesh()

@@ -48,6 +48,19 @@ def test_sharded_matmul_runs_on_mesh():
     np.testing.assert_allclose(np.asarray(y), np.full((16, 64), 32.0))
 
 
+def test_initialize_serving_mesh_subset_and_tp():
+    """The multi-chip serving recipe: a tp mesh over the first N devices,
+    installed as the global mesh (docs/SERVING.md "Multi-chip serving")."""
+    from deepspeed_tpu.parallel import initialize_serving_mesh
+
+    mesh = initialize_serving_mesh(tp=4, n_devices=4)
+    assert mesh.size == 4
+    assert mesh.shape["model"] == 4 and mesh.shape["data"] == 1
+    assert get_mesh() is mesh
+    with pytest.raises(ValueError, match="exceeds"):
+        initialize_serving_mesh(tp=2, n_devices=jax.device_count() + 1)
+
+
 class TestProcessTopology:
     """Mirrors reference ProcessTopology behavior (topology.py:12)."""
 

@@ -562,6 +562,29 @@ def test_serve_soak_short_deterministic():
     assert stats["parity_checked"] >= 1
 
 
+@pytest.mark.chaos
+def test_serve_soak_short_deterministic_on_mesh():
+    """The ISSUE 10 pinned seed: the same seeded kill/replay soak on a
+    2-device mesh (model axis = 2) — every page-accounting + refcount
+    invariant must hold with the pool SHARDED, and the soak's tp>1 branch
+    re-asserts mesh facts + per-device pool bytes = total/2."""
+    import os
+    import sys
+
+    tools = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         os.pardir, os.pardir, "tools")
+    sys.path.insert(0, tools)
+    try:
+        from chaos_soak import run_serve_soak
+    finally:
+        sys.path.remove(tools)
+    stats = run_serve_soak(seed=5, n_requests=6, verbose=False, tp=2)
+    assert stats["tp"] == 2
+    assert stats["terminal"] == stats["submitted"] == 6
+    assert stats["faults_fired"] >= 1
+    assert stats["parity_checked"] >= 1
+
+
 @pytest.mark.slow
 @pytest.mark.chaos
 def test_serve_soak_driver_multiseed(tmp_path):

@@ -169,8 +169,14 @@ def run_soak(seed: int, total_steps: int, ckpt_every: int, ckpt_dir: str,
 
 
 def run_serve_soak(seed: int, n_requests: int = 8, b_slots: int = 3,
-                   verbose: bool = True) -> dict:
+                   verbose: bool = True, tp: int = 1) -> dict:
     """One supervised serving session under a seeded random kill schedule.
+
+    ``tp > 1`` runs the WHOLE session on a ``tp``-device mesh (model axis =
+    tp over the first tp virtual host devices): the paged pool shards its
+    KV-head dim, every kill/replay lands on sharded programs, and the same
+    page-accounting + refcount invariants must hold — plus the sharded
+    extras (mesh facts in health(), per-device pool bytes = total/tp).
 
     The soak draws decode/prefill/replay kill points (and, half the time, a
     bounded queue + one dead-on-arrival deadline) from ``seed``, replays a
@@ -206,8 +212,13 @@ def run_serve_soak(seed: int, n_requests: int = 8, b_slots: int = 3,
     rng = Random(seed)
     model = CausalLM("tiny", dtype=jnp.float32, attn_impl="xla")
     params = model.init_fn(jax.random.PRNGKey(0))
+    mesh_kw = {}
+    if tp > 1:
+        from deepspeed_tpu.parallel.mesh import initialize_serving_mesh
+
+        mesh_kw["mesh"] = initialize_serving_mesh(tp=tp, n_devices=tp)
     engine = deepspeed_tpu.init_inference(
-        model=model, config={"dtype": "float32"}, params=params)
+        model=model, config={"dtype": "float32"}, params=params, **mesh_kw)
 
     nprng = np.random.default_rng(seed)
     # half the stream shares a seeded system prompt (long enough for one
@@ -290,8 +301,20 @@ def run_serve_soak(seed: int, n_requests: int = 8, b_slots: int = 3,
     # after drain no slot is active: every referenced page is index-cached
     assert acct["referenced"] == acct["cached"], \
         f"serve soak seed={seed}: leaked slot reference: {acct}"
+    if tp > 1:
+        # sharded extras (ISSUE 10): the mesh the session ran on is
+        # visible in health() and the pool's per-device footprint is
+        # total/tp — the page-accounting + refcount invariants above
+        # already held on the SHARDED pool across every kill/replay
+        assert h["mesh_devices"] == tp, \
+            f"serve soak seed={seed}: mesh facts wrong: {h['mesh_devices']}"
+        assert h["mesh_axes"].get("model") == tp, h["mesh_axes"]
+        assert h["kv_pool_bytes_per_device"] * tp \
+            == h["kv_pool_bytes_total"], \
+            f"serve soak seed={seed}: per-device pool bytes not 1/tp"
     stats = {
         "seed": seed,
+        "tp": tp,
         "submitted": len(base),
         "terminal": len(by_rid),
         "parity_checked": parity_checked,
@@ -833,6 +856,10 @@ def main(argv=None) -> int:
     ap.add_argument("--ckpt-every", type=int, default=2)
     ap.add_argument("--requests", type=int, default=8,
                     help="serve mode: requests per soak stream")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="serve mode: run each soak on a tp-device mesh "
+                         "(model axis = tp over the first tp virtual host "
+                         "devices; ISSUE 10 sharded serving)")
     ap.add_argument("--hosts", type=int, default=4,
                     help="pod mode: simulated hosts per soak")
     ap.add_argument("--seed", type=int, default=0,
@@ -854,9 +881,10 @@ def main(argv=None) -> int:
     for i in range(args.soaks):
         seed = args.seed + i
         if args.mode == "serve":
-            print(f"serve soak {i + 1}/{args.soaks} (seed={seed})")
+            print(f"serve soak {i + 1}/{args.soaks} (seed={seed}"
+                  + (f", tp={args.tp}" if args.tp > 1 else "") + ")")
             try:
-                run_serve_soak(seed, n_requests=args.requests)
+                run_serve_soak(seed, n_requests=args.requests, tp=args.tp)
             # broad catch by design: RestartBudgetExhausted / ServeTimeout /
             # an escaped InjectedFault ARE the per-seed failure signal this
             # driver exists to tally — one bad seed must not kill the rest

@@ -80,6 +80,19 @@ def _pct(xs, q):
     return xs[min(len(xs) - 1, int(round(q * (len(xs) - 1))))]
 
 
+def _clone_requests(stream, sampling: bool = True):
+    """Fresh Request objects for replaying ``stream`` through another
+    engine/pass (engines reject rid reuse within one engine; clones keep
+    the passes independent).  Drops ``arrival_time``/``deadline_s`` — the
+    benches replay saturated — and ``sampling=False`` strips the lanes
+    (greedy replay of a sampled stream).  ONE helper for every bench so a
+    new Request field is carried (or deliberately dropped) in one place."""
+    return [type(r)(rid=r.rid, input_ids=r.input_ids,
+                    max_new_tokens=r.max_new_tokens,
+                    sampling=(r.sampling if sampling else None))
+            for r in stream]
+
+
 def build_prefix_stream(vocab: int, n_requests: int, seed: int,
                         n_system: int = 2, sys_len: int = 230,
                         tail_rng=(4, 9), new_choices=(6, 8, 10)):
@@ -146,14 +159,21 @@ _CPU_BENCH_OVERRIDES = dict(hidden_size=256, intermediate_size=512,
                             num_layers=4, num_heads=8, vocab_size=2048)
 
 
-def _build_bench_engine(base_cfg: str, max_model_len: int, on_tpu: bool):
+def _build_bench_engine(base_cfg: str, max_model_len: int, on_tpu: bool,
+                        tp: int = 1, n_devices: int = None):
     """The model + inference engine both benches measure: bf16 on TPU at
-    the named config, f32 on CPU at the shared mid-size regime."""
+    the named config, f32 on CPU at the shared mid-size regime.  ``tp``/
+    ``n_devices`` install a model-axis-``tp`` global mesh over the first
+    ``n_devices`` devices (``--tp``, ISSUE 10) so the serving engine's
+    pool and programs tensor-shard over it — ``n_devices=1`` with
+    ``tp=1`` is the honest single-chip baseline (NOT the default
+    all-devices replicated mesh)."""
     import jax
     import jax.numpy as jnp
 
     import deepspeed_tpu
     from deepspeed_tpu.models import CausalLM
+    from deepspeed_tpu.parallel.mesh import initialize_serving_mesh
 
     dtype, cfg_dtype = ("bfloat16", jnp.bfloat16) if on_tpu \
         else ("float32", jnp.float32)
@@ -161,8 +181,14 @@ def _build_bench_engine(base_cfg: str, max_model_len: int, on_tpu: bool):
                      max_seq_len=max(max_model_len, 128),
                      **({} if on_tpu else _CPU_BENCH_OVERRIDES))
     params = model.init_fn(jax.random.PRNGKey(0))
+    mesh_kw = {}
+    if tp > 1 or n_devices is not None:
+        mesh_kw["mesh"] = initialize_serving_mesh(tp=tp,
+                                                  n_devices=n_devices)
     engine = deepspeed_tpu.init_inference(
-        model=model, config={"dtype": dtype}, params=params)
+        model=model,
+        config={"dtype": dtype, "tensor_parallel": {"tp_size": tp}},
+        params=params, **mesh_kw)
     return model, engine
 
 
@@ -200,10 +226,7 @@ def run_prefix_bench(model_name: str = "llama-374m", b_slots: int = 4,
     stream = build_prefix_stream(model.config.vocab_size, n_requests, seed,
                                  n_system=n_system, sys_len=sys_len)
 
-    def copies():
-        return [type(r)(rid=r.rid, input_ids=r.input_ids,
-                        max_new_tokens=r.max_new_tokens) for r in stream]
-
+    copies = lambda: _clone_requests(stream)          # noqa: E731
     count = compile_counter()
     kw = dict(b_slots=b_slots, page_size=page_size,
               max_model_len=max_model_len)
@@ -326,9 +349,7 @@ def run_fleet_bench(model_name: str = "llama-374m", n_engines: int = 3,
     stream = build_stream(model.config.vocab_size, n_requests, seed,
                           0.0, prompt_rng, new_choices)
 
-    def copies():
-        return [type(r)(rid=r.rid, input_ids=r.input_ids,
-                        max_new_tokens=r.max_new_tokens) for r in stream]
+    copies = lambda: _clone_requests(stream)          # noqa: E731
 
     # single-engine reference: the parity oracle AND the scale-out baseline
     ref_sup = engine.supervised_serving(
@@ -492,10 +513,7 @@ def run_sampled_bench(model_name: str = "llama-374m", b_slots: int = 8,
     count = compile_counter()
 
     def copies(sampled=True):
-        return [type(r)(rid=r.rid, input_ids=r.input_ids,
-                        max_new_tokens=r.max_new_tokens,
-                        sampling=(r.sampling if sampled else None))
-                for r in stream]
+        return _clone_requests(stream, sampling=sampled)
 
     # ---- parity oracle: per-request generate(sampling=...) through the
     # same counter-based lanes (greedy requests ride the greedy lane)
@@ -616,6 +634,154 @@ def run_sampled_bench(model_name: str = "llama-374m", b_slots: int = 8,
     }
 
 
+def run_mesh_bench(model_name: str = "llama-374m", tp: int = 2,
+                   b_slots: int = 4, n_requests: int = 16, seed: int = 0,
+                   page_size: int = 128, max_model_len: int = 0) -> dict:
+    """Multi-chip serving benchmark (ISSUE 10 acceptance): the same seeded
+    greedy and sampled streams through an UNSHARDED (tp=1, the historical
+    single-chip regime) and a TENSOR-SHARDED (model axis = ``tp``)
+    supervised serving engine, devices forced on CPU via
+    ``--xla_force_host_platform_device_count``.
+
+    Reports sharded-vs-unsharded tokens/sec + TTFT p50, the token-parity
+    gates (greedy AND sampled outputs identical across the two engines,
+    and identical to per-request ``generate()`` on the sharded params),
+    the compile count of the measured sharded passes (zero-recompile must
+    survive the mesh), and per-device KV-pool bytes — the ~1/tp shrink
+    that lets one pool span a slice's HBM.
+
+    The unsharded baseline runs on a SINGLE-device mesh (not the default
+    all-devices replicated mesh, which would charge the baseline 8-way
+    replication overhead and flatter the sharded number).
+
+    NOTE on CPU throughput: the virtual devices share ONE physical core,
+    so a sharded pass pays real partitioning overhead with none of a
+    slice's parallel FLOPs — the ratio documents that cost honestly; the
+    memory and parity columns are the acceptance surface.
+    """
+    import numpy as np
+
+    import jax
+
+    from deepspeed_tpu.inference.sampling import SamplingParams
+    from deepspeed_tpu.utils.compile_counter import compile_counter
+
+    n_dev = jax.device_count()
+    if tp < 2 or n_dev % tp != 0:
+        raise ValueError(f"--tp {tp} must be >= 2 and divide the "
+                         f"{n_dev} visible device(s)")
+    on_tpu = jax.devices()[0].platform not in ("cpu",)
+    if not on_tpu:
+        model_name, prompt_rng = "serve-mesh(cpu)", (3, 14)
+        new_choices = (8, 16)
+        base_cfg = "tiny"
+    else:
+        prompt_rng, new_choices = (4, 48), (32, 64)
+        base_cfg = model_name
+    max_model_len = max_model_len or (64 if not on_tpu else 2048)
+    page_size = min(page_size, max_model_len)
+    count = compile_counter()
+
+    copies = _clone_requests
+    per_cfg = {}
+    oracle_parity = None
+    for tp_c in (1, tp):
+        from deepspeed_tpu.parallel.mesh import reset_mesh
+
+        reset_mesh()
+        model, engine = _build_bench_engine(
+            base_cfg, max_model_len, on_tpu, tp=tp_c,
+            n_devices=(1 if tp_c == 1 else None))
+        vocab = model.config.vocab_size
+        greedy = build_stream(vocab, n_requests, seed, 0.0, prompt_rng,
+                              new_choices)
+        sampled = build_sampled_stream(vocab, n_requests, seed + 1,
+                                       prompt_rng, new_choices)
+        sup = engine.supervised_serving(b_slots=b_slots,
+                                        page_size=page_size,
+                                        max_model_len=max_model_len)
+        sup.run(copies(greedy))                      # warm
+        sup.run(copies(sampled))                     # warm (lane mix)
+        inventory = sup.engine.program_inventory()
+        n0 = count()
+        t0 = time.perf_counter()
+        res_g = sup.run(copies(greedy))              # measured greedy
+        dt_g = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        res_s = sup.run(copies(sampled))             # measured sampled
+        dt_s = time.perf_counter() - t0
+        compiles = count() - n0
+        h = sup.health()
+        if tp_c == tp:
+            # the generate() oracle on the SHARDED params: greedy rows and
+            # sampled rows alike must be token-identical to the one-shot
+            # path under the same counter-based lanes
+            oracle_parity = all(
+                np.array_equal(
+                    r.output_ids,
+                    np.asarray(engine.generate(
+                        req.input_ids[None],
+                        max_new_tokens=req.max_new_tokens,
+                        sampling=req.sampling or SamplingParams()))
+                    [0, len(req.input_ids):])
+                for stream, results in ((greedy, res_g), (sampled, res_s))
+                for req, r in zip(stream,
+                                  sorted(results, key=lambda x: x.rid)))
+        per_cfg[tp_c] = {
+            "tokens": sum(len(r.output_ids) for r in res_g + res_s),
+            "tokens_per_sec_greedy": round(
+                sum(len(r.output_ids) for r in res_g) / dt_g, 1),
+            "tokens_per_sec_sampled": round(
+                sum(len(r.output_ids) for r in res_s) / dt_s, 1),
+            "ttft_p50_s": round(_pct([r.ttft_s for r in res_g], 0.50), 4),
+            "compiles_during_measured_run": compiles,
+            "kv_pool_bytes_total": h["kv_pool_bytes_total"],
+            "kv_pool_bytes_per_device": h["kv_pool_bytes_per_device"],
+            "mesh_axes": h["mesh_axes"],
+            "inventory": inventory,
+            "outputs_greedy": {r.rid: r.output_ids for r in res_g},
+            "outputs_sampled": {r.rid: r.output_ids for r in res_s},
+            "restarts": sup.restarts,
+        }
+        del sup, engine       # release the pools before the next config
+
+    u, s = per_cfg[1], per_cfg[tp]
+    parity_greedy = all(np.array_equal(u["outputs_greedy"][rid], out)
+                        for rid, out in s["outputs_greedy"].items())
+    parity_sampled = all(np.array_equal(u["outputs_sampled"][rid], out)
+                         for rid, out in s["outputs_sampled"].items())
+    for cfg in (u, s):        # arrays served their purpose; keep JSON clean
+        cfg.pop("outputs_greedy")
+        cfg.pop("outputs_sampled")
+    shrink = u["kv_pool_bytes_per_device"] / max(
+        s["kv_pool_bytes_per_device"], 1)
+    return {
+        "metric": "serve-mesh",
+        "value": s["tokens_per_sec_greedy"],
+        "unit": "tokens/sec",
+        "vs_unsharded": round(s["tokens_per_sec_greedy"]
+                              / max(u["tokens_per_sec_greedy"], 1e-9), 3),
+        "detail": {
+            "model": model_name,
+            "platform": jax.devices()[0].platform,
+            "devices": n_dev,
+            "tp": tp,
+            "b_slots": b_slots,
+            "page_size": page_size,
+            "n_requests": n_requests,
+            "seed": seed,
+            "unsharded": u,
+            "sharded": s,
+            "kv_pool_per_device_shrink": round(shrink, 3),
+            # the acceptance gates: sharded == unsharded == generate(),
+            # greedy and sampled, with zero steady-state compiles
+            "token_exact_greedy": bool(parity_greedy),
+            "token_exact_sampled": bool(parity_sampled),
+            "parity_with_generate": bool(oracle_parity),
+        },
+    }
+
+
 def run_serve_bench(model_name: str = "llama-374m", b_slots: int = 8,
                     n_requests: int = 32, seed: int = 0,
                     rate_rps: float = 0.0, page_size: int = 128,
@@ -667,8 +833,7 @@ def run_serve_bench(model_name: str = "llama-374m", b_slots: int = 8,
     # arrivals-stripped (saturated) so vs_baseline compares like with like —
     # the baseline ignores arrival_time, and a Poisson-gated pass would
     # charge idle arrival waits against the serving engine.
-    stripped = [type(r)(rid=r.rid, input_ids=r.input_ids,
-                        max_new_tokens=r.max_new_tokens) for r in stream]
+    stripped = _clone_requests(stream)
     sup.run(list(stripped))                          # warm
     inventory = sup.engine.program_inventory()
     n_before = count()
@@ -786,12 +951,55 @@ def main(argv=None) -> int:
                          "16 CPU, 128 TPU)")
     ap.add_argument("--n_system", type=int, default=2,
                     help="prefix workload: distinct shared system prompts")
+    ap.add_argument("--tp", type=int, default=0,
+                    help="multi-chip workload (ISSUE 10): tensor-shard the "
+                         "decode tick + paged KV pool over a model-axis-N "
+                         "mesh and compare vs the unsharded engine — "
+                         "greedy+sampled token-parity gates, compile count, "
+                         "per-device pool bytes (forces the virtual host "
+                         "devices on CPU)")
     ap.add_argument("--max_model_len", type=int, default=0)
     ap.add_argument("--out", default=None, help="also write the JSON here")
     ap.add_argument("--trace", default=None, metavar="OUT.json",
                     help="emit a Chrome/Perfetto trace of one extra traced "
                          "pass (the measured pass stays untraced)")
     args = ap.parse_args(argv)
+    if args.tp:
+        if args.mode != "engine" or args.workload != "mixed" \
+                or args.trace or args.rate_rps or args.speculative \
+                or args.kill_engine or args.n_engines != 3 \
+                or args.journal_every_k != 4 or args.n_system != 2:
+            ap.error("--tp runs its own sharded-vs-unsharded comparison "
+                     "(greedy + sampled streams); it composes with "
+                     "--b_slots/--n_requests/--seed/--page_size/"
+                     "--max_model_len only")
+        # the forced host devices must win before jax initializes (the
+        # run_* imports below are what first touch jax); harmless on TPU,
+        # where the flag only affects the host platform
+        _flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in _flags:
+            os.environ["XLA_FLAGS"] = (
+                _flags + " --xla_force_host_platform_device_count=8").strip()
+        result = run_mesh_bench(
+            args.model, tp=args.tp,
+            b_slots=args.b_slots if args.b_slots is not None else 4,
+            n_requests=(args.n_requests
+                        if args.n_requests is not None else 16),
+            seed=args.seed,
+            page_size=args.page_size if args.page_size is not None else 128,
+            max_model_len=args.max_model_len)
+        line = json.dumps(result)
+        print(line)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        d = result["detail"]
+        ok = (d["token_exact_greedy"] and d["token_exact_sampled"]
+              and d["parity_with_generate"]
+              and d["sharded"]["compiles_during_measured_run"] == 0
+              and d["sharded"]["kv_pool_bytes_per_device"] * args.tp
+              == d["sharded"]["kv_pool_bytes_total"])
+        return 0 if ok else 1
     if args.mode == "fleet":
         if args.workload != "mixed":
             ap.error("--mode fleet runs the mixed stream (prefix reuse is "

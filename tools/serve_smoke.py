@@ -19,7 +19,12 @@ bit-identical.  A fifth phase runs the same greedy streams through a
 SPECULATIVE engine (layer-skip draft, verify-k): admission again compiles
 nothing beyond the init/bucket set, the inventory is stable across
 admissions, and greedy speculative outputs are token-identical to the plain
-engine's.  Exits nonzero on violation.
+engine's.  A sixth phase (ISSUE 10) runs a MIXED greedy/sampled admission
+through the speculative verify-k engine on a 4-device ``('data','model')``
+mesh (model axis 4, forced host devices): 0 steady-state compiles, a
+program inventory BIT-IDENTICAL to the unsharded speculative engine's —
+sharding is a placement property, never a program shape — and per-device
+KV-pool bytes = total/4.  Exits nonzero on violation.
 
 Wired into tier-1 via tests/unit/test_serving.py::test_serve_smoke_tool
 (non-slow, in-process).
@@ -146,6 +151,37 @@ def run_smoke(n_requests: int = 5, b_slots: int = 2, seed: int = 0) -> dict:
     spec_exact = all(np.array_equal(r.output_ids, plain_by_rid[r.rid])
                      for r in spec_results)
 
+    # ---- sharded phase (ISSUE 10): the same mixed greedy/sampled
+    # admission plus the speculative verify-k engine on a 4-device
+    # ('data','model') mesh (model axis = 4).  The warm streams build the
+    # sharded program inventory; the measured stream — greedy, sampled and
+    # speculative slots live at once — compiles NOTHING, the inventory is
+    # BIT-IDENTICAL to the unsharded speculative engine's (sharding is a
+    # placement property of the programs, never a new program shape), and
+    # the per-device KV-pool bytes are total/4.
+    from deepspeed_tpu.parallel.mesh import initialize_serving_mesh
+
+    del serve   # release the unsharded pools before the mesh engines build
+    mesh = initialize_serving_mesh(tp=4, n_devices=4)
+    engine_m = deepspeed_tpu.init_inference(
+        model=model, config={"dtype": "float32"}, params=params, mesh=mesh)
+    dm_m, dp_m = layer_skip_draft(model, engine_m.params, 1)
+    shard = engine_m.serving(
+        b_slots=b_slots, page_size=16, max_model_len=64,
+        speculative=SpeculativeConfig(draft_model=dm_m, draft_params=dp_m,
+                                      k=2))
+    shard.run(stream(seed))                          # warm (greedy buckets)
+    shard.run(sampled_stream("w", n_requests, seed + 3))   # warm (sampled)
+    shard_inv = shard.program_inventory()
+    base = count()
+    shard_results = shard.run(sampled_stream("m", n_requests, seed + 4))
+    shard_compiles = count() - base
+    shard_inv_ok = shard.program_inventory() == shard_inv
+    h = shard.health()
+    shard_pool_ok = (h["mesh_devices"] == 4
+                     and h["kv_pool_bytes_per_device"] * 4
+                     == h["kv_pool_bytes_total"])
+
     out = {
         "metric": "serve-smoke",
         "first_run_compiles": first_run,
@@ -163,6 +199,15 @@ def run_smoke(n_requests: int = 5, b_slots: int = 2, seed: int = 0) -> dict:
         "inventory_stable_across_speculative": bool(spec_inv_ok),
         "speculative_greedy_token_exact": bool(spec_exact),
         "speculative_inventory": spec_inv.get("speculative"),
+        "sharded_mesh_devices": h["mesh_devices"],
+        "sharded_steady_compiles": shard_compiles,
+        "inventory_stable_across_sharded": bool(shard_inv_ok),
+        # sharding must be a pure placement property: the sharded engine's
+        # inventory is structurally IDENTICAL to the unsharded speculative
+        # engine's (same decode/prefill/cow/verify shapes, same buckets)
+        "sharded_inventory_matches_unsharded": bool(shard_inv == spec_inv),
+        "sharded_pool_bytes_per_device_ok": bool(shard_pool_ok),
+        "sharded_served": len(shard_results),
         "ok": bool(first_run <= budget and steady == 0
                    and len(results) == n_requests
                    and shared_compiles == 0
@@ -170,15 +215,23 @@ def run_smoke(n_requests: int = 5, b_slots: int = 2, seed: int = 0) -> dict:
                    and hits_b == n_requests
                    and sampled_compiles == 0 and inv_sampled_ok
                    and len(sampled_results) == n_requests
-                   and spec_compiles == 0 and spec_inv_ok and spec_exact),
+                   and spec_compiles == 0 and spec_inv_ok and spec_exact
+                   and shard_compiles == 0 and shard_inv_ok
+                   and shard_inv == spec_inv and shard_pool_ok
+                   and len(shard_results) == n_requests),
     }
     return out
 
 
 def main(argv=None) -> int:
     # must win before jax initializes a backend (harmless under pytest's
-    # conftest, which already pinned cpu)
+    # conftest, which already pinned cpu + the 8 virtual devices the
+    # sharded phase needs)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
     result = run_smoke()
     print(json.dumps(result))
     if not result["ok"]:
@@ -186,8 +239,10 @@ def main(argv=None) -> int:
               "program inventory (admission recompiled?), the "
               "shared-prefix batch changed the inventory / missed the "
               "prefix index, the mixed-sampling batch compiled or changed "
-              "the inventory, or speculative greedy decode diverged from "
-              "the plain engine", file=sys.stderr)
+              "the inventory, speculative greedy decode diverged from "
+              "the plain engine, or the sharded 4-device phase compiled / "
+              "changed the inventory / missed the 1/tp pool shrink",
+              file=sys.stderr)
         return 1
     return 0
 
