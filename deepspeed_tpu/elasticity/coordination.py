@@ -492,6 +492,37 @@ def rollup_host_gauges(store: CoordinationStore, monitor, tick: int = 0,
     return gauges
 
 
+# ----------------------------------------------------- residency digests
+
+def publish_residency(store: CoordinationStore, owner_id: str, digest,
+                      prefix: str = "residency", **attrs) -> Dict:
+    """Publish a compact prefix-residency digest under
+    ``<prefix>/<owner_id>``: ``[[chain_key, tier], ...]`` pairs (tier 0 =
+    device-resident/hot, 1 = host-tier/demoted), MRU first.  Chain keys
+    are content-derived (``inference/prefix_cache.chain_keys``), so any
+    reader that can hash the same token chunks can match against the
+    digest without sharing Python objects with the owner — the serving
+    fleet router uses this (prefix ``fleet/residency``) to route
+    shared-prefix requests to the engine already holding the prefix
+    (docs/FLEET.md "Prefix residency routing")."""
+    doc = {"owner_id": str(owner_id), "t": store.now(),
+           "digest": [[int(k), int(t)] for k, t in digest],
+           "attrs": attrs}
+    store.put(f"{prefix}/{owner_id}", doc)
+    return doc
+
+
+def read_residency(store: CoordinationStore,
+                   prefix: str = "residency") -> Dict[str, Dict]:
+    """owner_id -> newest residency digest document under ``prefix``."""
+    out: Dict[str, Dict] = {}
+    for name in store.list(prefix):
+        doc = store.get(f"{prefix}/{name}")
+        if doc is not None:
+            out[str(doc.get("owner_id", name))] = doc
+    return out
+
+
 # --------------------------------------------------------------- generation
 
 def read_generation(store: CoordinationStore, key: str = "generation") -> int:

@@ -2,10 +2,12 @@
 built by ``deepspeed_tpu.init_inference``), the continuous-batching serving
 engine (``serving.ServingEngine``) over its mesh-wide execution tier
 (``execution.MeshExecutor`` — the tensor-sharded paged pool + program
-inventory), its warm-restart wrapper
+inventory) and host-RAM KV-page tier (``kv_tiering.HostTier`` — demoted
+prefix pages, promoted back on hits), its warm-restart wrapper
 (``serving_supervisor.ServingSupervisor``), the leased multi-engine
-fleet tier (``fleet.FleetRouter``), and the sampling/speculative subsystem
-(``sampling.SamplingParams``, ``speculative.SpeculativeConfig``)."""
+fleet tier (``fleet.FleetRouter``, with prefix-residency routing), and the
+sampling/speculative subsystem (``sampling.SamplingParams``,
+``speculative.SpeculativeConfig``)."""
 from .config import DeepSpeedInferenceConfig  # noqa: F401
 from .engine import InferenceEngine  # noqa: F401
 from .execution import MeshExecutor  # noqa: F401
@@ -15,7 +17,8 @@ from .fleet import (  # noqa: F401
     FleetRouter,
     FleetUnrecoverable,
 )
-from .prefix_cache import PrefixIndex, PrefixMatch  # noqa: F401
+from .kv_tiering import HostTier  # noqa: F401
+from .prefix_cache import PrefixIndex, PrefixMatch, chain_keys  # noqa: F401
 from .sampling import SamplingParams  # noqa: F401
 from .speculative import SpeculativeConfig, SpeculativeDecoder  # noqa: F401
 from .serving import (  # noqa: F401

@@ -49,6 +49,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..models.transformer import cow_copy_page
+from .kv_tiering import extract_page, inject_page
 from .sampling import position_keys, sample_tokens
 
 __all__ = ["MeshExecutor", "place_params", "pool_jit", "pool_bytes"]
@@ -59,6 +60,13 @@ __all__ = ["MeshExecutor", "place_params", "pool_jit", "pool_bytes"]
 # compile per process, and meshed/unmeshed pools each get their own
 # specialization of the same jit)
 _COW_PROGS: Dict[bool, Any] = {}
+
+# process-global KV-tiering programs (docs/SERVING.md "KV-page tiering"),
+# shared across engines for the same reason as _COW_PROGS.  The extract
+# half NEVER donates (a demote reads the pool and must leave it alive);
+# the inject half donates the pool like the COW snapshot.
+_TIER_EXTRACT_PROG: Any = None
+_TIER_INJECT_PROGS: Dict[bool, Any] = {}
 
 
 def pool_jit(fn, donate, mesh, kv_spec: P, n_leading: int):
@@ -127,7 +135,7 @@ class MeshExecutor:
 
     def __init__(self, model, params, num_pages: int, page_size: int,
                  b_slots: int, dtype=None, mesh=None,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True, host_tier: bool = False):
         self.model = model
         self.mesh = mesh
         self.num_pages = int(num_pages)
@@ -180,6 +188,21 @@ class MeshExecutor:
             # the zero-recompile steady state must hold from the first tick
             self.kpool, self.vpool = self._cow_prog(
                 self.kpool, self.vpool, jnp.int32(0), jnp.int32(0))
+        # KV-page tiering (docs/SERVING.md "KV-page tiering"): the device↔
+        # host page movers.  Page ids are traced scalars, so each is ONE
+        # program shape; both are pre-warmed on the trash page here at init
+        # so a demote/promote during admission can never compile.  The
+        # executor owns the move because on a mesh the host slab must be
+        # placed under the pool's own sharding (heads over 'model') so each
+        # shard receives exactly its head slice.
+        self._extract_prog = self._inject_prog = None
+        if host_tier:
+            self._extract_prog, self._inject_prog = self._build_tier()
+            hk, hv = self._extract_prog(self.kpool, self.vpool, jnp.int32(0))
+            hk, hv = np.asarray(hk), np.asarray(hv)
+            ph, pv = self._place_host_page(hk, hv)
+            self.kpool, self.vpool = self._inject_prog(
+                self.kpool, self.vpool, ph, pv, jnp.int32(0))
         # constant for the engine's lifetime (the pool never reallocates):
         # health()/gauges read these per tick, so compute them once
         self.pool_bytes = pool_bytes(self.kpool, self.vpool)
@@ -256,6 +279,34 @@ class MeshExecutor:
                 cow_copy_page, donate_argnums=(0, 1) if donate else ())
         return prog
 
+    def _build_tier(self):
+        # process-global jits (see _TIER_*): a warm-restart replacement's
+        # prewarm hits the jit cache on the same pool avals instead of
+        # recompiling.  No out_shardings on inject: the in-place page
+        # update propagates the input pools' sharding verbatim, exactly
+        # like COW.
+        global _TIER_EXTRACT_PROG
+        if _TIER_EXTRACT_PROG is None:
+            _TIER_EXTRACT_PROG = jax.jit(extract_page)
+        donate = jax.default_backend() != "cpu"
+        inj = _TIER_INJECT_PROGS.get(donate)
+        if inj is None:
+            inj = _TIER_INJECT_PROGS[donate] = jax.jit(
+                inject_page, donate_argnums=(0, 1) if donate else ())
+        return _TIER_EXTRACT_PROG, inj
+
+    def _place_host_page(self, hk, hv):
+        """Commit one host page slab pair to the pool's placement: on a
+        mesh the ``[L, page, Hkv, hd]`` slab shards its head dim over
+        'model' (the pool spec minus the page axis), so a promote feeds
+        each shard its own head slice; unmeshed, the numpy slabs ride the
+        jit's default device_put."""
+        if self.mesh is None:
+            return hk, hv
+        spec = P(self._kv_spec[0], *self._kv_spec[2:])
+        sh = NamedSharding(self.mesh, spec)
+        return jax.device_put(hk, sh), jax.device_put(hv, sh)
+
     # ---------------------------------------------------------- entry points
 
     def decode(self, page_table, lengths, last_tok, active, lanes):
@@ -293,6 +344,22 @@ class MeshExecutor:
         (copy-on-write boundary page; one fixed program shape)."""
         self.kpool, self.vpool = self._cow_prog(
             self.kpool, self.vpool, jnp.int32(src), jnp.int32(dst))
+
+    def extract(self, src: int):
+        """Demote half of the tier move: copy physical page ``src`` to
+        host, returning ``(hk, hv)`` numpy slabs of ``[L, page, Hkv, hd]``
+        (a sharded pool gathers the head shards into one slab).  Read-only
+        — the pool survives."""
+        hk, hv = self._extract_prog(self.kpool, self.vpool, jnp.int32(src))
+        return np.asarray(hk), np.asarray(hv)
+
+    def inject(self, hk, hv, dst: int) -> None:
+        """Promote half of the tier move: place the host slabs under the
+        pool's sharding and write them into physical page ``dst`` (one
+        fixed program shape; pools donated like COW)."""
+        ph, pv = self._place_host_page(hk, hv)
+        self.kpool, self.vpool = self._inject_prog(
+            self.kpool, self.vpool, ph, pv, jnp.int32(dst))
 
     def lanes(self, temp, top_k, top_p, seeds):
         """Cached device copy of the per-slot lane vectors; the engine

@@ -54,6 +54,17 @@ training pods — by leaning on the :class:`~..elasticity.coordination
   dispatched by the dead coordinator are tracked, failed over and completed
   by its successor.  Requests live on the coordination store, not in any
   single router's memory.
+- **Prefix residency routing** — each member publishes a compact
+  prefix-residency digest (``fleet/residency/<engine_id>``: the index's
+  content-derived chunk hashes + their tier, hot vs host-demoted) with
+  every advertisement, and admission grows a prefix-affinity term: a
+  request whose leading prompt chunks are resident on some engine routes
+  THERE (hot chunks score double a demoted one) instead of to the
+  least-loaded stranger, bounded by ``affinity_load_slack`` so affinity
+  never amplifies a hot spot.  Chunk hashes are pure functions of token
+  content (``prefix_cache.chain_keys``), so the router scores candidates
+  without sharing any Python state with the engines — closing the
+  per-engine prefix-index limitation of docs/FLEET.md.
 - **Rolling restarts** (:meth:`FleetRouter.rolling_restart`) — one engine
   at a time: stop routing to it, ``drain()`` (finishes in-flight work,
   token-exact mid-drain recovery included), redistribute the unserved
@@ -82,13 +93,17 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from collections import deque
+
 from ..elasticity.coordination import (CoordinationStore, beat,
                                        bump_generation, dead_set,
                                        dedup_drop_totals, elect_coordinator,
                                        lease_table, process_src,
-                                       read_generation, record_dead)
+                                       publish_residency, read_generation,
+                                       record_dead)
 from ..observability.trace import get_tracer, trace_span
 from ..utils.logging import log_dist, logger
+from .prefix_cache import chain_keys
 from .sampling import SamplingParams
 from .serving import Request, RequestResult, ServeTimeout, SlotPrefillError
 from .serving_supervisor import RestartBudgetExhausted, ServingSupervisor
@@ -101,6 +116,7 @@ FLEET_HEARTBEAT_PREFIX = "fleet/heartbeat"
 FLEET_DEAD_PREFIX = "fleet/dead"
 FLEET_ENGINES_PREFIX = "fleet/engines"
 FLEET_REQUESTS_PREFIX = "fleet/requests"
+FLEET_RESIDENCY_PREFIX = "fleet/residency"
 FLEET_COORDINATOR_KEY = "fleet/coordinator"
 FLEET_GENERATION_KEY = "fleet/generation"
 
@@ -162,6 +178,7 @@ class FleetMember:
         self.routable = True         # False while a rolling restart drains it
         self.death_cause: Optional[BaseException] = None
         self.last_advert: Optional[Dict[str, Any]] = None
+        self.last_residency: Optional[Dict[str, Any]] = None
         self._last_beat_t: Optional[float] = None   # store clock
         self.metrics_server = None
         if metrics_port is not None:
@@ -208,6 +225,14 @@ class FleetMember:
             return {}
         return self.sup.inflight_progress()
 
+    def residency_digest(self, cap: int = 1024) -> List:
+        """The engine's live prefix-residency digest — ``(chain_key,
+        tier)`` per cached full chunk, MRU first.  A dead member reports
+        nothing (its index died with it)."""
+        if not self.alive:
+            return []
+        return self.sup.engine.residency_digest(cap)
+
     # ------------------------------------------------- lease + advertisement
 
     def advertisement(self) -> Dict[str, Any]:
@@ -243,6 +268,14 @@ class FleetMember:
             "monitor_dropped": int(getattr(mon, "dropped_events", 0) or 0),
             "monitor_src": f"{src}.{id(mon)}",
             "last_restart_cause": h["last_restart_cause"],
+            # KV-page tiering rollup keys (docs/FLEET.md): the router sums
+            # these fleet-wide into the fleet/residency_* gauges
+            "page_size": int(self.sup.engine.page_size),
+            "residency_entries": h["prefix_index_entries"],
+            "demoted_pages": h["demoted_pages"],
+            "host_tier_bytes": h["host_tier_bytes"],
+            "promotions_total": h["promotions_total"],
+            "demotions_total": h["demotions_total"],
         }
 
     def beat(self, force: bool = False) -> None:
@@ -267,6 +300,13 @@ class FleetMember:
         # in-process readers (the router's gauge rollup) reuse what was
         # just written instead of re-reading the file every tick
         self.last_advert = ad
+        # prefix residency digest, same cadence as the advertisement: the
+        # store copy is the cross-process transport (a router with no live
+        # handle to this member reads it); an in-process router prefers the
+        # engine's live index (docs/FLEET.md "Prefix residency routing")
+        self.last_residency = publish_residency(
+            self.store, self.engine_id, self.residency_digest(),
+            prefix=FLEET_RESIDENCY_PREFIX, generation=int(self.generation))
 
     # --------------------------------------------------------------- pumping
 
@@ -341,7 +381,10 @@ class FleetRouter:
                  election_key: str = FLEET_COORDINATOR_KEY,
                  generation_key: str = FLEET_GENERATION_KEY,
                  journal_every_k: Optional[int] = 8,
-                 max_journal_tokens: int = 4096):
+                 journal_flush_ms: Optional[float] = None,
+                 max_journal_tokens: int = 4096,
+                 prefix_affinity: bool = True,
+                 affinity_load_slack: int = 2):
         self.store = store
         self.members: Dict[str, FleetMember] = {}
         for m in members:
@@ -380,6 +423,22 @@ class FleetRouter:
         if self.journal_every_k is not None and self.journal_every_k < 1:
             raise ValueError(
                 f"journal_every_k={self.journal_every_k} must be >= 1")
+        # time-based flush alternative (PR 8 carry-over): flush whenever
+        # journal_flush_ms of STORE-clock time passed since the last flush
+        # — the cadence an operator tunes against the store's real write
+        # latency (serve_bench --mode fleet reports per-flush CAS p50/p99
+        # for exactly that).  Composes with journal_every_k: either trigger
+        # flushes; None+None disables mid-stream appends entirely.
+        self.journal_flush_ms = (float(journal_flush_ms)
+                                 if journal_flush_ms is not None else None)
+        if self.journal_flush_ms is not None and self.journal_flush_ms <= 0:
+            raise ValueError(
+                f"journal_flush_ms={self.journal_flush_ms} must be > 0")
+        self._last_flush_t: Optional[float] = None     # store clock
+        self.journal_flushes_total = 0
+        # per-CAS wall latency of journal writes (bounded window): the
+        # flush-cadence tuning signal (fleet/journal_cas_* in the bench)
+        self._journal_cas_lat_s = deque(maxlen=4096)
         self.max_journal_tokens = int(max_journal_tokens)
         if self.max_journal_tokens < 0:
             raise ValueError(
@@ -403,6 +462,21 @@ class FleetRouter:
         self.shed_total = 0
         self.elections_total = 0
         self.rolling_restarts_total = 0
+        # prefix-affinity routing (docs/FLEET.md "Prefix residency
+        # routing"): when on, admission prefers the engine whose residency
+        # digest already holds the request's leading prefix chunks (hot
+        # counts double vs demoted), as long as that engine's load is
+        # within `affinity_load_slack` of the least-loaded one — affinity
+        # must never turn into a hot-spot amplifier.
+        self.prefix_affinity = bool(prefix_affinity)
+        self.affinity_load_slack = int(affinity_load_slack)
+        self.affinity_routes_total = 0
+        # per-round memo of each member's digest as a {chain_key: tier}
+        # map: scoring walks the full index otherwise, and a dispatch
+        # burst would rebuild it per member per request on the admission
+        # hot path (at most one round stale — the beat cadence is coarser)
+        self._affinity_tiers: Dict[str, Dict[int, int]] = {}
+        self._affinity_tiers_tick = -1
         self.tokens_by_engine: Dict[str, int] = {
             m.engine_id: 0 for m in members}
 
@@ -463,23 +537,92 @@ class FleetRouter:
         elapsed = max(0.0, time.monotonic() - req.arrival_epoch_s)
         return max(1e-6, req.deadline_s - elapsed)
 
-    def _pick_engine(self) -> Optional[str]:
-        """Least-loaded live routable engine (waiting + decoding count).
-        Read from the live member handle — the store advertisement carries
-        the SAME queue_depth/active_slots numbers for cross-process
-        consumers, but it is refreshed once per round and several
-        dispatches can land within one, so routing must see each dispatch
-        it just made.  engine_id breaks ties deterministically."""
+    def _pick_engine(self, request: Optional[Request] = None
+                     ) -> Optional[str]:
+        """Least-loaded live routable engine (waiting + decoding count)
+        with a prefix-affinity term: when ``request`` is given and its
+        leading prefix chunks are resident on some engine (hot or
+        demoted, per the residency digests), that engine wins admission
+        as long as its load is within ``affinity_load_slack`` of the
+        minimum — a shared-prefix request lands where the K/V already
+        lives instead of on the least-loaded stranger (docs/FLEET.md
+        "Prefix residency routing").  Loads read from the live member
+        handle — the store advertisement carries the SAME numbers for
+        cross-process consumers, but it is refreshed once per round and
+        several dispatches can land within one, so routing must see each
+        dispatch it just made.  engine_id breaks ties deterministically."""
         best = None
         best_load = None
+        loads: Dict[str, int] = {}
         for eid in sorted(self.members):
             m = self.members[eid]
             if not (m.alive and m.routable):
                 continue
-            load = m.outstanding()
-            if best_load is None or load < best_load:
-                best, best_load = eid, load
+            loads[eid] = m.outstanding()
+            if best_load is None or loads[eid] < best_load:
+                best, best_load = eid, loads[eid]
+        if best is None or request is None or not self.prefix_affinity:
+            return best
+        aff_best, aff_score = None, 0
+        key_memo: Dict[int, List[int]] = {}
+        for eid in sorted(loads):
+            m = self.members[eid]
+            ps = int(m.sup.engine.page_size) if m.alive else 0
+            if ps <= 0:
+                continue
+            keys = key_memo.get(ps)
+            if keys is None:
+                # the same cap as the engine's own lookup: the last prompt
+                # token always prefills, so it can never be resident
+                keys = key_memo[ps] = chain_keys(
+                    request.input_ids, ps,
+                    limit=len(request.input_ids) - 1)
+            score = self._affinity_score(keys, m)
+            if score > aff_score:
+                aff_best, aff_score = eid, score
+        if aff_best is not None \
+                and loads[aff_best] - best_load <= self.affinity_load_slack:
+            if aff_best != best:
+                logger.info(
+                    "fleet: routing %r to %s on prefix affinity "
+                    "(score %d, load %d vs min %d)", request.rid, aff_best,
+                    aff_score, loads[aff_best], best_load)
+            self.affinity_routes_total += 1
+            return aff_best
         return best
+
+    def _affinity_score(self, keys: List[int], member: FleetMember) -> int:
+        """Leading prefix chunks of ``keys`` resident on ``member``: 2 per
+        hot (device) chunk, 1 per demoted one, stopping at the first miss
+        (a non-leading hit saves nothing — admission maps prefixes from
+        token 0).  An in-process live member is scored off its engine's
+        index (memoized per router round); otherwise the last
+        store-published digest serves (the cross-process transport)."""
+        if self._affinity_tiers_tick != self._tick:
+            self._affinity_tiers = {}
+            self._affinity_tiers_tick = self._tick
+        tiers = self._affinity_tiers.get(member.engine_id)
+        if tiers is None:
+            digest = None
+            if member.alive:
+                try:
+                    digest = member.residency_digest()
+                except Exception:   # pragma: no cover - defensive
+                    digest = None
+            if digest is None:
+                doc = (member.last_residency
+                       or self.store.get(
+                           f"{FLEET_RESIDENCY_PREFIX}/{member.engine_id}"))
+                digest = (doc or {}).get("digest") or []
+            tiers = {int(k): int(t) for k, t in digest}
+            self._affinity_tiers[member.engine_id] = tiers
+        score = 0
+        for k in keys:
+            tier = tiers.get(k)
+            if tier is None:
+                break
+            score += 2 if tier == 0 else 1
+        return score
 
     def _route(self, request: Request, requeue: bool = False) -> None:
         """Dispatch to the least-loaded engine (or shed).  ``requeue`` is
@@ -491,7 +634,7 @@ class FleetRouter:
                 and self.fleet_queue_depth() >= self.max_fleet_queue:
             self._shed(request, "fleet queue full")
             return
-        target = self._pick_engine()
+        target = self._pick_engine(request)
         if target is None:
             if requeue:
                 raise FleetUnrecoverable(
@@ -679,6 +822,13 @@ class FleetRouter:
         ``fleet/journal_bytes`` gauge)."""
         return sum(self._journal_sizes.values())
 
+    def journal_cas_latencies(self) -> List[float]:
+        """Recent per-append journal CAS wall times in seconds (bounded
+        window) — what ``journal_every_k`` / ``journal_flush_ms`` should
+        be tuned against on a real store (serve_bench --mode fleet reports
+        the p50/p99)."""
+        return list(self._journal_cas_lat_s)
+
     def _journaled_tokens(self, rid: Any) -> List[int]:
         """The durably journaled stream for ``rid`` — the router's mirror,
         falling back to a store read for an entry adopted but never
@@ -729,7 +879,12 @@ class FleetRouter:
                 new["lane_counter"] = (len(cur.get("input_ids") or ())
                                        + len(total))
                 new["t"] = self.store.now()
-                if self.store.compare_and_swap(key, cur, new):
+                t0 = time.perf_counter()
+                won = self.store.compare_and_swap(key, cur, new)
+                # per-append CAS wall time: the number journal_flush_ms is
+                # tuned against (serve_bench --mode fleet reports p50/p99)
+                self._journal_cas_lat_s.append(time.perf_counter() - t0)
+                if won:
                     self._journal_docs[rid] = new
                     self._journal_sizes[rid] = _doc_bytes(new)
                 else:
@@ -780,11 +935,19 @@ class FleetRouter:
                     # router-visible form of this death
                     pass
                 self._collect(m)
-            if self.journal_every_k is not None \
-                    and self._tick % self.journal_every_k == 0:
+            due = (self.journal_every_k is not None
+                   and self._tick % self.journal_every_k == 0)
+            if not due and self.journal_flush_ms is not None:
+                now_store = self.store.now()
+                due = (self._last_flush_t is None
+                       or (now_store - self._last_flush_t) * 1000.0
+                       >= self.journal_flush_ms)
+            if due:
                 # flush BEFORE the lease scan: tokens decoded this round go
                 # durable before any failover decision can need them
                 self._flush_token_journal()
+                self._last_flush_t = self.store.now()
+                self.journal_flushes_total += 1
             self._scan_leases()
             self._write_gauges()
         return self.outstanding()
@@ -1172,9 +1335,30 @@ class FleetRouter:
             "resumed_tokens_total": self.resumed_tokens_total,
             "journal_entries": len(self._journal_sizes),
             "journal_bytes": self.journal_bytes(),
+            "journal_flushes_total": self.journal_flushes_total,
+            "affinity_routes_total": self.affinity_routes_total,
+            "residency": self._residency_rollup(ads),
             "tokens_by_engine": dict(self.tokens_by_engine),
             "engines": ads,
         }
+
+    @staticmethod
+    def _residency_rollup(ads: Dict[str, Optional[Dict[str, Any]]]
+                          ) -> Dict[str, int]:
+        """Fleet-wide KV-tiering totals folded from the member
+        advertisements (the fleet/residency_* gauges)."""
+        out = {"entries": 0, "demoted_pages": 0, "host_tier_bytes": 0,
+               "promotions_total": 0, "demotions_total": 0}
+        for ad in ads.values():
+            if not ad:
+                continue
+            out["entries"] += int(ad.get("residency_entries", 0) or 0)
+            out["demoted_pages"] += int(ad.get("demoted_pages", 0) or 0)
+            out["host_tier_bytes"] += int(ad.get("host_tier_bytes", 0) or 0)
+            out["promotions_total"] += int(ad.get("promotions_total", 0)
+                                           or 0)
+            out["demotions_total"] += int(ad.get("demotions_total", 0) or 0)
+        return out
 
     def _write_gauges(self) -> None:
         if self.monitor is None:
@@ -1195,6 +1379,7 @@ class FleetRouter:
             if ad is not None:
                 ads[eid] = ad
         flight, monitor_drops = dedup_drop_totals(ads)
+        res = self._residency_rollup(ads)
         self.monitor.write_events([
             ("fleet/engines_live", float(live), self._tick),
             ("fleet/queue_depth", float(self.fleet_queue_depth()),
@@ -1215,4 +1400,18 @@ class FleetRouter:
              self._tick),
             ("fleet/resumed_tokens_total", float(self.resumed_tokens_total),
              self._tick),
+            # KV-page tiering + residency routing (docs/FLEET.md,
+            # docs/OBSERVABILITY.md): fleet-wide tier footprint and how
+            # often affinity picked the admission target
+            ("fleet/residency_entries", float(res["entries"]), self._tick),
+            ("fleet/residency_demoted_pages", float(res["demoted_pages"]),
+             self._tick),
+            ("fleet/residency_host_bytes", float(res["host_tier_bytes"]),
+             self._tick),
+            ("fleet/residency_promotions_total",
+             float(res["promotions_total"]), self._tick),
+            ("fleet/residency_demotions_total",
+             float(res["demotions_total"]), self._tick),
+            ("fleet/affinity_routes_total",
+             float(self.affinity_routes_total), self._tick),
         ])

@@ -1,0 +1,195 @@
+"""KV-page tiering: a host-RAM offload tier behind the paged KV pool.
+
+The serving pool (``models.transformer.init_paged_cache``) is HBM-only, so
+under pool pressure ``ServingEngine._reclaim_cached`` used to permanently
+EVICT cached prefix pages — the K/V of a hot system prompt was recomputed
+from scratch the next time a request needed it.  This module is the
+ZeRO-Infinity move applied to serving (the reference ships a whole
+``runtime/swap_tensor`` offload tree for exactly this pattern, and this
+repo already proved tiered placement on the training side — the
+infinity_* artifacts): instead of evicting, a cold *full* (immutable)
+prefix page is **demoted** — its ``[L, page, Hkv, hd]`` K/V slab is copied
+to a pinned host buffer, the device page returns to the free list, and
+the :class:`~.prefix_cache.PrefixIndex` entry stays resident with
+``tier="host"``.  A later prefix hit on a demoted entry **promotes** the
+page back: the host slab is ``device_put`` onto the pool's sharding and
+injected into a freshly allocated device page by a fixed-shape program,
+and admission maps it exactly like any other shared page.
+
+Contracts this preserves (docs/SERVING.md "KV-page tiering"):
+
+- **zero-recompile**: :func:`extract_page` / :func:`inject_page` take the
+  page id as a TRACED int32 scalar — one compiled program each regardless
+  of which page moves, pre-warmed at engine init like the COW snapshot.
+  Promotion/demotion never introduces a program shape.
+- **accounting**: the device-pool invariant
+  ``free + quarantined + referenced == num_pages - 1`` is untouched (a
+  demoted entry holds NO device page), extended with a *demoted ledger*:
+  the index's demoted-entry count must equal the host tier's buffer count
+  (``ServingEngine.page_accounting()["balanced"]`` checks both).
+- **token exactness**: K/V at position ``t`` is a pure function of tokens
+  ``0..t``, and the demote/promote round-trip is a bit-exact copy, so a
+  promoted prefix decodes exactly as a never-demoted one (the tiered
+  bench and the chaos soak assert it).
+- **mesh correctness**: the :class:`~.execution.MeshExecutor` owns both
+  directions of the move — on a tensor-sharded pool the extract gathers
+  the head-sharded page to one host slab and the inject ``device_put``\\ s
+  it back under the pool's own NamedSharding, so every shard receives its
+  own head slice.
+
+Only *full* chunks demote: a partial boundary page is mutable (its owner
+may still be appending), so under pressure it is evicted exactly as
+before.  With speculative decoding the draft pool is NOT tiered — a
+promoted page's draft-side mirror is whatever currently occupies that
+physical page, which can only cost draft acceptance rate, never
+correctness (the verify pass reads the target pool).
+
+:class:`HostTier` itself is deliberately dumb storage — an LRU
+``OrderedDict`` of host slabs with a page-count cap; the engine
+orchestrates demotion order, capacity eviction (a host-capacity eviction
+is a REAL eviction: the entry dies with its only copy) and the ledger.
+Buffers are plain host numpy, so they survive a supervisor warm restart
+or ``recycle()`` even when the dead engine's device pool was consumed —
+the replacement engine adopts them (``ServingEngine.adopt_host_tier``)
+and serves promotions from the carried cache.
+
+:func:`chain_keys` exposes the prefix index's content-derived chunk-key
+schedule so a fleet router can compute a request's keys WITHOUT an index
+and match them against per-engine residency digests
+(``inference/fleet.py``; docs/FLEET.md "Prefix residency routing").
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from .prefix_cache import chain_keys  # noqa: F401  (digest key schedule)
+
+__all__ = ["HostTier", "TIER_HBM", "TIER_HOST", "chain_keys",
+           "extract_page", "inject_page"]
+
+# digest tier codes (compact on-store encoding; docs/FLEET.md)
+TIER_HBM = 0
+TIER_HOST = 1
+
+
+def extract_page(k, v, src):
+    """Read one physical page out of the ``[L, P, page, Hkv, hd]`` pools:
+    returns ``(k_page, v_page)`` slabs of shape ``[L, page, Hkv, hd]``.
+
+    ``src`` is a traced int32 scalar — ONE program shape for every page,
+    so demotion can never recompile.  Read-only: the pools are NOT donated
+    (a demote must leave the pool alive even when the jit backend donates
+    elsewhere).
+    """
+    import jax
+
+    return (jax.lax.dynamic_index_in_dim(k, src, axis=1, keepdims=False),
+            jax.lax.dynamic_index_in_dim(v, src, axis=1, keepdims=False))
+
+
+def inject_page(k, v, hk, hv, dst):
+    """Write the ``[L, page, Hkv, hd]`` slabs ``hk``/``hv`` into physical
+    page ``dst`` of the pools (the promote half of the tier move).
+    ``dst`` is a traced int32 scalar — one program shape; the pools are
+    donated by the caller's jit exactly like the COW snapshot."""
+    return k.at[:, dst].set(hk.astype(k.dtype)), \
+        v.at[:, dst].set(hv.astype(v.dtype))
+
+
+class HostTier:
+    """LRU store of demoted KV pages: index chain key -> host slab pair.
+
+    Pure host-side storage (numpy buffers; on a TPU host these live in
+    pinned RAM via the device_get path).  The serving engine owns the
+    policy — what demotes, when capacity evicts, and the demoted ledger;
+    the tier only holds buffers and their LRU order.  ``max_pages`` caps
+    the buffer count; ``page_bytes`` (k+v bytes of one page, constant for
+    the pool's lifetime) prices the ``host_tier_bytes`` gauge without
+    touching the buffers.
+    """
+
+    def __init__(self, max_pages: int, page_bytes: int = 0):
+        self.max_pages = int(max_pages)
+        if self.max_pages < 1:
+            raise ValueError(f"max_pages={max_pages} must be >= 1")
+        self.page_bytes = int(page_bytes)
+        self._buffers: "OrderedDict[object, Tuple[np.ndarray, np.ndarray]]" \
+            = OrderedDict()
+        self._bytes = 0
+
+    def __len__(self) -> int:
+        return len(self._buffers)
+
+    def __contains__(self, key) -> bool:
+        return key in self._buffers
+
+    def bytes(self) -> int:
+        """Host RAM currently held by demoted pages (actual buffer bytes)."""
+        return self._bytes
+
+    def full(self) -> bool:
+        return len(self._buffers) >= self.max_pages
+
+    def oldest_key(self):
+        """LRU-most key (the capacity-eviction victim), or None."""
+        return next(iter(self._buffers)) if self._buffers else None
+
+    def keys(self) -> Iterable:
+        return self._buffers.keys()
+
+    def put(self, key, hk: np.ndarray, hv: np.ndarray) -> None:
+        """Store one demoted page (the caller made room first).  A
+        re-demotion of a key replaces the old slab (same content — chain
+        keys are content-derived — so the bytes just re-account)."""
+        old = self._buffers.pop(key, None)
+        if old is not None:
+            self._bytes -= int(old[0].nbytes) + int(old[1].nbytes)
+        self._buffers[key] = (hk, hv)
+        self._bytes += int(hk.nbytes) + int(hv.nbytes)
+
+    def get(self, key, touch: bool = True
+            ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        data = self._buffers.get(key)
+        if data is not None and touch:
+            self._buffers.move_to_end(key)
+        return data
+
+    def touch(self, key) -> None:
+        if key in self._buffers:
+            self._buffers.move_to_end(key)
+
+    def pop(self, key) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        data = self._buffers.pop(key, None)
+        if data is not None:
+            self._bytes -= int(data[0].nbytes) + int(data[1].nbytes)
+        return data
+
+    def discard(self, key) -> None:
+        """Idempotent removal — the ``PrefixIndex.on_drop_host`` hook, so
+        an entry removed from the index (eviction, collision subtree,
+        LRU cap) can never strand its host buffer."""
+        self.pop(key)
+
+    def adopt(self, other: "HostTier",
+              keys: Optional[Iterable] = None) -> List:
+        """Move buffers from a dead engine's tier into this one (LRU order
+        preserved, capacity respected — oldest surplus dropped).  Returns
+        the keys actually adopted; ``keys`` restricts the carry to entries
+        the new prefix index re-registered."""
+        wanted = set(keys) if keys is not None else None
+        items = [(k, d) for k, d in other._buffers.items()
+                 if wanted is None or k in wanted]
+        free = self.max_pages - len(self._buffers)
+        if free <= 0:
+            return []
+        adopted = []
+        # slice BEFORE inserting so a pre-populated tier keeps the donor's
+        # MRU-most surplus, not its LRU-most (order inside the keep is
+        # still LRU→MRU, preserving recency here)
+        for k, (hk, hv) in items[-free:]:
+            self.put(k, hk, hv)
+            adopted.append(k)
+        return adopted

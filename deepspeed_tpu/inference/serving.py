@@ -60,6 +60,17 @@ indirection: the program inventory is unchanged at steady state and
 shared-prefix outputs stay token-exact with the unshared path (K/V at
 position ``t`` is a pure function of tokens ``0..t``).
 
+KV-page tiering (docs/SERVING.md "KV-page tiering"): with
+``host_tier_pages=N`` the reclaim path DEMOTES cold full prefix pages to a
+host-RAM tier (``inference/kv_tiering.py``) instead of evicting them, and a
+prefix hit on a demoted entry PROMOTES the page back into a free device
+slot before admission maps it — the cache working set is bounded by host
+RAM, not HBM.  The tier movers are fixed-shape programs compiled at init
+(zero-recompile preserved), the device-pool invariant extends with a
+demoted ledger (``demoted == host-tier size``, folded into
+``page_accounting()["balanced"]``), and host buffers survive supervisor
+warm restarts and ``recycle()`` (:meth:`adopt_host_tier`).
+
 Generation runs per-slot RNG lanes (docs/SERVING.md "Sampling"): each
 request may carry a :class:`~.sampling.SamplingParams` (temperature /
 top-k / top-p / seed) and the ONE decode program samples with *traced*
@@ -121,6 +132,7 @@ from ..resilience import (SITE_SERVE_ADMIT, SITE_SERVE_DECODE,
 from ..utils.logging import log_dist, logger
 from .engine import InferenceEngine
 from .execution import MeshExecutor
+from .kv_tiering import HostTier
 from .prefix_cache import PrefixIndex, PrefixMatch
 from .sampling import SamplingParams, as_lanes
 from .speculative import SpeculativeConfig, SpeculativeDecoder
@@ -280,6 +292,7 @@ class ServingEngine:
                  probe_after_ticks: Optional[int] = None,
                  prefix_cache: bool = True,
                  prefix_index_entries: int = 4096,
+                 host_tier_pages: Optional[int] = None,
                  speculative: Optional[SpeculativeConfig] = None):
         if not hasattr(model, "apply_paged"):
             raise ValueError(
@@ -327,9 +340,19 @@ class ServingEngine:
         # code below never touches a device array directly, so the same
         # loop drives one chip or a tensor-sharded mesh unchanged.
         self.mesh = mesh
+        if host_tier_pages is not None:
+            if not prefix_cache:
+                raise ValueError(
+                    "host_tier_pages requires prefix_cache=True — the host "
+                    "tier parks demoted PREFIX pages (docs/SERVING.md "
+                    "\"KV-page tiering\")")
+            if int(host_tier_pages) < 1:
+                raise ValueError(
+                    f"host_tier_pages={host_tier_pages} must be >= 1")
         self._exec = MeshExecutor(model, params, self.num_pages,
                                   self.page_size, self.b_slots, dtype=dtype,
-                                  mesh=mesh, prefix_cache=prefix_cache)
+                                  mesh=mesh, prefix_cache=prefix_cache,
+                                  host_tier=host_tier_pages is not None)
         self.params = self._exec.params   # auto-TP-sharded on a mesh
         self._free_pages: List[int] = list(range(self.num_pages - 1, 0, -1))
         # per-page reference counts (page 0, the trash page, is never
@@ -341,6 +364,26 @@ class ServingEngine:
         self._prefix = (PrefixIndex(self.page_size,
                                     max_entries=prefix_index_entries)
                         if prefix_cache else None)
+        # ---- KV-page tiering (docs/SERVING.md "KV-page tiering"): under
+        # pool pressure cold FULL prefix pages demote to pinned host
+        # buffers instead of being evicted; a prefix hit on a demoted
+        # entry promotes the page back into a free device slot before
+        # admission maps it.  None = legacy evict-only behavior.
+        self.host_tier_pages = (int(host_tier_pages)
+                                if host_tier_pages is not None else None)
+        self._tier: Optional[HostTier] = None
+        if self.host_tier_pages is not None:
+            page_bytes = self._exec.pool_bytes["total"] // self.num_pages
+            self._tier = HostTier(self.host_tier_pages,
+                                  page_bytes=page_bytes)
+            # entry removal (eviction, collision subtree, LRU cap) must
+            # drop the host buffer in the same step — never strand a slab
+            self._prefix.on_drop_host = self._tier.discard
+        self.demotions = 0            # pages moved device -> host
+        self.promotions = 0           # pages moved host -> device
+        self._demoted_hwm = 0         # high-water mark of the demoted ledger
+        self._promote_lat_s: Deque[float] = deque(maxlen=2048)
+        self._demote_lat_s: Deque[float] = deque(maxlen=2048)
         self.prefix_hits = 0
         self.prefix_misses = 0
         self.prefix_shared_tokens = 0
@@ -494,6 +537,10 @@ class ServingEngine:
         inv = {"decode": 1, "prefill_buckets": sorted(self._prefill_progs)}
         if self._cow_prog is not None:
             inv["cow"] = 1
+        if self._tier is not None:
+            # the tier movers compile at init (traced page ids = one shape
+            # each); demote/promote cycling never grows the inventory
+            inv["tier"] = {"extract": 1, "inject": 1}
         if self._spec is not None:
             # draft decode + verify compile at init; draft prefills track
             # the target's bucket set — admission (greedy, sampled or
@@ -546,24 +593,36 @@ class ServingEngine:
         trash page) is exactly one of free, quarantined, or referenced
         (held by slots and/or the prefix index).  ``balanced`` is what the
         chaos tests assert after every kill; ``cached`` counts pages the
-        prefix index pins (a subset of ``referenced``)."""
+        prefix index pins (a subset of ``referenced``).  With KV-page
+        tiering the invariant extends with the DEMOTED ledger: a demoted
+        entry holds no device page, so the device equation is untouched,
+        but every demoted index entry must have exactly one host-tier
+        buffer (``demoted == host tier size``) — ``balanced`` checks both.
+        """
         referenced = int((self._refcount[1:] > 0).sum())
         free = len(self._free_pages)
         quarantined = len(self._quarantined_pages)
+        demoted = self._prefix.demoted if self._prefix is not None else 0
         return {
             "free": free,
             "quarantined": quarantined,
             "referenced": referenced,
-            # entry↔page is one-to-one (PrefixIndex pins each published
-            # page until its entry dies), so the entry count IS the
-            # distinct-page count — O(1), and health() polls this per
-            # request.  A one-to-one violation still trips the chaos
-            # audits: duplicate entries would push cached ABOVE the
-            # quiescent referenced count.
-            "cached": len(self._prefix) if self._prefix is not None else 0,
+            # entry↔page is one-to-one over HBM entries (PrefixIndex pins
+            # each published page until its entry dies or demotes), so the
+            # HBM entry count IS the distinct-page count — O(1), and
+            # health() polls this per request.  A one-to-one violation
+            # still trips the chaos audits: duplicate entries would push
+            # cached ABOVE the quiescent referenced count.
+            "cached": (self._prefix.hbm_entries()
+                       if self._prefix is not None else 0),
+            "demoted": demoted,
+            "host_tier_bytes": self._tier.bytes() if self._tier is not None
+            else 0,
             "total": self.num_pages - 1,
             "balanced": free + quarantined + referenced
-            == self.num_pages - 1,
+            == self.num_pages - 1
+            and demoted == (len(self._tier) if self._tier is not None
+                            else 0),
         }
 
     def _prefix_lookup(self, req: Request) -> PrefixMatch:
@@ -579,20 +638,143 @@ class ServingEngine:
             # not worth a pool-shaped page snapshot: keep the full-page
             # share, prefill the boundary tokens like any other tail
             return PrefixMatch(pages=m.pages,
-                               n_tokens=len(m.pages) * self.page_size)
+                               n_tokens=len(m.pages) * self.page_size,
+                               keys=m.keys)
         return m
 
     def _reclaim_cached(self, n_pages: int) -> None:
-        """Pool pressure: evict LRU prefix entries until ``n_pages`` more
-        pages are actually free (an evicted page still held by a decoding
-        slot frees nothing yet — keep going) or the index is exhausted."""
+        """Pool pressure: reclaim cached-but-idle prefix pages, LRU first,
+        until ``n_pages`` more pages are actually free (a reclaimed page
+        still held by a decoding slot frees nothing yet — keep going) or
+        nothing reclaimable remains.  With a host tier configured, cold
+        FULL pages DEMOTE (their K/V parks on the host, the entry stays
+        matchable) instead of evicting; partial boundary pages are mutable
+        and evict as before."""
         freed = 0
         while freed < n_pages and self._prefix is not None \
                 and len(self._prefix):
             before = len(self._free_pages)
-            for p in self._prefix.evict(1):
-                self._drop_page(p)
+            if self._tier is not None:
+                if not self._demote_lru_entry():
+                    break   # every remaining entry is already on the host
+            else:
+                for p in self._prefix.evict(1):
+                    self._drop_page(p)
             freed += len(self._free_pages) - before
+
+    # ------------------------------------------------------ KV-page tiering
+
+    def _demote_lru_entry(self) -> bool:
+        """One reclaim step under tiering: demote the LRU full HBM entry
+        (extract its page to the host tier, free the device page) or evict
+        the LRU partial one.  Returns False when no entry holds a device
+        page anymore."""
+        cand = self._prefix.reclaim_candidate()
+        if cand is None:
+            return False
+        key, e = cand
+        if not e.full:
+            # a partial boundary page is mutable (its owner may still be
+            # appending) — it can never move to the host tier; evict it
+            # exactly as the untiered engine would
+            p = self._prefix.evict_key(key)
+            if p is not None:
+                self._drop_page(p)
+            return True
+        self._tier_make_room()
+        with trace_span("serve.demote", page=int(e.page)):
+            t0 = time.monotonic()
+            hk, hv = self._exec.extract(int(e.page))
+            self._tier.put(key, hk, hv)
+            page = self._prefix.demote(key)
+            self._drop_page(page)
+            self._demote_lat_s.append(time.monotonic() - t0)
+        self.demotions += 1
+        if self._prefix.demoted > self._demoted_hwm:
+            self._demoted_hwm = self._prefix.demoted
+        return True
+
+    def _tier_make_room(self) -> None:
+        """Host-tier capacity: a full tier evicts its LRU buffers FOR REAL
+        (the prefix entry dies with its only copy — this is the one place
+        tiering still loses cache)."""
+        while self._tier.full():
+            key = self._tier.oldest_key()
+            if key is None:   # pragma: no cover - defensive
+                return
+            self._prefix.evict_key(key)   # drops the buffer via the hook
+            self._tier.discard(key)       # belt-and-suspenders: idempotent
+
+    def _promote_match(self, match: PrefixMatch) -> bool:
+        """Promote every demoted chunk of ``match`` back into free device
+        pages (the caller checked the free count): inject the host slab,
+        flip the index entry hot — the fresh page's first reference IS the
+        index's — and patch the match in place so admission maps it like
+        any resident page.  Returns False when a host buffer vanished
+        (host-capacity eviction raced the lookup): the caller retries the
+        head with a fresh, smaller lookup."""
+        for i, p in enumerate(match.pages):
+            if p >= 0:
+                continue
+            key = match.keys[i]
+            data = self._tier.get(key)
+            if data is None:
+                # the tier evicted this entry between lookup and now; make
+                # sure the index agrees, then let the caller re-look-up
+                self._prefix.evict_key(key)
+                return False
+            with trace_span("serve.promote"):
+                t0 = time.monotonic()
+                (dst,) = self._alloc_pages(1)
+                try:
+                    self._exec.inject(data[0], data[1], dst)
+                except BaseException:
+                    self._drop_page(dst)
+                    raise
+                self._prefix.promote(key, dst)
+                self._tier.pop(key)
+                self._promote_lat_s.append(time.monotonic() - t0)
+            match.pages[i] = dst
+            self.promotions += 1
+        return True
+
+    def tier_latencies(self) -> Dict[str, List[float]]:
+        """Recent demote/promote wall times in seconds (bounded windows;
+        the tiered bench reads promote p50/p99 from here)."""
+        return {"promote_s": list(self._promote_lat_s),
+                "demote_s": list(self._demote_lat_s)}
+
+    def residency_digest(self, cap: int = 1024) -> List:
+        """Compact prefix-residency digest — ``(chain_key, tier)`` per full
+        cached chunk, MRU first — what a fleet member publishes through
+        the coordination store so the router can route shared-prefix
+        requests to the engine already holding them (docs/FLEET.md)."""
+        if self._prefix is None:
+            return []
+        return self._prefix.digest(cap)
+
+    def adopt_host_tier(self, old: "ServingEngine") -> int:
+        """Warm-restart/recycle carry: adopt the dead engine's DEMOTED
+        prefix entries and their host buffers.  Host slabs are plain host
+        memory, valid even when the old device pool was consumed, and K/V
+        is a pure function of (tokens, params) — the factory recreates the
+        same params — so the replacement serves promotions from the
+        carried cache instead of recomputing.  HBM entries died with the
+        pool and rebuild organically through replay.  Returns the entries
+        carried."""
+        if (self._tier is None or old._tier is None or self._prefix is None
+                or old._prefix is None):
+            return 0
+        keys = self._prefix.adopt_demoted(old._prefix)
+        adopted = self._tier.adopt(old._tier, keys=keys)
+        if len(adopted) < len(keys):
+            # tier capacity clipped the carry: drop the index entries whose
+            # buffers did not make it so the demoted ledger stays balanced
+            for key in set(keys) - set(adopted):
+                self._prefix.evict_key(key)
+        if self._prefix.demoted > self._demoted_hwm:
+            self._demoted_hwm = self._prefix.demoted
+        return len(adopted)
 
     def _arrival_abs(self, req: Request) -> float:
         """Absolute arrival stamp: the rebased epoch when the request rode
@@ -740,26 +922,44 @@ class ServingEngine:
             except StopIteration:
                 break
             match = self._prefix_lookup(req)
-            # pin the matched pages (incl. the COW source) for the span of
-            # this admission: reclaim below — or a concurrent eviction by
-            # the index's own LRU cap — must never free a matched page
-            # back into the pool it is about to be mapped from
-            pinned = list(match.pages)
+            # pin the matched DEVICE pages (incl. the COW source) for the
+            # span of this admission: reclaim below — or a concurrent
+            # eviction by the index's own LRU cap — must never free a
+            # matched page back into the pool it is about to be mapped
+            # from.  Demoted chunks (-1) have no device page to pin; their
+            # host buffers are LRU-touched instead so a capacity eviction
+            # during reclaim prefers other victims.
+            pinned = [p for p in match.pages if p >= 0]
             if match.cow_src is not None:
                 pinned.append(match.cow_src)
             for p in pinned:
                 self._share_page(p)
-            admitted = freed_pins = False
+            n_demoted = sum(1 for p in match.pages if p < 0)
+            if n_demoted and self._tier is not None:
+                for i, p in enumerate(match.pages):
+                    if p < 0:
+                        self._tier.touch(match.keys[i])
+            admitted = freed_pins = promote_retry = False
             try:
+                # demoted chunks each need one free device page for their
+                # promotion on top of the private remainder
                 need = self._pages_needed(req) - len(match.pages)
-                if len(self._free_pages) < need:
-                    # evict cached-but-idle prefix pages before blocking:
-                    # a cache must never starve admission
-                    self._reclaim_cached(need - len(self._free_pages))
-                if len(self._free_pages) >= need:
-                    with trace_span("serve.admit", rid=req.rid, slot=slot):
-                        self._admit_one(req, slot, match, need, now)
-                    admitted = True
+                if len(self._free_pages) < need + n_demoted:
+                    # reclaim (demote/evict) cached-but-idle prefix pages
+                    # before blocking: a cache must never starve admission
+                    self._reclaim_cached(need + n_demoted
+                                         - len(self._free_pages))
+                if len(self._free_pages) >= need + n_demoted:
+                    if n_demoted and not self._promote_match(match):
+                        # a matched host buffer vanished (host-capacity
+                        # eviction raced the lookup): retry with a fresh,
+                        # strictly smaller lookup
+                        promote_retry = True
+                    else:
+                        with trace_span("serve.admit", rid=req.rid,
+                                        slot=slot):
+                            self._admit_one(req, slot, match, need, now)
+                        admitted = True
             finally:
                 # the slot takes its own references inside _admit_one; the
                 # lookup pins existed only to survive reclaim.  If reclaim
@@ -771,10 +971,11 @@ class ServingEngine:
                     self._drop_page(p)
             if admitted:
                 continue
-            if freed_pins:
+            if freed_pins or promote_retry:
                 # pool pressure evicted the head's own matched prefix from
-                # the index, and the pages came free the instant the pins
-                # dropped — retry the head with a fresh (smaller) lookup
+                # the index (or its host buffer from the tier), and either
+                # the pages came free the instant the pins dropped or the
+                # match must shrink — retry the head with a fresh lookup
                 # instead of misreading this as head-of-line blocking.
                 # Terminates: each retry means the index strictly shrank.
                 continue
@@ -1333,6 +1534,16 @@ class ServingEngine:
             "prefix_index_entries": (len(self._prefix)
                                      if self._prefix is not None else 0),
             "cow_copies_total": self.cow_copies,
+            # KV-page tiering (docs/SERVING.md "KV-page tiering"): the
+            # demoted ledger and host-tier footprint, plus the cumulative
+            # movement counters — what capacity planning reads to size the
+            # host tier against the prefix working set
+            "demoted_pages": acct["demoted"],
+            "host_tier_bytes": acct["host_tier_bytes"],
+            "host_tier_capacity_pages": self.host_tier_pages or 0,
+            "demotions_total": self.demotions,
+            "promotions_total": self.promotions,
+            "demoted_pages_hwm": self._demoted_hwm,
             # sampling / speculative (docs/SERVING.md): non-greedy
             # admissions, and — with a draft configured — the verify-tick
             # economics operators size k from (mean accepted length > 1
@@ -1424,6 +1635,17 @@ class ServingEngine:
             ("serve/oldest_request_age_s",
              self._oldest_age_s(time.monotonic()), self._tick),
         ])
+        if self._tier is not None:
+            self.monitor.write_events([
+                ("serve/tier_demoted_pages", float(self._prefix.demoted),
+                 self._tick),
+                ("serve/tier_host_bytes", float(self._tier.bytes()),
+                 self._tick),
+                ("serve/tier_demotions_total", float(self.demotions),
+                 self._tick),
+                ("serve/tier_promotions_total", float(self.promotions),
+                 self._tick),
+            ])
         if self._spec is not None:
             self.monitor.write_events([
                 ("serve/spec_emitted_tokens_total",

@@ -122,6 +122,9 @@ class ServingSupervisor:
         self._spec_ticks_base = 0
         self._spec_emitted_base = 0
         self._spec_drafted_base = 0
+        self._demotions_base = 0
+        self._promotions_base = 0
+        self._demoted_hwm_base = 0
         self._pages_hwm_base = 0
         self._quarantined_slots_lifetime = 0
         self._quarantined_pages_lifetime = 0
@@ -331,6 +334,10 @@ class ServingSupervisor:
             h["spec_mean_accepted_len"] = round(
                 h["spec_emitted_tokens_total"]
                 / h["spec_verify_slot_ticks_total"], 4)
+        h["demotions_total"] += self._demotions_base
+        h["promotions_total"] += self._promotions_base
+        h["demoted_pages_hwm"] = max(h["demoted_pages_hwm"],
+                                     self._demoted_hwm_base)
         h["pages_hwm"] = max(h["pages_hwm"], self._pages_hwm_base)
         h["quarantined_slots_lifetime"] = (self._quarantined_slots_lifetime
                                            + h["quarantined_slots"])
@@ -456,6 +463,10 @@ class ServingSupervisor:
         # replacement engine reflect reality, not the cold-start floor.
         new = self.engine_factory()
         reused = self._adopt_programs(new, old)
+        # demoted prefix pages live in HOST buffers — they survive the dead
+        # pool (even a consumed one) and carry to the replacement when the
+        # fleet shape matches, so promotions keep hitting after a restart
+        tier_carried = new.adopt_host_tier(old) if reused else 0
         if old._ema_service_s is not None and new._ema_service_s is None:
             new._ema_service_s = old._ema_service_s
         # (5) replay.  Admission control is suspended: a request the old
@@ -513,10 +524,13 @@ class ServingSupervisor:
             "requeued": len(waiting) - stashed,
             "stashed": stashed,
             "mid_drain": drain,
-            # index entries lost with the dead pool; replay re-publishes
-            # organically through the normal admission path
-            "prefix_entries_dropped": (len(old._prefix)
-                                       if old._prefix is not None else 0),
+            # HBM index entries lost with the dead pool; replay re-publishes
+            # organically through the normal admission path.  Demoted
+            # entries (host buffers) carried to the replacement instead.
+            "prefix_entries_dropped": ((len(old._prefix)
+                                        if old._prefix is not None else 0)
+                                       - tier_carried),
+            "host_tier_entries_carried": tier_carried,
             "programs_reused": reused,
             "at_tick": old._tick,
         }
@@ -550,6 +564,10 @@ class ServingSupervisor:
             self._spec_ticks_base += old._spec.verify_slot_ticks
             self._spec_emitted_base += old._spec.emitted_tokens
             self._spec_drafted_base += old._spec.drafted_tokens
+        self._demotions_base += old.demotions
+        self._promotions_base += old.promotions
+        self._demoted_hwm_base = max(self._demoted_hwm_base,
+                                     old._demoted_hwm)
         self._pages_hwm_base = max(self._pages_hwm_base, old._pages_hwm)
         self._quarantined_slots_lifetime += int(old._quarantined.sum())
         self._quarantined_pages_lifetime += len(old._quarantined_pages)
@@ -577,12 +595,16 @@ class ServingSupervisor:
             self._collect(res)
         new = self.engine_factory()
         reused = self._adopt_programs(new, old)
+        # planned maintenance keeps the warm host cache too: demoted pages
+        # carry exactly as on a fault restart (docs/SERVING.md)
+        tier_carried = new.adopt_host_tier(old) if reused else 0
         if old._ema_service_s is not None and new._ema_service_s is None:
             new._ema_service_s = old._ema_service_s
         self._carry_counters(old)
         self.engine = new
         log_dist(f"serve supervisor: engine recycled (programs "
-                 f"{'reused' if reused else 'rebuilt'})", ranks=[0])
+                 f"{'reused' if reused else 'rebuilt'}, "
+                 f"{tier_carried} host-tier page(s) carried)", ranks=[0])
         return reused
 
     @staticmethod

@@ -934,3 +934,162 @@ def test_fleet_chaos_soak_multiseed(tmp_path):
     for seed in (0, 1, 2, 3, 4, 5):
         run_fleet_soak(seed=seed, coord_dir=str(tmp_path / f"c{seed}"),
                        n_requests=8, verbose=False)
+
+
+# ---------------------------------------- prefix residency routing (ISSUE 11)
+
+
+def test_fleet_prefix_affinity_routes_to_resident_engine_then_failover(
+        tiny_engine, tmp_path):
+    """ISSUE 11 acceptance: with residency digests published, a
+    shared-prefix request is admitted to the engine already holding that
+    prefix rather than the least-loaded stranger — and killing that engine
+    mid-stream still resumes the request token-exact from the journal on a
+    survivor."""
+    from deepspeed_tpu.inference.fleet import FLEET_RESIDENCY_PREFIX
+    from deepspeed_tpu.inference.prefix_cache import chain_keys
+
+    rng = np.random.default_rng(23)
+    system = rng.integers(1, 250, 17).astype(np.int32)   # 2 full pages @ 8
+    donor = Request(rid="donor",
+                    input_ids=np.concatenate(
+                        [system, np.array([3, 4], np.int32)]),
+                    max_new_tokens=3)
+    follower = Request(rid="follower",
+                       input_ids=np.concatenate(
+                           [system, np.array([9, 8, 7], np.int32)]),
+                       max_new_tokens=8)
+    filler = Request(rid="filler",
+                     input_ids=rng.integers(1, 250, 6).astype(np.int32),
+                     max_new_tokens=8)
+
+    # fault-free reference (outputs are engine-independent)
+    serve = tiny_engine.serving(b_slots=3, page_size=8, max_model_len=64)
+    ref = {r.rid: r.output_ids for r in serve.run(
+        [Request(rid=r.rid, input_ids=r.input_ids,
+                 max_new_tokens=r.max_new_tokens)
+         for r in (donor, follower, filler)])}
+    del serve
+
+    clock = [0.0]
+    store = FileCoordinationStore(str(tmp_path / "coord"),
+                                  clock=lambda: clock[0])
+    mon = InMemoryMonitor()
+    members = [FleetMember(f"engine{i}",
+                           tiny_engine.supervised_serving(max_restarts=5,
+                                                          **SERVE_KW),
+                           store, lease_s=1.0)
+               for i in range(2)]
+    router = FleetRouter(store, members, lease_s=100.0, miss_limit=3,
+                         journal_every_k=1, monitor=mon)
+
+    def tick(n=1):
+        for _ in range(n):
+            router.step()
+            clock[0] += 1.0
+
+    # seed residency: the donor lands on engine0 (both idle, id tie-break)
+    router.submit(Request(rid="donor", input_ids=donor.input_ids,
+                          max_new_tokens=donor.max_new_tokens))
+    assert router._owner["donor"] == "engine0"
+    while router.outstanding():
+        tick()
+    # digest published through the store and carrying the donor's chunks
+    doc = store.get(f"{FLEET_RESIDENCY_PREFIX}/engine0")
+    keys = chain_keys(donor.input_ids, 8, limit=len(donor.input_ids) - 1)
+    assert keys and all(
+        k in {int(dk) for dk, _ in doc["digest"]} for k in keys)
+
+    # make engine0 the BUSIER engine, then admit the shared-prefix
+    # follower: least-loaded alone would pick engine1 (the stranger), but
+    # affinity routes it to engine0 where the prefix is resident
+    router.submit(Request(rid="filler", input_ids=filler.input_ids,
+                          max_new_tokens=filler.max_new_tokens))
+    assert router._owner["filler"] == "engine0"
+    router.submit(Request(rid="follower", input_ids=follower.input_ids,
+                          max_new_tokens=follower.max_new_tokens))
+    assert router._owner["follower"] == "engine0"
+    assert router.affinity_routes_total >= 1
+
+    # a few rounds in (tokens journaled), kill the affinity target: the
+    # follower must fail over and resume token-exact from the journal
+    tick(3)
+    router.members["engine0"].kill()
+    results = {r.rid: r for r in router.run([], max_ticks=500,
+                                            on_tick=lambda r, n:
+                                            clock.__setitem__(
+                                                0, clock[0] + 1.0))}
+    for rid in ("donor", "filler", "follower"):
+        np.testing.assert_array_equal(results[rid].output_ids
+                                      if rid in results else ref[rid],
+                                      ref[rid])
+    assert results["follower"].failovers >= 1
+    assert results["follower"].resumed_tokens > 0
+    assert router._owner.get("follower") is None
+    assert store.list("fleet/requests") == []
+    # the residency rollup gauges landed on the monitor
+    names = {e[0] for e in mon.events_snapshot()}
+    assert {"fleet/residency_entries", "fleet/residency_demoted_pages",
+            "fleet/residency_host_bytes", "fleet/affinity_routes_total",
+            "fleet/residency_promotions_total"} <= names
+
+
+def test_fleet_affinity_respects_load_slack(tiny_engine, tmp_path):
+    """Affinity must not amplify a hot spot: when the resident engine's
+    load exceeds the least-loaded engine by more than
+    ``affinity_load_slack``, least-loaded wins."""
+    store = FileCoordinationStore(str(tmp_path / "coord"))
+    members = [FleetMember(f"engine{i}",
+                           tiny_engine.supervised_serving(max_restarts=5,
+                                                          **SERVE_KW),
+                           store, lease_s=100.0)
+               for i in range(2)]
+    router = FleetRouter(store, members, lease_s=100.0,
+                         affinity_load_slack=0)
+    rng = np.random.default_rng(29)
+    system = rng.integers(1, 250, 17).astype(np.int32)
+    router.submit(Request(rid="donor", input_ids=np.concatenate(
+        [system, np.array([1, 2], np.int32)]), max_new_tokens=2))
+    while router.outstanding():
+        router.step()
+    # engine0 holds the prefix; load it with a waiting request, then the
+    # follower must go to idle engine1 (slack 0 forbids the imbalance)
+    router.submit(Request(rid="busy",
+                          input_ids=rng.integers(1, 250, 5).astype(np.int32),
+                          max_new_tokens=4))
+    assert router._owner["busy"] == "engine0"
+    router.submit(Request(rid="follower", input_ids=np.concatenate(
+        [system, np.array([7, 7], np.int32)]), max_new_tokens=2))
+    assert router._owner["follower"] == "engine1"
+    router.run([], max_ticks=200)
+
+
+def test_fleet_journal_flush_ms_time_based_cadence(tiny_engine, tmp_path):
+    """ISSUE 11 satellite (PR 8 carry-over): journal flushes can be
+    time-based — `journal_flush_ms` on the store clock — instead of
+    every-K-rounds, and each flush's CAS wall latency is recorded so the
+    cadence can be tuned against a real store."""
+    clock = [0.0]
+    store = FileCoordinationStore(str(tmp_path / "coord"),
+                                  clock=lambda: clock[0])
+    members = [FleetMember("engine0",
+                           tiny_engine.supervised_serving(max_restarts=5,
+                                                          **SERVE_KW),
+                           store, lease_s=100.0)]
+    router = FleetRouter(store, members, lease_s=100.0,
+                         journal_every_k=None, journal_flush_ms=2000.0)
+    reqs = _stream(2, seed=31, new_choices=(8,))
+
+    def on_tick(r, rounds):
+        clock[0] += 1.0            # 1 store-second per round
+
+    results = router.run(_copies(reqs), max_ticks=500, on_tick=on_tick)
+    assert len(results) == 2
+    # ~1 flush per 2 store-seconds while streams were in flight
+    assert router.journal_flushes_total >= 2
+    lats = router.journal_cas_latencies()
+    assert lats and all(t >= 0 for t in lats)
+    h = router.health()
+    assert h["journal_flushes_total"] == router.journal_flushes_total
+    with pytest.raises(ValueError, match="journal_flush_ms"):
+        FleetRouter(store, members, journal_flush_ms=0.0)

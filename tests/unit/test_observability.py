@@ -431,3 +431,62 @@ def test_serving_engine_health_reports_bound_metrics_port(monkeypatch):
         if srv is not None:
             srv.close()
         monkeypatch.setattr(export_mod, "_METRICS_SERVER", None)
+
+
+# --------------------------------------- KV-page tiering gauges (ISSUE 11)
+
+def test_health_and_prometheus_carry_tier_gauges():
+    """ISSUE 11 satellite: health() and the Prometheus exposition grow the
+    tiering quartet — demoted_pages / host_tier_bytes / promotions_total /
+    demotions_total (serve/tier_* gauge names) — sourced from a real
+    demote/promote cycle under pool pressure."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.inference.serving import Request
+    from deepspeed_tpu.models import CausalLM
+
+    model = CausalLM("tiny", dtype=jnp.float32, attn_impl="xla")
+    params = model.init_fn(jax.random.PRNGKey(3))
+    engine = deepspeed_tpu.init_inference(
+        model=model, config={"dtype": "float32"}, params=params)
+    mon = InMemoryMonitor()
+    serve = engine.serving(b_slots=1, page_size=8, max_model_len=40,
+                           num_pages=8, host_tier_pages=16, monitor=mon)
+    rng = np.random.default_rng(5)
+    systems = [rng.integers(1, 250, 17).astype(np.int32) for _ in range(3)]
+    serve.run([Request(rid=i,
+                       input_ids=np.concatenate(
+                           [systems[i % 3],
+                            rng.integers(1, 250, 3).astype(np.int32)]),
+                       max_new_tokens=4)
+               for i in range(9)])
+    h = serve.health()
+    assert serve.demotions > 0 and serve.promotions > 0
+    assert h["demotions_total"] == serve.demotions
+    assert h["promotions_total"] == serve.promotions
+    assert h["demoted_pages"] == serve._prefix.demoted
+    assert h["host_tier_bytes"] == serve._tier.bytes()
+    assert h["host_tier_capacity_pages"] == 16
+    assert h["demoted_pages_hwm"] >= h["demoted_pages"]
+    # gauge series landed on the monitor...
+    for gauge in ("serve/tier_demoted_pages", "serve/tier_host_bytes",
+                  "serve/tier_demotions_total",
+                  "serve/tier_promotions_total"):
+        assert mon.series(gauge), f"missing gauge {gauge}"
+    assert mon.latest("serve/tier_demotions_total") == float(serve.demotions)
+    # ...and reach the Prometheus exposition like every other gauge
+    text = prometheus_text(monitor=mon)
+    assert "dstpu_serve_tier_demoted_pages" in text
+    assert "dstpu_serve_tier_host_bytes" in text
+    assert f"dstpu_serve_tier_promotions_total {serve.promotions}" in text
+    assert f"dstpu_serve_tier_demotions_total {serve.demotions}" in text
+    # an untiered engine carries the keys at zero (dashboards need not
+    # branch on configuration)
+    plain = engine.serving(b_slots=1, page_size=8, max_model_len=40)
+    hp = plain.health()
+    assert hp["demoted_pages"] == 0 and hp["host_tier_bytes"] == 0
+    assert hp["demotions_total"] == 0 and hp["promotions_total"] == 0

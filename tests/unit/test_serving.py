@@ -274,18 +274,16 @@ def test_prefix_sharing_token_exact_with_cow(tiny_engine):
     results = serve.run(list(reqs))
     for r in results:
         np.testing.assert_array_equal(r.output_ids, ref[r.rid])
-    # the donor was cold; request 1 shares the 2 full pages (the donor's
-    # page 3 is FULL — the 21-token boundary only becomes a COW entry once
-    # request 1 publishes its own partial page); every later request then
-    # shares full pages + the COW boundary = the whole 21-token system
-    # prompt
+    # the donor was cold; every follower — INCLUDING request 1 — shares the
+    # whole 21-token system prompt: the donor's page 3 is FULL, and a
+    # partial prefix match inside a full donor page is COW-served (the
+    # PR 6 carry-over closed in ISSUE 11), so the first follower no longer
+    # drops to full-page granularity
     shared = {r.rid: r.shared_prefix_tokens for r in results}
     assert shared[reqs[0].rid] == 0
-    assert shared[reqs[1].rid] == 16                # full-page granularity
-    assert all(v >= 21 for k, v in shared.items()
-               if k not in (reqs[0].rid, reqs[1].rid))
+    assert all(v >= 21 for k, v in shared.items() if k != reqs[0].rid)
     assert serve.prefix_hits == 5 and serve.prefix_misses == 1
-    assert serve.cow_copies == 4
+    assert serve.cow_copies == 5
     assert serve.prefix_pages_shared == 10          # 2 full pages x 5 hits
     assert serve.prefix_shared_tokens == sum(shared.values())
     acct = serve.page_accounting()
@@ -599,3 +597,198 @@ def test_request_timeline_fields(tiny_engine, tiny_serve):
         # the prefill emits tokens[0]; every other token is one decode tick
         assert r.decode_ticks == len(r.output_ids) - 1
         assert r.replays == 0                  # no supervisor, no restarts
+
+
+# ------------------------------------------------ KV-page tiering (ISSUE 11)
+
+
+def test_mid_page_divergence_cow_from_full_donor_page(tiny_engine):
+    """PR 6 carry-over closed: a prompt diverging INSIDE a donor's FULL
+    page is COW-served up to the divergence point — the first follower
+    after a donor no longer drops to full-page granularity."""
+    serve = tiny_engine.serving(b_slots=1, page_size=8, max_model_len=64)
+    cold = tiny_engine.serving(b_slots=1, page_size=8, max_model_len=64,
+                               prefix_cache=False)
+    donor_ids = np.arange(1, 20, dtype=np.int32)       # 2 full pages + 3
+    follower_ids = np.concatenate(                     # diverges at tok 12,
+        [donor_ids[:12], np.array([99, 98, 97], np.int32)])  # inside page 2
+    (ref,) = cold.run([Request(rid="f", input_ids=follower_ids.copy(),
+                               max_new_tokens=4)])
+    serve.run([Request(rid="d", input_ids=donor_ids, max_new_tokens=4)])
+    (res,) = serve.run([Request(rid="f", input_ids=follower_ids,
+                                max_new_tokens=4)])
+    np.testing.assert_array_equal(res.output_ids, ref.output_ids)
+    # page 1 mapped whole + the donor's FULL page 2 COW'd for its first
+    # 4 matching tokens = 12 shared prompt tokens, one snapshot
+    assert res.shared_prefix_tokens == 12
+    assert serve.cow_copies == 1
+    assert serve.page_accounting()["balanced"]
+
+
+def test_prefix_index_full_chunk_divergence_is_cow_candidate():
+    """Index half of the carry-over: lookup offers a full entry as COW
+    source when the prompt diverges inside it (and never a demoted one)."""
+    from deepspeed_tpu.inference.prefix_cache import PrefixIndex
+
+    idx = PrefixIndex(page_size=4, max_entries=8)
+    ids = np.array([1, 2, 3, 4, 5, 6, 7, 8], np.int32)
+    idx.publish(ids, [11, 12])                        # 2 full chunks
+    div = np.array([1, 2, 3, 4, 5, 6, 9, 9], np.int32)
+    m = idx.lookup(div, limit=8)
+    assert m.pages == [11] and m.keys and m.n_tokens == 6
+    assert m.cow_src == 12 and m.cow_valid == 2       # inside full chunk 1
+    # a demoted donor is no COW candidate (its page is on the host tier)
+    key1 = m.keys[0]
+    m_full = idx.lookup(ids, limit=8)
+    idx.demote(m_full.keys[1])
+    m2 = idx.lookup(div, limit=8)
+    assert m2.cow_src is None and m2.pages == [11]
+    assert key1 == m2.keys[0]
+
+
+def test_prefix_index_demote_promote_and_digest():
+    """Tiering state machine on the index: demote frees the page but keeps
+    the entry matchable (-1), promote restores it, removal of a demoted
+    entry fires on_drop_host, and the digest reports (chain_key, tier)."""
+    from deepspeed_tpu.inference.prefix_cache import PrefixIndex, chain_keys
+
+    idx = PrefixIndex(page_size=4, max_entries=8)
+    ids = np.array([1, 2, 3, 4, 5, 6, 7, 8, 9], np.int32)
+    idx.publish(ids, [11, 12, 13])                    # 2 full + partial
+    keys = chain_keys(ids, 4)
+    assert [k for k, _ in idx.digest()][::-1] == keys  # MRU-first
+
+    cand = idx.reclaim_candidate()
+    assert cand is not None and cand[0] == keys[0]     # LRU-most HBM entry
+    assert idx.demote(keys[0]) == 11
+    assert idx.demoted == 1 and idx.hbm_entries() == 2
+    m = idx.lookup(ids, limit=9)
+    assert m.pages == [-1, 12] and m.keys == keys      # still matchable
+    assert dict(idx.digest())[keys[0]] == 1            # host tier code
+    idx.promote(keys[0], 21)
+    assert idx.demoted == 0
+    assert idx.lookup(ids, limit=9).pages == [21, 12]
+
+    dropped = []
+    idx.on_drop_host = dropped.append
+    idx.demote(keys[1])
+    assert idx.evict_key(keys[1]) is None              # no device page
+    assert dropped == [keys[1]] and idx.demoted == 0
+    # partial entries never demote (the boundary entry lives under the
+    # chain key of the last full chunk)
+    with pytest.raises(ValueError):
+        idx.demote(("p", keys[1], (9,)))
+    # a FULL destination index adopts nothing (the lst[-0:] trap)
+    donor = PrefixIndex(page_size=4, max_entries=8)
+    donor.publish(ids, [31, 32, 33])
+    donor.demote(chain_keys(ids, 4)[0])
+    full_idx = PrefixIndex(page_size=4, max_entries=2)
+    full_idx.publish(np.array([7, 7, 7, 7, 8, 8, 8, 8], np.int32), [41, 42])
+    assert full_idx.adopt_demoted(donor) == []
+    assert full_idx.demoted == 0 and len(full_idx) == 2
+
+
+def test_host_tier_unit():
+    """HostTier storage semantics: LRU order, byte accounting, capacity,
+    idempotent discard, adoption with a budget."""
+    from deepspeed_tpu.inference.kv_tiering import HostTier
+
+    tier = HostTier(max_pages=2, page_bytes=64)
+    a = np.zeros((2, 4, 1, 2), np.float32)
+    tier.put("k1", a, a)
+    tier.put("k2", a, a)
+    assert len(tier) == 2 and tier.full()
+    assert tier.bytes() == 4 * a.nbytes
+    assert tier.oldest_key() == "k1"
+    tier.touch("k1")
+    assert tier.oldest_key() == "k2"
+    assert tier.get("k2") is not None                  # get touches too
+    assert tier.oldest_key() == "k1"
+    tier.discard("k1")
+    tier.discard("k1")                                 # idempotent
+    assert len(tier) == 1 and tier.bytes() == 2 * a.nbytes
+    assert tier.pop("missing") is None
+
+    other = HostTier(max_pages=4)
+    for k in ("a", "b", "c"):
+        other.put(k, a, a)
+    small = HostTier(max_pages=2)
+    adopted = small.adopt(other)
+    assert adopted == ["b", "c"]                       # MRU-most survive
+    with pytest.raises(ValueError):
+        HostTier(max_pages=0)
+
+
+def test_serving_tiering_demote_promote_token_exact(tiny_engine):
+    """Tentpole acceptance (engine level): under pool pressure the engine
+    DEMOTES cold prefix pages instead of evicting, promotes them on the
+    next hit, stays token-exact with an untiered engine, keeps the
+    extended accounting invariant balanced, and never grows the program
+    inventory past init's."""
+    rng = np.random.default_rng(7)
+    systems = [rng.integers(1, 250, 17).astype(np.int32) for _ in range(3)]
+    tails = [rng.integers(1, 250, 3).astype(np.int32) for _ in range(9)]
+
+    def stream(rid0=0):
+        return [Request(rid=rid0 + i,
+                        input_ids=np.concatenate([systems[i % 3], tails[i]]),
+                        max_new_tokens=4)
+                for i in range(9)]
+
+    ref_serve = tiny_engine.serving(b_slots=1, page_size=8, max_model_len=40,
+                                    num_pages=8, prefix_cache=False)
+    ref = {r.rid % 100: r.output_ids for r in ref_serve.run(stream())}
+    del ref_serve
+
+    serve = tiny_engine.serving(b_slots=1, page_size=8, max_model_len=40,
+                                num_pages=8, host_tier_pages=16)
+    assert serve.program_inventory()["tier"] == {"extract": 1, "inject": 1}
+    results = serve.run(stream())
+    inv = serve.program_inventory()   # buckets warm after the first batch
+    for r in results:
+        np.testing.assert_array_equal(r.output_ids, ref[r.rid])
+    assert serve.demotions > 0 and serve.promotions > 0
+    acct = serve.page_accounting()
+    assert acct["balanced"] and acct["demoted"] == len(serve._tier)
+    assert acct["host_tier_bytes"] == serve._tier.bytes()
+    # rotation round 2: every system prompt hits (hot or promoted), and
+    # demote/promote cycling never grows the inventory
+    results2 = serve.run(stream(rid0=100))
+    for r in results2:
+        np.testing.assert_array_equal(r.output_ids, ref[r.rid % 100])
+    assert all(r.shared_prefix_tokens > 0 for r in results2)
+    assert serve.program_inventory() == inv
+    h = serve.health()
+    assert h["demoted_pages_hwm"] >= h["demoted_pages"]
+    lat = serve.tier_latencies()
+    assert len(lat["promote_s"]) == serve.promotions
+    assert len(lat["demote_s"]) == serve.demotions
+    assert serve.residency_digest()
+    # gauges (the tier quartet) land on the monitor path via health/acct —
+    # exposition coverage lives in test_observability.py
+    with pytest.raises(ValueError, match="prefix_cache"):
+        tiny_engine.serving(b_slots=1, page_size=8, max_model_len=40,
+                            prefix_cache=False, host_tier_pages=4)
+
+
+def test_host_tier_capacity_evicts_for_real(tiny_engine):
+    """A full host tier evicts its LRU buffer AND the index entry — the
+    one place tiering still loses cache — with the ledger balanced."""
+    rng = np.random.default_rng(11)
+    systems = [rng.integers(1, 250, 17).astype(np.int32) for _ in range(4)]
+
+    def req(i, rid):
+        return Request(rid=rid,
+                       input_ids=np.concatenate(
+                           [systems[i],
+                            rng.integers(1, 250, 3).astype(np.int32)]),
+                       max_new_tokens=4)
+
+    serve = tiny_engine.serving(b_slots=1, page_size=8, max_model_len=40,
+                                num_pages=8, host_tier_pages=2)
+    serve.run([req(i, i) for i in range(4)])
+    assert serve.demotions > 0
+    assert len(serve._tier) <= 2
+    acct = serve.page_accounting()
+    assert acct["balanced"] and acct["demoted"] <= 2
+    assert serve._prefix.demoted == len(serve._tier)

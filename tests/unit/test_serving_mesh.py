@@ -232,3 +232,35 @@ def test_mesh_rejects_indivisible_kv_heads(sharded_engine):
     mesh = initialize_serving_mesh(tp=8)
     with pytest.raises(ValueError, match="kv_heads"):
         ServingEngine(model, params, mesh=mesh, **SERVE_KW)
+
+
+def test_sharded_pool_demote_promote_token_exact(sharded_engine):
+    """ISSUE 11 on a mesh: the tier movers run against the SHARDED pool —
+    extract gathers the head shards into one host slab, inject device_puts
+    it back under the pool's own NamedSharding — and demote/promote
+    cycling stays token-exact with an untiered sharded engine, ledger
+    balanced."""
+    _, _, engine = sharded_engine
+    rng = np.random.default_rng(19)
+    systems = [rng.integers(1, 250, 17).astype(np.int32) for _ in range(3)]
+    tails = [rng.integers(1, 250, 3).astype(np.int32) for _ in range(9)]
+
+    def stream():
+        return [Request(rid=i,
+                        input_ids=np.concatenate([systems[i % 3], tails[i]]),
+                        max_new_tokens=4)
+                for i in range(9)]
+
+    ref_serve = engine.serving(b_slots=1, page_size=8, max_model_len=40,
+                               num_pages=8, prefix_cache=False)
+    ref = {r.rid: r.output_ids for r in ref_serve.run(stream())}
+    del ref_serve
+    serve = engine.serving(b_slots=1, page_size=8, max_model_len=40,
+                           num_pages=8, host_tier_pages=16)
+    assert serve.mesh is not None
+    results = serve.run(stream())
+    for r in results:
+        np.testing.assert_array_equal(r.output_ids, ref[r.rid])
+    assert serve.demotions > 0 and serve.promotions > 0
+    acct = serve.page_accounting()
+    assert acct["balanced"] and acct["demoted"] == len(serve._tier)

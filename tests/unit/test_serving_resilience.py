@@ -536,6 +536,69 @@ def test_supervised_drain_returns_original_requests(tiny_engine):
     assert done.rid == 0 and done.finish_reason == "length"
 
 
+# --------------------------------------------- KV-page tiering (ISSUE 11)
+
+@pytest.mark.chaos
+def test_warm_restart_and_recycle_carry_host_tier(tiny_engine):
+    """Demoted prefix pages live in HOST buffers, so they survive the dead
+    engine's pool: a warm restart (and a planned recycle()) carries them
+    to the replacement, which serves promotions from the carried cache —
+    token-exact, ledger balanced, nothing stranded."""
+    from deepspeed_tpu.resilience.fault_injection import SITE_SERVE_DECODE
+
+    rng = np.random.default_rng(3)
+    systems = [rng.integers(1, 250, 17).astype(np.int32) for _ in range(3)]
+    tails = [rng.integers(1, 250, 3).astype(np.int32) for _ in range(9)]
+
+    def stream(rid0=0):
+        return [Request(rid=rid0 + i,
+                        input_ids=np.concatenate([systems[i % 3], tails[i]]),
+                        max_new_tokens=4)
+                for i in range(9)]
+
+    ref_serve = tiny_engine.serving(b_slots=1, page_size=8, max_model_len=40,
+                                    num_pages=8, prefix_cache=False)
+    ref = {r.rid % 100: r.output_ids for r in ref_serve.run(stream())}
+    del ref_serve
+
+    # pool of 7 usable pages, 3 system prompts of ~3 pages each: serving
+    # the rotation forces demote/promote cycling from the first batch
+    sup = tiny_engine.supervised_serving(
+        b_slots=1, page_size=8, max_model_len=40, num_pages=8,
+        host_tier_pages=16)
+    sup.run(stream())
+    assert sup.health()["demoted_pages"] > 0
+
+    inj = FaultInjector()
+    inj.add(site=SITE_SERVE_DECODE, kind="raise", at_call=3)
+    install_injector(inj)
+    try:
+        results = sup.run(stream(rid0=100), max_ticks=2000)
+    finally:
+        clear_injector()
+    assert sup.restarts == 1
+    entry = sup.restart_log[-1]
+    assert entry["host_tier_entries_carried"] > 0
+    for r in results:
+        np.testing.assert_array_equal(r.output_ids, ref[r.rid % 100])
+    acct = sup.engine.page_accounting()
+    assert acct["balanced"] and acct["demoted"] == len(sup.engine._tier)
+
+    # planned maintenance keeps the warm host cache too
+    assert not sup.drain(max_ticks=500)
+    demoted_before = sup.engine.page_accounting()["demoted"]
+    assert demoted_before > 0
+    assert sup.recycle()
+    acct2 = sup.engine.page_accounting()
+    assert acct2["balanced"] and acct2["demoted"] == demoted_before
+    results3 = sup.run(stream(rid0=200), max_ticks=2000)
+    for r in results3:
+        np.testing.assert_array_equal(r.output_ids, ref[r.rid % 100])
+    h = sup.health()
+    assert h["promotions_total"] > 0 and h["demotions_total"] > 0
+    assert sup.engine.page_accounting()["balanced"]
+
+
 # ------------------------------------------------------------- serve soak
 
 @pytest.mark.chaos
@@ -585,6 +648,34 @@ def test_serve_soak_short_deterministic_on_mesh():
     assert stats["parity_checked"] >= 1
 
 
+@pytest.mark.chaos
+def test_serve_soak_short_deterministic_tiered():
+    """The ISSUE 11 pinned seed: the seeded kill/replay soak under
+    KV-page tiering POOL PRESSURE (device pool shrunk to 10 pages, host
+    tier of 8) — the schedule demotes AND promotes shared prefix pages
+    across warm restarts, and the soak asserts the extended accounting
+    invariant (demoted ledger == host buffers, folded into `balanced`),
+    token exactness of promoted-prefix streams vs an UNTIERED reference,
+    and that quarantine/restarts never strand a demoted page."""
+    import os
+    import sys
+
+    tools = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         os.pardir, os.pardir, "tools")
+    sys.path.insert(0, tools)
+    try:
+        from chaos_soak import run_serve_soak
+    finally:
+        sys.path.remove(tools)
+    stats = run_serve_soak(seed=2, n_requests=10, verbose=False,
+                           host_tier_pages=8, num_pages=10,
+                           require_tier_cycles=True)
+    assert stats["terminal"] == stats["submitted"] == 10
+    assert stats["faults_fired"] >= 1 and stats["restarts"] >= 1
+    assert stats["demotions"] > 0 and stats["promotions"] > 0
+    assert stats["parity_checked"] >= 1
+
+
 @pytest.mark.slow
 @pytest.mark.chaos
 def test_serve_soak_driver_multiseed(tmp_path):
@@ -601,6 +692,12 @@ def test_serve_soak_driver_multiseed(tmp_path):
         sys.path.remove(tools)
     for seed in (20, 21, 22):
         stats = run_serve_soak(seed=seed, n_requests=8, verbose=False)
+        assert stats["terminal"] == stats["submitted"]
+    # tiered pool-pressure variants (ISSUE 11): the extended demote/
+    # promote + ledger invariants under the same randomized kills
+    for seed in (23, 24, 25):
+        stats = run_serve_soak(seed=seed, n_requests=10, verbose=False,
+                               host_tier_pages=8, num_pages=10)
         assert stats["terminal"] == stats["submitted"]
 
 

@@ -169,7 +169,9 @@ def run_soak(seed: int, total_steps: int, ckpt_every: int, ckpt_dir: str,
 
 
 def run_serve_soak(seed: int, n_requests: int = 8, b_slots: int = 3,
-                   verbose: bool = True, tp: int = 1) -> dict:
+                   verbose: bool = True, tp: int = 1,
+                   host_tier_pages: int = None, num_pages: int = None,
+                   require_tier_cycles: bool = False) -> dict:
     """One supervised serving session under a seeded random kill schedule.
 
     ``tp > 1`` runs the WHOLE session on a ``tp``-device mesh (model axis =
@@ -177,6 +179,18 @@ def run_serve_soak(seed: int, n_requests: int = 8, b_slots: int = 3,
     KV-head dim, every kill/replay lands on sharded programs, and the same
     page-accounting + refcount invariants must hold — plus the sharded
     extras (mesh facts in health(), per-device pool bytes = total/tp).
+
+    ``host_tier_pages`` (with a deliberately small ``num_pages``) runs the
+    session under KV-page tiering POOL PRESSURE (ISSUE 11): the shared
+    system prompt's pages demote to the host tier and promote back across
+    the kill schedule, and the extra invariants are asserted after every
+    audit — the extended page accounting (``balanced`` now includes the
+    demoted ledger: demoted index entries == host-tier buffers), token
+    exactness of promoted-prefix streams (the parity check), and that
+    quarantine / warm restarts never strand a demoted page (the ledger
+    re-balances on the replacement engine, which CARRIES the host tier).
+    ``require_tier_cycles`` additionally asserts the schedule really
+    demoted AND promoted (the tier-1 pinned seed uses it).
 
     The soak draws decode/prefill/replay kill points (and, half the time, a
     bounded queue + one dead-on-arrival deadline) from ``seed``, replays a
@@ -222,9 +236,13 @@ def run_serve_soak(seed: int, n_requests: int = 8, b_slots: int = 3,
 
     nprng = np.random.default_rng(seed)
     # half the stream shares a seeded system prompt (long enough for one
-    # full 8-token page + a COW boundary), so the kill schedule hits
-    # refcounted shared pages mid-prefill/mid-decode; the rest stay unique
-    system = nprng.integers(1, model.config.vocab_size, 11).astype(np.int32)
+    # full 8-token page + a COW boundary — TWO full pages under tiering
+    # pressure, so a whole immutable chunk demotes/promotes), so the kill
+    # schedule hits refcounted shared pages mid-prefill/mid-decode; the
+    # rest stay unique
+    tiered = host_tier_pages is not None
+    system = nprng.integers(1, model.config.vocab_size,
+                            19 if tiered else 11).astype(np.int32)
 
     def prompt(i):
         if i % 2 == 0:
@@ -244,7 +262,12 @@ def run_serve_soak(seed: int, n_requests: int = 8, b_slots: int = 3,
                         deadline_s=(1e-4 if r.rid == deadline_rid else None))
                 for r in base]
 
-    # fault-free reference (no injector installed yet)
+    tier_kw = dict(host_tier_pages=host_tier_pages, num_pages=num_pages) \
+        if tiered else {}
+
+    # fault-free reference (no injector installed yet; NO tiering — the
+    # parity of the tiered run against an untiered reference is exactly
+    # the promoted-prefix token-exactness invariant)
     ref_serve = engine.serving(b_slots=b_slots, page_size=8, max_model_len=64)
     ref = {r.rid: r.output_ids for r in ref_serve.run(copies())}
 
@@ -267,7 +290,7 @@ def run_serve_soak(seed: int, n_requests: int = 8, b_slots: int = 3,
     try:
         sup = engine.supervised_serving(
             b_slots=b_slots, page_size=8, max_model_len=64,
-            max_queue=max_queue, max_restarts=12)
+            max_queue=max_queue, max_restarts=12, **tier_kw)
         results = sup.run(copies(deadline_rid), max_ticks=5000)
     finally:
         clear_injector()
@@ -301,6 +324,26 @@ def run_serve_soak(seed: int, n_requests: int = 8, b_slots: int = 3,
     # after drain no slot is active: every referenced page is index-cached
     assert acct["referenced"] == acct["cached"], \
         f"serve soak seed={seed}: leaked slot reference: {acct}"
+    if tiered:
+        # extended invariants (ISSUE 11): the demoted ledger balances —
+        # every demoted index entry has exactly one host buffer (already
+        # folded into `balanced`, re-checked explicitly here), the byte
+        # gauge agrees with the buffers, and neither quarantine nor the
+        # warm restarts stranded a demoted page on either side of the
+        # ledger.  Promoted-prefix token exactness is the parity loop
+        # above (the reference ran untiered).
+        eng = sup.engine
+        assert acct["demoted"] == len(eng._tier), \
+            f"serve soak seed={seed}: demoted ledger torn: {acct} vs " \
+            f"{len(eng._tier)} host buffer(s)"
+        assert h["demoted_pages"] == acct["demoted"]
+        assert h["host_tier_bytes"] == eng._tier.bytes()
+        assert eng._prefix.demoted <= eng._tier.max_pages
+        if require_tier_cycles:
+            assert h["demotions_total"] > 0 and h["promotions_total"] > 0, \
+                f"serve soak seed={seed}: tier never cycled " \
+                f"(demotions={h['demotions_total']}, " \
+                f"promotions={h['promotions_total']})"
     if tp > 1:
         # sharded extras (ISSUE 10): the mesh the session ran on is
         # visible in health() and the pool's per-device footprint is
@@ -326,6 +369,9 @@ def run_serve_soak(seed: int, n_requests: int = 8, b_slots: int = 3,
         "quarantined_slots": h["quarantined_slots"],
         "prefix_hits": h["prefix_hits_total"],
         "cow_copies": h["cow_copies_total"],
+        "demotions": h["demotions_total"],
+        "promotions": h["promotions_total"],
+        "demoted_pages": h["demoted_pages"],
     }
     if verbose:
         print(f"  seed={seed}: OK — {stats['faults_fired']} fault(s) fired, "
@@ -860,6 +906,14 @@ def main(argv=None) -> int:
                     help="serve mode: run each soak on a tp-device mesh "
                          "(model axis = tp over the first tp virtual host "
                          "devices; ISSUE 10 sharded serving)")
+    ap.add_argument("--tier_pages", type=int, default=0,
+                    help="serve mode: enable KV-page tiering with a host "
+                         "tier of N pages AND shrink the device pool "
+                         "(--pool_pages) so the kill schedule lands on "
+                         "demote/promote cycles (ISSUE 11; 0 = off)")
+    ap.add_argument("--pool_pages", type=int, default=14,
+                    help="serve mode with --tier_pages: device pool size "
+                         "(small = pool pressure)")
     ap.add_argument("--hosts", type=int, default=4,
                     help="pod mode: simulated hosts per soak")
     ap.add_argument("--seed", type=int, default=0,
@@ -882,9 +936,14 @@ def main(argv=None) -> int:
         seed = args.seed + i
         if args.mode == "serve":
             print(f"serve soak {i + 1}/{args.soaks} (seed={seed}"
-                  + (f", tp={args.tp}" if args.tp > 1 else "") + ")")
+                  + (f", tp={args.tp}" if args.tp > 1 else "")
+                  + (f", tier={args.tier_pages}" if args.tier_pages else "")
+                  + ")")
             try:
-                run_serve_soak(seed, n_requests=args.requests, tp=args.tp)
+                run_serve_soak(
+                    seed, n_requests=args.requests, tp=args.tp,
+                    host_tier_pages=args.tier_pages or None,
+                    num_pages=args.pool_pages if args.tier_pages else None)
             # broad catch by design: RestartBudgetExhausted / ServeTimeout /
             # an escaped InjectedFault ARE the per-seed failure signal this
             # driver exists to tally — one bad seed must not kill the rest

@@ -29,6 +29,15 @@ p50/p99, pages served from the index, and token-exactness of shared
 outputs against a no-sharing run of the same stream
 (``tools/artifacts/serve_prefix_r9.json`` is the seeded CPU reference).
 
+``--workload tiered`` (ISSUE 11) sizes the prefix workload so the shared
+system prompts OUTSIZE the device pool and compares an HBM-only engine
+(eviction under pressure) against a host-tiered one (demote/promote,
+``inference/kv_tiering.py``): prefix hit rate with/without tiering,
+promote latency p50/p99, demoted-page high-water mark, token exactness,
+the zero-recompile gate, and the extended page-accounting invariant
+through cycling + a forced warm restart + ``recycle()``
+(``tools/artifacts/serve_tiered_r14.json`` is the seeded CPU reference).
+
 ``--workload sampled`` (ISSUE 9) drives a heterogeneous sampling-params
 stream (greedy / temperature / top-k / top-p lanes, per-request seeds)
 through the serving engine and checks PER-REQUEST parity against
@@ -309,11 +318,185 @@ def run_prefix_bench(model_name: str = "llama-374m", b_slots: int = 4,
     }
 
 
+def run_tiered_bench(model_name: str = "llama-374m", b_slots: int = 2,
+                     n_requests: int = 24, seed: int = 0,
+                     page_size: int = 0, n_system: int = 6,
+                     max_model_len: int = 0,
+                     host_tier_pages: int = 96) -> dict:
+    """KV-page tiering benchmark (ISSUE 11 acceptance): a prefix workload
+    whose SHARED PREFIXES EXCEED the device pool capacity — ``n_system``
+    rotating system prompts against a deliberately small HBM pool — run
+    through an HBM-only engine (eviction under pressure, the PR 6
+    behavior) and a host-tiered engine (demote/promote), both supervised
+    and warmed.
+
+    Reports the prefix hit rate with and without tiering (the acceptance
+    gate: tiered >= HBM-only on this workload), promote latency p50/p99,
+    the demoted-page high-water mark and host-tier bytes, token exactness
+    of the tiered outputs against the HBM-only run, the zero-recompile
+    check on the measured pass, and the extended page-accounting invariant
+    (device equation + demoted ledger) through the demote/promote cycling,
+    a forced supervisor WARM RESTART, and a ``recycle()`` — both of which
+    carry the host tier to the replacement engine."""
+    import numpy as np
+
+    import jax
+
+    from deepspeed_tpu.resilience import (FaultInjector, clear_injector,
+                                          install_injector)
+    from deepspeed_tpu.resilience.fault_injection import SITE_SERVE_DECODE
+    from deepspeed_tpu.utils.compile_counter import compile_counter
+
+    on_tpu = jax.devices()[0].platform not in ("cpu",)
+    if not on_tpu:
+        model_name, base_cfg, sys_len = "serve-tiered(cpu)", "tiny", 230
+        max_model_len = max_model_len or 256
+        page_size = page_size or 16
+    else:
+        base_cfg, sys_len = model_name, 1024
+        max_model_len = max_model_len or 2048
+        page_size = page_size or 128
+    model, engine = _build_bench_engine(base_cfg, max_model_len, on_tpu)
+    stream = build_prefix_stream(model.config.vocab_size, n_requests, seed,
+                                 n_system=n_system, sys_len=sys_len)
+    # the point of the sizing: the shared prefixes alone outsize the pool
+    pages_per_slot = -(-max_model_len // page_size)
+    num_pages = 1 + b_slots * pages_per_slot
+    prefix_pages = n_system * (-(-sys_len // page_size))
+    assert prefix_pages > num_pages - 1, \
+        f"workload too small: {prefix_pages} prefix pages fit the " \
+        f"{num_pages - 1}-page pool — raise n_system/sys_len"
+
+    copies = lambda s=None: _clone_requests(s or stream)      # noqa: E731
+    count = compile_counter()
+    kw = dict(b_slots=b_slots, page_size=page_size,
+              max_model_len=max_model_len, num_pages=num_pages)
+
+    # ---- HBM-only: prefix cache on, NO host tier — pool pressure evicts
+    hbm = engine.supervised_serving(**kw)
+    hbm.run(copies())                                # warm
+    t0 = time.perf_counter()
+    hbm_results = hbm.run(copies())                  # measured
+    hbm_dt = time.perf_counter() - t0
+    hbm_out = {r.rid: r.output_ids for r in hbm_results}
+    hbm_hits = sum(r.shared_prefix_tokens > 0 for r in hbm_results)
+    hbm_h = hbm.health()
+    del hbm, hbm_results   # release the HBM-only pool
+
+    # ---- tiered: same pool, demote instead of evict
+    sup = engine.supervised_serving(host_tier_pages=host_tier_pages, **kw)
+    sup.run(copies())                                # warm + tier populate
+    inventory = sup.engine.program_inventory()
+    n_before = count()
+    t0 = time.perf_counter()
+    tier_results = sup.run(copies())                 # measured
+    tier_dt = time.perf_counter() - t0
+    measured_compiles = count() - n_before
+    lat = sup.engine.tier_latencies()
+    tier_hits = sum(r.shared_prefix_tokens > 0 for r in tier_results)
+    token_exact = all(np.array_equal(r.output_ids, hbm_out[r.rid])
+                      for r in tier_results)
+    h = sup.health()
+    acct = sup.engine.page_accounting()
+    invariant_ok = bool(acct["balanced"])
+
+    # ---- recycle(): planned maintenance must carry the host tier and
+    # keep serving promotions from it
+    phase = stream[:n_system]          # one request per system prompt
+    sup.drain(max_ticks=10000)
+    demoted_before = sup.engine.page_accounting()["demoted"]
+    sup.recycle()
+    acct_recycle = sup.engine.page_accounting()
+    invariant_ok &= bool(acct_recycle["balanced"])
+    recycle_carried = acct_recycle["demoted"]
+    recycle_results = sup.run(
+        [type(r)(rid=1000 + i, input_ids=r.input_ids,
+                 max_new_tokens=r.max_new_tokens)
+         for i, r in enumerate(phase)])
+    recycle_exact = all(
+        np.array_equal(r.output_ids, hbm_out[r.rid - 1000])
+        for r in recycle_results)
+    recycle_hits = sum(r.shared_prefix_tokens > 0 for r in recycle_results)
+    invariant_ok &= bool(sup.engine.page_accounting()["balanced"])
+
+    # ---- forced warm restart mid-stream: the fault path must also carry
+    # the tier and replay token-exactly
+    inj = FaultInjector()
+    inj.add(site=SITE_SERVE_DECODE, kind="raise", at_call=3)
+    install_injector(inj)
+    try:
+        restart_results = sup.run(
+            [type(r)(rid=2000 + i, input_ids=r.input_ids,
+                     max_new_tokens=r.max_new_tokens)
+             for i, r in enumerate(phase)], max_ticks=100000)
+    finally:
+        clear_injector()
+    restart_exact = all(
+        np.array_equal(r.output_ids, hbm_out[r.rid - 2000])
+        for r in restart_results)
+    acct_restart = sup.engine.page_accounting()
+    invariant_ok &= bool(acct_restart["balanced"])
+    tier_carried_on_restart = (sup.restart_log[-1]
+                               .get("host_tier_entries_carried", 0)
+                               if sup.restart_log else 0)
+
+    hit_rate_hbm = hbm_hits / n_requests
+    hit_rate_tiered = tier_hits / n_requests
+    promote_lat = sorted(lat["promote_s"]) or [0.0]
+    total_tokens = sum(len(r.output_ids) for r in tier_results)
+    return {
+        "metric": "serve-tiered",
+        "value": round(hit_rate_tiered, 4),
+        "unit": "prefix-hit-rate",
+        "vs_hbm_only": round(hit_rate_tiered - hit_rate_hbm, 4),
+        "detail": {
+            "model": model_name,
+            "platform": jax.devices()[0].platform,
+            "b_slots": b_slots,
+            "page_size": page_size,
+            "num_pages": num_pages,
+            "usable_pages": num_pages - 1,
+            "shared_prefix_pages": prefix_pages,
+            "host_tier_pages": host_tier_pages,
+            "n_requests": n_requests,
+            "n_system_prompts": n_system,
+            "system_prompt_len": sys_len,
+            "seed": seed,
+            "prefix_hit_rate_tiered": round(hit_rate_tiered, 4),
+            "prefix_hit_rate_hbm_only": round(hit_rate_hbm, 4),
+            "prefix_evictions_hbm_only": hbm_h["prefix_evictions_total"],
+            "demotions_total": h["demotions_total"],
+            "promotions_total": h["promotions_total"],
+            "demoted_pages_hwm": h["demoted_pages_hwm"],
+            "host_tier_bytes": h["host_tier_bytes"],
+            "promote_latency_p50_ms": round(
+                _pct(promote_lat, 0.50) * 1e3, 3),
+            "promote_latency_p99_ms": round(
+                _pct(promote_lat, 0.99) * 1e3, 3),
+            "tokens_per_sec_tiered": round(total_tokens / tier_dt, 1),
+            "tokens_per_sec_hbm_only": round(total_tokens / hbm_dt, 1),
+            "token_exact_vs_hbm_only": bool(token_exact),
+            "compiles_during_measured_run": measured_compiles,
+            "program_inventory": inventory,
+            # invariant + carry phases (the ISSUE 11 acceptance surface)
+            "invariant_balanced_all_phases": bool(invariant_ok),
+            "recycle_carried_demoted_pages": recycle_carried,
+            "recycle_demoted_before": demoted_before,
+            "recycle_hits": recycle_hits,
+            "recycle_token_exact": bool(recycle_exact),
+            "restart_count": sup.restarts,
+            "restart_tier_entries_carried": tier_carried_on_restart,
+            "restart_token_exact": bool(restart_exact),
+        },
+    }
+
+
 def run_fleet_bench(model_name: str = "llama-374m", n_engines: int = 3,
                     b_slots: int = 4, n_requests: int = 36, seed: int = 0,
                     page_size: int = 128, max_model_len: int = 0,
                     kill_engine: bool = False,
-                    journal_every_k: int = 4) -> dict:
+                    journal_every_k: int = 4,
+                    journal_flush_ms: float = None) -> dict:
     """Fleet-tier serving benchmark (ISSUE 7/8): the seeded mixed stream
     through ``n_engines`` leased engines behind a :class:`FleetRouter` on a
     file-backed coordination store.  Reports fleet throughput, PER-ENGINE
@@ -372,8 +555,11 @@ def run_fleet_bench(model_name: str = "llama-374m", n_engines: int = 3,
                                engine.supervised_serving(**serve_kw), store)
                    for i in range(n_engines)]
         router = FleetRouter(store, members,
-                             journal_every_k=journal_every_k)
+                             journal_every_k=journal_every_k,
+                             journal_flush_ms=journal_flush_ms)
         router.run(copies(), max_ticks=100000)       # warm all members
+        warm_cas = len(router.journal_cas_latencies())
+        warm_flushes = router.journal_flushes_total
         # counter snapshots: tokens_by_engine / shed_total are cumulative
         # over the router's lifetime — the measured numbers must not
         # include the warm pass
@@ -404,6 +590,10 @@ def run_fleet_bench(model_name: str = "llama-374m", n_engines: int = 3,
         fleet_dt = time.perf_counter() - t0
         h = router.health()     # snapshot while the store still exists
         resumed_total = router.resumed_tokens_total - warm_resumed
+        # per-flush CAS wall latency on THIS store (measured pass only):
+        # the number journal_every_k / journal_flush_ms are tuned against
+        cas_lat = sorted(router.journal_cas_latencies()[warm_cas:]) or [0.0]
+        measured_flushes = router.journal_flushes_total - warm_flushes
     finally:
         shutil.rmtree(coord_dir, ignore_errors=True)
 
@@ -446,6 +636,12 @@ def run_fleet_bench(model_name: str = "llama-374m", n_engines: int = 3,
             "p99_latency_s": round(_pct(lat, 0.99), 4),
             "failovers_total": router.failovers_total,
             "journal_every_k": journal_every_k,
+            # flush-cadence tuning surface (ISSUE 11 satellite): the
+            # time-based alternative and the measured per-flush CAS cost
+            "journal_flush_ms": journal_flush_ms,
+            "journal_flushes_measured": measured_flushes,
+            "journal_cas_p50_ms": round(_pct(cas_lat, 0.50) * 1e3, 3),
+            "journal_cas_p99_ms": round(_pct(cas_lat, 0.99) * 1e3, 3),
             # mid-stream durability split (ISSUE 8): tokens the victim had
             # decoded when it was killed, how many a survivor RESUMED from
             # the journal (never re-decoded/re-emitted) and how many had
@@ -923,13 +1119,24 @@ def main(argv=None) -> int:
     ap.add_argument("--journal_every_k", type=int, default=4,
                     help="fleet mode: router rounds between token-journal "
                          "flushes (mid-stream durability; 0 disables)")
-    ap.add_argument("--workload", choices=("mixed", "prefix", "sampled"),
+    ap.add_argument("--journal_flush_ms", type=float, default=None,
+                    help="fleet mode: time-based flush cadence on the "
+                         "store clock (ISSUE 11 satellite; composes with "
+                         "--journal_every_k — either trigger flushes; the "
+                         "JSON reports per-flush CAS p50/p99 to tune it)")
+    ap.add_argument("--workload",
+                    choices=("mixed", "prefix", "sampled", "tiered"),
                     default="mixed",
                     help="mixed: ragged stream vs sequential generate(); "
                          "prefix: shared-system-prompt stream, sharing vs "
                          "cold engine (ISSUE 6 acceptance); sampled: "
                          "heterogeneous sampling-params stream with a "
-                         "generate(sampling=...) parity oracle (ISSUE 9)")
+                         "generate(sampling=...) parity oracle (ISSUE 9); "
+                         "tiered: prefix workload whose shared prefixes "
+                         "OUTSIZE the device pool — host-tier demote/"
+                         "promote vs HBM-only eviction (ISSUE 11)")
+    ap.add_argument("--host_tier_pages", type=int, default=96,
+                    help="tiered workload: host-RAM tier capacity in pages")
     ap.add_argument("--speculative", action="store_true",
                     help="sampled workload: add the verify-k section "
                          "(layer-skip draft) — mean accepted length, "
@@ -949,8 +1156,9 @@ def main(argv=None) -> int:
     ap.add_argument("--page_size", type=int, default=None,
                     help="default: 128 (mixed) / platform pick (prefix: "
                          "16 CPU, 128 TPU)")
-    ap.add_argument("--n_system", type=int, default=2,
-                    help="prefix workload: distinct shared system prompts")
+    ap.add_argument("--n_system", type=int, default=None,
+                    help="prefix/tiered workloads: distinct shared system "
+                         "prompts (default: 2 prefix / 6 tiered)")
     ap.add_argument("--tp", type=int, default=0,
                     help="multi-chip workload (ISSUE 10): tensor-shard the "
                          "decode tick + paged KV pool over a model-axis-N "
@@ -968,7 +1176,7 @@ def main(argv=None) -> int:
         if args.mode != "engine" or args.workload != "mixed" \
                 or args.trace or args.rate_rps or args.speculative \
                 or args.kill_engine or args.n_engines != 3 \
-                or args.journal_every_k != 4 or args.n_system != 2:
+                or args.journal_every_k != 4 or args.n_system is not None:
             ap.error("--tp runs its own sharded-vs-unsharded comparison "
                      "(greedy + sampled streams); it composes with "
                      "--b_slots/--n_requests/--seed/--page_size/"
@@ -1015,7 +1223,8 @@ def main(argv=None) -> int:
             seed=args.seed,
             page_size=args.page_size if args.page_size is not None else 128,
             max_model_len=args.max_model_len, kill_engine=args.kill_engine,
-            journal_every_k=args.journal_every_k or None)
+            journal_every_k=args.journal_every_k or None,
+            journal_flush_ms=args.journal_flush_ms)
         line = json.dumps(result)
         print(line)
         if args.out:
@@ -1055,6 +1264,33 @@ def main(argv=None) -> int:
     if args.speculative:
         ap.error("--speculative is a sampled-workload flag "
                  "(--workload sampled)")
+    if args.workload == "tiered":
+        if args.trace or args.rate_rps:
+            ap.error("--trace/--rate_rps are not supported with "
+                     "--workload tiered")
+        result = run_tiered_bench(
+            args.model,
+            b_slots=args.b_slots if args.b_slots is not None else 2,
+            n_requests=(args.n_requests
+                        if args.n_requests is not None else 24),
+            seed=args.seed,
+            page_size=args.page_size if args.page_size is not None else 0,
+            n_system=args.n_system if args.n_system is not None else 6,
+            max_model_len=args.max_model_len,
+            host_tier_pages=args.host_tier_pages)
+        line = json.dumps(result)
+        print(line)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        d = result["detail"]
+        ok = (d["prefix_hit_rate_tiered"] >= d["prefix_hit_rate_hbm_only"]
+              and d["token_exact_vs_hbm_only"]
+              and d["compiles_during_measured_run"] == 0
+              and d["invariant_balanced_all_phases"]
+              and d["recycle_token_exact"] and d["restart_token_exact"]
+              and d["promotions_total"] > 0 and d["demotions_total"] > 0)
+        return 0 if ok else 1
     if args.workload == "prefix":
         if args.trace:
             ap.error("--trace is not supported with --workload prefix "
@@ -1073,7 +1309,8 @@ def main(argv=None) -> int:
                         if args.n_requests is not None else 24),
             seed=args.seed,
             page_size=args.page_size if args.page_size is not None else 0,
-            n_system=args.n_system, max_model_len=args.max_model_len)
+            n_system=args.n_system if args.n_system is not None else 2,
+            max_model_len=args.max_model_len)
         line = json.dumps(result)
         print(line)
         if args.out:
