@@ -109,7 +109,7 @@ def measure_matmul_peak() -> float:
 def run(model_name: str, micro_batch: int, seq_len: int, steps: int, warmup: int,
         zero_stage: int, remat_policy: str = None, remat: bool = None,
         mu_dtype: str = None, grad_accum_dtype: str = None, gas: int = 1,
-        nu_dtype: str = None):
+        nu_dtype: str = None, device_trace: str = None):
     import jax
     import jax.numpy as jnp
 
@@ -162,6 +162,26 @@ def run(model_name: str, micro_batch: int, seq_len: int, steps: int, warmup: int
         loss = engine.train_batch(batch=batch)
     loss_val = float(loss)
     dt = time.perf_counter() - t0
+    # --device_trace: a few EXTRA steps under a windowed XLA-profiler
+    # capture (the measured loop above stays untraced so the headline
+    # keeps its production overhead profile).  train.batch/train.step
+    # spans land as TraceAnnotations on the captured host timeline; view
+    # with `tensorboard --logdir <dir>` → Profile tab
+    # (docs/OBSERVABILITY.md "Device-time correlation") — the tool the
+    # ROADMAP's MFU-reclaim item asks for.
+    if device_trace:
+        from deepspeed_tpu.observability import (capture_device_trace,
+                                                 stop_device_trace)
+
+        cap = capture_device_trace(device_trace)
+        try:
+            for _ in range(3):
+                # float() = device sync: the captured window must contain
+                # the real step execution, not just its dispatch
+                float(engine.train_batch(batch=batch))
+        finally:
+            if cap is not None:
+                stop_device_trace()
     # chip-health probe AFTER the run: the shared/tunneled part throttles
     # under sustained load (observed 8-9x episodes).  Read with care: a low
     # after-number MAY also reflect HBM pressure from the resident engine
@@ -235,7 +255,7 @@ def run(model_name: str, micro_batch: int, seq_len: int, steps: int, warmup: int
             "matmul_peak_after_run_tflops": round(peak_after, 1)
             if peak_after == peak_after else None,
             "mfu_vs_measured_peak": mfu_roof,  # same figure as the top-level
-
+            "device_trace_dir": device_trace,
         },
     }
 
@@ -347,6 +367,11 @@ def main():
     ap.add_argument("--no_retry", action="store_true",
                     help="run exactly one attempt in-process (used by the "
                          "subprocess-isolated OOM-retry loop)")
+    ap.add_argument("--device_trace", default=None, metavar="DIR",
+                    help="train mode: capture a windowed XLA-profiler "
+                         "device trace of a few extra steps into DIR (the "
+                         "measured loop stays untraced); view with "
+                         "tensorboard --logdir DIR (docs/OBSERVABILITY.md)")
     args = ap.parse_args()
     if args.model is None:
         # serve decodes a 374m-class model by default (the 740m train
@@ -414,7 +439,8 @@ def main():
                          remat_policy=args.remat_policy,
                          remat=False if args.no_remat else None,
                          mu_dtype=args.mu_dtype, nu_dtype=args.nu_dtype,
-                         grad_accum_dtype=args.grad_accum_dtype, gas=args.gas)
+                         grad_accum_dtype=args.grad_accum_dtype, gas=args.gas,
+                         device_trace=args.device_trace)
         except Exception as e:
             print(json.dumps({"metric": "llama-train-throughput", "value": 0.0,
                               "unit": "model TFLOPs/sec/chip", "vs_baseline": 0.0,

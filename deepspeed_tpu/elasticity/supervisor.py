@@ -159,12 +159,12 @@ class Supervisor:
             # failed round: capture the attempt's span history before the
             # next attempt overwrites the ring (None when tracing is off)
             try:
-                from ..observability.trace import (DEFAULT_DUMP_WINDOW_S,
+                from ..observability.trace import (dump_window_s,
                                                    flight_dump)
 
                 self.last_flight_dump = flight_dump(
                     f"supervisor.round[{rounds}] rc={rc}",
-                    monitor=self.monitor, last_s=DEFAULT_DUMP_WINDOW_S)
+                    monitor=self.monitor, last_s=dump_window_s())
             except Exception as e:
                 logger.warning("elastic supervisor: flight dump failed "
                                "(%s: %s)", type(e).__name__, e)

@@ -40,6 +40,7 @@ programs are real SPMD partitions either way (docs/SERVING.md
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, Optional
 
 import numpy as np
@@ -49,6 +50,8 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..models.transformer import cow_copy_page
+from ..observability.program_stats import (ProgramCatalog, account,
+                                           finish_sample)
 from .kv_tiering import extract_page, inject_page
 from .sampling import position_keys, sample_tokens
 
@@ -135,9 +138,16 @@ class MeshExecutor:
 
     def __init__(self, model, params, num_pages: int, page_size: int,
                  b_slots: int, dtype=None, mesh=None,
-                 prefix_cache: bool = True, host_tier: bool = False):
+                 prefix_cache: bool = True, host_tier: bool = False,
+                 catalog: Optional[ProgramCatalog] = None):
         self.model = model
         self.mesh = mesh
+        # per-program accounting (observability/program_stats.py): FLOPs
+        # from lowered cost analysis at first invocation (no extra backend
+        # compile), invocation counts per call, optional synced sampling.
+        # None = no accounting at all (the legacy zero-instrumentation
+        # path; the serving engine always passes one).
+        self.catalog = catalog
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
         self.b_slots = int(b_slots)
@@ -185,9 +195,11 @@ class MeshExecutor:
         if self._cow_prog is not None:
             # pre-warm the one COW program shape with a trash-page self-copy
             # so its single compile lands at init, never during admission —
-            # the zero-recompile steady state must hold from the first tick
-            self.kpool, self.vpool = self._cow_prog(
-                self.kpool, self.vpool, jnp.int32(0), jnp.int32(0))
+            # the zero-recompile steady state must hold from the first tick.
+            # Through the entry point so the prewarm also registers the
+            # program's cost in the catalog (acceptance: every inventory
+            # program reports nonzero FLOPs even before a real COW).
+            self.cow(0, 0)
         # KV-page tiering (docs/SERVING.md "KV-page tiering"): the device↔
         # host page movers.  Page ids are traced scalars, so each is ONE
         # program shape; both are pre-warmed on the trash page here at init
@@ -198,11 +210,10 @@ class MeshExecutor:
         self._extract_prog = self._inject_prog = None
         if host_tier:
             self._extract_prog, self._inject_prog = self._build_tier()
-            hk, hv = self._extract_prog(self.kpool, self.vpool, jnp.int32(0))
-            hk, hv = np.asarray(hk), np.asarray(hv)
-            ph, pv = self._place_host_page(hk, hv)
-            self.kpool, self.vpool = self._inject_prog(
-                self.kpool, self.vpool, ph, pv, jnp.int32(0))
+            # prewarm through the entry points (trash-page round trip):
+            # compiles land at init AND the catalog registers both movers
+            hk, hv = self.extract(0)
+            self.inject(hk, hv, 0)
         # constant for the engine's lifetime (the pool never reallocates):
         # health()/gauges read these per tick, so compute them once
         self.pool_bytes = pool_bytes(self.kpool, self.vpool)
@@ -308,15 +319,22 @@ class MeshExecutor:
         return jax.device_put(hk, sh), jax.device_put(hv, sh)
 
     # ---------------------------------------------------------- entry points
+    # Every program call site follows the one catalog protocol
+    # (program_stats.account / finish_sample): register lowered cost on
+    # first sight, count the dispatch, sample the synced wall time on the
+    # picked invocations (docs/OBSERVABILITY.md "Per-program accounting").
 
     def decode(self, page_table, lengths, last_tok, active, lanes):
         """One fixed-shape decode step over all slots; returns the sampled
         [B_slots] token vector (device array — the caller fetches inside
         its watchdog window) and updates the pools in place."""
-        nxt, self.kpool, self.vpool = self._decode_prog(
-            self.params, self.kpool, self.vpool,
-            jnp.asarray(page_table), jnp.asarray(lengths),
-            jnp.asarray(last_tok), jnp.asarray(active), *lanes)
+        args = (self.params, self.kpool, self.vpool,
+                jnp.asarray(page_table), jnp.asarray(lengths),
+                jnp.asarray(last_tok), jnp.asarray(active), *lanes)
+        t0 = account(self.catalog, "decode", self._decode_prog, args)
+        nxt, self.kpool, self.vpool = self._decode_prog(*args)
+        if t0 is not None:
+            finish_sample(self.catalog, "decode", nxt, t0)
         return nxt
 
     def prefill(self, s_pad: int, pt_row, tokens, n_real, start,
@@ -330,36 +348,51 @@ class MeshExecutor:
         # lanes ride as numpy arrays: jit device-puts them without
         # compiling the tiny list->array convert programs a jnp.asarray
         # of a Python list would cost on first use
-        nxt, self.kpool, self.vpool = prog(
-            self.params, self.kpool, self.vpool, pt_row, tokens,
-            jnp.int32(n_real), jnp.int32(start),
-            np.asarray([lane_t], np.float32),
-            np.asarray([lane_k], np.int32),
-            np.asarray([lane_p], np.float32),
-            np.asarray([lane_s], np.uint32))
+        args = (self.params, self.kpool, self.vpool, pt_row, tokens,
+                jnp.int32(n_real), jnp.int32(start),
+                np.asarray([lane_t], np.float32),
+                np.asarray([lane_k], np.int32),
+                np.asarray([lane_p], np.float32),
+                np.asarray([lane_s], np.uint32))
+        t0 = account(self.catalog, f"prefill_{s_pad}", prog, args)
+        nxt, self.kpool, self.vpool = prog(*args)
+        if t0 is not None:
+            finish_sample(self.catalog, f"prefill_{s_pad}", nxt, t0)
         return nxt
 
     def cow(self, src: int, dst: int) -> None:
         """Snapshot physical page ``src`` onto ``dst`` across all layers
         (copy-on-write boundary page; one fixed program shape)."""
-        self.kpool, self.vpool = self._cow_prog(
-            self.kpool, self.vpool, jnp.int32(src), jnp.int32(dst))
+        args = (self.kpool, self.vpool, jnp.int32(src), jnp.int32(dst))
+        t0 = account(self.catalog, "cow", self._cow_prog, args)
+        self.kpool, self.vpool = self._cow_prog(*args)
+        if t0 is not None:
+            finish_sample(self.catalog, "cow", self.kpool, t0)
 
     def extract(self, src: int):
         """Demote half of the tier move: copy physical page ``src`` to
         host, returning ``(hk, hv)`` numpy slabs of ``[L, page, Hkv, hd]``
         (a sharded pool gathers the head shards into one slab).  Read-only
         — the pool survives."""
-        hk, hv = self._extract_prog(self.kpool, self.vpool, jnp.int32(src))
-        return np.asarray(hk), np.asarray(hv)
+        args = (self.kpool, self.vpool, jnp.int32(src))
+        t0 = account(self.catalog, "tier_extract", self._extract_prog, args)
+        hk, hv = self._extract_prog(*args)
+        out = np.asarray(hk), np.asarray(hv)
+        if t0 is not None:   # the host fetch above already synced
+            self.catalog.record_sync("tier_extract",
+                                     time.perf_counter() - t0)
+        return out
 
     def inject(self, hk, hv, dst: int) -> None:
         """Promote half of the tier move: place the host slabs under the
         pool's sharding and write them into physical page ``dst`` (one
         fixed program shape; pools donated like COW)."""
         ph, pv = self._place_host_page(hk, hv)
-        self.kpool, self.vpool = self._inject_prog(
-            self.kpool, self.vpool, ph, pv, jnp.int32(dst))
+        args = (self.kpool, self.vpool, ph, pv, jnp.int32(dst))
+        t0 = account(self.catalog, "tier_inject", self._inject_prog, args)
+        self.kpool, self.vpool = self._inject_prog(*args)
+        if t0 is not None:
+            finish_sample(self.catalog, "tier_inject", self.kpool, t0)
 
     def lanes(self, temp, top_k, top_p, seeds):
         """Cached device copy of the per-slot lane vectors; the engine

@@ -89,7 +89,7 @@ import hashlib
 import json
 import re
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -276,6 +276,10 @@ class FleetMember:
             "host_tier_bytes": h["host_tier_bytes"],
             "promotions_total": h["promotions_total"],
             "demotions_total": h["demotions_total"],
+            # SLO firing states (docs/OBSERVABILITY.md "SLOs and alerts"):
+            # rule names currently firing on this engine — the router
+            # rolls the fleet-wide count up as fleet/alerts_firing
+            "alerts_firing": list(h.get("alerts", [])),
         }
 
     def beat(self, force: bool = False) -> None:
@@ -1338,9 +1342,26 @@ class FleetRouter:
             "journal_flushes_total": self.journal_flushes_total,
             "affinity_routes_total": self.affinity_routes_total,
             "residency": self._residency_rollup(ads),
+            # fleet-wide SLO rollup: every (engine, rule) currently firing
+            # anywhere on the fleet, from the member advertisements
+            "alerts_firing": self._alerts_rollup(ads),
             "tokens_by_engine": dict(self.tokens_by_engine),
             "engines": ads,
         }
+
+    @staticmethod
+    def _alerts_rollup(ads: Dict[str, Optional[Dict[str, Any]]]
+                       ) -> List[Tuple[str, str]]:
+        """Every firing (engine_id, rule) pair across the advertised
+        fleet — the fleet/alerts_firing gauge counts these."""
+        out: List[Tuple[str, str]] = []
+        for eid in sorted(ads):
+            ad = ads[eid]
+            if not ad:
+                continue
+            for rule in ad.get("alerts_firing", []) or []:
+                out.append((eid, str(rule)))
+        return out
 
     @staticmethod
     def _residency_rollup(ads: Dict[str, Optional[Dict[str, Any]]]
@@ -1414,4 +1435,10 @@ class FleetRouter:
              float(res["demotions_total"]), self._tick),
             ("fleet/affinity_routes_total",
              float(self.affinity_routes_total), self._tick),
+            # SLO rollup (docs/OBSERVABILITY.md "SLOs and alerts"): count
+            # of (engine, rule) pairs firing anywhere on the fleet — one
+            # scrape of the router's endpoint answers "is any member
+            # breaching its objectives"
+            ("fleet/alerts_firing", float(len(self._alerts_rollup(ads))),
+             self._tick),
         ])

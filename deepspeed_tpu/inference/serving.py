@@ -126,7 +126,11 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..models.transformer import PAGE_SIZE
-from ..observability.trace import trace_count, trace_span
+from ..observability.device_profiler import (device_trace_unit,
+                                             maybe_capture_from_env)
+from ..observability.program_stats import ProgramCatalog
+from ..observability.slo import SloEvaluator, SloRule
+from ..observability.trace import get_tracer, trace_count, trace_span
 from ..resilience import (SITE_SERVE_ADMIT, SITE_SERVE_DECODE,
                           SITE_SERVE_PREFILL, SITE_SERVE_TICK, maybe_fire)
 from ..utils.logging import log_dist, logger
@@ -293,7 +297,9 @@ class ServingEngine:
                  prefix_cache: bool = True,
                  prefix_index_entries: int = 4096,
                  host_tier_pages: Optional[int] = None,
-                 speculative: Optional[SpeculativeConfig] = None):
+                 speculative: Optional[SpeculativeConfig] = None,
+                 program_stats_sample_every: int = 0,
+                 slo_rules: Optional[List[SloRule]] = None):
         if not hasattr(model, "apply_paged"):
             raise ValueError(
                 "ServingEngine needs a model with the paged decode contract "
@@ -349,10 +355,27 @@ class ServingEngine:
             if int(host_tier_pages) < 1:
                 raise ValueError(
                     f"host_tier_pages={host_tier_pages} must be >= 1")
+        # per-program device-time accounting (docs/OBSERVABILITY.md
+        # "Per-program accounting"): FLOPs/bytes from lowered cost analysis
+        # at each program's first invocation, invocation counts per call,
+        # synced wall-time sampling every Nth invocation (default 0 = never
+        # — steady-state async pipelining untouched)
+        self._catalog = ProgramCatalog(
+            sample_every=program_stats_sample_every)
+        # SLO rules (docs/OBSERVABILITY.md "SLOs and alerts"): evaluated
+        # once per working tick over monitor gauges + span quantiles;
+        # firing states in health()["alerts"] and (via the alert{rule=...}
+        # gauges) on /metrics as dstpu_alert{rule="..."}
+        self._slo = SloEvaluator(slo_rules) if slo_rules else None
+        # windowed device-trace capture, env-armed (DS_TPU_DEVICE_TRACE):
+        # first engine in the process starts the capture; step() counts
+        # the window down one unit per tick
+        maybe_capture_from_env()
         self._exec = MeshExecutor(model, params, self.num_pages,
                                   self.page_size, self.b_slots, dtype=dtype,
                                   mesh=mesh, prefix_cache=prefix_cache,
-                                  host_tier=host_tier_pages is not None)
+                                  host_tier=host_tier_pages is not None,
+                                  catalog=self._catalog)
         self.params = self._exec.params   # auto-TP-sharded on a mesh
         self._free_pages: List[int] = list(range(self.num_pages - 1, 0, -1))
         # per-page reference counts (page 0, the trash page, is never
@@ -479,7 +502,7 @@ class ServingEngine:
             self._spec = SpeculativeDecoder(
                 speculative, model, self.num_pages, self.page_size,
                 self.b_slots, dtype=dtype, mesh=mesh,
-                donate=bool(self._donate))
+                donate=bool(self._donate), catalog=self._catalog)
             if self._cow_prog is not None:
                 # pre-warm the COW jit on the DRAFT pool aval too: a
                 # boundary COW at admission must never compile
@@ -547,6 +570,20 @@ class ServingEngine:
             # speculative mix) never grows any of it
             inv["speculative"] = self._spec.program_inventory()
         return inv
+
+    def program_stats(self) -> Dict[str, Dict[str, Any]]:
+        """Per-program accounting table (docs/OBSERVABILITY.md): for every
+        program this engine has invoked — decode, each prefill bucket,
+        COW, the tier movers, draft/verify under speculation — the
+        compile-time FLOPs/bytes, invocation count, executed-FLOPs ledger
+        and (when ``program_stats_sample_every`` > 0) sampled device wall
+        time.  Mirrored in ``health()["program_stats"]`` and the
+        ``serve/program_flops{program=...}`` gauges."""
+        return self._catalog.table()
+
+    def slo_states(self) -> Dict[str, Dict[str, Any]]:
+        """Per-rule SLO snapshot (empty when no rules are configured)."""
+        return self._slo.states() if self._slo is not None else {}
 
     # ---------------------------------------------------------- scheduling
 
@@ -1385,10 +1422,21 @@ class ServingEngine:
                 # scheduler round
                 if not self._draining:
                     self._admit(now)
+                # SLO evaluation per working tick (monitor-independent —
+                # alerts must fire even when no gauge backend is attached)
+                if self._slo is not None:
+                    self._slo.evaluate(monitor=self.monitor,
+                                       tracer=get_tracer())
                 # gauges only on working ticks: idle arrival-wait ticks
                 # would otherwise dilute occupancy stats and spam csv
                 # backends
                 self._write_gauges()
+                # windowed device capture (docs/OBSERVABILITY.md
+                # "Device-time correlation"): one WORKING tick = one
+                # capture unit — idle arrival-wait ticks must not burn the
+                # window before any decode/prefill lands in the trace.
+                # A global None check when no capture is armed.
+                device_trace_unit()
         return (int(self._active.sum()) + len(self._queue)
                 + len(self._pending))
 
@@ -1563,6 +1611,12 @@ class ServingEngine:
             "oldest_request_age_s": round(self._oldest_age_s(now), 4),
             "retry_after_hint_s": self._retry_after_hint(),
             "unclaimed_results": len(self._finished_order),
+            # per-program device-time accounting + SLO firing states
+            # (docs/OBSERVABILITY.md): the fleet advertisement carries
+            # alerts so the router can roll up fleet/alerts_firing
+            "program_stats": self.program_stats(),
+            "alerts": (self._slo.firing() if self._slo is not None
+                       else []),
             # the bound /metrics port (None = endpoint not enabled): with N
             # engines on one host each process binds its OWN port (ephemeral
             # fallback), so a scraper discovers endpoints from health/fleet
@@ -1653,3 +1707,21 @@ class ServingEngine:
                 ("serve/spec_mean_accepted_len",
                  self._spec.mean_accepted_len(), self._tick),
             ])
+        # per-program accounting gauges (docs/OBSERVABILITY.md): the
+        # {program=...} suffix rides the flat monitor stream and the
+        # Prometheus exposition renders it as a real label
+        # (dstpu_serve_program_flops{program="decode"}).
+        # device_seconds_total is 0 until synced sampling is enabled.
+        # gauge_rows() is the flat fast path — no table build per tick.
+        prog_events = []
+        for name, flops_total, device_s in self._catalog.gauge_rows():
+            prog_events.append((f"serve/program_flops{{program={name}}}",
+                                float(flops_total), self._tick))
+            prog_events.append(
+                (f"serve/device_seconds_total{{program={name}}}",
+                 float(device_s), self._tick))
+        if prog_events:
+            self.monitor.write_events(prog_events)
+        # SLO firing states as alert{rule=...} gauges -> dstpu_alert{...}
+        if self._slo is not None:
+            self.monitor.write_events(self._slo.gauge_events(self._tick))

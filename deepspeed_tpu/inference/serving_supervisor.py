@@ -68,8 +68,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
-from ..observability.trace import (DEFAULT_DUMP_WINDOW_S, flight_dump,
-                                   trace_span)
+from ..observability.trace import dump_window_s, flight_dump, trace_span
 from ..resilience import SITE_SERVE_REPLAY, maybe_fire
 from ..utils.logging import log_dist, logger
 from .serving import (Request, RequestResult, ServeTimeout, ServingEngine,
@@ -408,7 +407,7 @@ class ServingSupervisor:
         try:
             self.last_flight_dump = flight_dump(
                 f"serve.restart {type(cause).__name__}", monitor=self.monitor,
-                last_s=DEFAULT_DUMP_WINDOW_S)
+                last_s=dump_window_s())
         except Exception as e:
             self.last_flight_dump = None
             logger.warning("serve supervisor: flight dump failed (%s: %s)",

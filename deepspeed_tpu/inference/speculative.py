@@ -61,6 +61,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..observability.program_stats import account, finish_sample
 from .sampling import position_keys, sample_tokens, sampling_probs
 
 __all__ = ["SpeculativeConfig", "SpeculativeDecoder", "layer_skip_draft",
@@ -172,9 +173,14 @@ class SpeculativeDecoder:
 
     def __init__(self, config: SpeculativeConfig, target_model,
                  num_pages: int, page_size: int, b_slots: int,
-                 dtype=None, mesh=None, donate: bool = False):
+                 dtype=None, mesh=None, donate: bool = False,
+                 catalog=None):
         from .execution import place_params, pool_bytes
 
+        # per-program accounting shared with the owning engine's
+        # MeshExecutor (observability/program_stats.py): draft_decode /
+        # verify / draft_prefill_<bucket> rows land in the same ledger
+        self.catalog = catalog
         self.config = config
         self.k = int(config.k)
         self.draft_model = config.draft_model
@@ -351,9 +357,13 @@ class SpeculativeDecoder:
         if prog is None:
             prog = self._draft_prefill_progs[s_pad] = \
                 self._build_draft_prefill(s_pad)
-        self._dkpool, self._dvpool = prog(
-            self.draft_params, self._dkpool, self._dvpool, pt_row, tokens,
-            jnp.int32(n_real), jnp.int32(start))
+        args = (self.draft_params, self._dkpool, self._dvpool, pt_row,
+                tokens, jnp.int32(n_real), jnp.int32(start))
+        t0 = account(self.catalog, f"draft_prefill_{s_pad}", prog, args)
+        self._dkpool, self._dvpool = prog(*args)
+        if t0 is not None:
+            finish_sample(self.catalog, f"draft_prefill_{s_pad}",
+                          self._dkpool, t0)
 
     def cow(self, cow_prog, src: int, dst: int) -> None:
         """Mirror a target-pool COW snapshot in the draft pool (same
@@ -377,15 +387,22 @@ class SpeculativeDecoder:
         tok = jnp.asarray(last_tok)
         d_toks, d_probs = [], []
         for i in range(self.k):
-            tok, q, self._dkpool, self._dvpool = self._draft_prog(
-                self.draft_params, self._dkpool, self._dvpool, pt,
-                ln + i, tok, act, tj, kj, pj, sj)
+            dargs = (self.draft_params, self._dkpool, self._dvpool, pt,
+                     ln + i, tok, act, tj, kj, pj, sj)
+            t0 = account(self.catalog, "draft_decode", self._draft_prog,
+                         dargs)
+            tok, q, self._dkpool, self._dvpool = self._draft_prog(*dargs)
+            if t0 is not None:
+                finish_sample(self.catalog, "draft_decode", tok, t0)
             d_toks.append(tok)
             d_probs.append(q)
-        emitted, n_emit, kpool, vpool = self._verify_prog(
-            target_params, kpool, vpool, pt, ln, jnp.asarray(last_tok),
-            act, jnp.stack(d_toks, axis=1), jnp.stack(d_probs, axis=1),
-            tj, kj, pj, sj)
+        vargs = (target_params, kpool, vpool, pt, ln, jnp.asarray(last_tok),
+                 act, jnp.stack(d_toks, axis=1), jnp.stack(d_probs, axis=1),
+                 tj, kj, pj, sj)
+        t0 = account(self.catalog, "verify", self._verify_prog, vargs)
+        emitted, n_emit, kpool, vpool = self._verify_prog(*vargs)
+        if t0 is not None:
+            finish_sample(self.catalog, "verify", emitted, t0)
         n_active = int(np.asarray(active).sum())
         self.verify_slot_ticks += n_active
         self.drafted_tokens += self.k * n_active

@@ -10,7 +10,7 @@ from __future__ import annotations
 import os
 import threading
 from collections import deque
-from typing import Deque, List, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Tuple
 
 from ..utils.logging import logger
 
@@ -141,6 +141,12 @@ class InMemoryMonitor(Monitor):
         self.reports: Deque[Tuple[str, str]] = deque(maxlen=self.max_reports)
         self.dropped_events = 0
         self.dropped_reports = 0
+        # name -> newest value, maintained on write: latest() is O(1)
+        # instead of a full ring copy+scan — the SLO evaluator polls it
+        # per gauge rule per serving tick (observability/slo.py), which a
+        # 65536-deque scan would turn into real hot-loop cost.  Bounded by
+        # the number of DISTINCT gauge names, not traffic.
+        self._latest: Dict[str, float] = {}
         self._lock = threading.Lock()
 
     def write_events(self, event_list: List[Event]) -> None:
@@ -149,6 +155,7 @@ class InMemoryMonitor(Monitor):
                 if len(self.events) == self.max_events:
                     self.dropped_events += 1
                 self.events.append(ev)
+                self._latest[ev[0]] = ev[1]
 
     def write_report(self, name: str, text: str) -> None:
         with self._lock:
@@ -170,12 +177,21 @@ class InMemoryMonitor(Monitor):
 
     def latest(self, name: str) -> Optional[float]:
         """Most recent value of a gauge, or None if it never fired —
-        what a health/readiness assertion usually wants."""
-        snapshot = self.events_snapshot()
-        for n, value, _step in reversed(snapshot):
-            if n == name:
-                return value
-        return None
+        what a health/readiness assertion (and every SLO gauge rule)
+        usually wants.  O(1): read from the write-maintained map, which
+        remembers a name even after its events rotate out of the ring
+        (the newest value of a live gauge is never "gone")."""
+        with self._lock:
+            return self._latest.get(name)
+
+    def latest_map(self) -> Dict[str, float]:
+        """Locked copy of name -> newest value ever written.  The
+        Prometheus exposition prefers this over scanning the event ring:
+        once-at-init gauges (serve/mesh_devices, serve/kv_pool_bytes_*)
+        must not vanish from /metrics when per-tick traffic rotates their
+        events out of the bounded ring."""
+        with self._lock:
+            return dict(self._latest)
 
 
 class MonitorMaster(Monitor):

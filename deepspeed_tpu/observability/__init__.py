@@ -12,7 +12,14 @@ The reference stack's ``deepspeed/profiling`` + ``monitor/`` +
   ``ServingSupervisor``) dump it so every exit-85 and warm restart ships
   with the last seconds of scheduler history.
 - :mod:`.export` — Chrome/Perfetto trace-event JSON and Prometheus text
-  exposition of monitor gauges + span aggregates.
+  exposition of monitor gauges + span aggregates/histograms.
+- :mod:`.device_profiler` — windowed XLA-profiler captures
+  (``DS_TPU_DEVICE_TRACE``) with span-name ``TraceAnnotation`` correlation
+  onto the device timeline.
+- :mod:`.program_stats` — per-program FLOPs/invocation/device-time ledger
+  (``ProgramCatalog``) feeding MFU estimates.
+- :mod:`.slo` — span duration histograms + declarative ``SloRule`` alerts
+  (``dstpu_alert{rule=...}`` on /metrics, ``health()["alerts"]``).
 
 Instrumented sites: ``train.batch``/``train.data``/``train.step`` (plus the
 reference-shaped ``train.forward``/``train.backward``), ``ckpt.save``/
@@ -21,11 +28,19 @@ reference-shaped ``train.forward``/``train.backward``), ``ckpt.save``/
 """
 from .flight_recorder import (CounterEvent, DEFAULT_CAPACITY,  # noqa: F401
                               FlightRecorder)
-from .trace import (Span, TRACE_CAPACITY_ENV, TRACE_ENV,  # noqa: F401
-                    Tracer, configure_tracer, flight_dump, get_tracer,
-                    trace_count, trace_span)
+from .trace import (DUMP_WINDOW_ENV, Span,  # noqa: F401
+                    TRACE_CAPACITY_ENV, TRACE_ENV,
+                    Tracer, configure_tracer, dump_window_s, flight_dump,
+                    get_tracer, trace_count, trace_span)
 from .export import (METRICS_PORT_ENV, MetricsServer,  # noqa: F401
                      chrome_trace_events, get_metrics_server,
                      maybe_start_metrics_server,
                      prometheus_text, start_metrics_server,
                      write_chrome_trace)
+from .device_profiler import (DEVICE_TRACE_ENV,  # noqa: F401
+                              DeviceTraceCapture, capture_device_trace,
+                              device_capture_active, device_trace_unit,
+                              maybe_capture_from_env, stop_device_trace)
+from .program_stats import (PEAK_TFLOPS_ENV, ProgramCatalog,  # noqa: F401
+                            peak_flops_per_sec)
+from .slo import LogBucketHistogram, SloEvaluator, SloRule  # noqa: F401

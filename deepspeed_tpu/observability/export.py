@@ -20,6 +20,7 @@ safe (the snapshot is taken under the recorder lock).
 from __future__ import annotations
 
 import json
+import math
 import os
 import re
 from typing import Any, Dict, List, Optional
@@ -91,8 +92,16 @@ def write_chrome_trace(path: str, records: Optional[List[Any]] = None,
 
 
 # ------------------------------------------------------------- prometheus
+#
+# Exposition-format conformance (validated by a minimal parser in
+# tests/unit/test_device_observability.py against a live scrape): every
+# family carries # HELP and # TYPE lines, label values are escaped per the
+# spec (backslash, double-quote, newline), and ALL metric/label-name
+# sanitization funnels through _prom_name/_prom_label_key below — the one
+# place the `/` -> `_` mapping lives.
 
 _PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+_PROM_LABEL_BAD = re.compile(r"[^a-zA-Z0-9_]")
 
 
 def _prom_name(name: str, prefix: str = "dstpu_") -> str:
@@ -100,32 +109,124 @@ def _prom_name(name: str, prefix: str = "dstpu_") -> str:
     return "_" + n if n[0].isdigit() else n
 
 
+def _prom_label_key(key: str) -> str:
+    k = _PROM_LABEL_BAD.sub("_", key) or "_"
+    return "_" + k if k[0].isdigit() else k
+
+
+def _prom_label_value(value: str) -> str:
+    """Escape a label value per the exposition format: backslash, double
+    quote, and literal newline."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _split_labels(name: str):
+    """Split a monitor event name of the form ``base{k=v,k2=v2}`` into
+    ``(base, [(k, v), ...])``.  This is how label-carrying gauges ride the
+    flat ``(name, value, step)`` monitor stream: the serving engine writes
+    e.g. ``serve/program_flops{program=decode}`` and the exposition
+    renders ``dstpu_serve_program_flops{program="decode"}``.  A name with
+    no (or malformed) label suffix is a plain gauge."""
+    if not name.endswith("}"):
+        return name, []
+    i = name.find("{")
+    if i <= 0:
+        return name, []
+    base, inner = name[:i], name[i + 1:-1]
+    labels = []
+    for part in inner.split(","):
+        k, sep, v = part.partition("=")
+        if not sep or not k.strip():
+            return name, []   # not the label grammar: treat as a flat name
+        labels.append((k.strip(), v))
+    return base, labels
+
+
+def _render_labels(labels) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{_prom_label_key(k)}="{_prom_label_value(v)}"'
+                     for k, v in labels)
+    return "{" + inner + "}"
+
+
+# HELP strings for the families this tree emits; anything else gets the
+# generic fallback (HELP is documentation, not schema — unknown names must
+# still expose cleanly)
+_PROM_HELP = {
+    "dstpu_span_count": "Completed spans per span name (tracer aggregate).",
+    "dstpu_span_seconds_total":
+        "Total seconds spent in completed spans per span name.",
+    "dstpu_span_duration_seconds":
+        "Log-bucketed duration histogram of completed spans per span name.",
+    "dstpu_monitor_dropped_events_total":
+        "Monitor ring evictions (bounded InMemoryMonitor).",
+    "dstpu_flight_recorder_dropped_total":
+        "Flight-recorder ring evictions (bounded span/counter ring).",
+    "dstpu_alert":
+        "SLO rule firing state per rule (1 = firing; observability/slo.py).",
+}
+
+
+def _help_for(pname: str) -> str:
+    return _PROM_HELP.get(pname, f"deepspeed-tpu gauge {pname}")
+
+
+def _fmt_le(bound: float) -> str:
+    return "+Inf" if math.isinf(bound) else f"{bound:g}"
+
+
 def prometheus_text(monitor=None, tracer=None) -> str:
-    """Prometheus exposition of monitor gauges + tracer span aggregates.
+    """Prometheus exposition of monitor gauges + tracer span aggregates
+    and duration histograms.
 
     ``monitor`` contributes the latest value per distinct event name (its
     ``events`` stream holds ``(name, value, step)`` — ``serve/*`` gauges,
-    ``Train/Samples/*``); ``tracer`` (default: the global one) contributes
-    ``dstpu_span_count`` / ``dstpu_span_seconds_total`` per span name and
+    ``Train/Samples/*``; names may carry a ``{label=value}`` suffix, see
+    :func:`_split_labels`); ``tracer`` (default: the global one)
+    contributes ``dstpu_span_count`` / ``dstpu_span_seconds_total`` per
+    span name, ``dstpu_span_duration_seconds`` histogram families, and
     ring-drop accounting."""
     lines: List[str] = []
+
+    def family(pname: str, kind: str) -> None:
+        lines.append(f"# HELP {pname} {_help_for(pname)}")
+        lines.append(f"# TYPE {pname} {kind}")
+
     if monitor is not None:
-        # use the monitor's locked snapshot when it has one — iterating a
-        # live deque would race the serving loop's per-tick gauge appends
-        snap_fn = getattr(monitor, "events_snapshot", None)
-        events = snap_fn() if snap_fn is not None else getattr(
-            monitor, "events", None)
-        if events is not None:
-            latest: Dict[str, float] = {}
-            for name, value, _step in list(events):
-                latest[name] = value
+        # prefer the monitor's write-maintained latest map: the event ring
+        # is bounded, so deriving "latest per name" from it would drop
+        # once-at-init gauges (mesh topology, pool bytes) as soon as
+        # per-tick traffic rotates them out.  Duck-typed monitors without
+        # the map fall back to scanning a locked snapshot of the ring.
+        latest: Optional[Dict[str, float]] = None
+        latest_fn = getattr(monitor, "latest_map", None)
+        if latest_fn is not None:
+            latest = latest_fn()
+        else:
+            snap_fn = getattr(monitor, "events_snapshot", None)
+            events = snap_fn() if snap_fn is not None else getattr(
+                monitor, "events", None)
+            if events is not None:
+                latest = {}
+                for name, value, _step in list(events):
+                    latest[name] = value
+        if latest is not None:
+            # group label-carrying samples under one family so # TYPE is
+            # emitted once per family, not once per label set
+            families: Dict[str, List[str]] = {}
             for name in sorted(latest):
-                pname = _prom_name(name)
-                lines.append(f"# TYPE {pname} gauge")
-                lines.append(f"{pname} {latest[name]:g}")
+                base, labels = _split_labels(name)
+                pname = _prom_name(base)
+                families.setdefault(pname, []).append(
+                    f"{pname}{_render_labels(labels)} {latest[name]:g}")
+            for pname in sorted(families):
+                family(pname, "gauge")
+                lines.extend(families[pname])
         dropped = getattr(monitor, "dropped_events", None)
         if dropped is not None:
-            lines.append("# TYPE dstpu_monitor_dropped_events_total counter")
+            family("dstpu_monitor_dropped_events_total", "counter")
             lines.append(f"dstpu_monitor_dropped_events_total {dropped}")
     if tracer is None:
         from .trace import get_tracer
@@ -133,15 +234,37 @@ def prometheus_text(monitor=None, tracer=None) -> str:
         tracer = get_tracer()
     agg = tracer.aggregates()
     if agg:
-        lines.append("# TYPE dstpu_span_count counter")
-        lines.append("# TYPE dstpu_span_seconds_total counter")
+        count_lines, total_lines = [], []
         for name in sorted(agg):
             count, total = agg[name]
-            label = name.replace("\\", "\\\\").replace('"', '\\"')
-            lines.append(f'dstpu_span_count{{span="{label}"}} {count}')
-            lines.append(
+            label = _prom_label_value(name)
+            count_lines.append(f'dstpu_span_count{{span="{label}"}} {count}')
+            total_lines.append(
                 f'dstpu_span_seconds_total{{span="{label}"}} {total:.9f}')
-    lines.append("# TYPE dstpu_flight_recorder_dropped_total counter")
+        family("dstpu_span_count", "counter")
+        lines.extend(count_lines)
+        family("dstpu_span_seconds_total", "counter")
+        lines.extend(total_lines)
+    # span duration histograms (observability/slo.py): REAL prometheus
+    # histograms — cumulative buckets per le bound + _sum/_count — so an
+    # external prometheus can histogram_quantile() over scrapes instead of
+    # trusting our in-process quantiles
+    hists = tracer.histograms() if hasattr(tracer, "histograms") else {}
+    if hists:
+        family("dstpu_span_duration_seconds", "histogram")
+        for name in sorted(hists):
+            snap = hists[name]
+            label = _prom_label_value(name)
+            for bound, cum in snap["buckets"]:
+                lines.append(
+                    f'dstpu_span_duration_seconds_bucket{{span="{label}"'
+                    f',le="{_fmt_le(bound)}"}} {cum}')
+            lines.append(f'dstpu_span_duration_seconds_sum{{span="{label}"}}'
+                         f' {snap["sum"]:.9f}')
+            lines.append(
+                f'dstpu_span_duration_seconds_count{{span="{label}"}}'
+                f' {snap["count"]}')
+    family("dstpu_flight_recorder_dropped_total", "counter")
     lines.append(
         f"dstpu_flight_recorder_dropped_total {tracer.recorder.dropped}")
     return "\n".join(lines) + "\n"

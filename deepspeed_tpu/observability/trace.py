@@ -39,6 +39,20 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from .flight_recorder import CounterEvent, FlightRecorder
+from .slo import LogBucketHistogram
+
+# set by observability.device_profiler while a device capture is active:
+# a callable name -> context manager (jax.profiler.TraceAnnotation) every
+# span enters alongside its host bookkeeping, so host span names appear on
+# the XLA/TensorBoard device-trace timeline (docs/OBSERVABILITY.md
+# "Device-time correlation").  None when no capture is running — the hot
+# path pays one module-global load.
+_DEVICE_ANNOTATION = None
+
+
+def _set_device_annotation_factory(factory) -> None:
+    global _DEVICE_ANNOTATION
+    _DEVICE_ANNOTATION = factory
 
 
 class Span:
@@ -93,17 +107,57 @@ class _NullSpan:
 _NULL_SPAN = _NullSpan()
 
 
+class _AnnotationSpan:
+    """Annotation-only span: what ``trace_span`` returns while a device
+    capture is active but the HOST tracer is disabled — the XLA timeline
+    still gets the named region, with no host recording cost.  ``sync``
+    and ``set`` are no-ops like the null span's.  A profiler hiccup must
+    never fail the instrumented section, so every annotation call is
+    guarded."""
+
+    __slots__ = ("_annot",)
+
+    def __init__(self, name: str, factory):
+        try:
+            self._annot = factory(name)
+        except Exception:
+            self._annot = None
+
+    def __enter__(self):
+        if self._annot is not None:
+            try:
+                self._annot.__enter__()
+            except Exception:
+                self._annot = None
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._annot is not None:
+            try:
+                self._annot.__exit__(exc_type, exc, tb)
+            except Exception:
+                pass
+        return False
+
+    def sync(self, tree: Any) -> None:
+        pass
+
+    def set(self, **attrs) -> None:
+        pass
+
+
 class _SpanCtx:
     """Live span context: pushes onto the owning thread's stack on enter,
     stamps duration (after an optional ``block_until_ready`` sync point) and
     feeds the recorder on exit — including exception unwinds."""
 
-    __slots__ = ("_tracer", "_span", "_sync_tree")
+    __slots__ = ("_tracer", "_span", "_sync_tree", "_annot")
 
     def __init__(self, tracer: "Tracer", name: str,
                  attrs: Optional[Dict[str, Any]]):
         self._tracer = tracer
         self._sync_tree = None
+        self._annot = None
         stack = tracer._thread_stack()
         parent = stack[-1].name if stack else None
         self._span = Span(name, 0.0, threading.get_ident(),
@@ -124,6 +178,17 @@ class _SpanCtx:
 
     def __enter__(self):
         self._tracer._thread_stack().append(self._span)
+        fac = _DEVICE_ANNOTATION
+        if fac is not None:
+            # device capture active: mirror this span as a named region on
+            # the XLA profiler's host timeline.  Never let a profiler
+            # hiccup fail the instrumented section itself.
+            try:
+                annot = fac(self._span.name)
+                annot.__enter__()
+                self._annot = annot
+            except Exception:
+                self._annot = None
         self._span.t0 = time.monotonic()
         return self
 
@@ -134,6 +199,13 @@ class _SpanCtx:
 
                 jax.block_until_ready(self._sync_tree)
             except Exception:   # a poisoned tree must not mask the real exc
+                pass
+        if self._annot is not None:
+            # close AFTER the sync so the blocked device wait is attributed
+            # inside the annotated region on the profiler timeline
+            try:
+                self._annot.__exit__(exc_type, exc, tb)
+            except Exception:
                 pass
         sp = self._span
         sp.dur_s = time.monotonic() - sp.t0
@@ -182,6 +254,10 @@ class Tracer:
         self._open: Dict[int, Tuple[str, List[Span]]] = {}
         self._open_lock = threading.Lock()
         self._agg: Dict[str, List[float]] = {}   # name -> [count, total_s]
+        # per-span-name duration histograms (observability/slo.py): live
+        # quantiles without replaying the ring — count+sum alone cannot
+        # answer "serve.tick p99" (the PR 4 carry-over this closes)
+        self._hist: Dict[str, LogBucketHistogram] = {}
         self._agg_lock = threading.Lock()
 
     # ------------------------------------------------------------ recording
@@ -190,6 +266,9 @@ class Tracer:
         """Context manager for one traced section.  Disabled: returns the
         shared null span (no allocation, no clock read)."""
         if not self.enabled:
+            fac = _DEVICE_ANNOTATION
+            if fac is not None:
+                return _AnnotationSpan(name, fac)
             return _NULL_SPAN
         return _SpanCtx(self, name, attrs or None)
 
@@ -218,6 +297,10 @@ class Tracer:
             else:
                 agg[0] += 1.0
                 agg[1] += span.dur_s
+            hist = self._hist.get(span.name)
+            if hist is None:
+                hist = self._hist[span.name] = LogBucketHistogram()
+            hist.observe(span.dur_s)
 
     # ----------------------------------------------------------- inspection
 
@@ -226,6 +309,22 @@ class Tracer:
         :meth:`reset` — retention-independent (survives ring eviction)."""
         with self._agg_lock:
             return {k: (int(v[0]), v[1]) for k, v in self._agg.items()}
+
+    def histograms(self) -> Dict[str, Dict[str, Any]]:
+        """Per-span-name duration histogram snapshots (cumulative bucket
+        counts per ``le`` bound + count/sum) — what the Prometheus
+        exposition renders as real histogram families."""
+        with self._agg_lock:
+            return {name: h.snapshot() for name, h in self._hist.items()}
+
+    def span_quantile(self, name: str, q: float) -> Optional[float]:
+        """The ``q``-quantile of ``name``'s completed-span durations, or
+        ``None`` when that span was never recorded — live, bounded memory,
+        retention-independent (observability/slo.py feeds SLO rules from
+        this)."""
+        with self._agg_lock:
+            hist = self._hist.get(name)
+            return hist.quantile(q) if hist is not None else None
 
     def open_spans(self) -> List[Span]:
         """Spans currently on ANY thread's stack, outermost first — what
@@ -258,6 +357,7 @@ class Tracer:
         self.recorder.clear()
         with self._agg_lock:
             self._agg.clear()
+            self._hist.clear()
 
 
 # --------------------------------------------------------------- global hook
@@ -287,6 +387,11 @@ def configure_tracer(enabled: Optional[bool] = None,
 def trace_span(name: str, **attrs) -> Any:
     """``get_tracer().span(...)`` — the one-liner instrumentation sites use."""
     if not _GLOBAL.enabled:
+        fac = _DEVICE_ANNOTATION
+        if fac is not None:
+            # device capture active with the host tracer off: the XLA
+            # timeline still gets the named region (device_profiler.py)
+            return _AnnotationSpan(name, fac)
         return _NULL_SPAN
     return _SpanCtx(_GLOBAL, name, attrs or None)
 
@@ -300,6 +405,30 @@ def trace_count(name: str, value: float = 1.0, **attrs) -> None:
 # past even when the ring is configured huge (chaos soak uses 1<<17 records
 # — serializing all of it per failed round would swamp the report stream)
 DEFAULT_DUMP_WINDOW_S = 60.0
+DUMP_WINDOW_ENV = "DS_TPU_DUMP_WINDOW_S"
+
+
+def dump_window_s() -> float:
+    """The trailing window (seconds) crash-path dumps keep.  Defaults to
+    :data:`DEFAULT_DUMP_WINDOW_S`; ``DS_TPU_DUMP_WINDOW_S`` widens it for
+    long pod rounds whose post-mortem needs more than the last minute
+    (read per call so a supervisor can be re-windowed without a restart).
+    Malformed or non-positive values degrade to the default — a typo in an
+    env var must never break a crash path."""
+    raw = os.environ.get(DUMP_WINDOW_ENV, "").strip()
+    if raw:
+        try:
+            v = float(raw)
+            if v > 0:
+                return v
+        except ValueError:
+            pass
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "ignoring malformed $%s=%r (want a positive number of seconds)",
+            DUMP_WINDOW_ENV, raw)
+    return DEFAULT_DUMP_WINDOW_S
 
 
 def flight_dump(reason: str, monitor=None,

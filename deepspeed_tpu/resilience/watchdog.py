@@ -125,11 +125,11 @@ class HangWatchdog:
             # N seconds were doing — and the hung section itself shows up
             # as an open span (docs/OBSERVABILITY.md)
             try:
-                from ..observability.trace import (DEFAULT_DUMP_WINDOW_S,
+                from ..observability.trace import (dump_window_s,
                                                    flight_dump)
 
                 fr = flight_dump(f"watchdog {label or '<unlabelled>'}",
-                                 last_s=DEFAULT_DUMP_WINDOW_S)
+                                 last_s=dump_window_s())
             except Exception as e:
                 logger.warning("watchdog: flight dump failed (%s: %s)",
                                type(e).__name__, e)

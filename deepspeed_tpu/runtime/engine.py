@@ -375,6 +375,18 @@ class DeepSpeedEngine:
         wire_curriculum(self)
         wire_random_ltd(self, self.model)
         wire_flops_profiler(self)
+        # per-program device-time accounting (docs/OBSERVABILITY.md
+        # "Per-program accounting"): the fused train step registers its
+        # lowered FLOPs on first run; every step counts an invocation and
+        # the wall clock between step completions feeds the live
+        # train/tflops_est + train/mfu_est gauges (steady-state async
+        # dispatch means inter-step wall ~= device step time)
+        from ..observability.program_stats import ProgramCatalog
+
+        self.program_catalog = ProgramCatalog()
+        self._step_flops: Optional[float] = None
+        self._step_wall_t: Optional[float] = None
+        self._step_wall_s: Optional[float] = None   # EMA of inter-step wall
         self.training_dataloader = self._build_dataloader(training_data)
         self.monitor = self._build_monitor()
         # opt-in /metrics scrape endpoint (DS_TPU_METRICS_PORT): no-op
@@ -382,6 +394,11 @@ class DeepSpeedEngine:
         from ..observability.export import maybe_start_metrics_server
 
         maybe_start_metrics_server(self.monitor)
+        # windowed device-trace capture, env-armed (DS_TPU_DEVICE_TRACE):
+        # train_batch counts the window down one unit per step
+        from ..observability.device_profiler import maybe_capture_from_env
+
+        maybe_capture_from_env()
         self._watchdog = self._build_watchdog()
         log_dist(
             f"engine ready: params={self.param_count:,} zero_stage={self.zero_stage} "
@@ -1114,16 +1131,24 @@ class DeepSpeedEngine:
         (with ``train.data``/``train.step`` children in the fused path) on
         the process-global tracer — no-op when tracing is disabled
         (docs/OBSERVABILITY.md)."""
+        from ..observability.device_profiler import device_trace_unit
         from ..resilience.fault_injection import SITE_TRAIN_STEP, maybe_fire
 
         with trace_span("train.batch", step=self.global_steps + 1):
             if self._watchdog is None:
                 maybe_fire(SITE_TRAIN_STEP, step=self.global_steps + 1)
-                return self._train_batch_impl(data_iter=data_iter, batch=batch)
-            with self._watchdog.armed(
-                    f"train_batch step {self.global_steps + 1}"):
-                maybe_fire(SITE_TRAIN_STEP, step=self.global_steps + 1)
-                return self._train_batch_impl(data_iter=data_iter, batch=batch)
+                loss = self._train_batch_impl(data_iter=data_iter,
+                                              batch=batch)
+            else:
+                with self._watchdog.armed(
+                        f"train_batch step {self.global_steps + 1}"):
+                    maybe_fire(SITE_TRAIN_STEP, step=self.global_steps + 1)
+                    loss = self._train_batch_impl(data_iter=data_iter,
+                                                  batch=batch)
+        # windowed device capture: one train step = one capture unit
+        # (a global None check when no capture is armed)
+        device_trace_unit()
+        return loss
 
     def _train_batch_impl(self, data_iter=None, batch=None) -> jnp.ndarray:
         if batch is None:
@@ -1180,6 +1205,7 @@ class DeepSpeedEngine:
             self.state, metrics = self._compiled_train_step(self.state,
                                                             global_batch)
             _sp.sync(metrics["loss"])
+        self._account_step(global_batch)
         if profiling:
             from ..profiling.flops_profiler import cost_analysis_of
 
@@ -1435,6 +1461,33 @@ class DeepSpeedEngine:
         return self._accum_count > 0 and self._accum_count % self.gas == 0
 
     # ------------------------------------------------------------------
+    def _account_step(self, global_batch) -> None:
+        """Per-program accounting for the fused train step
+        (docs/OBSERVABILITY.md "Per-program accounting"): register the
+        compiled step's lowered FLOPs once (no backend compile — the
+        lowering hits the jit trace cache for these avals), count the
+        invocation, and EMA the inter-step wall clock.  At steady state
+        the loop is device-bound, so the wall between step RETURNS tracks
+        the device step time without adding a sync point.  NOTE: lax.scan
+        bodies (scan_layers, the gas accumulation loop) are counted once
+        by XLA's analysis, so the estimate UNDERCOUNTS scanned configs —
+        same caveat as the flops profiler; treat mfu_est as a trend gauge,
+        not the bench's certified figure."""
+        now = time.perf_counter()
+        if self._step_flops is None:
+            # register_call owns the lower()/cost_analysis() protocol
+            # (and its failure path: zeros + a warning, never a raise)
+            self.program_catalog.register_call(
+                "train_step", self._compiled_train_step, self.state,
+                global_batch)
+            self._step_flops = self.program_catalog.flops_of("train_step")
+        self.program_catalog.invoke("train_step")
+        if self._step_wall_t is not None:
+            dt = now - self._step_wall_t
+            self._step_wall_s = (dt if self._step_wall_s is None
+                                 else 0.8 * self._step_wall_s + 0.2 * dt)
+        self._step_wall_t = now
+
     def _emit_monitor_events(self, metrics):
         if self.monitor is None:
             return
@@ -1446,6 +1499,22 @@ class DeepSpeedEngine:
         if self.progressive_layer_drop is not None:
             events.append(("Train/Samples/pld_theta",
                            self.progressive_layer_drop.get_theta(),
+                           self.global_steps))
+        if self._step_flops and self._step_wall_s:
+            # live roofline gauges (docs/OBSERVABILITY.md): achieved
+            # model-flops throughput from the compiled step's cost and the
+            # inter-step wall EMA; mfu_est divides by the operator-stated
+            # roof (DS_TPU_PEAK_TFLOPS, e.g. the bench's measured matmul
+            # peak) and reads 0 until one is provided — dashboards never
+            # branch on configuration
+            from ..observability.program_stats import peak_flops_per_sec
+
+            achieved = self._step_flops / self._step_wall_s
+            peak = peak_flops_per_sec()
+            events.append(("train/tflops_est", achieved / 1e12,
+                           self.global_steps))
+            events.append(("train/mfu_est",
+                           achieved / peak if peak else 0.0,
                            self.global_steps))
         self.monitor.write_events(events)
 

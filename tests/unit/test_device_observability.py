@@ -1,0 +1,547 @@
+"""Device-time observability tests (ISSUE 12 tentpole).
+
+Covers the three new pieces and their exports:
+
+- device-trace correlation (``observability/device_profiler.py``): a
+  windowed capture arms a ``TraceAnnotation`` per ``trace_span`` ONLY
+  while active (CPU-safe — jax's profiler writes real trace files on the
+  host platform), the env arming, and the unit-countdown window;
+- per-program accounting (``observability/program_stats.py``): FLOPs from
+  lowered cost analysis, invocation counts with sampling off and on, the
+  serving-engine integration (every inventory program reports nonzero
+  FLOPs + invocations, including COW, the tier movers, and draft/verify
+  under speculation), and the ``train/tflops_est``/``train/mfu_est``
+  gauges;
+- SLO layer (``observability/slo.py``): histogram bucket math + quantile
+  monotonicity, rule parsing/firing/clearing, the serving engine's
+  ``health()["alerts"]`` and a live ``/metrics`` scrape showing
+  ``dstpu_alert{rule="..."} 1`` under a driven violation;
+- Prometheus exposition conformance: a minimal parser over a live
+  ``MetricsServer`` scrape (HELP/TYPE per family, label escaping, the
+  one-place name sanitization).
+
+The fleet rollup test (members advertise firing alerts, router counts
+``fleet/alerts_firing``) lives with its harness in ``test_fleet.py``.
+"""
+import json
+import math
+import os
+import re
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.inference.serving import Request
+from deepspeed_tpu.models import CausalLM
+from deepspeed_tpu.monitor import InMemoryMonitor
+from deepspeed_tpu.observability import (LogBucketHistogram, ProgramCatalog,
+                                         SloEvaluator, SloRule, Tracer,
+                                         configure_tracer, get_tracer,
+                                         prometheus_text,
+                                         start_metrics_server)
+from deepspeed_tpu.observability import device_profiler as dp
+from deepspeed_tpu.observability.program_stats import peak_flops_per_sec
+from deepspeed_tpu.observability.trace import dump_window_s
+
+
+# ----------------------------------------------------------- histograms
+
+def test_histogram_bucket_math_and_counts():
+    h = LogBucketHistogram()
+    vals = [1e-7, 1e-4, 1e-3, 1e-3, 0.5, 3.0, 1e6]
+    for v in vals:
+        h.observe(v)
+    assert h.count == len(vals)
+    assert h.sum == pytest.approx(sum(vals))
+    snap = h.snapshot()
+    # cumulative counts are monotone and end at the total under +Inf
+    cum = [c for _b, c in snap["buckets"]]
+    assert cum == sorted(cum)
+    assert snap["buckets"][-1][0] == math.inf
+    assert snap["buckets"][-1][1] == len(vals)
+    # bucket invariant: every observed value is <= its bound and > the
+    # previous populated bound's predecessor (log-bucket containment)
+    bounds = h.bounds()
+    for v in vals:
+        idx = next(i for i, b in enumerate(bounds) if v <= b)
+        assert h.counts[idx] >= 1
+
+
+def test_histogram_quantiles_monotone_and_accurate():
+    import random
+
+    rng = random.Random(7)
+    h = LogBucketHistogram()
+    vals = sorted(rng.uniform(0.0005, 0.2) for _ in range(5000))
+    for v in vals:
+        h.observe(v)
+    qs = [h.quantile(q / 100.0) for q in range(0, 101, 2)]
+    assert all(a <= b for a, b in zip(qs, qs[1:])), qs
+    # quarter-octave buckets: within ~19% of the true order statistic
+    for q, true in ((0.5, vals[2500]), (0.99, vals[4950])):
+        assert h.quantile(q) == pytest.approx(true, rel=0.20)
+    assert LogBucketHistogram().quantile(0.99) is None   # empty -> None
+
+
+def test_histogram_extremes_land_in_catchall_buckets():
+    h = LogBucketHistogram()
+    h.observe(0.0)
+    h.observe(-1.0)      # defensive: a clock anomaly must not throw
+    h.observe(1e12)
+    assert h.count == 3
+    assert h.counts[0] == 2 and h.counts[-1] == 1
+    # overflow quantile reports the largest finite bound, still monotone
+    assert h.quantile(1.0) == h.bounds()[-2]
+
+
+def test_tracer_feeds_histograms_and_quantiles():
+    t = Tracer(enabled=True)
+    for _ in range(20):
+        with t.span("unit.work"):
+            pass
+    assert t.span_quantile("unit.work", 0.5) is not None
+    assert t.span_quantile("never.seen", 0.5) is None
+    hists = t.histograms()
+    assert hists["unit.work"]["count"] == 20
+    t.reset()
+    assert t.histograms() == {} and t.span_quantile("unit.work", 0.5) is None
+
+
+# ------------------------------------------------------------ SLO rules
+
+def test_slo_rule_parse_and_validation():
+    r = SloRule.parse("serve.tick p99 < 0.05")
+    assert (r.metric, r.quantile, r.op, r.threshold) == \
+        ("serve.tick", 0.99, "<", 0.05)
+    g = SloRule.parse("serve/queue_depth <= 64", name="qd")
+    assert g.quantile is None and g.name == "qd" and g.op == "<="
+    with pytest.raises(ValueError):
+        SloRule.parse("not a rule at all !!")
+    with pytest.raises(ValueError):
+        SloRule(name="x", metric="m", op="~", threshold=1.0)
+    with pytest.raises(ValueError):
+        SloRule(name="x", metric="m", op="<", threshold=1.0, for_count=0)
+    with pytest.raises(ValueError):
+        SloEvaluator([g, SloRule.parse("a < 1", name="qd")])  # dup names
+
+
+def test_slo_evaluator_fires_and_clears_with_debounce():
+    ev = SloEvaluator([SloRule.parse("g/x < 5", name="r",
+                                     for_count=2, clear_count=2)])
+    mon = InMemoryMonitor()
+    mon.write_events([("g/x", 10.0, 1)])
+    assert ev.evaluate(monitor=mon) == {"r": False}    # breach 1/2
+    assert ev.evaluate(monitor=mon) == {"r": True}     # breach 2/2 -> fire
+    assert ev.firing() == ["r"]
+    mon.write_events([("g/x", 1.0, 2)])
+    assert ev.evaluate(monitor=mon) == {"r": True}     # ok 1/2
+    assert ev.evaluate(monitor=mon) == {"r": False}    # ok 2/2 -> clear
+    assert ev.firing() == []
+    st = ev.states()["r"]
+    assert st["value"] == 1.0 and not st["firing"]
+
+
+def test_slo_evaluator_missing_metric_freezes_state():
+    ev = SloEvaluator([SloRule.parse("g/missing < 5", name="r")])
+    assert ev.evaluate(monitor=InMemoryMonitor()) == {"r": False}
+    # span-quantile rule with no recorded span: also no verdict
+    ev2 = SloEvaluator([SloRule.parse("no.span p99 < 5", name="s")])
+    assert ev2.evaluate(tracer=Tracer(enabled=True)) == {"s": False}
+
+
+def test_slo_span_quantile_rule_fires_from_tracer():
+    t = Tracer(enabled=True)
+    with t.span("slow.section"):
+        import time
+
+        time.sleep(0.02)
+    ev = SloEvaluator([SloRule.parse("slow.section p50 < 0.001",
+                                     name="slow")])
+    assert ev.evaluate(tracer=t) == {"slow": True}
+
+
+# ------------------------------------------------------ program catalog
+
+def test_program_catalog_counts_without_sampling():
+    cat = ProgramCatalog(sample_every=0)
+
+    @jax.jit
+    def f(x):
+        return x @ x
+
+    x = jnp.ones((16, 16))
+    cat.register_call("mm", f, x)
+    assert cat.known("mm")
+    for _ in range(5):
+        assert cat.invoke("mm") is None       # N=0: never sampled
+    row = cat.table()["mm"]
+    assert row["flops"] > 0 and row["invocations"] == 5
+    assert row["flops_total"] == pytest.approx(row["flops"] * 5)
+    assert row["synced_samples"] == 0 and row["device_seconds_est"] == 0.0
+    # no samples anywhere -> no MFU even with a peak stated
+    assert cat.mfu(peak_flops_per_s=1e12) is None
+
+
+def test_program_catalog_sampled_sync_every_nth():
+    cat = ProgramCatalog(sample_every=2)
+    cat.register("p", flops=100.0)
+    stamps = [cat.invoke("p") for _ in range(6)]
+    assert [s is not None for s in stamps] == [False, True] * 3
+    for _ in range(3):
+        cat.record_sync("p", 0.01)
+    row = cat.table()["p"]
+    assert row["synced_samples"] == 3
+    assert row["sampled_mean_s"] == pytest.approx(0.01)
+    assert row["device_seconds_est"] == pytest.approx(0.01 * 6)
+    assert row["achieved_flops_per_s"] == pytest.approx(100.0 / 0.01)
+    # MFU: executed flops / est device seconds / peak
+    assert cat.mfu(peak_flops_per_s=10000.0) == pytest.approx(
+        (100.0 * 6) / (0.01 * 6) / 10000.0)
+    with pytest.raises(ValueError):
+        ProgramCatalog(sample_every=-1)
+
+
+def test_peak_flops_env(monkeypatch):
+    monkeypatch.delenv("DS_TPU_PEAK_TFLOPS", raising=False)
+    assert peak_flops_per_sec() is None
+    monkeypatch.setenv("DS_TPU_PEAK_TFLOPS", "110")
+    assert peak_flops_per_sec() == pytest.approx(110e12)
+    monkeypatch.setenv("DS_TPU_PEAK_TFLOPS", "nope")
+    assert peak_flops_per_sec() is None
+
+
+# ---------------------------------------- device-trace correlation smoke
+
+def test_device_capture_annotates_spans_only_while_active(tmp_path,
+                                                          monkeypatch):
+    """CPU-safe correlation smoke: while a capture is active every
+    trace_span (even with the HOST tracer disabled) enters a
+    TraceAnnotation; after the unit window is spent the hook is gone and
+    real profile files exist under the log dir."""
+    monkeypatch.setattr(dp, "_CAPTURE", None)
+    configure_tracer(enabled=False)
+    with deepspeed_tpu.observability.trace_span("before.capture"):
+        pass
+    cap = dp.capture_device_trace(str(tmp_path / "xla"), n_units=2)
+    assert cap is not None and cap.active and dp.device_capture_active()
+    with deepspeed_tpu.observability.trace_span("serve.decode"):
+        pass
+    assert cap.annotations == 1
+    # host tracer enabled: the full span path annotates too
+    configure_tracer(enabled=True, capacity=64)
+    try:
+        with deepspeed_tpu.observability.trace_span("train.step"):
+            pass
+    finally:
+        configure_tracer(enabled=False)
+        get_tracer().reset()
+    assert cap.annotations == 2
+    dp.device_trace_unit()
+    assert cap.active            # 1 of 2 units spent
+    dp.device_trace_unit()
+    assert not cap.active and not dp.device_capture_active()
+    after = cap.annotations
+    with deepspeed_tpu.observability.trace_span("after.capture"):
+        pass
+    assert cap.annotations == after     # hook detached with the capture
+    walked = [fn for _r, _d, fns in os.walk(str(tmp_path / "xla"))
+              for fn in fns]
+    assert walked, "no profile files written under the capture dir"
+    monkeypatch.setattr(dp, "_CAPTURE", None)
+
+
+def test_device_capture_env_arming(tmp_path, monkeypatch):
+    monkeypatch.setattr(dp, "_CAPTURE", None)
+    monkeypatch.setattr(dp, "_ENV_ARMED", False)
+    monkeypatch.setenv(dp.DEVICE_TRACE_ENV, str(tmp_path / "envtrace"))
+    monkeypatch.setenv(dp.DEVICE_TRACE_UNITS_ENV, "1")
+    cap = dp.maybe_capture_from_env()
+    try:
+        assert cap is not None and cap.active and cap.remaining == 1
+        # once per process: a second engine init must not re-arm
+        assert dp.maybe_capture_from_env() is None
+    finally:
+        dp.stop_device_trace()
+        monkeypatch.setattr(dp, "_CAPTURE", None)
+    # without the env var, arming is a no-op
+    monkeypatch.setattr(dp, "_ENV_ARMED", False)
+    monkeypatch.delenv(dp.DEVICE_TRACE_ENV, raising=False)
+    assert dp.maybe_capture_from_env() is None
+
+
+def test_capture_device_trace_requires_dir(monkeypatch):
+    monkeypatch.setattr(dp, "_CAPTURE", None)
+    monkeypatch.delenv(dp.DEVICE_TRACE_ENV, raising=False)
+    with pytest.raises(ValueError):
+        dp.capture_device_trace()
+    with pytest.raises(ValueError):
+        dp.DeviceTraceCapture("/tmp/x", n_units=0)
+
+
+# ----------------------------------------------------- dump window (env)
+
+def test_dump_window_env_override(monkeypatch):
+    monkeypatch.delenv("DS_TPU_DUMP_WINDOW_S", raising=False)
+    assert dump_window_s() == 60.0
+    monkeypatch.setenv("DS_TPU_DUMP_WINDOW_S", "300")
+    assert dump_window_s() == 300.0
+    monkeypatch.setenv("DS_TPU_DUMP_WINDOW_S", "garbage")
+    assert dump_window_s() == 60.0
+    monkeypatch.setenv("DS_TPU_DUMP_WINDOW_S", "-5")
+    assert dump_window_s() == 60.0
+
+
+# --------------------------------------------- serving-engine integration
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    model = CausalLM("tiny", dtype=jnp.float32, attn_impl="xla")
+    params = model.init_fn(jax.random.PRNGKey(3))
+    return deepspeed_tpu.init_inference(
+        model=model, config={"dtype": "float32"}, params=params)
+
+
+def _prefix_stream(n=9, seed=5, sys_len=17, tail=3):
+    rng = np.random.default_rng(seed)
+    systems = [rng.integers(1, 250, sys_len).astype(np.int32)
+               for _ in range(3)]
+    return [Request(rid=i,
+                    input_ids=np.concatenate(
+                        [systems[i % 3],
+                         rng.integers(1, 250, tail).astype(np.int32)]),
+                    max_new_tokens=4)
+            for i in range(n)]
+
+
+def test_program_stats_cover_full_serving_inventory(tiny_engine):
+    """Acceptance: program_stats() reports nonzero FLOPs and invocation
+    counts for every program in the serving inventory — decode, each
+    prefill bucket, COW, and the tier movers (speculative draft/verify
+    are covered by test_program_stats_cover_speculative_programs)."""
+    mon = InMemoryMonitor()
+    serve = tiny_engine.serving(b_slots=1, page_size=8, max_model_len=40,
+                                num_pages=8, host_tier_pages=16,
+                                monitor=mon)
+    serve.run(_prefix_stream())
+    stats = serve.program_stats()
+    inv = serve.program_inventory()
+    expected = ["decode", "cow", "tier_extract", "tier_inject"] + \
+        [f"prefill_{b}" for b in inv["prefill_buckets"]]
+    for name in expected:
+        assert name in stats, (name, sorted(stats))
+        assert stats[name]["flops"] > 0, name
+        assert stats[name]["invocations"] > 0, name
+    # the shared-prefix stream really exercised COW + both tier movers
+    # beyond their init prewarm
+    assert serve.demotions > 0 and serve.promotions > 0
+    assert stats["tier_extract"]["invocations"] >= 1 + serve.demotions
+    assert stats["tier_inject"]["invocations"] >= 1 + serve.promotions
+    # health mirrors the table; gauges carry the per-program labels
+    assert serve.health()["program_stats"] == stats
+    assert mon.latest("serve/program_flops{program=decode}") == \
+        pytest.approx(stats["decode"]["flops_total"])
+    text = prometheus_text(monitor=mon)
+    assert 'dstpu_serve_program_flops{program="decode"}' in text
+    assert 'dstpu_serve_device_seconds_total{program="cow"}' in text
+
+
+def test_program_stats_cover_speculative_programs(tiny_engine):
+    from deepspeed_tpu.inference.speculative import (SpeculativeConfig,
+                                                     layer_skip_draft)
+
+    model = tiny_engine._model
+    draft_model, draft_params = layer_skip_draft(model, tiny_engine.params,
+                                                 num_layers=1)
+    serve = tiny_engine.serving(
+        b_slots=2, page_size=8, max_model_len=48,
+        speculative=SpeculativeConfig(draft_model=draft_model,
+                                      draft_params=draft_params, k=2))
+    rng = np.random.default_rng(0)
+    serve.run([Request(rid=i,
+                       input_ids=rng.integers(1, 250, 5).astype(np.int32),
+                       max_new_tokens=6) for i in range(3)])
+    stats = serve.program_stats()
+    for name in ("draft_decode", "verify"):
+        assert stats[name]["flops"] > 0 and stats[name]["invocations"] > 0
+    draft_prefills = [k for k in stats if k.startswith("draft_prefill_")]
+    assert draft_prefills
+    # k draft invocations per verify pass
+    assert stats["draft_decode"]["invocations"] == \
+        2 * stats["verify"]["invocations"]
+
+
+def test_program_stats_sampling_measures_serving_device_time(tiny_engine):
+    serve = tiny_engine.serving(b_slots=2, page_size=8, max_model_len=48,
+                                program_stats_sample_every=2)
+    rng = np.random.default_rng(1)
+    serve.run([Request(rid=i,
+                       input_ids=rng.integers(1, 250, 6).astype(np.int32),
+                       max_new_tokens=8) for i in range(4)])
+    row = serve.program_stats()["decode"]
+    assert row["synced_samples"] > 0
+    assert row["device_seconds_est"] > 0
+    assert row["achieved_flops_per_s"] > 0
+
+
+def test_slo_alert_fires_on_live_metrics_scrape(tiny_engine):
+    """Acceptance: an SLO rule driven to violation shows up as
+    dstpu_alert{rule="..."} 1 on a LIVE /metrics scrape and in
+    health()["alerts"]."""
+    mon = InMemoryMonitor()
+    serve = tiny_engine.serving(
+        b_slots=1, page_size=8, max_model_len=48, monitor=mon,
+        slo_rules=[SloRule.parse("serve/queue_depth < 0", name="qd_floor"),
+                   SloRule.parse("serve/queue_depth < 1e9",
+                                 name="qd_sane")])
+    rng = np.random.default_rng(2)
+    serve.run([Request(rid=i,
+                       input_ids=rng.integers(1, 250, 5).astype(np.int32),
+                       max_new_tokens=6) for i in range(4)])
+    # queue_depth >= 0 always: the impossible floor rule is in violation,
+    # the sane ceiling rule is satisfied
+    assert serve.health()["alerts"] == ["qd_floor"]
+    assert serve.slo_states()["qd_floor"]["firing"]
+    srv = start_metrics_server(port=0, monitor=mon)
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics").read().decode()
+    finally:
+        srv.close()
+    assert 'dstpu_alert{rule="qd_floor"} 1' in body
+    assert 'dstpu_alert{rule="qd_sane"} 0' in body
+
+
+# -------------------------------------------- exposition conformance
+
+_SAMPLE_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<labels>[^{}]*)\})? '
+    r'(?P<value>[-+]?(?:[0-9.]+(?:[eE][-+]?[0-9]+)?|[Ii]nf|NaN))$')
+_LABEL_RE = re.compile(
+    r'^[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\\\|\\"|\\n)*"$')
+
+
+def _parse_exposition(text: str):
+    """Minimal exposition-format parser: validates the line grammar and
+    returns (samples, helped, typed) where samples maps metric name ->
+    list of (labels, value)."""
+    samples, helped, typed = {}, set(), {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            helped.add(line.split()[2])
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            assert len(parts) >= 4, line
+            assert parts[3] in ("gauge", "counter", "histogram",
+                                "summary", "untyped"), line
+            typed[parts[2]] = parts[3]
+            continue
+        assert not line.startswith("#"), f"unknown comment: {line}"
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable sample line: {line!r}"
+        labels = []
+        if m.group("labels"):
+            for pair in re.split(r',(?=[a-zA-Z_])', m.group("labels")):
+                assert _LABEL_RE.match(pair), \
+                    f"bad label pair {pair!r} in {line!r}"
+                k, v = pair.split("=", 1)
+                labels.append((k, v[1:-1]))
+        samples.setdefault(m.group("name"), []).append(
+            (tuple(labels), float(m.group("value").replace("Inf", "inf"))))
+    return samples, helped, typed
+
+
+def test_prometheus_exposition_conformance_on_live_scrape():
+    """Satellite: scrape a live MetricsServer carrying weird gauge names,
+    labeled program gauges, span aggregates AND histogram families, and
+    validate every line with a minimal exposition parser."""
+    mon = InMemoryMonitor()
+    mon.write_events([
+        ("serve/queue_depth", 3.0, 1),
+        ("Train/Samples/train_loss", 0.25, 1),
+        ("serve/program_flops{program=pre/fill_16}", 42.0, 1),
+        ('alert{rule=serve.tick p99 < 0.05}', 1.0, 1),
+        ('weird{label=has "quotes" and \\ backslash}', 7.0, 1),
+    ])
+    tracer = Tracer(enabled=True)
+    with tracer.span("serve.tick"):
+        pass
+    srv = start_metrics_server(port=0, monitor=mon, tracer=tracer)
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics").read().decode()
+    finally:
+        srv.close()
+    samples, helped, typed = _parse_exposition(body)
+    # every sample family is typed and helped (histogram child series
+    # belong to their parent family)
+    for name in samples:
+        base = re.sub(r"_(bucket|sum|count)$", "", name) \
+            if name.startswith("dstpu_span_duration_seconds") else name
+        assert base in typed, name
+        assert base in helped, name
+    # the one-place sanitization: / -> _ in names, label survives verbatim
+    assert samples["dstpu_serve_program_flops"] == \
+        [((("program", "pre/fill_16"),), 42.0)]
+    assert typed["dstpu_span_duration_seconds"] == "histogram"
+    buckets = samples["dstpu_span_duration_seconds_bucket"]
+    assert any(dict(lbls).get("le") == "+Inf" for lbls, _v in buckets)
+    # cumulative bucket counts are monotone per span
+    cums = [v for lbls, v in buckets
+            if dict(lbls).get("span") == "serve.tick"]
+    assert cums == sorted(cums)
+    # escaped label values round-trip through the parser
+    (lbls, v), = samples["dstpu_weird"]
+    assert v == 7.0 and dict(lbls)["label"] == \
+        'has \\"quotes\\" and \\\\ backslash'
+    assert ("rule", "serve.tick p99 < 0.05") in \
+        [pair for lbls, _v in samples["dstpu_alert"] for pair in lbls]
+
+
+def test_once_at_init_gauges_survive_ring_rotation():
+    """Once-at-init gauges (mesh topology, pool bytes) must stay on
+    /metrics after per-tick traffic rotates their events out of the
+    bounded ring: latest()/latest_map() are write-maintained, and the
+    exposition reads the map instead of scanning the ring."""
+    mon = InMemoryMonitor(max_events=4)
+    mon.write_events([("init/gauge", 7.0, 0)])
+    mon.write_events([("tick/gauge", float(i), i) for i in range(10)])
+    assert mon.latest("init/gauge") == 7.0
+    assert mon.latest_map()["tick/gauge"] == 9.0
+    text = prometheus_text(monitor=mon, tracer=Tracer(enabled=True))
+    assert "dstpu_init_gauge 7" in text
+    assert "dstpu_tick_gauge 9" in text
+
+
+# ----------------------------------------------------- train-side gauges
+
+def test_train_engine_emits_tflops_and_mfu_gauges(monkeypatch):
+    from deepspeed_tpu.parallel import mesh as mesh_mod
+
+    from .simple_model import SimpleModel, make_config, random_batch
+
+    monkeypatch.setenv("DS_TPU_PEAK_TFLOPS", "0.001")   # tiny fake roof
+    mesh_mod.reset_mesh()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(16), config=make_config(batch_size=16))
+    engine.monitor = InMemoryMonitor()
+    for s in range(3):
+        engine.train_batch(batch=random_batch(16, 16, seed=s))
+    # the compiled step registered its lowered cost once
+    row = engine.program_catalog.table()["train_step"]
+    assert row["flops"] > 0 and row["invocations"] == 3
+    assert engine.monitor.latest("train/tflops_est") > 0
+    assert engine.monitor.latest("train/mfu_est") > 0
+    # without a stated roof, mfu_est reads 0 (never a fake spec number)
+    monkeypatch.delenv("DS_TPU_PEAK_TFLOPS")
+    engine.train_batch(batch=random_batch(16, 16, seed=3))
+    assert engine.monitor.latest("train/mfu_est") == 0.0
+    assert engine.monitor.latest("train/tflops_est") > 0

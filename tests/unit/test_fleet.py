@@ -837,8 +837,47 @@ def test_fleet_gauges_reach_prometheus_exposition(tiny_engine, tmp_path):
                   "dstpu_fleet_failovers_total",
                   "dstpu_fleet_flight_dropped_total",
                   "dstpu_fleet_journal_bytes",
-                  "dstpu_fleet_resumed_tokens_total"):
+                  "dstpu_fleet_resumed_tokens_total",
+                  "dstpu_fleet_alerts_firing"):
         assert gauge in text, gauge
+
+
+def test_fleet_rolls_up_firing_slo_alerts(tiny_engine, tmp_path):
+    """ISSUE 12: members evaluate their SLO rules per working tick and
+    carry firing rule names in the store advertisement; the router rolls
+    the fleet-wide (engine, rule) pairs up into health()["alerts_firing"]
+    and the fleet/alerts_firing gauge."""
+    from deepspeed_tpu.observability import SloRule
+
+    mon = InMemoryMonitor()
+    store = FileCoordinationStore(str(tmp_path / "coord"))
+    # queue_depth >= 0 always, so an impossible "< 0" floor is driven to
+    # violation by any working tick; the sane ceiling never fires
+    rules = lambda: [SloRule.parse("serve/queue_depth < 0", name="qd0"),
+                     SloRule.parse("serve/queue_depth < 1e9", name="qd9")]
+    members = [FleetMember(
+        f"engine{i}",
+        tiny_engine.supervised_serving(monitor=InMemoryMonitor(),
+                                       slo_rules=rules(), **SERVE_KW),
+        store, lease_s=100.0) for i in range(2)]
+    router = FleetRouter(store, members, lease_s=100.0, miss_limit=3,
+                         monitor=mon)
+    router.run(_stream(6, seed=2), max_ticks=500)
+    # the advertisement refresh is rate-limited to lease/3; force a beat
+    # so the store copies reflect the post-run firing state
+    for m in members:
+        m.beat(force=True)
+    # the engines that served work fired the floor rule (queue_depth is 0
+    # after the drain: still >= 0, still violating the impossible floor)...
+    firing = router.health()["alerts_firing"]
+    assert firing and all(rule == "qd0" for _eid, rule in firing)
+    # ...their advertisements carry it...
+    fired_eids = {eid for eid, _rule in firing}
+    for eid in fired_eids:
+        assert store.get(f"fleet/engines/{eid}")["alerts_firing"] == ["qd0"]
+    # ...and the rollup gauge counts the pairs
+    router._write_gauges()
+    assert mon.latest("fleet/alerts_firing") == float(len(firing))
 
 
 # --------------------------------- acceptance: the chaos_soak fleet harness

@@ -981,7 +981,8 @@ def run_mesh_bench(model_name: str = "llama-374m", tp: int = 2,
 def run_serve_bench(model_name: str = "llama-374m", b_slots: int = 8,
                     n_requests: int = 32, seed: int = 0,
                     rate_rps: float = 0.0, page_size: int = 128,
-                    max_model_len: int = 0, trace: str = None) -> dict:
+                    max_model_len: int = 0, trace: str = None,
+                    device_trace: str = None) -> dict:
     import numpy as np
 
     import jax
@@ -1064,6 +1065,24 @@ def run_serve_bench(model_name: str = "llama-374m", b_slots: int = 8,
         write_chrome_trace(trace, metadata={
             "tool": "serve_bench", "model": model_name, "seed": seed,
             "b_slots": b_slots, "n_requests": n_requests})
+
+    # --device_trace: one EXTRA pass under a windowed XLA-profiler capture
+    # (same discipline as --trace: the reported numbers come from the
+    # untraced measured pass above).  While the capture is active every
+    # serve.* span ALSO lands as a TraceAnnotation on the device timeline,
+    # so the TensorBoard Profile tab shows host spans lined up against the
+    # XLA ops they dispatched (docs/OBSERVABILITY.md "Device-time
+    # correlation": tensorboard --logdir <dir>).
+    if device_trace:
+        from deepspeed_tpu.observability import (capture_device_trace,
+                                                 stop_device_trace)
+
+        cap = capture_device_trace(device_trace)
+        try:
+            sup.run(list(stripped))
+        finally:
+            if cap is not None:
+                stop_device_trace()
     lat = [r.latency_s for r in lat_results]
     ttft = [r.ttft_s for r in lat_results]
     serve_tps = total_tokens / serve_dt
@@ -1098,6 +1117,7 @@ def run_serve_bench(model_name: str = "llama-374m", b_slots: int = 8,
             "deadline_expired_total": health["deadline_expired_total"],
             "quarantined_slots_lifetime": health["quarantined_slots_lifetime"],
             "trace_artifact": trace,
+            "device_trace_dir": device_trace,
         },
     }
 
@@ -1171,10 +1191,17 @@ def main(argv=None) -> int:
     ap.add_argument("--trace", default=None, metavar="OUT.json",
                     help="emit a Chrome/Perfetto trace of one extra traced "
                          "pass (the measured pass stays untraced)")
+    ap.add_argument("--device_trace", default=None, metavar="DIR",
+                    help="capture a windowed XLA-profiler device trace of "
+                         "one extra pass into DIR (measured pass stays "
+                         "untraced); view with tensorboard --logdir DIR — "
+                         "serve.* spans appear as TraceAnnotations on the "
+                         "device timeline (docs/OBSERVABILITY.md)")
     args = ap.parse_args(argv)
     if args.tp:
         if args.mode != "engine" or args.workload != "mixed" \
-                or args.trace or args.rate_rps or args.speculative \
+                or args.trace or args.device_trace or args.rate_rps \
+                or args.speculative \
                 or args.kill_engine or args.n_engines != 3 \
                 or args.journal_every_k != 4 or args.n_system is not None:
             ap.error("--tp runs its own sharded-vs-unsharded comparison "
@@ -1212,9 +1239,9 @@ def main(argv=None) -> int:
         if args.workload != "mixed":
             ap.error("--mode fleet runs the mixed stream (prefix reuse is "
                      "per-engine; bench it with --workload prefix)")
-        if args.trace or args.rate_rps:
-            ap.error("--trace/--rate_rps are not supported with --mode "
-                     "fleet (the router owns arrival gating)")
+        if args.trace or args.device_trace or args.rate_rps:
+            ap.error("--trace/--device_trace/--rate_rps are not supported "
+                     "with --mode fleet (the router owns arrival gating)")
         result = run_fleet_bench(
             args.model, n_engines=args.n_engines,
             b_slots=args.b_slots if args.b_slots is not None else 4,
@@ -1235,9 +1262,9 @@ def main(argv=None) -> int:
               and (d["failovers_total"] > 0) == d["killed_engine"])
         return 0 if ok else 1
     if args.workload == "sampled":
-        if args.trace or args.rate_rps:
-            ap.error("--trace/--rate_rps are not supported with "
-                     "--workload sampled")
+        if args.trace or args.device_trace or args.rate_rps:
+            ap.error("--trace/--device_trace/--rate_rps are not supported "
+                     "with --workload sampled")
         result = run_sampled_bench(
             args.model,
             b_slots=args.b_slots if args.b_slots is not None else 8,
@@ -1265,9 +1292,9 @@ def main(argv=None) -> int:
         ap.error("--speculative is a sampled-workload flag "
                  "(--workload sampled)")
     if args.workload == "tiered":
-        if args.trace or args.rate_rps:
-            ap.error("--trace/--rate_rps are not supported with "
-                     "--workload tiered")
+        if args.trace or args.device_trace or args.rate_rps:
+            ap.error("--trace/--device_trace/--rate_rps are not supported "
+                     "with --workload tiered")
         result = run_tiered_bench(
             args.model,
             b_slots=args.b_slots if args.b_slots is not None else 2,
@@ -1292,9 +1319,10 @@ def main(argv=None) -> int:
               and d["promotions_total"] > 0 and d["demotions_total"] > 0)
         return 0 if ok else 1
     if args.workload == "prefix":
-        if args.trace:
-            ap.error("--trace is not supported with --workload prefix "
-                     "(use the mixed workload for a traced pass)")
+        if args.trace or args.device_trace:
+            ap.error("--trace/--device_trace are not supported with "
+                     "--workload prefix (use the mixed workload for a "
+                     "traced pass)")
         if args.rate_rps:
             ap.error("--rate_rps is not supported with --workload prefix "
                      "(the prefix stream arrives all at t=0 so shared-vs-"
@@ -1328,7 +1356,8 @@ def main(argv=None) -> int:
         args.n_requests if args.n_requests is not None else 32,
         args.seed, args.rate_rps,
         args.page_size if args.page_size is not None else 128,
-        args.max_model_len, trace=args.trace)
+        args.max_model_len, trace=args.trace,
+        device_trace=args.device_trace)
     line = json.dumps(result)
     print(line)
     if args.out:

@@ -96,6 +96,59 @@ def validate_trace(doc: dict) -> list:
     return problems
 
 
+def _histogram_slo_phase(prom: str) -> list:
+    """Histogram + SLO coverage over the traced run's span history
+    (ISSUE 12): serve.tick quantiles live and monotone, real histogram
+    families on the exposition, and one SloRule driven to firing and back
+    with its dstpu_alert{rule=...} gauge following."""
+    from deepspeed_tpu.monitor import InMemoryMonitor
+    from deepspeed_tpu.observability import (SloEvaluator, SloRule,
+                                             get_tracer, prometheus_text)
+
+    problems = []
+    tracer = get_tracer()
+    qs = [tracer.span_quantile("serve.tick", q)
+          for q in (0.1, 0.5, 0.9, 0.99)]
+    if any(v is None for v in qs):
+        problems.append("serve.tick duration histogram missing")
+    elif not all(a <= b for a, b in zip(qs, qs[1:])):
+        problems.append(f"serve.tick quantiles not monotone: {qs}")
+    if "dstpu_span_duration_seconds_bucket" not in prom:
+        problems.append("prometheus exposition missing span histograms")
+
+    mon = InMemoryMonitor()
+    ev = SloEvaluator([
+        SloRule.parse("slo/probe_depth < 4", name="probe_depth"),
+        SloRule.parse("serve.tick p99 < 120", name="tick_p99"),
+    ])
+    mon.write_events([("slo/probe_depth", 9.0, 1)])   # violate
+    ev.evaluate(monitor=mon, tracer=tracer)
+    fired = ev.firing()
+    text_fired = prometheus_text(monitor=_with_alerts(mon, ev, 1),
+                                 tracer=tracer)
+    mon.write_events([("slo/probe_depth", 1.0, 2)])   # satisfy
+    ev.evaluate(monitor=mon, tracer=tracer)
+    cleared = ev.firing()
+    text_cleared = prometheus_text(monitor=_with_alerts(mon, ev, 2),
+                                   tracer=tracer)
+    if fired != ["probe_depth"]:
+        problems.append(f"SLO rule did not fire as expected: {fired}")
+    if cleared:
+        problems.append(f"SLO rule did not clear: {cleared}")
+    if 'dstpu_alert{rule="probe_depth"} 1' not in text_fired:
+        problems.append("firing alert gauge missing from exposition")
+    if 'dstpu_alert{rule="probe_depth"} 0' not in text_cleared:
+        problems.append("cleared alert gauge missing from exposition")
+    return problems
+
+
+def _with_alerts(mon, ev, step):
+    """Mirror the serving engine's wiring: firing states ride the monitor
+    as alert{rule=...} gauges so the exposition renders dstpu_alert."""
+    mon.write_events(ev.gauge_events(step))
+    return mon
+
+
 def run_smoke(trace_path: str = None, train_steps: int = 2,
               n_requests: int = 3, seed: int = 0) -> dict:
     import numpy as np
@@ -145,6 +198,12 @@ def run_smoke(trace_path: str = None, train_steps: int = 2,
         timeline_ok = all(
             r.queued_s >= 0 and r.ttft_s >= 0
             and r.decode_ticks == len(r.output_ids) - 1 for r in results)
+
+        # ---- histogram / SLO phase (ISSUE 12): the traced run above fed
+        # per-span duration histograms; check serve.tick quantiles are
+        # live and monotone, exercise one SloRule to firing and back, and
+        # confirm both surfaces reach the Prometheus exposition
+        hist_slo_problems = _histogram_slo_phase(prom)
     finally:
         # restore the untraced default AND drop the history, so an
         # in-process caller (the tier-1 test) leaves no stale global state
@@ -154,6 +213,7 @@ def run_smoke(trace_path: str = None, train_steps: int = 2,
     with open(trace_path) as f:
         doc = json.load(f)
     problems = validate_trace(doc)
+    problems.extend(hist_slo_problems)
     if not timeline_ok:
         problems.append("RequestResult timeline fields inconsistent")
     if "dstpu_span_count" not in prom:
@@ -170,6 +230,7 @@ def run_smoke(trace_path: str = None, train_steps: int = 2,
                               if e.get("ph") == "X"}),
         "requests_served": len(results),
         "disabled_span_ns": round(disabled_ns, 1),
+        "histogram_slo_ok": not hist_slo_problems,
         "problems": problems,
         "ok": not problems,
     }
