@@ -170,6 +170,20 @@ class MeshExecutor:
         # params ride the same auto-TP shardings generate() uses; already-
         # committed trees (InferenceEngine.serving()) pass through
         self.params = place_params(params, mesh)
+        # capture the placed tree's shape so LIVE weight updates
+        # (update_params — hybrid rollout, docs/HYBRID.md) can be pinned to
+        # the exact avals + shardings every program compiled against: a jit
+        # caches on both, so an update committed to the captured placement
+        # is a guaranteed cache hit, never a recompile
+        leaves = jax.tree_util.tree_leaves(self.params)
+        self._param_treedef = jax.tree_util.tree_structure(self.params)
+        self._param_avals = [(tuple(getattr(x, "shape", ())),
+                              str(getattr(x, "dtype", type(x).__name__)))
+                             for x in leaves]
+        self._param_shardings = (
+            jax.tree_util.tree_map(lambda x: x.sharding, self.params)
+            if leaves and all(hasattr(x, "sharding") for x in leaves)
+            else None)
         cache = model.init_paged_cache(self.num_pages, self.page_size,
                                        dtype=dtype)
         self._kv_spec = model.paged_cache_specs()["k"]
@@ -393,6 +407,38 @@ class MeshExecutor:
         self.kpool, self.vpool = self._inject_prog(*args)
         if t0 is not None:
             finish_sample(self.catalog, "tier_inject", self.kpool, t0)
+
+    def update_params(self, params):
+        """Swap the LIVE param tree under every compiled program (hybrid
+        rollout, docs/HYBRID.md).  Params are ordinary program arguments,
+        so the swap itself is free — the work here is making it provably
+        zero-recompile: the incoming tree (typically the training engine's
+        live compute view) is resharded through the same
+        ``place_params``/``auto_tp_specs`` path the original placement
+        used, then committed to the EXACT shardings captured at build time,
+        so the jitted programs see identical avals + shardings and hit
+        their caches.  A tree whose structure or leaf shapes/dtypes differ
+        from the compiled ones is rejected loudly — it would silently
+        recompile every program in the inventory."""
+        placed = place_params(params, self.mesh)
+        treedef = jax.tree_util.tree_structure(placed)
+        if treedef != self._param_treedef:
+            raise ValueError(
+                "update_params: the new param tree's structure differs "
+                f"from the compiled one ({treedef} vs "
+                f"{self._param_treedef}) — every program would recompile")
+        leaves = jax.tree_util.tree_leaves(placed)
+        for i, x in enumerate(leaves):
+            aval = (tuple(getattr(x, "shape", ())),
+                    str(getattr(x, "dtype", type(x).__name__)))
+            if aval != self._param_avals[i]:
+                raise ValueError(
+                    f"update_params: leaf {i} has aval {aval}, compiled "
+                    f"programs expect {self._param_avals[i]} — the swap "
+                    "must be shape/dtype-identical (zero-recompile)")
+        if self._param_shardings is not None:
+            placed = jax.device_put(placed, self._param_shardings)
+        self.params = placed
 
     def lanes(self, temp, top_k, top_p, seeds):
         """Cached device copy of the per-slot lane vectors; the engine

@@ -118,6 +118,12 @@ class HostTier:
         self.page_bytes = int(page_bytes)
         self._buffers: "OrderedDict[object, Tuple[np.ndarray, np.ndarray]]" \
             = OrderedDict()
+        # weight epoch each slab was extracted under (docs/HYBRID.md):
+        # get(epoch=...) refuses a slab from any other epoch, so even a
+        # stranded pre-update slab can never be injected after a live
+        # param update — the engine's flush is the primary mechanism, the
+        # stamp is the proof
+        self._epochs: Dict[object, int] = {}
         self._bytes = 0
 
     def __len__(self) -> int:
@@ -140,22 +146,36 @@ class HostTier:
     def keys(self) -> Iterable:
         return self._buffers.keys()
 
-    def put(self, key, hk: np.ndarray, hv: np.ndarray) -> None:
-        """Store one demoted page (the caller made room first).  A
-        re-demotion of a key replaces the old slab (same content — chain
-        keys are content-derived — so the bytes just re-account)."""
+    def put(self, key, hk: np.ndarray, hv: np.ndarray,
+            epoch: int = 0) -> None:
+        """Store one demoted page (the caller made room first), stamped
+        with the weight ``epoch`` it was extracted under.  A re-demotion
+        of a key replaces the old slab (same content — chain keys are
+        content-derived — so the bytes just re-account)."""
         old = self._buffers.pop(key, None)
         if old is not None:
             self._bytes -= int(old[0].nbytes) + int(old[1].nbytes)
         self._buffers[key] = (hk, hv)
+        self._epochs[key] = int(epoch)
         self._bytes += int(hk.nbytes) + int(hv.nbytes)
 
-    def get(self, key, touch: bool = True
+    def get(self, key, touch: bool = True, epoch: Optional[int] = None
             ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """The slab for ``key`` — or ``None`` when absent, or when
+        ``epoch`` is given and the slab was extracted under a DIFFERENT
+        weight epoch (stale K/V must never be injected; docs/HYBRID.md)."""
         data = self._buffers.get(key)
-        if data is not None and touch:
+        if data is None:
+            return None
+        if epoch is not None and self._epochs.get(key, 0) != int(epoch):
+            return None
+        if touch:
             self._buffers.move_to_end(key)
         return data
+
+    def epoch_of(self, key) -> Optional[int]:
+        """Weight epoch the stored slab was extracted under (None=absent)."""
+        return self._epochs.get(key) if key in self._buffers else None
 
     def touch(self, key) -> None:
         if key in self._buffers:
@@ -163,6 +183,7 @@ class HostTier:
 
     def pop(self, key) -> Optional[Tuple[np.ndarray, np.ndarray]]:
         data = self._buffers.pop(key, None)
+        self._epochs.pop(key, None)
         if data is not None:
             self._bytes -= int(data[0].nbytes) + int(data[1].nbytes)
         return data
@@ -190,6 +211,6 @@ class HostTier:
         # MRU-most surplus, not its LRU-most (order inside the keep is
         # still LRU→MRU, preserving recency here)
         for k, (hk, hv) in items[-free:]:
-            self.put(k, hk, hv)
+            self.put(k, hk, hv, epoch=other._epochs.get(k, 0))
             adopted.append(k)
         return adopted

@@ -47,6 +47,16 @@ never demote (mutable), and demoted entries are skipped as COW donors.
 ``on_drop_host`` (set by the engine) fires whenever a demoted entry is
 removed, so its host buffer can never be stranded.
 
+**Weight epochs** (docs/HYBRID.md): K/V is a pure function of *(tokens,
+params)*, so the moment the serving weights move (hybrid rollout:
+``ServingEngine.update_params``) every cached entry describes activations
+of weights that no longer exist.  Each entry is stamped with the index's
+``epoch`` at publish; :meth:`lookup` treats any entry from another epoch
+as a MISS (never a wrong page), and :meth:`flush` drops the whole index in
+one step when the engine flips epochs.  The flush is the primary
+mechanism; the per-entry stamp is the defense-in-depth proof that a
+pre-update entry can never be served even if one survived.
+
 The index does not own device memory; it hands page ids back to the engine,
 which holds one refcount per live HBM entry (see ``ServingEngine``).
 Entries are LRU-ordered; :meth:`evict` releases the oldest so the engine
@@ -117,6 +127,10 @@ class _Entry:
     prev: int                 # chain key of the preceding prefix
     full: bool
     tier: str = "hbm"         # "hbm" | "host" (demoted; page == -1)
+    # weight epoch the chunk's K/V was computed under (docs/HYBRID.md):
+    # lookup refuses entries from any other epoch — stale K/V is a miss,
+    # never a served page
+    epoch: int = 0
 
 
 class PrefixIndex:
@@ -142,7 +156,12 @@ class PrefixIndex:
         # mid-page-divergence COW candidates, and the O(1) subtree walk
         self._full_children: Dict[int, Set[object]] = {}
         self.evictions = 0
+        self.invalidations = 0    # entries dropped by weight-epoch flushes
         self.demoted = 0          # entries currently on the host tier
+        # current weight epoch (docs/HYBRID.md): the engine advances it on
+        # every live param update; entries publish stamped with it and
+        # lookup refuses any other stamp
+        self.epoch = 0
         # engine hook: fired with the entry key whenever a DEMOTED entry is
         # removed, so the host tier can drop the orphaned buffer in the
         # same step (never strand a slab)
@@ -187,7 +206,11 @@ class PrefixIndex:
             chunk = tup[n:n + ps]
             key = self._chain(h, chunk)
             e = self._entries.get(key)
-            if e is None or not e.full or e.prev != h or e.tokens != chunk:
+            if e is None or not e.full or e.prev != h or e.tokens != chunk \
+                    or e.epoch != self.epoch:
+                # an epoch mismatch is K/V computed under retired weights
+                # (docs/HYBRID.md) — a MISS by contract, exactly like a
+                # hash collision degrading to a miss
                 break
             pages.append(e.page if e.tier == "hbm" else -1)
             keys.append(key)
@@ -202,7 +225,7 @@ class PrefixIndex:
         best_j, best_key, best_page = 0, None, None
         for pk in self._children.get(h, ()):
             e = self._entries.get(pk)
-            if e is None:
+            if e is None or e.epoch != self.epoch:
                 continue
             j = 0
             for a, b in zip(e.tokens, rem):
@@ -213,7 +236,7 @@ class PrefixIndex:
                 best_j, best_key, best_page = j, pk, e.page
         for fk in self._full_children.get(h, ()):
             e = self._entries.get(fk)
-            if e is None or e.tier != "hbm":
+            if e is None or e.tier != "hbm" or e.epoch != self.epoch:
                 continue
             j = 0
             for a, b in zip(e.tokens, rem):
@@ -259,7 +282,8 @@ class PrefixIndex:
             chunk = tup[i * ps:(i + 1) * ps]
             key = self._chain(h, chunk)
             e = self._entries.get(key)
-            if e is not None and e.prev == h and e.tokens == chunk:
+            if e is not None and e.prev == h and e.tokens == chunk \
+                    and e.epoch == self.epoch:
                 if e.tier == "host":
                     # rehydrate: the publisher just recomputed this exact
                     # chunk's K/V into pages[i] — point the entry at it
@@ -273,27 +297,40 @@ class PrefixIndex:
                 self._entries.move_to_end(key)
             else:
                 if e is not None:
-                    # chain-hash collision: replace outright — INCLUDING
+                    # chain-hash collision — or a same-content entry from a
+                    # RETIRED weight epoch: replace outright, INCLUDING
                     # every entry published under the collided key's chain
                     # (deeper full chunks and partial boundary children).
-                    # They describe a DIFFERENT prefix; left reachable, the
-                    # new chain would verify their per-chunk tokens yet map
-                    # K/V computed under the old prefix — the one way a
-                    # collision could serve wrong pages instead of a miss.
+                    # A collision describes a DIFFERENT prefix; left
+                    # reachable, the new chain would verify their per-chunk
+                    # tokens yet map K/V computed under the old prefix — the
+                    # one way a collision could serve wrong pages instead of
+                    # a miss.  A stale epoch is the same hazard from the
+                    # other direction: same tokens, OLD weights.
                     released.extend(self._remove_subtree(key))
                 self._entries[key] = _Entry(page=pages[i], tokens=chunk,
-                                            prev=h, full=True)
+                                            prev=h, full=True,
+                                            epoch=self.epoch)
                 self._full_children.setdefault(h, set()).add(key)
                 newly.append(pages[i])
             h, i = key, i + 1
         part = tup[i * ps:]
         if part:
             pk = ("p", h, part)
-            if pk in self._entries:
+            pe = self._entries.get(pk)
+            if pe is not None and pe.epoch != self.epoch:
+                # stale-epoch boundary page: the publisher recomputed this
+                # partial chunk under the live weights — replace the entry
+                p = self._remove(pk)
+                if p is not None:
+                    released.append(p)
+                pe = None
+            if pe is not None:
                 self._entries.move_to_end(pk)
             else:
                 self._entries[pk] = _Entry(page=pages[i], tokens=part,
-                                           prev=h, full=False)
+                                           prev=h, full=False,
+                                           epoch=self.epoch)
                 self._children.setdefault(h, set()).add(pk)
                 newly.append(pages[i])
         while len(self._entries) > self.max_entries:
@@ -367,15 +404,23 @@ class PrefixIndex:
         are content-derived, so adopted entries re-chain correctly and
         temporarily-orphaned ones behave exactly like eviction orphans.
         Returns the adopted keys (the engine moves their buffers)."""
+        if other.epoch != self.epoch:
+            # a cross-epoch carry would adopt K/V computed under retired
+            # weights (docs/HYBRID.md) — the caller syncs epochs BEFORE
+            # adopting (ServingSupervisor does); a mismatch here means the
+            # donor's entries are stale by contract, so adopt nothing
+            return []
         demoted = [(k, e) for k, e in other._entries.items()
-                   if e.full and e.tier == "host" and k not in self._entries]
+                   if e.full and e.tier == "host" and k not in self._entries
+                   and e.epoch == other.epoch]
         adopted: List[object] = []
         budget = self.max_entries - len(self._entries)
         if budget <= 0:
             return adopted      # full index adopts nothing (lst[-0:] trap)
         for key, e in demoted[-budget:]:           # keep the MRU-most
             self._entries[key] = _Entry(page=-1, tokens=e.tokens,
-                                        prev=e.prev, full=True, tier="host")
+                                        prev=e.prev, full=True, tier="host",
+                                        epoch=self.epoch)
             self._full_children.setdefault(e.prev, set()).add(key)
             self.demoted += 1
             adopted.append(key)
@@ -423,6 +468,24 @@ class PrefixIndex:
                     pages.append(p)
             stack.extend(kids)
         return pages
+
+    def flush(self) -> List[int]:
+        """Drop EVERY entry — the weight-epoch flip (docs/HYBRID.md): all
+        cached K/V describes retired weights the moment the live params
+        move, so the engine flushes the whole index in one step (demoted
+        entries release their host buffers via ``on_drop_host``).  Returns
+        the device pages released (one engine refcount each).  Counted as
+        ``invalidations``, not ``evictions`` — these are correctness
+        invalidations, not capacity pressure."""
+        released: List[int] = []
+        for key in list(self._entries):
+            if key not in self._entries:   # removed as part of a subtree
+                continue
+            self.invalidations += 1
+            p = self._remove(key)
+            if p is not None:
+                released.append(p)
+        return released
 
     def evict(self, n: int = 1) -> List[int]:
         """Drop the ``n`` least-recently-used entries; returns their device

@@ -407,6 +407,19 @@ class ServingEngine:
         self._demoted_hwm = 0         # high-water mark of the demoted ledger
         self._promote_lat_s: Deque[float] = deque(maxlen=2048)
         self._demote_lat_s: Deque[float] = deque(maxlen=2048)
+        # ---- weight epochs (docs/HYBRID.md): the live-weight generation
+        # this engine is serving.  update_params() advances it and flushes
+        # every cached K/V page / prefix entry / host-tier slab (K/V is a
+        # pure function of (tokens, params) — a param update makes all of
+        # it stale).  Pages are stamped at allocation and admission refuses
+        # to map a page from another epoch — the runtime proof that a
+        # post-update prefix lookup can never serve pre-update K/V.
+        self._weight_epoch = 0
+        self.weight_updates = 0       # update_params() calls
+        self.kv_flushed_pages = 0     # HBM prefix pages flushed by updates
+        self.kv_flushed_slabs = 0     # host-tier slabs flushed by updates
+        self._refresh_lat_s: Deque[float] = deque(maxlen=2048)
+        self._page_epoch = np.zeros((self.num_pages,), np.int64)
         self.prefix_hits = 0
         self.prefix_misses = 0
         self.prefix_shared_tokens = 0
@@ -593,10 +606,14 @@ class ServingEngine:
     # ------------------------------------------------- page refcounting
 
     def _alloc_pages(self, n: int) -> List[int]:
-        """Pop ``n`` free pages and take the first reference on each."""
+        """Pop ``n`` free pages and take the first reference on each.
+        Every allocation stamps the page with the current weight epoch —
+        the content about to be written is a function of the LIVE params
+        (docs/HYBRID.md)."""
         pages = [self._free_pages.pop() for _ in range(n)]
         for p in pages:
             self._refcount[p] = 1
+            self._page_epoch[p] = self._weight_epoch
         occupied = (self.num_pages - 1) - len(self._free_pages)
         if occupied > self._pages_hwm:
             self._pages_hwm = occupied
@@ -722,7 +739,7 @@ class ServingEngine:
         with trace_span("serve.demote", page=int(e.page)):
             t0 = time.monotonic()
             hk, hv = self._exec.extract(int(e.page))
-            self._tier.put(key, hk, hv)
+            self._tier.put(key, hk, hv, epoch=self._weight_epoch)
             page = self._prefix.demote(key)
             self._drop_page(page)
             self._demote_lat_s.append(time.monotonic() - t0)
@@ -754,11 +771,16 @@ class ServingEngine:
             if p >= 0:
                 continue
             key = match.keys[i]
-            data = self._tier.get(key)
+            # epoch-gated fetch: a slab extracted under retired weights is
+            # treated exactly like a vanished one (docs/HYBRID.md) — the
+            # entry dies and the caller retries with a smaller match
+            data = self._tier.get(key, epoch=self._weight_epoch)
             if data is None:
-                # the tier evicted this entry between lookup and now; make
-                # sure the index agrees, then let the caller re-look-up
+                # the tier evicted this entry between lookup and now (or
+                # its slab is from another weight epoch); make sure the
+                # index agrees, then let the caller re-look-up
                 self._prefix.evict_key(key)
+                self._tier.discard(key)
                 return False
             with trace_span("serve.promote"):
                 t0 = time.monotonic()
@@ -812,6 +834,131 @@ class ServingEngine:
         if self._prefix.demoted > self._demoted_hwm:
             self._demoted_hwm = self._prefix.demoted
         return len(adopted)
+
+    # ------------------------------------- live weight updates (hybrid)
+
+    @property
+    def weight_epoch(self) -> int:
+        """The live-weight generation this engine is serving
+        (docs/HYBRID.md).  Monotonic; advanced by :meth:`update_params`.
+        Setting it directly (the supervisor's epoch carry, the rollout
+        factory) re-stamps the prefix index so published entries tag
+        correctly."""
+        return self._weight_epoch
+
+    @weight_epoch.setter
+    def weight_epoch(self, value: int) -> None:
+        self._weight_epoch = int(value)
+        if self._prefix is not None:
+            self._prefix.epoch = self._weight_epoch
+
+    def update_params(self, params, draft_params=None,
+                      epoch: Optional[int] = None) -> Dict[str, Any]:
+        """Swap the LIVE weights under every compiled program and advance
+        the **weight epoch** — the train↔serve handoff of the hybrid
+        rollout subsystem (docs/HYBRID.md).
+
+        Params are already program arguments, so the swap is
+        zero-recompile by construction: the tree is resharded through the
+        shared ``place_params``/``auto_tp_specs`` path and committed to
+        the exact shardings the programs compiled against
+        (:meth:`MeshExecutor.update_params`); a structurally different
+        tree is rejected loudly.
+
+        The hard contract is the flush: every paged K/V page the prefix
+        index pins, every COW-donor boundary page, and every demoted
+        host-tier slab describes activations of the OLD weights — all of
+        it is invalidated here (flush), and everything is epoch-stamped
+        (tag) so a stale page could not be served even if one survived.
+        The page-accounting ledger stays balanced through the flip
+        (flushed pages return to the free list; the demoted ledger drops
+        to zero with its slabs).
+
+        Requires no slot in flight (a mid-stream weight change would split
+        one request's output across two weight generations); queued and
+        pending requests are fine — they prefill from scratch under the
+        new epoch.  ``draft_params`` optionally refreshes a speculative
+        draft's weights (stale draft weights only cost acceptance rate,
+        never correctness).  ``epoch`` overrides the new epoch number (the
+        supervisor's restart carry); default is +1.
+
+        Returns the update stats (also mirrored on the ``serve/weight_*``
+        gauges): new epoch, flushed HBM pages / host slabs, the refresh
+        wall time, and the post-flip ``page_accounting()`` verdict."""
+        if self._active.any():
+            raise RuntimeError(
+                f"update_params with {int(self._active.sum())} slot(s) "
+                "in flight: a live stream's K/V would straddle two weight "
+                "epochs — drain or finish the tick loop first "
+                "(RolloutEngine sequences rounds so this cannot happen)")
+        t0 = time.monotonic()
+        with trace_span("serve.weight_update", epoch=self._weight_epoch + 1):
+            # swaps first (each validates BEFORE mutating), flush last, and
+            # the DRAFT before the TARGET: any rejection then leaves a
+            # correct engine — a draft-only partial swap can only cost
+            # acceptance rate, while the target weights, the cache and the
+            # epoch move together or not at all (stale cached K/V can never
+            # coexist with swapped target weights).
+            if draft_params is not None and self._spec is not None:
+                self._spec.update_params(draft_params)
+            self._exec.update_params(params)
+            self.params = self._exec.params
+            flushed_pages, flushed_slabs = self._flush_cached_kv()
+            self.weight_epoch = (int(epoch) if epoch is not None
+                                 else self._weight_epoch + 1)
+        self.weight_updates += 1
+        dt = time.monotonic() - t0
+        self._refresh_lat_s.append(dt)
+        acct = self.page_accounting()
+        if not acct["balanced"]:   # pragma: no cover - defensive
+            raise RuntimeError(
+                f"page accounting unbalanced after weight-epoch flip: "
+                f"{acct} — the flush leaked or double-freed")
+        if self.monitor is not None:
+            self.monitor.write_events([
+                ("serve/weight_epoch", float(self._weight_epoch),
+                 self._tick),
+                ("serve/weight_updates_total", float(self.weight_updates),
+                 self._tick),
+                ("serve/weight_refresh_s", dt, self._tick),
+                ("serve/kv_flushed_pages_total",
+                 float(self.kv_flushed_pages), self._tick),
+            ])
+        log_dist(
+            f"serve: weight epoch -> {self._weight_epoch} "
+            f"({flushed_pages} cached page(s) + {flushed_slabs} host "
+            f"slab(s) flushed, refresh {dt * 1e3:.1f} ms)", ranks=[0])
+        return {"weight_epoch": self._weight_epoch,
+                "flushed_hbm_pages": flushed_pages,
+                "flushed_host_slabs": flushed_slabs,
+                "refresh_s": dt,
+                "balanced": acct["balanced"]}
+
+    def _flush_cached_kv(self) -> tuple:
+        """Release every prefix-cached page and host-tier slab (the
+        weight-epoch flip).  Slots are idle (checked by the caller), so
+        after the flush the only non-free pages are quarantined ones —
+        accounting stays exact."""
+        flushed_pages = flushed_slabs = 0
+        if self._prefix is not None:
+            flushed_slabs = self._prefix.demoted
+            for p in self._prefix.flush():
+                self._drop_page(p)
+                flushed_pages += 1
+        if self._tier is not None and len(self._tier):
+            # every demoted entry's removal dropped its slab via the
+            # on_drop_host hook; anything left is a stranded-slab bug
+            raise RuntimeError(
+                f"host tier holds {len(self._tier)} slab(s) after the "
+                "prefix flush — stranded buffers (ledger torn)")
+        self.kv_flushed_pages += flushed_pages
+        self.kv_flushed_slabs += flushed_slabs
+        return flushed_pages, flushed_slabs
+
+    def refresh_latencies(self) -> List[float]:
+        """Recent ``update_params`` wall times in seconds (bounded window;
+        the rollout bench reads weight-refresh p50/p99 from here)."""
+        return list(self._refresh_lat_s)
 
     def _arrival_abs(self, req: Request) -> float:
         """Absolute arrival stamp: the rebased epoch when the request rode
@@ -1107,6 +1254,22 @@ class ServingEngine:
         S = len(req.input_ids)
         n_shared = match.n_tokens
         pages = shared + private
+        # weight-epoch invariant (docs/HYBRID.md): a mapped shared page (or
+        # COW donor) must carry K/V of the CURRENT weights.  The prefix
+        # index and host tier already refuse stale entries, so this firing
+        # means the flush-or-tag machinery has a hole — fail loudly rather
+        # than emit tokens conditioned on retired weights.
+        suspects = shared + ([match.cow_src]
+                             if match.cow_src is not None else [])
+        stale = [p for p in suspects
+                 if self._page_epoch[p] != self._weight_epoch]
+        if stale:
+            raise RuntimeError(
+                f"weight-epoch invariant violated: request {req.rid!r} "
+                f"would map page(s) {stale} stamped "
+                f"{[int(self._page_epoch[p]) for p in stale]} at weight "
+                f"epoch {self._weight_epoch} — pre-update K/V must never "
+                "be served (docs/HYBRID.md)")
         tail = req.input_ids[n_shared:]
         S_tail = len(tail)   # >= 1: lookup is capped at prompt-1
         s_pad = _bucket(S_tail)
@@ -1592,6 +1755,14 @@ class ServingEngine:
             "demotions_total": self.demotions,
             "promotions_total": self.promotions,
             "demoted_pages_hwm": self._demoted_hwm,
+            # weight epochs (docs/HYBRID.md): the live-weight generation
+            # being served plus the flush counters — a rollout controller
+            # reads these to confirm the train↔serve flip landed and the
+            # stale-KV flush balanced
+            "weight_epoch": self._weight_epoch,
+            "weight_updates_total": self.weight_updates,
+            "kv_flushed_pages_total": self.kv_flushed_pages,
+            "kv_flushed_slabs_total": self.kv_flushed_slabs,
             # sampling / speculative (docs/SERVING.md): non-greedy
             # admissions, and — with a draft configured — the verify-tick
             # economics operators size k from (mean accepted length > 1
@@ -1686,6 +1857,7 @@ class ServingEngine:
             ("serve/cow_copies_total", float(self.cow_copies), self._tick),
             ("serve/sampled_admissions_total",
              float(self.sampled_admissions), self._tick),
+            ("serve/weight_epoch", float(self._weight_epoch), self._tick),
             ("serve/oldest_request_age_s",
              self._oldest_age_s(time.monotonic()), self._tick),
         ])

@@ -123,6 +123,9 @@ class ServingSupervisor:
         self._spec_drafted_base = 0
         self._demotions_base = 0
         self._promotions_base = 0
+        self._weight_updates_base = 0
+        self._kv_flushed_pages_base = 0
+        self._kv_flushed_slabs_base = 0
         self._demoted_hwm_base = 0
         self._pages_hwm_base = 0
         self._quarantined_slots_lifetime = 0
@@ -335,6 +338,9 @@ class ServingSupervisor:
                 / h["spec_verify_slot_ticks_total"], 4)
         h["demotions_total"] += self._demotions_base
         h["promotions_total"] += self._promotions_base
+        h["weight_updates_total"] += self._weight_updates_base
+        h["kv_flushed_pages_total"] += self._kv_flushed_pages_base
+        h["kv_flushed_slabs_total"] += self._kv_flushed_slabs_base
         h["demoted_pages_hwm"] = max(h["demoted_pages_hwm"],
                                      self._demoted_hwm_base)
         h["pages_hwm"] = max(h["pages_hwm"], self._pages_hwm_base)
@@ -462,6 +468,13 @@ class ServingSupervisor:
         # replacement engine reflect reality, not the cold-start floor.
         new = self.engine_factory()
         reused = self._adopt_programs(new, old)
+        # weight-epoch carry (docs/HYBRID.md): a factory whose captured
+        # params predate live update_params() calls would replay under
+        # RETIRED weights — re-publish the dead engine's live view at ITS
+        # epoch (a fresh engine caches nothing, so this is a pure
+        # zero-recompile swap).  Must land BEFORE the host-tier carry:
+        # adopt_demoted refuses a cross-epoch donor.
+        self._carry_weight_epoch(new, old)
         # demoted prefix pages live in HOST buffers — they survive the dead
         # pool (even a consumed one) and carry to the replacement when the
         # fleet shape matches, so promotions keep hitting after a restart
@@ -565,6 +578,9 @@ class ServingSupervisor:
             self._spec_drafted_base += old._spec.drafted_tokens
         self._demotions_base += old.demotions
         self._promotions_base += old.promotions
+        self._weight_updates_base += old.weight_updates
+        self._kv_flushed_pages_base += old.kv_flushed_pages
+        self._kv_flushed_slabs_base += old.kv_flushed_slabs
         self._demoted_hwm_base = max(self._demoted_hwm_base,
                                      old._demoted_hwm)
         self._pages_hwm_base = max(self._pages_hwm_base, old._pages_hwm)
@@ -594,6 +610,8 @@ class ServingSupervisor:
             self._collect(res)
         new = self.engine_factory()
         reused = self._adopt_programs(new, old)
+        # live weights + epoch carry exactly as on a fault restart
+        self._carry_weight_epoch(new, old)
         # planned maintenance keeps the warm host cache too: demoted pages
         # carry exactly as on a fault restart (docs/SERVING.md)
         tier_carried = new.adopt_host_tier(old) if reused else 0
@@ -605,6 +623,17 @@ class ServingSupervisor:
                  f"{'reused' if reused else 'rebuilt'}, "
                  f"{tier_carried} host-tier page(s) carried)", ranks=[0])
         return reused
+
+    @staticmethod
+    def _carry_weight_epoch(new: ServingEngine, old: ServingEngine) -> None:
+        """Replacement engines must serve the SAME weight epoch the dead
+        one did (docs/HYBRID.md): a rollout-style factory already builds at
+        the published params + epoch (no-op here); a plain factory whose
+        closure captured pre-update params gets the dead engine's live view
+        re-published at the dead engine's epoch — replay then decodes under
+        the exact weights the interrupted stream started with."""
+        if old.weight_epoch > new.weight_epoch:
+            new.update_params(old.params, epoch=old.weight_epoch)
 
     @staticmethod
     def _rebase(req: Request, elapsed: float, t0: float) -> Request:

@@ -200,6 +200,18 @@ class SpeculativeDecoder:
         # target's (a layer-skip draft SHARES the target's leaves, so this
         # is a no-op for it; a standalone draft tree gets sharded here)
         self.draft_params = place_params(config.draft_params, mesh)
+        # captured placement for live draft-weight refreshes (hybrid
+        # rollout, docs/HYBRID.md): an update committed to these shardings
+        # keeps identical avals, so draft/verify never recompile
+        _leaves = jax.tree_util.tree_leaves(self.draft_params)
+        self._draft_treedef = jax.tree_util.tree_structure(self.draft_params)
+        self._draft_avals = [(tuple(getattr(x, "shape", ())),
+                              str(getattr(x, "dtype", type(x).__name__)))
+                             for x in _leaves]
+        self._draft_shardings = (
+            jax.tree_util.tree_map(lambda x: x.sharding, self.draft_params)
+            if _leaves and all(hasattr(x, "sharding") for x in _leaves)
+            else None)
         cache = self.draft_model.init_paged_cache(num_pages, page_size,
                                                   dtype=dtype)
         self._kv_spec = self.draft_model.paged_cache_specs()["k"]
@@ -341,6 +353,37 @@ class SpeculativeDecoder:
     def program_inventory(self) -> Dict[str, Any]:
         return {"k": self.k, "draft_decode": 1, "verify": 1,
                 "draft_prefill_buckets": sorted(self._draft_prefill_progs)}
+
+    def update_params(self, draft_params) -> None:
+        """Swap the LIVE draft weights (hybrid rollout, docs/HYBRID.md) —
+        committed to the placement captured at build time so draft/verify
+        stay cache hits.  The draft pool is NOT flushed here: stale draft
+        K/V can only cost acceptance rate, never correctness (the verify
+        pass reads the TARGET pool), and the owning engine's
+        ``update_params`` already flushed every target-side page."""
+        from .execution import place_params
+
+        placed = place_params(draft_params, self._mesh)
+        # same zero-recompile guard as MeshExecutor.update_params: a
+        # structurally different draft tree would silently recompile
+        # draft/prefill/verify on every subsequent tick
+        treedef = jax.tree_util.tree_structure(placed)
+        if treedef != self._draft_treedef:
+            raise ValueError(
+                "update_params: the new draft tree's structure differs "
+                f"from the compiled one ({treedef} vs "
+                f"{self._draft_treedef}) — draft/verify would recompile")
+        for i, x in enumerate(jax.tree_util.tree_leaves(placed)):
+            aval = (tuple(getattr(x, "shape", ())),
+                    str(getattr(x, "dtype", type(x).__name__)))
+            if aval != self._draft_avals[i]:
+                raise ValueError(
+                    f"update_params: draft leaf {i} has aval {aval}, "
+                    f"compiled programs expect {self._draft_avals[i]} — "
+                    "the swap must be shape/dtype-identical")
+        if self._draft_shardings is not None:
+            placed = jax.device_put(placed, self._draft_shardings)
+        self.draft_params = placed
 
     # ----------------------------------------------------------- the tick
 

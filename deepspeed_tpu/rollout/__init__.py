@@ -1,0 +1,16 @@
+"""Hybrid rollout subsystem: RLHF-shaped generation through the paged
+serving engine over LIVE training weights (docs/HYBRID.md).
+
+The reference's third engine is ``DeepSpeedHybridEngine`` (training and
+inference sharing one weight set for DeepSpeed-Chat actors).  This package
+is the TPU-native production form of that workload: a
+:class:`~.engine.RolloutEngine` serves batched, sampled rollouts through
+the continuous-batching :class:`~..inference.serving.ServingEngine` —
+paged KV pool, per-slot RNG lanes, zero-recompile admission, warm-restart
+supervision — reading the training engine's live compute-precision params
+between train steps, with the **weight epoch** contract guaranteeing a
+post-update prefix lookup can never serve pre-update K/V.
+"""
+from .engine import RolloutEngine, RolloutRound
+
+__all__ = ["RolloutEngine", "RolloutRound"]

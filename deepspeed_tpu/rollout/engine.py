@@ -1,0 +1,331 @@
+"""RolloutEngine: the RLHF actor loop over the paged serving engine.
+
+Parity target: the reference's ``DeepSpeedHybridEngine`` actor loop
+(``runtime/hybrid_engine.py:32`` — DeepSpeed-Chat's generate→score→train
+cycle over one shared weight set).  The seed
+:class:`~..runtime.hybrid_engine.DeepSpeedHybridEngine` already hands the
+live training view to sequential ``InferenceEngine.generate()``; this
+module routes the same weights through the **continuous-batching serving
+stack** instead — slot-based decode over the paged KV pool, per-slot RNG
+lanes, prefix caching, warm-restart supervision — so rollout generation
+gets the same throughput, resilience and observability machinery
+production serving has (docs/SERVING.md), while training keeps owning the
+weights.
+
+The three contracts (docs/HYBRID.md):
+
+- **zero-recompile weight handoff** — serving programs take params as
+  arguments, so publishing a train step's weights is
+  :meth:`~..inference.serving.ServingEngine.update_params`: the live tree
+  is resharded through the shared ``place_params``/``auto_tp_specs`` path
+  and committed to the exact shardings the programs compiled against —
+  a cache hit, never a recompile.  The LoRA fuse-once-per-flip cache from
+  the seed hybrid engine is kept: :meth:`publish_weights` reads
+  ``DeepSpeedHybridEngine._generation_params()``, which re-fuses
+  base + A@B·scale only when ``global_steps`` moved.
+- **weight epochs** — a param update makes every cached K/V page, prefix
+  index entry and demoted host-tier slab stale; ``update_params`` flushes
+  them (ledger-balanced) and stamps everything with the new epoch, so a
+  post-update prefix lookup can never serve pre-update K/V.
+- **round resilience** — rollouts run under
+  :class:`~..inference.serving_supervisor.ServingSupervisor`: a kill
+  mid-rollout warm-restarts with the adopted program inventory and
+  replays token-exactly under the same RNG lane AND the same weight epoch
+  (the factory rebuilds from the published params; the supervisor's epoch
+  carry covers every other path).  The round loop itself is resumable, so
+  it rides an :class:`~..elasticity.Supervisor` (or the pod tier's
+  ``PodSupervisor`` rounds) for train-side kills —
+  ``tools/chaos_soak.py --mode hybrid`` is the seeded proof.
+
+Typical actor loop::
+
+    rollout = RolloutEngine(train_engine, b_slots=8, max_model_len=512)
+    for r in range(rounds):
+        round = rollout.run_round(
+            prompts, train_batches=ppo_batches(r),
+            max_new_tokens=128, sampling=SamplingParams(temperature=0.8,
+                                                        seed=r))
+        ppo_batches = score(round.results, round.train_batch)
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..inference.sampling import SamplingParams
+from ..inference.serving import Request, RequestResult, ServingEngine
+from ..inference.serving_supervisor import ServingSupervisor
+from ..observability.trace import trace_span
+from ..utils.logging import log_dist
+
+__all__ = ["RolloutEngine", "RolloutRound"]
+
+Prompts = Union[np.ndarray, Sequence[np.ndarray]]
+Sampling = Union[None, SamplingParams, Sequence[Optional[SamplingParams]]]
+
+
+@dataclasses.dataclass
+class RolloutRound:
+    """One completed actor round: train K steps → publish the weight epoch
+    → collect rollouts → hand back a training batch."""
+    round: int                       # 1-based round index
+    weight_epoch: int                # epoch the rollouts decoded under
+    losses: List[float]              # per-train-step losses (K entries)
+    results: List[RequestResult]     # rollouts, completion order
+    train_batch: Optional[Dict[str, np.ndarray]]  # {"input_ids": [B, S]}
+    rollout_tokens: int              # tokens generated this round
+    rollout_s: float                 # wall time of the collect phase
+    refresh_s: float                 # update_params wall time
+    flushed_pages: int               # stale HBM pages flushed by the flip
+    flushed_slabs: int               # stale host-tier slabs flushed
+
+
+class RolloutEngine:
+    """Batched, sampled rollouts through the paged serving engine over the
+    live training weights.
+
+    ``engine`` is a training :class:`~..runtime.engine.DeepSpeedEngine`
+    (or an existing
+    :class:`~..runtime.hybrid_engine.DeepSpeedHybridEngine` wrapping one —
+    its LoRA fuse cache and sequential ``generate()`` are reused as-is).
+    Remaining kwargs configure the underlying
+    :class:`~..inference.serving.ServingEngine` (``b_slots``,
+    ``page_size``, ``max_model_len``, ``host_tier_pages``, ...); the mesh
+    defaults to the training engine's, so on a pod the rollout programs
+    span the same devices training does.
+    """
+
+    def __init__(self, engine, model: Any = None, monitor=None,
+                 max_restarts: int = 5, rollout_seq_len: Optional[int] = None,
+                 pad_token_id: int = 0, **serving_kwargs):
+        from ..runtime.hybrid_engine import DeepSpeedHybridEngine
+
+        self.hybrid = (engine if isinstance(engine, DeepSpeedHybridEngine)
+                       else DeepSpeedHybridEngine(engine, model=model))
+        self.engine = self.hybrid.engine
+        self.model = self.hybrid._gen_model
+        if not hasattr(self.model, "apply_paged"):
+            raise ValueError(
+                "RolloutEngine needs a model with the paged decode "
+                "contract (apply_paged) — see models.CausalLM")
+        self.monitor = monitor
+        self.rollout_seq_len = (int(rollout_seq_len)
+                                if rollout_seq_len is not None else None)
+        self.pad_token_id = int(pad_token_id)
+        self._serving_kwargs = dict(serving_kwargs)
+        self._serving_kwargs.setdefault("mesh", self.engine.mesh)
+        self._serving_kwargs.setdefault("monitor", monitor)
+        # the weight view rollouts decode under: pinned at the last
+        # publish_weights() so a warm-restart replacement mid-rollout
+        # rebuilds at the SAME epoch even if someone trained in between
+        # (params are immutable jax arrays — pinning is one reference)
+        self._published_params = None
+        self._rid_seq = 0
+        self.rounds_completed = 0
+        self.rollout_tokens = 0
+        self._round_tok_s: Deque[float] = deque(maxlen=256)
+        self._sup = ServingSupervisor(self._build_serving,
+                                      max_restarts=max_restarts,
+                                      monitor=monitor)
+        self._published_params = self._sup.engine.params
+        log_dist(
+            f"rollout engine ready: b_slots={self._sup.engine.b_slots} "
+            f"weight_epoch={self.weight_epoch} "
+            f"(serving the live training view)", ranks=[0])
+
+    # ------------------------------------------------------------ plumbing
+
+    @property
+    def supervisor(self) -> ServingSupervisor:
+        return self._sup
+
+    @property
+    def serving(self) -> ServingEngine:
+        """The live serving incarnation (replaced by warm restarts)."""
+        return self._sup.engine
+
+    @property
+    def weight_epoch(self) -> int:
+        return self._sup.engine.weight_epoch
+
+    def _build_serving(self) -> ServingEngine:
+        """ServingSupervisor factory: a fresh engine over the PUBLISHED
+        weight view at the published epoch — a mid-rollout warm restart
+        replays under the exact weights the interrupted streams started
+        with (docs/HYBRID.md)."""
+        params = self._published_params
+        epoch = 0
+        if params is None:           # first build (supervisor ctor)
+            params = self.hybrid._generation_params()
+        else:
+            epoch = self._sup.engine.weight_epoch
+        eng = ServingEngine(self.model, params, **self._serving_kwargs)
+        if epoch:
+            eng.weight_epoch = epoch
+        return eng
+
+    # ------------------------------------------------------------- publish
+
+    def publish_weights(self) -> Dict[str, Any]:
+        """Flip the serving side to the CURRENT training weights: one
+        zero-recompile param swap + the weight-epoch flush
+        (:meth:`~..inference.serving.ServingEngine.update_params`).  LoRA
+        actors fuse base + adapters once per flip via the hybrid engine's
+        cache — repeated publishes without a train step reuse the fused
+        tree.  Returns the update stats (epoch, flushed pages/slabs,
+        refresh wall time)."""
+        params = self.hybrid._generation_params()
+        with trace_span("rollout.publish", epoch=self.weight_epoch + 1):
+            stats = self._sup.engine.update_params(params)
+        self._published_params = self._sup.engine.params
+        if self.monitor is not None:
+            self.monitor.write_events([
+                ("rollout/weight_epoch", float(stats["weight_epoch"]), 0),
+                ("rollout/refresh_s", stats["refresh_s"], 0),
+                ("rollout/flushed_pages_total",
+                 float(self._sup.health()["kv_flushed_pages_total"]), 0),
+            ])
+        return stats
+
+    # ------------------------------------------------------------- rollout
+
+    def _normalize_prompts(self, prompts: Prompts) -> List[np.ndarray]:
+        if isinstance(prompts, np.ndarray) and prompts.ndim == 2:
+            return [np.asarray(row, np.int32) for row in prompts]
+        return [np.asarray(p, np.int32).reshape(-1) for p in prompts]
+
+    @staticmethod
+    def _normalize_sampling(sampling: Sampling,
+                            n: int) -> List[Optional[SamplingParams]]:
+        if sampling is None or isinstance(sampling, SamplingParams):
+            return [sampling] * n
+        lanes = list(sampling)
+        if len(lanes) != n:
+            raise ValueError(
+                f"sampling: got {len(lanes)} SamplingParams for {n} "
+                "prompt(s) (pass one, one per prompt, or None)")
+        return lanes
+
+    def rollout(self, prompts: Prompts, max_new_tokens: int = 32,
+                sampling: Sampling = None,
+                eos_token_id: Optional[int] = None,
+                max_ticks: Optional[int] = None) -> List[RequestResult]:
+        """Serve one prompt batch through the supervised serving engine at
+        the current weight epoch; returns per-request results in
+        completion order (``rid`` is ``(batch_seq, prompt_index)``).
+        Per-prompt ``sampling`` lanes ride the serving engine's traced
+        per-slot RNG lanes, so the output is token-identical to
+        ``hybrid.generate(prompt, sampling=lane)`` on the same weights —
+        and a mid-rollout warm restart replays token-exactly under the
+        same lane and epoch (docs/HYBRID.md)."""
+        rows = self._normalize_prompts(prompts)
+        lanes = self._normalize_sampling(sampling, len(rows))
+        self._rid_seq += 1
+        reqs = [Request(rid=(self._rid_seq, i), input_ids=ids,
+                        max_new_tokens=int(max_new_tokens),
+                        eos_token_id=eos_token_id, sampling=lanes[i])
+                for i, ids in enumerate(rows)]
+        t0 = time.monotonic()
+        with trace_span("rollout.collect", n=len(reqs),
+                        epoch=self.weight_epoch):
+            results = self._sup.run(reqs, max_ticks=max_ticks)
+        dt = max(time.monotonic() - t0, 1e-9)
+        tokens = sum(len(r.output_ids) for r in results)
+        self.rollout_tokens += tokens
+        self._round_tok_s.append(tokens / dt)
+        if self.monitor is not None:
+            self.monitor.write_events([
+                ("rollout/tokens_total", float(self.rollout_tokens), 0),
+                ("rollout/tokens_per_sec", tokens / dt, 0),
+            ])
+        return results
+
+    # --------------------------------------------------------- round loop
+
+    def run_round(self, prompts: Prompts, train_batches: Sequence = (),
+                  max_new_tokens: int = 32, sampling: Sampling = None,
+                  eos_token_id: Optional[int] = None,
+                  max_ticks: Optional[int] = None,
+                  build_train_batch: bool = True) -> RolloutRound:
+        """One actor round: train K steps on ``train_batches`` → publish
+        the new weight epoch → admit the prompt batch with its sampling
+        lanes → collect rollouts → hand back a fixed-shape training batch
+        (``{"input_ids": [B, S]}``, prompt + rollout right-padded) the
+        caller scores and feeds into the next round's ``train_batches``.
+
+        The loop is restart-friendly by construction: each phase is
+        idempotent from the outside (a supervisor retrying a raised round
+        re-runs only the phase that failed — ``train_batch`` mutates state
+        only on success, ``publish_weights`` is a pure flip, and the
+        serving supervisor already replays interrupted rollouts
+        internally)."""
+        idx = self.rounds_completed + 1
+        with trace_span("rollout.round", round=idx):
+            losses: List[float] = []
+            if train_batches:
+                with trace_span("rollout.train", steps=len(train_batches)):
+                    for b in train_batches:
+                        losses.append(float(self.hybrid.train_batch(batch=b)))
+            pub = self.publish_weights()
+            t0 = time.monotonic()
+            results = self.rollout(prompts, max_new_tokens=max_new_tokens,
+                                   sampling=sampling,
+                                   eos_token_id=eos_token_id,
+                                   max_ticks=max_ticks)
+            rollout_s = time.monotonic() - t0
+            batch = (self.training_batch(results)
+                     if build_train_batch else None)
+        self.rounds_completed += 1
+        if self.monitor is not None:
+            self.monitor.write_events([
+                ("rollout/rounds_total", float(self.rounds_completed), 0)])
+        return RolloutRound(
+            round=idx, weight_epoch=pub["weight_epoch"], losses=losses,
+            results=results, train_batch=batch,
+            rollout_tokens=sum(len(r.output_ids) for r in results),
+            rollout_s=rollout_s, refresh_s=pub["refresh_s"],
+            flushed_pages=pub["flushed_hbm_pages"],
+            flushed_slabs=pub["flushed_host_slabs"])
+
+    def training_batch(self, results: Sequence[RequestResult],
+                       seq_len: Optional[int] = None
+                       ) -> Dict[str, np.ndarray]:
+        """Assemble rollouts into one fixed-shape training batch:
+        ``input_ids [B, S]`` int32, row ``i`` = prompt ``i`` + its
+        generated tokens, right-padded with ``pad_token_id`` (truncated at
+        ``S``).  ``S`` defaults to ``rollout_seq_len`` (ctor) or the
+        longest row — pin ``rollout_seq_len`` in production so the train
+        step never sees a new shape across rounds."""
+        rows = sorted(results, key=lambda r: r.rid)
+        seqs = [np.concatenate([np.asarray(r.input_ids, np.int32),
+                                np.asarray(r.output_ids, np.int32)])
+                for r in rows]
+        S = int(seq_len or self.rollout_seq_len
+                or max(len(s) for s in seqs))
+        batch = np.full((len(seqs), S), self.pad_token_id, np.int32)
+        for i, s in enumerate(seqs):
+            batch[i, :min(len(s), S)] = s[:S]
+        return {"input_ids": batch}
+
+    # ------------------------------------------------------------- health
+
+    def health(self) -> Dict[str, Any]:
+        """Serving health (through the supervisor, cumulative across warm
+        restarts) plus the rollout-loop counters."""
+        h = self._sup.health()
+        lat = sorted(self.serving.refresh_latencies())
+        h["rollout_rounds_total"] = self.rounds_completed
+        h["rollout_tokens_total"] = self.rollout_tokens
+        h["rollout_tokens_per_sec_last"] = (round(self._round_tok_s[-1], 2)
+                                            if self._round_tok_s else 0.0)
+        h["rollout_refresh_p50_s"] = (lat[len(lat) // 2] if lat else 0.0)
+        return h
+
+    def drain(self, max_ticks: Optional[int] = None) -> List[Request]:
+        """Hand back any unserved rollout requests (see
+        :meth:`~..inference.serving_supervisor.ServingSupervisor.drain`)."""
+        return self._sup.drain(max_ticks=max_ticks)

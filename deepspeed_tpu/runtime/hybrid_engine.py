@@ -56,9 +56,15 @@ class DeepSpeedHybridEngine:
         self._fuse_jit = None
         self._fused_params = None
         self._fused_at_step = None
+        # compute_dtype may be a dtype CLASS (jnp.bfloat16) or a dtype
+        # INSTANCE (np.dtype("bfloat16")) — `.__name__` only exists on the
+        # class and crashed on instances; jnp.dtype() normalizes both
+        import jax.numpy as jnp
+
+        dtype_name = jnp.dtype(engine.compute_dtype).name
         cfg = inference_config or DeepSpeedInferenceConfig(
-            dtype="bf16" if str(engine.compute_dtype.__name__) == "bfloat16"
-            else "fp32")
+            dtype={"bfloat16": "bf16", "float16": "fp16"}.get(dtype_name,
+                                                              "fp32"))
         # params=None: generation always reads the LIVE training view
         self._infer = InferenceEngine(self._gen_model, config=cfg, params=None,
                                       apply_fn=self._gen_model.apply_fn,
@@ -100,6 +106,19 @@ class DeepSpeedHybridEngine:
         self._generate_time += time.perf_counter() - t0
         self._generate_calls += 1
         return out
+
+    # -- batched rollouts through the serving stack (docs/HYBRID.md) --
+    def rollout_engine(self, **kwargs):
+        """A :class:`~..rollout.RolloutEngine` sharing this hybrid
+        engine's live weights, LoRA fuse cache and model: rollouts run
+        through the continuous-batching paged serving engine (per-slot
+        sampling lanes, warm-restart supervision, weight-epoch KV
+        invalidation) instead of sequential :meth:`generate` — the
+        production RLHF actor path.  Kwargs configure the underlying
+        ``ServingEngine`` (``b_slots``, ``max_model_len``, ...)."""
+        from ..rollout import RolloutEngine
+
+        return RolloutEngine(self, **kwargs)
 
     # -- training passthrough --
     def train_batch(self, *args, **kwargs):

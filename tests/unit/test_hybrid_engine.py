@@ -83,6 +83,28 @@ def test_requires_kv_cache_model():
         DeepSpeedHybridEngine(engine)
 
 
+def test_dtype_instance_does_not_crash_and_normalizes():
+    """ISSUE 13 satellite regression: ``compute_dtype`` may be a dtype
+    INSTANCE (np.dtype("bfloat16")) rather than the jnp class — the old
+    ``compute_dtype.__name__`` derivation crashed on it.  Both spellings
+    must normalize via jnp.dtype(...).name, and float16 must map to fp16
+    instead of silently falling into fp32."""
+    import numpy as np
+
+    engine, _ = _engine()
+    # class spelling (the historical path): bf16
+    assert engine.compute_dtype is jnp.bfloat16
+    assert DeepSpeedHybridEngine(engine)._infer._config.dtype == "bf16"
+    # instance spellings: np.dtype objects for bf16 / fp16 / fp32
+    engine.compute_dtype = np.dtype("bfloat16")
+    assert DeepSpeedHybridEngine(engine)._infer._config.dtype == "bf16"
+    engine.compute_dtype = np.dtype("float16")
+    assert DeepSpeedHybridEngine(engine)._infer._config.dtype == "fp16"
+    engine.compute_dtype = np.dtype("float32")
+    assert DeepSpeedHybridEngine(engine)._infer._config.dtype == "fp32"
+    engine.compute_dtype = jnp.bfloat16   # restore the class spelling
+
+
 def test_eval_train_mode_flips_are_noops():
     engine, _ = _engine()
     hybrid = DeepSpeedHybridEngine(engine)

@@ -885,17 +885,233 @@ def run_pod_soak(seed: int, total_steps: int = 12, ckpt_every: int = 2,
     return stats
 
 
+def run_hybrid_soak(seed: int, rounds: int = 3, steps_per_round: int = 2,
+                    n_prompts: int = 5, max_new: int = 6,
+                    verbose: bool = True) -> dict:
+    """One hybrid train+rollout session under a seeded kill schedule
+    (ISSUE 13; docs/HYBRID.md).
+
+    The actor loop (train K steps → publish the weight epoch → rollout a
+    mixed greedy/sampled prompt batch) runs under BOTH supervision tiers:
+    mid-rollout kills (``serve.decode`` / ``serve.prefill`` /
+    ``serve.replay``) are absorbed by the :class:`ServingSupervisor`
+    inside :class:`RolloutEngine` (warm restart, adopted program
+    inventory, token-exact replay under the same lane + epoch), while
+    mid-train-step kills (``train.step`` — fired BEFORE the optimizer
+    mutates state) escape the round and are retried by an
+    ``elasticity.Supervisor`` driving a RESUMABLE round loop (completed
+    substeps are skipped, so a retry re-executes exactly the killed
+    step — the same shape a ``PodSupervisor`` round gives the loop on a
+    real pod).
+
+    Invariants asserted against a fault-free reference run of the same
+    seeded schedule:
+
+    - **loss continuity**: every executed train step's loss equals the
+      reference's for that (round, step) — no step lost, re-run on
+      mutated state, or double-applied;
+    - **rollout replay parity**: every rollout of every round is
+      token-identical to the reference (greedy and sampled lanes — the
+      counter-based keys make replays and restarts exact);
+    - **the pool invariant**: page accounting balances after the session
+      (and update_params re-checks it at every epoch flip);
+    - **the epoch ladder**: one weight epoch per round, on the ladder the
+      reference climbed.
+    """
+    import numpy as np
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.elasticity import Supervisor
+    from deepspeed_tpu.inference.sampling import SamplingParams
+    from deepspeed_tpu.models import CausalLM
+    from deepspeed_tpu.parallel import mesh as mesh_mod
+    from deepspeed_tpu.resilience import (FaultInjector, clear_injector,
+                                          install_injector)
+    from deepspeed_tpu.resilience.fault_injection import (
+        SITE_SERVE_DECODE, SITE_SERVE_PREFILL, SITE_SERVE_REPLAY,
+        SITE_TRAIN_STEP)
+    from deepspeed_tpu.rollout import RolloutEngine
+
+    rng = Random(seed)
+    nprng = np.random.default_rng(seed)
+    cfg = {
+        "train_micro_batch_size_per_gpu": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 2},
+    }
+
+    def build():
+        mesh_mod.reset_mesh()
+        model = CausalLM("tiny", dtype=jnp.float32, attn_impl="xla",
+                         max_seq_len=64)
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+        return engine, RolloutEngine(engine, b_slots=3, page_size=8,
+                                     max_model_len=64, max_restarts=12)
+
+    # one deterministic schedule both runs replay: per-round train batches,
+    # prompt batches, and a mixed greedy/sampled lane assignment
+    prompts = [[nprng.integers(1, 256, int(nprng.integers(4, 12)))
+                .astype(np.int32) for _ in range(n_prompts)]
+               for _ in range(rounds)]
+    lanes = [[(SamplingParams(temperature=0.9, top_k=25,
+                              seed=100 * r + i) if i % 3 == 1 else
+               SamplingParams(temperature=1.1, top_p=0.9,
+                              seed=200 * r + i) if i % 3 == 2 else None)
+              for i in range(n_prompts)]
+             for r in range(rounds)]
+
+    def drive(ro, on_loss, on_rollout, progress):
+        """The resumable round loop (completed substeps are skipped)."""
+        while progress["round"] < rounds:
+            r = progress["round"]
+            while progress["step"] < steps_per_round:
+                k = progress["step"]
+                loss = float(ro.hybrid.train_batch(batch=batches[r][k]))
+                on_loss(r, k, loss)
+                progress["step"] += 1
+            if not progress["published"]:
+                ro.publish_weights()
+                progress["published"] = True
+            results = ro.rollout(prompts[r], max_new_tokens=max_new,
+                                 sampling=lanes[r], max_ticks=8000)
+            on_rollout(r, results)
+            progress["round"] += 1
+            progress["step"] = 0
+            progress["published"] = False
+
+    # ---- fault-free reference (no injector installed yet)
+    _, ref_ro = build()
+    bs = ref_ro.engine.train_batch_size
+    batches = [[{"input_ids": nprng.integers(
+        0, 256, (bs, 16)).astype(np.int32)} for _ in range(steps_per_round)]
+        for _ in range(rounds)]
+    ref_losses: dict = {}
+    ref_rollouts: dict = {}
+    drive(ref_ro,
+          lambda r, k, loss: ref_losses.__setitem__((r, k), loss),
+          lambda r, res: ref_rollouts.__setitem__(
+              r, {x.rid[1]: x.output_ids for x in res}),
+          {"round": 0, "step": 0, "published": False})
+    assert ref_ro.weight_epoch == rounds
+
+    # ---- chaos run
+    _, ro = build()
+    total_steps = rounds * steps_per_round
+    inj = FaultInjector()
+    # at least one decode kill early in a rollout, maybe more later
+    inj.add(site=SITE_SERVE_DECODE, kind="raise", at_call=rng.randint(2, 6))
+    for _ in range(rng.randint(0, 2)):
+        inj.add(site=SITE_SERVE_DECODE, kind="raise",
+                at_call=rng.randint(6, rounds * n_prompts * max_new))
+    # at least one mid-train-step kill (train.step fires before the
+    # optimizer mutates state, so the retry is loss-continuous)
+    inj.add(site=SITE_TRAIN_STEP, kind="raise",
+            at_call=rng.randint(2, total_steps))
+    if rng.random() < 0.5:
+        inj.add(site=SITE_SERVE_PREFILL, kind="raise",
+                at_call=rng.randint(1, rounds * n_prompts))
+    if rng.random() < 0.3:
+        inj.add(site=SITE_SERVE_REPLAY, kind="raise", at_call=1)
+    install_injector(inj)
+
+    losses: dict = {}
+    rollouts: dict = {}
+    progress = {"round": 0, "step": 0, "published": False}
+
+    def record_loss(r, k, loss):
+        assert (r, k) not in losses, \
+            f"hybrid soak seed={seed}: step ({r},{k}) applied twice"
+        losses[(r, k)] = loss
+
+    def attempt(_):
+        drive(ro, record_loss,
+              lambda r, res: rollouts.__setitem__(
+                  r, {x.rid[1]: x.output_ids for x in res}),
+              progress)
+        return 0
+
+    sup = Supervisor(
+        attempt, max_restarts=12, backoff_s=0,
+        progress_fn=lambda: (progress["round"] * (steps_per_round + 1)
+                             + progress["step"]),
+        zero_progress_limit=6, seed=seed)
+    rc = sup.run()
+    clear_injector()
+    assert rc == 0, f"hybrid soak seed={seed}: supervisor exited rc={rc} " \
+                    f"(diagnosis: {sup.diagnosis})"
+
+    # invariant: loss continuity — every executed step matches the
+    # reference exactly (same program, same state, same batch)
+    assert sorted(losses) == sorted(ref_losses), \
+        f"hybrid soak seed={seed}: steps lost/extra: " \
+        f"{sorted(set(ref_losses) ^ set(losses))}"
+    for key, loss in losses.items():
+        assert abs(loss - ref_losses[key]) < 1e-5, \
+            f"hybrid soak seed={seed}: loss continuity broken at {key}: " \
+            f"{loss} != {ref_losses[key]}"
+    # invariant: rollout replay parity, every round, token-exact
+    parity_checked = 0
+    for r in range(rounds):
+        assert sorted(rollouts[r]) == sorted(ref_rollouts[r]), \
+            f"hybrid soak seed={seed}: round {r} lost rollouts"
+        for i, out in rollouts[r].items():
+            assert np.array_equal(out, ref_rollouts[r][i]), \
+                f"hybrid soak seed={seed}: rollout ({r},{i}) diverged " \
+                "after replay"
+            parity_checked += 1
+    # invariant: the pool + demoted ledgers balance, the epoch ladder
+    # matches the reference's (one epoch per round — train-step retries
+    # must not double-publish)
+    acct = ro.serving.page_accounting()
+    assert acct["balanced"], \
+        f"hybrid soak seed={seed}: page accounting broken: {acct}"
+    assert ro.weight_epoch == rounds, \
+        f"hybrid soak seed={seed}: weight epoch {ro.weight_epoch} != " \
+        f"{rounds} (double publish?)"
+    train_kills = sum(1 for e in inj.log if e["site"] == "train.step")
+    stats = {
+        "seed": seed,
+        "rounds": rounds,
+        "faults_fired": len(inj.log),
+        "fault_log": inj.log,
+        "train_kills": train_kills,
+        "outer_restart_rounds": train_kills,   # each escaped to Supervisor
+        "serve_restarts": ro.supervisor.restarts,
+        "weight_epoch": ro.weight_epoch,
+        "train_steps_total": total_steps,
+        "losses_checked": len(losses),
+        "rollouts_total": rounds * n_prompts,
+        "parity_checked": parity_checked,
+        "balanced": acct["balanced"],
+    }
+    if verbose:
+        print(f"  seed={seed}: OK — {stats['faults_fired']} fault(s) fired "
+              f"({train_kills} mid-train), {stats['serve_restarts']} serving "
+              f"restart(s), {parity_checked} rollout(s) parity-checked, "
+              f"epoch {ro.weight_epoch}")
+    return stats
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="randomized fault-injection soak for the resilience "
                     "subsystem")
-    ap.add_argument("--mode", choices=("train", "serve", "pod", "fleet"),
+    ap.add_argument("--mode",
+                    choices=("train", "serve", "pod", "fleet", "hybrid"),
                     default="train",
                     help="train: supervised elastic rounds; serve: "
                          "ServingSupervisor kill/replay soak; pod: "
                          "simulated multi-host kill + shrink-to-healthy "
                          "re-formation; fleet: serving-fleet engine + "
-                         "coordinator kills with store-lease failover")
+                         "coordinator kills with store-lease failover; "
+                         "hybrid: train+rollout rounds with mid-train-step "
+                         "AND mid-rollout kills (loss continuity + rollout "
+                         "replay parity + pool invariant, docs/HYBRID.md)")
     ap.add_argument("--soaks", type=int, default=3,
                     help="number of supervised sessions to soak")
     ap.add_argument("--total-steps", type=int, default=8)
@@ -916,6 +1132,10 @@ def main(argv=None) -> int:
                          "(small = pool pressure)")
     ap.add_argument("--hosts", type=int, default=4,
                     help="pod mode: simulated hosts per soak")
+    ap.add_argument("--rounds", type=int, default=3,
+                    help="hybrid mode: train+rollout rounds per soak")
+    ap.add_argument("--steps-per-round", type=int, default=2,
+                    help="hybrid mode: train steps per round")
     ap.add_argument("--seed", type=int, default=0,
                     help="base seed; soak i uses seed+i")
     ap.add_argument("--keep-dirs", action="store_true",
@@ -947,6 +1167,18 @@ def main(argv=None) -> int:
             # broad catch by design: RestartBudgetExhausted / ServeTimeout /
             # an escaped InjectedFault ARE the per-seed failure signal this
             # driver exists to tally — one bad seed must not kill the rest
+            except Exception as e:
+                failures += 1
+                print(f"  FAILED ({type(e).__name__}): {e}", file=sys.stderr)
+            continue
+        if args.mode == "hybrid":
+            print(f"hybrid soak {i + 1}/{args.soaks} (seed={seed}, "
+                  f"rounds={args.rounds}x{args.steps_per_round})")
+            try:
+                run_hybrid_soak(seed, rounds=args.rounds,
+                                steps_per_round=args.steps_per_round,
+                                n_prompts=args.requests
+                                if args.requests != 8 else 5)
             except Exception as e:
                 failures += 1
                 print(f"  FAILED ({type(e).__name__}): {e}", file=sys.stderr)

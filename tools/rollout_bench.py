@@ -1,0 +1,234 @@
+#!/usr/bin/env python
+"""Rollout benchmark: batched rollouts through the paged serving engine
+over LIVE training weights vs sequential ``hybrid.generate()``.
+
+The hybrid rollout subsystem (``deepspeed_tpu/rollout``, docs/HYBRID.md)
+claims three measurable things; this bench gates all of them on one
+seeded train+rollout session:
+
+- **throughput**: rounds of (train K steps → publish the weight epoch →
+  rollout a mixed greedy/sampled prompt batch) through the
+  continuous-batching :class:`ServingEngine` vs the seed hybrid engine's
+  sequential per-prompt ``generate()`` on the same weights — the speedup
+  is the whole point of routing RLHF generation through the serving
+  stack;
+- **weight-refresh latency**: p50/p99 wall time of
+  ``ServingEngine.update_params`` (the zero-recompile param swap + the
+  stale-KV epoch flush) — the per-round tax of the train↔serve handoff;
+- **correctness gates**: every rollout token-identical to
+  ``generate(sampling=lane)`` on that round's weights (greedy AND
+  sampled), 0 XLA compiles across the measured rounds (the zero-recompile
+  contract holds THROUGH live weight updates), and a bit-identical
+  ``program_inventory()`` at the end.
+
+Emits one BENCH_ROLLOUT JSON line::
+
+    {"metric": "rollout-throughput", "value": <tok/s>, "unit": ...,
+     "vs_sequential": <speedup>, "detail": {...}}
+
+CPU runs the shared tiny-model regime (scheduler-honest, numbers are
+CPU-relative); TPU runs the named config in bf16.  The seeded CPU
+reference artifact is ``tools/artifacts/rollout_r15.json``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _pct(xs, q):
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(round(q * (len(xs) - 1))))]
+
+
+def run_rollout_bench(model_name: str = "llama-374m", rounds: int = 3,
+                      steps_per_round: int = 2, n_prompts: int = 12,
+                      max_new: int = 16, b_slots: int = 4,
+                      seed: int = 0) -> dict:
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.inference.sampling import SamplingParams
+    from deepspeed_tpu.models import CausalLM
+    from deepspeed_tpu.rollout import RolloutEngine
+    from deepspeed_tpu.utils.compile_counter import compile_counter
+
+    on_tpu = jax.devices()[0].platform not in ("cpu",)
+    if not on_tpu:
+        # the shared CPU bench regime (serve_bench._CPU_BENCH_OVERRIDES):
+        # big enough that per-token math is real work, small enough that a
+        # training step is CPU-affordable
+        model_name = "rollout(cpu)"
+        model = CausalLM("tiny", dtype=jnp.float32, attn_impl="xla",
+                         max_seq_len=128, hidden_size=256,
+                         intermediate_size=512, num_layers=4, num_heads=8,
+                         vocab_size=2048)
+        micro, train_seq = 2, 32
+        precision_cfg = {}
+    else:
+        model = CausalLM(model_name, dtype=jnp.bfloat16, attn_impl="auto",
+                         max_seq_len=2048)
+        micro, train_seq = 4, 512
+        precision_cfg = {"bf16": {"enabled": True}}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": micro,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2},
+        **precision_cfg,
+    })
+    vocab = model.config.vocab_size
+    max_model_len = 64 if not on_tpu else 1024
+    page_size = 16 if not on_tpu else 128
+    ro = RolloutEngine(engine, b_slots=b_slots, page_size=page_size,
+                       max_model_len=max_model_len,
+                       rollout_seq_len=48 if not on_tpu else 1024)
+
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(1, vocab, int(rng.integers(8, 25)))
+               .astype(np.int32) for _ in range(n_prompts)]
+    lanes = [(SamplingParams(temperature=0.9, top_k=40, seed=7 * i)
+              if i % 3 == 1 else
+              SamplingParams(temperature=1.1, top_p=0.9, seed=11 * i)
+              if i % 3 == 2 else None) for i in range(n_prompts)]
+
+    def batches(r):
+        return [{"input_ids": np.random.default_rng(1000 + 10 * r + k)
+                 .integers(0, vocab, (engine.train_batch_size, train_seq))
+                 .astype(np.int32)} for k in range(steps_per_round)]
+
+    def sequential_pass():
+        """The seed hybrid path: one generate() per prompt, same lanes —
+        token streams returned per prompt index for the parity gate."""
+        outs = {}
+        for i, p in enumerate(prompts):
+            sp = lanes[i] or SamplingParams()
+            outs[i] = np.asarray(ro.hybrid.generate(
+                p[None], max_new_tokens=max_new,
+                sampling=sp))[0, len(p):]
+        return outs
+
+    count = compile_counter()
+
+    # ---- warm round: serving buckets, the train-step program, the
+    # sequential oracle's lane programs — every compile lands here
+    ro.run_round(prompts, train_batches=batches(-1), max_new_tokens=max_new,
+                 sampling=lanes, max_ticks=50_000)
+    sequential_pass()
+    inventory = ro.serving.program_inventory()
+
+    # ---- measured rounds: train -> publish -> rollout (timed) then the
+    # sequential baseline on the SAME weights (timed + parity oracle)
+    base_compiles = count()
+    rollout_s, seq_s, refresh_s, train_s = [], [], [], []
+    tokens_round = []
+    parity = True
+    epochs = []
+    for r in range(rounds):
+        t0 = time.perf_counter()
+        for b in batches(r):
+            ro.hybrid.train_batch(batch=b)
+        train_s.append(time.perf_counter() - t0)
+        pub = ro.publish_weights()
+        refresh_s.append(pub["refresh_s"])
+        epochs.append(pub["weight_epoch"])
+        t0 = time.perf_counter()
+        results = ro.rollout(prompts, max_new_tokens=max_new,
+                             sampling=lanes, max_ticks=50_000)
+        rollout_s.append(time.perf_counter() - t0)
+        tokens_round.append(sum(len(x.output_ids) for x in results))
+        t0 = time.perf_counter()
+        seq_outs = sequential_pass()
+        seq_s.append(time.perf_counter() - t0)
+        for res in results:
+            if not np.array_equal(res.output_ids, seq_outs[res.rid[1]]):
+                parity = False
+    measured_compiles = count() - base_compiles
+
+    total_tokens = sum(tokens_round)
+    roll_tps = total_tokens / sum(rollout_s)
+    seq_tps = total_tokens / sum(seq_s)
+    h = ro.health()
+    inventory_stable = ro.serving.program_inventory() == inventory
+    acct = ro.serving.page_accounting()
+    result = {
+        "metric": "rollout-throughput",
+        "value": round(roll_tps, 1),
+        "unit": "tokens/sec",
+        "vs_sequential": round(roll_tps / seq_tps, 3),
+        "detail": {
+            "model": model_name,
+            "platform": jax.devices()[0].platform,
+            "seed": seed,
+            "rounds_measured": rounds,
+            "steps_per_round": steps_per_round,
+            "n_prompts": n_prompts,
+            "max_new_tokens": max_new,
+            "b_slots": b_slots,
+            "page_size": page_size,
+            "train_batch_size": engine.train_batch_size,
+            "rollout_tokens_total": total_tokens,
+            "rollout_tokens_per_sec": round(roll_tps, 1),
+            "sequential_tokens_per_sec": round(seq_tps, 1),
+            "speedup_vs_sequential_generate": round(roll_tps / seq_tps, 3),
+            "train_s_per_round_p50": round(_pct(train_s, 0.5), 4),
+            "weight_refresh_p50_ms": round(_pct(refresh_s, 0.5) * 1e3, 3),
+            "weight_refresh_p99_ms": round(_pct(refresh_s, 0.99) * 1e3, 3),
+            "weight_epochs": epochs,
+            "kv_flushed_pages_total": h["kv_flushed_pages_total"],
+            "sampled_admissions_total": h["sampled_admissions_total"],
+            # ---- the gates
+            "token_exact_vs_sequential_generate": parity,
+            "compiles_during_measured_rounds": measured_compiles,
+            "program_inventory_stable": inventory_stable,
+            "program_inventory": inventory,
+            "page_accounting_balanced": acct["balanced"],
+            "serving_restarts": h["restarts"],
+        },
+    }
+    ok = (parity and measured_compiles == 0 and inventory_stable
+          and acct["balanced"])
+    result["gates_passed"] = ok
+    return result
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="train+rollout benchmark for the hybrid rollout "
+                    "subsystem (docs/HYBRID.md)")
+    ap.add_argument("--model", default="llama-374m")
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--steps_per_round", type=int, default=2)
+    ap.add_argument("--n_prompts", type=int, default=12)
+    ap.add_argument("--max_new", type=int, default=16)
+    ap.add_argument("--b_slots", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=None, help="also write the JSON here")
+    args = ap.parse_args(argv)
+
+    result = run_rollout_bench(
+        model_name=args.model, rounds=args.rounds,
+        steps_per_round=args.steps_per_round, n_prompts=args.n_prompts,
+        max_new=args.max_new, b_slots=args.b_slots, seed=args.seed)
+    line = json.dumps(result)
+    print(f"BENCH_ROLLOUT {line}")
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+        print(f"artifact -> {args.out}")
+    if not result["gates_passed"]:
+        print("GATES FAILED (parity / zero-recompile / inventory / "
+              "accounting)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
