@@ -1,0 +1,25 @@
+"""graft-lint: contract-enforcing static analysis for this repo.
+
+AST-based rules that mechanically enforce the conventions the framework's
+correctness rests on — the zero-recompile program inventory, host-path
+purity, the supervisor counter-carry contract, the span/gauge/fault-site
+name registries, and daemon-thread write discipline.  CLI:
+``python tools/dslint.py deepspeed_tpu/``; catalog and workflow:
+docs/ANALYSIS.md.
+"""
+from .core import (AnalysisResult, Finding, ModuleInfo, ProjectRule, Rule,
+                   baseline_from_findings, collect_py_files, load_baseline,
+                   load_module, run_analysis, save_baseline)
+from .registries import (CodeName, RegistryName, extract_fault_sites,
+                         extract_gauge_names, extract_trace_names,
+                         parse_registry)
+from .rules import build_default_rules
+
+__all__ = [
+    "AnalysisResult", "Finding", "ModuleInfo", "ProjectRule", "Rule",
+    "baseline_from_findings", "collect_py_files", "load_baseline",
+    "load_module", "run_analysis", "save_baseline",
+    "CodeName", "RegistryName", "extract_fault_sites",
+    "extract_gauge_names", "extract_trace_names", "parse_registry",
+    "build_default_rules",
+]

@@ -1,0 +1,301 @@
+"""graft-lint core: the rule framework behind ``tools/dslint.py``.
+
+The framework's correctness contracts — "sharding is placement, never a
+program shape" (the zero-recompile inventory), the supervisor
+counter-carry contract, the span/gauge/fault-site name registries in
+docs/OBSERVABILITY.md and docs/RESILIENCE.md — are conventions no type
+checker can see.  Every recent PR re-found the same bug classes by hand
+(a per-instance COW jit, a counter missing from ``_carry_counters``, an
+SloRule name that silently demotes its alert); on a real TPU slice some
+of them only surface as a recompile stall or a dropped counter after a
+failover.  This package catches them mechanically, at review time.
+
+Pieces:
+
+- :class:`Finding` — one diagnostic: ``file:line``, rule id, message,
+  and a line-number-free ``key`` so the baseline survives unrelated
+  edits to the same file.
+- :class:`ModuleInfo` — a parsed source file (AST + raw lines +
+  suppression table), handed to every rule.
+- :class:`Rule` / :class:`ProjectRule` — per-module vs whole-tree rules
+  (counter-carry and registry-conformance need cross-file views).
+- inline suppressions — ``# dslint: disable=<rule>[,<rule>]`` on the
+  flagged line (or the line above, for wrapped statements) silences a
+  finding in place; thread-guard additionally honours
+  ``# dslint: guarded-by(<lock>)`` as a reviewed-benign annotation.
+- baseline — a checked-in JSON map of finding fingerprints
+  (``rule|path|key``) to counts.  Grandfathered findings don't fail the
+  build; NEW findings do.  ``tools/dslint.py --write-baseline``
+  regenerates it, and the artifact JSON tracks per-rule counts so the
+  burn-down trajectory is visible across PRs.
+
+See docs/ANALYSIS.md for the rule catalog and the why behind each
+contract.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Finding", "ModuleInfo", "Rule", "ProjectRule", "AnalysisResult",
+    "load_module", "collect_py_files", "run_analysis",
+    "load_baseline", "baseline_from_findings", "save_baseline",
+]
+
+# ``# dslint: disable=rule-a,rule-b`` — everything after ``disable=`` up
+# to the next ``#`` or end of line, comma-separated.  ``disable=all``
+# silences every rule on that line.
+_SUPPRESS_RE = re.compile(r"#\s*dslint:\s*disable=([a-zA-Z0-9_,\- ]+)")
+# ``# dslint: guarded-by(self._lock)`` — thread-guard's reviewed
+# annotation naming the lock that callers hold around this write.
+_GUARDED_RE = re.compile(r"#\s*dslint:\s*guarded-by\(([^)]+)\)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic.  ``key`` is the stable identity used for
+    baselining: it must not contain line numbers (they drift under
+    unrelated edits) — rules set it to the thing being flagged (an
+    attribute name, a qualname, a registry name)."""
+
+    rule: str
+    path: str          # repo-relative, forward slashes
+    line: int
+    message: str
+    key: str
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule}|{self.path}|{self.key}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class ModuleInfo:
+    """A parsed Python source file plus its suppression table."""
+
+    path: str                      # absolute
+    relpath: str                   # repo-relative, forward slashes
+    source: str
+    lines: List[str]
+    tree: ast.Module
+    # line -> set of rule ids suppressed there ({"all"} = every rule)
+    suppressions: Dict[int, set] = field(default_factory=dict)
+    # line -> lock name from a guarded-by annotation
+    guarded_by: Dict[int, str] = field(default_factory=dict)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        for ln in (line, line - 1):
+            s = self.suppressions.get(ln)
+            if s and (rule in s or "all" in s):
+                return True
+        return False
+
+    def guard_annotation(self, line: int) -> Optional[str]:
+        for ln in (line, line - 1):
+            g = self.guarded_by.get(ln)
+            if g:
+                return g
+        return None
+
+
+class Rule:
+    """A per-module rule: sees one file at a time."""
+
+    id: str = "abstract"
+    description: str = ""
+
+    def check_module(self, mod: ModuleInfo) -> List[Finding]:
+        raise NotImplementedError
+
+
+class ProjectRule(Rule):
+    """A whole-tree rule (cross-file contracts: counter-carry,
+    registry-conformance).  ``root`` is the repo root — project rules
+    may also read non-Python inputs (the docs registries)."""
+
+    def check_module(self, mod: ModuleInfo) -> List[Finding]:
+        return []
+
+    def check_project(self, modules: Sequence[ModuleInfo],
+                      root: str) -> List[Finding]:
+        raise NotImplementedError
+
+
+def _parse_suppressions(lines: List[str]) -> Tuple[Dict[int, set],
+                                                   Dict[int, str]]:
+    sup: Dict[int, set] = {}
+    guards: Dict[int, str] = {}
+    for i, text in enumerate(lines, start=1):
+        if "dslint" not in text:
+            continue
+        m = _SUPPRESS_RE.search(text)
+        if m:
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            if rules:
+                sup[i] = rules
+        g = _GUARDED_RE.search(text)
+        if g:
+            guards[i] = g.group(1).strip()
+    return sup, guards
+
+
+def load_module(path: str, root: str) -> Optional[ModuleInfo]:
+    """Parse one file; returns None for unparseable sources (a syntax
+    error is the interpreter's job to report, not the linter's)."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        tree = ast.parse(source, filename=path)
+    except (OSError, SyntaxError, ValueError):
+        return None
+    lines = source.splitlines()
+    sup, guards = _parse_suppressions(lines)
+    rel = os.path.relpath(path, root).replace(os.sep, "/")
+    return ModuleInfo(path=path, relpath=rel, source=source, lines=lines,
+                      tree=tree, suppressions=sup, guarded_by=guards)
+
+
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules",
+              "build", "dist", ".eggs"}
+
+
+def collect_py_files(paths: Iterable[str]) -> List[str]:
+    # deduplicated: overlapping path arguments (a dir + a file inside
+    # it) must not analyze a file twice — duplicate findings would
+    # overflow the baseline's per-fingerprint counts and read as NEW
+    out: List[str] = []
+    seen = set()
+
+    def add(path: str) -> None:
+        ap = os.path.abspath(path)
+        if ap not in seen:
+            seen.add(ap)
+            out.append(ap)
+
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            add(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in _SKIP_DIRS)
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    add(os.path.join(dirpath, fn))
+    return out
+
+
+# --------------------------------------------------------------- baseline
+
+def load_baseline(path: str) -> Dict[str, int]:
+    """fingerprint -> grandfathered count.  A missing file is an empty
+    baseline (everything is new)."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    return {str(k): int(v) for k, v in data.get("findings", {}).items()}
+
+
+def baseline_from_findings(findings: Sequence[Finding]) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.fingerprint] = counts.get(f.fingerprint, 0) + 1
+    return counts
+
+
+def save_baseline(path: str, findings: Sequence[Finding]) -> None:
+    data = {
+        "comment": ("graft-lint baseline: grandfathered findings "
+                    "(fingerprint -> count).  Regenerate with "
+                    "`python tools/dslint.py deepspeed_tpu/ "
+                    "--write-baseline`; the goal is burn-down, "
+                    "not growth (docs/ANALYSIS.md)."),
+        "findings": dict(sorted(
+            baseline_from_findings(findings).items())),
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=False)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+# ----------------------------------------------------------------- runner
+
+@dataclass
+class AnalysisResult:
+    findings: List[Finding]          # every unsuppressed finding
+    new_findings: List[Finding]      # findings the baseline doesn't cover
+    suppressed: int                  # count silenced by inline comments
+    files: int
+
+    def by_rule(self) -> Dict[str, Dict[str, int]]:
+        out: Dict[str, Dict[str, int]] = {}
+        new = {id(f) for f in self.new_findings}
+        for f in self.findings:
+            row = out.setdefault(f.rule, {"findings": 0, "new": 0,
+                                          "baselined": 0})
+            row["findings"] += 1
+            if id(f) in new:
+                row["new"] += 1
+            else:
+                row["baselined"] += 1
+        return out
+
+
+def run_analysis(paths: Sequence[str], root: str,
+                 rules: Optional[Sequence[Rule]] = None,
+                 baseline: Optional[Dict[str, int]] = None
+                 ) -> AnalysisResult:
+    """Run ``rules`` over every ``.py`` under ``paths``.
+
+    Suppressions are applied first (inline comments are reviewed code),
+    then the baseline: for each fingerprint, up to ``baseline[fp]``
+    findings are grandfathered; any beyond that count are NEW."""
+    if rules is None:
+        from .rules import build_default_rules
+
+        rules = build_default_rules()
+    modules: List[ModuleInfo] = []
+    for path in collect_py_files(paths):
+        mod = load_module(path, root)
+        if mod is not None:
+            modules.append(mod)
+    mod_by_rel = {m.relpath: m for m in modules}
+
+    raw: List[Finding] = []
+    for rule in rules:
+        for mod in modules:
+            raw.extend(rule.check_module(mod))
+        if isinstance(rule, ProjectRule):
+            raw.extend(rule.check_project(modules, root))
+
+    findings: List[Finding] = []
+    suppressed = 0
+    for f in raw:
+        mod = mod_by_rel.get(f.path)
+        if mod is not None and mod.suppressed(f.rule, f.line):
+            suppressed += 1
+            continue
+        findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+
+    remaining = dict(baseline or {})
+    new: List[Finding] = []
+    for f in findings:
+        if remaining.get(f.fingerprint, 0) > 0:
+            remaining[f.fingerprint] -= 1
+        else:
+            new.append(f)
+    return AnalysisResult(findings=findings, new_findings=new,
+                         suppressed=suppressed, files=len(modules))

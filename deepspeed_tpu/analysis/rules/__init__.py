@@ -1,0 +1,28 @@
+"""The five repo-specific graft-lint rules (docs/ANALYSIS.md)."""
+from __future__ import annotations
+
+from typing import List
+
+from ..core import Rule
+from .counter_carry import CounterCarryRule, CounterSpec
+from .host_sync import HostSyncRule
+from .recompile import RecompileHazardRule
+from .registry_conformance import RegistryConformanceRule
+from .thread_guard import ThreadGuardRule
+
+__all__ = [
+    "build_default_rules", "CounterCarryRule", "CounterSpec",
+    "HostSyncRule", "RecompileHazardRule", "RegistryConformanceRule",
+    "ThreadGuardRule",
+]
+
+
+def build_default_rules() -> List[Rule]:
+    """The shipped rule set with the repo's contract configuration."""
+    return [
+        RecompileHazardRule(),
+        HostSyncRule(),
+        CounterCarryRule(),
+        RegistryConformanceRule(),
+        ThreadGuardRule(),
+    ]

@@ -1,0 +1,71 @@
+"""Shared AST helpers for the graft-lint rules."""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Tuple
+
+Scope = Tuple[str, str]   # ("class" | "func", name)
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``jax.jit`` / ``np.asarray`` / ``jnp`` -> the dotted source text;
+    None for anything that isn't a plain Name/Attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def walk_scoped(tree: ast.AST) -> Iterator[Tuple[ast.AST, Tuple[Scope, ...]]]:
+    """ast.walk with scope tracking: yields every node with the stack of
+    enclosing class/function scopes (outermost first)."""
+
+    def rec(node: ast.AST, scopes: Tuple[Scope, ...]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield child, scopes
+                yield from rec(child, scopes + (("func", child.name),))
+            elif isinstance(child, ast.ClassDef):
+                yield child, scopes
+                yield from rec(child, scopes + (("class", child.name),))
+            elif isinstance(child, ast.Lambda):
+                yield child, scopes
+                yield from rec(child, scopes + (("func", "<lambda>"),))
+            else:
+                yield child, scopes
+                yield from rec(child, scopes)
+
+    yield from rec(tree, ())
+
+
+def qualname(scopes: Tuple[Scope, ...]) -> str:
+    """Dotted human-readable scope name; "" at module level."""
+    return ".".join(name for _, name in scopes)
+
+
+def enclosing_function(scopes: Tuple[Scope, ...]) -> Optional[str]:
+    for kind, name in reversed(scopes):
+        if kind == "func":
+            return name
+    return None
+
+
+def class_methods(cls: ast.ClassDef) -> dict:
+    """name -> FunctionDef for the class's direct methods."""
+    return {n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def self_attr_target(node: ast.AST, base: str = "self") -> Optional[str]:
+    """``self.X`` -> "X"; ``self.Y.X`` -> "Y.X"; None otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name) and node.id == base and parts:
+        return ".".join(reversed(parts))
+    return None

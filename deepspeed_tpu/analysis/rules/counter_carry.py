@@ -1,0 +1,183 @@
+"""counter-carry: engine counters must survive warm restarts.
+
+``ServingSupervisor`` replaces a poisoned engine with a fresh one and
+keeps the operator-visible ``*_total`` numbers cumulative by folding the
+retiring incarnation's counters into supervisor-held bases
+(``_carry_counters``).  The contract: every monotonic counter attribute
+incremented on ``ServingEngine`` (and on ``SpeculativeDecoder``, whose
+counters ride ``old._spec``) must be named there — a counter that
+isn't silently resets to zero at the first fault restart or rolling
+``recycle()``, which is exactly the drift PR 7's review caught by hand
+when ``_carry_counters`` was first factored out.
+
+Mechanics: the rule collects every ``self.X += …`` / ``self._spec.X +=
+…`` with a *public* attribute name inside the engine classes (private
+``_underscore`` attributes are per-incarnation working state by
+convention: ``_tick``, ``_tokens_out``, the HWM pair carries via
+``max()`` under its own names), then parses ``_carry_counters`` for the
+``old.<attr>`` / ``old._spec.<attr>`` reads.  Incremented-but-not-
+carried is a finding anchored at the increment; a counter that is
+genuinely per-incarnation can say so with an inline suppression naming
+the reason.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Sequence, Set
+
+from ..core import Finding, ModuleInfo, ProjectRule
+from ._util import class_methods, self_attr_target
+
+
+class CounterSpec:
+    """Where counters live and where they must be carried."""
+
+    def __init__(self,
+                 engine_module: str, engine_class: str,
+                 spec_module: str, spec_class: str, spec_attr: str,
+                 supervisor_module: str, supervisor_class: str,
+                 carry_method: str):
+        self.engine_module = engine_module
+        self.engine_class = engine_class
+        self.spec_module = spec_module
+        self.spec_class = spec_class
+        self.spec_attr = spec_attr
+        self.supervisor_module = supervisor_module
+        self.supervisor_class = supervisor_class
+        self.carry_method = carry_method
+
+
+DEFAULT_SPEC = CounterSpec(
+    engine_module="deepspeed_tpu/inference/serving.py",
+    engine_class="ServingEngine",
+    spec_module="deepspeed_tpu/inference/speculative.py",
+    spec_class="SpeculativeDecoder",
+    spec_attr="_spec",
+    supervisor_module="deepspeed_tpu/inference/serving_supervisor.py",
+    supervisor_class="ServingSupervisor",
+    carry_method="_carry_counters",
+)
+
+
+def _class_in(mod: ModuleInfo, name: str):
+    for node in mod.tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == name:
+            return node
+    return None
+
+
+def _incremented_attrs(cls: ast.ClassDef,
+                       via: str = None) -> Dict[str, int]:
+    """Public attrs incremented with ``+=`` on ``self`` (``via=None``)
+    or on ``self.<via>`` -> first increment line."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.AugAssign) \
+                or not isinstance(node.op, ast.Add):
+            continue
+        target = self_attr_target(node.target)
+        if target is None:
+            continue
+        parts = target.split(".")
+        if via is None and len(parts) == 1:
+            attr = parts[0]
+        elif via is not None and len(parts) == 2 and parts[0] == via:
+            attr = parts[1]
+        else:
+            continue
+        if attr.startswith("_"):
+            continue
+        out.setdefault(attr, node.lineno)
+    return out
+
+
+def _carried_attrs(carry: ast.FunctionDef, old_param: str) -> Set[str]:
+    """Every attribute read off the retiring engine inside the carry
+    method: ``old.X``, ``old._spec.X``, ``old._prefix.X`` … -> {X}."""
+    out: Set[str] = set()
+    for node in ast.walk(carry):
+        t = self_attr_target(node, base=old_param) \
+            if isinstance(node, ast.Attribute) else None
+        if t is not None:
+            out.add(t.split(".")[-1])
+    return out
+
+
+class CounterCarryRule(ProjectRule):
+    id = "counter-carry"
+    description = ("monotonic engine counter incremented but not folded "
+                   "into ServingSupervisor._carry_counters")
+
+    def __init__(self, spec: CounterSpec = DEFAULT_SPEC):
+        self.spec = spec
+
+    def check_project(self, modules: Sequence[ModuleInfo],
+                      root: str) -> List[Finding]:
+        byrel = {m.relpath: m for m in modules}
+        s = self.spec
+        sup_mod = byrel.get(s.supervisor_module)
+        eng_mod = byrel.get(s.engine_module)
+        if sup_mod is None or eng_mod is None:
+            return []   # partial runs (a fixture dir) skip the contract
+        findings: List[Finding] = []
+
+        sup_cls = _class_in(sup_mod, s.supervisor_class)
+        carry = (class_methods(sup_cls).get(s.carry_method)
+                 if sup_cls is not None else None)
+        if carry is None:
+            return [Finding(
+                rule=self.id, path=s.supervisor_module, line=1,
+                message=(f"{s.supervisor_class}.{s.carry_method} not "
+                         "found — the counter-carry contract has no "
+                         "anchor"),
+                key=f"missing:{s.carry_method}")]
+        old_param = (carry.args.args[1].name
+                     if hasattr(carry.args.args[1], "name")
+                     else carry.args.args[1].arg) \
+            if len(carry.args.args) > 1 else "old"
+        carried = _carried_attrs(carry, old_param)
+
+        eng_cls = _class_in(eng_mod, s.engine_class)
+        if eng_cls is not None:
+            for attr, line in sorted(_incremented_attrs(eng_cls).items(),
+                                     key=lambda kv: kv[1]):
+                if attr not in carried:
+                    findings.append(Finding(
+                        rule=self.id, path=s.engine_module, line=line,
+                        message=(f"{s.engine_class}.{attr} is "
+                                 "incremented here but never read in "
+                                 f"{s.supervisor_class}."
+                                 f"{s.carry_method} — it resets to 0 "
+                                 "on every warm restart/recycle"),
+                        key=f"{s.engine_class}.{attr}"))
+            # speculative counters bumped from the engine side
+            # (self._spec.X += …) obey the same contract
+            for attr, line in sorted(
+                    _incremented_attrs(eng_cls, via=s.spec_attr).items(),
+                    key=lambda kv: kv[1]):
+                if attr not in carried:
+                    findings.append(Finding(
+                        rule=self.id, path=s.engine_module, line=line,
+                        message=(f"{s.spec_class}.{attr} (via self."
+                                 f"{s.spec_attr}) is incremented here "
+                                 "but never read in "
+                                 f"{s.supervisor_class}."
+                                 f"{s.carry_method}"),
+                        key=f"{s.spec_class}.{attr}"))
+
+        spec_mod = byrel.get(s.spec_module)
+        spec_cls = (_class_in(spec_mod, s.spec_class)
+                    if spec_mod is not None else None)
+        if spec_cls is not None:
+            for attr, line in sorted(_incremented_attrs(spec_cls).items(),
+                                     key=lambda kv: kv[1]):
+                if attr not in carried:
+                    findings.append(Finding(
+                        rule=self.id, path=s.spec_module, line=line,
+                        message=(f"{s.spec_class}.{attr} is incremented "
+                                 "here but never read in "
+                                 f"{s.supervisor_class}."
+                                 f"{s.carry_method} — speculative "
+                                 "counters reset on warm restart"),
+                        key=f"{s.spec_class}.{attr}"))
+        return findings

@@ -1,0 +1,243 @@
+"""registry-conformance: code-emitted names == docs registry tables.
+
+docs/OBSERVABILITY.md and docs/RESILIENCE.md are the operator contract:
+every ``trace_span`` name, ``trace_count`` counter, monitor gauge, and
+fault-injection site is supposed to be in their tables — that is what a
+dashboard, an SLO rule, or a ``DS_TPU_FAULTS`` schedule is written
+against.  Until this rule, nothing enforced it, and the first run found
+nine spans the table had silently drifted away from (``fleet.tick``,
+``pod.round``, ``serve.probe``, …).
+
+The tables are machine-readable via ``<!-- dslint-registry: <kind> -->``
+markers (``analysis/registries.py``); kinds: ``spans``, ``counters``,
+``gauges``, ``fault-sites``.  The rule proves **bidirectional**
+agreement:
+
+- a name the code emits with no registry row -> finding at the emit
+  site (an unregistered span/gauge is invisible to the operator
+  contract);
+- a registry row no code emits -> finding at the docs line (a dead row
+  documents observability that does not exist);
+- every registry name must also survive the SAME Prometheus
+  sanitization ``export.py`` applies (``_prom_name``): a name whose
+  base sanitizes to nothing, or a labeled gauge whose label half is
+  malformed, would silently demote or mangle its exposition family —
+  the SloRule-name bug class PR 12 fixed at runtime, caught here at
+  review time.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Sequence, Tuple
+
+from ..core import Finding, ModuleInfo, ProjectRule
+from ..registries import (CodeName, RegistryName, extract_fault_sites,
+                          extract_gauge_names, extract_trace_names,
+                          parse_registry)
+
+DEFAULT_REGISTRY_DOCS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
+    ("docs/OBSERVABILITY.md", ("spans", "counters", "gauges")),
+    ("docs/RESILIENCE.md", ("fault-sites",)),
+)
+
+_PROM_VALID = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_FORM = re.compile(r"^([^{}]+)\{([A-Za-z_][A-Za-z0-9_]*)=([^{}]*)\}$")
+
+
+_EXPORT_PROM_NAME = None
+
+
+def _load_export_prom_name():
+    """export.py's actual sanitizer, so the two can never drift.  The
+    relative import works in-package (tests, programmatic use); under
+    the standalone CLI loader (tools/dslint.py registers the package as
+    a top level, so ``...`` has no parent) export.py — stdlib-only by
+    design — is loaded by file path instead.  Only if BOTH fail does an
+    inline copy of the regex take over."""
+    try:
+        from ...observability.export import _prom_name
+
+        return _prom_name
+    except Exception:
+        pass
+    try:
+        import importlib.util
+
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            os.pardir, os.pardir, "observability",
+                            "export.py")
+        spec = importlib.util.spec_from_file_location("_dslint_export",
+                                                      path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod._prom_name
+    except Exception:
+        return lambda name: (lambda n: "_" + n if n[0].isdigit() else n)(
+            "dstpu_" + re.sub(r"[^a-zA-Z0-9_:]", "_", name))
+
+
+def _prom_name(name: str) -> str:
+    global _EXPORT_PROM_NAME
+    if _EXPORT_PROM_NAME is None:
+        _EXPORT_PROM_NAME = _load_export_prom_name()
+    return _EXPORT_PROM_NAME(name)
+
+
+class RegistryConformanceRule(ProjectRule):
+    id = "registry-conformance"
+    description = ("span/counter/gauge/fault-site names must agree with "
+                   "the docs registry tables, both directions")
+
+    def __init__(self,
+                 registry_docs: Sequence[Tuple[str, Sequence[str]]]
+                 = DEFAULT_REGISTRY_DOCS,
+                 code_prefix: str = "deepspeed_tpu/"):
+        self.registry_docs = tuple((d, tuple(k)) for d, k in registry_docs)
+        # only product modules emit registered names; tools/ and tests/
+        # construct ad-hoc names for fixtures and benches
+        self.code_prefix = code_prefix
+
+    # --------------------------------------------------------------- load
+
+    def _load_registries(self, root: str
+                         ) -> Tuple[Dict[str, List[RegistryName]],
+                                    List[Finding]]:
+        regs: Dict[str, List[RegistryName]] = {}
+        findings: List[Finding] = []
+        for relpath, kinds in self.registry_docs:
+            path = os.path.join(root, relpath)
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    text = f.read()
+            except OSError:
+                findings.append(Finding(
+                    rule=self.id, path=relpath, line=1,
+                    message=f"registry document {relpath} is missing",
+                    key=f"missing-doc:{relpath}"))
+                continue
+            for kind in kinds:
+                rows = parse_registry(text, relpath, kind)
+                if not rows:
+                    findings.append(Finding(
+                        rule=self.id, path=relpath, line=1,
+                        message=(f"no `<!-- dslint-registry: {kind} -->`"
+                                 f" table found in {relpath}"),
+                        key=f"missing-table:{kind}"))
+                regs.setdefault(kind, []).extend(rows)
+        return regs, findings
+
+    # -------------------------------------------------------------- match
+
+    def _check_kind(self, kind: str, code: Sequence[CodeName],
+                    rows: Sequence[RegistryName]) -> List[Finding]:
+        findings: List[Finding] = []
+        used = [False] * len(rows)
+        seen_unregistered = set()
+        for cn in code:
+            hit = False
+            for i, row in enumerate(rows):
+                if cn.matches_registry(row):
+                    used[i] = True
+                    hit = True
+            if not hit:
+                display = cn.name.replace("\x00", "<…>")
+                if (kind, display) in seen_unregistered:
+                    continue   # one finding per name, not per call site
+                seen_unregistered.add((kind, display))
+                findings.append(Finding(
+                    rule=self.id, path=cn.relpath, line=cn.line,
+                    message=(f"{kind[:-1] if kind.endswith('s') else kind}"
+                             f" name '{display}' is emitted here but "
+                             "has no row in the docs registry "
+                             "(docs/ANALYSIS.md \"registry-"
+                             "conformance\")"),
+                    key=f"unregistered:{kind}:{display}"))
+        for i, row in enumerate(rows):
+            if used[i]:
+                continue
+            # a literal row shadowed by an identical duplicate is still
+            # dead; a pattern row is dead only if nothing dynamic hit it
+            findings.append(Finding(
+                rule=self.id, path=row.doc_relpath, line=row.line,
+                message=(f"registry row '{row.name}' ({kind}) matches "
+                         "nothing the code emits — dead documentation "
+                         "or a renamed emission"),
+                key=f"dead-row:{kind}:{row.name}"))
+        return findings
+
+    def _check_prom_validity(self, kind: str,
+                             rows: Sequence[RegistryName]
+                             ) -> List[Finding]:
+        findings: List[Finding] = []
+        for row in rows:
+            name = row.name
+            base, label_val = name, None
+            m = _LABEL_FORM.match(name)
+            if m:
+                base, _, label_val = m.groups()
+            elif "{" in name or "}" in name:
+                findings.append(Finding(
+                    rule=self.id, path=row.doc_relpath, line=row.line,
+                    message=(f"'{name}' has a malformed label form — "
+                             "the exposition expects exactly "
+                             "base{key=value}; anything else demotes "
+                             "to a flat (mangled) gauge name"),
+                    key=f"prom-invalid:{name}"))
+                continue
+            base = re.sub(r"<[A-Za-z0-9_.-]+>", "x", base)
+            if "," in base or "\n" in base or " " in base.strip():
+                findings.append(Finding(
+                    rule=self.id, path=row.doc_relpath, line=row.line,
+                    message=(f"'{name}' contains characters the "
+                             "Prometheus exposition cannot carry in a "
+                             "metric name (comma/space/newline)"),
+                    key=f"prom-invalid:{name}"))
+                continue
+            if not _PROM_VALID.match(_prom_name(base)):
+                findings.append(Finding(
+                    rule=self.id, path=row.doc_relpath, line=row.line,
+                    message=(f"'{name}' does not sanitize to a valid "
+                             "Prometheus metric name under export.py's "
+                             "_prom_name"),
+                    key=f"prom-invalid:{name}"))
+        return findings
+
+    # ---------------------------------------------------------------- run
+
+    def check_project(self, modules: Sequence[ModuleInfo],
+                      root: str) -> List[Finding]:
+        regs, findings = self._load_registries(root)
+        if not regs:
+            return findings
+        prod = [m for m in modules
+                if m.relpath.startswith(self.code_prefix)]
+
+        traced = extract_trace_names(prod)
+        if "spans" in regs:
+            findings.extend(self._check_kind(
+                "spans", traced.get("trace_span", []), regs["spans"]))
+            findings.extend(
+                self._check_prom_validity("spans", regs["spans"]))
+        if "counters" in regs:
+            findings.extend(self._check_kind(
+                "counters", traced.get("trace_count", []),
+                regs["counters"]))
+            findings.extend(
+                self._check_prom_validity("counters", regs["counters"]))
+        if "gauges" in regs:
+            namespaces = sorted({
+                r.name.split("/", 1)[0].split("{", 1)[0]
+                for r in regs["gauges"]})
+            gauges = extract_gauge_names(prod, namespaces)
+            findings.extend(
+                self._check_kind("gauges", gauges, regs["gauges"]))
+            findings.extend(
+                self._check_prom_validity("gauges", regs["gauges"]))
+        if "fault-sites" in regs:
+            sites = extract_fault_sites(prod)
+            findings.extend(self._check_kind(
+                "fault-sites", sites, regs["fault-sites"]))
+            findings.extend(self._check_prom_validity(
+                "fault-sites", regs["fault-sites"]))
+        return findings

@@ -1,0 +1,272 @@
+"""thread-guard: daemon-thread/main-path shared writes need a lock.
+
+The tree runs several daemon threads against live engine state: the
+HangWatchdog scan, the pod HeartbeatWatchdog renew loop, the async-
+checkpoint finalize thread, the MetricsServer.  Python's GIL makes the
+individual stores atomic but not the read-modify-write sequences around
+them (``self.beats += 1`` from two threads loses beats; a check-then-set
+on ``self._thread`` races arm() against the watcher) — and none of it
+shows up in tests that never lose the timing race.
+
+The rule is intra-class and syntactic, by design (reviewable, no false
+dataflow): for every class it finds the *thread entry points* — methods
+passed as ``threading.Thread(target=self.<m>)`` plus ``run`` on
+``Thread`` subclasses — and the intra-class call closure under them.
+A closure method the main path can also enter — public, or called from
+a non-closure method — counts as BOTH sides (the
+``HeartbeatWatchdog.beat_once`` pattern: the renew daemon calls it and
+the docstring invites the step loop to).  An attribute written (outside
+``__init__``) from both sides must have EVERY write site either
+
+- lexically inside a ``with self.<lock>:`` block, where ``<lock>`` is a
+  ``threading.Lock/RLock/Condition`` built in ``__init__`` (or any attr
+  whose name contains "lock"), or
+- annotated ``# dslint: guarded-by(<lock>)`` on the write line — the
+  reviewed escape hatch for writes protected by protocol rather than by
+  a lexical lock (e.g. single-writer-then-join handoffs).
+
+Module-level thread closures (``Thread(target=localfn)`` around a
+nested function) get the same check against the enclosing module's
+other writes to the same attribute name.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Finding, ModuleInfo, Rule
+from ._util import class_methods, dotted_name, self_attr_target
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition",
+               "Lock", "RLock", "Condition"}
+
+
+def _thread_targets(node: ast.Call) -> Optional[str]:
+    """``threading.Thread(target=self.X, …)`` -> "X" (None otherwise)."""
+    callee = dotted_name(node.func)
+    if callee not in ("threading.Thread", "Thread"):
+        return None
+    for kw in node.keywords:
+        if kw.arg == "target":
+            t = self_attr_target(kw.value)
+            if t is not None and "." not in t:
+                return t
+            if isinstance(kw.value, ast.Name):
+                return kw.value.id     # local function closure
+    return None
+
+
+class _ClassWrites(ast.NodeVisitor):
+    """Attribute writes per method, with lock-context tracking."""
+
+    def __init__(self, lock_attrs: Set[str]):
+        self.lock_attrs = lock_attrs
+        # attr -> [(method, line, guarded)]
+        self.writes: Dict[str, List[Tuple[str, int, bool]]] = {}
+        self._method: Optional[str] = None
+        self._lock_depth = 0
+
+    def visit_method(self, name: str, fn: ast.AST) -> None:
+        self._method, self._lock_depth = name, 0
+        self.generic_visit(fn)
+
+    def visit_With(self, node: ast.With) -> None:
+        locked = False
+        for item in node.items:
+            t = self_attr_target(item.context_expr)
+            if t is not None and (t in self.lock_attrs
+                                  or "lock" in t.lower()):
+                locked = True
+        if locked:
+            self._lock_depth += 1
+            self.generic_visit(node)
+            self._lock_depth -= 1
+        else:
+            self.generic_visit(node)
+
+    def _record(self, target: ast.AST, line: int) -> None:
+        t = self_attr_target(target)
+        if t is None or "." in t:
+            return
+        self.writes.setdefault(t, []).append(
+            (self._method or "", line, self._lock_depth > 0))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._record(t, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record(node.target, node.lineno)
+        self.generic_visit(node)
+
+
+def _intra_class_closure(methods: Dict[str, ast.AST],
+                         roots: Set[str]) -> Set[str]:
+    """Transitive ``self.m()`` call closure from the root methods."""
+    closure = set(r for r in roots if r in methods)
+    frontier = list(closure)
+    while frontier:
+        m = frontier.pop()
+        for node in ast.walk(methods[m]):
+            if isinstance(node, ast.Call):
+                t = self_attr_target(node.func)
+                if t is not None and "." not in t and t in methods \
+                        and t not in closure:
+                    closure.add(t)
+                    frontier.append(t)
+    return closure
+
+
+class ThreadGuardRule(Rule):
+    id = "thread-guard"
+    description = ("attribute written from both a daemon-thread entry "
+                   "point and the main path without a lock or a "
+                   "guarded-by annotation")
+
+    def check_module(self, mod: ModuleInfo) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._check_class(mod, node))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(self._check_closure_threads(mod, node))
+        return findings
+
+    # ---------------------------------------------------------- per class
+
+    def _check_class(self, mod: ModuleInfo,
+                     cls: ast.ClassDef) -> List[Finding]:
+        methods = class_methods(cls)
+        # thread entry points named inside this class's own body
+        entries: Set[str] = set()
+        for n in ast.walk(cls):
+            if isinstance(n, ast.Call):
+                t = _thread_targets(n)
+                if t is not None and t in methods:
+                    entries.add(t)
+        for base in cls.bases:
+            b = dotted_name(base)
+            if b in ("threading.Thread", "Thread") and "run" in methods:
+                entries.add("run")
+        if not entries:
+            return []
+
+        lock_attrs: Set[str] = set()
+        init = methods.get("__init__")
+        if init is not None:
+            for n in ast.walk(init):
+                if isinstance(n, ast.Assign) \
+                        and isinstance(n.value, ast.Call):
+                    ctor = dotted_name(n.value.func)
+                    if ctor in _LOCK_CTORS:
+                        for t in n.targets:
+                            at = self_attr_target(t)
+                            if at is not None:
+                                lock_attrs.add(at)
+
+        writes = _ClassWrites(lock_attrs)
+        for name, fn in methods.items():
+            if name == "__init__":
+                continue   # runs before any thread exists
+            writes.visit_method(name, fn)
+
+        thread_methods = _intra_class_closure(methods, entries)
+        # a closure method that the MAIN path can also enter counts as
+        # both sides: public methods (the HeartbeatWatchdog.beat_once
+        # pattern — "call this from the step loop"), and methods called
+        # from non-closure methods of the class.  Without this, a race
+        # confined to one dual-use method is invisible.
+        called_from_main: Set[str] = set()
+        for name, fn in methods.items():
+            if name in thread_methods or name == "__init__":
+                continue
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Call):
+                    t = self_attr_target(n.func)
+                    if t is not None and "." not in t:
+                        called_from_main.add(t)
+        dual = {m for m in thread_methods
+                if (not m.startswith("_") and m not in entries)
+                or m in called_from_main}
+        findings: List[Finding] = []
+        for attr, sites in sorted(writes.writes.items()):
+            from_thread = any(m in thread_methods for m, _, _ in sites)
+            from_main = any(m not in thread_methods or m in dual
+                            for m, _, _ in sites)
+            if not (from_thread and from_main):
+                continue
+            for method, line, guarded in sites:
+                if guarded or mod.guard_annotation(line):
+                    continue
+                side = "daemon-thread" if method in thread_methods \
+                    else "main-path"
+                findings.append(Finding(
+                    rule=self.id, path=mod.relpath, line=line,
+                    message=(f"{cls.name}.{attr} is written from both "
+                             f"a daemon-thread entry point and the "
+                             f"main path; this {side} write in "
+                             f"{method}() is outside any lock — guard "
+                             "it or annotate `# dslint: guarded-by"
+                             "(<lock>)` with the protocol that makes "
+                             "it safe"),
+                    key=f"{cls.name}.{attr}@{method}"))
+        return findings
+
+    # --------------------------------------------- module-level closures
+
+    def _check_closure_threads(self, mod: ModuleInfo,
+                               fn: ast.AST) -> List[Finding]:
+        """``Thread(target=localfn)`` closures: attribute names written
+        inside the closure AND elsewhere in the module."""
+        locals_: Dict[str, ast.AST] = {
+            n.name: n for n in ast.iter_child_nodes(fn)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        targets: Set[str] = set()
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Call):
+                t = _thread_targets(n)
+                if t in locals_:
+                    targets.add(t)
+        if not targets:
+            return []
+
+        def attr_writes(root: ast.AST) -> Dict[str, List[int]]:
+            out: Dict[str, List[int]] = {}
+            for n in ast.walk(root):
+                tgts = []
+                if isinstance(n, ast.Assign):
+                    tgts = n.targets
+                elif isinstance(n, ast.AugAssign):
+                    tgts = [n.target]
+                for t in tgts:
+                    if isinstance(t, ast.Attribute):
+                        out.setdefault(t.attr, []).append(n.lineno)
+            return out
+
+        findings: List[Finding] = []
+        closure_nodes = [locals_[t] for t in sorted(targets)]
+        closure_lines: Set[int] = set()
+        closure_writes: Dict[str, List[int]] = {}
+        for cn in closure_nodes:
+            for attr, lines in attr_writes(cn).items():
+                closure_writes.setdefault(attr, []).extend(lines)
+            closure_lines.update(
+                range(cn.lineno, (cn.end_lineno or cn.lineno) + 1))
+        module_writes = attr_writes(mod.tree)
+        for attr, lines in sorted(closure_writes.items()):
+            outside = [ln for ln in module_writes.get(attr, [])
+                       if ln not in closure_lines]
+            if not outside:
+                continue
+            for line in lines:
+                if mod.guard_annotation(line):
+                    continue
+                findings.append(Finding(
+                    rule=self.id, path=mod.relpath, line=line,
+                    message=(f"attribute '{attr}' is written inside a "
+                             "thread-closure here and also at line(s) "
+                             f"{outside} on the main path — lock it or "
+                             "annotate `# dslint: guarded-by(<lock>)`"),
+                    key=f"closure:{attr}"))
+        return findings

@@ -718,6 +718,12 @@ class HeartbeatWatchdog:
         self.beats = 0
         self._attrs: Dict = {}
         self._started_at: Optional[float] = None   # store clock, at start()
+        # beat_once() runs on BOTH the renew daemon and the training step
+        # loop (piggybacked attrs); `beats += 1` and the advert rate-limit
+        # check-then-set are read-modify-write, so without the lock two
+        # concurrent renewals lose a beat — and `beats` gates the _scan
+        # grace window, so lost beats extend the dead-host grace period
+        self._beat_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -729,7 +735,8 @@ class HeartbeatWatchdog:
     def start(self) -> "HeartbeatWatchdog":
         beat(self.store, self.host_id, self.generation, self.lease_s,
              **self._attrs)   # first lease lands before start() returns
-        self.beats = 1
+        with self._beat_lock:
+            self.beats = 1
         self._started_at = self.store.now()
         self._thread = threading.Thread(
             target=self._loop, name=f"pod-heartbeat[{self.host_id}]",
@@ -748,18 +755,22 @@ class HeartbeatWatchdog:
         call this from the step loop to piggyback fresh attrs)."""
         beat(self.store, self.host_id, self.generation, self.lease_s,
              **self._attrs)
-        if self.advertise:
-            # once per lease, not per renewal: the advertisement's only
-            # consumer (rollup_host_gauges) is itself rate-limited to once
-            # per lease, so renewing it 3x as often just doubles the
-            # store's write volume for an identical cross-host view
-            now = self.store.now()
-            if self._last_advert_t is None \
-                    or now - self._last_advert_t >= self.lease_s:
-                self._last_advert_t = now
-                advertise_host(self.store, self.host_id, self.generation,
-                               monitor=self.monitor, **self._attrs)
-        self.beats += 1
+        should_advertise = False
+        with self._beat_lock:
+            self.beats += 1
+            if self.advertise:
+                # once per lease, not per renewal: the advertisement's only
+                # consumer (rollup_host_gauges) is itself rate-limited to
+                # once per lease, so renewing it 3x as often just doubles
+                # the store's write volume for an identical cross-host view
+                now = self.store.now()
+                if self._last_advert_t is None \
+                        or now - self._last_advert_t >= self.lease_s:
+                    self._last_advert_t = now
+                    should_advertise = True
+        if should_advertise:   # store write outside the lock
+            advertise_host(self.store, self.host_id, self.generation,
+                           monitor=self.monitor, **self._attrs)
 
     def _loop(self) -> None:
         # renew well inside the lease so one slow write never costs it

@@ -110,7 +110,9 @@ class DSUNet:
         self.dtype = self.config.dtype
         self.data_format = data_format
         self.fwd_count = 0
-        self._jitted = jax.jit(
+        # per-instance by design: one UNet wrapper per pipeline process,
+        # outside the serving zero-recompile inventory
+        self._jitted = jax.jit(   # dslint: disable=recompile-hazard
             lambda p, s, t, c: unet_forward(self.config, p, s, t, c))
 
     @classmethod
@@ -184,9 +186,10 @@ class DSVAE:
         self.params = params
         self.dtype = self.config.dtype
         self.data_format = data_format
-        self._enc = jax.jit(
+        # per-instance by design: one VAE wrapper per pipeline process
+        self._enc = jax.jit(   # dslint: disable=recompile-hazard
             lambda p, x: vae_encode_moments(self.config, p, x))
-        self._dec = jax.jit(
+        self._dec = jax.jit(   # dslint: disable=recompile-hazard
             lambda p, z: vae_decode(self.config, p, z, scale=False))
 
     @classmethod

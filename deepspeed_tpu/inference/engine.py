@@ -107,7 +107,9 @@ class InferenceEngine:
                 # tree twice.  No donation — the caller owns `params`.
                 # (Quantize-during-stream for models whose compute-dtype
                 # form exceeds HBM is future loader work.)
-                qleaf = jax.jit(lambda x: quantize_params(
+                # one-shot init-time cast, discarded after this load —
+                # never in the serving/steady path
+                qleaf = jax.jit(lambda x: quantize_params(   # dslint: disable=recompile-hazard
                     x, bits=bits, compute_dtype=cdtype))
                 self.params = jax.tree_util.tree_map(qleaf, params)
             else:
@@ -118,7 +120,8 @@ class InferenceEngine:
                     is_leaf=lambda x: isinstance(x, P))
                 cast = lambda x: x.astype(dtype) if hasattr(x, "dtype") and jnp.issubdtype(  # noqa: E731
                     x.dtype, jnp.floating) else x
-                self.params = jax.jit(lambda p: jax.tree_util.tree_map(cast, p),
+                # one-shot init-time cast+placement, discarded after load
+                self.params = jax.jit(lambda p: jax.tree_util.tree_map(cast, p),   # dslint: disable=recompile-hazard
                                       out_shardings=shardings)(params)
         else:
             self.params = None
@@ -152,7 +155,10 @@ class InferenceEngine:
                     shim.apply_paged = lambda p, *a, **k: inner_paged(
                         dequantize_params(p), *a, **k)
                 self._model = shim
-        self._forward = jax.jit(self.apply_fn)
+        # the engine's ONE forward program: per-instance by design (one
+        # inference engine per process; serving routes through the
+        # MeshExecutor inventory, never this)
+        self._forward = jax.jit(self.apply_fn)   # dslint: disable=recompile-hazard
         log_dist(f"inference engine ready: tp={tp} dtype={self._config.dtype}"
                  + (f" quant=int{self._config.quant.num_bits}"
                     if self._quant else ""), ranks=[0])

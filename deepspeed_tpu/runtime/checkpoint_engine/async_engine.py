@@ -94,7 +94,10 @@ def async_save_engine_checkpoint(engine, save_dir: str, ckpt_dir: str,
                                   "w") as f:
                             f.write(str(tag))
         except Exception as e:   # surface on wait; never publish latest
-            engine._async_ckpt_error = e
+            # the main path only reads/clears this AFTER t.join() proves
+            # the commit thread dead (wait_for_pending_checkpoint), so
+            # the join is the synchronization point, not a lock
+            engine._async_ckpt_error = e   # dslint: guarded-by(thread-join)
             logger.error(f"async checkpoint {tag} failed: {e}")
             return
         log_dist(f"committed async checkpoint {tag} -> {ckpt_dir}", ranks=[0])

@@ -8,6 +8,7 @@ scenario drives the same simulated-pod harness as
 ``tools/chaos_soak.py --mode pod`` at a pinned seed."""
 import json
 import os
+import sys
 import threading
 import time
 
@@ -197,6 +198,40 @@ def test_watchdog_declares_silent_peer_dead_and_records_marker(tmp_path):
         wd.stop()
     assert dead == ["h1"]
     assert dead_set(s) == ["h1"]                # durable marker for re-plan
+
+
+def test_beat_once_concurrent_callers_lose_no_beats(tmp_path):
+    """graft-lint thread-guard regression (ISSUE 14): ``beat_once()``
+    runs on BOTH the renew daemon and the training step loop, and
+    ``beats += 1`` plus the advert rate-limit check-then-set were
+    unlocked read-modify-writes — concurrent renewals could lose beats,
+    and ``beats`` gates the dead-host grace window in ``_scan``.  Now
+    both run under ``_beat_lock``: N concurrent callers == exactly N
+    beats."""
+    s = _store(tmp_path)
+    wd = HeartbeatWatchdog(s, "h0", generation=1, peers=["h1"],
+                           lease_s=10.0, renew_s=10.0,
+                           on_peer_dead=lambda h: None)
+    n_threads, n_calls = 8, 200
+    start_gate = threading.Event()
+
+    def hammer():
+        start_gate.wait()
+        for _ in range(n_calls):
+            wd.beat_once()
+
+    old_interval = sys.getswitchinterval()
+    sys.setswitchinterval(1e-6)   # force preemption inside the hot +=
+    try:
+        threads = [threading.Thread(target=hammer) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        start_gate.set()
+        for t in threads:
+            t.join()
+    finally:
+        sys.setswitchinterval(old_interval)
+    assert wd.beats == n_threads * n_calls
 
 
 def test_watchdog_quiet_while_peers_renew(tmp_path):
