@@ -45,7 +45,7 @@ from .core import ModuleInfo
 __all__ = [
     "RegistryName", "parse_registry", "registry_kinds_in",
     "CodeName", "extract_trace_names", "extract_gauge_names",
-    "extract_fault_sites",
+    "extract_fault_sites", "extract_tag_names",
 ]
 
 _MARKER_RE = re.compile(r"<!--\s*dslint-registry:\s*([a-z-]+)\s*-->")
@@ -247,6 +247,50 @@ def extract_gauge_names(modules: Sequence[ModuleInfo],
                         p.replace("\x00", "X"), namespaces):
                     out.append(CodeName(p, mod.relpath, h.lineno,
                                         dynamic=True))
+    return out
+
+
+def extract_tag_names(modules: Sequence[ModuleInfo],
+                      funcs: Tuple[str, ...] = ("trace_context",
+                                                "trace_tags"),
+                      ) -> List[CodeName]:
+    """Trace-context TAG keys (docs/OBSERVABILITY.md "Distributed
+    tracing"): the keyword names of every ``trace_context(...)`` /
+    ``trace_tags(...)`` call, plus the implicit ``trace_id``/``rid`` keys
+    a ``trace_context`` with positional identity arguments injects, plus
+    mid-span attrs set through ``<span>.set(key=...)`` (the slot→rid map
+    rides that path).  Keyword'd ``.set`` calls are matched by method
+    name — in this tree only span contexts take keyword ``set`` args, and
+    a future non-span hit just prompts a registry row or a rename.  Tag
+    keys become Perfetto ``args`` keys and fleet-trace filter terms — the
+    registry table is the operator contract for what can be filtered on."""
+    out: List[CodeName] = []
+    for mod in modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = _name_of_call(node)
+            if fname == "set" and isinstance(node.func, ast.Attribute):
+                for kw in node.keywords:
+                    if kw.arg is not None:
+                        out.append(CodeName(kw.arg, mod.relpath,
+                                            node.lineno, dynamic=False))
+                continue
+            if fname not in funcs:
+                continue
+            names = [kw.arg for kw in node.keywords if kw.arg is not None]
+            if fname == "trace_context":
+                # positional trace_id/rid inject those keys implicitly;
+                # count them only when actually passed (non-None spelling
+                # is a runtime property — registering the pair whenever a
+                # positional arg appears keeps the check sound)
+                if len(node.args) >= 1:
+                    names.append("trace_id")
+                if len(node.args) >= 2:
+                    names.append("rid")
+            for n in names:
+                out.append(CodeName(n, mod.relpath, node.lineno,
+                                    dynamic=False))
     return out
 
 

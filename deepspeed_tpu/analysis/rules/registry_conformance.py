@@ -33,11 +33,11 @@ from typing import Dict, List, Sequence, Tuple
 
 from ..core import Finding, ModuleInfo, ProjectRule
 from ..registries import (CodeName, RegistryName, extract_fault_sites,
-                          extract_gauge_names, extract_trace_names,
-                          parse_registry)
+                          extract_gauge_names, extract_tag_names,
+                          extract_trace_names, parse_registry)
 
 DEFAULT_REGISTRY_DOCS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
-    ("docs/OBSERVABILITY.md", ("spans", "counters", "gauges")),
+    ("docs/OBSERVABILITY.md", ("spans", "counters", "gauges", "tags")),
     ("docs/RESILIENCE.md", ("fault-sites",)),
 )
 
@@ -234,6 +234,12 @@ class RegistryConformanceRule(ProjectRule):
                 self._check_kind("gauges", gauges, regs["gauges"]))
             findings.extend(
                 self._check_prom_validity("gauges", regs["gauges"]))
+        if "tags" in regs:
+            # trace-context tag keys (docs/OBSERVABILITY.md "Distributed
+            # tracing"); no prom-validity pass — tags become Perfetto args
+            # keys, not Prometheus metric names
+            findings.extend(self._check_kind(
+                "tags", extract_tag_names(prod), regs["tags"]))
         if "fault-sites" in regs:
             sites = extract_fault_sites(prod)
             findings.extend(self._check_kind(

@@ -523,6 +523,58 @@ def read_residency(store: CoordinationStore,
     return out
 
 
+# ------------------------------------------------------------ trace segments
+
+def append_trace_segment(store: CoordinationStore, owner_id: str,
+                         spans: List[Dict], prefix: str = "trace",
+                         max_spans: int = 2048,
+                         attrs: Optional[Dict] = None) -> Dict:
+    """CAS-append completed-span records under ``<prefix>/<owner_id>``
+    (the serving fleet uses ``fleet/trace/<engine>`` — docs/FLEET.md
+    keyspace table).  The document is size-capped like the request
+    journal: past ``max_spans`` the OLDEST records drop and the ``dropped``
+    counter grows, so one chatty process can never grow its store document
+    unboundedly — truncation is visible, never silent.
+
+    Every append stamps a fresh **clock anchor** pairing the writing
+    process's ``time.monotonic()`` with ``time.time()``: span records
+    stamp monotonic t0s (immune to wall steps but process-local), and the
+    anchor is what lets ``observability/trace_assembly.py`` place every
+    process's spans on ONE shared epoch timeline with per-process skew
+    correction.  The write is a CAS loop (single writer per owner in
+    practice — contention can only be a dying predecessor's last append),
+    mirroring ``record_dead``/``bump_generation``."""
+    key = f"{prefix}/{owner_id}"
+    while True:
+        cur = store.get(key)
+        merged = list((cur or {}).get("spans") or ())
+        merged.extend(spans)
+        dropped = int((cur or {}).get("dropped") or 0)
+        if len(merged) > int(max_spans):
+            dropped += len(merged) - int(max_spans)
+            merged = merged[-int(max_spans):]
+        doc = {"owner_id": str(owner_id),
+               "anchor": {"mono": time.monotonic(), "epoch": time.time()},
+               "spans": merged,
+               "dropped": dropped,
+               "attrs": dict(attrs or {}),
+               "t": store.now()}
+        if store.compare_and_swap(key, cur, doc):
+            return doc
+
+
+def read_trace_segments(store: CoordinationStore,
+                        prefix: str = "trace") -> Dict[str, Dict]:
+    """owner_id -> newest trace-segment document under ``prefix`` — the
+    input ``trace_assembly.assemble_fleet_trace`` merges."""
+    out: Dict[str, Dict] = {}
+    for name in store.list(prefix):
+        doc = store.get(f"{prefix}/{name}")
+        if doc is not None:
+            out[str(doc.get("owner_id", name))] = doc
+    return out
+
+
 # --------------------------------------------------------------- generation
 
 def read_generation(store: CoordinationStore, key: str = "generation") -> int:

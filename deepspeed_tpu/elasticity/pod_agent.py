@@ -113,6 +113,7 @@ def save_pod_checkpoint(engine, save_dir: str, ctx: "PodContext",
             ctx.store.put(f"commit/gen{ctx.generation}",
                           {"tag": str(tag), "t": ctx.store.now()})
         shard_files: List[str] = []
+        owner: Optional[int] = None
         if ctx.shard_writer is not None:
             shard_files = list(ctx.shard_writer(ckpt_dir, ctx.host_id))
         if engine is not None:
@@ -133,12 +134,15 @@ def save_pod_checkpoint(engine, save_dir: str, ctx: "PodContext",
                 proc = int(jax.process_index())
             except Exception:   # pragma: no cover - no device runtime
                 proc = ctx.rank
+            owner = proc
             shard_files.extend(
                 f for f in host_payload_files(ckpt_dir, process_index=proc)
                 if f not in shard_files)
         step = int(engine.global_steps) if engine is not None else -1
+        # the explicit owner stamp lets commit/verify cross-check the
+        # path-derived attribution (integrity._owner_attribution_problems)
         write_host_manifest(ckpt_dir, ctx.host_id, ctx.generation, step,
-                            files=shard_files)
+                            files=shard_files, owner=owner)
         if ctx.is_coordinator:
             commit_pod_manifest(ckpt_dir, ctx.generation,
                                 expected_hosts=ctx.hosts,

@@ -101,7 +101,9 @@ from ..elasticity.coordination import (CoordinationStore, beat,
                                        lease_table, process_src,
                                        publish_residency, read_generation,
                                        record_dead)
-from ..observability.trace import get_tracer, trace_span
+from ..observability.slo import SloEvaluator, SloRule
+from ..observability.trace import (get_tracer, new_trace_id, trace_span,
+                                   trace_tags)
 from ..utils.logging import log_dist, logger
 from .prefix_cache import chain_keys
 from .sampling import SamplingParams
@@ -117,6 +119,7 @@ FLEET_DEAD_PREFIX = "fleet/dead"
 FLEET_ENGINES_PREFIX = "fleet/engines"
 FLEET_REQUESTS_PREFIX = "fleet/requests"
 FLEET_RESIDENCY_PREFIX = "fleet/residency"
+FLEET_TRACE_PREFIX = "fleet/trace"
 FLEET_COORDINATOR_KEY = "fleet/coordinator"
 FLEET_GENERATION_KEY = "fleet/generation"
 
@@ -180,6 +183,18 @@ class FleetMember:
         self.last_advert: Optional[Dict[str, Any]] = None
         self.last_residency: Optional[Dict[str, Any]] = None
         self._last_beat_t: Optional[float] = None   # store clock
+        # distributed-tracing segment publisher (docs/OBSERVABILITY.md
+        # "Distributed tracing"): built lazily on the first beat with the
+        # tracer enabled; publishes this member's completed spans (the
+        # ones tagged engine=<id> by pump()'s ambient tag context) under
+        # fleet/trace/<engine> so tools/trace_assemble.py can merge the
+        # fleet timeline.  None while tracing is off — zero store traffic.
+        self._trace_pub = None
+        # publisher rate limit on the host monotonic clock (beats are
+        # already store-clock rate-limited; this additionally bounds real
+        # store writes when an injected test clock makes beats cheap).
+        # Soaks set 0 so every beat publishes deterministically.
+        self.trace_publish_interval_s = 0.25
         self.metrics_server = None
         if metrics_port is not None:
             # N engines sharing a host with one configured port: the shared
@@ -280,6 +295,13 @@ class FleetMember:
             # rule names currently firing on this engine — the router
             # rolls the fleet-wide count up as fleet/alerts_firing
             "alerts_firing": list(h.get("alerts", [])),
+            # distributed-tracing segment accounting: spans this member
+            # published under fleet/trace/<engine> and segment-cap drops —
+            # the router rolls them up into the fleet/trace_* gauges
+            "trace_spans_published": (self._trace_pub.published_total
+                                      if self._trace_pub is not None else 0),
+            "trace_dropped": (self._trace_pub.dropped_total
+                              if self._trace_pub is not None else 0),
         }
 
     def beat(self, force: bool = False) -> None:
@@ -311,6 +333,30 @@ class FleetMember:
         self.last_residency = publish_residency(
             self.store, self.engine_id, self.residency_digest(),
             prefix=FLEET_RESIDENCY_PREFIX, generation=int(self.generation))
+        # completed-span segment publish rides the beat cadence (already
+        # rate-limited to lease_s/3) — a no-op while tracing is disabled
+        self.publish_trace_segments()
+
+    def publish_trace_segments(self, force: bool = False) -> int:
+        """Publish this member's newly completed spans (the ones pump()'s
+        ambient ``engine=<id>`` tag attributed to it) as a CAS-appended,
+        size-capped segment under ``fleet/trace/<engine>`` with a
+        monotonic↔epoch clock anchor (docs/OBSERVABILITY.md "Distributed
+        tracing").  Returns the spans published (0 with tracing off)."""
+        tracer = get_tracer()
+        if not tracer.enabled:
+            return 0
+        if self._trace_pub is None:
+            from ..observability.trace_assembly import TraceSegmentPublisher
+
+            eid = self.engine_id
+            self._trace_pub = TraceSegmentPublisher(
+                self.store, eid, prefix=FLEET_TRACE_PREFIX,
+                span_filter=lambda s: ((s.attrs or {}).get("engine") == eid
+                                       and not s.name.startswith("fleet.")),
+                min_interval_s=self.trace_publish_interval_s)
+        with trace_span("fleet.trace_publish", engine=self.engine_id):
+            return self._trace_pub.publish(tracer, force=force)
 
     # --------------------------------------------------------------- pumping
 
@@ -323,18 +369,23 @@ class FleetMember:
         if not self.alive:
             raise EngineDead(f"engine {self.engine_id} is dead")
         sup = self.sup
-        try:
-            return sup.engine.step()
-        except (KeyboardInterrupt, ServeTimeout):
-            raise
-        except SlotPrefillError as e:
-            if sup.engine.pool_alive():
-                logger.warning("fleet[%s]: continuing past %s",
-                               self.engine_id, e)
-                return self.outstanding()
-            return self._recover(e)
-        except Exception as e:
-            return self._recover(e)
+        # ambient engine tag: every span this member's tick (or recovery)
+        # opens carries engine=<id>, which is what attributes spans to
+        # members when N in-process members share one tracer ring — and
+        # names the engine in production per-process rings too
+        with trace_tags(engine=self.engine_id):
+            try:
+                return sup.engine.step()
+            except (KeyboardInterrupt, ServeTimeout):
+                raise
+            except SlotPrefillError as e:
+                if sup.engine.pool_alive():
+                    logger.warning("fleet[%s]: continuing past %s",
+                                   self.engine_id, e)
+                    return self.outstanding()
+                return self._recover(e)
+            except Exception as e:
+                return self._recover(e)
 
     def _recover(self, cause: BaseException) -> int:
         try:
@@ -388,7 +439,8 @@ class FleetRouter:
                  journal_flush_ms: Optional[float] = None,
                  max_journal_tokens: int = 4096,
                  prefix_affinity: bool = True,
-                 affinity_load_slack: int = 2):
+                 affinity_load_slack: int = 2,
+                 slo_rules: Optional[List[SloRule]] = None):
         self.store = store
         self.members: Dict[str, FleetMember] = {}
         for m in members:
@@ -451,6 +503,22 @@ class FleetRouter:
         # are baked into the live assignment's prompt (KV reconstruction),
         # so collected outputs are stitched back behind them
         self._resumed: Dict[Any, List[int]] = {}
+        # rid -> router-recorded lifecycle events (failover/resume markers,
+        # src = the engine id involved) — journaled alongside the tokens so
+        # a successor coordinator stitches the same record the dispatching
+        # router would have (docs/OBSERVABILITY.md "Distributed tracing")
+        self._lifecycle: Dict[Any, List] = {}
+        # router-side SLO evaluation over the fleet rollup gauges
+        # (docs/FLEET.md "Router-side SLOs"): same SloRule/SloEvaluator the
+        # engines run, evaluated once per coordinator round AFTER the gauge
+        # write so e.g. "fleet/journal_bytes < N" sees this round's value;
+        # firing states land on health()["router_alerts"] and — via the
+        # alert{rule=...} gauges — as dstpu_alert on the router's /metrics
+        self._slo = SloEvaluator(slo_rules) if slo_rules else None
+        # router-half trace-segment publisher (fleet.* spans); lazy like
+        # the member half, inert while tracing is disabled
+        self._trace_pub = None
+        self.trace_publish_interval_s = 0.25
         # rid -> the journal document as last written/read by THIS router:
         # the CAS `expected` for the next append, and the byte-accounting
         # source for the fleet/journal_bytes gauge
@@ -518,6 +586,11 @@ class FleetRouter:
             request = dataclasses.replace(
                 request,
                 arrival_epoch_s=self._t0 + max(0.0, request.arrival_time))
+        if request.trace_id is None:
+            # the router is the request's first hop: assign the fleet-wide
+            # trace id here so every dispatch, journal entry and failover
+            # reconstruction carries the SAME id (docs/OBSERVABILITY.md)
+            request = dataclasses.replace(request, trace_id=new_trace_id())
         self._requests[rid] = request
         if request.arrival_time > 0:
             # journal BEFORE parking (engine=None: accepted, not yet
@@ -668,6 +741,12 @@ class FleetRouter:
             arrival_time=max(0.0,
                              time.monotonic() - member.sup.engine._t0),
             deadline_s=self._remaining_deadline(request))
+        if resumed:
+            # lifecycle resume marker (src = the engine continuing the
+            # stream) — recorded BEFORE the journal write below so the
+            # entry a successor adopts carries it too
+            self._lifecycle.setdefault(rid, []).append(
+                ("resume", time.monotonic(), target))
         # journal BEFORE dispatch: a failover/redistribution write that
         # loses its CAS means a successor coordinator owns this request —
         # submitting it here anyway would re-serve a stream the successor
@@ -688,12 +767,15 @@ class FleetRouter:
         hint = (self.members[target].sup.engine._retry_after_hint()
                 if target is not None else 1.0)
         rid = request.rid
+        lc = self._lifecycle.pop(rid, [])
+        lc.append(("shed", t, self.router_id))
         self._results[rid] = RequestResult(
             rid=rid, input_ids=request.input_ids,
             output_ids=np.zeros((0,), np.int32), finish_reason="shed",
             prefill_bucket=0,
             arrival_s=request.arrival_epoch_s or t, admit_s=t,
-            first_token_s=t, finish_s=t, retry_after_s=hint)
+            first_token_s=t, finish_s=t, retry_after_s=hint,
+            trace_id=request.trace_id, lifecycle=lc)
         self._order.append(rid)
         self._requests.pop(rid, None)
         # a shed request may have been journaled at submit (future
@@ -747,6 +829,12 @@ class FleetRouter:
             "sampling": (dataclasses.asdict(request.sampling)
                          if request.sampling is not None else None),
             "lane_counter": len(request.input_ids) + len(resumed),
+            # distributed tracing (docs/OBSERVABILITY.md): the trace id —
+            # a failover reconstruction continues the SAME trace on the
+            # new engine — plus the router-recorded lifecycle markers
+            # (failover/resume) so a successor stitches the same record
+            "trace_id": request.trace_id,
+            "lifecycle": [list(e) for e in self._lifecycle.get(rid, ())],
             "t": self.store.now()}
         key = f"{FLEET_REQUESTS_PREFIX}/{_rid_key(rid)}"
         expected = self._journal_docs.get(rid)
@@ -819,6 +907,7 @@ class FleetRouter:
         self._journal_docs.pop(rid, None)
         self._journal_sizes.pop(rid, None)
         self._resumed.pop(rid, None)
+        self._lifecycle.pop(rid, None)
 
     def journal_bytes(self) -> int:
         """Approximate bytes of journal entries this coordinator currently
@@ -916,7 +1005,10 @@ class FleetRouter:
         if not self.is_coordinator or lease.term != self.term:
             self._take_over(lease)
         self._tick += 1
-        with trace_span("fleet.tick", tick=self._tick):
+        # ambient router tag (mirrors the member's engine tag): attributes
+        # fleet.* spans to THIS router when standbys share a process ring
+        with trace_tags(router=self.router_id), \
+                trace_span("fleet.tick", tick=self._tick):
             for eid in sorted(self.members):
                 m = self.members[eid]
                 if m.alive:
@@ -954,7 +1046,43 @@ class FleetRouter:
                 self.journal_flushes_total += 1
             self._scan_leases()
             self._write_gauges()
+            if self._slo is not None:
+                # router-side SLOs (docs/FLEET.md): evaluated AFTER the
+                # gauge write so rules over fleet/* rollups see this
+                # round's values; firing states ride the monitor as
+                # alert{rule=...} -> dstpu_alert on the router's /metrics
+                self._slo.evaluate(monitor=self.monitor,
+                                   tracer=get_tracer())
+                if self.monitor is not None:
+                    self.monitor.write_events(
+                        self._slo.gauge_events(self._tick))
+            self.publish_trace_segments()
         return self.outstanding()
+
+    def router_alerts(self) -> List[str]:
+        """Names of router-side SLO rules currently firing (empty when no
+        ``slo_rules`` are configured)."""
+        return self._slo.firing() if self._slo is not None else []
+
+    def publish_trace_segments(self, force: bool = False) -> int:
+        """Publish the router half of the fleet trace — the ``fleet.*``
+        spans (tick, election, failover, rolling_restart) — under
+        ``fleet/trace/<router_id>``.  A no-op while tracing is off."""
+        tracer = get_tracer()
+        if not tracer.enabled:
+            return 0
+        if self._trace_pub is None:
+            from ..observability.trace_assembly import TraceSegmentPublisher
+
+            rid_ = self.router_id
+            self._trace_pub = TraceSegmentPublisher(
+                self.store, rid_, prefix=FLEET_TRACE_PREFIX,
+                span_filter=lambda s: (s.name.startswith("fleet.")
+                                       and (s.attrs or {}).get("router")
+                                       == rid_),
+                min_interval_s=self.trace_publish_interval_s)
+        return self._trace_pub.publish(tracer, force=force,
+                                       attrs={"term": int(self.term)})
 
     def outstanding(self) -> int:
         return len(self._requests)
@@ -1020,6 +1148,15 @@ class FleetRouter:
                     resumed_tokens=len(resumed))
             if fo:
                 res = dataclasses.replace(res, failovers=fo)
+            lc = self._lifecycle.pop(rid, None)
+            if lc:
+                # router-recorded failover/resume markers lead into the
+                # finishing engine's own record: t is monotonic per
+                # process, so within one process the merged record reads
+                # in order; cross-process ordering is the trace assembly's
+                # job (clock anchors), not the lifecycle's
+                res = dataclasses.replace(res,
+                                          lifecycle=lc + res.lifecycle)
             self._results[rid] = res
             self._order.append(rid)
             self._owner.pop(rid, None)
@@ -1075,6 +1212,13 @@ class FleetRouter:
                 self._failover(eid, "dead marker" if eid in marked else desc)
 
     def _failover(self, engine_id: str, why: str) -> None:
+        # tagged here, not only in step(): benches/tests trigger failover
+        # from on_tick hooks outside the step tag, and the failover spans
+        # must still attribute to THIS router's trace segment
+        with trace_tags(router=self.router_id):
+            self._failover_tagged(engine_id, why)
+
+    def _failover_tagged(self, engine_id: str, why: str) -> None:
         m = self.members.get(engine_id)
         if m is not None:
             m.alive = False
@@ -1093,6 +1237,8 @@ class FleetRouter:
             self._owner.pop(rid)
             self.failovers_total += 1
             self._failed_over[rid] = self._failed_over.get(rid, 0) + 1
+            self._lifecycle.setdefault(rid, []).append(
+                ("failover", time.monotonic(), engine_id))
             journaled = self._journaled_tokens(rid)
             with trace_span("fleet.failover", rid=rid,
                             from_engine=engine_id,
@@ -1141,6 +1287,8 @@ class FleetRouter:
     def _finish_from_journal(self, rid: Any, req: Request,
                              journaled: List[int], reason: str) -> None:
         t = time.monotonic()
+        lc = self._lifecycle.pop(rid, [])
+        lc.append(("finish", t, "journal"))
         self._results[rid] = RequestResult(
             rid=rid, input_ids=req.input_ids,
             output_ids=np.asarray(journaled, np.int32),
@@ -1148,7 +1296,8 @@ class FleetRouter:
             arrival_s=req.arrival_epoch_s or t, admit_s=t,
             first_token_s=t, finish_s=t,
             resumed_tokens=len(journaled),
-            failovers=self._failed_over.pop(rid, 0))
+            failovers=self._failed_over.pop(rid, 0),
+            trace_id=req.trace_id, lifecycle=lc)
         self._order.append(rid)
         self._requests.pop(rid, None)
         self._journal_delete(rid)
@@ -1164,8 +1313,9 @@ class FleetRouter:
         cannot tear or double-apply it) and adopt the request journal, so
         work dispatched by the previous coordinator is tracked, failed
         over and completed by this one."""
-        with trace_span("fleet.election", router=self.router_id,
-                        term=lease.term):
+        with trace_tags(router=self.router_id), \
+                trace_span("fleet.election", router=self.router_id,
+                           term=lease.term):
             self.is_coordinator = True
             self.term = lease.term
             self.elections_total += 1
@@ -1195,6 +1345,9 @@ class FleetRouter:
                             (rec.get("tokens") or [])[:int(rec["resumed"])]]
                     else:
                         self._resumed.pop(rid, None)
+                    if rec.get("lifecycle"):
+                        self._lifecycle[rid] = [
+                            tuple(e) for e in rec["lifecycle"]]
                     if rec.get("failovers"):
                         self._failed_over[rid] = int(rec["failovers"])
                     if rec["engine"] is not None:
@@ -1213,10 +1366,16 @@ class FleetRouter:
                     # prompt + journaled length; `lane_counter` documents
                     # it for operators and cross-implementations)
                     sampling=(SamplingParams(**rec["sampling"])
-                              if rec.get("sampling") else None))
+                              if rec.get("sampling") else None),
+                    # the journaled trace id: the adopted request stays
+                    # ONE trace across coordinator takeovers too
+                    trace_id=rec.get("trace_id"))
                 self._requests[rid] = req
                 if rec.get("failovers"):
                     self._failed_over[rid] = int(rec["failovers"])
+                if rec.get("lifecycle"):
+                    self._lifecycle[rid] = [tuple(e)
+                                            for e in rec["lifecycle"]]
                 # adopt the token-journal state: the document is the CAS
                 # base for this router's future appends, and `resumed`
                 # tokens are baked into the LIVE assignment's prompt — the
@@ -1279,7 +1438,11 @@ class FleetRouter:
             m.routable = False
             unserved: List[Request] = []
             try:
-                with trace_span("fleet.rolling_restart", engine=eid):
+                with trace_tags(router=self.router_id), \
+                        trace_span("fleet.rolling_restart", engine=eid), \
+                        trace_tags(engine=eid):
+                    # ambient tag: the drain/recycle serve.* spans belong
+                    # to the member being restarted, not the router
                     unserved = m.sup.drain(max_ticks=max_ticks)
                     self._collect(m)
                     m.recycle()
@@ -1345,6 +1508,11 @@ class FleetRouter:
             # fleet-wide SLO rollup: every (engine, rule) currently firing
             # anywhere on the fleet, from the member advertisements
             "alerts_firing": self._alerts_rollup(ads),
+            # router-side SLO rules currently firing (docs/FLEET.md
+            # "Router-side SLOs") + their full per-rule states
+            "router_alerts": self.router_alerts(),
+            "router_slo_states": (self._slo.states()
+                                  if self._slo is not None else {}),
             "tokens_by_engine": dict(self.tokens_by_engine),
             "engines": ads,
         }
@@ -1441,4 +1609,19 @@ class FleetRouter:
             # breaching its objectives"
             ("fleet/alerts_firing", float(len(self._alerts_rollup(ads))),
              self._tick),
+            # distributed-tracing segment accounting (docs/OBSERVABILITY
+            # "Distributed tracing"): spans published to fleet/trace/* by
+            # the members (advertised) plus this router's own publisher,
+            # and segment-cap drops — a nonzero drop count means the
+            # fleet trace is windowed, not complete
+            ("fleet/trace_spans_published_total",
+             float(sum(int(ad.get("trace_spans_published", 0) or 0)
+                       for ad in ads.values())
+                   + (self._trace_pub.published_total
+                      if self._trace_pub is not None else 0)), self._tick),
+            ("fleet/trace_dropped_total",
+             float(sum(int(ad.get("trace_dropped", 0) or 0)
+                       for ad in ads.values())
+                   + (self._trace_pub.dropped_total
+                      if self._trace_pub is not None else 0)), self._tick),
         ])

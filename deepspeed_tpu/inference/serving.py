@@ -130,7 +130,8 @@ from ..observability.device_profiler import (device_trace_unit,
                                              maybe_capture_from_env)
 from ..observability.program_stats import ProgramCatalog
 from ..observability.slo import SloEvaluator, SloRule
-from ..observability.trace import get_tracer, trace_count, trace_span
+from ..observability.trace import (get_tracer, new_trace_id, trace_count,
+                                   trace_context, trace_span)
 from ..resilience import (SITE_SERVE_ADMIT, SITE_SERVE_DECODE,
                           SITE_SERVE_PREFILL, SITE_SERVE_TICK, maybe_fire)
 from ..utils.logging import log_dist, logger
@@ -200,6 +201,12 @@ class Request:
     # failover resume and cross-engine parity with generate(sampling=...)
     # all stay token-exact (docs/SERVING.md "Sampling").
     sampling: Optional[SamplingParams] = None
+    # fleet-wide trace id (docs/OBSERVABILITY.md "Distributed tracing"):
+    # one id per REQUEST, assigned at first submission (router or engine)
+    # and propagated verbatim through every hop — warm-restart replays,
+    # failover re-dispatches and journal reconstructions all continue the
+    # SAME trace, so one request is one trace across the whole fleet.
+    trace_id: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -247,6 +254,18 @@ class RequestResult:
     # They contribute no decode_ticks (decode_ticks counts the finishing
     # stream's own decode-program invocations).  0 = no mid-stream resume.
     resumed_tokens: int = 0
+    # the request's fleet-wide trace id (mirrors Request.trace_id)
+    trace_id: Optional[str] = None
+    # structured lifecycle record (docs/OBSERVABILITY.md "Distributed
+    # tracing"): ordered (event, t, src) tuples covering
+    # queued→admit→[prefix_match/cow]→prefill→first_token→
+    # [replay|failover|resume]→finish.  `t` is time.monotonic() on the
+    # recording process; `src` is the engine incarnation (int) for
+    # engine-recorded events and an engine/router id (str) for
+    # fleet-recorded ones.  ServingSupervisor and FleetRouter stitch the
+    # record across incarnations and engines exactly like they stitch
+    # tokens, so a failed-over request's record reads end to end.
+    lifecycle: List = dataclasses.field(default_factory=list)
 
     @property
     def ttft_s(self) -> float:
@@ -277,6 +296,9 @@ class _Slot:
     # not one).  Without speculation this is len(tokens) - 1; a speculative
     # verify tick emits 1..k+1 tokens per invocation, so it can be less.
     decode_ticks: int = 0
+    # lifecycle events recorded so far (moved from _lifecycle_pending at
+    # admission; the finish event completes it into RequestResult)
+    lifecycle: List = dataclasses.field(default_factory=list)
 
 
 class ServingEngine:
@@ -447,6 +469,15 @@ class ServingEngine:
         # queued + pending + in-flight + unclaimed results, for O(1)
         # duplicate-rid rejection (removed when the result is claimed)
         self._live_rids: set = set()
+        # which engine incarnation this is under its supervisor (0 = the
+        # first build; warm restarts and recycles stamp replacement
+        # engines +1) — lifecycle events carry it so a stitched record
+        # shows which incarnation served each phase
+        self.engine_incarnation = 0
+        # rid -> lifecycle events recorded before the request owns a slot
+        # (the "queued" stamp); moved into the slot at admission, or
+        # flushed into the terminal result for shed/expired requests
+        self._lifecycle_pending: Dict[Any, List] = {}
         self._results: Dict[Any, RequestResult] = {}
         self._finished_order: List[Any] = []
         self._tick = 0
@@ -990,11 +1021,14 @@ class ServingEngine:
         never parked on an unbounded queue."""
         t = time.monotonic()
         hint = self._retry_after_hint()
+        lc = self._lifecycle_pending.pop(request.rid, [])
+        lc.append(("shed", t, self.engine_incarnation))
         self._results[request.rid] = RequestResult(
             rid=request.rid, input_ids=request.input_ids,
             output_ids=np.zeros((0,), np.int32), finish_reason="shed",
             prefill_bucket=0, arrival_s=t, admit_s=t, first_token_s=t,
-            finish_s=t, retry_after_s=hint)
+            finish_s=t, retry_after_s=hint, trace_id=request.trace_id,
+            lifecycle=lc)
         self._finished_order.append(request.rid)
         self._live_rids.add(request.rid)
         self.shed_count += 1
@@ -1016,13 +1050,16 @@ class ServingEngine:
                         and now >= req.arrival_time + req.deadline_s):
                     self._waiting_deadlines -= 1
                     t = time.monotonic()
+                    lc = self._lifecycle_pending.pop(req.rid, [])
+                    lc.append(("deadline", t, self.engine_incarnation))
                     self._results[req.rid] = RequestResult(
                         rid=req.rid, input_ids=req.input_ids,
                         output_ids=np.zeros((0,), np.int32),
                         finish_reason="deadline", prefill_bucket=0,
                         arrival_s=self._arrival_abs(req), admit_s=t,
                         first_token_s=t, finish_s=t,
-                        retry_after_s=self._retry_after_hint())
+                        retry_after_s=self._retry_after_hint(),
+                        trace_id=req.trace_id, lifecycle=lc)
                     self._finished_order.append(req.rid)
                     self.deadline_count += 1
                     logger.warning("serve: request %r expired in queue "
@@ -1076,12 +1113,20 @@ class ServingEngine:
             raise ValueError(
                 f"request id {rid!r} is already queued, in flight, or has "
                 f"an unclaimed result — rids must be unique")
+        if request.trace_id is None:
+            # first hop of a standalone engine: assign the fleet-wide
+            # trace id here (a FleetRouter assigns before dispatch, and
+            # replays/failovers arrive with the original id — accepted
+            # verbatim so the request stays ONE trace end to end)
+            request = dataclasses.replace(request, trace_id=new_trace_id())
         backlog = len(self._queue) + len(self._pending)
         if self._draining or (self.max_queue is not None
                               and backlog >= self.max_queue):
             return self._shed(request,
                               "draining" if self._draining else "queue full")
         self._live_rids.add(rid)
+        self._lifecycle_pending[rid] = [
+            ("queued", time.monotonic(), self.engine_incarnation)]
         if request.deadline_s is not None:
             self._waiting_deadlines += 1
         if request.arrival_time > 0:
@@ -1105,54 +1150,63 @@ class ServingEngine:
                             and not self._quarantined[i])
             except StopIteration:
                 break
-            match = self._prefix_lookup(req)
-            # pin the matched DEVICE pages (incl. the COW source) for the
-            # span of this admission: reclaim below — or a concurrent
-            # eviction by the index's own LRU cap — must never free a
-            # matched page back into the pool it is about to be mapped
-            # from.  Demoted chunks (-1) have no device page to pin; their
-            # host buffers are LRU-touched instead so a capacity eviction
-            # during reclaim prefers other victims.
-            pinned = [p for p in match.pages if p >= 0]
-            if match.cow_src is not None:
-                pinned.append(match.cow_src)
-            for p in pinned:
-                self._share_page(p)
-            n_demoted = sum(1 for p in match.pages if p < 0)
-            if n_demoted and self._tier is not None:
-                for i, p in enumerate(match.pages):
-                    if p < 0:
-                        self._tier.touch(match.keys[i])
             admitted = freed_pins = promote_retry = False
-            try:
-                # demoted chunks each need one free device page for their
-                # promotion on top of the private remainder
-                need = self._pages_needed(req) - len(match.pages)
-                if len(self._free_pages) < need + n_demoted:
-                    # reclaim (demote/evict) cached-but-idle prefix pages
-                    # before blocking: a cache must never starve admission
-                    self._reclaim_cached(need + n_demoted
-                                         - len(self._free_pages))
-                if len(self._free_pages) >= need + n_demoted:
-                    if n_demoted and not self._promote_match(match):
-                        # a matched host buffer vanished (host-capacity
-                        # eviction raced the lookup): retry with a fresh,
-                        # strictly smaller lookup
-                        promote_retry = True
-                    else:
-                        with trace_span("serve.admit", rid=req.rid,
-                                        slot=slot):
-                            self._admit_one(req, slot, match, need, now)
-                        admitted = True
-            finally:
-                # the slot takes its own references inside _admit_one; the
-                # lookup pins existed only to survive reclaim.  If reclaim
-                # evicted the head's OWN matched entries, our pins are now
-                # the last references — dropping them frees the pages.
-                if not admitted:
-                    freed_pins = any(self._refcount[p] == 1 for p in pinned)
+            # the owning request's trace context (docs/OBSERVABILITY.md
+            # "Distributed tracing"): every span this admission opens —
+            # prefix_match, demote/promote under reclaim, COW, admit,
+            # prefill — inherits the request's trace_id/rid tags
+            with trace_context(req.trace_id, req.rid):
+                match = self._prefix_lookup(req)
+                # pin the matched DEVICE pages (incl. the COW source) for
+                # the span of this admission: reclaim below — or a
+                # concurrent eviction by the index's own LRU cap — must
+                # never free a matched page back into the pool it is about
+                # to be mapped from.  Demoted chunks (-1) have no device
+                # page to pin; their host buffers are LRU-touched instead
+                # so a capacity eviction during reclaim prefers other
+                # victims.
+                pinned = [p for p in match.pages if p >= 0]
+                if match.cow_src is not None:
+                    pinned.append(match.cow_src)
                 for p in pinned:
-                    self._drop_page(p)
+                    self._share_page(p)
+                n_demoted = sum(1 for p in match.pages if p < 0)
+                if n_demoted and self._tier is not None:
+                    for i, p in enumerate(match.pages):
+                        if p < 0:
+                            self._tier.touch(match.keys[i])
+                try:
+                    # demoted chunks each need one free device page for
+                    # their promotion on top of the private remainder
+                    need = self._pages_needed(req) - len(match.pages)
+                    if len(self._free_pages) < need + n_demoted:
+                        # reclaim (demote/evict) cached-but-idle prefix
+                        # pages before blocking: a cache must never starve
+                        # admission
+                        self._reclaim_cached(need + n_demoted
+                                             - len(self._free_pages))
+                    if len(self._free_pages) >= need + n_demoted:
+                        if n_demoted and not self._promote_match(match):
+                            # a matched host buffer vanished (host-capacity
+                            # eviction raced the lookup): retry with a
+                            # fresh, strictly smaller lookup
+                            promote_retry = True
+                        else:
+                            with trace_span("serve.admit", rid=req.rid,
+                                            slot=slot):
+                                self._admit_one(req, slot, match, need, now)
+                            admitted = True
+                finally:
+                    # the slot takes its own references inside _admit_one;
+                    # the lookup pins existed only to survive reclaim.  If
+                    # reclaim evicted the head's OWN matched entries, our
+                    # pins are now the last references — dropping them
+                    # frees the pages.
+                    if not admitted:
+                        freed_pins = any(self._refcount[p] == 1
+                                         for p in pinned)
+                    for p in pinned:
+                        self._drop_page(p)
             if admitted:
                 continue
             if freed_pins or promote_retry:
@@ -1176,6 +1230,8 @@ class ServingEngine:
         # request queued (recoverable), not silently dropped
         maybe_fire(SITE_SERVE_ADMIT, rid=req.rid, slot=slot)
         self._queue.popleft()
+        self._lifecycle_pending.setdefault(req.rid, []).append(
+            ("admit", time.monotonic(), self.engine_incarnation))
         if req.deadline_s is not None:
             self._waiting_deadlines -= 1
         shared = list(match.pages)
@@ -1310,10 +1366,18 @@ class ServingEngine:
                                        n_shared)
         t = time.monotonic()
         self._slot_failures[slot] = 0   # quarantine counts CONSECUTIVE fails
+        lc = self._lifecycle_pending.pop(req.rid, [])
+        inc = self.engine_incarnation
+        if n_shared > 0:
+            lc.append(("prefix_match", t, inc))
+        if match.cow_src is not None:
+            lc.append(("cow", t, inc))
+        lc.append(("prefill", t, inc))
+        lc.append(("first_token", t, inc))
         self._slots[slot] = _Slot(
             request=req, pages=pages, tokens=[tok], bucket=s_pad,
             arrival_s=self._arrival_abs(req), admit_s=self._t0 + now,
-            first_token_s=t, shared_tokens=n_shared)
+            first_token_s=t, shared_tokens=n_shared, lifecycle=lc)
         self._lengths[slot] = S
         self._last_tok[slot] = tok
         self._active[slot] = True
@@ -1349,6 +1413,12 @@ class ServingEngine:
         elif req.max_new_tokens == 1:
             self._finish(slot, "length")
 
+    def _slot_rid_map(self) -> Dict[str, str]:
+        """Active slot → rid, stringified for trace-event ``args`` (only
+        built when tracing is enabled — the disabled tick never pays it)."""
+        return {str(int(s)): str(self._slots[s].request.rid)
+                for s in np.flatnonzero(self._active)}
+
     def _armed(self, label: str):
         """Watchdog deadline around a device call (+ its host fetch), or a
         no-op context when no watchdog is attached."""
@@ -1362,12 +1432,20 @@ class ServingEngine:
         return self._exec.lanes(self._lane_temp, self._lane_top_k,
                                 self._lane_top_p, self._lane_seed)
 
-    def _decode_tick(self) -> None:
+    def _decode_tick(self, rid_map: Optional[Dict[str, str]] = None) -> None:
         if self._spec is not None:
-            self._spec_tick()
+            self._spec_tick(rid_map)
             return
         lanes = self._lanes_jnp()
-        with trace_span("serve.decode", tick=self._tick):
+        with trace_span("serve.decode", tick=self._tick) as sp:
+            # tick-level slot→rid map (docs/OBSERVABILITY.md "Distributed
+            # tracing"): a decode tick serves many requests at once, so
+            # instead of one owning context the span is tagged with every
+            # slot's rid — a poisoned-tick flight dump names exactly the
+            # streams it was serving.  Built once per tick by step()
+            # (None while tracing is off).
+            if rid_map is not None:
+                sp.set(slot_rids=rid_map)
             maybe_fire(SITE_SERVE_DECODE, tick=self._tick)
             with self._armed(f"serve.decode tick {self._tick}"):
                 nxt = self._exec.decode(self._page_table, self._lengths,
@@ -1389,14 +1467,16 @@ class ServingEngine:
             elif len(st.tokens) >= req.max_new_tokens:
                 self._finish(slot, "length")
 
-    def _spec_tick(self) -> None:
+    def _spec_tick(self, rid_map: Optional[Dict[str, str]] = None) -> None:
         """Speculative decode tick: k draft proposals + one verify-k pass,
         then per-slot host bookkeeping consuming 1..k emitted tokens
         (truncated by the slot's own eos / remaining budget — rejected or
         over-budget draft K/V past the consumed length is causally
         invisible garbage the next tick's writes overwrite)."""
         with trace_span("serve.decode", tick=self._tick,
-                        speculative=self._spec.k):
+                        speculative=self._spec.k) as sp:
+            if rid_map is not None:
+                sp.set(slot_rids=rid_map)
             maybe_fire(SITE_SERVE_DECODE, tick=self._tick)
             with self._armed(f"serve.decode tick {self._tick} "
                              f"(speculative k={self._spec.k})"):
@@ -1433,17 +1513,20 @@ class ServingEngine:
 
     def _finish(self, slot: int, reason: str) -> None:
         st = self._slots[slot]
+        finish_t = time.monotonic()
+        st.lifecycle.append(("finish", finish_t, self.engine_incarnation))
         result = RequestResult(
             rid=st.request.rid, input_ids=st.request.input_ids,
             output_ids=np.asarray(st.tokens, np.int32),
             finish_reason=reason, prefill_bucket=st.bucket,
             arrival_s=st.arrival_s, admit_s=st.admit_s,
-            first_token_s=st.first_token_s, finish_s=time.monotonic(),
+            first_token_s=st.first_token_s, finish_s=finish_t,
             # the prefill produced tokens[0]; every later token came from a
             # decode-program invocation (== len(tokens) - 1 without
             # speculation; a speculative verify tick emits several)
             decode_ticks=st.decode_ticks,
-            shared_prefix_tokens=st.shared_tokens)
+            shared_prefix_tokens=st.shared_tokens,
+            trace_id=st.request.trace_id, lifecycle=st.lifecycle)
         if reason == "deadline":
             self.deadline_count += 1
         else:
@@ -1568,7 +1651,7 @@ class ServingEngine:
                 "were preserved by the admission unwind (ServingSupervisor "
                 "automates the rebuild and replays in-flight work)")
         self._tick += 1
-        with trace_span("serve.tick", tick=self._tick):
+        with trace_span("serve.tick", tick=self._tick) as sp:
             maybe_fire(SITE_SERVE_TICK, tick=self._tick)
             if now is None:
                 now = time.monotonic() - self._t0
@@ -1579,7 +1662,12 @@ class ServingEngine:
             if not self._draining:
                 self._admit(now)
             if self._active.any():
-                self._decode_tick()
+                rid_map = (self._slot_rid_map() if get_tracer().enabled
+                           else None)
+                if rid_map is not None:
+                    # tick span carries the slot→rid map it decoded under
+                    sp.set(slot_rids=rid_map)
+                self._decode_tick(rid_map)
                 # refill slots the decode just retired — the queue head
                 # starts its prefill this tick instead of idling one
                 # scheduler round
@@ -1814,6 +1902,10 @@ class ServingEngine:
         self._pending.clear()
         self._waiting_deadlines = 0
         self._live_rids.difference_update(r.rid for r in unserved)
+        for r in unserved:
+            # the hand-off target's submit() starts a fresh queued stamp;
+            # keeping these would leak entries for requests we no longer own
+            self._lifecycle_pending.pop(r.rid, None)
         log_dist(f"serve: drained — {len(unserved)} unserved request(s) "
                  f"handed back, {len(self._finished_order)} result(s) "
                  "claimable", ranks=[0])

@@ -140,6 +140,10 @@ class ServingSupervisor:
         # rid -> tokens decoded in previous engine incarnations; replay
         # outputs are prefixed with these when results are stitched
         self._prefix: Dict[Any, List[int]] = {}
+        # rid -> lifecycle events from previous incarnations (each replay
+        # appends a ("replay", t, new_incarnation) marker); stitched in
+        # front of the finishing incarnation's record exactly like tokens
+        self._lifecycle: Dict[Any, List] = {}
         # rid -> number of in-flight replays (stamped on stitched results)
         self._replay_count: Dict[Any, int] = {}
         self._collected: Dict[Any, RequestResult] = {}
@@ -284,6 +288,7 @@ class ServingSupervisor:
             for r in handed:
                 self._prefix.pop(r.rid, None)
                 self._replay_count.pop(r.rid, None)
+                self._lifecycle.pop(r.rid, None)
             return handed
 
     def take_results(self) -> List[RequestResult]:
@@ -360,6 +365,7 @@ class ServingSupervisor:
         prefix = self._prefix.pop(res.rid, None)
         orig = self._orig.pop(res.rid, None)
         replays = self._replay_count.pop(res.rid, 0)
+        lifecycle = self._lifecycle.pop(res.rid, None)
         if prefix:
             # a replayed request: its engine-side prompt was orig + prefix
             # and its output is the continuation — stitch the caller-facing
@@ -380,6 +386,12 @@ class ServingSupervisor:
                 replays=replays)
         elif replays:
             res = dataclasses.replace(res, replays=replays)
+        if lifecycle:
+            # dead incarnations' events (queued/admit/prefill/... plus the
+            # replay markers) lead; the finishing incarnation's record
+            # follows — one end-to-end lifecycle per request
+            res = dataclasses.replace(res,
+                                      lifecycle=lifecycle + res.lifecycle)
         self._collected[res.rid] = res
         self._order.append(res.rid)
 
@@ -467,6 +479,10 @@ class ServingSupervisor:
         # rides along so the very first retry_after_s hints out of the
         # replacement engine reflect reality, not the cold-start floor.
         new = self.engine_factory()
+        # incarnation stamp (docs/OBSERVABILITY.md "Distributed tracing"):
+        # lifecycle events carry it, so a stitched record shows which
+        # incarnation served each phase of a replayed stream
+        new.engine_incarnation = old.engine_incarnation + 1
         reused = self._adopt_programs(new, old)
         # weight-epoch carry (docs/HYBRID.md): a factory whose captured
         # params predate live update_params() calls would replay under
@@ -496,7 +512,8 @@ class ServingSupervisor:
                 with trace_span("serve.replay", rid=req.rid,
                                 generated=len(st.tokens)):
                     new.submit(replay)
-                replayed.append((req.rid, list(st.tokens)))
+                replayed.append((req.rid, list(st.tokens),
+                                 list(st.lifecycle)))
             if drain:
                 # mid-drain recovery: never-served waiting requests are
                 # handed back, not re-served — stash them.  But a QUEUED
@@ -522,9 +539,24 @@ class ServingSupervisor:
             new.max_queue = saved_max_queue
         # (6) commit: prefixes only once every submission landed, so a
         # failed restart never double-counts replay tokens
-        for rid, tokens in replayed:
+        replay_t = time.monotonic()
+        for rid, tokens, lc in replayed:
             self._prefix[rid] = self._prefix.get(rid, []) + tokens
             self._replay_count[rid] = self._replay_count.get(rid, 0) + 1
+            # lifecycle carry: the dead incarnation's events plus a replay
+            # marker stamped with the REPLACEMENT's incarnation (the
+            # engine-side events that follow carry the same number)
+            self._lifecycle[rid] = (
+                self._lifecycle.get(rid, []) + lc
+                + [("replay", replay_t, new.engine_incarnation)])
+        for req in waiting:
+            # a waiting request's only event so far is its queued stamp —
+            # carry it so the stitched record keeps the TRUE first-queued
+            # time (re-submission on the replacement stamps another)
+            lc = old._lifecycle_pending.get(req.rid)
+            if lc:
+                self._lifecycle[req.rid] = (self._lifecycle.get(req.rid, [])
+                                            + list(lc))
         self._carry_counters(old)
         self.engine = new
         entry = {
@@ -609,6 +641,7 @@ class ServingSupervisor:
         for res in old.take_results():
             self._collect(res)
         new = self.engine_factory()
+        new.engine_incarnation = old.engine_incarnation + 1
         reused = self._adopt_programs(new, old)
         # live weights + epoch carry exactly as on a fault restart
         self._carry_weight_epoch(new, old)

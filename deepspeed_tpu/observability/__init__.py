@@ -30,8 +30,12 @@ from .flight_recorder import (CounterEvent, DEFAULT_CAPACITY,  # noqa: F401
                               FlightRecorder)
 from .trace import (DUMP_WINDOW_ENV, Span,  # noqa: F401
                     TRACE_CAPACITY_ENV, TRACE_ENV,
-                    Tracer, configure_tracer, dump_window_s, flight_dump,
-                    get_tracer, trace_count, trace_span)
+                    Tracer, configure_tracer, current_trace_tags,
+                    dump_window_s, flight_dump, get_tracer, new_trace_id,
+                    trace_context, trace_count, trace_span, trace_tags)
+from .trace_assembly import (TraceSegmentPublisher,  # noqa: F401
+                             assemble_fleet_trace, events_for_trace,
+                             load_segments)
 from .export import (METRICS_PORT_ENV, MetricsServer,  # noqa: F401
                      chrome_trace_events, get_metrics_server,
                      maybe_start_metrics_server,

@@ -26,10 +26,19 @@ import re
 from typing import Any, Dict, List, Optional
 
 
-def chrome_trace_events(records: List[Any]) -> List[Dict[str, Any]]:
-    """Render recorder records (spans + counters) as trace-event dicts."""
+def chrome_trace_events(records: List[Any],
+                        process_name: Optional[str] = None
+                        ) -> List[Dict[str, Any]]:
+    """Render recorder records (spans + counters) as trace-event dicts.
+    ``process_name`` additionally emits a ``process_name`` metadata event
+    (engine id, router term, supervisor incarnation — whatever names this
+    process) so a trace merged with others stays readable in Perfetto
+    without a pid decoder ring."""
     pid = os.getpid()
     events: List[Dict[str, Any]] = []
+    if process_name:
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": str(process_name)}})
     threads: Dict[int, str] = {}
     for r in records:
         if hasattr(r, "t0"):      # Span
@@ -71,15 +80,18 @@ def chrome_trace_events(records: List[Any]) -> List[Dict[str, Any]]:
 
 
 def write_chrome_trace(path: str, records: Optional[List[Any]] = None,
-                       metadata: Optional[Dict[str, Any]] = None) -> str:
+                       metadata: Optional[Dict[str, Any]] = None,
+                       process_name: Optional[str] = None) -> str:
     """Write a complete Chrome/Perfetto trace JSON.  ``records`` defaults
-    to the global tracer's full recorder snapshot."""
+    to the global tracer's full recorder snapshot; ``process_name`` names
+    this process's track (see :func:`chrome_trace_events`)."""
     if records is None:
         from .trace import get_tracer
 
         records = get_tracer().recorder.snapshot()
     doc: Dict[str, Any] = {
-        "traceEvents": chrome_trace_events(records),
+        "traceEvents": chrome_trace_events(records,
+                                           process_name=process_name),
         "displayTimeUnit": "ms",
     }
     if metadata:

@@ -36,6 +36,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 from .flight_recorder import CounterEvent, FlightRecorder
@@ -53,6 +54,83 @@ _DEVICE_ANNOTATION = None
 def _set_device_annotation_factory(factory) -> None:
     global _DEVICE_ANNOTATION
     _DEVICE_ANNOTATION = factory
+
+
+# --------------------------------------------------------------- trace context
+#
+# Request-scoped tracing (docs/OBSERVABILITY.md "Distributed tracing"): a
+# thread-local stack of TAG dicts.  `trace_context(trace_id, rid)` pushes
+# the request identity; `trace_tags(engine=...)` pushes ambient tags (the
+# fleet member's engine id, a rollout round's sequence id).  Every span
+# opened under an active context inherits the merged tags into its attrs,
+# so the flight ring, the Chrome/Perfetto export (`args`) and the published
+# fleet trace segments all carry the request identity with zero plumbing.
+# The stack holds PRE-MERGED dicts (child = parent ∪ own at push time), so
+# the per-span cost is one thread-local read; with the tracer disabled the
+# context manager body is skipped entirely and trace_span's ~280ns
+# disabled-callsite gate is untouched (disabled spans record nothing, so
+# tags would have nowhere to land anyway).
+
+_CTX = threading.local()
+
+
+def new_trace_id() -> str:
+    """A fresh fleet-unique trace id (one per request; every hop —
+    router, engines, failover replacements — propagates it verbatim)."""
+    return uuid.uuid4().hex[:16]
+
+
+class trace_context:
+    """Context manager activating a request trace context on this thread.
+
+    ``trace_context(trace_id, rid)`` tags every span opened under it with
+    ``trace_id``/``rid``; extra keyword tags ride along.  Contexts nest
+    (inner tags shadow outer ones) and are strictly thread-local.  When
+    the global tracer is disabled the manager is inert — no allocation,
+    no thread-local mutation."""
+
+    __slots__ = ("_tags", "_pushed")
+
+    def __init__(self, trace_id: Optional[str] = None, rid: Any = None,
+                 **tags):
+        if trace_id is not None:
+            tags["trace_id"] = trace_id
+        if rid is not None:
+            tags["rid"] = rid
+        self._tags = tags
+        self._pushed = False
+
+    def __enter__(self):
+        if not _GLOBAL.enabled or not self._tags:
+            return self
+        stack = getattr(_CTX, "stack", None)
+        if stack is None:
+            stack = _CTX.stack = []
+        merged = dict(stack[-1], **self._tags) if stack else self._tags
+        stack.append(merged)
+        self._pushed = True
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._pushed:
+            _CTX.stack.pop()
+            self._pushed = False
+        return False
+
+
+def trace_tags(**tags) -> trace_context:
+    """Ambient-tag context: like :class:`trace_context` but with no
+    request identity — e.g. ``trace_tags(engine="engine0")`` around a
+    fleet member's tick so every span it opens is attributable to that
+    member even when N in-process members share one tracer ring."""
+    return trace_context(None, None, **tags)
+
+
+def current_trace_tags() -> Optional[Dict[str, Any]]:
+    """The merged tag dict of this thread's active trace context, or
+    ``None`` — what every span opened right now would inherit."""
+    stack = getattr(_CTX, "stack", None)
+    return stack[-1] if stack else None
 
 
 class Span:
@@ -158,6 +236,12 @@ class _SpanCtx:
         self._tracer = tracer
         self._sync_tree = None
         self._annot = None
+        # active trace context (docs/OBSERVABILITY.md "Distributed
+        # tracing"): merge its tags under the explicit attrs — the span
+        # inherits trace_id/rid/ambient tags with explicit attrs winning
+        ctx = getattr(_CTX, "stack", None)
+        if ctx:
+            attrs = dict(ctx[-1], **attrs) if attrs else dict(ctx[-1])
         stack = tracer._thread_stack()
         parent = stack[-1].name if stack else None
         self._span = Span(name, 0.0, threading.get_ident(),

@@ -269,12 +269,7 @@ def host_payload_files(ckpt_dir: str, process_index: int = 0) -> List[str]:
     """
     mine: List[str] = []
     for rel in sorted(_payload_listing(ckpt_dir)):
-        owner = None
-        for comp in rel.replace(os.sep, "/").split("/"):
-            m = _PROCESS_COMPONENT.search(comp)
-            if m is not None:
-                owner = int(m.group(1))
-                break
+        owner = _path_process_owner(rel)
         if owner == int(process_index) or (owner is None
                                            and int(process_index) == 0):
             mine.append(rel)
@@ -283,11 +278,20 @@ def host_payload_files(ckpt_dir: str, process_index: int = 0) -> List[str]:
 
 def write_host_manifest(ckpt_dir: str, host_id: str, generation: int,
                         global_steps: int,
-                        files: Optional[List[str]] = None) -> str:
+                        files: Optional[List[str]] = None,
+                        owner: Optional[int] = None) -> str:
     """Land one host's shard manifest: relative ``files`` (the shard files
     THIS host wrote, already durable) with size + sha256.  Fires the
     ``ckpt.shard_commit`` fault site before writing — the commit unit chaos
-    tests kill to produce torn pod checkpoints."""
+    tests kill to produce torn pod checkpoints.
+
+    ``owner`` stamps the manifest with the process index whose payload
+    files it attests (the same index :func:`host_payload_files` partitions
+    by).  Verification then cross-checks every listed path's path-derived
+    process component against the stamp: a file whose path names process
+    ``k`` attested under a manifest stamped ``j != k`` fails LOUDLY at
+    commit/verify time instead of silently mis-attributing (the path-based
+    attribution window the ROADMAP carried)."""
     from .fault_injection import SITE_SHARD_COMMIT, maybe_fire
 
     maybe_fire(SITE_SHARD_COMMIT, path=ckpt_dir, host=host_id,
@@ -298,9 +302,42 @@ def write_host_manifest(ckpt_dir: str, host_id: str, generation: int,
         listing[rel] = {"size": os.path.getsize(p), "sha256": _sha256(p)}
     doc = {"host_id": str(host_id), "generation": int(generation),
            "global_steps": int(global_steps), "files": listing}
+    if owner is not None:
+        doc["owner"] = int(owner)
     mdir = os.path.join(ckpt_dir, HOST_MANIFEST_DIR)
     os.makedirs(mdir, exist_ok=True)
     return _atomic_write_json(os.path.join(mdir, f"host{host_id}.json"), doc)
+
+
+def _path_process_owner(rel: str) -> Optional[int]:
+    """The process index a payload path claims (``ocdbt.process_<k>`` et
+    al.), or ``None`` for unmarked paths — ONE spelling of the
+    attribution, shared by partitioning and verification."""
+    for comp in rel.replace(os.sep, "/").split("/"):
+        m = _PROCESS_COMPONENT.search(comp)
+        if m is not None:
+            return int(m.group(1))
+    return None
+
+
+def _owner_attribution_problems(host: str, manifest: Dict) -> List[str]:
+    """Cross-check a manifest's explicit ``owner`` stamp against the
+    path-derived attribution of every file it attests.  Manifests without
+    the stamp (pre-stamp writers, simulated-host shard files) skip the
+    check — the stamp is what closes the window, not a retroactive
+    requirement."""
+    owner = manifest.get("owner")
+    if owner is None:
+        return []
+    problems = []
+    for rel in manifest.get("files", {}):
+        claimed = _path_process_owner(rel)
+        if claimed is not None and claimed != int(owner):
+            problems.append(
+                f"host{host}:{rel}: path names process {claimed} but the "
+                f"manifest is stamped owner={int(owner)} — silent "
+                "shard misattribution")
+    return problems
 
 
 def _atomic_write_json(path: str, doc: Dict) -> str:
@@ -385,6 +422,10 @@ def commit_pod_manifest(ckpt_dir: str, generation: int,
     # at restore time generations later
     problems: List[str] = []
     for host in expected:
+        # owner-stamp cross-check: a misattributed shard (path names one
+        # process, manifest stamped another) fails the COMMIT, the same
+        # discipline as a torn checksum
+        problems.extend(_owner_attribution_problems(host, manifests[host]))
         for rel, meta in manifests[host].get("files", {}).items():
             p = os.path.join(ckpt_dir, rel)
             if not os.path.exists(p):
@@ -441,6 +482,7 @@ def verify_pod_checkpoint_dir(ckpt_dir: str) -> Dict:
         if int(m.get("generation", -1)) != int(pod["generation"]):
             problems.append(f"host{host}: generation "
                             f"{m.get('generation')} != {pod['generation']}")
+        problems.extend(_owner_attribution_problems(host, m))
         for rel, meta in m.get("files", {}).items():
             p = os.path.join(ckpt_dir, rel)
             if not os.path.exists(p):

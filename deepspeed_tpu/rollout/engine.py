@@ -59,7 +59,7 @@ import numpy as np
 from ..inference.sampling import SamplingParams
 from ..inference.serving import Request, RequestResult, ServingEngine
 from ..inference.serving_supervisor import ServingSupervisor
-from ..observability.trace import trace_span
+from ..observability.trace import trace_span, trace_tags
 from ..utils.logging import log_dist
 
 __all__ = ["RolloutEngine", "RolloutRound"]
@@ -230,8 +230,13 @@ class RolloutEngine:
                         eos_token_id=eos_token_id, sampling=lanes[i])
                 for i, ids in enumerate(rows)]
         t0 = time.monotonic()
+        # ambient rollout tag (docs/OBSERVABILITY.md "Distributed
+        # tracing"): every serving span this batch opens — admissions,
+        # ticks, replays after a mid-rollout kill — carries the rollout
+        # sequence id, so one round is one filterable unit in Perfetto
         with trace_span("rollout.collect", n=len(reqs),
-                        epoch=self.weight_epoch):
+                        epoch=self.weight_epoch), \
+                trace_tags(rollout_seq=self._rid_seq):
             results = self._sup.run(reqs, max_ticks=max_ticks)
         dt = max(time.monotonic() - t0, 1e-9)
         tokens = sum(len(r.output_ids) for r in results)

@@ -496,7 +496,8 @@ def run_fleet_bench(model_name: str = "llama-374m", n_engines: int = 3,
                     page_size: int = 128, max_model_len: int = 0,
                     kill_engine: bool = False,
                     journal_every_k: int = 4,
-                    journal_flush_ms: float = None) -> dict:
+                    journal_flush_ms: float = None,
+                    collect_traces: str = None) -> dict:
     """Fleet-tier serving benchmark (ISSUE 7/8): the seeded mixed stream
     through ``n_engines`` leased engines behind a :class:`FleetRouter` on a
     file-backed coordination store.  Reports fleet throughput, PER-ENGINE
@@ -594,6 +595,15 @@ def run_fleet_bench(model_name: str = "llama-374m", n_engines: int = 3,
         # the number journal_every_k / journal_flush_ms are tuned against
         cas_lat = sorted(router.journal_cas_latencies()[warm_cas:]) or [0.0]
         measured_flushes = router.journal_flushes_total - warm_flushes
+        # distributed-tracing collection (ISSUE 15 satellite): one EXTRA
+        # traced pass AFTER the measured one (the reported numbers above
+        # stay untraced — the --trace discipline), members publishing
+        # span segments on their beats, force-flushed and assembled into
+        # ONE fleet Perfetto file.  Runs inside the try: it needs the
+        # live store.
+        fleet_trace = (_collect_fleet_trace(router, members, copies,
+                                            collect_traces)
+                       if collect_traces else None)
     finally:
         shutil.rmtree(coord_dir, ignore_errors=True)
 
@@ -663,7 +673,59 @@ def run_fleet_bench(model_name: str = "llama-374m", n_engines: int = 3,
             # leases, failover), not scale-out — production members run one
             # per process/host (docs/FLEET.md)
             "harness": "cooperative-in-process",
+            # traced extra pass + assembled fleet trace (--collect_traces;
+            # None when not requested)
+            "collect_traces": fleet_trace,
         },
+    }
+
+
+def _collect_fleet_trace(router, members, copies, out_dir: str) -> dict:
+    """The --collect_traces pass: trace one extra serve of the stream
+    through the (possibly kill-shrunken) fleet, force-publish every
+    owner's span segments, assemble ONE skew-corrected Perfetto file, and
+    report segment-publish CAS p50/p99 + cap-drop counts
+    (docs/OBSERVABILITY.md "Distributed tracing")."""
+    import os
+
+    from deepspeed_tpu.observability import configure_tracer, get_tracer
+    from deepspeed_tpu.observability.trace_assembly import (
+        assemble_fleet_trace, load_segments)
+
+    os.makedirs(out_dir, exist_ok=True)
+    configure_tracer(enabled=True, capacity=1 << 16)
+    get_tracer().reset()
+    try:
+        router.run(copies(), max_ticks=100000)
+        for m in members:
+            if m.alive:
+                m.publish_trace_segments(force=True)
+        router.publish_trace_segments(force=True)
+        segments = load_segments(router.store)
+        path = os.path.join(out_dir, "fleet_trace.json")
+        doc = assemble_fleet_trace(segments, out_path=path)
+    finally:
+        configure_tracer(enabled=False)
+        get_tracer().reset()
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    trace_ids = {(e.get("args") or {}).get("trace_id") for e in spans}
+    trace_ids.discard(None)
+    cas = sorted(lat for pub in
+                 [m._trace_pub for m in members if m._trace_pub is not None]
+                 + ([router._trace_pub] if router._trace_pub is not None
+                    else [])
+                 for lat in pub.cas_latencies()) or [0.0]
+    return {
+        "trace_path": path,
+        "owners": doc["otherData"]["owners"],
+        "spans_assembled": len(spans),
+        "distinct_trace_ids": len(trace_ids),
+        # the store-write cost of publishing (what a real fleet pays per
+        # beat) and how much of the window the caps dropped
+        "segment_publish_cas_p50_ms": round(_pct(cas, 0.50) * 1e3, 3),
+        "segment_publish_cas_p99_ms": round(_pct(cas, 0.99) * 1e3, 3),
+        "dropped_segment_spans_total": int(
+            sum(doc["otherData"]["dropped_by_owner"].values())),
     }
 
 
@@ -1144,6 +1206,14 @@ def main(argv=None) -> int:
                          "store clock (ISSUE 11 satellite; composes with "
                          "--journal_every_k — either trigger flushes; the "
                          "JSON reports per-flush CAS p50/p99 to tune it)")
+    ap.add_argument("--collect_traces", default=None, metavar="DIR",
+                    help="fleet mode: run one EXTRA traced pass (measured "
+                         "numbers stay untraced), publish every owner's "
+                         "span segments to the store, and assemble the "
+                         "run's fleet trace into DIR/fleet_trace.json — "
+                         "reports segment-publish CAS p50/p99 and dropped-"
+                         "segment counts (docs/OBSERVABILITY.md "
+                         "\"Distributed tracing\")")
     ap.add_argument("--workload",
                     choices=("mixed", "prefix", "sampled", "tiered"),
                     default="mixed",
@@ -1198,6 +1268,9 @@ def main(argv=None) -> int:
                          "serve.* spans appear as TraceAnnotations on the "
                          "device timeline (docs/OBSERVABILITY.md)")
     args = ap.parse_args(argv)
+    if args.collect_traces and args.mode != "fleet":
+        ap.error("--collect_traces assembles a FLEET trace — use "
+                 "--mode fleet (single-engine runs want --trace)")
     if args.tp:
         if args.mode != "engine" or args.workload != "mixed" \
                 or args.trace or args.device_trace or args.rate_rps \
@@ -1251,7 +1324,8 @@ def main(argv=None) -> int:
             page_size=args.page_size if args.page_size is not None else 128,
             max_model_len=args.max_model_len, kill_engine=args.kill_engine,
             journal_every_k=args.journal_every_k or None,
-            journal_flush_ms=args.journal_flush_ms)
+            journal_flush_ms=args.journal_flush_ms,
+            collect_traces=args.collect_traces)
         line = json.dumps(result)
         print(line)
         if args.out:
@@ -1260,6 +1334,10 @@ def main(argv=None) -> int:
         d = result["detail"]
         ok = (d["parity_with_single_engine"] and d["none_lost"]
               and (d["failovers_total"] > 0) == d["killed_engine"])
+        if args.collect_traces:
+            ct = d["collect_traces"]
+            ok = ok and ct is not None and ct["spans_assembled"] > 0 \
+                and ct["distinct_trace_ids"] > 0
         return 0 if ok else 1
     if args.workload == "sampled":
         if args.trace or args.device_trace or args.rate_rps:

@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Assemble a fleet's published trace segments into one Perfetto file.
+
+Every fleet process (router + engines) publishes bounded completed-span
+segments under the coordination store's ``fleet/trace/<owner>`` keyspace
+(docs/OBSERVABILITY.md "Distributed tracing").  This tool merges them into
+ONE Chrome/Perfetto trace — per-owner process tracks named by
+``process_name`` metadata, per-process clock-skew correction via the
+segments' monotonic↔epoch anchors, and request trace-context tags
+(``trace_id``/``rid``) as ``args`` — so a mid-stream failover reads as one
+request spanning two engine tracks in https://ui.perfetto.dev.
+
+Usage::
+
+    python tools/trace_assemble.py --coord_dir /path/to/store \\
+        --out fleet_trace.json
+    python tools/trace_assemble.py --coord_dir ... --trace_id ab12cd34…
+        # also prints that request's event timeline (causal order)
+
+Exits nonzero when no segments exist under the keyspace (nothing was
+published — is tracing enabled on the fleet?).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="trace_assemble", description=__doc__)
+    ap.add_argument("--coord_dir", required=True,
+                    help="root of the fleet's file-backed coordination "
+                         "store (the --fleet_coord_dir of the run)")
+    ap.add_argument("--out", default="fleet_trace.json",
+                    help="where to write the merged Chrome/Perfetto JSON")
+    ap.add_argument("--prefix", default="fleet/trace",
+                    help="store keyspace holding the segments")
+    ap.add_argument("--trace_id", default=None,
+                    help="also print this request's event timeline")
+    args = ap.parse_args(argv)
+
+    from deepspeed_tpu.elasticity.coordination import FileCoordinationStore
+    from deepspeed_tpu.observability.trace_assembly import (
+        assemble_fleet_trace, events_for_trace, load_segments)
+
+    store = FileCoordinationStore(args.coord_dir)
+    segments = load_segments(store, prefix=args.prefix)
+    if not segments:
+        print(f"no trace segments under {args.prefix!r} in "
+              f"{args.coord_dir} — was the fleet run traced "
+              "(DS_TPU_TRACE=1 / configure_tracer)?", file=sys.stderr)
+        return 1
+    doc = assemble_fleet_trace(segments, out_path=args.out)
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    trace_ids = {(e.get("args") or {}).get("trace_id") for e in spans}
+    trace_ids.discard(None)
+    summary = {
+        "metric": "trace-assemble",
+        "out": args.out,
+        "owners": doc["otherData"]["owners"],
+        "spans": len(spans),
+        "distinct_trace_ids": len(trace_ids),
+        "dropped_by_owner": doc["otherData"]["dropped_by_owner"],
+    }
+    if args.trace_id:
+        summary["trace_events"] = [
+            {"owner": e["pid"], "name": e["name"], "ts": e["ts"],
+             "dur": e["dur"], "args": e.get("args", {})}
+            for e in events_for_trace(doc, args.trace_id)]
+    print(json.dumps(summary, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
