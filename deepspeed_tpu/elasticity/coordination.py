@@ -61,12 +61,14 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import threading
 import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
-from ..resilience.fault_injection import (SITE_POD_HEARTBEAT,
+from ..resilience.fault_injection import (SITE_FLEET_CHANNEL,
+                                          SITE_POD_HEARTBEAT,
                                           SITE_POD_RENDEZVOUS, maybe_fire)
 from ..utils.logging import logger
 
@@ -122,6 +124,37 @@ class CoordinationStore:
     def delete(self, key: str) -> None:
         raise NotImplementedError
 
+    def compare_and_delete(self, key: str, expected: Dict) -> bool:
+        """Delete ``key`` iff the current document equals ``expected``,
+        leaving a TOMBSTONE that blocks a later create
+        (``compare_and_swap(key, None, ...)``) until it is cleared or
+        expires — the fenced GC primitive (docs/FLEET.md "Journal GC"):
+        a leader stalled past its election lease holds a stale
+        ``expected`` and can never delete an entry its successor
+        re-stamped, and its stale appends cannot resurrect an entry the
+        live owner already collected.  ``expected`` must not be ``None``
+        (deleting an absent key is a plain :meth:`delete`).
+
+        This base implementation is read-compare-delete with no lock and
+        no tombstone — correct only under a single writer, exactly like
+        the base :meth:`compare_and_swap` it mirrors.  Real backends MUST
+        override it atomically (``FileCoordinationStore`` serializes
+        through the same per-key lock file its CAS uses)."""
+        if expected is None:
+            raise ValueError(
+                "compare_and_delete: expected must be a document, not None")
+        if self.get(key) != expected:
+            return False
+        self.delete(key)
+        return True
+
+    def clear_tombstone(self, key: str) -> None:
+        """Drop the tombstone a :meth:`compare_and_delete` left on
+        ``key`` so a create can land again — the escape hatch for a
+        caller that KNOWS the key's next writer is legitimate (e.g. a
+        fresh submission reusing a collected rid).  Base stores keep no
+        tombstones; this is a no-op there."""
+
     def list(self, prefix: str) -> List[str]:
         raise NotImplementedError
 
@@ -151,7 +184,8 @@ class FileCoordinationStore(CoordinationStore):
     """
 
     def __init__(self, root: str, clock: Optional[Callable[[], float]] = None,
-                 cas_timeout_s: float = 10.0, lock_stale_s: float = 5.0):
+                 cas_timeout_s: float = 10.0, lock_stale_s: float = 5.0,
+                 tombstone_ttl_s: float = 300.0):
         self.root = str(root)
         os.makedirs(self.root, exist_ok=True)
         self._clock = clock
@@ -162,6 +196,15 @@ class FileCoordinationStore(CoordinationStore):
         self.cas_timeout_s = max(float(cas_timeout_s),
                                  float(lock_stale_s) + 1.0)
         self.lock_stale_s = float(lock_stale_s)
+        # tombstones left by compare_and_delete expire after this long
+        # (wall clock, like the stale-lock breaker): fencing windows are
+        # election-lease-sized, so a tombstone old enough to outlive every
+        # deposed writer is pure debris
+        self.tombstone_ttl_s = float(tombstone_ttl_s)
+        # CAS acquisitions that found the per-key lock held at least once
+        # (the fleet/store_cas_contended_total gauge): N routers racing
+        # one key show up here long before latency does
+        self.cas_contended_total = 0
 
     def _path(self, key: str) -> str:
         key = key.strip("/")
@@ -190,20 +233,29 @@ class FileCoordinationStore(CoordinationStore):
                            key, e)
             return None
 
-    def compare_and_swap(self, key: str, expected: Optional[Dict],
-                         new: Dict) -> bool:
-        from ..resilience.integrity import _atomic_write_json
-
-        path = self._path(key)
-        os.makedirs(os.path.dirname(path), exist_ok=True)
+    def _acquire_lock(self, key: str, path: str,
+                      what: str) -> Tuple[int, int, str]:
+        """Take the per-key ``<key>.lock`` (O_CREAT|O_EXCL — atomic across
+        threads AND processes), spinning with jittered exponential backoff
+        under contention: N routers racing one hot key (the admission
+        partition table, the election key) must degrade into staggered
+        retries, not a synchronized hot-spin that keeps re-colliding at
+        the same instants.  Returns ``(fd, inode, lock_path)``; the caller
+        must release via :meth:`_release_lock`."""
         lock = path + ".lock"
         deadline = time.monotonic() + self.cas_timeout_s
+        attempt = 0
         while True:
             try:
                 fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-                my_ino = os.fstat(fd).st_ino
-                break
+                return fd, os.fstat(fd).st_ino, lock
             except FileExistsError:
+                if attempt == 0:
+                    # counted once per contended ACQUISITION, not per spin:
+                    # the gauge answers "how often do writers collide", not
+                    # "how long did they wait"
+                    self.cas_contended_total += 1
+                attempt += 1
                 try:
                     # break a lock orphaned by a writer that died holding
                     # it (wall-clock mtime: the injectable store clock must
@@ -225,43 +277,115 @@ class FileCoordinationStore(CoordinationStore):
                            # stole it) between the two calls
                 if time.monotonic() >= deadline:
                     raise PodCoordinationError(
-                        f"compare_and_swap({key!r}): lock {lock} held for "
+                        f"{what}({key!r}): lock {lock} held for "
                         f"over {self.cas_timeout_s:.1f}s — a writer is "
                         "wedged or the stale-lock breaker is disabled")
-                time.sleep(0.001)
+                # full jitter on an exponentially growing ceiling (capped
+                # well under the lease scale): waiters desynchronize, and
+                # the first retry stays ~instant for the common
+                # two-writers-once case
+                cap = min(0.02, 0.0005 * (1 << min(attempt, 6)))
+                time.sleep(random.uniform(0.0001, cap))
+
+    @staticmethod
+    def _release_lock(fd: int, my_ino: int, lock: str) -> None:
+        os.close(fd)
+        try:
+            # ownership-checked release: if a waiter stale-stole OUR
+            # lock (we stalled past lock_stale_s inside this critical
+            # section), the file at `lock` is now the stealer's —
+            # removing it blindly would admit yet another writer.  The
+            # stale threshold (seconds) vs the ms-long critical section
+            # makes a steal-from-live vanishingly rare, but the release
+            # must not widen it into a cascade.
+            if os.stat(lock).st_ino == my_ino:
+                os.remove(lock)
+        except OSError:   # pragma: no cover - breaker raced us
+            pass
+
+    def _tomb_path(self, path: str) -> str:
+        return path + ".tomb"
+
+    def _tombstone_blocks(self, path: str) -> bool:
+        """Whether a LIVE tombstone sits on ``path`` (expired ones are
+        reaped in passing — debris, not a fence; the TTL is wall-clock
+        like the stale-lock breaker, and far beyond any election lease)."""
+        tomb = self._tomb_path(path)
+        try:
+            if time.time() - os.path.getmtime(tomb) <= self.tombstone_ttl_s:
+                return True
+            os.remove(tomb)
+        except OSError:
+            pass
+        return False
+
+    def compare_and_swap(self, key: str, expected: Optional[Dict],
+                         new: Dict) -> bool:
+        from ..resilience.integrity import _atomic_write_json
+
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd, my_ino, lock = self._acquire_lock(key, path, "compare_and_swap")
         try:
             if self.get(key) != expected:
+                return False
+            if expected is None and self._tombstone_blocks(path):
+                # a compare_and_delete fenced this key: a create here is by
+                # definition a writer that did not see the delete (the
+                # deposed leader's stale append / create retry) — blocked
+                # until clear_tombstone or the TTL says no deposed writer
+                # can still be alive
                 return False
             _atomic_write_json(path, new)
             return True
         finally:
-            os.close(fd)
+            self._release_lock(fd, my_ino, lock)
+
+    def compare_and_delete(self, key: str, expected: Dict) -> bool:
+        if expected is None:
+            raise ValueError(
+                "compare_and_delete: expected must be a document, not None")
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd, my_ino, lock = self._acquire_lock(key, path, "compare_and_delete")
+        try:
+            if self.get(key) != expected:
+                return False
+            # tombstone FIRST, then remove: a crash between the two leaves
+            # the key both present and fenced — the next compare_and_delete
+            # with the same expected finishes the job, and no create can
+            # slip into the gap
+            with open(self._tomb_path(path), "w", encoding="utf-8") as fh:
+                json.dump({"t": self.now()}, fh)
             try:
-                # ownership-checked release: if a waiter stale-stole OUR
-                # lock (we stalled past lock_stale_s inside this critical
-                # section), the file at `lock` is now the stealer's —
-                # removing it blindly would admit yet another writer.  The
-                # stale threshold (seconds) vs the ms-long critical section
-                # makes a steal-from-live vanishingly rare, but the release
-                # must not widen it into a cascade.
-                if os.stat(lock).st_ino == my_ino:
-                    os.remove(lock)
-            except OSError:   # pragma: no cover - breaker raced us
+                os.remove(path)
+            except FileNotFoundError:   # pragma: no cover - defensive
                 pass
+            return True
+        finally:
+            self._release_lock(fd, my_ino, lock)
+
+    def clear_tombstone(self, key: str) -> None:
+        try:
+            os.remove(self._tomb_path(self._path(key)))
+        except OSError:
+            pass
 
     def list(self, prefix: str) -> List[str]:
         try:
             names = os.listdir(self._path(prefix))
         except (FileNotFoundError, NotADirectoryError):
             return []
-        # tmp siblings and CAS lock files (incl. `<key>.lock.stale.*`
-        # rename-steal remnants of a waiter that died mid-steal) are
-        # write-protocol artifacts, never documents.  Match the exact
-        # artifact shapes, not a bare ".lock" substring — a legitimate id
-        # like "db.lockhart-3" must stay visible to lease/dead scans.
+        # tmp siblings, CAS lock files (incl. `<key>.lock.stale.*`
+        # rename-steal remnants of a waiter that died mid-steal) and
+        # compare-delete tombstones are write-protocol artifacts, never
+        # documents.  Match the exact artifact shapes, not a bare ".lock"
+        # substring — a legitimate id like "db.lockhart-3" must stay
+        # visible to lease/dead scans.
         return sorted(n for n in names
                       if ".tmp." not in n and not n.endswith(".lock")
-                      and ".lock.stale." not in n)
+                      and ".lock.stale." not in n
+                      and not n.endswith(".tomb"))
 
     def delete(self, key: str) -> None:
         try:
@@ -573,6 +697,74 @@ def read_trace_segments(store: CoordinationStore,
         if doc is not None:
             out[str(doc.get("owner_id", name))] = doc
     return out
+
+
+# ----------------------------------------------------------------- channels
+#
+# Store-mediated message channels: how a fleet router and a MEMBER DAEMON
+# in another OS process exchange assignments, results and control verbs
+# with no coupling beyond the store (docs/FLEET.md "Member daemons").  One
+# channel is one size-capped document; every payload gets a CAS-assigned,
+# strictly increasing sequence number, so a consumer detects capped-out
+# drops as sequence gaps (truncation is visible, never silent — the same
+# contract as the trace segments and the request journal).  Consumption is
+# a CAS truncation: of N racing consumers (a deposed router and its
+# successor both draining a results channel), exactly one claims each item.
+
+def channel_append(store: CoordinationStore, key: str, payload: Dict,
+                   owner_id: str, max_items: int = 256,
+                   max_bytes: int = 262144) -> int:
+    """Append ``payload`` to the channel at ``key`` and return its
+    sequence number.  Past ``max_items`` entries (or ``max_bytes`` of
+    serialized items) the OLDEST entries drop and the ``dropped`` counter
+    grows — one wedged consumer can never grow a producer's document
+    unboundedly.  CAS loop, mirroring :func:`append_trace_segment`."""
+    maybe_fire(SITE_FLEET_CHANNEL, key=key)
+    while True:
+        cur = store.get(key)
+        items = [list(e) for e in ((cur or {}).get("items") or ())]
+        seq = int((cur or {}).get("seq") or 0) + 1
+        items.append([seq, payload])
+        dropped = int((cur or {}).get("dropped") or 0)
+        if len(items) > int(max_items):
+            dropped += len(items) - int(max_items)
+            items = items[-int(max_items):]
+        while len(items) > 1 and len(json.dumps(items)) > int(max_bytes):
+            items.pop(0)
+            dropped += 1
+        doc = {"owner": str(owner_id), "seq": seq, "items": items,
+               "dropped": dropped, "t": store.now()}
+        if store.compare_and_swap(key, cur, doc):
+            return seq
+
+
+def channel_consume(store: CoordinationStore, key: str,
+                    consumer_id: str) -> List[Tuple[int, Dict]]:
+    """Claim every pending ``(seq, payload)`` on the channel at ``key``
+    (ascending seq) and truncate it — atomically, via CAS: a concurrent
+    producer append or a RACING CONSUMER makes the truncation lose, and
+    the loop re-reads.  Each item is claimed by exactly one consumer;
+    ``consumer_id`` is stamped on the truncated document so an operator
+    can see who drained it last."""
+    while True:
+        cur = store.get(key)
+        if cur is None or not cur.get("items"):
+            return []
+        new = {"owner": cur.get("owner"), "seq": int(cur.get("seq") or 0),
+               "items": [], "dropped": int(cur.get("dropped") or 0),
+               "consumer": str(consumer_id), "t": store.now()}
+        if store.compare_and_swap(key, cur, new):
+            return [(int(s), p) for s, p in cur["items"]]
+
+
+def channel_stats(store: CoordinationStore, key: str) -> Dict[str, int]:
+    """``{"seq", "pending", "dropped"}`` for the channel at ``key`` —
+    the drop accounting the fleet gauges roll up (all zero when the
+    channel was never written)."""
+    doc = store.get(key) or {}
+    return {"seq": int(doc.get("seq") or 0),
+            "pending": len(doc.get("items") or ()),
+            "dropped": int(doc.get("dropped") or 0)}
 
 
 # --------------------------------------------------------------- generation

@@ -16,6 +16,11 @@ from .fleet import (  # noqa: F401
     FleetMember,
     FleetRouter,
     FleetUnrecoverable,
+    FleetWrongPartition,
+)
+from .fleet_daemon import (  # noqa: F401
+    FleetMemberDaemon,
+    StoreMemberProxy,
 )
 from .kv_tiering import HostTier  # noqa: F401
 from .prefix_cache import PrefixIndex, PrefixMatch, chain_keys  # noqa: F401

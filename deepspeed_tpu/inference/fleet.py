@@ -89,6 +89,7 @@ import hashlib
 import json
 import re
 import time
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -96,7 +97,7 @@ import numpy as np
 from collections import deque
 
 from ..elasticity.coordination import (CoordinationStore, beat,
-                                       bump_generation, dead_set,
+                                       bump_generation, clear_dead, dead_set,
                                        dedup_drop_totals, elect_coordinator,
                                        lease_table, process_src,
                                        publish_residency, read_generation,
@@ -110,7 +111,9 @@ from .sampling import SamplingParams
 from .serving import Request, RequestResult, ServeTimeout, SlotPrefillError
 from .serving_supervisor import RestartBudgetExhausted, ServingSupervisor
 
-__all__ = ["EngineDead", "FleetMember", "FleetRouter", "FleetUnrecoverable"]
+__all__ = ["EngineDead", "FleetMember", "FleetRouter", "FleetUnrecoverable",
+           "FleetWrongPartition", "partition_of", "request_to_doc",
+           "request_from_doc", "result_to_doc", "result_from_doc"]
 
 # store namespaces of the fleet tier (the pod tier keeps heartbeat/, dead/,
 # generation — one store can carry both without key collisions)
@@ -122,6 +125,32 @@ FLEET_RESIDENCY_PREFIX = "fleet/residency"
 FLEET_TRACE_PREFIX = "fleet/trace"
 FLEET_COORDINATOR_KEY = "fleet/coordinator"
 FLEET_GENERATION_KEY = "fleet/generation"
+# member-daemon channels (docs/FLEET.md "Member daemons"): per-engine
+# CAS-appended message documents — the ONLY coupling between a router and
+# a member running in its own OS process (inference/fleet_daemon.py)
+FLEET_ASSIGN_PREFIX = "fleet/assign"
+FLEET_RESULTS_PREFIX = "fleet/results"
+FLEET_CONTROL_PREFIX = "fleet/control"
+FLEET_PROGRESS_PREFIX = "fleet/progress"
+# sharded admission (docs/FLEET.md "Sharded admission"): follower routers
+# lease under router_heartbeat/ and claim rid-hash partitions by CAS
+FLEET_ROUTER_HEARTBEAT_PREFIX = "fleet/router_heartbeat"
+FLEET_ROUTER_DEAD_PREFIX = "fleet/router_dead"
+FLEET_PARTITION_PREFIX = "fleet/partition"
+# fleet-wide weight-epoch barrier (docs/FLEET.md, docs/HYBRID.md): the
+# committed epoch, the in-progress flip document, and per-member prepare
+# marks — every member flips before any router admits at the new epoch
+FLEET_EPOCH_KEY = "fleet/epoch/current"
+FLEET_EPOCH_FLIP_KEY = "fleet/epoch/flip"
+FLEET_EPOCH_PREPARE_PREFIX = "fleet/epoch/prepare"
+
+
+def partition_of(rid: Any, n_partitions: int) -> int:
+    """Stable rid-hash -> admission-partition map: process-independent
+    (crc32, never Python ``hash``) so every router of a fleet computes
+    the same owner for a rid (docs/FLEET.md "Sharded admission")."""
+    raw = f"{'i' if isinstance(rid, int) else 's'}{rid}".encode()
+    return zlib.crc32(raw) % max(1, int(n_partitions))
 
 
 class EngineDead(RuntimeError):
@@ -134,16 +163,23 @@ class FleetUnrecoverable(RuntimeError):
     """No live engine remains to fail requests over to."""
 
 
+class FleetWrongPartition(ValueError):
+    """The rid hashes to an admission partition this router does not own
+    (docs/FLEET.md "Sharded admission") — resubmit to the owner."""
+
+
 def _rid_key(rid: Any) -> str:
     """Store-key-safe encoding of a request id (journal entries live at
     ``fleet/requests/<key>``).  Type-prefixed so int 7 and str "7" cannot
     collide; non-key-safe or long rids get a stable content hash suffix."""
     raw = f"{'i' if isinstance(rid, int) else 's'}{rid}"
     safe = re.sub(r"[^A-Za-z0-9_.-]", "_", raw)
-    if safe != raw or len(safe) > 80 or ".lock" in safe or ".tmp." in safe:
-        # ".lock"/".tmp." would collide with the store's write-protocol
-        # artifacts and be FILTERED from list() — a journal entry a
-        # successor coordinator could never see
+    if safe != raw or len(safe) > 80 or ".lock" in safe or ".tmp." in safe \
+            or safe.endswith(".tomb"):
+        # ".lock"/".tmp."/".tomb" would collide with the store's
+        # write-protocol artifacts (CAS locks, atomic-write temps,
+        # compare-delete tombstones) and be FILTERED from list() — a
+        # journal entry a successor coordinator could never see
         safe = re.sub(r"[^A-Za-z0-9_-]", "_", safe[:64])
         safe = f"{safe}-{hashlib.sha1(raw.encode()).hexdigest()[:10]}"
     return safe
@@ -156,6 +192,86 @@ def _doc_bytes(doc: Dict[str, Any]) -> int:
         return len(json.dumps(doc))
     except (TypeError, ValueError):   # pragma: no cover - defensive
         return 0
+
+
+def request_to_doc(req: Request) -> Dict[str, Any]:
+    """JSON-serializable form of a :class:`Request` — the assignment-
+    channel payload between a router and a member daemon.  The monotonic
+    ``arrival_time`` is NOT carried (it is meaningless across processes):
+    the daemon re-stamps arrival on its own clock at receipt, while
+    ``arrival_epoch_s``/``deadline_s`` keep the true-arrival accounting."""
+    return {
+        "rid": req.rid,
+        "input_ids": [int(x) for x in np.asarray(req.input_ids).reshape(-1)],
+        "max_new_tokens": int(req.max_new_tokens),
+        "eos_token_id": (int(req.eos_token_id)
+                         if req.eos_token_id is not None else None),
+        "deadline_s": req.deadline_s,
+        "arrival_epoch_s": req.arrival_epoch_s,
+        "sampling": (dataclasses.asdict(req.sampling)
+                     if req.sampling is not None else None),
+        "trace_id": req.trace_id,
+    }
+
+
+def request_from_doc(doc: Dict[str, Any]) -> Request:
+    return Request(
+        rid=doc["rid"],
+        input_ids=np.asarray(doc["input_ids"], np.int32),
+        max_new_tokens=int(doc["max_new_tokens"]),
+        eos_token_id=doc.get("eos_token_id"),
+        arrival_time=0.0,
+        deadline_s=doc.get("deadline_s"),
+        arrival_epoch_s=doc.get("arrival_epoch_s"),
+        sampling=(SamplingParams(**doc["sampling"])
+                  if doc.get("sampling") else None),
+        trace_id=doc.get("trace_id"))
+
+
+def result_to_doc(res: RequestResult) -> Dict[str, Any]:
+    """JSON-serializable form of a :class:`RequestResult` — the results-
+    channel payload a member daemon publishes back to the router."""
+    return {
+        "rid": res.rid,
+        "input_ids": [int(x) for x in np.asarray(res.input_ids).reshape(-1)],
+        "output_ids": [int(x)
+                       for x in np.asarray(res.output_ids).reshape(-1)],
+        "finish_reason": res.finish_reason,
+        "prefill_bucket": int(res.prefill_bucket),
+        "arrival_s": res.arrival_s,
+        "admit_s": res.admit_s,
+        "first_token_s": res.first_token_s,
+        "finish_s": res.finish_s,
+        "retry_after_s": res.retry_after_s,
+        "decode_ticks": int(res.decode_ticks),
+        "replays": int(res.replays),
+        "shared_prefix_tokens": int(res.shared_prefix_tokens),
+        "failovers": int(res.failovers),
+        "resumed_tokens": int(res.resumed_tokens),
+        "trace_id": res.trace_id,
+        "lifecycle": [list(e) for e in res.lifecycle],
+    }
+
+
+def result_from_doc(doc: Dict[str, Any]) -> RequestResult:
+    return RequestResult(
+        rid=doc["rid"],
+        input_ids=np.asarray(doc["input_ids"], np.int32),
+        output_ids=np.asarray(doc["output_ids"], np.int32),
+        finish_reason=doc["finish_reason"],
+        prefill_bucket=int(doc.get("prefill_bucket") or 0),
+        arrival_s=float(doc.get("arrival_s") or 0.0),
+        admit_s=float(doc.get("admit_s") or 0.0),
+        first_token_s=float(doc.get("first_token_s") or 0.0),
+        finish_s=float(doc.get("finish_s") or 0.0),
+        retry_after_s=doc.get("retry_after_s"),
+        decode_ticks=int(doc.get("decode_ticks") or 0),
+        replays=int(doc.get("replays") or 0),
+        shared_prefix_tokens=int(doc.get("shared_prefix_tokens") or 0),
+        failovers=int(doc.get("failovers") or 0),
+        resumed_tokens=int(doc.get("resumed_tokens") or 0),
+        trace_id=doc.get("trace_id"),
+        lifecycle=[tuple(e) for e in doc.get("lifecycle") or []])
 
 
 class FleetMember:
@@ -283,6 +399,10 @@ class FleetMember:
             "monitor_dropped": int(getattr(mon, "dropped_events", 0) or 0),
             "monitor_src": f"{src}.{id(mon)}",
             "last_restart_cause": h["last_restart_cause"],
+            # the engine's weight epoch: the router's stale-weight
+            # admission guard reads this for members it holds no live
+            # handle to (docs/FLEET.md "Weight-epoch barrier")
+            "weight_epoch": int(self.sup.engine.weight_epoch),
             # KV-page tiering rollup keys (docs/FLEET.md): the router sums
             # these fleet-wide into the fleet/residency_* gauges
             "page_size": int(self.sup.engine.page_size),
@@ -410,6 +530,34 @@ class FleetMember:
         """Rolling-restart hand-off: fresh engine, no budget spent."""
         return self.sup.recycle()
 
+    def weight_epoch(self) -> int:
+        """The engine's live weight epoch (the stale-weight admission
+        guard reads it; a store-proxied member reads its advertisement)."""
+        return int(self.sup.engine.weight_epoch)
+
+    def prepare_epoch(self, params, epoch: int) -> bool:
+        """Fleet epoch-barrier PREPARE (docs/FLEET.md "Weight-epoch
+        barrier"): once this member has nothing in flight, flip its engine
+        to ``params`` at ``epoch`` and write the durable prepare mark
+        under ``fleet/epoch/prepare/<engine_id>``.  Returns whether the
+        flip landed — ``False`` means still busy (the router keeps
+        pumping; admission is gated, so the backlog only drains).
+
+        ``params=None`` re-stamps the CURRENT weights at the new epoch
+        (cache flushed, epoch advanced): the successor-coordinator path,
+        which adopts an orphaned flip without the dead coordinator's
+        param tree — each member's own weight source is authoritative
+        (a daemon's ``params_provider``)."""
+        if not self.alive or self.outstanding() > 0:
+            return False
+        self.sup.engine.update_params(
+            params if params is not None else self.sup.engine.params,
+            epoch=int(epoch))
+        self.store.put(f"{FLEET_EPOCH_PREPARE_PREFIX}/{self.engine_id}",
+                       {"engine": self.engine_id, "epoch": int(epoch),
+                        "t": self.store.now()})
+        return True
+
     def kill(self) -> None:
         """Test/chaos hook simulating process death: the lease silently
         stops renewing and the engine's host-side state (queue, slots,
@@ -440,7 +588,8 @@ class FleetRouter:
                  max_journal_tokens: int = 4096,
                  prefix_affinity: bool = True,
                  affinity_load_slack: int = 2,
-                 slo_rules: Optional[List[SloRule]] = None):
+                 slo_rules: Optional[List[SloRule]] = None,
+                 admission_partitions: Optional[int] = None):
         self.store = store
         self.members: Dict[str, FleetMember] = {}
         for m in members:
@@ -551,6 +700,33 @@ class FleetRouter:
         self._affinity_tiers_tick = -1
         self.tokens_by_engine: Dict[str, int] = {
             m.engine_id: 0 for m in members}
+        # ---- sharded admission (docs/FLEET.md "Sharded admission"): N
+        # routers under ONE election — followers CAS-claim rid-hash
+        # partitions and journal-create accepted requests (engine=None);
+        # the coordinator adopts and serves them.  None disables the
+        # partition table entirely (the classic single-router fleet).
+        self.admission_partitions = (int(admission_partitions)
+                                     if admission_partitions is not None
+                                     else None)
+        if self.admission_partitions is not None \
+                and self.admission_partitions < 1:
+            raise ValueError(
+                f"admission_partitions={self.admission_partitions} "
+                "must be >= 1")
+        self._my_partitions: set = set()
+        self.partition_admissions_total = 0
+        self.adopted_admissions_total = 0
+        self._last_router_beat_t: Optional[float] = None   # store clock
+        self._last_adopt_scan_t: Optional[float] = None    # store clock
+        # ---- fleet-wide weight-epoch barrier (docs/FLEET.md,
+        # docs/HYBRID.md): the in-progress flip document mirror, the
+        # params being flipped to, and dispatches parked until commit
+        self._flip: Optional[Dict[str, Any]] = None
+        self._flip_params = None
+        self._flip_hold: List[Tuple[Request, bool]] = []
+        self.epoch_flips_total = 0
+        epoch_doc = store.get(FLEET_EPOCH_KEY)
+        self.fleet_epoch = int((epoch_doc or {}).get("epoch") or 0)
 
     # ------------------------------------------------------------ admission
 
@@ -603,6 +779,320 @@ class FleetRouter:
         self._route(request)
         return rid
 
+    # --------------------------------------------------- sharded admission
+
+    def owns_partition(self, rid: Any) -> bool:
+        """Whether THIS router owns the admission partition ``rid`` hashes
+        to (always True when partitioning is disabled)."""
+        if self.admission_partitions is None:
+            return True
+        return (partition_of(rid, self.admission_partitions)
+                in self._my_partitions)
+
+    def admit(self, request: Request) -> Any:
+        """Sharded admission (docs/FLEET.md "Sharded admission"): accept a
+        request on a FOLLOWER router by journal-creating its entry
+        (``engine=None`` — accepted, not yet dispatched) straight on the
+        store.  The elected coordinator adopts and serves it; results are
+        claimed from the coordinator.  This is how N routers break the
+        one-process admission bound: validation + the journal-create write
+        shard by rid hash, while membership, failover and GC stay with the
+        single coordinator.  Requires ownership of the rid's partition
+        (:class:`FleetWrongPartition` otherwise).  On the coordinator —
+        or with partitioning disabled — this is a plain :meth:`submit`."""
+        if self.admission_partitions is None or self.is_coordinator:
+            return self.submit(request)
+        ids = np.asarray(request.input_ids, np.int32).reshape(-1)
+        request = dataclasses.replace(request, input_ids=ids)
+        rid = request.rid
+        if not isinstance(rid, (str, int)) or isinstance(rid, bool):
+            raise ValueError(
+                f"fleet request ids must be str or int (got {type(rid)}): "
+                "the store journal must reconstruct them on adoption")
+        part = partition_of(rid, self.admission_partitions)
+        if part not in self._my_partitions:
+            raise FleetWrongPartition(
+                f"rid {rid!r} hashes to partition {part}, which router "
+                f"{self.router_id} does not own "
+                f"(owned: {sorted(self._my_partitions)})")
+        if request.arrival_epoch_s is None:
+            request = dataclasses.replace(
+                request, arrival_epoch_s=time.monotonic())
+        if request.trace_id is None:
+            request = dataclasses.replace(request, trace_id=new_trace_id())
+        with trace_tags(router=self.router_id), \
+                trace_span("fleet.admit", rid=rid, partition=part):
+            doc = {
+                "rid": rid,
+                "engine": None,
+                "input_ids": [int(x) for x in request.input_ids],
+                "max_new_tokens": int(request.max_new_tokens),
+                "eos_token_id": (int(request.eos_token_id)
+                                 if request.eos_token_id is not None
+                                 else None),
+                "deadline_s": request.deadline_s,
+                "arrival_epoch_s": request.arrival_epoch_s,
+                "failovers": 0,
+                "tokens": [],
+                "resumed": 0,
+                "sampling": (dataclasses.asdict(request.sampling)
+                             if request.sampling is not None else None),
+                "lane_counter": len(request.input_ids),
+                "trace_id": request.trace_id,
+                "lifecycle": [],
+                # admission stamp, not ownership: the coordinator
+                # re-stamps owner/term when it adopts the entry
+                "owner": self.router_id,
+                "term": 0,
+                "t": self.store.now()}
+            key = f"{FLEET_REQUESTS_PREFIX}/{_rid_key(rid)}"
+            # same create-retry shape as the coordinator's submission-time
+            # journal write: a pre-existing document for a rid this router
+            # just accepted can only be an orphan of a previous run
+            while True:
+                cur = self.store.get(key)
+                if self.store.compare_and_swap(key, cur, doc):
+                    if cur is not None:
+                        logger.warning(
+                            "fleet: admission entry for %r was an orphan "
+                            "of a previous run; overwritten", rid)
+                    break
+                if cur is None and self.store.get(key) is None:
+                    # a compare-delete tombstone of a COLLECTED previous
+                    # stream with this rid blocks the create: a fresh
+                    # admission is a new stream by contract — clear it
+                    self.store.clear_tombstone(key)
+        self.partition_admissions_total += 1
+        return rid
+
+    def _partition_key(self, i: int) -> str:
+        return f"{FLEET_PARTITION_PREFIX}/{int(i)}"
+
+    def claim_partitions(self, max_new: int = 1) -> set:
+        """Renew this router's partition claims and CAS-claim vacant ones
+        (at most ``max_new`` new claims per call, so N starting routers
+        spread the table instead of one grabbing everything).  A claim the
+        coordinator force-released from a dead router (its compare-delete
+        leaves a tombstone) is cleared here and claimed on the NEXT round
+        — one round of backoff keeps rival claimers from spinning on the
+        clear/create race.  Returns the owned partition set."""
+        if self.admission_partitions is None:
+            return set()
+        now = self.store.now()
+        new_claims = 0
+        for i in range(self.admission_partitions):
+            key = self._partition_key(i)
+            doc = self.store.get(key)
+            claim = {"partition": i, "router": self.router_id, "t": now}
+            if doc is not None and doc.get("router") == self.router_id:
+                if self.store.compare_and_swap(key, doc, claim):
+                    self._my_partitions.add(i)
+                else:
+                    # reassigned under us (the coordinator declared this
+                    # router dead and freed the claim): stop admitting it
+                    self._my_partitions.discard(i)
+            elif doc is None and new_claims < int(max_new):
+                if self.store.compare_and_swap(key, None, claim):
+                    self._my_partitions.add(i)
+                    new_claims += 1
+                elif self.store.get(key) is None:
+                    self.store.clear_tombstone(key)
+            elif doc is not None:
+                self._my_partitions.discard(i)
+        return set(self._my_partitions)
+
+    def _beat_router(self) -> None:
+        """Renew this ROUTER's lease (``fleet/router_heartbeat/<id>``) —
+        the liveness signal partition reassignment keys off.  Same
+        rate-limit discipline as the member beats."""
+        now = self.store.now()
+        if self._last_router_beat_t is not None \
+                and now - self._last_router_beat_t < self.lease_s / 3.0:
+            return
+        self._last_router_beat_t = now
+        beat(self.store, self.router_id, self.generation, self.lease_s,
+             prefix=FLEET_ROUTER_HEARTBEAT_PREFIX,
+             partitions=sorted(self._my_partitions),
+             is_coordinator=self.is_coordinator)
+        # the lease is truth; the dead marker is a scan artifact.  A router
+        # wrongly marked dead (e.g. a stop-the-world pause lapsed its lease)
+        # re-admits itself the moment it beats again — otherwise
+        # _scan_router_leases would release its partition claims forever
+        # even though the lease is fresh (permanent-marker livelock).
+        clear_dead(self.store, self.router_id,
+                   prefix=FLEET_ROUTER_DEAD_PREFIX)
+
+    def _scan_router_leases(self) -> None:
+        """Coordinator side of partition reassignment: a partition whose
+        claiming router's lease lapsed ``miss_limit`` periods (or which
+        carries a dead marker) is force-released with a FENCED
+        compare-delete — a claimant that was merely stalled renews by CAS
+        against its own claim document and loses cleanly.  The tombstone
+        is cleared right away: the fence against the stale RENEWAL is the
+        expected-document mismatch, and fresh claims must land."""
+        if self.admission_partitions is None:
+            return
+        now = self.store.now()
+        table = lease_table(self.store,
+                            prefix=FLEET_ROUTER_HEARTBEAT_PREFIX)
+        marked = set(dead_set(self.store, prefix=FLEET_ROUTER_DEAD_PREFIX))
+        for i in range(self.admission_partitions):
+            key = self._partition_key(i)
+            doc = self.store.get(key)
+            if doc is None:
+                continue
+            owner = str(doc.get("router"))
+            if owner == self.router_id:
+                continue
+            lease = table.get(owner)
+            lapsed = (lease is None
+                      or lease.missed(now) >= self.miss_limit)
+            if not lapsed and owner not in marked:
+                continue
+            if self.store.compare_and_delete(key, doc):
+                self.store.clear_tombstone(key)
+                record_dead(self.store, owner, self.generation,
+                            self.router_id,
+                            prefix=FLEET_ROUTER_DEAD_PREFIX)
+                log_dist(
+                    f"fleet: released admission partition {i} from dead "
+                    f"router {owner} (lease "
+                    f"{'lapsed' if lapsed else 'marked dead'})", ranks=[0])
+
+    def _adopt_new_admissions(self) -> None:
+        """Coordinator pickup of follower-admitted requests: scan the
+        journal for entries this router does not track and adopt them
+        (the same adoption path a takeover runs).  Rate-limited to a
+        third of the election lease on the store clock — admission
+        latency is bounded by the scan period, which is the price of
+        store-only coupling between routers."""
+        if self.admission_partitions is None:
+            return
+        now = self.store.now()
+        if self._last_adopt_scan_t is not None \
+                and now - self._last_adopt_scan_t < self.lease_s / 3.0:
+            return
+        self._last_adopt_scan_t = now
+        for name in self.store.list(FLEET_REQUESTS_PREFIX):
+            rec = self.store.get(f"{FLEET_REQUESTS_PREFIX}/{name}")
+            if rec is None:
+                continue
+            rid = rec["rid"]
+            if rid in self._requests or rid in self._results:
+                continue
+            self._adopt_entry(rec)
+            self.adopted_admissions_total += 1
+
+    # ------------------------------------------------- weight-epoch barrier
+
+    def begin_epoch_flip(self, params, epoch: Optional[int] = None) -> int:
+        """Start a fleet-wide two-phase weight flip (docs/FLEET.md
+        "Weight-epoch barrier"; closes the docs/HYBRID.md caller-sequenced
+        limitation).  Phase 1 (prepare): routing is HELD — every new or
+        failed-over request parks at the router — while each live member
+        drains its in-flight work and flips to ``params`` at the target
+        epoch, writing a durable ``fleet/epoch/prepare/<engine>`` mark.
+        Phase 2 (commit): once every LIVE member's mark is at the target,
+        the coordinator CAS-commits ``fleet/epoch/current`` and releases
+        the held requests — so no request is ever admitted against stale
+        weights, on any member.  Members whose lease lapses mid-prepare
+        are excluded by the same lease scan that fails their work over
+        (the failover re-route parks with everything else until the
+        commit).  Coordinator action; the flip itself advances inside
+        :meth:`step` (see :meth:`flip_weight_epoch` for the synchronous
+        wrapper)."""
+        if not self.is_coordinator:
+            raise RuntimeError(
+                "begin_epoch_flip is a coordinator action — step() until "
+                "this router holds the lease")
+        if self._flip is not None:
+            raise RuntimeError(
+                f"weight-epoch flip to {self._flip['epoch']} is already "
+                "in progress")
+        target = int(epoch) if epoch is not None else self.fleet_epoch + 1
+        if target <= self.fleet_epoch:
+            raise ValueError(
+                f"epoch must advance: target {target} <= committed "
+                f"{self.fleet_epoch}")
+        doc = {"epoch": target, "coordinator": self.router_id,
+               "term": int(self.term), "t": self.store.now()}
+        while True:
+            cur = self.store.get(FLEET_EPOCH_FLIP_KEY)
+            if self.store.compare_and_swap(FLEET_EPOCH_FLIP_KEY, cur, doc):
+                break
+            if cur is None and self.store.get(FLEET_EPOCH_FLIP_KEY) is None:
+                self.store.clear_tombstone(FLEET_EPOCH_FLIP_KEY)
+        self._flip = doc
+        self._flip_params = params
+        log_dist(f"fleet: weight-epoch flip to {target} started "
+                 f"(coordinator {self.router_id}, term {self.term})",
+                 ranks=[0])
+        return target
+
+    def _advance_epoch_flip(self) -> None:
+        """One prepare/commit round of an in-progress flip — runs every
+        coordinator tick after the lease scan, so members that died
+        mid-prepare have already been excluded (and their work parked)."""
+        if self._flip is None:
+            return
+        target = int(self._flip["epoch"])
+        with trace_span("fleet.epoch_flip", epoch=target,
+                        router=self.router_id):
+            pending = []
+            for eid in sorted(self.members):
+                m = self.members[eid]
+                if not m.alive:
+                    continue   # lapsed mid-prepare: excluded by the scan
+                mark = self.store.get(f"{FLEET_EPOCH_PREPARE_PREFIX}/{eid}")
+                if mark is not None and int(mark.get("epoch") or -1) \
+                        >= target:
+                    continue   # durable prepare mark already at target
+                if not m.prepare_epoch(self._flip_params, target):
+                    pending.append(eid)
+            if pending:
+                return   # still draining; routing stays held
+            commit = {"epoch": target, "coordinator": self.router_id,
+                      "term": int(self.term), "t": self.store.now()}
+            while True:
+                cur = self.store.get(FLEET_EPOCH_KEY)
+                if cur is not None and int(cur.get("epoch") or 0) >= target:
+                    break   # a racing coordinator committed past us
+                if self.store.compare_and_swap(FLEET_EPOCH_KEY, cur,
+                                               commit):
+                    break
+        if self.store.compare_and_delete(FLEET_EPOCH_FLIP_KEY, self._flip):
+            # the tombstone fenced the dead coordinator's stale flip doc,
+            # not future flips — clear it so the next begin_ can create
+            self.store.clear_tombstone(FLEET_EPOCH_FLIP_KEY)
+        self.fleet_epoch = target
+        self.epoch_flips_total += 1
+        self._flip = None
+        self._flip_params = None
+        held, self._flip_hold = self._flip_hold, []
+        log_dist(f"fleet: weight-epoch {target} committed fleet-wide; "
+                 f"releasing {len(held)} held request(s)", ranks=[0])
+        for req, requeue in held:
+            self._route(req, requeue=requeue)
+
+    def flip_weight_epoch(self, params, epoch: Optional[int] = None,
+                          max_ticks: int = 500, on_tick=None) -> int:
+        """Synchronous fleet-wide weight flip: begin, then step the fleet
+        until the commit lands.  Returns the committed epoch.  This is
+        what :meth:`RolloutEngine.publish_weights_fleet` drives between
+        rollout rounds."""
+        target = self.begin_epoch_flip(params, epoch=epoch)
+        rounds = 0
+        while self._flip is not None:
+            self.step()
+            rounds += 1
+            if on_tick is not None:
+                on_tick(self, rounds)
+            if rounds >= max_ticks:
+                raise ServeTimeout(
+                    f"weight-epoch flip to {target} did not commit within "
+                    f"max_ticks={max_ticks} (members still draining?)")
+        return self.fleet_epoch
+
     def _remaining_deadline(self, req: Request) -> Optional[float]:
         """Deadline budget left, measured from the TRUE arrival epoch —
         idempotent across failovers (always derived from the original
@@ -634,6 +1124,12 @@ class FleetRouter:
         for eid in sorted(self.members):
             m = self.members[eid]
             if not (m.alive and m.routable):
+                continue
+            if self.fleet_epoch and m.weight_epoch() < self.fleet_epoch:
+                # weight-epoch invariant (docs/FLEET.md "Weight-epoch
+                # barrier"): a member still serving pre-flip weights is
+                # not an admission target — no request is ever admitted
+                # against stale weights
                 continue
             loads[eid] = m.outstanding()
             if best_load is None or loads[eid] < best_load:
@@ -707,6 +1203,15 @@ class FleetRouter:
         is never shed by its own recovery — the same contract the serving
         supervisor holds for replays."""
         rid = request.rid
+        if self._flip is not None:
+            # weight-epoch admission gate: nothing dispatches while the
+            # fleet flips (members must drain to flip, and a dispatch
+            # here would land on pre-flip weights) — parked, dispatched
+            # the round the flip commits.  Shedding is gated too:
+            # dropping work the fleet can serve seconds later is worse
+            # than holding it.
+            self._flip_hold.append((request, requeue))
+            return
         if not requeue and self.max_fleet_queue is not None \
                 and self.fleet_queue_depth() >= self.max_fleet_queue:
             self._shed(request, "fleet queue full")
@@ -835,6 +1340,14 @@ class FleetRouter:
             # (failover/resume) so a successor stitches the same record
             "trace_id": request.trace_id,
             "lifecycle": [list(e) for e in self._lifecycle.get(rid, ())],
+            # ownership stamp: which router wrote this document under
+            # which election term.  A takeover RE-stamps every adopted
+            # entry, so a deposed leader's mirror goes stale the moment a
+            # successor owns the journal — its compare-delete and CAS
+            # appends then lose by construction (docs/FLEET.md
+            # "Journal GC").
+            "owner": self.router_id,
+            "term": int(self.term),
             "t": self.store.now()}
         key = f"{FLEET_REQUESTS_PREFIX}/{_rid_key(rid)}"
         expected = self._journal_docs.get(rid)
@@ -859,6 +1372,14 @@ class FleetRouter:
                     self._journal_docs[rid] = doc
                     self._journal_sizes[rid] = _doc_bytes(doc)
                     return True
+                if cur is None and self.store.get(key) is None:
+                    # the create lost to nothing visible: a live GC
+                    # tombstone from a just-collected previous request
+                    # under the same rid.  Legitimate rid reuse — clear
+                    # the tombstone and retry (a racing deposed leader's
+                    # stale append still has a non-None expected and
+                    # cannot slip through this gap).
+                    self.store.clear_tombstone(key)
         if expected is None:
             # DISPATCH-time write (failover/redistribution) with no
             # mirror: this router lost journal ownership earlier (a lost
@@ -893,17 +1414,32 @@ class FleetRouter:
         collected by a freshly elected standby that never dispatched the
         request.
 
-        Known residual window (documented, not guarded): a leader that
-        confirms its lease at the top of step(), then stalls past the
-        election lease MID-step, can reach this delete after a successor
-        adopted the entry.  The store API has no compare-and-delete, so
-        the delete cannot be fenced the way the CAS'd writes are — but in
-        that scenario the deposed router also CLAIMED the result from the
-        (in-process) member, so keeping the entry would only make the
-        successor re-serve a request whose result was already returned.
-        The window is one stalled step; the deposed router discovers its
-        deposition at the next election poll and stops collecting."""
-        self.store.delete(f"{FLEET_REQUESTS_PREFIX}/{_rid_key(rid)}")
+        The delete is FENCED (``compare_and_delete`` against the same
+        mirror the CAS'd writes use), closing what used to be the
+        one-stalled-step duplicate-serve window: a leader that confirms
+        its lease at the top of step(), stalls past the election lease
+        MID-step, and reaches this delete after a successor adopted (and
+        re-stamped) the entry now LOSES the compare — the successor's
+        document survives and the request is re-served exactly once by
+        the owner that adopted it.  With no mirror we fall back to a
+        store read, but stand down entirely if the document carries a
+        different router's ownership stamp."""
+        key = f"{FLEET_REQUESTS_PREFIX}/{_rid_key(rid)}"
+        expected = self._journal_docs.get(rid)
+        if expected is None:
+            expected = self.store.get(key)
+            if expected is not None and expected.get("owner") not in (
+                    None, self.router_id):
+                logger.warning(
+                    "fleet: journal GC for %r stood down — entry is owned "
+                    "by %r now (we were deposed)", rid,
+                    expected.get("owner"))
+                expected = None
+        if expected is not None:
+            if not self.store.compare_and_delete(key, expected):
+                logger.warning(
+                    "fleet: journal GC for %r lost its compare-delete (a "
+                    "successor re-stamped the entry); standing down", rid)
         self._journal_docs.pop(rid, None)
         self._journal_sizes.pop(rid, None)
         self._resumed.pop(rid, None)
@@ -956,6 +1492,12 @@ class FleetRouter:
                     cur = self.store.get(key)
                     if cur is None:
                         continue   # collected/shed elsewhere: never recreate
+                    if cur.get("owner") not in (None, self.router_id):
+                        # a successor re-stamped this entry: it owns the
+                        # stream's journal now, and an append from here —
+                        # however fresh the tokens — would race its GC's
+                        # compare-delete into a leak.  Deposed: stand down.
+                        continue
                     # re-cache what we just read: without this, an entry
                     # whose mirror was dropped (lost CAS) pays a store read
                     # EVERY flush for the rest of its stream, and falls out
@@ -1001,6 +1543,12 @@ class FleetRouter:
                                   key=self.election_key)
         if lease is None:
             self.is_coordinator = False
+            if self.admission_partitions is not None:
+                # follower routers stay useful: renew the router lease the
+                # coordinator's partition scan keys off, and keep/claim
+                # admission partitions so admit() has somewhere to land
+                self._beat_router()
+                self.claim_partitions()
             return self.outstanding()
         if not self.is_coordinator or lease.term != self.term:
             self._take_over(lease)
@@ -1014,6 +1562,10 @@ class FleetRouter:
                 if m.alive:
                     m.generation = self.generation
                     m.beat()
+            if self.admission_partitions is not None:
+                self._beat_router()
+                self._adopt_new_admissions()
+                self._scan_router_leases()
             now = time.monotonic() - self._t0
             k = bisect.bisect_right(self._later, now,
                                     key=lambda r: r.arrival_time)
@@ -1045,6 +1597,7 @@ class FleetRouter:
                 self._last_flush_t = self.store.now()
                 self.journal_flushes_total += 1
             self._scan_leases()
+            self._advance_epoch_flip()
             self._write_gauges()
             if self._slo is not None:
                 # router-side SLOs (docs/FLEET.md): evaluated AFTER the
@@ -1110,10 +1663,21 @@ class FleetRouter:
                 # work exists on the store (either the live coordinator
                 # finishes it, emptying the journal, or its lease lapses
                 # and this router takes over); exiting here would abandon
-                # requests a dead coordinator dispatched
-                if self.is_coordinator \
+                # requests a dead coordinator dispatched.  A PARTITIONED
+                # coordinator has the same obligation: a follower may have
+                # journal-created an admission it has not adopted yet, so
+                # "tracking nothing" only means done once the journal is
+                # empty too.
+                if (self.is_coordinator
+                        and self.admission_partitions is None) \
                         or not self.store.list(FLEET_REQUESTS_PREFIX):
                     return self.take_results()
+                if self.is_coordinator:
+                    # idle with journaled work outstanding: the adopt-scan
+                    # rate limit only bounds scan COST while serving — an
+                    # idle coordinator should pick follower admissions up
+                    # next round, not after lease_s/3
+                    self._last_adopt_scan_t = None
             if max_ticks is not None and rounds >= max_ticks:
                 raise ServeTimeout(
                     f"fleet loop exceeded max_ticks={max_ticks} with "
@@ -1222,6 +1786,12 @@ class FleetRouter:
         m = self.members.get(engine_id)
         if m is not None:
             m.alive = False
+            # harvest DURABLE results first: a store-proxied member's
+            # published results outlive its process (the results channel
+            # is on the store), and re-routing a request whose terminal
+            # result already landed would serve it twice.  An in-process
+            # dead member reports nothing here — its results died with it.
+            self._collect(m)
         self._failed_engines.add(engine_id)
         record_dead(self.store, engine_id, self.generation, self.router_id,
                     prefix=FLEET_DEAD_PREFIX)
@@ -1307,6 +1877,83 @@ class FleetRouter:
 
     # ----------------------------------------------------- coordinator side
 
+    def _restamp(self, rec: Dict[str, Any]) -> Dict[str, Any]:
+        """CAS-rewrite an adopted journal document with THIS router's
+        ownership stamp.  This is the fencing half of the compare-delete
+        story: the moment the stamp lands, a deposed leader's mirror (and
+        therefore its compare-delete and CAS appends) is stale and loses
+        by construction.  On CAS loss — a concurrent writer got there
+        first — re-read and use the store's truth; the next write from
+        this router re-syncs or stands down normally."""
+        key = f"{FLEET_REQUESTS_PREFIX}/{_rid_key(rec['rid'])}"
+        stamped = dict(rec, owner=self.router_id, term=int(self.term),
+                       t=self.store.now())
+        if self.store.compare_and_swap(key, rec, stamped):
+            return stamped
+        cur = self.store.get(key)
+        return cur if cur is not None else rec
+
+    def _adopt_entry(self, rec: Dict[str, Any]) -> None:
+        """Adopt one journal document this router has never tracked:
+        re-stamp ownership, rebuild the Request (RNG lane, trace id,
+        failover/lifecycle history), mirror the token-journal state, and
+        either park/route it (undispatched) or record its owner engine.
+        Shared by coordinator takeover and by the admission-adoption scan
+        that picks up entries journaled by follower routers."""
+        rec = self._restamp(rec)
+        rid = rec["rid"]
+        req = Request(
+            rid=rid,
+            input_ids=np.asarray(rec["input_ids"], np.int32),
+            max_new_tokens=int(rec["max_new_tokens"]),
+            eos_token_id=rec["eos_token_id"],
+            deadline_s=rec["deadline_s"],
+            arrival_epoch_s=rec["arrival_epoch_s"],
+            # re-derive the RNG lane from the journaled seed/params
+            # — counter-based keys make the adopted stream's
+            # continuation token-exact (the counter is implicit in
+            # prompt + journaled length; `lane_counter` documents
+            # it for operators and cross-implementations)
+            sampling=(SamplingParams(**rec["sampling"])
+                      if rec.get("sampling") else None),
+            # the journaled trace id: the adopted request stays
+            # ONE trace across coordinator takeovers too
+            trace_id=rec.get("trace_id"))
+        self._requests[rid] = req
+        if rec.get("failovers"):
+            self._failed_over[rid] = int(rec["failovers"])
+        if rec.get("lifecycle"):
+            self._lifecycle[rid] = [tuple(e)
+                                    for e in rec["lifecycle"]]
+        # adopt the token-journal state: the document is the CAS
+        # base for this router's future appends, and `resumed`
+        # tokens are baked into the LIVE assignment's prompt — the
+        # successor must stitch collected outputs exactly as the
+        # dispatching router would have
+        self._journal_docs[rid] = rec
+        self._journal_sizes[rid] = _doc_bytes(rec)
+        if rec.get("resumed"):
+            self._resumed[rid] = [
+                int(t) for t in
+                (rec.get("tokens") or [])[:int(rec["resumed"])]]
+        if rec["engine"] is None:
+            # accepted but never dispatched (a future arrival
+            # parked at the dead coordinator): keep the remaining
+            # delay on OUR clock, or route now when already due
+            remaining = max(0.0, (req.arrival_epoch_s or 0.0)
+                            - time.monotonic())
+            if remaining > 0:
+                req = dataclasses.replace(
+                    req, arrival_time=(time.monotonic() - self._t0
+                                       + remaining))
+                self._requests[rid] = req
+                bisect.insort(self._later, req,
+                              key=lambda r: r.arrival_time)
+            else:
+                self._route(req)
+        else:
+            self._owner[rid] = rec["engine"]
+
     def _take_over(self, lease) -> None:
         """This router just became (or re-confirmed as) the leader: bump
         the fleet generation (CAS — a deposed leader racing its successor
@@ -1322,6 +1969,31 @@ class FleetRouter:
             self._lead_since = self.store.now()
             self.generation = bump_generation(self.store,
                                               key=self.generation_key)
+            # adopt the fleet weight epoch — and any IN-PROGRESS flip the
+            # dead coordinator left behind.  The successor has no access
+            # to the dead process's param tree, so the adopted flip runs
+            # with params=None: each member re-stamps its own weights at
+            # the target epoch (a daemon pulls from its params_provider).
+            # Completing the flip (rather than abandoning it) is what
+            # keeps members that already prepared from diverging from the
+            # committed epoch forever.
+            committed = self.store.get(FLEET_EPOCH_KEY)
+            if committed is not None:
+                self.fleet_epoch = max(self.fleet_epoch,
+                                       int(committed.get("epoch") or 0))
+            flip = self.store.get(FLEET_EPOCH_FLIP_KEY)
+            if flip is not None and self._flip is None:
+                if int(flip.get("epoch") or 0) > self.fleet_epoch:
+                    self._flip = flip
+                    self._flip_params = None
+                    log_dist(
+                        f"fleet: adopted in-progress weight-epoch flip to "
+                        f"{flip.get('epoch')} from deposed coordinator "
+                        f"{flip.get('coordinator')}", ranks=[0])
+                elif self.store.compare_and_delete(FLEET_EPOCH_FLIP_KEY,
+                                                   flip):
+                    # stale flip doc at or below the committed epoch
+                    self.store.clear_tombstone(FLEET_EPOCH_FLIP_KEY)
             adopted = 0
             for name in self.store.list(FLEET_REQUESTS_PREFIX):
                 rec = self.store.get(f"{FLEET_REQUESTS_PREFIX}/{name}")
@@ -1336,7 +2008,9 @@ class FleetRouter:
                     # tokens/resumed/engine.  Re-sync every mirror to the
                     # store's truth, or collect-time stitching would use
                     # our stale pre-deposition state (e.g. dropping the
-                    # successor's resumed prefix from the output).
+                    # successor's resumed prefix from the output).  The
+                    # re-stamp re-fences the entry under OUR new term.
+                    rec = self._restamp(rec)
                     self._journal_docs[rid] = rec
                     self._journal_sizes[rid] = _doc_bytes(rec)
                     if rec.get("resumed"):
@@ -1353,57 +2027,7 @@ class FleetRouter:
                     if rec["engine"] is not None:
                         self._owner[rid] = rec["engine"]
                     continue
-                req = Request(
-                    rid=rid,
-                    input_ids=np.asarray(rec["input_ids"], np.int32),
-                    max_new_tokens=int(rec["max_new_tokens"]),
-                    eos_token_id=rec["eos_token_id"],
-                    deadline_s=rec["deadline_s"],
-                    arrival_epoch_s=rec["arrival_epoch_s"],
-                    # re-derive the RNG lane from the journaled seed/params
-                    # — counter-based keys make the adopted stream's
-                    # continuation token-exact (the counter is implicit in
-                    # prompt + journaled length; `lane_counter` documents
-                    # it for operators and cross-implementations)
-                    sampling=(SamplingParams(**rec["sampling"])
-                              if rec.get("sampling") else None),
-                    # the journaled trace id: the adopted request stays
-                    # ONE trace across coordinator takeovers too
-                    trace_id=rec.get("trace_id"))
-                self._requests[rid] = req
-                if rec.get("failovers"):
-                    self._failed_over[rid] = int(rec["failovers"])
-                if rec.get("lifecycle"):
-                    self._lifecycle[rid] = [tuple(e)
-                                            for e in rec["lifecycle"]]
-                # adopt the token-journal state: the document is the CAS
-                # base for this router's future appends, and `resumed`
-                # tokens are baked into the LIVE assignment's prompt — the
-                # successor must stitch collected outputs exactly as the
-                # dispatching router would have
-                self._journal_docs[rid] = rec
-                self._journal_sizes[rid] = _doc_bytes(rec)
-                if rec.get("resumed"):
-                    self._resumed[rid] = [
-                        int(t) for t in
-                        (rec.get("tokens") or [])[:int(rec["resumed"])]]
-                if rec["engine"] is None:
-                    # accepted but never dispatched (a future arrival
-                    # parked at the dead coordinator): keep the remaining
-                    # delay on OUR clock, or route now when already due
-                    remaining = max(0.0, (req.arrival_epoch_s or 0.0)
-                                    - time.monotonic())
-                    if remaining > 0:
-                        req = dataclasses.replace(
-                            req, arrival_time=(time.monotonic() - self._t0
-                                               + remaining))
-                        self._requests[rid] = req
-                        bisect.insort(self._later, req,
-                                      key=lambda r: r.arrival_time)
-                    else:
-                        self._route(req)
-                else:
-                    self._owner[rid] = rec["engine"]
+                self._adopt_entry(rec)
                 adopted += 1
             log_dist(
                 f"fleet: router {self.router_id} leads term {self.term} "
@@ -1514,6 +2138,16 @@ class FleetRouter:
             "router_slo_states": (self._slo.states()
                                   if self._slo is not None else {}),
             "tokens_by_engine": dict(self.tokens_by_engine),
+            # host-scale fleet (docs/FLEET.md): sharded-admission and
+            # weight-epoch-barrier state
+            "fleet_epoch": self.fleet_epoch,
+            "epoch_flip_in_progress": (int(self._flip["epoch"])
+                                       if self._flip is not None else None),
+            "epoch_flips_total": self.epoch_flips_total,
+            "admission_partitions": self.admission_partitions,
+            "my_partitions": sorted(self._my_partitions),
+            "partition_admissions_total": self.partition_admissions_total,
+            "adopted_admissions_total": self.adopted_admissions_total,
             "engines": ads,
         }
 
@@ -1624,4 +2258,21 @@ class FleetRouter:
                        for ad in ads.values())
                    + (self._trace_pub.dropped_total
                       if self._trace_pub is not None else 0)), self._tick),
+            # host-scale fleet (docs/FLEET.md "Host-scale deployment"):
+            # store CAS contention, the committed weight epoch + flips,
+            # sharded-admission volume, and store-channel drop accounting
+            # summed across store-proxied members
+            ("fleet/store_cas_contended_total",
+             float(getattr(self.store, "cas_contended_total", 0) or 0),
+             self._tick),
+            ("fleet/weight_epoch", float(self.fleet_epoch), self._tick),
+            ("fleet/epoch_flips_total", float(self.epoch_flips_total),
+             self._tick),
+            ("fleet/partition_admissions_total",
+             float(self.partition_admissions_total), self._tick),
+            ("fleet/adopted_admissions_total",
+             float(self.adopted_admissions_total), self._tick),
+            ("fleet/channel_dropped_total",
+             float(sum(int(getattr(m, "channel_dropped_total", 0) or 0)
+                       for m in self.members.values())), self._tick),
         ])

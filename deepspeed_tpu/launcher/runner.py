@@ -132,6 +132,17 @@ def parse_args(args=None):
     p.add_argument("--fleet_miss_limit", type=int, default=3,
                    help="missed leases before the router declares an "
                         "engine dead and fails its requests over")
+    p.add_argument("--fleet_daemon", action="store_true",
+                   help="host-scale fleet (docs/FLEET.md): spawn the N "
+                        "--fleet members as PER-PROCESS member daemons "
+                        "(tools/fleet_member.py children, store-only "
+                        "coupling) instead of in-process engines; the "
+                        "serving script drives StoreMemberProxy handles")
+    p.add_argument("--fleet_routers", type=int, default=0, metavar="N",
+                   help="sharded admission: export DS_TPU_FLEET_ROUTERS=N "
+                        "so the serving script runs N routers under one "
+                        "coordinator election, each CAS-claiming admission "
+                        "partitions (rid-hash sharded; docs/FLEET.md)")
     p.add_argument("--force_multi", action="store_true",
                    help="use the multinode path even for a single local host")
     p.add_argument("user_script", help="training script (or module with --module)")
@@ -150,6 +161,16 @@ def parse_args(args=None):
                     "--fleet_coord_dir (or --pod_coord_dir, which it "
                     "defaults to) — engine leases and the coordinator "
                     "election live there")
+    if parsed.fleet_daemon and not parsed.fleet:
+        p.error("--fleet_daemon needs --fleet N: the daemons ARE the "
+                "fleet members")
+    if parsed.fleet_routers:
+        if parsed.fleet_routers < 1:
+            p.error(f"--fleet_routers {parsed.fleet_routers}: need at "
+                    "least one router")
+        if not parsed.fleet:
+            p.error("--fleet_routers needs --fleet N: routers shard "
+                    "admission over the fleet's store")
     return parsed
 
 
@@ -159,12 +180,43 @@ def fleet_env(args) -> dict:
     read these to build their members (docs/FLEET.md)."""
     if not args.fleet:
         return {}
-    return {
+    env = {
         "DS_TPU_FLEET_SIZE": str(args.fleet),
         "DS_TPU_FLEET_COORD_DIR": args.fleet_coord_dir or args.pod_coord_dir,
         "DS_TPU_FLEET_LEASE": str(args.fleet_lease),
         "DS_TPU_FLEET_MISS_LIMIT": str(args.fleet_miss_limit),
     }
+    if args.fleet_daemon:
+        # the members run as child daemon processes: the serving script
+        # builds StoreMemberProxy handles instead of in-process engines
+        env["DS_TPU_FLEET_DAEMON"] = "1"
+    if args.fleet_routers:
+        env["DS_TPU_FLEET_ROUTERS"] = str(args.fleet_routers)
+    return env
+
+
+def spawn_fleet_daemons(args, env) -> list:
+    """Start the ``--fleet N`` member daemons as children of the launcher
+    (one ``tools/fleet_member.py`` process per engine, store coupling
+    only).  Returns the ``subprocess.Popen`` handles; the caller reaps
+    them after the serving script exits (the script itself shuts members
+    down through the control channel)."""
+    script = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "..", "tools", "fleet_member.py")
+    script = os.path.normpath(script)
+    if not os.path.isfile(script):
+        raise FileNotFoundError(
+            f"--fleet_daemon: member entry point not found at {script}")
+    procs = []
+    for i in range(args.fleet):
+        child_env = dict(env)
+        child_env["DS_TPU_FLEET_ENGINE_ID"] = f"engine{i}"
+        procs.append(subprocess.Popen(
+            [sys.executable, script], env=child_env))
+        logger.info("launcher: fleet member daemon engine%d -> pid %d",
+                    i, procs[-1].pid)
+    return procs
 
 
 def fetch_hostfile(path: str) -> "OrderedDict[str, int]":
@@ -294,9 +346,21 @@ def _run_local_single(args, active) -> int:
     env = dict(os.environ)
     env.pop("COORDINATOR_ADDRESS", None)  # single-process mode
     env.update(fleet_env(args))
+    daemons = spawn_fleet_daemons(args, env) if args.fleet_daemon else []
     cmd = _build_user_cmd(args)
     logger.info("launcher: single-host local exec: %s", shlex.join(cmd))
-    return subprocess.call(cmd, env=env)
+    try:
+        return subprocess.call(cmd, env=env)
+    finally:
+        for p in daemons:
+            if p.poll() is None:
+                p.terminate()
+        for p in daemons:
+            try:
+                p.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:   # pragma: no cover
+                p.kill()
+                p.wait()
 
 
 def wait_all_or_fail(procs, poll_s: float = 0.2, on_fail=None,

@@ -74,12 +74,13 @@ SITE_SERVE_REPLAY = "serve.replay"
 SITE_POD_HEARTBEAT = "pod.heartbeat"
 SITE_POD_RENDEZVOUS = "pod.rendezvous"
 SITE_SHARD_COMMIT = "ckpt.shard_commit"
+SITE_FLEET_CHANNEL = "fleet.channel_append"
 
 SITES = (SITE_CKPT_SAVE, SITE_CKPT_LOAD, SITE_LATEST_PUBLISH,
          SITE_TRAIN_STEP, SITE_SUPERVISOR_ATTEMPT, SITE_SERVE_TICK,
          SITE_SERVE_ADMIT, SITE_SERVE_PREFILL, SITE_SERVE_DECODE,
          SITE_SERVE_REPLAY, SITE_POD_HEARTBEAT, SITE_POD_RENDEZVOUS,
-         SITE_SHARD_COMMIT)
+         SITE_SHARD_COMMIT, SITE_FLEET_CHANNEL)
 KINDS = ("raise", "delay", "corrupt", "sigterm")
 
 FAULTS_ENV = "DS_TPU_FAULTS"

@@ -191,6 +191,31 @@ class RolloutEngine:
             ])
         return stats
 
+    def publish_weights_fleet(self, router, max_ticks: int = 500,
+                              on_tick=None) -> int:
+        """Fleet-capable publish (docs/FLEET.md "Weight-epoch barrier";
+        closes the docs/HYBRID.md single-engine limitation): flip EVERY
+        member of ``router``'s fleet to the current training weights
+        through the store-mediated two-phase barrier — the router holds
+        admission while members drain and prepare, then commits, so no
+        rollout request is ever admitted against stale weights on any
+        member.  Store-proxied member daemons re-derive their weight
+        material from their own ``params_provider`` (the epoch number is
+        what crosses the store).  Returns the committed fleet epoch."""
+        params = self.hybrid._generation_params()
+        target = max(self.weight_epoch, router.fleet_epoch) + 1
+        with trace_span("rollout.publish", epoch=target):
+            epoch = router.flip_weight_epoch(params, epoch=target,
+                                             max_ticks=max_ticks,
+                                             on_tick=on_tick)
+        self._published_params = params
+        if self.monitor is not None:
+            self.monitor.write_events([
+                ("rollout/weight_epoch", float(epoch), 0),
+                ("rollout/refresh_s", 0.0, 0),
+            ])
+        return epoch
+
     # ------------------------------------------------------------- rollout
 
     def _normalize_prompts(self, prompts: Prompts) -> List[np.ndarray]:

@@ -771,7 +771,14 @@ def test_fleet_reelected_leader_resyncs_tracked_rids(tiny_engine, tmp_path):
     router._take_over(CoordinatorLease(leader_id="router0", term=2,
                                        t=router.store.now(), lease_s=100.0))
     assert router._resumed["x"] == [11, 12, 13]
-    assert router._journal_docs["x"] == doc
+    adopted = router._journal_docs["x"]
+    # the re-adopted mirror carries the successor's stream state...
+    assert adopted["tokens"] == [11, 12, 13] and adopted["resumed"] == 3
+    assert adopted["engine"] == other and adopted["failovers"] == 1
+    # ...RE-STAMPED under this router's new term (ISSUE 16 ownership
+    # fencing: any still-stalled writer's mirror goes stale on adoption)
+    assert adopted["owner"] == "router0" and adopted["term"] == 2
+    assert store.get(key) == adopted
     assert router._failed_over["x"] == 1
     assert router._owner["x"] == other
 
@@ -1132,3 +1139,402 @@ def test_fleet_journal_flush_ms_time_based_cadence(tiny_engine, tmp_path):
     assert h["journal_flushes_total"] == router.journal_flushes_total
     with pytest.raises(ValueError, match="journal_flush_ms"):
         FleetRouter(store, members, journal_flush_ms=0.0)
+
+
+# ------------------- compare-delete, tombstones, channels (ISSUE 16)
+
+def test_compare_and_delete_matches_and_tombstones(tmp_path):
+    s = _store(tmp_path)
+    s.put("j/r1", {"v": 1})
+    assert not s.compare_and_delete("j/r1", {"v": 0})   # stale expected
+    assert s.get("j/r1") == {"v": 1}
+    assert s.compare_and_delete("j/r1", {"v": 1})
+    assert s.get("j/r1") is None
+    # the delete's tombstone blocks create-if-absent — the deposed
+    # writer's "append as create" can never resurrect the entry...
+    assert not s.compare_and_swap("j/r1", None, {"v": 9})
+    assert s.get("j/r1") is None
+    # ...until the owner that deleted it clears the tombstone (rid reuse)
+    s.clear_tombstone("j/r1")
+    assert s.compare_and_swap("j/r1", None, {"v": 9})
+    with pytest.raises(ValueError, match="expected"):
+        s.compare_and_delete("j/r1", None)
+
+
+def test_compare_and_delete_racing_deleters_exactly_one_wins(tmp_path):
+    s = _store(tmp_path)
+    s.put("k", {"v": 7})
+    wins = []
+    barrier = threading.Barrier(4)
+
+    def racer():
+        barrier.wait()
+        wins.append(s.compare_and_delete("k", {"v": 7}))
+
+    ts = [threading.Thread(target=racer) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert sum(wins) == 1                  # exactly one deleter won
+    assert s.get("k") is None
+
+
+def test_tombstone_expires_by_ttl_and_hides_from_list(tmp_path):
+    s = _store(tmp_path)
+    s.put("j/a", {"v": 1})
+    s.put("j/b", {"v": 2})
+    assert s.compare_and_delete("j/a", {"v": 1})
+    # tombstones are write-protocol artifacts: invisible to list()
+    assert s.list("j") == ["b"]
+    assert not s.compare_and_swap("j/a", None, {"v": 3})
+    # the TTL is real wall time (file mtime): backdate the tomb past it
+    tomb = s._path("j/a") + ".tomb"
+    past = time.time() - s.tombstone_ttl_s - 1.0
+    os.utime(tomb, (past, past))
+    assert s.compare_and_swap("j/a", None, {"v": 3})
+    assert s.get("j/a") == {"v": 3}
+
+
+def test_cas_lock_contention_backs_off_and_counts(tmp_path):
+    """Satellite (a): a held per-key lock makes the CAS jitter-back-off
+    instead of failing, and the contention lands in the
+    ``fleet/store_cas_contended_total`` counter's source."""
+    s = _store(tmp_path)
+    s.put("k", {"v": 0})
+    lock = s._path("k") + ".lock"
+    open(lock, "w").close()                 # a concurrent writer's lock
+    done = []
+    t = threading.Thread(
+        target=lambda: done.append(s.compare_and_swap("k", {"v": 0},
+                                                      {"v": 1})))
+    t.start()
+    time.sleep(0.05)
+    os.remove(lock)
+    t.join()
+    assert done == [True]                   # backed off, then won
+    assert s.cas_contended_total >= 1
+    # the router surfaces it as a fleet gauge (health/_write_gauges read
+    # the same counter); plain base-class stores report 0 via getattr
+
+
+def test_channel_append_consume_ordering_and_drop_accounting(tmp_path):
+    from deepspeed_tpu.elasticity import (channel_append, channel_consume,
+                                          channel_stats)
+
+    s = _store(tmp_path)
+    seqs = [channel_append(s, "fleet/assign/e0", {"i": i}, "router0")
+            for i in range(5)]
+    assert seqs == sorted(seqs) and len(set(seqs)) == 5   # monotonic seq
+    got = channel_consume(s, "fleet/assign/e0", "e0")
+    assert [d["i"] for _, d in got] == list(range(5))     # FIFO, all
+    assert channel_consume(s, "fleet/assign/e0", "e0") == []
+    st = channel_stats(s, "fleet/assign/e0")
+    assert st["pending"] == 0 and st["seq"] == seqs[-1]
+    # bounded channel: oldest entries drop, and the drop is ACCOUNTED
+    for i in range(4):
+        channel_append(s, "c2", {"i": i}, "w", max_items=2)
+    st = channel_stats(s, "c2")
+    assert st["dropped"] == 2
+    assert [d["i"] for _, d in channel_consume(s, "c2", "r")] == [2, 3]
+
+
+def test_channel_racing_consumers_each_item_exactly_once(tmp_path):
+    from deepspeed_tpu.elasticity import channel_append, channel_consume
+
+    s = _store(tmp_path)
+    for i in range(6):
+        channel_append(s, "ch", {"i": i}, "w")
+    claimed = []
+    lock = threading.Lock()
+    barrier = threading.Barrier(3)
+
+    def consumer(cid):
+        barrier.wait()
+        got = channel_consume(s, "ch", cid)
+        with lock:
+            claimed.extend(d["i"] for _, d in got)
+
+    ts = [threading.Thread(target=consumer, args=(f"c{i}",))
+          for i in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    # the CAS truncation makes consumption atomic: every item claimed by
+    # exactly one consumer, none lost, none doubled
+    assert sorted(claimed) == list(range(6))
+
+
+# ------------------------- member daemon over store channels (ISSUE 16)
+
+def test_store_member_daemon_serves_token_exact_and_verbs(
+        tiny_engine, reference, tmp_path):
+    """In-process pump of the daemon loop: a FleetMember coupled to its
+    router ONLY through the store (assignments/results/control channels +
+    progress docs) must serve token-exact, GC the journal, and honor
+    control verbs."""
+    from deepspeed_tpu.inference.fleet_daemon import (FleetMemberDaemon,
+                                                      StoreMemberProxy)
+
+    store = _store(tmp_path)
+    member = FleetMember(
+        "engine0",
+        tiny_engine.supervised_serving(max_restarts=5, **SERVE_KW),
+        store, lease_s=100.0)
+    member.beat(force=True)
+    daemon = FleetMemberDaemon(member, store)
+    proxy = StoreMemberProxy("engine0", store, lease_s=100.0)
+    proxy.beat()
+    router = FleetRouter(store, [proxy], lease_s=100.0)
+    reqs, ref = reference
+    results = router.run(_copies(reqs[:4]), max_ticks=2000,
+                         on_tick=lambda r, n: daemon.poll_once())
+    assert sorted(r.rid for r in results) == [r.rid for r in reqs[:4]]
+    for r in results:
+        assert np.array_equal(r.output_ids, ref[r.rid]), r.rid
+    assert store.list("fleet/requests") == []          # journal GC'd
+    # control verbs ride the control channel: recycle then shutdown
+    assert proxy.recycle()
+    daemon.poll_once()
+    assert member.alive
+    proxy.send_control("shutdown")
+    daemon.poll_once()
+    assert daemon.shutdown
+
+
+def test_store_member_proxy_dead_member_results_stay_claimable(
+        tiny_engine, tmp_path):
+    """The durable-results contract: a result the daemon published before
+    dying is claimable AFTER the death (unlike an in-process member,
+    whose unclaimed results die with it) — this is what makes failover
+    collect-first safe against duplicate serves."""
+    from deepspeed_tpu.elasticity import channel_append
+    from deepspeed_tpu.inference.fleet_daemon import StoreMemberProxy
+
+    store = _store(tmp_path)
+    proxy = StoreMemberProxy("engine0", store, lease_s=1.0)
+    channel_append(store, "fleet/results/engine0",
+                   {"rid": 1, "input_ids": [1, 2], "output_ids": [3],
+                    "finish_reason": "length", "prefill_bucket": 8},
+                   "engine0")
+    proxy.alive = False                     # SIGKILLed
+    assert proxy.stream_progress() == {}    # no live progress claims
+    got = proxy.take_results()
+    assert [r.rid for r in got] == [1]      # durable result survives
+
+
+# ------------------------------------ sharded admission (ISSUE 16)
+
+def test_partition_of_deterministic_and_in_range():
+    from deepspeed_tpu.inference.fleet import partition_of
+
+    for rid in (0, 7, "req-a", "7", 10 ** 9):
+        p = partition_of(rid, 4)
+        assert p == partition_of(rid, 4)
+        assert 0 <= p < 4
+    assert partition_of(3, 1) == 0
+
+
+def test_sharded_admission_follower_admits_coordinator_serves(
+        tiny_engine, reference, tmp_path):
+    from deepspeed_tpu.inference.fleet import FleetWrongPartition
+
+    store = _store(tmp_path)
+    members = [FleetMember(f"engine{i}",
+                           tiny_engine.supervised_serving(
+                               max_restarts=5, **SERVE_KW),
+                           store, lease_s=100.0)
+               for i in range(2)]
+    coord = FleetRouter(store, members, router_id="r0", lease_s=100.0,
+                        admission_partitions=2)
+    follower = FleetRouter(store, members, router_id="r1", lease_s=100.0,
+                           admission_partitions=2)
+    coord.step()                            # wins the election
+    assert coord.is_coordinator
+    reqs, ref = reference
+    # admission requires partition ownership — this follower has not
+    # claimed anything yet, so a misrouted request must fail loudly
+    # (routing is the caller's contract, not a silent re-route)
+    with pytest.raises(FleetWrongPartition):
+        follower.admit(_copies(reqs[:1])[0])
+    for _ in range(6):                      # follower CAS-claims both
+        follower.step()
+        if len(follower._my_partitions) == 2:
+            break
+    assert follower._my_partitions == {0, 1}
+    # the coordinator never journal-defers its own admissions: admit()
+    # falls through to plain submit() (it IS the serving loop)
+    coord.admit(_copies(reqs[4:5])[0])
+    assert coord.outstanding() == 1
+    for r in _copies(reqs[:4]):
+        follower.admit(r)
+    assert follower.partition_admissions_total == 4
+    # the follower only journal-created: nothing is tracked there
+    assert follower.outstanding() == 0
+    results = coord.run([], max_ticks=2000,
+                        on_tick=lambda r, n: follower.step())
+    assert sorted(r.rid for r in results) == sorted(r.rid for r in reqs[:5])
+    for r in results:
+        assert np.array_equal(r.output_ids, ref[r.rid]), r.rid
+    assert coord.adopted_admissions_total == 4
+    assert store.list("fleet/requests") == []
+    h = coord.health()
+    assert h["admission_partitions"] == 2
+    assert h["adopted_admissions_total"] == 4
+
+
+def test_router_death_reassigns_partitions(tiny_engine, tmp_path):
+    """A follower whose router lease lapses loses its partitions: the
+    coordinator's router-lease scan compare-deletes the claims (and
+    records the death); a surviving follower re-claims them."""
+    clock = [0.0]
+    store = _store(tmp_path, clock=lambda: clock[0])
+    members = [FleetMember("engine0",
+                           tiny_engine.supervised_serving(
+                               max_restarts=5, **SERVE_KW),
+                           store, lease_s=100.0)]
+    mk = lambda rid: FleetRouter(store, members, router_id=rid,  # noqa: E731
+                                 lease_s=2.0, miss_limit=3,
+                                 admission_partitions=3)
+    coord, f1, f2 = mk("r0"), mk("r1"), mk("r2")
+    coord.step()
+    assert coord.is_coordinator
+    for _ in range(12):
+        f1.step()
+        f2.step()
+        clock[0] += 0.1
+        if len(f1._my_partitions) + len(f2._my_partitions) == 3:
+            break
+    assert len(f1._my_partitions) + len(f2._my_partitions) == 3
+    lost = set(f1._my_partitions)
+    # f1 dies silently: stops stepping, its router lease lapses
+    clock[0] += 2.0 * 3 + 1.0
+    for _ in range(10):
+        coord.step()                        # scan reaps the lapsed claims
+        f2.step()                           # survivor re-claims
+        clock[0] += 0.7
+        if lost <= f2._my_partitions:
+            break
+    assert lost <= f2._my_partitions
+    assert "r1" in dead_set(store, prefix="fleet/router_dead")
+
+
+# ------------------------------- weight-epoch barrier (ISSUE 16)
+
+def test_epoch_flip_holds_admission_until_committed(tiny_engine, reference,
+                                                    tmp_path):
+    store, router = _fleet(tiny_engine, tmp_path, n=2)
+    router.step()
+    assert router.is_coordinator and router.fleet_epoch == 0
+    target = router.begin_epoch_flip(None)  # re-stamp current weights
+    assert target == 1
+    # admission during the flip PARKS — no member may see the request
+    # until every member runs at the new epoch
+    reqs, ref = reference
+    router.submit(_copies(reqs[:1])[0])
+    assert len(router._flip_hold) == 1
+    assert all(m.outstanding() == 0 for m in router.members.values())
+    for _ in range(20):
+        router.step()
+        if router._flip is None:
+            break
+    assert router.fleet_epoch == 1
+    assert router.epoch_flips_total == 1
+    for m in router.members.values():
+        assert m.weight_epoch() == 1        # nobody serves stale weights
+    assert store.get("fleet/epoch/current")["epoch"] == 1
+    results = router.run([], max_ticks=500)
+    assert len(results) == 1
+    assert np.array_equal(results[0].output_ids, ref[reqs[0].rid])
+    h = router.health()
+    assert h["fleet_epoch"] == 1 and not h["epoch_flip_in_progress"]
+
+
+def test_epoch_flip_member_death_midprepare_does_not_wedge(
+        tiny_engine, reference, tmp_path):
+    """A member that dies while the flip waits on its drain is excluded
+    by the SAME lease scan that fails its work over — the flip commits
+    with the survivors and the re-routed request is served at the new
+    epoch, never the stale one."""
+    clock = [0.0]
+    store, router = _fleet(tiny_engine, tmp_path, n=2,
+                           clock=lambda: clock[0], member_lease=1.0)
+    router.step()
+    reqs, ref = reference
+    req = _copies(reqs[:1])[0]
+    router.submit(req)                      # dispatched to some member
+    victim = router._owner[req.rid]
+    router.begin_epoch_flip(None)
+    # the victim is mid-stream, so its prepare can't land — and then it
+    # dies silently
+    router.members[victim].kill()
+    clock[0] += 1.0 * 3 + 1.0               # lease lapses
+    for _ in range(50):
+        router.step()
+        clock[0] += 0.5
+        if router._flip is None:
+            break
+    assert router._flip is None and router.fleet_epoch == 1
+    survivor = next(eid for eid in router.members if eid != victim)
+    assert router.members[survivor].weight_epoch() == 1
+    results = router.run([], max_ticks=1000)
+    assert [r.rid for r in results] == [req.rid]
+    assert np.array_equal(results[0].output_ids, ref[req.rid])
+    assert results[0].failovers == 1
+
+
+def test_epoch_flip_successor_adopts_orphaned_flip(tiny_engine, tmp_path):
+    """Coordinator death mid-flip: the successor adopts the orphaned flip
+    doc (params=None — members re-stamp their OWN weights) and completes
+    it instead of abandoning half-prepared members."""
+    clock = [0.0]
+    store = _store(tmp_path, clock=lambda: clock[0])
+    members = [FleetMember("engine0",
+                           tiny_engine.supervised_serving(
+                               max_restarts=5, **SERVE_KW),
+                           store, lease_s=100.0)]
+    A = FleetRouter(store, members, router_id="rA", lease_s=2.0,
+                    miss_limit=3)
+    B = FleetRouter(store, members, router_id="rB", lease_s=2.0,
+                    miss_limit=3)
+    A.step()
+    assert A.is_coordinator
+    A.begin_epoch_flip(None, epoch=5)
+    # A dies before a single advance; its flip doc is orphaned on the
+    # store.  B takes the next term and must finish the flip.
+    clock[0] += 2.0 * 3 + 1.0
+    for _ in range(50):
+        B.step()
+        clock[0] += 0.5
+        if B.is_coordinator and B._flip is None:
+            break
+    assert B.is_coordinator and B.term == 2
+    assert B.fleet_epoch == 5
+    assert members[0].weight_epoch() == 5
+    assert store.get("fleet/epoch/flip") is None
+
+
+# ------------------------- pinned fleet_procs chaos seed (ISSUE 16)
+
+@pytest.mark.chaos
+def test_fleet_procs_chaos_soak_deterministic_seed(tmp_path):
+    """Pinned seed of ``tools/chaos_soak.py --mode fleet_procs`` (ISSUE
+    16 acceptance): REAL member-daemon subprocesses over the store, a
+    real SIGKILL landing mid-stream (none lost, token-exact resume across
+    the process boundary, zero duplicate serves, journal GC'd), plus the
+    stalled-leader/compare-delete race (delete fenced, stale append
+    stands down, resurrection tombstoned)."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    os.pardir, os.pardir, "tools"))
+    from chaos_soak import run_fleet_procs_soak
+
+    stats = run_fleet_procs_soak(seed=18, root=str(tmp_path),
+                                 verbose=False)
+    assert stats["terminal"] == 6 == stats["parity_checked"]
+    assert stats["failovers"] >= 1
+    assert stats["resumed_tokens"] > 0      # the kill landed mid-stream
+    assert stats["stalled_final_term"] == 2
+    assert stats["stalled_parity_checked"] == 6

@@ -1020,6 +1020,360 @@ def run_pod_soak(seed: int, total_steps: int = 12, ckpt_every: int = 2,
     return stats
 
 
+def run_fleet_procs_soak(seed: int, root: str, n_requests: int = 6,
+                         n_members: int = 2, verbose: bool = True) -> dict:
+    """Host-scale fleet soak: REAL member-daemon subprocesses, a real
+    SIGKILL, and the stalled-leader/compare-delete race (ISSUE 16;
+    docs/FLEET.md "Member daemons").
+
+    Phase 1 — subprocess kill.  ``n_members`` ``tools/fleet_member.py``
+    daemons are spawned as real OS processes against a shared real-clock
+    file store; the router drives them through
+    :class:`~deepspeed_tpu.inference.fleet_daemon.StoreMemberProxy`
+    handles (assignments/results/control ride store channels — no shared
+    memory, no pipes).  One daemon is SIGKILLed the moment the journal
+    shows it mid-stream (journaled tokens outstanding, stream unfinished):
+    its lease lapses, the router fails the in-flight work over, and the
+    survivor daemon resumes AFTER the last journaled token.  Invariants:
+    every rid reaches exactly ONE terminal result (results published to
+    the durable channel before the kill are claimed, never re-served);
+    completed outputs are token-identical to a fault-free in-process
+    reference (the daemons build the same seeded tiny model, and sampled
+    lanes use counter-based keys, so parity is exact across process
+    boundaries); resumed streams keep their submission ``trace_id``
+    end-to-end; the victim is visibly dead through the store; the journal
+    is empty after collection.
+
+    Phase 2 — stalled leader vs compare-delete.  A separate injected-clock
+    store: router A leads and dispatches until a stream has journaled
+    tokens, then stalls (stops stepping — the in-process stand-in for a
+    GC'd/hung leader process).  B wins the next election term and
+    RE-STAMPS every adopted journal entry with its own owner/term.  The
+    stalled A then wakes and runs its GC path: ``_journal_delete`` is a
+    ``compare_and_delete`` against A's stale mirror, so it MUST lose —
+    the entry B adopted survives, owner intact.  A's stale token-append
+    loses its CAS and stands down.  After B collects and GC's the stream,
+    the delete's tombstone must also block A's resurrection write
+    (``CAS(key, None, stale_doc)`` -> False).  Zero duplicate serves,
+    zero resurrected journal entries.
+    """
+    import signal
+    import subprocess
+
+    import numpy as np
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.elasticity import (FileCoordinationStore, dead_set,
+                                          lease_table)
+    from deepspeed_tpu.inference.fleet import FleetRouter
+    from deepspeed_tpu.inference.fleet_daemon import StoreMemberProxy
+    from deepspeed_tpu.inference.sampling import SamplingParams
+    from deepspeed_tpu.inference.serving import Request
+    from deepspeed_tpu.models import CausalLM
+
+    rng = Random(seed)
+    model = CausalLM("tiny", dtype=jnp.float32, attn_impl="xla")
+    params = model.init_fn(jax.random.PRNGKey(0))
+    engine = deepspeed_tpu.init_inference(
+        model=model, config={"dtype": "float32"}, params=params)
+
+    nprng = np.random.default_rng(seed)
+
+    def lane(i):
+        if i % 3 != 1:
+            return None
+        return SamplingParams(temperature=0.8 if i % 2 else 1.2,
+                              top_k=0 if i % 6 == 1 else 12,
+                              top_p=0.9, seed=900 + i)
+
+    # long streams (16 new tokens) so the SIGKILL window — journaled
+    # tokens outstanding, stream unfinished — stays open across many
+    # real-clock router rounds
+    base = [Request(rid=i,
+                    input_ids=nprng.integers(
+                        1, model.config.vocab_size,
+                        int(nprng.integers(3, 12))).astype(np.int32),
+                    max_new_tokens=16, sampling=lane(i),
+                    trace_id=f"procs-{seed}-{i}")
+            for i in range(n_requests)]
+
+    def copies():
+        return [Request(rid=r.rid, input_ids=r.input_ids,
+                        max_new_tokens=r.max_new_tokens,
+                        sampling=r.sampling, trace_id=r.trace_id)
+                for r in base]
+
+    # fault-free in-process reference: the daemons build the identical
+    # seeded model, and greedy/sampled outputs are engine-independent
+    ref_serve = engine.serving(b_slots=3, page_size=8, max_model_len=64)
+    ref = {r.rid: r.output_ids for r in ref_serve.run(copies())}
+    del ref_serve
+
+    # ---- phase 1: real daemon subprocesses, real SIGKILL -----------------
+    coord_dir = os.path.join(root, "coord")
+    store = FileCoordinationStore(coord_dir)   # REAL clock: leases are wall
+    # 1s lease x3 missed: detection ~3s of wall clock after the SIGKILL,
+    # with enough slack that a straggler compile or scheduler stall on a
+    # LIVE daemon never reads as a death
+    LEASE_S, MISS = 1.0, 3
+    member_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "fleet_member.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs, logs = {}, {}
+    stats = {}
+    try:
+        for i in range(n_members):
+            eid = f"engine{i}"
+            ready = os.path.join(root, f"ready_{eid}")
+            logs[eid] = open(os.path.join(root, f"{eid}.log"), "w")
+            procs[eid] = subprocess.Popen(
+                [sys.executable, member_py, "--engine_id", eid,
+                 "--coord_dir", coord_dir, "--lease_s", str(LEASE_S),
+                 "--idle_sleep_s", "0.002", "--max_restarts", "5",
+                 "--ready_file", ready],
+                env=env, stdout=logs[eid], stderr=subprocess.STDOUT)
+        deadline = time.monotonic() + 180.0
+        for i in range(n_members):
+            ready = os.path.join(root, f"ready_engine{i}")
+            while not os.path.exists(ready):
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"fleet_procs seed={seed}: engine{i} daemon never "
+                        f"came ready (see {root}/engine{i}.log)")
+                if procs[f"engine{i}"].poll() is not None:
+                    raise RuntimeError(
+                        f"fleet_procs seed={seed}: engine{i} daemon died "
+                        f"at startup (see {root}/engine{i}.log)")
+                time.sleep(0.05)
+
+        proxies = [StoreMemberProxy(f"engine{i}", store,
+                                    router_id="router0", lease_s=LEASE_S)
+                   for i in range(n_members)]
+        for p in proxies:
+            p.beat()
+        router = FleetRouter(store, proxies, router_id="router0",
+                             lease_s=30.0, miss_limit=MISS,
+                             journal_every_k=1)
+        victim = f"engine{rng.randrange(n_members)}"
+        state = {"killed": False, "kill_round": None}
+
+        def on_tick(r, rounds):
+            time.sleep(0.005)   # real clock: let the daemons decode
+            if state["killed"]:
+                return
+            mid_stream = any(
+                doc.get("engine") == victim and doc.get("tokens")
+                and len(doc["tokens"]) < r._requests[rid].max_new_tokens
+                for rid, doc in r._journal_docs.items()
+                if rid in r._requests)
+            # fallback: if scheduling starves the victim of a journaled
+            # mid-stream window, kill anyway — failover is still exercised
+            if mid_stream or rounds >= 600:
+                os.kill(procs[victim].pid, signal.SIGKILL)
+                state["killed"] = True
+                state["kill_round"] = rounds
+
+        results = router.run(copies(), max_ticks=60000, on_tick=on_tick)
+        assert state["killed"], \
+            f"fleet_procs seed={seed}: stream finished before any kill"
+
+        by_rid = {}
+        for res in results:
+            assert res.rid not in by_rid, \
+                f"fleet_procs seed={seed}: rid {res.rid} served TWICE"
+            by_rid[res.rid] = res
+        assert sorted(by_rid) == sorted(r.rid for r in base), \
+            f"fleet_procs seed={seed}: lost requests " \
+            f"{sorted(set(r.rid for r in base) - set(by_rid))}"
+        parity_checked = resumed_results = resumed_tokens = 0
+        for rid, res in by_rid.items():
+            assert res.finish_reason in ("eos", "length"), res.finish_reason
+            assert np.array_equal(res.output_ids, ref[rid]), \
+                f"fleet_procs seed={seed}: rid {rid} diverged across the " \
+                f"process boundary after failover"
+            assert res.trace_id == f"procs-{seed}-{rid}", \
+                f"fleet_procs seed={seed}: rid {rid} lost its trace_id " \
+                f"({res.trace_id})"
+            parity_checked += 1
+            if res.resumed_tokens:
+                resumed_results += 1
+                resumed_tokens += res.resumed_tokens
+        assert router.failovers_total >= 1, \
+            f"fleet_procs seed={seed}: SIGKILL never became a failover"
+        # the victim must be visibly dead THROUGH THE STORE (lapsed lease
+        # or dead marker) — the router may not invent deaths
+        assert victim in router._failed_engines, \
+            f"fleet_procs seed={seed}: {victim} never declared dead"
+        lease = lease_table(store, prefix="fleet/heartbeat").get(victim)
+        lapsed = lease is None or lease.missed(store.now()) >= MISS
+        marked = victim in dead_set(store, prefix="fleet/dead")
+        assert lapsed or marked, \
+            f"fleet_procs seed={seed}: {victim} failed over while its " \
+            f"lease was live"
+        leftover = store.list("fleet/requests")
+        assert not leftover, \
+            f"fleet_procs seed={seed}: journal entries leaked: {leftover}"
+        stats = {
+            "seed": seed,
+            "submitted": len(base),
+            "terminal": len(by_rid),
+            "parity_checked": parity_checked,
+            "victim": victim,
+            "kill_round": state["kill_round"],
+            "failovers": router.failovers_total,
+            "resumed_results": resumed_results,
+            "resumed_tokens": resumed_tokens,
+            "channel_dropped": sum(p.channel_dropped_total for p in proxies),
+            "cas_contended": getattr(store, "cas_contended_total", 0),
+        }
+    finally:
+        for eid, proc in procs.items():
+            if proc.poll() is None:
+                proc.terminate()
+        for eid, proc in procs.items():
+            try:
+                proc.wait(timeout=10)
+            except Exception:
+                proc.kill()
+                proc.wait(timeout=10)
+        for f in logs.values():
+            f.close()
+
+    # ---- phase 2: stalled leader vs compare-delete fencing ---------------
+    stats.update(_stalled_leader_scenario(
+        seed, os.path.join(root, "stalled"), engine, model, ref, base))
+    if verbose:
+        print(f"  seed={seed}: OK — SIGKILLed {victim} at round "
+              f"{stats['kill_round']}, {stats['failovers']} failover(s), "
+              f"{stats['resumed_tokens']} resumed token(s), "
+              f"{stats['parity_checked']} parity-checked; stalled-leader "
+              f"fencing held (delete fenced, append stood down, "
+              f"resurrection tombstoned)")
+    return stats
+
+
+def _stalled_leader_scenario(seed: int, coord_dir: str, engine, model,
+                             ref: dict, base: list) -> dict:
+    """Phase 2 of :func:`run_fleet_procs_soak` — see its docstring.  Uses
+    an injected store clock (election timing must be exact) and in-process
+    members shared by two routers, which is the real topology: the members
+    outlive the stalled leader, and the successor resyncs the live streams
+    it adopts from the journal."""
+    import numpy as np
+
+    from deepspeed_tpu.elasticity import FileCoordinationStore
+    from deepspeed_tpu.inference.fleet import (FLEET_REQUESTS_PREFIX,
+                                               FleetMember, FleetRouter,
+                                               _rid_key)
+    from deepspeed_tpu.inference.serving import Request
+
+    clock = [0.0]
+    store = FileCoordinationStore(coord_dir, clock=lambda: clock[0])
+    serve_kw = dict(b_slots=2, page_size=8, max_model_len=64)
+    members = [FleetMember(f"engine{i}",
+                           engine.supervised_serving(max_restarts=5,
+                                                     **serve_kw),
+                           store, lease_s=1.0)
+               for i in range(2)]
+    ROUTER_LEASE, MISS = 5.0, 3
+    A = FleetRouter(store, members, router_id="routerA",
+                    lease_s=ROUTER_LEASE, miss_limit=MISS, journal_every_k=1)
+    B = FleetRouter(store, members, router_id="routerB",
+                    lease_s=ROUTER_LEASE, miss_limit=MISS, journal_every_k=1)
+
+    def copies():
+        return [Request(rid=r.rid, input_ids=r.input_ids,
+                        max_new_tokens=r.max_new_tokens,
+                        sampling=r.sampling, trace_id=r.trace_id)
+                for r in base]
+
+    for r in copies():
+        A.submit(r)
+    # step A until a stream is journaled MID-FLIGHT, then stall it there
+    target = None
+    for _ in range(200):
+        A.step()
+        clock[0] += 0.2
+        for rid, doc in A._journal_docs.items():
+            if doc.get("engine") and doc.get("tokens") \
+                    and rid in A._requests:
+                target = rid
+                break
+        if target is not None:
+            break
+    assert target is not None, \
+        f"stalled-leader seed={seed}: no mid-stream journal entry appeared"
+    key = f"{FLEET_REQUESTS_PREFIX}/{_rid_key(target)}"
+    stale_doc = dict(A._journal_docs[target])   # A's last-written view
+    assert stale_doc.get("owner") == "routerA"
+
+    # A stalls: no more steps.  Advance the clock past its election lease
+    # so B wins term 2 and adopts (+ re-stamps) the journal.
+    clock[0] += ROUTER_LEASE * MISS + 1.0
+    for _ in range(50):
+        B.step()
+        clock[0] += 0.2
+        if B.is_coordinator:
+            break
+    assert B.is_coordinator and B.term == 2, \
+        f"stalled-leader seed={seed}: election never converged ({B.term})"
+    adopted = store.get(key)
+    assert adopted is not None and adopted.get("owner") == "routerB", \
+        f"stalled-leader seed={seed}: takeover did not re-stamp {key}: " \
+        f"{adopted}"
+
+    # the stalled ex-leader wakes mid-GC: its compare-delete carries the
+    # STALE expected doc and must lose — zero resurrected entries
+    A._journal_delete(target)
+    after = store.get(key)
+    assert after is not None and after.get("owner") == "routerB", \
+        f"stalled-leader seed={seed}: deposed leader deleted the " \
+        f"successor's journal entry ({after})"
+    # ... and its stale token-append must lose its CAS and stand down
+    A._flush_token_journal()
+    assert target not in A._journal_docs, \
+        f"stalled-leader seed={seed}: deposed leader kept fighting for " \
+        f"{target} after losing the append CAS"
+    assert store.get(key).get("owner") == "routerB"
+
+    # B converges the stream; every rid terminal EXACTLY once across both
+    # routers' claims (A may hold results it collected before stalling)
+    results = list(A.take_results())
+    results += B.run([], max_ticks=4000,
+                     on_tick=lambda r, n: clock.__setitem__(0, clock[0] + 1.0))
+    by_rid = {}
+    for res in results:
+        assert res.rid not in by_rid, \
+            f"stalled-leader seed={seed}: rid {res.rid} served TWICE"
+        by_rid[res.rid] = res
+    assert sorted(by_rid) == sorted(r.rid for r in base), \
+        f"stalled-leader seed={seed}: lost " \
+        f"{sorted(set(r.rid for r in base) - set(by_rid))}"
+    for rid, res in by_rid.items():
+        assert res.finish_reason in ("eos", "length"), res.finish_reason
+        assert np.array_equal(res.output_ids, ref[rid]), \
+            f"stalled-leader seed={seed}: rid {rid} diverged"
+    leftover = store.list(FLEET_REQUESTS_PREFIX)
+    assert not leftover, \
+        f"stalled-leader seed={seed}: journal leaked: {leftover}"
+    # B's GC left a tombstone on the key: the deposed leader's stale
+    # append-as-create must NOT resurrect the finished request
+    assert not store.compare_and_swap(key, None, stale_doc), \
+        f"stalled-leader seed={seed}: tombstone failed to block the " \
+        f"deposed leader's resurrection write"
+    assert store.get(key) is None
+    return {
+        "stalled_target": target,
+        "stalled_final_term": B.term,
+        "stalled_parity_checked": len(by_rid),
+    }
+
+
 def run_hybrid_soak(seed: int, rounds: int = 3, steps_per_round: int = 2,
                     n_prompts: int = 5, max_new: int = 6,
                     verbose: bool = True) -> dict:
@@ -1237,16 +1591,21 @@ def main(argv=None) -> int:
         description="randomized fault-injection soak for the resilience "
                     "subsystem")
     ap.add_argument("--mode",
-                    choices=("train", "serve", "pod", "fleet", "hybrid"),
+                    choices=("train", "serve", "pod", "fleet",
+                             "fleet_procs", "hybrid"),
                     default="train",
                     help="train: supervised elastic rounds; serve: "
                          "ServingSupervisor kill/replay soak; pod: "
                          "simulated multi-host kill + shrink-to-healthy "
                          "re-formation; fleet: serving-fleet engine + "
                          "coordinator kills with store-lease failover; "
-                         "hybrid: train+rollout rounds with mid-train-step "
-                         "AND mid-rollout kills (loss continuity + rollout "
-                         "replay parity + pool invariant, docs/HYBRID.md)")
+                         "fleet_procs: REAL member-daemon subprocesses "
+                         "with a mid-stream SIGKILL plus the stalled-"
+                         "leader/compare-delete race (ISSUE 16, "
+                         "docs/FLEET.md); hybrid: train+rollout rounds "
+                         "with mid-train-step AND mid-rollout kills (loss "
+                         "continuity + rollout replay parity + pool "
+                         "invariant, docs/HYBRID.md)")
     ap.add_argument("--soaks", type=int, default=3,
                     help="number of supervised sessions to soak")
     ap.add_argument("--total-steps", type=int, default=8)
@@ -1267,6 +1626,12 @@ def main(argv=None) -> int:
                          "(small = pool pressure)")
     ap.add_argument("--hosts", type=int, default=4,
                     help="pod mode: simulated hosts per soak")
+    ap.add_argument("--members", type=int, default=2,
+                    help="fleet_procs mode: member daemon subprocesses "
+                         "per soak")
+    ap.add_argument("--json", default=None, metavar="OUT.json",
+                    help="write the per-seed stats dicts (plus a pass/"
+                         "fail tally) as a JSON artifact")
     ap.add_argument("--rounds", type=int, default=3,
                     help="hybrid mode: train+rollout rounds per soak")
     ap.add_argument("--steps-per-round", type=int, default=2,
@@ -1301,8 +1666,25 @@ def main(argv=None) -> int:
         configure_tracer(enabled=True, capacity=1 << 17)
 
     failures = 0
+    all_stats = []
     for i in range(args.soaks):
         seed = args.seed + i
+        if args.mode == "fleet_procs":
+            root = tempfile.mkdtemp(prefix=f"chaos_fleetprocs_{seed}_")
+            print(f"fleet_procs soak {i + 1}/{args.soaks} (seed={seed}, "
+                  f"members={args.members}) -> {root}")
+            try:
+                all_stats.append(run_fleet_procs_soak(
+                    seed, root, n_requests=args.requests
+                    if args.requests != 8 else 6,
+                    n_members=args.members))
+            except Exception as e:
+                failures += 1
+                print(f"  FAILED ({type(e).__name__}): {e}", file=sys.stderr)
+            finally:
+                if not args.keep_dirs:
+                    shutil.rmtree(root, ignore_errors=True)
+            continue
         if args.mode == "serve":
             print(f"serve soak {i + 1}/{args.soaks} (seed={seed}"
                   + (f", tp={args.tp}" if args.tp > 1 else "")
@@ -1336,9 +1718,10 @@ def main(argv=None) -> int:
             root = tempfile.mkdtemp(prefix=f"chaos_fleet_{seed}_")
             print(f"fleet soak {i + 1}/{args.soaks} (seed={seed}) -> {root}")
             try:
-                run_fleet_soak(seed, coord_dir=os.path.join(root, "coord"),
-                               n_requests=args.requests,
-                               collect_traces=args.collect_traces)
+                all_stats.append(run_fleet_soak(
+                    seed, coord_dir=os.path.join(root, "coord"),
+                    n_requests=args.requests,
+                    collect_traces=args.collect_traces))
             except Exception as e:
                 failures += 1
                 print(f"  FAILED ({type(e).__name__}): {e}", file=sys.stderr)
@@ -1381,6 +1764,16 @@ def main(argv=None) -> int:
             "tool": "chaos_soak", "mode": args.mode, "seed": args.seed,
             "soaks": args.soaks})
         print(f"trace artifact -> {args.trace}")
+    if args.json:
+        import json
+
+        os.makedirs(os.path.dirname(os.path.abspath(args.json)),
+                    exist_ok=True)
+        with open(args.json, "w") as f:
+            json.dump({"mode": args.mode, "soaks": args.soaks,
+                       "failures": failures, "base_seed": args.seed,
+                       "stats": all_stats}, f, indent=2, default=str)
+        print(f"stats artifact -> {args.json}")
     print(f"chaos soak ({args.mode}): "
           f"{args.soaks - failures}/{args.soaks} converged")
     return 1 if failures else 0

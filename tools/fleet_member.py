@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Fleet member daemon entry point (docs/FLEET.md "Member daemons").
+
+Runs ONE :class:`~deepspeed_tpu.inference.fleet.FleetMember` in this OS
+process, coupled to its router by nothing but the coordination store: it
+drains assignment/control channels, pumps its engine, publishes results,
+progress and its lease, and exits on a ``shutdown`` verb (or engine
+death).  SIGKILLing this process is a first-class fleet event — the lease
+lapses, the router fails the in-flight work over from the journal, and
+results published before the kill stay durably claimable.
+
+Launched by ``deepspeed_tpu.launcher --fleet_daemon`` (which exports the
+``DS_TPU_FLEET_*`` contract this script reads as flag defaults), by the
+fleet_procs chaos soak (which SIGKILLs it mid-stream on purpose), or by
+hand::
+
+    python tools/fleet_member.py --engine_id engine0 \\
+        --coord_dir /mnt/shared/fleet
+
+The model here is the deterministic tiny CausalLM the soaks and benches
+serve — a production deployment wires its own model/params the same way
+(build the supervisor, hand it to FleetMemberDaemon).
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _env(name, default=None):
+    v = os.environ.get(name)
+    return v if v not in (None, "") else default
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--engine_id",
+                   default=_env("DS_TPU_FLEET_ENGINE_ID"),
+                   help="this member's engine id (fleet-unique); env "
+                        "fallback DS_TPU_FLEET_ENGINE_ID")
+    p.add_argument("--coord_dir",
+                   default=_env("DS_TPU_FLEET_COORD_DIR"),
+                   help="coordination store root shared with the router; "
+                        "env fallback DS_TPU_FLEET_COORD_DIR")
+    p.add_argument("--lease_s", type=float,
+                   default=float(_env("DS_TPU_FLEET_LEASE", 5.0)),
+                   help="member lease period (env DS_TPU_FLEET_LEASE)")
+    p.add_argument("--b_slots", type=int, default=2)
+    p.add_argument("--page_size", type=int, default=8)
+    p.add_argument("--max_model_len", type=int, default=64)
+    p.add_argument("--max_restarts", type=int, default=5,
+                   help="warm-restart budget before the member writes its "
+                        "dead marker and exits")
+    p.add_argument("--max_ticks", type=int, default=None,
+                   help="optional daemon round budget (soaks bound runs)")
+    p.add_argument("--idle_sleep_s", type=float, default=0.01,
+                   help="sleep between idle rounds (0 = spin; soaks use "
+                        "small values to keep wall time down)")
+    p.add_argument("--ready_file", default=None,
+                   help="touch this path once the daemon is serving "
+                        "(launcher/soak startup handshake)")
+    args = p.parse_args(argv)
+    if not args.engine_id:
+        p.error("--engine_id (or DS_TPU_FLEET_ENGINE_ID) is required")
+    if not args.coord_dir:
+        p.error("--coord_dir (or DS_TPU_FLEET_COORD_DIR) is required")
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.elasticity import FileCoordinationStore
+    from deepspeed_tpu.inference.fleet import FleetMember
+    from deepspeed_tpu.inference.fleet_daemon import FleetMemberDaemon
+    from deepspeed_tpu.models import CausalLM
+
+    model = CausalLM("tiny", dtype=jnp.float32, attn_impl="xla")
+    params = model.init_fn(jax.random.PRNGKey(0))
+    engine = deepspeed_tpu.init_inference(
+        model=model, config={"dtype": "float32"}, params=params)
+    sup = engine.supervised_serving(
+        max_restarts=args.max_restarts, b_slots=args.b_slots,
+        page_size=args.page_size, max_model_len=args.max_model_len)
+    # warm the compiled programs (prefill/decode/sampled lane) BEFORE the
+    # first lease beat: the first real assignment otherwise stalls the
+    # daemon loop for the compile and a sub-second lease lapses — the
+    # router would fail over a perfectly healthy member
+    import numpy as np
+
+    from deepspeed_tpu.inference.sampling import SamplingParams
+    from deepspeed_tpu.inference.serving import Request
+
+    sup.engine.run([
+        Request(rid="__warm_g__", input_ids=np.arange(1, 7, dtype=np.int32),
+                max_new_tokens=2),
+        Request(rid="__warm_s__", input_ids=np.arange(1, 7, dtype=np.int32),
+                max_new_tokens=2,
+                sampling=SamplingParams(temperature=1.0, top_k=8,
+                                        top_p=0.9, seed=0)),
+    ])
+    store = FileCoordinationStore(args.coord_dir)
+    member = FleetMember(args.engine_id, sup, store, lease_s=args.lease_s)
+    member.beat(force=True)   # advertise immediately: the router may be up
+    daemon = FleetMemberDaemon(member, store,
+                               idle_sleep_s=args.idle_sleep_s)
+    if args.ready_file:
+        with open(args.ready_file, "w") as f:
+            f.write(args.engine_id)
+    rounds = daemon.run(max_ticks=args.max_ticks)
+    print(f"fleet_member[{args.engine_id}]: exit after {rounds} round(s), "
+          f"alive={member.alive}")
+    return 0 if member.alive or daemon.shutdown else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

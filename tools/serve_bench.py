@@ -497,7 +497,8 @@ def run_fleet_bench(model_name: str = "llama-374m", n_engines: int = 3,
                     kill_engine: bool = False,
                     journal_every_k: int = 4,
                     journal_flush_ms: float = None,
-                    collect_traces: str = None) -> dict:
+                    collect_traces: str = None,
+                    n_routers: int = 1) -> dict:
     """Fleet-tier serving benchmark (ISSUE 7/8): the seeded mixed stream
     through ``n_engines`` leased engines behind a :class:`FleetRouter` on a
     file-backed coordination store.  Reports fleet throughput, PER-ENGINE
@@ -557,7 +558,10 @@ def run_fleet_bench(model_name: str = "llama-374m", n_engines: int = 3,
                    for i in range(n_engines)]
         router = FleetRouter(store, members,
                              journal_every_k=journal_every_k,
-                             journal_flush_ms=journal_flush_ms)
+                             journal_flush_ms=journal_flush_ms,
+                             admission_partitions=(n_routers
+                                                   if n_routers > 1
+                                                   else None))
         router.run(copies(), max_ticks=100000)       # warm all members
         warm_cas = len(router.journal_cas_latencies())
         warm_flushes = router.journal_flushes_total
@@ -590,11 +594,28 @@ def run_fleet_bench(model_name: str = "llama-374m", n_engines: int = 3,
         results = router.run(copies(), max_ticks=100000, on_tick=on_tick)
         fleet_dt = time.perf_counter() - t0
         h = router.health()     # snapshot while the store still exists
+        # snapshot BEFORE the extra passes below (sharded admission /
+        # trace collection) pump more tokens through the same members
+        measured_tokens = dict(router.tokens_by_engine)
         resumed_total = router.resumed_tokens_total - warm_resumed
         # per-flush CAS wall latency on THIS store (measured pass only):
         # the number journal_every_k / journal_flush_ms are tuned against
         cas_lat = sorted(router.journal_cas_latencies()[warm_cas:]) or [0.0]
         measured_flushes = router.journal_flushes_total - warm_flushes
+        # sharded admission (ISSUE 16, docs/FLEET.md "Sharded admission"):
+        # N routers under the ONE election, followers CAS-claiming
+        # rid-hash partitions and journal-creating accepted requests via
+        # admit() while the coordinator adopts and serves them.  The
+        # timed comparison is the SAME admit() path run single-threaded
+        # on one router vs sharded across N admitting threads — the
+        # scale-out claim is about the admission path (validation + the
+        # journal-create write), while membership/failover/GC stay with
+        # the coordinator.
+        sharded = None
+        if n_routers > 1:
+            sharded = _run_sharded_admission(
+                store, members, router, stream, ref, n_routers,
+                journal_every_k, journal_flush_ms)
         # distributed-tracing collection (ISSUE 15 satellite): one EXTRA
         # traced pass AFTER the measured one (the reported numbers above
         # stay untraced — the --trace discipline), members publishing
@@ -622,7 +643,7 @@ def run_fleet_bench(model_name: str = "llama-374m", n_engines: int = 3,
     ttft = [r.ttft_s for r in results]
     lat = [r.latency_s for r in results]
     per_engine = {eid: round((tok - warm_tokens.get(eid, 0)) / fleet_dt, 1)
-                  for eid, tok in router.tokens_by_engine.items()}
+                  for eid, tok in measured_tokens.items()}
     return {
         "metric": "serve-fleet",
         "value": round(total_tokens / fleet_dt, 1),
@@ -676,7 +697,123 @@ def run_fleet_bench(model_name: str = "llama-374m", n_engines: int = 3,
             # traced extra pass + assembled fleet trace (--collect_traces;
             # None when not requested)
             "collect_traces": fleet_trace,
+            # sharded-admission extra pass (--n_routers > 1; None when
+            # not requested): single vs N-router admit() throughput and
+            # per-partition balance
+            "sharded_admission": sharded,
         },
+    }
+
+
+def _run_sharded_admission(store, members, router, stream, ref,
+                           n_routers: int, journal_every_k,
+                           journal_flush_ms) -> dict:
+    """The --n_routers extra pass of :func:`run_fleet_bench`: stand up
+    ``n_routers - 1`` follower routers against the live store, converge
+    the partition claim table, then admit one re-rid'd copy of the stream
+    SEQUENTIALLY through one router and another SHARDED across all N
+    (each router admitting only the partitions it owns, concurrently) —
+    the coordinator adopts and serves both sets, and the report carries
+    admissions/sec for each path plus the per-partition balance."""
+    import threading
+
+    import numpy as np
+
+    from deepspeed_tpu.inference.fleet import FleetRouter, partition_of
+    from deepspeed_tpu.inference.serving import Request
+
+    followers = [FleetRouter(store, members, router_id=f"router{i}",
+                             journal_every_k=journal_every_k,
+                             journal_flush_ms=journal_flush_ms,
+                             admission_partitions=n_routers)
+                 for i in range(1, n_routers)]
+    all_routers = [router] + followers
+
+    def step_all():
+        for r in all_routers:
+            r.step()
+
+    # converge the claim table: every partition owned by exactly one
+    # router (claims are store-CAS'd, one per router step)
+    for _ in range(20 * n_routers):
+        step_all()
+        owned = [p for r in all_routers for p in r._my_partitions]
+        if sorted(owned) == list(range(n_routers)):
+            break
+    assert sorted(owned) == list(range(n_routers)), \
+        f"partition claims never converged: {owned}"
+
+    def re_rid(offset):
+        return [Request(rid=r.rid + offset, input_ids=r.input_ids,
+                        max_new_tokens=r.max_new_tokens,
+                        sampling=r.sampling) for r in stream]
+
+    # single-path baseline: the same admit() journal-create, one thread —
+    # the coordinator necessarily owns SOME partitions, so route each rid
+    # to its owner but run the loop sequentially
+    single_set = re_rid(100000)
+    by_owner_single = {r.router_id: [] for r in all_routers}
+    for req in single_set:
+        part = partition_of(req.rid, n_routers)
+        owner = next(r for r in all_routers if part in r._my_partitions)
+        by_owner_single[owner.router_id].append((owner, req))
+    t0 = time.perf_counter()
+    for batch in by_owner_single.values():
+        for owner, req in batch:
+            owner.admit(req)
+    t_single = time.perf_counter() - t0
+
+    # sharded: the identical work fanned out — one admitting thread per
+    # router, each covering only the partitions it owns
+    sharded_set = re_rid(200000)
+    by_owner = {r.router_id: (r, []) for r in all_routers}
+    for req in sharded_set:
+        part = partition_of(req.rid, n_routers)
+        owner = next(r for r in all_routers if part in r._my_partitions)
+        by_owner[owner.router_id][1].append(req)
+    threads = [threading.Thread(
+        target=lambda r=r, reqs=reqs: [r.admit(q) for q in reqs])
+        for r, reqs in by_owner.values()]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    t_sharded = time.perf_counter() - t0
+
+    # the coordinator adopts both sets from the journal and serves them;
+    # followers keep stepping (router beats) so their claims stay live
+    results = router.run(
+        [], max_ticks=100000,
+        on_tick=lambda r, n: [f.step() for f in followers])
+    by_rid = {r.rid: r for r in results}
+    want = sorted(r.rid for r in single_set + sharded_set)
+    none_lost = sorted(by_rid) == want
+    parity = all(
+        np.array_equal(res.output_ids, ref[rid % 100000])
+        for rid, res in by_rid.items()
+        if res.finish_reason in ("eos", "length"))
+    n = len(stream)
+    balance = {}
+    for req in sharded_set:
+        p = partition_of(req.rid, n_routers)
+        balance[p] = balance.get(p, 0) + 1
+    return {
+        "n_routers": n_routers,
+        "single_admit_per_sec": round(n / t_single, 1),
+        "sharded_admit_per_sec": round(n / t_sharded, 1),
+        "sharded_vs_single": round(t_single / t_sharded, 3),
+        "admissions_by_router": {
+            r.router_id: r.partition_admissions_total
+            for r in all_routers},
+        "admissions_by_partition": {
+            str(p): balance.get(p, 0) for p in range(n_routers)},
+        "adopted_by_coordinator": router.adopted_admissions_total,
+        "none_lost": none_lost,
+        "parity_with_single_engine": parity,
+        # same cooperative-harness caveat as the fleet numbers: threads
+        # over one file store measure the admission PATH, not N hosts
+        "harness": "threads-in-process",
     }
 
 
@@ -1194,6 +1331,11 @@ def main(argv=None) -> int:
                          "count, per-engine throughput, fleet TTFT")
     ap.add_argument("--n_engines", type=int, default=3,
                     help="fleet mode: engines behind the router")
+    ap.add_argument("--n_routers", type=int, default=1,
+                    help="fleet mode: total routers under the one "
+                         "election (ISSUE 16 sharded admission) — an "
+                         "extra pass reports single vs sharded admit() "
+                         "throughput and per-partition balance")
     ap.add_argument("--kill_engine", action="store_true",
                     help="fleet mode: kill engine0 a few rounds into the "
                          "measured pass so failover cost lands in the "
@@ -1325,7 +1467,8 @@ def main(argv=None) -> int:
             max_model_len=args.max_model_len, kill_engine=args.kill_engine,
             journal_every_k=args.journal_every_k or None,
             journal_flush_ms=args.journal_flush_ms,
-            collect_traces=args.collect_traces)
+            collect_traces=args.collect_traces,
+            n_routers=args.n_routers)
         line = json.dumps(result)
         print(line)
         if args.out:
@@ -1334,6 +1477,10 @@ def main(argv=None) -> int:
         d = result["detail"]
         ok = (d["parity_with_single_engine"] and d["none_lost"]
               and (d["failovers_total"] > 0) == d["killed_engine"])
+        if args.n_routers > 1:
+            sh = d["sharded_admission"]
+            ok = ok and sh is not None and sh["none_lost"] \
+                and sh["parity_with_single_engine"]
         if args.collect_traces:
             ct = d["collect_traces"]
             ok = ok and ct is not None and ct["spans_assembled"] > 0 \
