@@ -174,7 +174,11 @@ class InferenceEngine:
         are identical to :meth:`generate`.  On a quantized engine the
         shimmed ``apply_paged`` dequantizes at program entry, so serving
         reads the same int8/int4 weights as quantized ``generate()`` and
-        stays token-identical to it.  See docs/SERVING.md."""
+        stays token-identical to it.  The ``dtype`` pin below governs only
+        the pool's COMPUTE dtype; pass ``kv_dtype="int8"`` to additionally
+        narrow the pool's at-rest storage (docs/SERVING.md "Quantized KV
+        pages") — weight quantization and KV quantization are independent
+        knobs that compose in one engine.  See docs/SERVING.md."""
         if self._model is None or not hasattr(self._model, "apply_paged"):
             raise ValueError(
                 "serving() needs a model with the paged decode contract "
@@ -183,10 +187,13 @@ class InferenceEngine:
 
         kwargs.setdefault("mesh", self.mesh)
         if self._quant and kwargs.get("dtype") is None:
-            # the serving KV pool is compute-dtype regardless of weight
-            # quantization; pin it explicitly (also over an explicit
-            # dtype=None) so the pool never allocates pages in the
-            # weights' storage dtype
+            # the serving KV pool's COMPUTE dtype stays the compute dtype
+            # regardless of weight quantization; pin it explicitly (also
+            # over an explicit dtype=None) so the pool never allocates
+            # pages in the weights' storage dtype.  An explicit
+            # kv_dtype="int8" kwarg still narrows the at-rest storage on
+            # top of this pin — the scale rows dequantize back into the
+            # pinned compute dtype inside the gather
             kwargs["dtype"] = self._config.compute_jnp_dtype
         return ServingEngine(self._model, self.params, **kwargs)
 

@@ -49,10 +49,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..models.transformer import cow_copy_page
+from ..models.transformer import (PAGED_POOL_KEYS, cow_copy_pool,
+                                  paged_pool_cache, paged_pool_tuple)
 from ..observability.program_stats import (ProgramCatalog, account,
                                            finish_sample)
-from .kv_tiering import extract_page, inject_page
+from .kv_tiering import extract_pool_page, inject_pool_page
 from .sampling import position_keys, sample_tokens
 
 __all__ = ["MeshExecutor", "place_params", "pool_jit", "pool_bytes"]
@@ -61,7 +62,10 @@ __all__ = ["MeshExecutor", "place_params", "pool_jit", "pool_bytes"]
 # on argument avals INCLUDING shardings, so every engine with the same pool
 # shape/dtype/placement — notably a warm-restart replacement — shares ONE
 # compile per process, and meshed/unmeshed pools each get their own
-# specialization of the same jit)
+# specialization of the same jit).  The programs are generic over the
+# canonical pool TUPLE — a jit retraces per input pytree structure, so the
+# same cached jit serves full-precision (k, v) and quantized
+# (k, v, k_scale, v_scale) pools with one compile each.
 _COW_PROGS: Dict[bool, Any] = {}
 
 # process-global KV-tiering programs (docs/SERVING.md "KV-page tiering"),
@@ -72,18 +76,23 @@ _TIER_EXTRACT_PROG: Any = None
 _TIER_INJECT_PROGS: Dict[bool, Any] = {}
 
 
-def pool_jit(fn, donate, mesh, kv_spec: P, n_leading: int):
-    """jit a pool-consuming program.  On a mesh, pin the outputs:
-    ``n_leading`` replicated leading outputs (tokens/counts) followed by
-    the k/v pools on their canonical sharding — without ``out_shardings``
-    GSPMD is free to pick a different pool placement per program and the
-    donated buffers would reshard every tick."""
+def pool_jit(fn, donate, mesh, pool_specs, n_leading: int):
+    """jit a pool-consuming program.  ``fn`` takes and returns the pool as
+    ONE canonical tuple argument/output (so ``donate_argnums`` donates
+    every pool leaf at once — payload AND scale planes on a quantized
+    pool).  On a mesh, pin the outputs: ``n_leading`` replicated leading
+    outputs (tokens/counts) followed by the pool tuple on its canonical
+    shardings (``pool_specs``: one PartitionSpec per pool array) — without
+    ``out_shardings`` GSPMD is free to pick a different pool placement per
+    program and the donated buffers would reshard every tick."""
     if mesh is None:
         return jax.jit(fn, donate_argnums=donate)
     rep = NamedSharding(mesh, P())
-    kv = NamedSharding(mesh, kv_spec)
+    pools = tuple(NamedSharding(mesh, s) for s in pool_specs)
+    if n_leading == 0:   # the program returns the bare pool tuple
+        return jax.jit(fn, donate_argnums=donate, out_shardings=pools)
     return jax.jit(fn, donate_argnums=donate,
-                   out_shardings=tuple([rep] * n_leading) + (kv, kv))
+                   out_shardings=tuple([rep] * n_leading) + (pools,))
 
 
 def place_params(params, mesh):
@@ -109,14 +118,19 @@ def place_params(params, mesh):
     return jax.device_put(params, shardings)
 
 
-def pool_bytes(kpool, vpool) -> Dict[str, int]:
-    """Total and per-device bytes of a (possibly sharded) k/v pool pair.
-    ``per_device`` is the MAX across devices (capacity planning reads the
-    worst shard); on a tp-sharded pool it is ~``total / tp``."""
-    total = int(kpool.nbytes) + int(vpool.nbytes)
+def pool_bytes(*pools) -> Dict[str, int]:
+    """Total and per-device bytes of a (possibly sharded) pool tuple —
+    EVERY pool array counts, so a quantized pool's scale planes are priced
+    into ``kv_pool_bytes_*`` (the 2× capacity claim is only honest with
+    the scales in the denominator).  ``per_device`` is the MAX across
+    devices (capacity planning reads the worst shard); on a tp-sharded
+    full-precision pool it is ~``total / tp`` (a quantized pool's
+    replicated scale planes sit on every device, so the equality is
+    deliberately NOT asserted there)."""
+    total = sum(int(a.nbytes) for a in pools)
     per: Dict[Any, int] = {}
     try:
-        for arr in (kpool, vpool):
+        for arr in pools:
             for s in arr.addressable_shards:
                 per[s.device] = per.get(s.device, 0) + int(s.data.nbytes)
     except Exception:   # duck-typed arrays without shard metadata
@@ -137,7 +151,7 @@ class MeshExecutor:
     """
 
     def __init__(self, model, params, num_pages: int, page_size: int,
-                 b_slots: int, dtype=None, mesh=None,
+                 b_slots: int, dtype=None, kv_dtype=None, mesh=None,
                  prefix_cache: bool = True, host_tier: bool = False,
                  catalog: Optional[ProgramCatalog] = None):
         self.model = model
@@ -185,24 +199,36 @@ class MeshExecutor:
             if leaves and all(hasattr(x, "sharding") for x in leaves)
             else None)
         cache = model.init_paged_cache(self.num_pages, self.page_size,
-                                       dtype=dtype)
-        self._kv_spec = model.paged_cache_specs()["k"]
+                                       dtype=dtype, kv_dtype=kv_dtype)
+        specs = model.paged_cache_specs(kv_dtype=kv_dtype)
+        # canonical pool tuple (models.transformer.PAGED_POOL_KEYS order):
+        # (k, v) full precision, (k, v, k_scale, v_scale) quantized — every
+        # program, COW/tier mover and byte gauge runs off this one tuple,
+        # so the int8 layout is the SAME code path, not a parallel one
+        self.kv_dtype = kv_dtype if kv_dtype is None else str(kv_dtype)
+        self.quantized = "k_scale" in cache
+        self._pool_keys = tuple(k for k in PAGED_POOL_KEYS if k in cache)
+        self._pool_specs = tuple(specs[k] for k in self._pool_keys)
+        self._kv_spec = specs["k"]
         # commit the fresh pool to its placement: a jit caches on the arg's
         # committed-ness, so an UNcommitted initial pool would cost each
         # program one extra compile when the second call arrives holding
         # committed program outputs.  On a mesh the pool must live on the
-        # same device set as the (sharded) params — KV heads over 'model'.
+        # same device set as the (sharded) params — KV heads over 'model'
+        # (scale planes carry no head dim and ride replicated).
         if mesh is not None:
-            sh = NamedSharding(mesh, self._kv_spec)
-            self.kpool = jax.device_put(cache["k"], sh)
-            self.vpool = jax.device_put(cache["v"], sh)
+            self.pools = tuple(
+                jax.device_put(cache[k], NamedSharding(mesh, specs[k]))
+                for k in self._pool_keys)
         else:
-            self.kpool = jax.device_put(cache["k"], cache["k"].sharding)
-            self.vpool = jax.device_put(cache["v"], cache["v"].sharding)
+            self.pools = tuple(
+                jax.device_put(cache[k], cache[k].sharding)
+                for k in self._pool_keys)
         # donation: each tick consumes and reproduces the pool — donate the
         # buffers so the pool exists once in HBM, not twice (CPU has no
-        # donation support and would warn every compile)
-        self._donate = (1, 2) if jax.default_backend() != "cpu" else ()
+        # donation support and would warn every compile).  The pool tuple
+        # is ONE jit argument, so (1,) donates every leaf.
+        self._donate = (1,) if jax.default_backend() != "cpu" else ()
         self._decode_prog = self._build_decode()
         self._prefill_progs: Dict[int, Any] = {}
         self._cow_prog = self._build_cow() if prefix_cache else None
@@ -226,23 +252,41 @@ class MeshExecutor:
             self._extract_prog, self._inject_prog = self._build_tier()
             # prewarm through the entry points (trash-page round trip):
             # compiles land at init AND the catalog registers both movers
-            hk, hv = self.extract(0)
-            self.inject(hk, hv, 0)
+            self.inject(self.extract(0), 0)
         # constant for the engine's lifetime (the pool never reallocates):
         # health()/gauges read these per tick, so compute them once
-        self.pool_bytes = pool_bytes(self.kpool, self.vpool)
+        self.pool_bytes = pool_bytes(*self.pools)
         # device copy of the lane vectors, rebuilt only when a lane
         # changes (admission / retirement) — unlike lengths/last_tok the
         # lanes are constant across a request's whole decode, so the
         # per-tick call must not pay 4 host->device transfers for them
         self._lanes_device = None
 
+    # k/v pool views: the canonical state is the `pools` tuple (programs
+    # consume/produce it whole so donation covers every leaf); kpool/vpool
+    # stay as named accessors because tests and health checks read them
+    @property
+    def kpool(self):
+        return self.pools[0]
+
+    @kpool.setter
+    def kpool(self, value):
+        self.pools = (value,) + self.pools[1:]
+
+    @property
+    def vpool(self):
+        return self.pools[1]
+
+    @vpool.setter
+    def vpool(self, value):
+        self.pools = self.pools[:1] + (value,) + self.pools[2:]
+
     # ------------------------------------------------------------ programs
 
     def _build_decode(self):
         apply_paged = self.model.apply_paged
 
-        def prog(params, kpool, vpool, page_table, lengths, last_tok, active,
+        def prog(params, pools, page_table, lengths, last_tok, active,
                  temp, top_k, top_p, seeds):
             # write each slot's last token at position `lengths`, read the
             # next-token logits; inactive slots write to the trash page.
@@ -251,19 +295,19 @@ class MeshExecutor:
             # generate(sampling=...) and a replay/failover re-prefill
             # derive, which is what keeps sampled streams engine-
             # independent and resume-exact (docs/SERVING.md "Sampling").
-            cache = {"k": kpool, "v": vpool}
+            cache = paged_pool_cache(pools)
             logits, cache = apply_paged(params, last_tok[:, None], cache,
                                         page_table, lengths, active[:, None])
             nxt = sample_tokens(logits[:, -1, :], temp, top_k, top_p,
                                 lambda: position_keys(seeds, lengths + 1))
-            return nxt, cache["k"], cache["v"]
+            return nxt, paged_pool_tuple(cache)
 
-        return pool_jit(prog, self._donate, self.mesh, self._kv_spec, 1)
+        return pool_jit(prog, self._donate, self.mesh, self._pool_specs, 1)
 
     def _build_prefill(self, s_pad: int):
         apply_paged = self.model.apply_paged
 
-        def prog(params, kpool, vpool, pt_row, tokens, n_real, start,
+        def prog(params, pools, pt_row, tokens, n_real, start,
                  temp, top_k, top_p, seed):
             # tokens [1, s_pad] right-padded; only the first n_real K/V are
             # written (pads go to the trash page); the first generated token
@@ -276,7 +320,7 @@ class MeshExecutor:
             # attend to the shared pages through the ordinary causal mask).
             # A traced scalar: every start shares ONE program per bucket.
             seq_mask = (jnp.arange(s_pad, dtype=jnp.int32) < n_real)[None, :]
-            cache = {"k": kpool, "v": vpool}
+            cache = paged_pool_cache(pools)
             logits, cache = apply_paged(params, tokens, cache, pt_row,
                                         start[None], seq_mask)
             lg = logits[0, n_real - 1, :][None]        # [1, V]
@@ -286,9 +330,9 @@ class MeshExecutor:
             nxt = sample_tokens(
                 lg, temp, top_k, top_p,
                 lambda: position_keys(seed, (start + n_real)[None]))[0]
-            return nxt, cache["k"], cache["v"]
+            return nxt, paged_pool_tuple(cache)
 
-        return pool_jit(prog, self._donate, self.mesh, self._kv_spec, 1)
+        return pool_jit(prog, self._donate, self.mesh, self._pool_specs, 1)
 
     def _build_cow(self):
         # process-global jit (see _COW_PROGS): a replacement engine's init
@@ -301,7 +345,7 @@ class MeshExecutor:
         prog = _COW_PROGS.get(donate)
         if prog is None:
             prog = _COW_PROGS[donate] = jax.jit(
-                cow_copy_page, donate_argnums=(0, 1) if donate else ())
+                cow_copy_pool, donate_argnums=(0,) if donate else ())
         return prog
 
     def _build_tier(self):
@@ -312,25 +356,27 @@ class MeshExecutor:
         # like COW.
         global _TIER_EXTRACT_PROG
         if _TIER_EXTRACT_PROG is None:
-            _TIER_EXTRACT_PROG = jax.jit(extract_page)
+            _TIER_EXTRACT_PROG = jax.jit(extract_pool_page)
         donate = jax.default_backend() != "cpu"
         inj = _TIER_INJECT_PROGS.get(donate)
         if inj is None:
             inj = _TIER_INJECT_PROGS[donate] = jax.jit(
-                inject_page, donate_argnums=(0, 1) if donate else ())
+                inject_pool_page, donate_argnums=(0,) if donate else ())
         return _TIER_EXTRACT_PROG, inj
 
-    def _place_host_page(self, hk, hv):
-        """Commit one host page slab pair to the pool's placement: on a
-        mesh the ``[L, page, Hkv, hd]`` slab shards its head dim over
-        'model' (the pool spec minus the page axis), so a promote feeds
-        each shard its own head slice; unmeshed, the numpy slabs ride the
-        jit's default device_put."""
+    def _place_host_slabs(self, slabs):
+        """Commit one host page's slab tuple to the pool's placement: on a
+        mesh each ``[L, page, Hkv, hd]`` payload slab shards its head dim
+        over 'model' (its pool spec minus the page axis), so a promote
+        feeds each shard its own head slice; ``[L, page]`` scale slabs ride
+        replicated.  Unmeshed, the numpy slabs ride the jit's default
+        device_put."""
         if self.mesh is None:
-            return hk, hv
-        spec = P(self._kv_spec[0], *self._kv_spec[2:])
-        sh = NamedSharding(self.mesh, spec)
-        return jax.device_put(hk, sh), jax.device_put(hv, sh)
+            return tuple(slabs)
+        return tuple(
+            jax.device_put(s, NamedSharding(self.mesh,
+                                            P(spec[0], *spec[2:])))
+            for s, spec in zip(slabs, self._pool_specs))
 
     # ---------------------------------------------------------- entry points
     # Every program call site follows the one catalog protocol
@@ -342,11 +388,11 @@ class MeshExecutor:
         """One fixed-shape decode step over all slots; returns the sampled
         [B_slots] token vector (device array — the caller fetches inside
         its watchdog window) and updates the pools in place."""
-        args = (self.params, self.kpool, self.vpool,
+        args = (self.params, self.pools,
                 jnp.asarray(page_table), jnp.asarray(lengths),
                 jnp.asarray(last_tok), jnp.asarray(active), *lanes)
         t0 = account(self.catalog, "decode", self._decode_prog, args)
-        nxt, self.kpool, self.vpool = self._decode_prog(*args)
+        nxt, self.pools = self._decode_prog(*args)
         if t0 is not None:
             finish_sample(self.catalog, "decode", nxt, t0)
         return nxt
@@ -362,51 +408,54 @@ class MeshExecutor:
         # lanes ride as numpy arrays: jit device-puts them without
         # compiling the tiny list->array convert programs a jnp.asarray
         # of a Python list would cost on first use
-        args = (self.params, self.kpool, self.vpool, pt_row, tokens,
+        args = (self.params, self.pools, pt_row, tokens,
                 jnp.int32(n_real), jnp.int32(start),
                 np.asarray([lane_t], np.float32),
                 np.asarray([lane_k], np.int32),
                 np.asarray([lane_p], np.float32),
                 np.asarray([lane_s], np.uint32))
         t0 = account(self.catalog, f"prefill_{s_pad}", prog, args)
-        nxt, self.kpool, self.vpool = prog(*args)
+        nxt, self.pools = prog(*args)
         if t0 is not None:
             finish_sample(self.catalog, f"prefill_{s_pad}", nxt, t0)
         return nxt
 
     def cow(self, src: int, dst: int) -> None:
         """Snapshot physical page ``src`` onto ``dst`` across all layers
-        (copy-on-write boundary page; one fixed program shape)."""
-        args = (self.kpool, self.vpool, jnp.int32(src), jnp.int32(dst))
+        (copy-on-write boundary page; one fixed program shape).  On a
+        quantized pool the copy moves raw int8 bytes + scale rows — COW
+        never round-trips through float."""
+        args = (self.pools, jnp.int32(src), jnp.int32(dst))
         t0 = account(self.catalog, "cow", self._cow_prog, args)
-        self.kpool, self.vpool = self._cow_prog(*args)
+        self.pools = self._cow_prog(*args)
         if t0 is not None:
-            finish_sample(self.catalog, "cow", self.kpool, t0)
+            finish_sample(self.catalog, "cow", self.pools[0], t0)
 
     def extract(self, src: int):
         """Demote half of the tier move: copy physical page ``src`` to
-        host, returning ``(hk, hv)`` numpy slabs of ``[L, page, Hkv, hd]``
-        (a sharded pool gathers the head shards into one slab).  Read-only
-        — the pool survives."""
-        args = (self.kpool, self.vpool, jnp.int32(src))
+        host, returning one numpy slab per pool array in canonical order —
+        ``(hk, hv)`` of ``[L, page, Hkv, hd]`` full precision, plus the
+        ``[L, page]`` scale slabs on an int8 pool (a sharded pool gathers
+        the head shards into one slab).  Read-only — the pool survives."""
+        args = (self.pools, jnp.int32(src))
         t0 = account(self.catalog, "tier_extract", self._extract_prog, args)
-        hk, hv = self._extract_prog(*args)
-        out = np.asarray(hk), np.asarray(hv)
+        slabs = self._extract_prog(*args)
+        out = tuple(np.asarray(s) for s in slabs)
         if t0 is not None:   # the host fetch above already synced
             self.catalog.record_sync("tier_extract",
                                      time.perf_counter() - t0)
         return out
 
-    def inject(self, hk, hv, dst: int) -> None:
-        """Promote half of the tier move: place the host slabs under the
-        pool's sharding and write them into physical page ``dst`` (one
+    def inject(self, slabs, dst: int) -> None:
+        """Promote half of the tier move: place the host slab tuple under
+        the pool's shardings and write it into physical page ``dst`` (one
         fixed program shape; pools donated like COW)."""
-        ph, pv = self._place_host_page(hk, hv)
-        args = (self.kpool, self.vpool, ph, pv, jnp.int32(dst))
+        placed = self._place_host_slabs(slabs)
+        args = (self.pools, placed, jnp.int32(dst))
         t0 = account(self.catalog, "tier_inject", self._inject_prog, args)
-        self.kpool, self.vpool = self._inject_prog(*args)
+        self.pools = self._inject_prog(*args)
         if t0 is not None:
-            finish_sample(self.catalog, "tier_inject", self.kpool, t0)
+            finish_sample(self.catalog, "tier_inject", self.pools[0], t0)
 
     def update_params(self, params):
         """Swap the LIVE param tree under every compiled program (hybrid

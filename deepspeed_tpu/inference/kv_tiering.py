@@ -68,7 +68,8 @@ import numpy as np
 from .prefix_cache import chain_keys  # noqa: F401  (digest key schedule)
 
 __all__ = ["HostTier", "TIER_HBM", "TIER_HOST", "chain_keys",
-           "extract_page", "inject_page"]
+           "extract_page", "inject_page", "extract_pool_page",
+           "inject_pool_page"]
 
 # digest tier codes (compact on-store encoding; docs/FLEET.md)
 TIER_HBM = 0
@@ -99,6 +100,26 @@ def inject_page(k, v, hk, hv, dst):
         v.at[:, dst].set(hv.astype(v.dtype))
 
 
+def extract_pool_page(pools, src):
+    """:func:`extract_page` generalized over the canonical pool tuple: one
+    slab per pool array — ``[L, page, Hkv, hd]`` for the k/v payload plus,
+    on a quantized pool, the ``[L, page]`` scale rows.  An int8 page moves
+    as raw int8 bytes + its scales (never re-expanded to float), which is
+    what halves the host-tier slab (docs/SERVING.md "Quantized KV pages").
+    """
+    import jax
+
+    return tuple(jax.lax.dynamic_index_in_dim(a, src, axis=1,
+                                              keepdims=False) for a in pools)
+
+
+def inject_pool_page(pools, slabs, dst):
+    """:func:`inject_page` generalized over the canonical pool tuple (the
+    promote half; pools donated by the caller's jit exactly like COW)."""
+    return tuple(a.at[:, dst].set(s.astype(a.dtype))
+                 for a, s in zip(pools, slabs))
+
+
 class HostTier:
     """LRU store of demoted KV pages: index chain key -> host slab pair.
 
@@ -116,7 +137,10 @@ class HostTier:
         if self.max_pages < 1:
             raise ValueError(f"max_pages={max_pages} must be >= 1")
         self.page_bytes = int(page_bytes)
-        self._buffers: "OrderedDict[object, Tuple[np.ndarray, np.ndarray]]" \
+        # slab TUPLES in canonical pool order: (hk, hv) for a full-precision
+        # pool, (hk, hv, hk_scale, hv_scale) for an int8 one — byte
+        # accounting sums every member, so the scale planes are priced in
+        self._buffers: "OrderedDict[object, Tuple[np.ndarray, ...]]" \
             = OrderedDict()
         # weight epoch each slab was extracted under (docs/HYBRID.md):
         # get(epoch=...) refuses a slab from any other epoch, so even a
@@ -146,21 +170,20 @@ class HostTier:
     def keys(self) -> Iterable:
         return self._buffers.keys()
 
-    def put(self, key, hk: np.ndarray, hv: np.ndarray,
-            epoch: int = 0) -> None:
-        """Store one demoted page (the caller made room first), stamped
-        with the weight ``epoch`` it was extracted under.  A re-demotion
-        of a key replaces the old slab (same content — chain keys are
-        content-derived — so the bytes just re-account)."""
+    def put(self, key, *slabs: np.ndarray, epoch: int = 0) -> None:
+        """Store one demoted page's slab tuple (the caller made room
+        first), stamped with the weight ``epoch`` it was extracted under.
+        A re-demotion of a key replaces the old slabs (same content —
+        chain keys are content-derived — so the bytes just re-account)."""
         old = self._buffers.pop(key, None)
         if old is not None:
-            self._bytes -= int(old[0].nbytes) + int(old[1].nbytes)
-        self._buffers[key] = (hk, hv)
+            self._bytes -= sum(int(s.nbytes) for s in old)
+        self._buffers[key] = tuple(slabs)
         self._epochs[key] = int(epoch)
-        self._bytes += int(hk.nbytes) + int(hv.nbytes)
+        self._bytes += sum(int(s.nbytes) for s in slabs)
 
     def get(self, key, touch: bool = True, epoch: Optional[int] = None
-            ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+            ) -> Optional[Tuple[np.ndarray, ...]]:
         """The slab for ``key`` — or ``None`` when absent, or when
         ``epoch`` is given and the slab was extracted under a DIFFERENT
         weight epoch (stale K/V must never be injected; docs/HYBRID.md)."""
@@ -181,11 +204,11 @@ class HostTier:
         if key in self._buffers:
             self._buffers.move_to_end(key)
 
-    def pop(self, key) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    def pop(self, key) -> Optional[Tuple[np.ndarray, ...]]:
         data = self._buffers.pop(key, None)
         self._epochs.pop(key, None)
         if data is not None:
-            self._bytes -= int(data[0].nbytes) + int(data[1].nbytes)
+            self._bytes -= sum(int(s.nbytes) for s in data)
         return data
 
     def discard(self, key) -> None:
@@ -210,7 +233,7 @@ class HostTier:
         # slice BEFORE inserting so a pre-populated tier keeps the donor's
         # MRU-most surplus, not its LRU-most (order inside the keep is
         # still LRU→MRU, preserving recency here)
-        for k, (hk, hv) in items[-free:]:
-            self.put(k, hk, hv, epoch=other._epochs.get(k, 0))
+        for k, slabs in items[-free:]:
+            self.put(k, *slabs, epoch=other._epochs.get(k, 0))
             adopted.append(k)
         return adopted

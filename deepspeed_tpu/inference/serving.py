@@ -313,7 +313,7 @@ class ServingEngine:
     def __init__(self, model, params, b_slots: int = 4,
                  page_size: int = PAGE_SIZE, num_pages: Optional[int] = None,
                  max_model_len: Optional[int] = None, monitor=None,
-                 watchdog=None, dtype=None, mesh=None,
+                 watchdog=None, dtype=None, kv_dtype=None, mesh=None,
                  max_queue: Optional[int] = None, quarantine_limit: int = 2,
                  probe_after_ticks: Optional[int] = None,
                  prefix_cache: bool = True,
@@ -395,10 +395,17 @@ class ServingEngine:
         maybe_capture_from_env()
         self._exec = MeshExecutor(model, params, self.num_pages,
                                   self.page_size, self.b_slots, dtype=dtype,
-                                  mesh=mesh, prefix_cache=prefix_cache,
+                                  kv_dtype=kv_dtype, mesh=mesh,
+                                  prefix_cache=prefix_cache,
                                   host_tier=host_tier_pages is not None,
                                   catalog=self._catalog)
         self.params = self._exec.params   # auto-TP-sharded on a mesh
+        # at-rest storage dtype of the paged pool (docs/SERVING.md
+        # "Quantized KV pages"): None = compute dtype, "int8" = quantize-
+        # on-store pages + per-page scale rows.  A page is still a page —
+        # accounting, prefix sharing, COW, tiering and epoch stamps are
+        # dtype-blind
+        self.kv_dtype = self._exec.kv_dtype
         self._free_pages: List[int] = list(range(self.num_pages - 1, 0, -1))
         # per-page reference counts (page 0, the trash page, is never
         # counted): 0 = free or quarantined, >0 = held by slots and/or the
@@ -527,11 +534,22 @@ class ServingEngine:
         info = self._exec.mesh_info()
         if self.monitor is not None:
             pb = self._exec.pool_bytes
+            # kvq_* (docs/OBSERVABILITY.md): storage-dtype facts, constant
+            # for the engine's lifetime.  scale_bytes_total is the part of
+            # kv_pool_bytes_total spent on per-page scale rows (0 on a
+            # full-precision pool), page_bytes the all-in per-page cost —
+            # the honest denominator of the 2× capacity claim
+            scale_bytes = sum(int(a.nbytes) for a in self._exec.pools[2:])
             self.monitor.write_events(
                 [("serve/mesh_devices", float(info["mesh_devices"]), 0),
                  ("serve/kv_pool_bytes_total", float(pb["total"]), 0),
                  ("serve/kv_pool_bytes_per_device",
-                  float(pb["per_device"]), 0)]
+                  float(pb["per_device"]), 0),
+                 ("serve/kvq_enabled",
+                  1.0 if self._exec.quantized else 0.0, 0),
+                 ("serve/kvq_scale_bytes_total", float(scale_bytes), 0),
+                 ("serve/kvq_page_bytes",
+                  float(pb["total"] // self.num_pages), 0)]
                 + [(f"serve/mesh_axis_{a}", float(s), 0)
                    for a, s in info["mesh_axes"].items()])
 
@@ -545,7 +563,7 @@ class ServingEngine:
             speculative.validate(model, self.max_model_len)
             self._spec = SpeculativeDecoder(
                 speculative, model, self.num_pages, self.page_size,
-                self.b_slots, dtype=dtype, mesh=mesh,
+                self.b_slots, dtype=dtype, kv_dtype=kv_dtype, mesh=mesh,
                 donate=bool(self._donate), catalog=self._catalog)
             if self._cow_prog is not None:
                 # pre-warm the COW jit on the DRAFT pool aval too: a
@@ -769,8 +787,8 @@ class ServingEngine:
         self._tier_make_room()
         with trace_span("serve.demote", page=int(e.page)):
             t0 = time.monotonic()
-            hk, hv = self._exec.extract(int(e.page))
-            self._tier.put(key, hk, hv, epoch=self._weight_epoch)
+            slabs = self._exec.extract(int(e.page))
+            self._tier.put(key, *slabs, epoch=self._weight_epoch)
             page = self._prefix.demote(key)
             self._drop_page(page)
             self._demote_lat_s.append(time.monotonic() - t0)
@@ -817,7 +835,7 @@ class ServingEngine:
                 t0 = time.monotonic()
                 (dst,) = self._alloc_pages(1)
                 try:
-                    self._exec.inject(data[0], data[1], dst)
+                    self._exec.inject(data, dst)
                 except BaseException:
                     self._drop_page(dst)
                     raise
@@ -1480,8 +1498,8 @@ class ServingEngine:
             maybe_fire(SITE_SERVE_DECODE, tick=self._tick)
             with self._armed(f"serve.decode tick {self._tick} "
                              f"(speculative k={self._spec.k})"):
-                emitted, n_emit, self._kpool, self._vpool = self._spec.tick(
-                    self.params, self._kpool, self._vpool,
+                emitted, n_emit, self._exec.pools = self._spec.tick(
+                    self.params, self._exec.pools,
                     self._page_table, self._lengths, self._last_tok,
                     self._active, *self._lanes_jnp())
         active_slots = np.flatnonzero(self._active)
@@ -1804,6 +1822,10 @@ class ServingEngine:
             "mesh_axes": info["mesh_axes"],
             "kv_pool_bytes_total": pb["total"],
             "kv_pool_bytes_per_device": pb["per_device"],
+            # at-rest pool storage dtype (docs/SERVING.md "Quantized KV
+            # pages"): None = compute dtype; "int8" pools include their
+            # scale rows in every byte figure above
+            "kv_dtype": self.kv_dtype,
             "draft_pool_bytes_per_device": (
                 self._spec.pool_bytes["per_device"]
                 if self._spec is not None else 0),

@@ -703,6 +703,7 @@ class ServingSupervisor:
                 and new.page_size == old.page_size
                 and new.num_pages == old.num_pages
                 and new.max_model_len == old.max_model_len
+                and new.kv_dtype == old.kv_dtype
                 and new._donate == old._donate
                 and new.mesh == old.mesh):
             new._exec.adopt_programs(old._exec)

@@ -61,6 +61,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..models.transformer import (PAGED_POOL_KEYS, paged_pool_cache,
+                                  paged_pool_tuple)
 from ..observability.program_stats import account, finish_sample
 from .sampling import position_keys, sample_tokens, sampling_probs
 
@@ -173,7 +175,7 @@ class SpeculativeDecoder:
 
     def __init__(self, config: SpeculativeConfig, target_model,
                  num_pages: int, page_size: int, b_slots: int,
-                 dtype=None, mesh=None, donate: bool = False,
+                 dtype=None, kv_dtype=None, mesh=None, donate: bool = False,
                  catalog=None):
         from .execution import place_params, pool_bytes
 
@@ -212,20 +214,32 @@ class SpeculativeDecoder:
             jax.tree_util.tree_map(lambda x: x.sharding, self.draft_params)
             if _leaves and all(hasattr(x, "sharding") for x in _leaves)
             else None)
+        # the draft pool mirrors the target's storage dtype too: a
+        # quantized engine quantizes BOTH pools, so the HBM headroom the
+        # int8 target pool buys isn't spent back on a full-precision draft
+        self.kv_dtype = kv_dtype if kv_dtype is None else str(kv_dtype)
         cache = self.draft_model.init_paged_cache(num_pages, page_size,
-                                                  dtype=dtype)
-        self._kv_spec = self.draft_model.paged_cache_specs()["k"]
+                                                  dtype=dtype,
+                                                  kv_dtype=kv_dtype)
+        specs = self.draft_model.paged_cache_specs(kv_dtype=kv_dtype)
+        self._pool_keys = tuple(k for k in PAGED_POOL_KEYS if k in cache)
+        self._pool_specs = tuple(specs[k] for k in self._pool_keys)
+        self._kv_spec = specs["k"]
+        tspecs = target_model.paged_cache_specs(kv_dtype=kv_dtype)
+        self._target_pool_specs = tuple(tspecs[k] for k in PAGED_POOL_KEYS
+                                        if k in tspecs)
         if mesh is not None:
             from jax.sharding import NamedSharding
 
-            sh = NamedSharding(mesh, self._kv_spec)
-            self._dkpool = jax.device_put(cache["k"], sh)
-            self._dvpool = jax.device_put(cache["v"], sh)
+            self.dpools = tuple(
+                jax.device_put(cache[k], NamedSharding(mesh, specs[k]))
+                for k in self._pool_keys)
         else:
-            self._dkpool = jax.device_put(cache["k"], cache["k"].sharding)
-            self._dvpool = jax.device_put(cache["v"], cache["v"].sharding)
-        self.pool_bytes = pool_bytes(self._dkpool, self._dvpool)
-        dn = (1, 2) if donate else ()
+            self.dpools = tuple(
+                jax.device_put(cache[k], cache[k].sharding)
+                for k in self._pool_keys)
+        self.pool_bytes = pool_bytes(*self.dpools)
+        dn = (1,) if donate else ()
         self._draft_prog = self._build_draft(dn)
         self._verify_prog = self._build_verify(target_model, dn)
         self._draft_prefill_progs: Dict[int, Any] = {}
@@ -239,12 +253,12 @@ class SpeculativeDecoder:
     def _build_draft(self, donate):
         draft_apply = self.draft_model.apply_paged
 
-        def prog(dparams, dk, dv, page_table, pos, tok, active,
+        def prog(dparams, dpools, page_table, pos, tok, active,
                  temp, top_k, top_p, seeds):
             # write `tok` (pending at `pos`) into the draft pool, propose
             # the token at pos+1 from the draft distribution under the
             # slot's own sampling lane (salted position key)
-            cache = {"k": dk, "v": dv}
+            cache = paged_pool_cache(dpools)
             logits, cache = draft_apply(dparams, tok[:, None], cache,
                                         page_table, pos, active[:, None])
             lg = logits[:, -1, :]
@@ -252,33 +266,33 @@ class SpeculativeDecoder:
                 lg, temp, top_k, top_p,
                 lambda: position_keys(seeds, pos + 1, salt=SALT_DRAFT))
             q = sampling_probs(lg, temp, top_k, top_p)
-            return d_tok, q, cache["k"], cache["v"]
+            return d_tok, q, paged_pool_tuple(cache)
 
         from .execution import pool_jit
 
-        return pool_jit(prog, donate, self._mesh, self._kv_spec, 2)
+        return pool_jit(prog, donate, self._mesh, self._pool_specs, 2)
 
     def _build_draft_prefill(self, s_pad: int):
         draft_apply = self.draft_model.apply_paged
 
-        def prog(dparams, dk, dv, pt_row, tokens, n_real, start):
+        def prog(dparams, dpools, pt_row, tokens, n_real, start):
             seq_mask = (jnp.arange(s_pad, dtype=jnp.int32)
                         < n_real)[None, :]
-            cache = {"k": dk, "v": dv}
+            cache = paged_pool_cache(dpools)
             _, cache = draft_apply(dparams, tokens, cache, pt_row,
                                    start[None], seq_mask)
-            return cache["k"], cache["v"]
+            return paged_pool_tuple(cache)
 
         from .execution import pool_jit
 
-        return pool_jit(prog, (1, 2) if self._donate else (), self._mesh,
-                        self._kv_spec, 0)
+        return pool_jit(prog, (1,) if self._donate else (), self._mesh,
+                        self._pool_specs, 0)
 
     def _build_verify(self, target_model, donate):
         target_apply = target_model.apply_paged
         k = self.k
 
-        def prog(params, kpool, vpool, page_table, lengths, last_tok,
+        def prog(params, pools, page_table, lengths, last_tok,
                  active, d_toks, d_probs, temp, top_k, top_p, seeds):
             B = lengths.shape[0]
             V = d_probs.shape[-1]
@@ -286,7 +300,7 @@ class SpeculativeDecoder:
             # positions L..L+k and yields the k+1 next-token distributions
             tokens = jnp.concatenate([last_tok[:, None], d_toks], axis=1)
             seq_mask = jnp.broadcast_to(active[:, None], (B, k + 1))
-            cache = {"k": kpool, "v": vpool}
+            cache = paged_pool_cache(pools)
             logits, cache = target_apply(params, tokens, cache, page_table,
                                          lengths, seq_mask)
             rep = lambda x: jnp.repeat(x, k + 1)                 # noqa: E731
@@ -340,15 +354,15 @@ class SpeculativeDecoder:
                 [d_toks, jnp.zeros((B, 1), jnp.int32)], axis=1)
             emitted = emitted.at[jnp.arange(B), n_acc].set(final)
             n_emit = jnp.minimum(n_acc + 1, k).astype(jnp.int32)
-            return emitted, n_emit, cache["k"], cache["v"]
+            return emitted, n_emit, paged_pool_tuple(cache)
 
         from .execution import pool_jit
 
         # the verify pass consumes and reproduces the TARGET pool: its
-        # output pools pin to the target's canonical sharding, same as the
-        # plain decode tick's
-        return pool_jit(prog, donate, self._mesh, target_model
-                        .paged_cache_specs()["k"], 2)
+        # output pools pin to the target's canonical shardings, same as
+        # the plain decode tick's
+        return pool_jit(prog, donate, self._mesh, self._target_pool_specs,
+                        2)
 
     def program_inventory(self) -> Dict[str, Any]:
         return {"k": self.k, "draft_decode": 1, "verify": 1,
@@ -388,8 +402,8 @@ class SpeculativeDecoder:
     # ----------------------------------------------------------- the tick
 
     def pool_alive(self) -> bool:
-        dead = getattr(self._dkpool, "is_deleted", None)
-        return not (dead and self._dkpool.is_deleted())
+        dead = getattr(self.dpools[0], "is_deleted", None)
+        return not (dead and self.dpools[0].is_deleted())
 
     def prefill(self, s_pad: int, pt_row, tokens, n_real: int,
                 start: int) -> None:
@@ -400,28 +414,27 @@ class SpeculativeDecoder:
         if prog is None:
             prog = self._draft_prefill_progs[s_pad] = \
                 self._build_draft_prefill(s_pad)
-        args = (self.draft_params, self._dkpool, self._dvpool, pt_row,
+        args = (self.draft_params, self.dpools, pt_row,
                 tokens, jnp.int32(n_real), jnp.int32(start))
         t0 = account(self.catalog, f"draft_prefill_{s_pad}", prog, args)
-        self._dkpool, self._dvpool = prog(*args)
+        self.dpools = prog(*args)
         if t0 is not None:
             finish_sample(self.catalog, f"draft_prefill_{s_pad}",
-                          self._dkpool, t0)
+                          self.dpools[0], t0)
 
     def cow(self, cow_prog, src: int, dst: int) -> None:
         """Mirror a target-pool COW snapshot in the draft pool (same
         fixed-shape program; jit re-specializes once per pool aval at
         engine init, never at admission)."""
-        self._dkpool, self._dvpool = cow_prog(
-            self._dkpool, self._dvpool, jnp.int32(src), jnp.int32(dst))
+        self.dpools = cow_prog(self.dpools, jnp.int32(src), jnp.int32(dst))
 
-    def tick(self, target_params, kpool, vpool, page_table, lengths,
+    def tick(self, target_params, pools, page_table, lengths,
              last_tok, active, temp, top_k, top_p,
-             seeds) -> Tuple[np.ndarray, np.ndarray, Any, Any]:
+             seeds) -> Tuple[np.ndarray, np.ndarray, Any]:
         """One speculative decode tick: k draft invocations + one verify.
-        Returns ``(emitted [B, k+1], n_emit [B], kpool, vpool)`` — the
-        caller consumes ``emitted[b, :n_emit[b]]`` per slot (truncated by
-        its own budget/eos) and the updated TARGET pools."""
+        Returns ``(emitted [B, k+1], n_emit [B], pools)`` — the caller
+        consumes ``emitted[b, :n_emit[b]]`` per slot (truncated by its own
+        budget/eos) and the updated TARGET pool tuple."""
         pt = jnp.asarray(page_table)
         ln = jnp.asarray(lengths)
         act = jnp.asarray(active)
@@ -430,26 +443,26 @@ class SpeculativeDecoder:
         tok = jnp.asarray(last_tok)
         d_toks, d_probs = [], []
         for i in range(self.k):
-            dargs = (self.draft_params, self._dkpool, self._dvpool, pt,
+            dargs = (self.draft_params, self.dpools, pt,
                      ln + i, tok, act, tj, kj, pj, sj)
             t0 = account(self.catalog, "draft_decode", self._draft_prog,
                          dargs)
-            tok, q, self._dkpool, self._dvpool = self._draft_prog(*dargs)
+            tok, q, self.dpools = self._draft_prog(*dargs)
             if t0 is not None:
                 finish_sample(self.catalog, "draft_decode", tok, t0)
             d_toks.append(tok)
             d_probs.append(q)
-        vargs = (target_params, kpool, vpool, pt, ln, jnp.asarray(last_tok),
+        vargs = (target_params, pools, pt, ln, jnp.asarray(last_tok),
                  act, jnp.stack(d_toks, axis=1), jnp.stack(d_probs, axis=1),
                  tj, kj, pj, sj)
         t0 = account(self.catalog, "verify", self._verify_prog, vargs)
-        emitted, n_emit, kpool, vpool = self._verify_prog(*vargs)
+        emitted, n_emit, pools = self._verify_prog(*vargs)
         if t0 is not None:
             finish_sample(self.catalog, "verify", emitted, t0)
         n_active = int(np.asarray(active).sum())
         self.verify_slot_ticks += n_active
         self.drafted_tokens += self.k * n_active
-        return np.asarray(emitted), np.asarray(n_emit), kpool, vpool
+        return np.asarray(emitted), np.asarray(n_emit), pools
 
     def mean_accepted_len(self) -> float:
         """Tokens emitted per verify tick per slot (1..k; > 1 means the
@@ -467,6 +480,7 @@ class SpeculativeDecoder:
                 and self.num_pages == other.num_pages
                 and self.page_size == other.page_size
                 and self.b_slots == other.b_slots
+                and self.kv_dtype == other.kv_dtype
                 and self._donate == other._donate)
 
     def adopt_programs(self, old: "SpeculativeDecoder") -> None:

@@ -12,16 +12,19 @@ import os
 
 import jax.numpy as jnp
 
-from .transformer import (CONFIGS, PAGE_SIZE, TransformerConfig, cache_specs,
-                          cow_copy_page, cross_entropy_loss, forward,
+from .transformer import (CONFIGS, KV_QUANT_DTYPES, PAGE_SIZE,
+                          TransformerConfig, cache_specs, cow_copy_page,
+                          cow_copy_pool, cross_entropy_loss, forward,
                           forward_cached, forward_paged, get_config, has_moe,
                           init_cache, init_paged_cache, init_params,
-                          paged_cache_specs, param_specs)
+                          paged_cache_specs, paged_pool_cache,
+                          paged_pool_tuple, param_specs)
 
 __all__ = ["CausalLM", "TransformerConfig", "CONFIGS", "get_config", "forward",
            "forward_cached", "forward_paged", "init_cache", "init_paged_cache",
            "cache_specs", "paged_cache_specs", "init_params", "param_specs",
-           "cross_entropy_loss", "PAGE_SIZE", "cow_copy_page"]
+           "cross_entropy_loss", "PAGE_SIZE", "cow_copy_page", "cow_copy_pool",
+           "paged_pool_tuple", "paged_pool_cache", "KV_QUANT_DTYPES"]
 
 
 class CausalLM:
@@ -188,12 +191,16 @@ class CausalLM:
                               input_mask)
 
     # -- block-paged decode contract (used by ServingEngine): one physical
-    #    page pool multiplexed across decode slots via per-slot page tables --
-    def init_paged_cache(self, num_pages, page_size=PAGE_SIZE, dtype=None):
-        return init_paged_cache(self.config, num_pages, page_size, dtype)
+    #    page pool multiplexed across decode slots via per-slot page tables.
+    #    kv_dtype="int8" narrows the pool's at-rest representation (per-page
+    #    scale planes ride in the cache dict); None = compute dtype --
+    def init_paged_cache(self, num_pages, page_size=PAGE_SIZE, dtype=None,
+                         kv_dtype=None):
+        return init_paged_cache(self.config, num_pages, page_size, dtype,
+                                kv_dtype=kv_dtype)
 
-    def paged_cache_specs(self):
-        return paged_cache_specs(self.config)
+    def paged_cache_specs(self, kv_dtype=None):
+        return paged_cache_specs(self.config, kv_dtype=kv_dtype)
 
     def apply_paged(self, params, tokens, cache, page_table, start, seq_mask):
         return forward_paged(self.config, params, tokens, cache, page_table,

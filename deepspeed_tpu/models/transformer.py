@@ -1488,9 +1488,45 @@ def forward_cached(cfg: TransformerConfig, params: Dict[str, Any],
 
 PAGE_SIZE = 128   # tokens per KV page; 128 keeps cache tiles lane-aligned
 
+# quantized pool storage dtypes accepted by ``init_paged_cache(kv_dtype=)``.
+# int8 is the only wired width: the per-row symmetric absmax scheme below
+# needs a sign bit + enough mantissa that greedy decode stays token-exact
+# on realistic logit margins (docs/SERVING.md "Quantized KV pages")
+KV_QUANT_DTYPES = ("int8",)
+
+# canonical leaf order of a paged cache dict — the pool TUPLE the executor
+# threads through every program (k/v always; the scale planes only when the
+# pool is quantized).  Keeping the order fixed is what lets one generic
+# program body serve both pool layouts with a stable donation index.
+PAGED_POOL_KEYS = ("k", "v", "k_scale", "v_scale")
+
+
+def paged_pool_tuple(cache: Dict[str, Any]) -> tuple:
+    """The cache dict's pool arrays in canonical order (len 2 = full
+    precision, len 4 = int8 + per-page scale planes)."""
+    return tuple(cache[k] for k in PAGED_POOL_KEYS if k in cache)
+
+
+def paged_pool_cache(pools) -> Dict[str, Any]:
+    """Inverse of :func:`paged_pool_tuple`."""
+    return dict(zip(PAGED_POOL_KEYS, pools))
+
+
+def _normalize_kv_dtype(kv_dtype):
+    """None (full precision) or the canonical string "int8"."""
+    if kv_dtype is None:
+        return None
+    name = getattr(kv_dtype, "name", None) or str(kv_dtype)
+    if name not in KV_QUANT_DTYPES:
+        raise ValueError(
+            f"kv_dtype={kv_dtype!r} is not a quantized KV storage dtype; "
+            f"supported: {KV_QUANT_DTYPES} (None = full precision)")
+    return name
+
 
 def init_paged_cache(cfg: TransformerConfig, num_pages: int,
-                     page_size: int = PAGE_SIZE, dtype=None) -> Dict[str, Any]:
+                     page_size: int = PAGE_SIZE, dtype=None,
+                     kv_dtype=None) -> Dict[str, Any]:
     """Allocate the physical page pool: ``k``/``v`` are
     ``[L, num_pages, page_size, Hkv, hd]``.
 
@@ -1512,18 +1548,36 @@ def init_paged_cache(cfg: TransformerConfig, num_pages: int,
     one mutable case — a *partial* boundary page the owner is still
     appending to — is shared by value instead: :func:`cow_copy_page`
     snapshots it into the reader's own page (copy-on-write).
+
+    ``kv_dtype="int8"`` allocates the pools in int8 plus per-page scale
+    planes ``k_scale``/``v_scale`` of shape ``[L, num_pages, page_size]``
+    (float32): each page carries one symmetric-absmax scale per token row
+    per layer, written by the same scatter that stores the row and applied
+    inside the gather (docs/SERVING.md "Quantized KV pages").  Every
+    sharing/COW/tiering contract above is dtype-blind — a page is still a
+    page; only its at-rest representation narrows.
     """
     dtype = dtype or cfg.dtype
     kv = (cfg.num_layers, num_pages, page_size, cfg.kv_heads,
           cfg.dims_per_head)
-    return {"k": jnp.zeros(kv, dtype), "v": jnp.zeros(kv, dtype)}
+    if _normalize_kv_dtype(kv_dtype) is None:
+        return {"k": jnp.zeros(kv, dtype), "v": jnp.zeros(kv, dtype)}
+    sc = (cfg.num_layers, num_pages, page_size)
+    return {"k": jnp.zeros(kv, jnp.int8), "v": jnp.zeros(kv, jnp.int8),
+            "k_scale": jnp.zeros(sc, jnp.float32),
+            "v_scale": jnp.zeros(sc, jnp.float32)}
 
 
-def paged_cache_specs(cfg: TransformerConfig) -> Dict[str, P]:
+def paged_cache_specs(cfg: TransformerConfig, kv_dtype=None) -> Dict[str, P]:
     """Shardings for the page pool: KV heads over 'model'; pages replicated
-    (any slot on any data shard may own any page)."""
+    (any slot on any data shard may own any page).  A quantized pool's
+    scale planes ``[L, P, page]`` have no head dim, so they ride replicated
+    alongside their (page-replicated) int8 payload."""
     kv = P(None, None, None, "model", None)
-    return {"k": kv, "v": kv}
+    if _normalize_kv_dtype(kv_dtype) is None:
+        return {"k": kv, "v": kv}
+    sc = P(None, None, None)
+    return {"k": kv, "v": kv, "k_scale": sc, "v_scale": sc}
 
 
 def cow_copy_page(k: jax.Array, v: jax.Array, src: jax.Array,
@@ -1543,6 +1597,45 @@ def cow_copy_page(k: jax.Array, v: jax.Array, src: jax.Array,
     harmless self-copy.
     """
     return k.at[:, dst].set(k[:, src]), v.at[:, dst].set(v[:, src])
+
+
+def cow_copy_pool(pools, src: jax.Array, dst: jax.Array):
+    """:func:`cow_copy_page` generalized over the canonical pool tuple
+    (k/v, plus the ``[L, P, page]`` scale planes of a quantized pool):
+    every array copies its page-axis slice ``src`` onto ``dst`` — raw
+    bytes, so an int8 page's COW snapshot never round-trips through
+    float (the sharer's copy dequantizes bit-identically to the donor's).
+    """
+    return tuple(a.at[:, dst].set(a[:, src]) for a in pools)
+
+
+def kv_quantize_rows(x: jax.Array):
+    """Symmetric absmax int8 quantization of one write slice: ``x``
+    ``[N, Hkv, hd]`` -> (int8 rows, float32 per-row scales ``[N]``).
+
+    One scale per token row (the page slice being written), computed over
+    the row's whole ``Hkv*hd`` K (or V) vector: a row is written exactly
+    once at its position and never rescaled, so incremental page fills
+    need no running-max bookkeeping and a full page's bytes are a pure
+    function of the tokens that produced it — the property prefix sharing,
+    COW and demote/promote round trips rely on.  An all-zero row (padding,
+    trash-page writes) stores scale 1 so dequantization is exact zero.
+    """
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=(1, 2))
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(xf / scale[:, None, None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def kv_dequantize(q: jax.Array, scale: jax.Array, dtype):
+    """Invert :func:`kv_quantize_rows` on a gathered ``[B, T, Hkv, hd]``
+    block with its ``[B, T]`` scale rows; dequantizes in float32 before
+    casting to the compute dtype so the scale multiply never loses the
+    int8 mantissa."""
+    return (q.astype(jnp.float32)
+            * scale[..., None, None]).astype(dtype)
 
 
 def _attention_paged(cfg, q, ck, cv, q_pos):
@@ -1575,11 +1668,20 @@ def _attention_paged(cfg, q, ck, cv, q_pos):
     return out.reshape(B, S, Hq, hd)
 
 
-def _block_paged(cfg, lp, x, ckf, cvf, positions, write_idx, gather_idx, rng):
+def _block_paged(cfg, lp, x, ckf, cvf, positions, write_idx, gather_idx, rng,
+                 cksf=None, cvsf=None):
     """One transformer block against the paged pool.  ``ckf``/``cvf`` are
     this layer's pool flattened to ``[P*page, Hkv, hd]``; ``write_idx``
     [B*S] flat destinations (trash-redirected for masked tokens);
-    ``gather_idx`` [B, T] flat sources for each slot's pages."""
+    ``gather_idx`` [B, T] flat sources for each slot's pages.
+
+    ``cksf``/``cvsf`` (both or neither) are a quantized pool's scale
+    planes flattened to ``[P*page]``: the store quantizes each written row
+    (symmetric absmax, :func:`kv_quantize_rows`) and scatters its scale
+    through the SAME ``write_idx``, the gather dequantizes in-place before
+    attention — the scales ride as one extra traced operand, so the
+    program shapes (and the zero-recompile inventory built on them) are
+    unchanged."""
     B, S, _ = x.shape
     hd, nh, nkv = cfg.dims_per_head, cfg.num_heads, cfg.kv_heads
 
@@ -1597,12 +1699,29 @@ def _block_paged(cfg, lp, x, ckf, cvf, positions, write_idx, gather_idx, rng):
         q, k = _rope(q, k, positions, cfg.rope_theta, hd,
                      rotary_dim=cfg.rotary_dim,
                      interleaved=cfg.rope_interleaved)
-    ckf = ckf.at[write_idx].set(k.reshape(B * S, nkv, hd).astype(ckf.dtype))
-    cvf = cvf.at[write_idx].set(v.reshape(B * S, nkv, hd).astype(cvf.dtype))
-    ckf = constrain_spec(ckf, P(None, "model", None))
-    cvf = constrain_spec(cvf, P(None, "model", None))
-    ck = ckf[gather_idx]   # [B, T, Hkv, hd] — each slot's pages, in order
-    cv = cvf[gather_idx]
+    if cksf is not None:
+        # quantize on store: int8 rows + per-row scales through one scatter
+        kq, ks = kv_quantize_rows(k.reshape(B * S, nkv, hd))
+        vq, vs = kv_quantize_rows(v.reshape(B * S, nkv, hd))
+        ckf = ckf.at[write_idx].set(kq)
+        cvf = cvf.at[write_idx].set(vq)
+        cksf = cksf.at[write_idx].set(ks)
+        cvsf = cvsf.at[write_idx].set(vs)
+        ckf = constrain_spec(ckf, P(None, "model", None))
+        cvf = constrain_spec(cvf, P(None, "model", None))
+        # dequantize inside the gather: the narrow representation is what
+        # crosses HBM; attention sees compute-dtype values
+        ck = kv_dequantize(ckf[gather_idx], cksf[gather_idx], cfg.dtype)
+        cv = kv_dequantize(cvf[gather_idx], cvsf[gather_idx], cfg.dtype)
+    else:
+        ckf = ckf.at[write_idx].set(
+            k.reshape(B * S, nkv, hd).astype(ckf.dtype))
+        cvf = cvf.at[write_idx].set(
+            v.reshape(B * S, nkv, hd).astype(cvf.dtype))
+        ckf = constrain_spec(ckf, P(None, "model", None))
+        cvf = constrain_spec(cvf, P(None, "model", None))
+        ck = ckf[gather_idx]   # [B, T, Hkv, hd] — each slot's pages
+        cv = cvf[gather_idx]
     attn = _attention_paged(cfg, q, ck, cv, positions)
     attn = attn.reshape(B, S, nh * hd) @ lp["wo"]
     if cfg.attn_bias:
@@ -1612,13 +1731,13 @@ def _block_paged(cfg, lp, x, ckf, cvf, positions, write_idx, gather_idx, rng):
         h2 = h if cfg.shared_layernorm else _maybe_act_quant(cfg, _norm(
             cfg, x, lp["mlp_norm_scale"], lp.get("mlp_norm_bias")))
         m, _ = _mlp(cfg, lp, h2, rng, deterministic=True)
-        return x + attn + m, ckf, cvf
+        return x + attn + m, ckf, cvf, cksf, cvsf
 
     x = x + attn
     h = _norm(cfg, x, lp["mlp_norm_scale"], lp.get("mlp_norm_bias"))
     h = _maybe_act_quant(cfg, h)
     m, _ = _mlp(cfg, lp, h, rng, deterministic=True)
-    return x + m, ckf, cvf
+    return x + m, ckf, cvf, cksf, cvsf
 
 
 def forward_paged(cfg: TransformerConfig, params: Dict[str, Any],
@@ -1646,6 +1765,12 @@ def forward_paged(cfg: TransformerConfig, params: Dict[str, Any],
     rather than wrapping into the clamped last page, so multi-token decode
     can never corrupt live K/V; their logits are garbage the caller never
     reads.  Returns ``(logits [B,S,V], new_cache)``.
+
+    A quantized cache (``init_paged_cache(kv_dtype="int8")`` — extra
+    ``k_scale``/``v_scale`` planes) runs the same three program shapes:
+    writes quantize on store, the gather dequantizes, and the scale planes
+    scan through as two extra traced operands (docs/SERVING.md "Quantized
+    KV pages").
     """
     assert cfg.pipeline_stages == 1, "paged decode requires pipeline_stages=1"
     if not cfg.causal:
@@ -1690,18 +1815,33 @@ def forward_paged(cfg: TransformerConfig, params: Dict[str, Any],
     x = constrain_spec(x, P(BATCH_AXES, None, None))
 
     rng = jax.random.PRNGKey(0)
+    quantized = "k_scale" in cache
 
     def body(x, layer):
-        lp, ck, cv = layer
-        x, ckf, cvf = _block_paged(cfg, lp, x,
-                                   ck.reshape(num_pages * ps, *ck.shape[2:]),
-                                   cv.reshape(num_pages * ps, *cv.shape[2:]),
-                                   positions, write_idx, gather_idx, rng)
+        if quantized:
+            lp, ck, cv, cks, cvs = layer
+            sks, svs = cks.reshape(num_pages * ps), cvs.reshape(num_pages * ps)
+        else:
+            lp, ck, cv = layer
+            sks = svs = None
+        x, ckf, cvf, cksf, cvsf = _block_paged(
+            cfg, lp, x,
+            ck.reshape(num_pages * ps, *ck.shape[2:]),
+            cv.reshape(num_pages * ps, *cv.shape[2:]),
+            positions, write_idx, gather_idx, rng, cksf=sks, cvsf=svs)
         x = constrain_spec(x, P(BATCH_AXES, None, None))
-        return x, (ckf.reshape(ck.shape), cvf.reshape(cv.shape))
+        out = (ckf.reshape(ck.shape), cvf.reshape(cv.shape))
+        if quantized:
+            out += (cksf.reshape(cks.shape), cvsf.reshape(cvs.shape))
+        return x, out
 
-    x, (ck_all, cv_all) = jax.lax.scan(
-        body, x, (params["layers"], cache["k"], cache["v"]))
+    if quantized:
+        xs = (params["layers"], cache["k"], cache["v"],
+              cache["k_scale"], cache["v_scale"])
+        x, (ck_all, cv_all, cks_all, cvs_all) = jax.lax.scan(body, x, xs)
+    else:
+        x, (ck_all, cv_all) = jax.lax.scan(
+            body, x, (params["layers"], cache["k"], cache["v"]))
 
     x = _norm(cfg, x, params["final_norm_scale"], params.get("final_norm_bias"))
     if cfg.tie_embeddings:
@@ -1710,7 +1850,10 @@ def forward_paged(cfg: TransformerConfig, params: Dict[str, Any],
         logits = x @ params["lm_head"].astype(cfg.dtype)
         if "lm_head_bias" in params:   # GPT-J ties a bias to the LM head
             logits = logits + params["lm_head_bias"].astype(cfg.dtype)
-    return logits, {"k": ck_all, "v": cv_all}
+    new_cache = {"k": ck_all, "v": cv_all}
+    if quantized:
+        new_cache["k_scale"], new_cache["v_scale"] = cks_all, cvs_all
+    return logits, new_cache
 
 
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array,

@@ -1,0 +1,374 @@
+"""Quantized KV pages tests (ISSUE 17 tentpole).
+
+Covers: ``kv_dtype`` validation + the int8 pool layout (per-page-row
+scales), quantize/dequantize row math, EXACT pool-byte accounting
+(payload + scale arrays) and the ``serve/kvq_*`` gauges, host-tier byte
+accounting over slab tuples, greedy token-exactness at the measured
+tiny-config threshold, a sampled-stream distribution check against the
+fp engine, the zero-recompile + bit-identical-inventory gates across
+prefix sharing / COW / tiering / ``recycle()`` / a forced warm restart,
+``update_params`` epoch-flip compile parity with the fp engine,
+speculative int8 exactness (draft pool quantized too), composition with
+quantized WEIGHTS in one engine, and the pinned int8 tiered chaos seed.
+
+Compile discipline (single-core CI): ONE module-scoped tiny engine,
+short streams with small max_new choice sets, and every engine built
+here is deleted as soon as its outputs are captured.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.inference.kv_tiering import HostTier
+from deepspeed_tpu.inference.sampling import SamplingParams
+from deepspeed_tpu.inference.serving import Request
+from deepspeed_tpu.models import KV_QUANT_DTYPES, CausalLM
+from deepspeed_tpu.models.transformer import (kv_dequantize,
+                                              kv_quantize_rows)
+from deepspeed_tpu.monitor import InMemoryMonitor
+from deepspeed_tpu.resilience import (FaultInjector, clear_injector,
+                                      install_injector)
+from deepspeed_tpu.resilience.fault_injection import SITE_SERVE_DECODE
+from deepspeed_tpu.utils.compile_counter import compile_counter
+
+_count = compile_counter()
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    model = CausalLM("tiny", dtype=jnp.float32, attn_impl="xla")
+    params = model.init_fn(jax.random.PRNGKey(3))
+    engine = deepspeed_tpu.init_inference(
+        model=model, config={"dtype": "float32"}, params=params)
+    return model, engine
+
+
+def _stream(n, seed=0, rid0=0, smin=3, smax=14, new=(4, 6, 8), vocab=250,
+            sampled=False):
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for i in range(n):
+        sp = None
+        if sampled and i % 3:
+            sp = SamplingParams(temperature=(0.8, 1.2)[i % 2],
+                                top_k=int(rng.integers(4, 32))
+                                if i % 3 == 2 else 0,
+                                seed=100 + i)
+        reqs.append(Request(
+            rid=rid0 + i,
+            input_ids=rng.integers(1, vocab,
+                                   int(rng.integers(smin, smax))
+                                   ).astype(np.int32),
+            max_new_tokens=int(rng.choice(new)), sampling=sp))
+    return reqs
+
+
+def _prefix_stream(n, seed=1, rid0=0, sys_len=19, vocab=250, n_system=3):
+    """``n_system`` rotating shared system prompts + short unique tails:
+    sys_len 19 with page_size 8 = two full immutable pages + a COW
+    boundary page each, so the prompts OUTSIZE a small pool and whole
+    shared chunks demote AND promote back under pressure."""
+    rng = np.random.default_rng(seed)
+    systems = [rng.integers(1, vocab, sys_len).astype(np.int32)
+               for _ in range(n_system)]
+    return [Request(rid=rid0 + i,
+                    input_ids=np.concatenate(
+                        [systems[i % n_system],
+                         rng.integers(1, vocab, int(rng.integers(2, 6))
+                                      ).astype(np.int32)]),
+                    max_new_tokens=6)
+            for i in range(n)]
+
+
+# ------------------------------------------------ layout + row quantizer
+
+def test_kv_dtype_validation_and_layout(tiny):
+    model, _ = tiny
+    assert "int8" in KV_QUANT_DTYPES
+    with pytest.raises(ValueError):
+        model.init_paged_cache(num_pages=3, page_size=8, kv_dtype="int4")
+    cache = model.init_paged_cache(num_pages=5, page_size=8,
+                                   kv_dtype="int8")
+    assert cache["k"].dtype == jnp.int8 and cache["v"].dtype == jnp.int8
+    # scale rows: one f32 scale per (layer, page, slot) token row
+    assert cache["k_scale"].shape == cache["k"].shape[:3]
+    assert cache["v_scale"].dtype == jnp.float32
+    fp = model.init_paged_cache(num_pages=5, page_size=8)
+    assert "k_scale" not in fp and fp["k"].dtype == jnp.float32
+
+
+def test_kv_quantize_rows_roundtrip():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(6, 4, 16)).astype(np.float32))
+    q, scale = kv_quantize_rows(x)
+    assert q.dtype == jnp.int8 and scale.shape == (6,)
+    amax = np.abs(np.asarray(x)).max(axis=(1, 2))
+    np.testing.assert_allclose(np.asarray(scale), amax / 127.0, rtol=1e-6)
+    # symmetric round-to-nearest: per-row error bounded by scale/2
+    y = np.asarray(kv_dequantize(q, scale, jnp.float32))
+    err = np.abs(y - np.asarray(x)).max(axis=(1, 2))
+    assert (err <= amax / 127.0 * 0.5 + 1e-7).all()
+    # all-zero rows: scale folds to 1.0 (no div-by-zero), values exact
+    qz, sz = kv_quantize_rows(jnp.zeros((2, 4, 16)))
+    np.testing.assert_array_equal(np.asarray(sz), 1.0)
+    np.testing.assert_array_equal(np.asarray(qz), 0)
+
+
+# ------------------------------------------------------- byte accounting
+
+def test_pool_byte_math_and_kvq_gauges(tiny):
+    model, engine = tiny
+    c = model.config
+    L, hkv, hd = c.num_layers, c.kv_heads, c.dims_per_head
+    P, ps = 6, 8
+    payload = 2 * L * P * ps * hkv * hd          # int8: 1 byte/elt, k+v
+    scales = 2 * L * P * ps * 4                  # f32 scale rows, k+v
+    mon = InMemoryMonitor()
+    s = engine.serving(b_slots=2, page_size=ps, num_pages=P,
+                       max_model_len=32, kv_dtype="int8", monitor=mon)
+    h = s.health()
+    assert h["kv_dtype"] == "int8"
+    assert h["kv_pool_bytes_total"] == payload + scales
+    assert mon.latest("serve/kvq_enabled") == 1.0
+    assert mon.latest("serve/kvq_scale_bytes_total") == scales
+    assert mon.latest("serve/kvq_page_bytes") == (payload + scales) // P
+    del s
+    mon2 = InMemoryMonitor()
+    fp = engine.serving(b_slots=2, page_size=ps, num_pages=P,
+                        max_model_len=32, monitor=mon2)
+    hf = fp.health()
+    assert hf["kv_pool_bytes_total"] == payload * 4   # f32, no scales
+    assert mon2.latest("serve/kvq_enabled") == 0.0
+    assert mon2.latest("serve/kvq_scale_bytes_total") == 0.0
+    del fp
+
+
+def test_host_tier_bytes_sum_slab_tuples():
+    tier = HostTier(max_pages=4)
+    kv8 = np.zeros((2, 8, 4, 16), np.int8)
+    sc = np.zeros((2, 8), np.float32)
+    tier.put("q", kv8, kv8.copy(), sc, sc.copy())
+    q_bytes = 2 * kv8.nbytes + 2 * sc.nbytes
+    assert tier.bytes() == q_bytes
+    kvf = np.zeros((2, 8, 4, 16), np.float32)
+    tier.put("f", kvf, kvf.copy())
+    assert tier.bytes() == q_bytes + 2 * kvf.nbytes
+    # the transfer-byte win: an int8 page (payload + scales) is < half
+    # an fp32 page
+    assert q_bytes * 2 < 2 * kvf.nbytes
+    assert tier.pop("q") is not None   # bytes re-account on removal
+    assert tier.bytes() == 2 * kvf.nbytes
+    assert tier.get("f") is not None and tier.get("q") is None
+
+
+# ----------------------------------------------------- numerical parity
+
+def test_int8_greedy_token_exact_vs_fp(tiny):
+    """The measured exactness threshold: at the tiny config the per-row
+    int8 rounding never flips a greedy argmax, so the quantized engine
+    is token-identical to fp (docs/SERVING.md \"Quantized KV pages\" —
+    exactness is scale-dependent; serve_bench reports the distribution
+    at larger configs)."""
+    _, engine = tiny
+    fp = engine.serving(b_slots=3, page_size=8, max_model_len=64)
+    ref = {r.rid: r.output_ids for r in fp.run(_stream(10, seed=4))}
+    del fp
+    q = engine.serving(b_slots=3, page_size=8, max_model_len=64,
+                       kv_dtype="int8")
+    for r in q.run(_stream(10, seed=4)):
+        np.testing.assert_array_equal(r.output_ids, ref[r.rid])
+    del q
+
+
+def test_int8_sampled_distribution_vs_fp(tiny):
+    """Sampled lanes ride the same counter-based RNG on both engines, so
+    near-identical logits ⇒ near-identical streams: most requests match
+    token-for-token and the emitted-token histograms stay close in total
+    variation."""
+    _, engine = tiny
+    fp = engine.serving(b_slots=3, page_size=8, max_model_len=64)
+    ref = {r.rid: r.output_ids
+           for r in fp.run(_stream(12, seed=6, sampled=True))}
+    del fp
+    q = engine.serving(b_slots=3, page_size=8, max_model_len=64,
+                       kv_dtype="int8")
+    out = {r.rid: r.output_ids
+           for r in q.run(_stream(12, seed=6, sampled=True))}
+    del q
+    matched = total = 0
+    hist_fp, hist_q = {}, {}
+    for rid, toks in out.items():
+        rtoks = ref[rid]
+        n = min(len(toks), len(rtoks))
+        div = next((i for i in range(n) if toks[i] != rtoks[i]), n)
+        matched += div
+        total += len(rtoks)
+        for t in rtoks:
+            hist_fp[int(t)] = hist_fp.get(int(t), 0) + 1
+        for t in toks:
+            hist_q[int(t)] = hist_q.get(int(t), 0) + 1
+    assert matched / total >= 0.9, f"streams diverged: {matched}/{total}"
+    nf, nq = sum(hist_fp.values()), sum(hist_q.values())
+    tv = 0.5 * sum(abs(hist_fp.get(t, 0) / nf - hist_q.get(t, 0) / nq)
+                   for t in set(hist_fp) | set(hist_q))
+    assert tv <= 0.25, f"sampled token distribution drifted: TV={tv:.3f}"
+
+
+# ---------------------------------------- zero-recompile + inventory
+
+def test_int8_zero_recompile_inventory_tiered(tiny):
+    """The steady-state gates on the QUANTIZED engine under the full
+    serving surface: prefix sharing + COW (unaligned shared prompt),
+    tiering pool pressure (demote/promote), then ``recycle()`` and a
+    forced warm restart — program inventory bit-identical and zero
+    compiles throughout, page ledger balanced, host-tier bytes exact."""
+    _, engine = tiny
+    sup = engine.supervised_serving(b_slots=3, page_size=8,
+                                    max_model_len=64, kv_dtype="int8",
+                                    num_pages=10, host_tier_pages=8)
+    sup.run(_prefix_stream(8, rid0=0))          # warm (compiles)
+    sup.run(_prefix_stream(8, rid0=100))        # warm residual buckets
+    inv = sup.engine.program_inventory()
+    ref = {r.rid % 100: r.output_ids
+           for r in sup.run(_prefix_stream(8, rid0=200))}
+    n0 = _count()
+    results = sup.run(_prefix_stream(8, rid0=300))
+    assert _count() - n0 == 0, "int8 steady state recompiled"
+    assert sup.engine.program_inventory() == inv
+    for r in results:
+        np.testing.assert_array_equal(r.output_ids, ref[r.rid % 100])
+    h = sup.health()
+    assert h["demotions_total"] > 0 and h["promotions_total"] > 0, \
+        "no tier pressure — the gate did not exercise demote/promote"
+    assert h["cow_copies_total"] > 0
+    assert sup.engine.page_accounting()["balanced"]
+    assert h["host_tier_bytes"] == sup.engine._tier.bytes()
+
+    # recycle(): replacement engine adopts the programs — inventory and
+    # the zero-compile steady state survive, outputs stay exact
+    sup.drain(max_ticks=500)
+    sup.recycle()
+    n0 = _count()
+    for r in sup.run(_prefix_stream(8, rid0=400)):
+        np.testing.assert_array_equal(r.output_ids, ref[r.rid % 100])
+    assert _count() - n0 == 0, "recycle() recompiled int8 programs"
+    assert sup.engine.program_inventory() == inv
+
+    # forced warm restart mid-stream: programs carried, replay exact
+    inj = FaultInjector()
+    inj.add(site=SITE_SERVE_DECODE, kind="raise", at_call=2)
+    install_injector(inj)
+    try:
+        n0 = _count()
+        results = sup.run(_prefix_stream(8, rid0=500), max_ticks=5000)
+    finally:
+        clear_injector()
+    assert _count() - n0 == 0, "warm restart recompiled int8 programs"
+    assert sup.engine.program_inventory() == inv
+    for r in results:
+        np.testing.assert_array_equal(r.output_ids, ref[r.rid % 100])
+    assert sup.restarts == 1
+    assert sup.engine.page_accounting()["balanced"]
+    del sup
+
+
+def test_int8_update_params_flip_compiles_match_fp(tiny):
+    """The weight-epoch flip re-lowers the donated programs for the new
+    param buffers on BOTH layouts; the gate is that the quantized pools
+    tuple adds NO compiles beyond what the fp flip already costs."""
+    _, engine = tiny
+
+    def flip_compiles(kv_dtype):
+        s = engine.serving(b_slots=2, page_size=8, max_model_len=32,
+                           kv_dtype=kv_dtype)
+        s.run(_stream(4, seed=9, new=(4,)))
+        n0 = _count()
+        s.update_params(engine.params)
+        s.run(_stream(4, seed=9, rid0=100, new=(4,)))
+        d = _count() - n0
+        del s
+        return d
+
+    assert flip_compiles("int8") == flip_compiles(None)
+
+
+def test_int8_speculative_greedy_exact_zero_recompile(tiny):
+    from deepspeed_tpu.inference.speculative import (SpeculativeConfig,
+                                                     layer_skip_draft)
+
+    model, engine = tiny
+    plain = engine.serving(b_slots=2, page_size=8, max_model_len=64,
+                           kv_dtype="int8")
+    ref = {r.rid: r.output_ids for r in plain.run(_stream(6, seed=11))}
+    del plain
+    dm, dp = layer_skip_draft(model, engine.params, 1)
+    spec = engine.serving(
+        b_slots=2, page_size=8, max_model_len=64, kv_dtype="int8",
+        speculative=SpeculativeConfig(draft_model=dm, draft_params=dp,
+                                      k=3))
+    # the draft pool is quantized too: 4 slabs (k, v, k_scale, v_scale)
+    assert spec._spec.kv_dtype == "int8" and len(spec._spec.dpools) == 4
+    spec.run(_stream(6, seed=11, rid0=100))          # warm
+    n0 = _count()
+    results = spec.run(_stream(6, seed=11, rid0=200))
+    assert _count() - n0 == 0, "int8 speculative steady state recompiled"
+    for r in results:
+        np.testing.assert_array_equal(r.output_ids, ref[r.rid % 100])
+    assert spec.health()["spec_mean_accepted_len"] > 1.0
+    del spec
+
+
+# -------------------------------------------------------- composition
+
+def test_quantized_weights_compose_with_int8_kv():
+    """Satellite 6 (ISSUE 17): weight quantization (the engine shim) and
+    KV quantization are independent knobs that compose in ONE engine —
+    the shimmed ``apply_paged`` dequantizes the int8 WEIGHTS at program
+    entry while the pool stores int8 PAGES, and the composed engine is
+    token-identical to the same quantized-weights engine on an fp pool."""
+    model = CausalLM("tiny", dtype=jnp.float32, attn_impl="xla")
+    params = model.init_fn(jax.random.PRNGKey(7))
+    qeng = deepspeed_tpu.init_inference(
+        model=model, params=params,
+        config={"dtype": "float32", "quant": {"enabled": True}})
+    s_fp = qeng.serving(b_slots=2, page_size=8, max_model_len=48)
+    ref = {r.rid: r.output_ids
+           for r in s_fp.run(_stream(5, seed=13, new=(4, 6)))}
+    del s_fp
+    s_q = qeng.serving(b_slots=2, page_size=8, max_model_len=48,
+                       kv_dtype="int8")
+    assert s_q.health()["kv_dtype"] == "int8"
+    for r in s_q.run(_stream(5, seed=13, new=(4, 6))):
+        np.testing.assert_array_equal(r.output_ids, ref[r.rid])
+    del s_q, qeng
+
+
+# ------------------------------------------------------- pinned chaos
+
+@pytest.mark.chaos
+def test_serve_soak_short_deterministic_tiered_int8():
+    """The ISSUE 17 pinned seed: the seeded kill/replay soak under
+    tiering POOL PRESSURE on the QUANTIZED pool — the extended ledger
+    (free + quarantined + referenced + demoted) balances after every
+    audit and promoted int8 streams replay token-exactly against an
+    unkilled int8 reference (asserted inside ``run_serve_soak``)."""
+    tools = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         os.pardir, os.pardir, "tools")
+    sys.path.insert(0, tools)
+    try:
+        from chaos_soak import run_serve_soak
+    finally:
+        sys.path.remove(tools)
+    stats = run_serve_soak(seed=2, n_requests=10, verbose=False,
+                           host_tier_pages=8, num_pages=10,
+                           require_tier_cycles=True, kv_dtype="int8")
+    assert stats["kv_dtype"] == "int8"
+    assert stats["terminal"] == stats["submitted"] == 10
+    assert stats["demotions"] > 0 and stats["promotions"] > 0
+    assert stats["parity_checked"] >= 1

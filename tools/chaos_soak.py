@@ -171,7 +171,8 @@ def run_soak(seed: int, total_steps: int, ckpt_every: int, ckpt_dir: str,
 def run_serve_soak(seed: int, n_requests: int = 8, b_slots: int = 3,
                    verbose: bool = True, tp: int = 1,
                    host_tier_pages: int = None, num_pages: int = None,
-                   require_tier_cycles: bool = False) -> dict:
+                   require_tier_cycles: bool = False,
+                   kv_dtype: str = None) -> dict:
     """One supervised serving session under a seeded random kill schedule.
 
     ``tp > 1`` runs the WHOLE session on a ``tp``-device mesh (model axis =
@@ -191,6 +192,14 @@ def run_serve_soak(seed: int, n_requests: int = 8, b_slots: int = 3,
     re-balances on the replacement engine, which CARRIES the host tier).
     ``require_tier_cycles`` additionally asserts the schedule really
     demoted AND promoted (the tier-1 pinned seed uses it).
+
+    ``kv_dtype="int8"`` (ISSUE 17) runs BOTH the fault-free reference and
+    the supervised session on the QUANTIZED paged pool, so the parity
+    loop asserts that promoted int8 streams (half-byte host-tier slabs +
+    scale rows) replay token-exactly against an unkilled int8 engine —
+    quantization error never compounds across demote/promote/kill/replay
+    because pages move as raw int8 bytes, never round-tripping through
+    float (docs/SERVING.md "Quantized KV pages").
 
     The soak draws decode/prefill/replay kill points (and, half the time, a
     bounded queue + one dead-on-arrival deadline) from ``seed``, replays a
@@ -268,7 +277,8 @@ def run_serve_soak(seed: int, n_requests: int = 8, b_slots: int = 3,
     # fault-free reference (no injector installed yet; NO tiering — the
     # parity of the tiered run against an untiered reference is exactly
     # the promoted-prefix token-exactness invariant)
-    ref_serve = engine.serving(b_slots=b_slots, page_size=8, max_model_len=64)
+    ref_serve = engine.serving(b_slots=b_slots, page_size=8, max_model_len=64,
+                               kv_dtype=kv_dtype)
     ref = {r.rid: r.output_ids for r in ref_serve.run(copies())}
 
     # seeded random kill schedule.  The first decode kill lands early so a
@@ -290,7 +300,8 @@ def run_serve_soak(seed: int, n_requests: int = 8, b_slots: int = 3,
     try:
         sup = engine.supervised_serving(
             b_slots=b_slots, page_size=8, max_model_len=64,
-            max_queue=max_queue, max_restarts=12, **tier_kw)
+            max_queue=max_queue, max_restarts=12, kv_dtype=kv_dtype,
+            **tier_kw)
         results = sup.run(copies(deadline_rid), max_ticks=5000)
     finally:
         clear_injector()
@@ -352,12 +363,17 @@ def run_serve_soak(seed: int, n_requests: int = 8, b_slots: int = 3,
         assert h["mesh_devices"] == tp, \
             f"serve soak seed={seed}: mesh facts wrong: {h['mesh_devices']}"
         assert h["mesh_axes"].get("model") == tp, h["mesh_axes"]
-        assert h["kv_pool_bytes_per_device"] * tp \
-            == h["kv_pool_bytes_total"], \
-            f"serve soak seed={seed}: per-device pool bytes not 1/tp"
+        if kv_dtype is None:
+            # replicated scale planes break the exact 1/tp split on a
+            # quantized meshed pool (execution.pool_bytes docstring), so
+            # the equality is an fp-only invariant
+            assert h["kv_pool_bytes_per_device"] * tp \
+                == h["kv_pool_bytes_total"], \
+                f"serve soak seed={seed}: per-device pool bytes not 1/tp"
     stats = {
         "seed": seed,
         "tp": tp,
+        "kv_dtype": kv_dtype or "fp",
         "submitted": len(base),
         "terminal": len(by_rid),
         "parity_checked": parity_checked,
@@ -1624,6 +1640,11 @@ def main(argv=None) -> int:
     ap.add_argument("--pool_pages", type=int, default=14,
                     help="serve mode with --tier_pages: device pool size "
                          "(small = pool pressure)")
+    ap.add_argument("--kv_dtype", choices=("int8",), default=None,
+                    help="serve mode (ISSUE 17): run reference AND "
+                         "supervised session on the quantized paged pool "
+                         "— promoted int8 streams must replay token-"
+                         "exactly across the kill schedule")
     ap.add_argument("--hosts", type=int, default=4,
                     help="pod mode: simulated hosts per soak")
     ap.add_argument("--members", type=int, default=2,
@@ -1689,12 +1710,14 @@ def main(argv=None) -> int:
             print(f"serve soak {i + 1}/{args.soaks} (seed={seed}"
                   + (f", tp={args.tp}" if args.tp > 1 else "")
                   + (f", tier={args.tier_pages}" if args.tier_pages else "")
+                  + (f", kv={args.kv_dtype}" if args.kv_dtype else "")
                   + ")")
             try:
                 run_serve_soak(
                     seed, n_requests=args.requests, tp=args.tp,
                     host_tier_pages=args.tier_pages or None,
-                    num_pages=args.pool_pages if args.tier_pages else None)
+                    num_pages=args.pool_pages if args.tier_pages else None,
+                    kv_dtype=args.kv_dtype)
             # broad catch by design: RestartBudgetExhausted / ServeTimeout /
             # an escaped InjectedFault ARE the per-seed failure signal this
             # driver exists to tally — one bad seed must not kill the rest

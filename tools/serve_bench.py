@@ -38,6 +38,13 @@ the zero-recompile gate, and the extended page-accounting invariant
 through cycling + a forced warm restart + ``recycle()``
 (``tools/artifacts/serve_tiered_r14.json`` is the seeded CPU reference).
 
+``--kv_dtype int8`` (ISSUE 17) runs the prefix/tiered workloads on the
+QUANTIZED paged pool (int8 pages + per-page-row scales, dequant fused
+into the gather); the tiered run appends the ``kvq_vs_fp`` section —
+fp-vs-quantized page bytes (the effective-capacity ratio), hit rate at
+an equal HBM byte budget, and token parity against the fp baseline
+(``tools/artifacts/serve_kvq_r19.json`` is the seeded CPU reference).
+
 ``--workload sampled`` (ISSUE 9) drives a heterogeneous sampling-params
 stream (greedy / temperature / top-k / top-p lanes, per-request seeds)
 through the serving engine and checks PER-REQUEST parity against
@@ -204,7 +211,7 @@ def _build_bench_engine(base_cfg: str, max_model_len: int, on_tpu: bool,
 def run_prefix_bench(model_name: str = "llama-374m", b_slots: int = 4,
                      n_requests: int = 24, seed: int = 0,
                      page_size: int = 0, n_system: int = 2,
-                     max_model_len: int = 0) -> dict:
+                     max_model_len: int = 0, kv_dtype: str = None) -> dict:
     """Prefix-heavy serving benchmark (ISSUE 6 acceptance): the same seeded
     shared-prompt stream through a no-sharing engine (``prefix_cache=False``,
     the cold path) and a sharing engine, both supervised and warmed.
@@ -212,6 +219,11 @@ def run_prefix_bench(model_name: str = "llama-374m", b_slots: int = 4,
     Reports ``prefix_hit_rate`` on the measured (warm-index) pass, shared-
     vs-cold TTFT p50/p99, pages/tokens served from the index, and a
     token-exactness verdict of shared outputs against the no-sharing run.
+
+    ``kv_dtype="int8"`` (ISSUE 17) runs BOTH engines on the quantized
+    paged pool — the cold-vs-shared exactness gate then checks that prefix
+    reuse of quantized pages reproduces the no-sharing quantized outputs
+    bit-for-bit (dequantized gathers read the same int8 rows either way).
     """
     import numpy as np
 
@@ -238,7 +250,7 @@ def run_prefix_bench(model_name: str = "llama-374m", b_slots: int = 4,
     copies = lambda: _clone_requests(stream)          # noqa: E731
     count = compile_counter()
     kw = dict(b_slots=b_slots, page_size=page_size,
-              max_model_len=max_model_len)
+              max_model_len=max_model_len, kv_dtype=kv_dtype)
 
     # ---- cold path: prefix cache OFF (every request prefills from token 0)
     cold = engine.supervised_serving(prefix_cache=False, **kw)
@@ -293,6 +305,8 @@ def run_prefix_bench(model_name: str = "llama-374m", b_slots: int = 4,
             "n_system_prompts": n_system,
             "system_prompt_len": sys_len,
             "seed": seed,
+            "kv_dtype": h["kv_dtype"] or "fp",
+            "kv_pool_bytes_total": h["kv_pool_bytes_total"],
             "prefix_hit_rate": round(hit_rate, 4),
             "prompt_tokens_total": prompt_tokens,
             "shared_prefix_tokens_total": shared_tokens,
@@ -322,7 +336,8 @@ def run_tiered_bench(model_name: str = "llama-374m", b_slots: int = 2,
                      n_requests: int = 24, seed: int = 0,
                      page_size: int = 0, n_system: int = 6,
                      max_model_len: int = 0,
-                     host_tier_pages: int = 96) -> dict:
+                     host_tier_pages: int = 96,
+                     kv_dtype: str = None) -> dict:
     """KV-page tiering benchmark (ISSUE 11 acceptance): a prefix workload
     whose SHARED PREFIXES EXCEED the device pool capacity — ``n_system``
     rotating system prompts against a deliberately small HBM pool — run
@@ -337,7 +352,17 @@ def run_tiered_bench(model_name: str = "llama-374m", b_slots: int = 2,
     check on the measured pass, and the extended page-accounting invariant
     (device equation + demoted ledger) through the demote/promote cycling,
     a forced supervisor WARM RESTART, and a ``recycle()`` — both of which
-    carry the host tier to the replacement engine."""
+    carry the host tier to the replacement engine.
+
+    ``kv_dtype="int8"`` (ISSUE 17) runs the whole comparison on the
+    quantized paged pool AND appends a ``kvq_vs_fp`` section: an fp
+    tiered engine at the SAME page count fixes the baseline outputs and
+    the fp page bytes, the ratio of fp to quantized page bytes is the
+    effective-capacity multiplier (the acceptance gate wants >= 1.8x),
+    and a second quantized engine sized to the fp run's HBM BYTE budget
+    (so it holds ~ratio x as many pages) re-serves the stream — its hit
+    rate at equal bytes and its token parity against the fp baseline are
+    the quantized pool's headline win."""
     import numpy as np
 
     import jax
@@ -370,7 +395,8 @@ def run_tiered_bench(model_name: str = "llama-374m", b_slots: int = 2,
     copies = lambda s=None: _clone_requests(s or stream)      # noqa: E731
     count = compile_counter()
     kw = dict(b_slots=b_slots, page_size=page_size,
-              max_model_len=max_model_len, num_pages=num_pages)
+              max_model_len=max_model_len, num_pages=num_pages,
+              kv_dtype=kv_dtype)
 
     # ---- HBM-only: prefix cache on, NO host tier — pool pressure evicts
     hbm = engine.supervised_serving(**kw)
@@ -439,11 +465,96 @@ def run_tiered_bench(model_name: str = "llama-374m", b_slots: int = 2,
     tier_carried_on_restart = (sup.restart_log[-1]
                                .get("host_tier_entries_carried", 0)
                                if sup.restart_log else 0)
+    restarts_total = sup.restarts
+    total_tokens = sum(len(r.output_ids) for r in tier_results)
+
+    # ---- kvq_vs_fp (ISSUE 17): the quantized pool's capacity win at a
+    # fixed HBM byte budget.  An fp tiered engine at the SAME page count
+    # fixes the baseline outputs + fp page bytes; the fp:quantized
+    # page-byte ratio is the effective-capacity multiplier; a second
+    # quantized engine holding the fp run's BYTES (ratio x the pages)
+    # re-serves the stream for the equal-bytes hit rate + parity gates.
+    kvq = None
+    if kv_dtype:
+        tier_out = {r.rid: r.output_ids for r in tier_results}
+        del sup, tier_results         # release the measured int8 pool
+        q_page_bytes = h["kv_pool_bytes_total"] // num_pages
+        fp_kw = dict(kw)
+        fp_kw["kv_dtype"] = None
+        fp = engine.supervised_serving(host_tier_pages=host_tier_pages,
+                                       **fp_kw)
+        fp.run(copies())                             # warm
+        fp_results = fp.run(copies())                # fp baseline
+        fp_h = fp.health()
+        fp_out = {r.rid: r.output_ids for r in fp_results}
+        fp_hits = sum(r.shared_prefix_tokens > 0 for r in fp_results)
+        fp_page_bytes = fp_h["kv_pool_bytes_total"] // num_pages
+        del fp, fp_results            # release the fp pool
+        capacity_ratio = fp_page_bytes / q_page_bytes
+        # the fp pool's usable bytes re-spent on quantized pages
+        budget_pages = 1 + int((num_pages - 1) * capacity_ratio)
+        budget_kw = dict(kw)
+        budget_kw["num_pages"] = budget_pages
+        budget = engine.supervised_serving(host_tier_pages=host_tier_pages,
+                                           **budget_kw)
+        budget.run(copies())                         # warm
+        budget_results = budget.run(copies())        # equal-bytes measured
+        budget_h = budget.health()
+        budget_lat = sorted(budget.engine.tier_latencies()["promote_s"]) \
+            or [0.0]
+        budget_hits = sum(r.shared_prefix_tokens > 0
+                          for r in budget_results)
+        # the invariant gate: pool SIZE must never change quantized
+        # outputs — the equal-bytes run replays the same-pages run
+        # token-for-token (pure capacity effect, identical numerics)
+        size_invariant = all(
+            np.array_equal(r.output_ids, tier_out[r.rid])
+            for r in budget_results)
+        # fp parity is scale-dependent (int8 rounding can flip a greedy
+        # argmax once logit gaps shrink — docs/SERVING.md "Quantized KV
+        # pages"); report it as a distribution, exactness asserted at the
+        # measured tiny-config threshold in tests/unit/test_kv_quant.py
+
+        def _match_frac(a, b):
+            n = min(len(a), len(b))
+            div = next((i for i in range(n) if a[i] != b[i]), n)
+            return div / max(len(b), 1)
+
+        exact_n = sum(np.array_equal(r.output_ids, fp_out[r.rid])
+                      for r in budget_results)
+        match_fracs = [_match_frac(r.output_ids, fp_out[r.rid])
+                       for r in budget_results]
+        del budget, budget_results
+        kvq = {
+            "kv_dtype": kv_dtype,
+            "fp_page_bytes": fp_page_bytes,
+            "quantized_page_bytes": q_page_bytes,
+            "effective_capacity_ratio": round(capacity_ratio, 3),
+            "fp_pool_pages": num_pages,
+            "equal_bytes_quantized_pages": budget_pages,
+            "prefix_hit_rate_fp": round(fp_hits / n_requests, 4),
+            "prefix_hit_rate_quantized_same_pages": round(
+                tier_hits / n_requests, 4),
+            "prefix_hit_rate_quantized_equal_bytes": round(
+                budget_hits / n_requests, 4),
+            "host_tier_bytes_fp": fp_h["host_tier_bytes"],
+            "host_tier_bytes_quantized": h["host_tier_bytes"],
+            "host_tier_bytes_equal_bytes_run": budget_h["host_tier_bytes"],
+            "demotions_equal_bytes_run": budget_h["demotions_total"],
+            "promote_latency_p50_ms_equal_bytes": round(
+                _pct(budget_lat, 0.50) * 1e3, 3),
+            "promote_latency_p99_ms_equal_bytes": round(
+                _pct(budget_lat, 0.99) * 1e3, 3),
+            "token_exact_vs_quantized_same_pages": bool(size_invariant),
+            "token_exact_vs_fp_baseline": bool(exact_n == n_requests),
+            "token_exact_fraction_vs_fp": round(exact_n / n_requests, 4),
+            "match_prefix_frac_p50_vs_fp": round(
+                _pct(match_fracs, 0.50), 4),
+        }
 
     hit_rate_hbm = hbm_hits / n_requests
     hit_rate_tiered = tier_hits / n_requests
     promote_lat = sorted(lat["promote_s"]) or [0.0]
-    total_tokens = sum(len(r.output_ids) for r in tier_results)
     return {
         "metric": "serve-tiered",
         "value": round(hit_rate_tiered, 4),
@@ -462,6 +573,9 @@ def run_tiered_bench(model_name: str = "llama-374m", b_slots: int = 2,
             "n_system_prompts": n_system,
             "system_prompt_len": sys_len,
             "seed": seed,
+            "kv_dtype": h["kv_dtype"] or "fp",
+            "kv_pool_bytes_total": h["kv_pool_bytes_total"],
+            "page_bytes": h["kv_pool_bytes_total"] // num_pages,
             "prefix_hit_rate_tiered": round(hit_rate_tiered, 4),
             "prefix_hit_rate_hbm_only": round(hit_rate_hbm, 4),
             "prefix_evictions_hbm_only": hbm_h["prefix_evictions_total"],
@@ -484,9 +598,11 @@ def run_tiered_bench(model_name: str = "llama-374m", b_slots: int = 2,
             "recycle_demoted_before": demoted_before,
             "recycle_hits": recycle_hits,
             "recycle_token_exact": bool(recycle_exact),
-            "restart_count": sup.restarts,
+            "restart_count": restarts_total,
             "restart_tier_entries_carried": tier_carried_on_restart,
             "restart_token_exact": bool(restart_exact),
+            # --kv_dtype only: equal-HBM-bytes comparison vs the fp pool
+            "kvq_vs_fp": kvq,
         },
     }
 
@@ -1369,6 +1485,14 @@ def main(argv=None) -> int:
                          "promote vs HBM-only eviction (ISSUE 11)")
     ap.add_argument("--host_tier_pages", type=int, default=96,
                     help="tiered workload: host-RAM tier capacity in pages")
+    ap.add_argument("--kv_dtype", choices=("int8",), default=None,
+                    help="prefix/tiered workloads (ISSUE 17): store the "
+                         "paged KV pool quantized (per-page-row scales, "
+                         "dequant fused into the gather).  tiered adds "
+                         "the kvq_vs_fp section — effective-capacity "
+                         "ratio, equal-HBM-bytes hit rate, token parity "
+                         "vs the fp baseline (docs/SERVING.md "
+                         "\"Quantized KV pages\")")
     ap.add_argument("--speculative", action="store_true",
                     help="sampled workload: add the verify-k section "
                          "(layer-skip draft) — mean accepted length, "
@@ -1410,6 +1534,11 @@ def main(argv=None) -> int:
                          "serve.* spans appear as TraceAnnotations on the "
                          "device timeline (docs/OBSERVABILITY.md)")
     args = ap.parse_args(argv)
+    if args.kv_dtype and (args.mode != "engine"
+                          or args.workload not in ("prefix", "tiered")
+                          or args.tp):
+        ap.error("--kv_dtype benches the quantized paged pool on the "
+                 "prefix and tiered workloads (--workload prefix|tiered)")
     if args.collect_traces and args.mode != "fleet":
         ap.error("--collect_traces assembles a FLEET trace — use "
                  "--mode fleet (single-engine runs want --trace)")
@@ -1529,7 +1658,8 @@ def main(argv=None) -> int:
             page_size=args.page_size if args.page_size is not None else 0,
             n_system=args.n_system if args.n_system is not None else 6,
             max_model_len=args.max_model_len,
-            host_tier_pages=args.host_tier_pages)
+            host_tier_pages=args.host_tier_pages,
+            kv_dtype=args.kv_dtype)
         line = json.dumps(result)
         print(line)
         if args.out:
@@ -1542,6 +1672,13 @@ def main(argv=None) -> int:
               and d["invariant_balanced_all_phases"]
               and d["recycle_token_exact"] and d["restart_token_exact"]
               and d["promotions_total"] > 0 and d["demotions_total"] > 0)
+        if args.kv_dtype:
+            kvq = d["kvq_vs_fp"]
+            ok = ok and kvq is not None \
+                and kvq["effective_capacity_ratio"] >= 1.8 \
+                and kvq["token_exact_vs_quantized_same_pages"] \
+                and (kvq["prefix_hit_rate_quantized_equal_bytes"]
+                     >= kvq["prefix_hit_rate_fp"])
         return 0 if ok else 1
     if args.workload == "prefix":
         if args.trace or args.device_trace:
@@ -1563,7 +1700,7 @@ def main(argv=None) -> int:
             seed=args.seed,
             page_size=args.page_size if args.page_size is not None else 0,
             n_system=args.n_system if args.n_system is not None else 2,
-            max_model_len=args.max_model_len)
+            max_model_len=args.max_model_len, kv_dtype=args.kv_dtype)
         line = json.dumps(result)
         print(line)
         if args.out:
