@@ -86,6 +86,18 @@ class PodRendezvousTimeout(PodCoordinationError):
     """Rendezvous did not reach the expected membership in time."""
 
 
+class StoreUnavailable(PodCoordinationError):
+    """The coordination store is unreachable for THIS client — a
+    blackout/partition, or a retry discipline that exhausted its
+    deadline.  The graceful-degradation signal, not a retry signal:
+    clients catch it and degrade (a member daemon buffers its outbox
+    and keeps decoding, a router parks admission, a watchdog counts a
+    failed scan instead of declaring peers dead) rather than spinning
+    against a store that is gone.  :class:`StoreRetryPolicy` NEVER
+    retries it — transient flakiness is ``OSError``; this is "stop
+    asking" (docs/FLEET.md "Store brownouts and partitions")."""
+
+
 class CoordinationStore:
     """Namespaced key -> JSON document store with atomic replace.
 
@@ -205,6 +217,11 @@ class FileCoordinationStore(CoordinationStore):
         # (the fleet/store_cas_contended_total gauge): N routers racing
         # one key show up here long before latency does
         self.cas_contended_total = 0
+        # torn/corrupt documents quarantined aside by get() (the
+        # store/corrupt_docs_total gauge): every one of these is a
+        # writer that bypassed the tmp+rename discipline (or storage
+        # corruption) — it must be visible, never silently "absent"
+        self.corrupt_docs_total = 0
 
     def _path(self, key: str) -> str:
         key = key.strip("/")
@@ -226,11 +243,44 @@ class FileCoordinationStore(CoordinationStore):
                 return json.load(f)
         except FileNotFoundError:
             return None
-        except (OSError, ValueError) as e:
-            # a half-visible write on flaky network storage reads as absent,
-            # not as a crash — callers poll and will see the committed value
-            logger.warning("coordination store: unreadable key %s (%s)",
-                           key, e)
+        except ValueError as e:
+            # TORN/CORRUPT document (our own writes are tmp+atomic-rename,
+            # so this is a foreign writer that skipped the discipline, or
+            # real storage corruption).  Silently reading it as "absent"
+            # used to let a CAS create clobber whatever the key held and
+            # made torn-write-recovered indistinguishable from lost —
+            # quarantine the bytes aside (numbered, never clobbering an
+            # earlier quarantine), count it, and ONLY then report absent:
+            # the checker and the gauge can now tell the two apart.
+            self.corrupt_docs_total += 1
+            quarantined = self._quarantine_corrupt(path)
+            logger.error(
+                "coordination store: corrupt document at key %s (%s); "
+                "quarantined to %s", key, e, quarantined or "<unmovable>")
+            return None
+        except OSError as e:
+            # the backend itself failed (not "no such key"): this client
+            # cannot tell what the key holds, and "absent" would be a
+            # LIE that cascades — a lease scan would declare live peers
+            # dead, a CAS create would fence-break.  Degrade typed.
+            raise StoreUnavailable(
+                f"coordination store: backend read of {key!r} failed "
+                f"({e})") from e
+
+    @staticmethod
+    def _quarantine_corrupt(path: str) -> Optional[str]:
+        """Move a corrupt document aside as ``<path>.corrupt[.N]`` (the
+        numbered-collision discipline of ``integrity.quarantine_tag``);
+        returns the quarantine path, or None when the rename failed."""
+        dst = path + ".corrupt"
+        n = 0
+        while os.path.exists(dst):
+            n += 1
+            dst = f"{path}.corrupt.{n}"
+        try:
+            os.replace(path, dst)
+            return dst
+        except OSError:   # pragma: no cover - racing quarantines
             return None
 
     def _acquire_lock(self, key: str, path: str,
@@ -377,15 +427,18 @@ class FileCoordinationStore(CoordinationStore):
         except (FileNotFoundError, NotADirectoryError):
             return []
         # tmp siblings, CAS lock files (incl. `<key>.lock.stale.*`
-        # rename-steal remnants of a waiter that died mid-steal) and
-        # compare-delete tombstones are write-protocol artifacts, never
+        # rename-steal remnants of a waiter that died mid-steal),
+        # compare-delete tombstones and quarantined corrupt documents
+        # (`<key>.corrupt[.N]`) are write-protocol artifacts, never
         # documents.  Match the exact artifact shapes, not a bare ".lock"
         # substring — a legitimate id like "db.lockhart-3" must stay
         # visible to lease/dead scans.
         return sorted(n for n in names
                       if ".tmp." not in n and not n.endswith(".lock")
                       and ".lock.stale." not in n
-                      and not n.endswith(".tomb"))
+                      and not n.endswith(".tomb")
+                      and not n.endswith(".corrupt")
+                      and ".corrupt." not in n)
 
     def delete(self, key: str) -> None:
         try:
@@ -395,6 +448,103 @@ class FileCoordinationStore(CoordinationStore):
 
     def now(self) -> float:
         return self._clock() if self._clock is not None else time.time()
+
+
+# ------------------------------------------------------------- retry policy
+
+# process-wide count of store-op retries taken through StoreRetryPolicy
+# (CAS losses re-attempted + transient errors absorbed) — the single
+# number behind the fleet/store_retries_total gauge, whatever mix of
+# policy instances a process runs
+_STORE_RETRIES_LOCK = threading.Lock()
+_STORE_RETRIES_TOTAL = 0
+
+
+def store_retries_total() -> int:
+    """Process-wide retries taken by every :class:`StoreRetryPolicy`
+    (the ``fleet/store_retries_total`` gauge reads this)."""
+    return _STORE_RETRIES_TOTAL
+
+
+class StoreRetryPolicy:
+    """The one retry discipline for store protocol loops: jittered
+    exponential backoff under a wall-clock deadline, store-agnostic.
+
+    Replaces the ad-hoc bare ``while True`` CAS loops that used to live
+    in :func:`bump_generation`, :func:`channel_append`, the journal
+    flush and the partition claims — each of which would spin forever
+    (and hot) against a store that stopped answering.  Two retryable
+    outcomes, one terminal one:
+
+    - the attempt returns :data:`RETRY` (a lost CAS: re-read, try
+      again) — retried with backoff;
+    - the attempt raises ``OSError`` (transient backend flakiness,
+      injected or real) — retried with backoff;
+    - the attempt raises :class:`StoreUnavailable` (blackout/partition)
+      — **propagated immediately**: a dark store must fail FAST into
+      the caller's degradation path (outbox, parked admission), not
+      stall it for the full deadline.
+
+    Past ``deadline_s`` of wall time the policy raises
+    :class:`StoreUnavailable` itself — the per-op deadline wrapper the
+    degradation contracts are written against.  Every retry counts into
+    :func:`store_retries_total` and the instance's ``retries_total``.
+    """
+
+    RETRY = object()   # sentinel an attempt returns to request another try
+
+    def __init__(self, deadline_s: float = 10.0, base_s: float = 0.0005,
+                 cap_s: float = 0.02, seed: Optional[int] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.deadline_s = float(deadline_s)
+        self.base_s = float(base_s)
+        self.cap_s = float(cap_s)
+        self.retries_total = 0
+        self._rng = random.Random(seed)
+        self._sleep = sleep
+
+    def run(self, what: str, attempt: Callable[[], object]):
+        """Call ``attempt()`` until it returns a non-:data:`RETRY` value
+        (returned), raising :class:`StoreUnavailable` at the deadline."""
+        global _STORE_RETRIES_TOTAL
+        deadline = time.monotonic() + self.deadline_s
+        attempts = 0
+        err: Optional[BaseException] = None
+        while True:
+            try:
+                out = attempt()
+                if out is not StoreRetryPolicy.RETRY:
+                    return out
+            except StoreUnavailable:
+                raise
+            except OSError as e:
+                err = e
+            attempts += 1
+            self.retries_total += 1
+            with _STORE_RETRIES_LOCK:
+                _STORE_RETRIES_TOTAL += 1
+            if time.monotonic() >= deadline:
+                raise StoreUnavailable(
+                    f"{what}: no successful store op within "
+                    f"{self.deadline_s:.1f}s ({attempts} attempt(s); "
+                    f"last error: {err!r})") from err
+            # full jitter on an exponentially growing ceiling — the same
+            # shape as the file store's lock spin, but store-agnostic
+            cap = min(self.cap_s, self.base_s * (1 << min(attempts, 6)))
+            self._sleep(self._rng.uniform(0.0, cap))
+
+
+_DEFAULT_RETRY: Optional[StoreRetryPolicy] = None
+
+
+def default_retry_policy() -> StoreRetryPolicy:
+    """The module-shared policy behind :func:`bump_generation`,
+    :func:`record_dead`, :func:`channel_append` and friends — one
+    instance, so the protocol helpers stay zero-config."""
+    global _DEFAULT_RETRY
+    if _DEFAULT_RETRY is None:
+        _DEFAULT_RETRY = StoreRetryPolicy()
+    return _DEFAULT_RETRY
 
 
 # --------------------------------------------------------------- heartbeats
@@ -474,14 +624,20 @@ def record_dead(store: CoordinationStore, host_id: str, generation: int,
     reporters commit exactly one marker per generation — the FIRST
     reporter wins, and a marker from an equal-or-newer generation is never
     clobbered by a stale scanner still looking at an old epoch."""
+    key = f"{prefix}/{host_id}"
     doc = {"host_id": host_id, "generation": int(generation),
            "reported_by": reported_by, "t": store.now()}
-    while True:
-        cur = store.get(f"{prefix}/{host_id}")
-        if cur is not None and int(cur.get("generation", -1)) >= int(generation):
-            return
-        if store.compare_and_swap(f"{prefix}/{host_id}", cur, doc):
-            return
+
+    def attempt():
+        cur = store.get(key)
+        if cur is not None \
+                and int(cur.get("generation", -1)) >= int(generation):
+            return None
+        if store.compare_and_swap(key, cur, doc):
+            return None
+        return StoreRetryPolicy.RETRY
+
+    default_retry_policy().run(f"record_dead({host_id!r})", attempt)
 
 
 def dead_set(store: CoordinationStore, prefix: str = "dead") -> List[str]:
@@ -665,11 +821,13 @@ def append_trace_segment(store: CoordinationStore, owner_id: str,
     stamp monotonic t0s (immune to wall steps but process-local), and the
     anchor is what lets ``observability/trace_assembly.py`` place every
     process's spans on ONE shared epoch timeline with per-process skew
-    correction.  The write is a CAS loop (single writer per owner in
-    practice — contention can only be a dying predecessor's last append),
-    mirroring ``record_dead``/``bump_generation``."""
+    correction.  The write retries through :class:`StoreRetryPolicy`
+    (single writer per owner in practice — contention can only be a
+    dying predecessor's last append), mirroring
+    ``record_dead``/``bump_generation``."""
     key = f"{prefix}/{owner_id}"
-    while True:
+
+    def attempt():
         cur = store.get(key)
         merged = list((cur or {}).get("spans") or ())
         merged.extend(spans)
@@ -685,6 +843,10 @@ def append_trace_segment(store: CoordinationStore, owner_id: str,
                "t": store.now()}
         if store.compare_and_swap(key, cur, doc):
             return doc
+        return StoreRetryPolicy.RETRY
+
+    return default_retry_policy().run(
+        f"append_trace_segment({owner_id!r})", attempt)
 
 
 def read_trace_segments(store: CoordinationStore,
@@ -718,9 +880,11 @@ def channel_append(store: CoordinationStore, key: str, payload: Dict,
     sequence number.  Past ``max_items`` entries (or ``max_bytes`` of
     serialized items) the OLDEST entries drop and the ``dropped`` counter
     grows — one wedged consumer can never grow a producer's document
-    unboundedly.  CAS loop, mirroring :func:`append_trace_segment`."""
+    unboundedly.  Retries through :class:`StoreRetryPolicy`, mirroring
+    :func:`append_trace_segment`."""
     maybe_fire(SITE_FLEET_CHANNEL, key=key)
-    while True:
+
+    def attempt():
         cur = store.get(key)
         items = [list(e) for e in ((cur or {}).get("items") or ())]
         seq = int((cur or {}).get("seq") or 0) + 1
@@ -736,6 +900,9 @@ def channel_append(store: CoordinationStore, key: str, payload: Dict,
                "dropped": dropped, "t": store.now()}
         if store.compare_and_swap(key, cur, doc):
             return seq
+        return StoreRetryPolicy.RETRY
+
+    return default_retry_policy().run(f"channel_append({key!r})", attempt)
 
 
 def channel_consume(store: CoordinationStore, key: str,
@@ -746,7 +913,7 @@ def channel_consume(store: CoordinationStore, key: str,
     the loop re-reads.  Each item is claimed by exactly one consumer;
     ``consumer_id`` is stamped on the truncated document so an operator
     can see who drained it last."""
-    while True:
+    def attempt():
         cur = store.get(key)
         if cur is None or not cur.get("items"):
             return []
@@ -755,6 +922,9 @@ def channel_consume(store: CoordinationStore, key: str,
                "consumer": str(consumer_id), "t": store.now()}
         if store.compare_and_swap(key, cur, new):
             return [(int(s), p) for s, p in cur["items"]]
+        return StoreRetryPolicy.RETRY
+
+    return default_retry_policy().run(f"channel_consume({key!r})", attempt)
 
 
 def channel_stats(store: CoordinationStore, key: str) -> Dict[str, int]:
@@ -776,16 +946,21 @@ def read_generation(store: CoordinationStore, key: str = "generation") -> int:
 
 def bump_generation(store: CoordinationStore, key: str = "generation") -> int:
     """Advance the generation and return the value THIS caller committed.
-    A CAS loop: each concurrent bumper wins exactly one distinct round —
-    two supervisor processes racing (or a deposed coordinator racing its
-    successor) can no longer lose an update or tear the counter.  The
-    returned value is strictly monotonic across all winners."""
-    while True:
+    A retried CAS (:class:`StoreRetryPolicy`): each concurrent bumper
+    wins exactly one distinct round — two supervisor processes racing
+    (or a deposed coordinator racing its successor) can no longer lose
+    an update or tear the counter.  The returned value is strictly
+    monotonic across all winners."""
+    def attempt():
         doc = store.get(key)
         gen = int(doc["generation"]) if doc else 0
         if store.compare_and_swap(key, doc,
-                                  {"generation": gen + 1, "t": store.now()}):
+                                  {"generation": gen + 1,
+                                   "t": store.now()}):
             return gen + 1
+        return StoreRetryPolicy.RETRY
+
+    return default_retry_policy().run(f"bump_generation({key!r})", attempt)
 
 
 # ----------------------------------------------------- coordinator election
@@ -937,7 +1112,8 @@ class HeartbeatWatchdog:
                  miss_limit: int = 3,
                  on_peer_dead: Optional[Callable[[str], None]] = None,
                  monitor=None, grace_beats: int = 3,
-                 renew_s: Optional[float] = None, advertise: bool = True):
+                 renew_s: Optional[float] = None, advertise: bool = True,
+                 store_fail_grace: int = 3):
         self.store = store
         self.host_id = host_id
         self.generation = int(generation)
@@ -960,6 +1136,19 @@ class HeartbeatWatchdog:
         self._last_advert_t: Optional[float] = None   # store clock
         self.dead: List[str] = []
         self.beats = 0
+        # store-failure escalation (docs/FLEET.md "Store brownouts and
+        # partitions"): consecutive renew/scan rounds that failed on the
+        # STORE (not on a peer).  Below `store_fail_grace` it is a logged
+        # brownout; at the grace it escalates to the
+        # pod/store_unreachable gauge + a flight-recorder note.  Peers
+        # are NEVER declared dead from a failed scan — "my store view is
+        # broken" and "that host stopped beating" are different facts —
+        # and after a heal one clean scan runs declaration-free (the
+        # peers' beats may have been dark through the same partition).
+        self.store_fail_grace = int(store_fail_grace)
+        self.store_fail_streak = 0
+        self.store_failures_total = 0
+        self.store_unreachable = False
         self._attrs: Dict = {}
         self._started_at: Optional[float] = None   # store clock, at start()
         # beat_once() runs on BOTH the renew daemon and the training step
@@ -1019,14 +1208,70 @@ class HeartbeatWatchdog:
     def _loop(self) -> None:
         # renew well inside the lease so one slow write never costs it
         while not self._stop.wait(self.renew_s):
-            try:
-                self.beat_once()
-                if not self.dead:
-                    self._scan()
-            except Exception as e:   # the watchdog must outlive flaky storage
-                logger.warning("pod heartbeat: %s: %s", type(e).__name__, e)
+            self.tick_once()
 
-    def _scan(self) -> None:
+    def tick_once(self) -> None:
+        """One renew+scan round with the store-failure escalation —
+        factored off the daemon loop so deterministic tests (and
+        cooperative harnesses) can drive it without threads."""
+        try:
+            healed = self.store_fail_streak > 0
+            self.beat_once()
+            if not self.dead:
+                # the first clean scan after a heal observes but does
+                # not declare: peers whose beats were dark through the
+                # same partition get one round to land a fresh lease
+                self._scan(declare=not healed)
+            self._note_store_ok(healed)
+        except (StoreUnavailable, OSError, PodCoordinationError) as e:
+            # the STORE failed this round, not a peer: count toward the
+            # escalation grace, never toward any dead declaration
+            self._note_store_failure(e)
+        except Exception as e:   # the watchdog must outlive flaky storage
+            logger.warning("pod heartbeat: %s: %s", type(e).__name__, e)
+
+    def _note_store_ok(self, healed: bool) -> None:
+        if not healed and not self.store_unreachable:
+            return
+        if healed:
+            logger.info(
+                "pod heartbeat[%s]: store reachable again after %d "
+                "failed round(s)", self.host_id, self.store_fail_streak)
+        self.store_fail_streak = 0
+        if self.store_unreachable:
+            self.store_unreachable = False
+            if self.monitor is not None:
+                self.monitor.write_events([
+                    ("pod/store_unreachable", 0.0, self.beats)])
+
+    def _note_store_failure(self, err: BaseException) -> None:
+        self.store_fail_streak += 1
+        self.store_failures_total += 1
+        logger.warning(
+            "pod heartbeat[%s]: store op failed (%s: %s) — streak %d/%d; "
+            "no peer is declared dead from a failed scan", self.host_id,
+            type(err).__name__, err, self.store_fail_streak,
+            self.store_fail_grace)
+        if self.store_fail_streak < self.store_fail_grace \
+                or self.store_unreachable:
+            return
+        self.store_unreachable = True
+        if self.monitor is not None:
+            self.monitor.write_events([
+                ("pod/store_unreachable", 1.0, self.beats)])
+        from ..observability.trace import trace_count
+
+        # flight-recorder note: the escalation shows up in crash dumps
+        # and trace exports even when no scraper watches the gauge
+        trace_count("pod.store_unreachable", 1.0, host=self.host_id,
+                    streak=self.store_fail_streak)
+        logger.error(
+            "pod heartbeat[%s]: %d consecutive store failures — this "
+            "host's STORE VIEW is unreachable (escalating the "
+            "pod/store_unreachable gauge); peer liveness is unknown, "
+            "not absent", self.host_id, self.store_fail_streak)
+
+    def _scan(self, declare: bool = True) -> None:
         # the "never beat at all" check needs BOTH grace gates: our own
         # renewal count AND miss_limit lease periods of STORE-CLOCK time
         # since start() — a peer still inside device init (its watchdog not
@@ -1065,6 +1310,16 @@ class HeartbeatWatchdog:
                         self.store, self.monitor, tick=self.beats,
                         max_age_s=self.miss_limit * self.lease_s)
         if not dead:
+            return
+        if not declare:
+            # post-heal observation round: the peers' beats may have been
+            # dark through the SAME partition we just recovered from, so
+            # what looks lapsed gets one renew interval to land a fresh
+            # lease before any durable declaration
+            logger.warning(
+                "pod heartbeat[%s]: host(s) %s look lapsed on the first "
+                "scan after a store heal — withholding declaration for "
+                "one round", self.host_id, dead)
             return
         self.dead = dead
         for host in dead:

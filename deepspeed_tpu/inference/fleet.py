@@ -96,12 +96,15 @@ import numpy as np
 
 from collections import deque
 
-from ..elasticity.coordination import (CoordinationStore, beat,
+from ..elasticity.coordination import (CoordinationStore, StoreRetryPolicy,
+                                       StoreUnavailable, beat,
                                        bump_generation, clear_dead, dead_set,
-                                       dedup_drop_totals, elect_coordinator,
+                                       dedup_drop_totals,
+                                       default_retry_policy,
+                                       elect_coordinator,
                                        lease_table, process_src,
                                        publish_residency, read_generation,
-                                       record_dead)
+                                       record_dead, store_retries_total)
 from ..observability.slo import SloEvaluator, SloRule
 from ..observability.trace import (get_tracer, new_trace_id, trace_span,
                                    trace_tags)
@@ -725,6 +728,26 @@ class FleetRouter:
         self._flip_params = None
         self._flip_hold: List[Tuple[Request, bool]] = []
         self.epoch_flips_total = 0
+        # ---- store-partition tolerance (docs/FLEET.md "Store brownouts
+        # and partitions").  self_fenced: this router believes it leads
+        # but its own lease renewal has not succeeded within lease_s —
+        # it must go QUIET (no dispatch, no journal flush, no GC) until a
+        # successful election poll re-reads its leadership, because a
+        # successor may already be serving the journal it still mirrors.
+        # _renewal_ok_t: store-clock stamp of the last successful own-
+        # lease renewal (the fence deadline's anchor).  _parked: requests
+        # admission accepted but could not durably journal/dispatch while
+        # the store was dark — retried every healthy coordinator round.
+        # _pending_gc: journal entries whose terminal result landed but
+        # whose fenced compare-delete could not reach the store.
+        self.self_fenced = False
+        self._renewal_ok_t: Optional[float] = None   # store clock
+        self._parked: deque = deque()
+        self._pending_gc: set = set()
+        self.parked_total = 0
+        self.fences_total = 0
+        self.dispatches_total = 0
+        self.store_unavailable_total = 0
         epoch_doc = store.get(FLEET_EPOCH_KEY)
         self.fleet_epoch = int((epoch_doc or {}).get("epoch") or 0)
 
@@ -773,7 +796,19 @@ class FleetRouter:
             # dispatched) — a future arrival must survive coordinator
             # death like any dispatched request, or the standby would
             # adopt an empty journal and silently drop it
-            self._journal(rid, request, None, create=True)
+            try:
+                self._journal(rid, request, None, create=True)
+            except (StoreUnavailable, OSError) as e:
+                # degraded acceptance (docs/FLEET.md "Store brownouts and
+                # partitions"): the arrival is tracked and will be
+                # journaled at dispatch (the route-time create heals it),
+                # but a coordinator death before then loses it — logged,
+                # never silent
+                self.store_unavailable_total += 1
+                logger.warning(
+                    "fleet: accepted %r without a durable journal entry "
+                    "(store unavailable: %s); it will be journaled at "
+                    "dispatch", rid, e)
             bisect.insort(self._later, request, key=lambda r: r.arrival_time)
             return rid
         self._route(request)
@@ -846,22 +881,29 @@ class FleetRouter:
                 "term": 0,
                 "t": self.store.now()}
             key = f"{FLEET_REQUESTS_PREFIX}/{_rid_key(rid)}"
+
             # same create-retry shape as the coordinator's submission-time
             # journal write: a pre-existing document for a rid this router
-            # just accepted can only be an orphan of a previous run
-            while True:
+            # just accepted can only be an orphan of a previous run.  The
+            # retry loop rides StoreRetryPolicy, so a store that stays
+            # dark surfaces as a typed StoreUnavailable to the admission
+            # caller (honest backpressure) instead of spinning forever.
+            def _attempt():
                 cur = self.store.get(key)
                 if self.store.compare_and_swap(key, cur, doc):
                     if cur is not None:
                         logger.warning(
                             "fleet: admission entry for %r was an orphan "
                             "of a previous run; overwritten", rid)
-                    break
+                    return True
                 if cur is None and self.store.get(key) is None:
                     # a compare-delete tombstone of a COLLECTED previous
                     # stream with this rid blocks the create: a fresh
                     # admission is a new stream by contract — clear it
                     self.store.clear_tombstone(key)
+                return StoreRetryPolicy.RETRY
+
+            default_retry_policy().run(f"admit({rid!r})", _attempt)
         self.partition_admissions_total += 1
         return rid
 
@@ -1016,12 +1058,15 @@ class FleetRouter:
                 f"{self.fleet_epoch}")
         doc = {"epoch": target, "coordinator": self.router_id,
                "term": int(self.term), "t": self.store.now()}
-        while True:
+        def _attempt():
             cur = self.store.get(FLEET_EPOCH_FLIP_KEY)
             if self.store.compare_and_swap(FLEET_EPOCH_FLIP_KEY, cur, doc):
-                break
+                return True
             if cur is None and self.store.get(FLEET_EPOCH_FLIP_KEY) is None:
                 self.store.clear_tombstone(FLEET_EPOCH_FLIP_KEY)
+            return StoreRetryPolicy.RETRY
+
+        default_retry_policy().run("begin_epoch_flip", _attempt)
         self._flip = doc
         self._flip_params = params
         log_dist(f"fleet: weight-epoch flip to {target} started "
@@ -1053,13 +1098,17 @@ class FleetRouter:
                 return   # still draining; routing stays held
             commit = {"epoch": target, "coordinator": self.router_id,
                       "term": int(self.term), "t": self.store.now()}
-            while True:
+
+            def _attempt():
                 cur = self.store.get(FLEET_EPOCH_KEY)
                 if cur is not None and int(cur.get("epoch") or 0) >= target:
-                    break   # a racing coordinator committed past us
+                    return True   # a racing coordinator committed past us
                 if self.store.compare_and_swap(FLEET_EPOCH_KEY, cur,
                                                commit):
-                    break
+                    return True
+                return StoreRetryPolicy.RETRY
+
+            default_retry_policy().run("commit_epoch", _attempt)
         if self.store.compare_and_delete(FLEET_EPOCH_FLIP_KEY, self._flip):
             # the tombstone fenced the dead coordinator's stale flip doc,
             # not future flips — clear it so the next begin_ can create
@@ -1203,6 +1252,13 @@ class FleetRouter:
         is never shed by its own recovery — the same contract the serving
         supervisor holds for replays."""
         rid = request.rid
+        if self.self_fenced:
+            # fence first, flip-hold second: a fenced router must not
+            # dispatch AT ALL — a successor may own this very rid —
+            # so the request parks until a successful election poll
+            # re-reads leadership (docs/FLEET.md "Store brownouts")
+            self._park(request, requeue, "self-fenced")
+            return
         if self._flip is not None:
             # weight-epoch admission gate: nothing dispatches while the
             # fleet flips (members must drain to flip, and a dispatch
@@ -1258,13 +1314,32 @@ class FleetRouter:
         # is already completing (duplicate terminal result).  Only a
         # non-requeue dispatch (fresh submission / adopted parked arrival)
         # may CREATE the journal entry.
-        if not self._journal(rid, request, target, create=not requeue):
+        try:
+            owned = self._journal(rid, request, target, create=not requeue)
+        except (StoreUnavailable, OSError) as e:
+            # the store is dark: dispatching WITHOUT the durable record
+            # would make this stream invisible to any successor (lost on
+            # the next failover) — park it and retry when the store heals
+            self.store_unavailable_total += 1
+            self._park(request, requeue, f"store unavailable: {e}")
+            return
+        if not owned:
             logger.warning(
                 "fleet: skipping dispatch of %r — journal ownership lost "
                 "to a successor coordinator, which now drives it", rid)
             return
         member.submit(sub)
         self._owner[rid] = target
+        self.dispatches_total += 1
+
+    def _park(self, request: Request, requeue: bool, why: str) -> None:
+        """Park admission instead of crashing (or worse, dispatching
+        un-journaled): the request stays tracked in ``_requests`` and is
+        re-routed on the next healthy, un-fenced coordinator round."""
+        self._parked.append((request, requeue))
+        self.parked_total += 1
+        logger.warning("fleet: parking %r (%s); %d parked",
+                       request.rid, why, len(self._parked))
 
     def _shed(self, request: Request, why: str) -> None:
         t = time.monotonic()
@@ -1360,8 +1435,11 @@ class FleetRouter:
             # un-journaled (flush never creates).  Retry the create
             # against each freshly read value until our document lands
             # (same loop shape as bump_generation; contention here can
-            # only be the dying orphan writer's last flushes).
-            while True:
+            # only be the dying orphan writer's last flushes).  The loop
+            # rides StoreRetryPolicy: a dark store surfaces as a typed
+            # StoreUnavailable at its deadline, which _route turns into a
+            # parked request instead of a crash.
+            def _attempt():
                 cur = self.store.get(key)
                 if self.store.compare_and_swap(key, cur, doc):
                     if cur is not None:
@@ -1380,6 +1458,10 @@ class FleetRouter:
                     # stale append still has a non-None expected and
                     # cannot slip through this gap).
                     self.store.clear_tombstone(key)
+                return StoreRetryPolicy.RETRY
+
+            return default_retry_policy().run(
+                f"journal_create({rid!r})", _attempt)
         if expected is None:
             # DISPATCH-time write (failover/redistribution) with no
             # mirror: this router lost journal ownership earlier (a lost
@@ -1424,22 +1506,43 @@ class FleetRouter:
         the owner that adopted it.  With no mirror we fall back to a
         store read, but stand down entirely if the document carries a
         different router's ownership stamp."""
+        if self.self_fenced:
+            # defense in depth on top of the fenced step(): a fenced
+            # ex-leader must not GC — the successor may still be serving
+            # this rid, and even a LOSING compare-delete round-trips the
+            # store it has no business writing to.  Deferred; the
+            # un-fenced retry path picks it up.
+            self._pending_gc.add(rid)
+            return
         key = f"{FLEET_REQUESTS_PREFIX}/{_rid_key(rid)}"
         expected = self._journal_docs.get(rid)
-        if expected is None:
-            expected = self.store.get(key)
-            if expected is not None and expected.get("owner") not in (
-                    None, self.router_id):
-                logger.warning(
-                    "fleet: journal GC for %r stood down — entry is owned "
-                    "by %r now (we were deposed)", rid,
-                    expected.get("owner"))
-                expected = None
-        if expected is not None:
-            if not self.store.compare_and_delete(key, expected):
-                logger.warning(
-                    "fleet: journal GC for %r lost its compare-delete (a "
-                    "successor re-stamped the entry); standing down", rid)
+        try:
+            if expected is None:
+                expected = self.store.get(key)
+                if expected is not None and expected.get("owner") not in (
+                        None, self.router_id):
+                    logger.warning(
+                        "fleet: journal GC for %r stood down — entry is "
+                        "owned by %r now (we were deposed)", rid,
+                        expected.get("owner"))
+                    expected = None
+            if expected is not None:
+                if not self.store.compare_and_delete(key, expected):
+                    logger.warning(
+                        "fleet: journal GC for %r lost its compare-delete "
+                        "(a successor re-stamped the entry); standing "
+                        "down", rid)
+        except (StoreUnavailable, OSError) as e:
+            # the terminal result is already local — only the GC write is
+            # owed.  Defer it (mirror kept: it is the fenced compare-
+            # delete's expected document) and retry on a healthy round.
+            self.store_unavailable_total += 1
+            self._pending_gc.add(rid)
+            logger.warning(
+                "fleet: journal GC for %r deferred — store unavailable "
+                "(%s)", rid, e)
+            return
+        self._pending_gc.discard(rid)
         self._journal_docs.pop(rid, None)
         self._journal_sizes.pop(rid, None)
         self._resumed.pop(rid, None)
@@ -1476,6 +1579,12 @@ class FleetRouter:
         fighting.  Appends never CREATE an entry (a missing document means
         the request was collected or shed — recreating it would resurrect
         a finished request on the next takeover)."""
+        if self.self_fenced:
+            # defense in depth: a fenced ex-leader's appends would lose
+            # their CAS anyway once the successor re-stamps, but before
+            # adoption they would WIN against entries nobody owns —
+            # racing the successor's takeover scan.  Quiet means quiet.
+            return
         for eid in sorted(self.members):
             m = self.members[eid]
             if not m.alive:
@@ -1539,19 +1648,66 @@ class FleetRouter:
         count this router tracks."""
         if not self.alive:
             raise RuntimeError(f"router {self.router_id} is dead")
-        lease = elect_coordinator(self.store, self.router_id, self.lease_s,
-                                  key=self.election_key)
+        try:
+            lease = elect_coordinator(self.store, self.router_id,
+                                      self.lease_s, key=self.election_key)
+        except (StoreUnavailable, OSError) as e:
+            # the store said NOTHING about our leadership this round —
+            # neither renewed nor deposed.  The data plane keeps moving
+            # (degraded step); the control plane waits, and once the
+            # silence outlasts lease_s we must assume a successor exists
+            # and self-fence (docs/FLEET.md "Store brownouts and
+            # partitions").
+            self.store_unavailable_total += 1
+            logger.warning("fleet: election poll failed (%s: %s)",
+                           type(e).__name__, e)
+            return self._degraded_step()
         if lease is None:
+            if self.is_coordinator or self.self_fenced:
+                log_dist(
+                    f"fleet: router {self.router_id} "
+                    f"{'un-fenced and ' if self.self_fenced else ''}"
+                    f"deposed from term {self.term} — standing down to "
+                    "standby", ranks=[0])
             self.is_coordinator = False
+            self.self_fenced = False
+            self._renewal_ok_t = None
             if self.admission_partitions is not None:
                 # follower routers stay useful: renew the router lease the
                 # coordinator's partition scan keys off, and keep/claim
                 # admission partitions so admit() has somewhere to land
-                self._beat_router()
-                self.claim_partitions()
+                try:
+                    self._beat_router()
+                    self.claim_partitions()
+                except (StoreUnavailable, OSError) as e:
+                    self.store_unavailable_total += 1
+                    logger.warning(
+                        "fleet: follower beat/claim failed (store "
+                        "unavailable: %s)", e)
             return self.outstanding()
+        # a successful poll IS the leadership re-read: our lease renewed
+        # under this term, so the fence (if any) lifts here and only here
+        self._renewal_ok_t = self.store.now()
+        if self.self_fenced:
+            self.self_fenced = False
+            log_dist(
+                f"fleet: router {self.router_id} un-fenced — lease "
+                f"renewal confirmed leadership of term {lease.term}",
+                ranks=[0])
         if not self.is_coordinator or lease.term != self.term:
-            self._take_over(lease)
+            try:
+                self._take_over(lease)
+            except (StoreUnavailable, OSError) as e:
+                # takeover aborted mid-adoption: stand down and re-run the
+                # WHOLE takeover next round (is_coordinator stays False so
+                # the journal scan repeats; adoption is idempotent)
+                self.store_unavailable_total += 1
+                self.is_coordinator = False
+                logger.warning(
+                    "fleet: takeover for term %d aborted (store "
+                    "unavailable: %s); retrying next round",
+                    lease.term, e)
+                return self.outstanding()
         self._tick += 1
         # ambient router tag (mirrors the member's engine tag): attributes
         # fleet.* spans to THIS router when standbys share a process ring
@@ -1561,11 +1717,22 @@ class FleetRouter:
                 m = self.members[eid]
                 if m.alive:
                     m.generation = self.generation
-                    m.beat()
+                    self._guarded(f"beat({eid})", m.beat)
             if self.admission_partitions is not None:
-                self._beat_router()
-                self._adopt_new_admissions()
-                self._scan_router_leases()
+                self._guarded("router beat", self._beat_router)
+                self._guarded("admission adopt", self._adopt_new_admissions)
+                self._guarded("router lease scan", self._scan_router_leases)
+            if self._parked:
+                # retry parked admissions FIRST: they were accepted
+                # strictly before anything promoted this round, and the
+                # store just proved reachable (the election poll).  A
+                # re-park on a mid-round relapse is harmless — the swap
+                # below makes the retry single-shot per round.
+                parked, self._parked = list(self._parked), deque()
+                logger.info("fleet: retrying %d parked request(s)",
+                            len(parked))
+                for req, requeue in parked:
+                    self._route(req, requeue=requeue)
             now = time.monotonic() - self._t0
             k = bisect.bisect_right(self._later, now,
                                     key=lambda r: r.arrival_time)
@@ -1582,7 +1749,12 @@ class FleetRouter:
                     # handled below: the dead marker / lapsed lease is the
                     # router-visible form of this death
                     pass
-                self._collect(m)
+                self._guarded(f"collect({eid})",
+                              lambda m=m: self._collect(m))
+            for rid in list(self._pending_gc):
+                # journal GC owed from a brownout round: the terminal
+                # results are long since local, only the delete is owed
+                self._journal_delete(rid)
             due = (self.journal_every_k is not None
                    and self._tick % self.journal_every_k == 0)
             if not due and self.journal_flush_ms is not None:
@@ -1592,13 +1764,16 @@ class FleetRouter:
                        >= self.journal_flush_ms)
             if due:
                 # flush BEFORE the lease scan: tokens decoded this round go
-                # durable before any failover decision can need them
-                self._flush_token_journal()
-                self._last_flush_t = self.store.now()
-                self.journal_flushes_total += 1
-            self._scan_leases()
-            self._advance_epoch_flip()
-            self._write_gauges()
+                # durable before any failover decision can need them.  A
+                # flush the store fails stays DUE — _last_flush_t only
+                # advances on success
+                if self._guarded("journal flush",
+                                 self._flush_token_journal):
+                    self._last_flush_t = self.store.now()
+                    self.journal_flushes_total += 1
+            self._guarded("lease scan", self._scan_leases)
+            self._guarded("epoch flip", self._advance_epoch_flip)
+            self._guarded("gauges", self._write_gauges)
             if self._slo is not None:
                 # router-side SLOs (docs/FLEET.md): evaluated AFTER the
                 # gauge write so rules over fleet/* rollups see this
@@ -1609,7 +1784,64 @@ class FleetRouter:
                 if self.monitor is not None:
                     self.monitor.write_events(
                         self._slo.gauge_events(self._tick))
-            self.publish_trace_segments()
+            self._guarded("trace publish", self.publish_trace_segments)
+        return self.outstanding()
+
+    def _guarded(self, what: str, fn) -> bool:
+        """Run one control-plane block, absorbing store unavailability: a
+        brownout DEGRADES the round (the block is skipped — or half-done
+        and naturally retried next round; every block is idempotent)
+        instead of crashing the router.  Engine/data-plane exceptions
+        still propagate.  Returns whether the block completed."""
+        try:
+            fn()
+            return True
+        except (StoreUnavailable, OSError) as e:
+            self.store_unavailable_total += 1
+            logger.warning("fleet: %s skipped — store unavailable (%s: %s)",
+                           what, type(e).__name__, e)
+            return False
+
+    def _degraded_step(self) -> int:
+        """A round in which the election poll could not reach the store.
+        The DATA plane keeps moving — live engines are pumped, so decode
+        never blocks on the control plane — but nothing store-coupled
+        runs: no dispatch, no journal flush, no lease scan (a failed scan
+        must never declare peers dead), no GC — and no result collection
+        either.  Collecting a result whose journal entry cannot be GC'd
+        leaves that entry open for a successor to adopt and re-serve
+        (the compare-delete fence would then protect the SUCCESSOR's
+        re-stamp from our stale delete, not us from the duplicate), so
+        results stay queued on the member (or its daemon outbox) until a
+        healthy round collects-then-GCs as one unit.  A standby just
+        waits for the store.  Once the silence outlasts ``lease_s``
+        since the last successful renewal the coordinator SELF-FENCES: a
+        successor may legitimately lead by now."""
+        if not self.is_coordinator:
+            return self.outstanding()
+        if not self.self_fenced and (
+                self._renewal_ok_t is None
+                or self.store.now() - self._renewal_ok_t >= self.lease_s):
+            self.self_fenced = True
+            self.fences_total += 1
+            log_dist(
+                f"fleet: router {self.router_id} SELF-FENCED — no "
+                f"successful lease renewal within lease_s={self.lease_s}s "
+                "(store partitioned?); dispatch, journal flush and GC "
+                "stay parked until a successful election poll re-reads "
+                "leadership", ranks=[0])
+        self._tick += 1
+        with trace_tags(router=self.router_id), \
+                trace_span("fleet.tick", tick=self._tick, degraded=True):
+            for eid in sorted(self.members):
+                m = self.members[eid]
+                if not m.alive:
+                    continue
+                try:
+                    m.pump()
+                except EngineDead:
+                    pass   # declared by the lease scan on a healthy round
+            self._guarded("gauges", self._write_gauges)
         return self.outstanding()
 
     def router_alerts(self) -> List[str]:
@@ -1668,9 +1900,18 @@ class FleetRouter:
                 # journal-created an admission it has not adopted yet, so
                 # "tracking nothing" only means done once the journal is
                 # empty too.
-                if (self.is_coordinator
-                        and self.admission_partitions is None) \
-                        or not self.store.list(FLEET_REQUESTS_PREFIX):
+                try:
+                    done = ((self.is_coordinator
+                             and not self.self_fenced
+                             and self.admission_partitions is None)
+                            or not self.store.list(FLEET_REQUESTS_PREFIX))
+                except (StoreUnavailable, OSError):
+                    # the journal is unknowable while the store is dark —
+                    # exiting now could abandon journaled work.  Keep
+                    # polling until a healthy round answers.
+                    self.store_unavailable_total += 1
+                    done = False
+                if done:
                     return self.take_results()
                 if self.is_coordinator:
                     # idle with journaled work outstanding: the adopt-scan
@@ -2106,8 +2347,14 @@ class FleetRouter:
     def health(self) -> Dict[str, Any]:
         """Fleet rollup + per-engine advertisements (as last written to
         the store) — what an external balancer or dashboard polls."""
-        ads = {eid: self.store.get(f"{FLEET_ENGINES_PREFIX}/{eid}")
-               for eid in sorted(self.members)}
+        # a health probe must answer even through a store brownout: the
+        # advertisement mirror degrades to empty, the router-local state
+        # (fencing, parked admissions, counters) is always reportable
+        try:
+            ads = {eid: self.store.get(f"{FLEET_ENGINES_PREFIX}/{eid}")
+                   for eid in sorted(self.members)}
+        except (StoreUnavailable, OSError):
+            ads = {eid: None for eid in sorted(self.members)}
         live = [eid for eid, m in self.members.items() if m.alive]
         return {
             "router_id": self.router_id,
@@ -2148,6 +2395,16 @@ class FleetRouter:
             "my_partitions": sorted(self._my_partitions),
             "partition_admissions_total": self.partition_admissions_total,
             "adopted_admissions_total": self.adopted_admissions_total,
+            # store-partition tolerance (docs/FLEET.md "Store brownouts
+            # and partitions"): fencing + degradation state
+            "self_fenced": self.self_fenced,
+            "fences_total": self.fences_total,
+            "parked_admissions": len(self._parked),
+            "parked_total": self.parked_total,
+            "pending_gc": len(self._pending_gc),
+            "dispatches_total": self.dispatches_total,
+            "store_unavailable_total": self.store_unavailable_total,
+            "store_retries_total": store_retries_total(),
             "engines": ads,
         }
 
@@ -2275,4 +2532,19 @@ class FleetRouter:
             ("fleet/channel_dropped_total",
              float(sum(int(getattr(m, "channel_dropped_total", 0) or 0)
                        for m in self.members.values())), self._tick),
+            # store-partition tolerance (docs/FLEET.md "Store brownouts
+            # and partitions"): the fence state, parked admissions owed a
+            # healthy round, unified CAS-retry volume across every store
+            # protocol, and documents the backend quarantined as corrupt
+            ("fleet/self_fenced", 1.0 if self.self_fenced else 0.0,
+             self._tick),
+            ("fleet/parked_admissions", float(len(self._parked)),
+             self._tick),
+            ("fleet/store_retries_total", float(store_retries_total()),
+             self._tick),
+            ("fleet/store_unavailable_total",
+             float(self.store_unavailable_total), self._tick),
+            ("store/corrupt_docs_total",
+             float(getattr(self.store, "corrupt_docs_total", 0) or 0),
+             self._tick),
         ])

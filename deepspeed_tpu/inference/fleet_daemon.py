@@ -38,18 +38,20 @@ Keyspace (all under the fleet prefix, docs/FLEET.md "Store keyspace"):
 from __future__ import annotations
 
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional
 
-from ..elasticity.coordination import (CoordinationStore, channel_append,
-                                       channel_consume, channel_stats,
-                                       read_generation)
+from ..elasticity.coordination import (CoordinationStore, StoreUnavailable,
+                                       channel_append, channel_consume,
+                                       channel_stats, read_generation)
 from ..utils.logging import logger
 from .fleet import (FLEET_ASSIGN_PREFIX, FLEET_CONTROL_PREFIX,
                     FLEET_ENGINES_PREFIX, FLEET_GENERATION_KEY,
-                    FLEET_PROGRESS_PREFIX, FLEET_RESIDENCY_PREFIX,
+                    FLEET_PROGRESS_PREFIX, FLEET_REQUESTS_PREFIX,
+                    FLEET_RESIDENCY_PREFIX,
                     FLEET_RESULTS_PREFIX, EngineDead, FleetMember,
-                    request_from_doc, request_to_doc, result_from_doc,
-                    result_to_doc)
+                    _rid_key, request_from_doc, request_to_doc,
+                    result_from_doc, result_to_doc)
 from .serving import Request, RequestResult
 
 __all__ = ["FleetMemberDaemon", "StoreMemberProxy"]
@@ -240,7 +242,8 @@ class FleetMemberDaemon:
     """
 
     def __init__(self, member: FleetMember, store: CoordinationStore,
-                 params_provider=None, idle_sleep_s: float = 0.0):
+                 params_provider=None, idle_sleep_s: float = 0.0,
+                 outbox_cap: int = 256, min_store_poll_s: float = 0.0):
         self.member = member
         self.store = store
         self.params_provider = params_provider
@@ -248,6 +251,27 @@ class FleetMemberDaemon:
         self.shutdown = False
         self._pending_epoch: Optional[int] = None
         self._draining = False
+        # ---- store-brownout tolerance (docs/FLEET.md "Store brownouts
+        # and partitions").  The DATA plane (pump/decode) never blocks on
+        # the control plane: when the store is dark, results buffer in a
+        # bounded outbox (oldest dropped at the cap, with accounting) and
+        # republish on heal — after a staleness check against the journal,
+        # because a stream that failed over while this member was
+        # partitioned is being re-served elsewhere and publishing our copy
+        # would serve it twice.  ``min_store_poll_s`` bounds store-op
+        # volume per wall second on the HOST clock (0 = poll every round,
+        # the deterministic-test default).
+        self.outbox_cap = int(outbox_cap)
+        if self.outbox_cap < 1:
+            raise ValueError(f"outbox_cap={outbox_cap} must be >= 1")
+        self.min_store_poll_s = float(min_store_poll_s)
+        self._last_store_poll_t: Optional[float] = None   # host monotonic
+        self._outbox: deque = deque()
+        self._store_dark = False
+        self.outbox_dropped_total = 0
+        self.outbox_stale_dropped_total = 0
+        self.outbox_republished_total = 0
+        self.store_unavailable_total = 0
 
     def _key(self, prefix: str) -> str:
         return f"{prefix}/{self.member.engine_id}"
@@ -267,24 +291,119 @@ class FleetMemberDaemon:
             logger.warning("fleet daemon[%s]: unknown control verb %r",
                            self.member.engine_id, verb)
 
-    def poll_once(self) -> int:
-        """One daemon round.  Returns the member's outstanding count (the
-        loop's idle signal)."""
+    def _store_due(self) -> bool:
+        """Host-monotonic rate limit on the round's STORE half: consumes,
+        outbox flush, progress and beats happen at most once per
+        ``min_store_poll_s`` while pump runs every round — the bound that
+        keeps store-op volume per wall second independent of the tick
+        rate (and of per-op store latency; see serve_bench
+        --store_latency_ms)."""
+        if self.min_store_poll_s <= 0:
+            return True
+        now = time.monotonic()
+        if self._last_store_poll_t is None \
+                or now - self._last_store_poll_t >= self.min_store_poll_s:
+            self._last_store_poll_t = now
+            return True
+        return False
+
+    def _enqueue_result(self, doc: Dict[str, Any]) -> None:
+        if len(self._outbox) >= self.outbox_cap:
+            dropped = self._outbox.popleft()
+            self.outbox_dropped_total += 1
+            logger.warning(
+                "fleet daemon[%s]: outbox full (cap %d) — dropped oldest "
+                "buffered result %r (%d dropped so far; the router's "
+                "journal failover re-serves it)", self.member.engine_id,
+                self.outbox_cap, dropped.get("rid"),
+                self.outbox_dropped_total)
+        self._outbox.append(doc)
+
+    def _flush_outbox(self) -> bool:
+        """Publish buffered results to the results channel.  On a
+        republish after a dark spell (``_store_dark``), each doc first
+        passes a staleness check against the journal: an entry that is
+        gone (stream already terminal) or re-stamped to another engine
+        (failed over while we were partitioned) means OUR copy must be
+        dropped — the fleet serves every stream exactly once.  Returns
+        False when the store went dark mid-flush (the rest stays
+        queued)."""
         m = self.member
         eid = m.engine_id
-        for _seq, op in channel_consume(self.store,
-                                        self._key(FLEET_CONTROL_PREFIX),
-                                        eid):
-            self._apply_control(op)
-        if not self._draining:
-            for _seq, doc in channel_consume(self.store,
-                                             self._key(FLEET_ASSIGN_PREFIX),
-                                             eid):
-                try:
-                    m.submit(request_from_doc(doc))
-                except Exception as e:
-                    logger.warning("fleet daemon[%s]: rejected assignment "
-                                   "%r: %s", eid, doc.get("rid"), e)
+        check_stale = self._store_dark
+        republished = 0
+        while self._outbox:
+            doc = self._outbox.popleft()
+            try:
+                if check_stale:
+                    rid = doc.get("rid")
+                    entry = self.store.get(
+                        f"{FLEET_REQUESTS_PREFIX}/{_rid_key(rid)}")
+                    if entry is None or entry.get("engine") != eid:
+                        self.outbox_stale_dropped_total += 1
+                        logger.warning(
+                            "fleet daemon[%s]: dropped stale buffered "
+                            "result %r after heal (%s)", eid, rid,
+                            "journal entry gone — stream already terminal"
+                            if entry is None else
+                            f"failed over to {entry.get('engine')!r}")
+                        continue
+                channel_append(self.store,
+                               self._key(FLEET_RESULTS_PREFIX), doc, eid)
+                if check_stale:
+                    republished += 1
+            except (StoreUnavailable, OSError) as e:
+                self._outbox.appendleft(doc)
+                self.store_unavailable_total += 1
+                logger.warning(
+                    "fleet daemon[%s]: outbox flush interrupted — store "
+                    "unavailable (%s); %d result(s) stay buffered", eid, e,
+                    len(self._outbox))
+                return False
+        if republished:
+            self.outbox_republished_total += republished
+            logger.info(
+                "fleet daemon[%s]: republished %d buffered result(s) "
+                "after store heal (%d stale-dropped, %d cap-dropped "
+                "total)", eid, republished,
+                self.outbox_stale_dropped_total, self.outbox_dropped_total)
+        return True
+
+    def poll_once(self) -> int:
+        """One daemon round.  Returns the member's outstanding count (the
+        loop's idle signal).  The store half degrades, never crashes: a
+        dark store means no NEW work arrives and nothing publishes —
+        decode of accepted work continues regardless, results buffer in
+        the outbox, and the member's lease simply stops renewing (which
+        is exactly the signal the router's grace window interprets)."""
+        m = self.member
+        eid = m.engine_id
+        store_due = self._store_due()
+        dark = self._store_dark and not store_due
+        if store_due:
+            dark = False
+            try:
+                for _seq, op in channel_consume(
+                        self.store, self._key(FLEET_CONTROL_PREFIX), eid):
+                    self._apply_control(op)
+                if not self._draining:
+                    for _seq, doc in channel_consume(
+                            self.store, self._key(FLEET_ASSIGN_PREFIX),
+                            eid):
+                        try:
+                            m.submit(request_from_doc(doc))
+                        except Exception as e:
+                            logger.warning(
+                                "fleet daemon[%s]: rejected assignment "
+                                "%r: %s", eid, doc.get("rid"), e)
+            except (StoreUnavailable, OSError) as e:
+                dark = True
+                self.store_unavailable_total += 1
+                logger.warning(
+                    "fleet daemon[%s]: store unavailable on consume (%s: "
+                    "%s) — decoding continues, publishes buffer", eid,
+                    type(e).__name__, e)
+        # ---- DATA PLANE: runs every round, dark or not
         if m.alive:
             try:
                 m.pump()
@@ -295,35 +414,72 @@ class FleetMemberDaemon:
                 logger.warning("fleet daemon[%s]: engine dead: %s", eid, e)
                 self.shutdown = True
         for res in m.take_results() if m.alive else []:
-            channel_append(self.store, self._key(FLEET_RESULTS_PREFIX),
-                           result_to_doc(res), eid)
-        if m.alive:
-            self.store.put(
-                self._key(FLEET_PROGRESS_PREFIX),
-                {"streams": [[rid, [int(t) for t in toks]]
-                             for rid, toks in m.stream_progress().items()],
-                 "t": self.store.now()})
+            self._enqueue_result(result_to_doc(res))
+        # ---- store publishes: skipped while dark (buffered instead)
+        if store_due and not dark:
+            if self._outbox:
+                dark = not self._flush_outbox()
+            if m.alive and not dark:
+                try:
+                    self.store.put(
+                        self._key(FLEET_PROGRESS_PREFIX),
+                        {"streams": [
+                            [rid, [int(t) for t in toks]]
+                            for rid, toks in m.stream_progress().items()],
+                         "t": self.store.now()})
+                except (StoreUnavailable, OSError) as e:
+                    dark = True
+                    self.store_unavailable_total += 1
+                    logger.warning(
+                        "fleet daemon[%s]: progress publish skipped — "
+                        "store unavailable (%s)", eid, e)
         if self._draining and m.alive and m.outstanding() == 0:
             self._draining = False
             if getattr(self, "_pending_recycle", False):
                 self._pending_recycle = False
                 m.recycle()
-                m.beat(force=True)
+                try:
+                    m.beat(force=True)
+                except (StoreUnavailable, OSError):
+                    dark = True
+                    self.store_unavailable_total += 1
         if self._pending_epoch is not None and m.alive \
-                and m.outstanding() == 0:
+                and m.outstanding() == 0 and store_due and not dark:
             epoch = self._pending_epoch
             params = (self.params_provider(epoch)
                       if self.params_provider is not None else None)
-            if m.prepare_epoch(params, epoch):
-                self._pending_epoch = None
-                logger.info("fleet daemon[%s]: prepared weight epoch %d",
-                            eid, epoch)
-        if m.alive:
-            # the coordinator bumps the fleet generation through the store;
-            # the daemon stamps its lease with whatever is current
-            m.generation = read_generation(self.store,
-                                           key=FLEET_GENERATION_KEY)
-            m.beat()
+            try:
+                if m.prepare_epoch(params, epoch):
+                    self._pending_epoch = None
+                    logger.info(
+                        "fleet daemon[%s]: prepared weight epoch %d",
+                        eid, epoch)
+            except (StoreUnavailable, OSError) as e:
+                dark = True
+                self.store_unavailable_total += 1
+                logger.warning(
+                    "fleet daemon[%s]: epoch prepare deferred — store "
+                    "unavailable (%s)", eid, e)
+        if m.alive and store_due and not dark:
+            # the coordinator bumps the fleet generation through the
+            # store; the daemon stamps its lease with whatever is current.
+            # A dark store means the lease does NOT renew — the honest
+            # signal: the router's miss_limit grace decides whether this
+            # member is partitioned-but-decoding or gone.
+            try:
+                m.generation = read_generation(self.store,
+                                               key=FLEET_GENERATION_KEY)
+                m.beat()
+            except (StoreUnavailable, OSError) as e:
+                dark = True
+                self.store_unavailable_total += 1
+                logger.warning(
+                    "fleet daemon[%s]: lease beat failed — store "
+                    "unavailable (%s)", eid, e)
+        if store_due:
+            if self._store_dark and not dark:
+                logger.info("fleet daemon[%s]: store reachable again", eid)
+            self._store_dark = dark
         return m.outstanding() if m.alive else 0
 
     def run(self, max_ticks: Optional[int] = None) -> int:

@@ -80,7 +80,13 @@ SITES = (SITE_CKPT_SAVE, SITE_CKPT_LOAD, SITE_LATEST_PUBLISH,
          SITE_TRAIN_STEP, SITE_SUPERVISOR_ATTEMPT, SITE_SERVE_TICK,
          SITE_SERVE_ADMIT, SITE_SERVE_PREFILL, SITE_SERVE_DECODE,
          SITE_SERVE_REPLAY, SITE_POD_HEARTBEAT, SITE_POD_RENDEZVOUS,
-         SITE_SHARD_COMMIT, SITE_FLEET_CHANNEL)
+         SITE_SHARD_COMMIT, SITE_FLEET_CHANNEL,
+         # coordination-store op sites, fired by the FaultyStore proxy
+         # on every proxied op (elasticity/store_faults.py; canonical
+         # SITE_STORE_* spellings live there to keep this module free of
+         # an elasticity import)
+         "store.get", "store.put", "store.cas", "store.delete",
+         "store.compare_delete", "store.list")
 KINDS = ("raise", "delay", "corrupt", "sigterm")
 
 FAULTS_ENV = "DS_TPU_FAULTS"

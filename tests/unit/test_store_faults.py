@@ -1,0 +1,470 @@
+"""Store-partition tolerance (ISSUE 18; docs/FLEET.md "Store brownouts
+and partitions"): the FaultyStore proxy's deterministic fault programs,
+torn-write quarantine/recovery, the daemon outbox's buffer/heal/drop
+accounting, coordinator self-fencing, the watchdog's store-failure
+grace, and the protocol history checker's positive cases.
+
+Deterministic throughout: fault rules carry their own seeded PRNG,
+stores run on injected clocks, and the pinned-seed soak drives the same
+harness as ``tools/chaos_soak.py --mode store_partition``.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.elasticity import (
+    FaultyStore,
+    FileCoordinationStore,
+    InjectedStoreFault,
+    StoreFaultRule,
+    StoreRetryPolicy,
+    StoreUnavailable,
+    maybe_faulty,
+    rules_from_env,
+    store_retries_total,
+)
+from deepspeed_tpu.elasticity.coordination import (
+    HeartbeatWatchdog,
+    beat,
+    channel_append,
+    channel_consume,
+)
+from deepspeed_tpu.monitor import InMemoryMonitor
+
+
+def _store(tmp_path, clock=None, name="coord"):
+    return FileCoordinationStore(str(tmp_path / name), clock=clock)
+
+
+def _tools_import(name):
+    """Import from tools/ (the store_check / chaos_soak harnesses) with
+    the exact-entry path discipline of test_serving_resilience."""
+    tools = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         os.pardir, os.pardir, "tools")
+    sys.path.insert(0, tools)
+    try:
+        return __import__(name)
+    finally:
+        sys.path.remove(tools)
+
+
+# ---------------------------------------------------------- rule programs
+
+def test_fault_rule_determinism_per_seed(tmp_path):
+    """Same seed + same op sequence => identical fire pattern; a
+    different seed diverges.  The soak's reproducibility rides on this."""
+
+    def pattern(seed):
+        s = FaultyStore(_store(tmp_path, name=f"c{seed}"), client="c",
+                        rules=[StoreFaultRule(ops=("get",), kind="error",
+                                              probability=0.5, seed=seed)])
+        fired = []
+        for i in range(200):
+            try:
+                s.get(f"k{i}")
+                fired.append(False)
+            except InjectedStoreFault:
+                fired.append(True)
+        return fired
+
+    assert pattern(7) == pattern(7)
+    assert pattern(7) != pattern(8)
+
+
+def test_latency_rule_counts_into_measured_percentiles(tmp_path):
+    """The injected delay must appear in op_latency_percentiles() — the
+    serve_bench store-latency sweep's CAS-p50-grows claim measures
+    exactly this surface."""
+    s = FaultyStore(_store(tmp_path), client="c",
+                    rules=[StoreFaultRule(ops=("get",), kind="latency",
+                                          delay_s=0.02)])
+    for _ in range(3):
+        s.get("k")
+    p = s.op_latency_percentiles()["get"]
+    assert p["n"] == 3.0
+    assert p["p50"] >= 0.02
+
+
+def test_partition_toggle_and_counters(tmp_path):
+    s = FaultyStore(_store(tmp_path, clock=lambda: 42.0), client="c")
+    s.put("k", {"v": 1})
+    s.partitioned = True
+    for op in (lambda: s.get("k"), lambda: s.put("k", {"v": 2}),
+               lambda: s.compare_and_swap("k", {"v": 1}, {"v": 2}),
+               lambda: s.list("")):
+        with pytest.raises(StoreUnavailable):
+            op()
+    assert s.faults_by_kind["blackout"] == 4
+    s.partitioned = False
+    assert s.get("k") == {"v": 1}          # heal: nothing was written
+    assert s.now() == 42.0                 # the clock is never faulted
+
+
+def test_stale_read_serves_previously_observed_doc(tmp_path):
+    s = FaultyStore(_store(tmp_path), client="c")
+    s.put("k", {"v": 1})
+    assert s.get("k") == {"v": 1}          # observe v1
+    s.put("k", {"v": 2})
+    rule = StoreFaultRule(ops=("get",), kind="stale_read")
+    s.rules.append(rule)
+    assert s.get("k") == {"v": 1}          # the lagging-replica read
+    s.rules.remove(rule)
+    assert s.get("k") == {"v": 2}
+
+
+def test_rules_from_env_and_maybe_faulty(tmp_path):
+    spec = ('[{"ops": ["get"], "kind": "error", "at_call": 1}]')
+    rules = rules_from_env(env=spec)
+    assert len(rules) == 1 and rules[0].kind == "error"
+    wrapped = maybe_faulty(_store(tmp_path), client="e0", env=spec)
+    assert isinstance(wrapped, FaultyStore)
+    with pytest.raises(InjectedStoreFault):
+        wrapped.get("k")
+    assert wrapped.get("k") is None        # at_call=1 fired once
+    # unarmed: the store passes through untouched
+    bare = _store(tmp_path, name="bare")
+    assert maybe_faulty(bare, client="e0", env="") is bare
+    with pytest.raises(ValueError):
+        rules_from_env(env='{"not": "a list"}')
+
+
+# ------------------------------------------------- torn writes + quarantine
+
+def test_torn_write_quarantined_and_recovered(tmp_path):
+    backend = _store(tmp_path)
+    s = FaultyStore(backend, client="c",
+                    rules=[StoreFaultRule(ops=("put",), kind="torn_write",
+                                          at_call=2)])
+    s.put("ns/k", {"v": 1})
+    with pytest.raises(InjectedStoreFault):
+        s.put("ns/k", {"v": 2, "pad": "x" * 64})   # crash mid-write
+    # the torn bytes are on storage; get() must quarantine them aside and
+    # count them — never read them as a document, never silently "absent"
+    assert backend.get("ns/k") is None
+    assert backend.corrupt_docs_total == 1
+    quarantined = [p for p in os.listdir(os.path.dirname(
+        backend._path("ns/k"))) if ".corrupt" in p]
+    assert quarantined, "torn bytes were discarded, not quarantined"
+    # list() never surfaces quarantine artifacts, and the key writes again
+    assert backend.list("ns") == []
+    s.put("ns/k", {"v": 3})
+    assert backend.get("ns/k") == {"v": 3}
+    assert backend.corrupt_docs_total == 1
+
+
+# --------------------------------------------------------- retry discipline
+
+def test_retry_policy_absorbs_transient_faults_and_counts(tmp_path):
+    s = FaultyStore(_store(tmp_path), client="c",
+                    rules=[StoreFaultRule(ops=("get",), kind="error",
+                                          max_fires=2)])
+    s.put("k", {"v": 1})
+    before = store_retries_total()
+    policy = StoreRetryPolicy(deadline_s=5.0)
+    assert policy.run("get k", lambda: s.get("k")) == {"v": 1}
+    assert store_retries_total() - before == 2
+    assert policy.retries_total == 2
+
+
+def test_retry_policy_propagates_store_unavailable_immediately(tmp_path):
+    s = FaultyStore(_store(tmp_path), client="c")
+    s.partitioned = True
+    before = store_retries_total()
+    with pytest.raises(StoreUnavailable):
+        StoreRetryPolicy(deadline_s=5.0).run("get k", lambda: s.get("k"))
+    assert store_retries_total() == before   # degrade, don't spin
+
+
+# ------------------------------------------------- watchdog store grace
+
+def test_watchdog_never_declares_peers_from_failed_scans(tmp_path):
+    """N consecutive store failures escalate the pod/store_unreachable
+    gauge — but a peer whose lease LOOKS lapsed through a broken store
+    view is never declared dead ("my store is broken" and "that host
+    stopped beating" are different facts), and the first clean scan
+    after a heal runs declaration-free."""
+    clock = [0.0]
+    backend = _store(tmp_path, clock=lambda: clock[0])
+    beat(backend, "h1", generation=1, lease_s=1.0)   # h1 beats once
+    s = FaultyStore(backend, client="h0")
+    mon = InMemoryMonitor()
+    dead = []
+    wd = HeartbeatWatchdog(s, "h0", generation=1, peers=["h0", "h1"],
+                           lease_s=1.0, miss_limit=2, grace_beats=0,
+                           on_peer_dead=dead.append, monitor=mon,
+                           store_fail_grace=3)
+    wd.beat_once()
+    clock[0] = 50.0          # h1's lease is now WAY lapsed
+    s.partitioned = True
+    for i in range(3):
+        wd.tick_once()
+        assert wd.dead == [] and dead == []
+        assert wd.store_unreachable == (i >= 2)
+    assert wd.store_fail_streak == 3
+    assert wd.store_failures_total == 3
+    gauge = [e for e in mon.events if e[0] == "pod/store_unreachable"]
+    assert [v for _, v, _ in gauge] == [1.0]
+    # heal: the gauge clears and the first scan declares nothing
+    s.partitioned = False
+    wd.tick_once()
+    assert not wd.store_unreachable
+    assert [v for _, v, _ in (e for e in mon.events
+                              if e[0] == "pod/store_unreachable")] \
+        == [1.0, 0.0]
+    assert dead == []
+    # the NEXT scan may declare: the lapse is now a store-confirmed fact
+    wd.tick_once()
+    assert dead == ["h1"]
+
+
+# ------------------------------------------------ daemon outbox accounting
+
+def _tiny_member(store, eid="engine0", lease_s=1.0):
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.inference.fleet import FleetMember
+    from deepspeed_tpu.models import CausalLM
+
+    jax.config.update("jax_platforms", "cpu")
+    model = CausalLM("tiny", dtype=jnp.float32, attn_impl="xla")
+    params = model.init_fn(jax.random.PRNGKey(0))
+    engine = deepspeed_tpu.init_inference(
+        model=model, config={"dtype": "float32"}, params=params)
+    sup = engine.supervised_serving(max_restarts=2, b_slots=2,
+                                    page_size=8, max_model_len=64)
+    m = FleetMember(eid, sup, store, lease_s=lease_s)
+    m.beat(force=True)
+    return m, model
+
+
+@pytest.mark.chaos
+def test_outbox_buffers_heals_republishes_and_stale_drops(tmp_path):
+    """The daemon's degradation contract end-to-end: results buffer in
+    the outbox through a blackout (decode never stops), republish on
+    heal when the journal still names this engine, STALE-DROP when a
+    survivor re-stamped the entry, and cap overflows are counted."""
+    from deepspeed_tpu.inference.fleet import _rid_key
+    from deepspeed_tpu.inference.fleet_daemon import (FleetMemberDaemon,
+                                                      StoreMemberProxy)
+    from deepspeed_tpu.inference.serving import Request
+
+    clock = [0.0]
+    backend = _store(tmp_path, clock=lambda: clock[0])
+    view = FaultyStore(backend, client="engine0")
+    member, model = _tiny_member(view)
+    daemon = FleetMemberDaemon(member, view, outbox_cap=2)
+    proxy = StoreMemberProxy("engine0", backend, router_id="r0",
+                             lease_s=1.0)
+    for i in range(3):
+        proxy.submit(Request(rid=f"q{i}",
+                             input_ids=np.arange(1, 6, dtype=np.int32),
+                             max_new_tokens=4))
+    daemon.poll_once()                      # consume the assignments
+    view.partitioned = True                 # full blackout
+    for _ in range(40):
+        daemon.poll_once()
+        clock[0] += 0.05
+        if daemon.outbox_dropped_total + len(daemon._outbox) == 3:
+            break                           # all three streams terminal
+    assert daemon._store_dark
+    assert daemon.store_unavailable_total >= 1
+    # 3 terminal results, cap 2: one counted cap-drop, two buffered
+    assert daemon.outbox_dropped_total == 1
+    assert len(daemon._outbox) == 2
+    buffered = [doc.get("rid") for doc in daemon._outbox]
+    # the journal names engine0 for one buffered rid; a survivor
+    # re-stamped the other — exactly one republish, one stale-drop
+    keep, stolen = buffered[0], buffered[1]
+    backend.put(f"fleet/requests/{_rid_key(keep)}",
+                {"rid": keep, "engine": "engine0", "tokens": []})
+    backend.put(f"fleet/requests/{_rid_key(stolen)}",
+                {"rid": stolen, "engine": "engine1", "tokens": []})
+    view.partitioned = False
+    daemon.poll_once()
+    assert daemon.outbox_republished_total == 1
+    assert daemon.outbox_stale_dropped_total == 1
+    assert len(daemon._outbox) == 0
+    assert not daemon._store_dark
+    served = [r.rid for r in proxy.take_results()]
+    assert served == [keep]
+
+
+# ------------------------------------------------------ leader self-fencing
+
+@pytest.mark.chaos
+def test_partitioned_coordinator_self_fences_and_parks(tmp_path):
+    """A partitioned-but-live coordinator freezes its OWN control plane
+    within lease_s of its last successful renewal: zero dispatches, new
+    admissions parked (not crashed, not routed), journal GC deferred
+    without one store op — and the first healthy poll stands it down."""
+    from deepspeed_tpu.inference.fleet import FleetRouter
+    from deepspeed_tpu.inference.serving import Request
+
+    clock = [0.0]
+    backend = _store(tmp_path, clock=lambda: clock[0])
+    view = FaultyStore(backend, client="r0")
+    member, model = _tiny_member(backend, lease_s=10.0)
+    router = FleetRouter(view, [member], router_id="r0", lease_s=1.0,
+                         journal_every_k=1)
+    router.step()
+    assert router.is_coordinator and not router.self_fenced
+    router.submit(Request(rid="a", input_ids=np.arange(1, 6,
+                                                       dtype=np.int32),
+                          max_new_tokens=4))
+    router.step()
+    disp0 = router.dispatches_total
+    assert disp0 >= 1
+    view.partitioned = True
+    for _ in range(30):
+        router.step()
+        clock[0] += 0.1
+        if router.self_fenced:
+            break
+    assert router.self_fenced and router.is_coordinator
+    assert router.fences_total == 1
+    # fenced admission: parked, not dispatched, not an exception
+    router.submit(Request(rid="b", input_ids=np.arange(1, 6,
+                                                       dtype=np.int32),
+                          max_new_tokens=4))
+    ops0 = view.ops_total
+    for _ in range(10):
+        router.step()
+        clock[0] += 0.1
+    assert router.dispatches_total == disp0
+    assert [req.rid for req, _requeue in router._parked] == ["b"]
+    # fenced GC/flush: deferred with ZERO store ops attempted
+    ops0 = view.ops_total
+    router._journal_delete("a")
+    router._flush_token_journal()
+    assert view.ops_total == ops0
+    assert "a" in router._pending_gc
+    assert router.health()["self_fenced"] == 1
+    # heal: the next election poll re-reads leadership (nobody took the
+    # term here, so the renewal succeeds) and the fence lifts; the
+    # parked admission dispatches
+    view.partitioned = False
+    for _ in range(30):
+        router.step()
+        clock[0] += 0.1
+        if not router._parked and not router._pending_gc:
+            break
+    assert not router.self_fenced and router.is_coordinator
+    assert router.dispatches_total > disp0
+    results = {r.rid for r in router.run([], max_ticks=2000)}
+    assert results == {"a", "b"}
+
+
+# ----------------------------------------------------- history checker
+
+def _channel_append(key, exp_doc, seq, payload, client="e0", i=0):
+    items = list((exp_doc or {}).get("items") or []) + [[seq, payload]]
+    return {"i": i, "client": client, "op": "cas", "key": key, "t": 0.0,
+            "expected": exp_doc,
+            "new": {"seq": seq, "items": items, "consumer": None},
+            "ok": True}
+
+
+def test_history_checker_passes_a_clean_protocol_run(tmp_path):
+    sc = _tools_import("store_check")
+    backend = _store(tmp_path)
+    rec = sc.RecordingStore(backend, client="r0")
+    h = rec.handle("e0")
+    rec.compare_and_swap("fleet/coordinator", None,
+                         {"leader_id": "r0", "term": 1})
+    rec.compare_and_swap("fleet/requests/i1", None,
+                         {"rid": 1, "engine": "e0"})
+    channel_append(h, "fleet/results/e0", {"rid": 1}, "e0")
+    channel_consume(rec, "fleet/results/e0", "r0")
+    rec.compare_and_delete("fleet/requests/i1",
+                           {"rid": 1, "engine": "e0"})
+    v = sc.check_history(rec.events)
+    assert v.ok, v.violations
+    assert v.counts["serve"] == 1 and v.counts["consume"] == 1
+    # save/load round-trips to the same verdict (the CLI path)
+    path = str(tmp_path / "history.jsonl")
+    assert rec.save(path) == len(rec.events)
+    assert sc.check_history(sc.load_history(path)).ok
+
+
+def test_history_checker_flags_planted_duplicate_serve():
+    sc = _tools_import("store_check")
+    key = "fleet/results/e0"
+    ev1 = _channel_append(key, None, 1, {"rid": "r1"}, i=0)
+    ev2 = _channel_append(key, ev1["new"], 2, {"rid": "r1"}, i=1)
+    v = sc.check_history([ev1, ev2])
+    assert not v.ok
+    assert any("duplicate serve" in viol for viol in v.violations)
+
+
+def test_history_checker_flags_planted_stale_cas():
+    sc = _tools_import("store_check")
+    events = [
+        {"i": 0, "client": "a", "op": "cas", "key": "k", "t": 0.0,
+         "expected": None, "new": {"v": 1}, "ok": True},
+        # the store ADMITTED a CAS whose expected was never current —
+        # the split-brain shape every fence exists to prevent
+        {"i": 1, "client": "b", "op": "cas", "key": "k", "t": 1.0,
+         "expected": {"v": 99}, "new": {"v": 2}, "ok": True},
+    ]
+    v = sc.check_history(events)
+    assert not v.ok
+    assert any("stale CAS" in viol for viol in v.violations)
+
+
+def test_history_checker_flags_two_leaders_one_term():
+    sc = _tools_import("store_check")
+    events = [
+        {"i": 0, "client": "a", "op": "cas", "key": "fleet/coordinator",
+         "t": 0.0, "expected": None,
+         "new": {"leader_id": "a", "term": 3}, "ok": True},
+        {"i": 1, "client": "b", "op": "cas", "key": "fleet/coordinator",
+         "t": 1.0, "expected": {"leader_id": "a", "term": 3},
+         "new": {"leader_id": "b", "term": 3}, "ok": True},
+    ]
+    v = sc.check_history(events)
+    assert not v.ok
+    assert any("two coordinators" in viol for viol in v.violations)
+
+
+def test_history_checker_flags_journal_resurrection():
+    sc = _tools_import("store_check")
+    key = "fleet/requests/i7"
+    events = [
+        {"i": 0, "client": "a", "op": "cas", "key": key, "t": 0.0,
+         "expected": None, "new": {"rid": 7}, "ok": True},
+        {"i": 1, "client": "a", "op": "compare_delete", "key": key,
+         "t": 1.0, "expected": {"rid": 7}, "ok": True},
+        {"i": 2, "client": "b", "op": "cas", "key": key, "t": 2.0,
+         "expected": None, "new": {"rid": 7}, "ok": True},
+    ]
+    v = sc.check_history(events)
+    assert not v.ok
+    assert any("resurrection" in viol for viol in v.violations)
+
+
+# ------------------------------------------------------- pinned-seed soak
+
+@pytest.mark.chaos
+def test_store_partition_soak_pinned_seed(tmp_path):
+    """Tier-1 variant of ``tools/chaos_soak.py --mode store_partition``:
+    brownout absorbed, sub-grace blackout decoded dark with republish,
+    over-grace partition failed over with token-exact resume +
+    stale-drop, the partitioned leader self-fenced, and the recorded
+    history passed every checker invariant."""
+    cs = _tools_import("chaos_soak")
+    stats = cs.run_store_partition_soak(seed=3, root=str(tmp_path),
+                                        n_requests=6, verbose=False)
+    assert stats["terminal"] == stats["submitted"] == 6
+    assert stats["brownout_faults"] >= 1
+    assert stats["failovers"] >= 1
+    assert stats["resumed_results"] >= 1
+    assert stats["outbox_republished"] >= 1
+    assert stats["outbox_stale_dropped"] >= 1
+    assert stats["history_events"] > 0
+    assert stats["fences_total"] == 1
+    assert stats["fenced_dispatch_delta"] == 0
+    assert stats["partition_final_term"] == 2

@@ -33,7 +33,19 @@ Three modes (``--mode train`` is the default):
   lapsed lease or a durable ``fleet/dead`` marker, every journal entry
   must be GC'd by the collecting router (original or standby), and the
   fleet generation must bump monotonically across coordinator terms
-  (docs/FLEET.md).
+  (docs/FLEET.md);
+- **store_partition**: the STORE is the fault axis (ISSUE 18) — a router
+  plus daemonized members run over per-client ``FaultyStore`` views of
+  one recorded file store: transient-error brownouts the retry policy
+  must absorb (zero failovers), a sub-grace member blackout that must
+  NOT fail over (the member decodes dark and republishes its outbox on
+  heal), an over-grace asymmetric partition that MUST (token-exact
+  resume; the healed victim stale-drops its buffered copies — zero
+  duplicate serves), and the live-but-partitioned LEADER, which must
+  self-fence within ``lease_s`` (zero dispatches, zero journal deletes)
+  while a successor takes the next term.  The complete linearized op
+  history must pass every ``tools/store_check.py`` invariant
+  (docs/FLEET.md "Store brownouts and partitions").
 
 Each soak round draws a fault mix from a seeded PRNG — preemption SIGTERMs
 at random steps, checkpoint-write failures, corruption of the newest
@@ -1390,6 +1402,490 @@ def _stalled_leader_scenario(seed: int, coord_dir: str, engine, model,
     }
 
 
+def run_store_partition_soak(seed: int, root: str, n_requests: int = 8,
+                             verbose: bool = True) -> dict:
+    """Store-partition soak (ISSUE 18; docs/FLEET.md "Store brownouts
+    and partitions"): live traffic through daemonized members while the
+    coordination store itself browns out and partitions — the fault
+    axis process-kill chaos leaves untouched.
+
+    Topology: one router driving two cooperative in-process
+    :class:`~deepspeed_tpu.inference.fleet_daemon.FleetMemberDaemon`
+    loops over a shared injected-clock file store.  Every client
+    (router, each daemon) sits behind its OWN
+    :class:`~deepspeed_tpu.elasticity.FaultyStore` proxy over a shared
+    ``tools/store_check.RecordingStore`` handle, so faults are
+    per-client (asymmetric by construction) and the complete linearized
+    op history is protocol-checked after the fact.  The fault proxy
+    wraps the recording handle, not the other way round: an op a
+    blackout rejected never reached the store, so it must not enter the
+    history either.
+
+    Schedule (store clock; one router round + both daemon rounds per
+    0.05s tick):
+
+    1. **warmup** until both engines hold a mid-stream journal entry;
+    2. **brownout** — seeded transient-error rules on the ROUTER's ops
+       for a 0.6s window: the retry policy must absorb every one
+       (``store_retries_total`` grows; zero failovers; nobody dead);
+    3. **sub-grace blackout** — engine1 fully partitioned for 1.5s
+       (< lease_s*miss = 3s): it keeps DECODING dark, buffers results
+       in its outbox, republishes on heal; still zero failovers;
+    4. **over-grace partition** — engine0 partitioned for 4.5s: the
+       router declares it dead through the (healthy) store and fails
+       its streams over with a token-exact resume; the victim finishes
+       its copies dark and must STALE-DROP every one on heal (journal
+       re-stamped to the survivor) — zero duplicate serves;
+    5. **heal + drain** — every rid terminal exactly once,
+       token-identical to a fault-free reference, journal GC'd, and
+       the recorded history passes every checker invariant.
+
+    Phase 2 (:func:`_partitioned_leader_scenario`) puts the PARTITION
+    ON THE LEADER itself and proves it self-fences.
+    """
+    import numpy as np
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.elasticity import (FaultyStore, FileCoordinationStore,
+                                          StoreFaultRule,
+                                          store_retries_total)
+    from deepspeed_tpu.inference.fleet import (FLEET_REQUESTS_PREFIX,
+                                               FleetMember, FleetRouter)
+    from deepspeed_tpu.inference.fleet_daemon import (FleetMemberDaemon,
+                                                      StoreMemberProxy)
+    from deepspeed_tpu.inference.sampling import SamplingParams
+    from deepspeed_tpu.inference.serving import Request
+    from deepspeed_tpu.models import CausalLM
+    from tools.store_check import RecordingStore, check_history
+
+    MAX_NEW = 24
+    model = CausalLM("tiny", dtype=jnp.float32, attn_impl="xla")
+    params = model.init_fn(jax.random.PRNGKey(0))
+    engine = deepspeed_tpu.init_inference(
+        model=model, config={"dtype": "float32"}, params=params)
+    nprng = np.random.default_rng(seed)
+
+    def lane(i):
+        if i % 3 != 1:
+            return None
+        return SamplingParams(temperature=0.8 if i % 2 else 1.2,
+                              top_k=0 if i % 6 == 1 else 12,
+                              top_p=0.9, seed=900 + i)
+
+    # long streams: the brownout/blackout/partition windows all need
+    # mid-stream journal entries to land on
+    base = [Request(rid=i,
+                    input_ids=nprng.integers(
+                        1, model.config.vocab_size,
+                        int(nprng.integers(3, 12))).astype(np.int32),
+                    max_new_tokens=MAX_NEW, sampling=lane(i),
+                    trace_id=f"storepart-{seed}-{i}")
+            for i in range(n_requests)]
+
+    def copies(reqs=None):
+        return [Request(rid=r.rid, input_ids=r.input_ids,
+                        max_new_tokens=r.max_new_tokens,
+                        sampling=r.sampling, trace_id=r.trace_id)
+                for r in (base if reqs is None else reqs)]
+
+    # the last few requests are held back and submitted mid-run when the
+    # blackout heals: the over-grace partition needs engine0 to hold a
+    # stream with REAL work left, and by that point in the schedule its
+    # upfront share has usually finished
+    n_late = max(1, min(3, n_requests - 2))
+    upfront, late = base[:-n_late], base[-n_late:]
+
+    ref_serve = engine.serving(b_slots=3, page_size=8, max_model_len=64)
+    ref = {r.rid: r.output_ids for r in ref_serve.run(copies())}
+    del ref_serve
+
+    clock = [0.0]
+    DT = 0.05
+    backend = FileCoordinationStore(os.path.join(root, "coord"),
+                                    clock=lambda: clock[0])
+    recorded = RecordingStore(backend, client="base")
+    views = {c: FaultyStore(recorded.handle(c), client=c)
+             for c in ("router0", "engine0", "engine1")}
+    LEASE_S, MISS = 1.0, 3   # member death grace = 3.0 store-sec
+    serve_kw = dict(b_slots=2, page_size=8, max_model_len=64)
+    daemons = []
+    for i in range(2):
+        eid = f"engine{i}"
+        m = FleetMember(eid, engine.supervised_serving(max_restarts=5,
+                                                       **serve_kw),
+                        views[eid], lease_s=LEASE_S)
+        m.beat(force=True)
+        daemons.append(FleetMemberDaemon(m, views[eid]))
+    proxies = [StoreMemberProxy(f"engine{i}", views["router0"],
+                                router_id="router0", lease_s=LEASE_S)
+               for i in range(2)]
+    for p in proxies:
+        p.beat()
+    router = FleetRouter(views["router0"], proxies, router_id="router0",
+                         lease_s=5.0, miss_limit=MISS, journal_every_k=1)
+
+    def midstream(eid, min_remaining=1):
+        return any(doc.get("engine") == eid and doc.get("tokens")
+                   and len(doc["tokens"]) <= MAX_NEW - min_remaining
+                   for rid, doc in router._journal_docs.items()
+                   if rid in router._requests)
+
+    st = {"phase": "warmup", "until": None, "rule": None,
+          "retries0": None, "retries_brownout": None,
+          "brownout_faults": 0, "failovers_at_blackout": None,
+          "blackout_dark_seen": False, "failovers_pre_partition": None,
+          "victim_declared_round": None}
+
+    def on_tick(r, rounds):
+        for d in daemons:
+            d.poll_once()
+        clock[0] += DT
+        ph = st["phase"]
+        if ph == "warmup":
+            if midstream("engine0") and midstream("engine1"):
+                st["retries0"] = store_retries_total()
+                st["until"] = clock[0] + 0.6
+                st["rule"] = StoreFaultRule(
+                    ops=("get", "put", "cas", "list"), kind="error",
+                    probability=0.3, until_t=st["until"], seed=seed)
+                views["router0"].rules.append(st["rule"])
+                st["phase"] = "brownout"
+            elif rounds > 2000:
+                raise RuntimeError(
+                    f"store_partition seed={seed}: warmup never saw both "
+                    f"engines mid-stream")
+        elif ph == "brownout":
+            if clock[0] >= st["until"]:
+                views["router0"].rules.remove(st["rule"])
+                st["brownout_faults"] = st["rule"].fires
+                st["retries_brownout"] = \
+                    store_retries_total() - st["retries0"]
+                st["failovers_at_blackout"] = r.failovers_total
+                st["phase"] = "pre_blackout"
+        elif ph == "pre_blackout":
+            if midstream("engine1"):
+                views["engine1"].partitioned = True
+                st["until"] = clock[0] + 1.5   # < the 3.0s death grace
+                st["phase"] = "blackout"
+            elif rounds > 4000:
+                raise RuntimeError(
+                    f"store_partition seed={seed}: engine1 never "
+                    f"mid-stream for the sub-grace blackout")
+        elif ph == "blackout":
+            if daemons[1]._store_dark:
+                st["blackout_dark_seen"] = True
+            if clock[0] >= st["until"]:
+                views["engine1"].partitioned = False
+                # submit the held-back requests NOW: engine1's buffered
+                # terminals keep the run loop pending through this round,
+                # and the fresh streams give engine0 real work to be
+                # mid-stream on when the partition lands
+                for req in copies(late):
+                    r.submit(req)
+                st["phase"] = "pre_partition"
+        elif ph == "pre_partition":
+            if midstream("engine0", min_remaining=MAX_NEW // 2):
+                st["failovers_pre_partition"] = r.failovers_total
+                views["engine0"].partitioned = True
+                st["until"] = clock[0] + 4.5   # > the 3.0s death grace
+                st["phase"] = "partition"
+            elif rounds > 6000:
+                raise RuntimeError(
+                    f"store_partition seed={seed}: engine0 never "
+                    f"mid-stream for the over-grace partition")
+        elif ph == "partition":
+            if st["victim_declared_round"] is None \
+                    and "engine0" in r._failed_engines:
+                st["victim_declared_round"] = rounds
+            if clock[0] >= st["until"]:
+                views["engine0"].partitioned = False
+                st["phase"] = "drain"
+
+    results = router.run(copies(upfront), max_ticks=60000, on_tick=on_tick)
+    assert st["phase"] in ("partition", "drain"), \
+        f"store_partition seed={seed}: schedule stuck in {st['phase']!r}"
+    # the survivor usually finishes the failed-over work BEFORE the
+    # partition window closes, so the run returns with the victim still
+    # dark: heal it now and give both daemons a few more polls so the
+    # republish-after-heal staleness check actually runs (drops are
+    # asserted below; a wrongly REPUBLISHED copy would also fail the
+    # history checker's duplicate-serve invariant)
+    views["engine0"].partitioned = False
+    for _ in range(5):
+        for d in daemons:
+            d.poll_once()
+        clock[0] += DT
+    by_rid = {}
+    for res in results:
+        assert res.rid not in by_rid, \
+            f"store_partition seed={seed}: rid {res.rid} served TWICE"
+        by_rid[res.rid] = res
+    assert sorted(by_rid) == sorted(r.rid for r in base), \
+        f"store_partition seed={seed}: lost requests " \
+        f"{sorted(set(r.rid for r in base) - set(by_rid))}"
+    resumed_results = 0
+    for rid, res in by_rid.items():
+        assert res.finish_reason in ("eos", "length"), res.finish_reason
+        assert np.array_equal(res.output_ids, ref[rid]), \
+            f"store_partition seed={seed}: rid {rid} diverged under " \
+            f"store faults"
+        assert res.trace_id == f"storepart-{seed}-{rid}", \
+            f"store_partition seed={seed}: rid {rid} lost its trace_id"
+        if res.resumed_tokens:
+            resumed_results += 1
+    # brownout: absorbed by the retry policy, never escalated
+    assert st["brownout_faults"] > 0, \
+        f"store_partition seed={seed}: the brownout injected nothing"
+    assert st["retries_brownout"] > 0, \
+        f"store_partition seed={seed}: brownout faults never hit the " \
+        f"retry policy"
+    assert st["failovers_at_blackout"] == 0, \
+        f"store_partition seed={seed}: a brownout became a failover"
+    # sub-grace blackout: dark, decoding, never declared dead
+    assert st["blackout_dark_seen"], \
+        f"store_partition seed={seed}: engine1 never went dark"
+    assert st["failovers_pre_partition"] == 0, \
+        f"store_partition seed={seed}: a sub-grace blackout became a " \
+        f"failover"
+    assert daemons[1].outbox_republished_total >= 1, \
+        f"store_partition seed={seed}: engine1 republished nothing " \
+        f"after its blackout healed"
+    # over-grace partition: a real failover, through the healthy store
+    assert router.failovers_total >= 1, \
+        f"store_partition seed={seed}: the partition never failed over"
+    assert "engine0" in router._failed_engines, \
+        f"store_partition seed={seed}: engine0 never declared dead"
+    assert "engine1" not in router._failed_engines, \
+        f"store_partition seed={seed}: engine1 wrongly declared dead"
+    assert resumed_results >= 1, \
+        f"store_partition seed={seed}: failover never resumed a stream"
+    assert daemons[0].outbox_stale_dropped_total >= 1, \
+        f"store_partition seed={seed}: the healed victim dropped no " \
+        f"stale buffered result — its copies went somewhere"
+    assert daemons[0].outbox_dropped_total == 0 \
+        and daemons[1].outbox_dropped_total == 0, \
+        f"store_partition seed={seed}: outbox cap overflowed"
+    assert router.fences_total == 0 and not router.self_fenced, \
+        f"store_partition seed={seed}: the sole router self-fenced"
+    leftover = backend.list(FLEET_REQUESTS_PREFIX)
+    assert not leftover, \
+        f"store_partition seed={seed}: journal entries leaked: {leftover}"
+    # the recorded linearized history passes every protocol invariant
+    recorded.save(os.path.join(root, "history.jsonl"))
+    verdict = check_history(recorded.events)
+    assert verdict.ok, \
+        f"store_partition seed={seed}: history checker FAILED: " \
+        f"{verdict.violations}"
+    stats = {
+        "seed": seed,
+        "submitted": len(base),
+        "terminal": len(by_rid),
+        "resumed_results": resumed_results,
+        "failovers": router.failovers_total,
+        "victim_declared_round": st["victim_declared_round"],
+        "brownout_faults": st["brownout_faults"],
+        "brownout_retries": st["retries_brownout"],
+        "router_store_unavailable": router.store_unavailable_total,
+        "daemon_store_unavailable": [d.store_unavailable_total
+                                     for d in daemons],
+        "outbox_republished": daemons[1].outbox_republished_total,
+        "outbox_stale_dropped": daemons[0].outbox_stale_dropped_total,
+        "history_events": verdict.checked_events,
+        "history_checks": verdict.counts,
+    }
+    stats.update(_partitioned_leader_scenario(
+        seed, os.path.join(root, "fenced"), engine, ref, base))
+    if verbose:
+        print(f"  seed={seed}: OK — brownout absorbed "
+              f"({stats['brownout_faults']} fault(s), "
+              f"{stats['brownout_retries']} retrie(s), 0 failovers); "
+              f"sub-grace blackout decoded dark "
+              f"({stats['outbox_republished']} republished on heal, 0 "
+              f"failovers); over-grace partition failed over "
+              f"({stats['failovers']}) with {stats['resumed_results']} "
+              f"resumed stream(s) and "
+              f"{stats['outbox_stale_dropped']} stale-dropped victim "
+              f"result(s); history clean over "
+              f"{stats['history_events']} op(s); partitioned leader "
+              f"self-fenced in {stats['fence_rounds']} round(s) with 0 "
+              f"dispatches/deletes, successor term "
+              f"{stats['partition_final_term']}")
+    return stats
+
+
+def _partitioned_leader_scenario(seed: int, coord_dir: str, engine,
+                                 ref: dict, base: list) -> dict:
+    """Phase 2 of :func:`run_store_partition_soak` — the LIVE but
+    partitioned leader (contrast :func:`_stalled_leader_scenario`'s
+    GC'd/hung one): router A keeps STEPPING while its own store view is
+    blacked out.  Within ``lease_s`` of its last successful renewal it
+    must self-fence — zero dispatches, zero journal deletes, not one
+    store op from the GC/flush paths while fenced — B must win the next
+    term through the healthy store and adopt, and on heal A's first
+    successful election poll re-reads leadership and stands down,
+    leaving B's re-stamped entries untouched."""
+    import numpy as np
+
+    from deepspeed_tpu.elasticity import FaultyStore, FileCoordinationStore
+    from deepspeed_tpu.inference.fleet import (FLEET_REQUESTS_PREFIX,
+                                               FleetMember, FleetRouter,
+                                               _rid_key)
+    from deepspeed_tpu.inference.serving import Request
+
+    clock = [0.0]
+    store = FileCoordinationStore(coord_dir, clock=lambda: clock[0])
+    a_store = FaultyStore(store, client="routerA")
+    serve_kw = dict(b_slots=2, page_size=8, max_model_len=64)
+    members = [FleetMember(f"engine{i}",
+                           engine.supervised_serving(max_restarts=5,
+                                                     **serve_kw),
+                           store, lease_s=1.0)
+               for i in range(2)]
+    ROUTER_LEASE, MISS = 5.0, 3
+    A = FleetRouter(a_store, members, router_id="routerA",
+                    lease_s=ROUTER_LEASE, miss_limit=MISS,
+                    journal_every_k=1)
+    B = FleetRouter(store, members, router_id="routerB",
+                    lease_s=ROUTER_LEASE, miss_limit=MISS,
+                    journal_every_k=1)
+
+    def copies():
+        return [Request(rid=r.rid, input_ids=r.input_ids,
+                        max_new_tokens=r.max_new_tokens,
+                        sampling=r.sampling, trace_id=r.trace_id)
+                for r in base]
+
+    # one extra LONG greedy stream is the fence target: the base copies
+    # are short enough to finish while A steps fenced (degraded rounds
+    # still pump the data plane), and the fence assertions need a
+    # journal entry that is still LIVE when B adopts.  Submitted first
+    # so it takes a decode slot immediately.
+    def probe_copy():
+        return Request(rid="fence_probe",
+                       input_ids=np.arange(1, 7, dtype=np.int32),
+                       max_new_tokens=56,
+                       trace_id=f"storepart-{seed}-probe")
+
+    ref = dict(ref)
+    ref["fence_probe"] = {
+        r.rid: r.output_ids
+        for r in engine.serving(**serve_kw).run([probe_copy()])
+    }["fence_probe"]
+    all_rids = set(r.rid for r in base) | {"fence_probe"}
+
+    A.submit(probe_copy())
+    for r in copies():
+        A.submit(r)
+    target = "fence_probe"
+    key = f"{FLEET_REQUESTS_PREFIX}/{_rid_key(target)}"
+    for _ in range(200):
+        A.step()
+        clock[0] += 0.2
+        doc = A._journal_docs.get(target)
+        if doc and doc.get("engine") and doc.get("tokens") \
+                and target in A._requests:
+            break
+    else:
+        raise AssertionError(
+            f"partitioned-leader seed={seed}: probe never mid-stream")
+
+    # the partition: A is alive and stepping, but every store op it
+    # issues fails.  Its data plane must keep ticking; its control
+    # plane must freeze itself within lease_s.
+    a_store.partitioned = True
+    fence_rounds = 0
+    for _ in range(int(ROUTER_LEASE / 0.2) + 10):
+        A.step()
+        clock[0] += 0.2
+        fence_rounds += 1
+        if A.self_fenced:
+            break
+    assert A.self_fenced and A.is_coordinator, \
+        f"partitioned-leader seed={seed}: no self-fence after " \
+        f"{fence_rounds} dark round(s)"
+    disp0 = A.dispatches_total
+    flushes0 = A.journal_flushes_total
+    for _ in range(20):
+        A.step()
+        clock[0] += 0.2
+    assert A.dispatches_total == disp0, \
+        f"partitioned-leader seed={seed}: fenced router dispatched"
+    assert A.journal_flushes_total == flushes0, \
+        f"partitioned-leader seed={seed}: fenced router flushed the " \
+        f"journal"
+
+    # B wins the next term through the healthy store and re-stamps
+    for _ in range(50):
+        B.step()
+        clock[0] += 0.2
+        if B.is_coordinator:
+            break
+    assert B.is_coordinator and B.term == 2, \
+        f"partitioned-leader seed={seed}: election never converged " \
+        f"({B.term})"
+    adopted = store.get(key)
+    assert adopted is not None and adopted.get("owner") == "routerB", \
+        f"partitioned-leader seed={seed}: takeover did not re-stamp " \
+        f"{key}: {adopted}"
+
+    # the fenced ex-leader's GC and flush paths must not attempt ONE
+    # store op — deferral, not a lost compare-delete race
+    ops0 = a_store.ops_total
+    A._journal_delete(target)
+    A._flush_token_journal()
+    assert a_store.ops_total == ops0, \
+        f"partitioned-leader seed={seed}: a fenced router reached for " \
+        f"the store"
+    assert target in A._pending_gc, \
+        f"partitioned-leader seed={seed}: fenced GC not deferred"
+    assert store.get(key).get("owner") == "routerB"
+
+    # heal: the first successful poll IS the leadership re-read
+    a_store.partitioned = False
+    A.step()
+    clock[0] += 0.2
+    assert not A.self_fenced and not A.is_coordinator, \
+        f"partitioned-leader seed={seed}: healed ex-leader kept leading"
+    assert store.get(key).get("owner") == "routerB", \
+        f"partitioned-leader seed={seed}: heal disturbed the " \
+        f"successor's adopted entry"
+
+    # B converges every stream; each rid terminal EXACTLY once across
+    # both routers' claims (A holds only what it collected-and-GC'd
+    # while healthy — degraded rounds never collect)
+    results = list(A.take_results())
+    results += B.run([], max_ticks=4000,
+                     on_tick=lambda r, n: clock.__setitem__(0, clock[0] + 1.0))
+    by_rid = {}
+    for res in results:
+        assert res.rid not in by_rid, \
+            f"partitioned-leader seed={seed}: rid {res.rid} served TWICE"
+        by_rid[res.rid] = res
+    assert set(by_rid) == all_rids, \
+        f"partitioned-leader seed={seed}: lost " \
+        f"{sorted(map(repr, all_rids - set(by_rid)))}"
+    for rid, res in by_rid.items():
+        assert res.finish_reason in ("eos", "length"), res.finish_reason
+        assert np.array_equal(res.output_ids, ref[rid]), \
+            f"partitioned-leader seed={seed}: rid {rid} diverged"
+    leftover = store.list(FLEET_REQUESTS_PREFIX)
+    assert not leftover, \
+        f"partitioned-leader seed={seed}: journal leaked: {leftover}"
+    return {
+        "fenced_target": target,
+        "fence_rounds": fence_rounds,
+        "fences_total": A.fences_total,
+        "fenced_dispatch_delta": A.dispatches_total - disp0,
+        "partition_final_term": B.term,
+        "partition_parity_checked": len(by_rid),
+    }
+
+
 def run_hybrid_soak(seed: int, rounds: int = 3, steps_per_round: int = 2,
                     n_prompts: int = 5, max_new: int = 6,
                     verbose: bool = True) -> dict:
@@ -1608,7 +2104,7 @@ def main(argv=None) -> int:
                     "subsystem")
     ap.add_argument("--mode",
                     choices=("train", "serve", "pod", "fleet",
-                             "fleet_procs", "hybrid"),
+                             "fleet_procs", "store_partition", "hybrid"),
                     default="train",
                     help="train: supervised elastic rounds; serve: "
                          "ServingSupervisor kill/replay soak; pod: "
@@ -1618,7 +2114,12 @@ def main(argv=None) -> int:
                          "fleet_procs: REAL member-daemon subprocesses "
                          "with a mid-stream SIGKILL plus the stalled-"
                          "leader/compare-delete race (ISSUE 16, "
-                         "docs/FLEET.md); hybrid: train+rollout rounds "
+                         "docs/FLEET.md); store_partition: brownouts, "
+                         "asymmetric member partitions and a partitioned "
+                         "LEADER over per-client FaultyStore views, with "
+                         "the recorded op history protocol-checked "
+                         "(ISSUE 18, docs/FLEET.md \"Store brownouts and "
+                         "partitions\"); hybrid: train+rollout rounds "
                          "with mid-train-step AND mid-rollout kills (loss "
                          "continuity + rollout replay parity + pool "
                          "invariant, docs/HYBRID.md)")
@@ -1699,6 +2200,20 @@ def main(argv=None) -> int:
                     seed, root, n_requests=args.requests
                     if args.requests != 8 else 6,
                     n_members=args.members))
+            except Exception as e:
+                failures += 1
+                print(f"  FAILED ({type(e).__name__}): {e}", file=sys.stderr)
+            finally:
+                if not args.keep_dirs:
+                    shutil.rmtree(root, ignore_errors=True)
+            continue
+        if args.mode == "store_partition":
+            root = tempfile.mkdtemp(prefix=f"chaos_storepart_{seed}_")
+            print(f"store_partition soak {i + 1}/{args.soaks} "
+                  f"(seed={seed}) -> {root}")
+            try:
+                all_stats.append(run_store_partition_soak(
+                    seed, root, n_requests=args.requests))
             except Exception as e:
                 failures += 1
                 print(f"  FAILED ({type(e).__name__}): {e}", file=sys.stderr)

@@ -72,7 +72,7 @@ def main(argv=None) -> int:
     import jax.numpy as jnp
 
     import deepspeed_tpu
-    from deepspeed_tpu.elasticity import FileCoordinationStore
+    from deepspeed_tpu.elasticity import FileCoordinationStore, maybe_faulty
     from deepspeed_tpu.inference.fleet import FleetMember
     from deepspeed_tpu.inference.fleet_daemon import FleetMemberDaemon
     from deepspeed_tpu.models import CausalLM
@@ -101,7 +101,11 @@ def main(argv=None) -> int:
                 sampling=SamplingParams(temperature=1.0, top_k=8,
                                         top_p=0.9, seed=0)),
     ])
-    store = FileCoordinationStore(args.coord_dir)
+    # DS_TPU_STORE_FAULTS (when armed) injects this member's fault
+    # schedule between the daemon and the real store — how the
+    # store_partition soak browns out SPECIFIC processes from outside
+    store = maybe_faulty(FileCoordinationStore(args.coord_dir),
+                         client=args.engine_id)
     member = FleetMember(args.engine_id, sup, store, lease_s=args.lease_s)
     member.beat(force=True)   # advertise immediately: the router may be up
     daemon = FleetMemberDaemon(member, store,

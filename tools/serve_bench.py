@@ -982,6 +982,145 @@ def _collect_fleet_trace(router, members, copies, out_dir: str) -> dict:
     }
 
 
+def run_store_latency_bench(model_name: str = "llama-374m",
+                            b_slots: int = 4, n_requests: int = 20,
+                            seed: int = 0, page_size: int = 128,
+                            max_model_len: int = 0,
+                            store_latency_ms: float = 20.0,
+                            journal_every_k: int = 4) -> dict:
+    """Store-latency sweep (ISSUE 18; docs/FLEET.md "Store brownouts and
+    partitions"): the SAME daemonized-member fleet run at store op
+    latencies of 0, N/2 and N ms (a :class:`FaultyStore` latency rule on
+    every op the member daemon issues), proving the data/control-plane
+    split: decode throughput stays FLAT while the member's store CAS
+    p50/p99 grows with the injected delay, because the daemon's store
+    polls are rate-gated (``min_store_poll_s``) and decode never waits
+    on the control plane.  A coupled design would show tok/s falling
+    1:1 with store latency."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import jax
+
+    from deepspeed_tpu.elasticity import (FaultyStore,
+                                          FileCoordinationStore,
+                                          StoreFaultRule)
+    from deepspeed_tpu.inference.fleet import FleetMember, FleetRouter
+    from deepspeed_tpu.inference.fleet_daemon import (FleetMemberDaemon,
+                                                      StoreMemberProxy)
+
+    on_tpu = jax.devices()[0].platform not in ("cpu",)
+    if not on_tpu:
+        model_name, prompt_rng = "serve-fleet(cpu)", (3, 14)
+        new_choices = (16, 24, 32)
+        base_cfg = "tiny"
+    else:
+        prompt_rng, new_choices = (4, 48), (32, 64, 96)
+        base_cfg = model_name
+    max_model_len = max_model_len or (64 if not on_tpu else 2048)
+    page_size = min(page_size, max_model_len)
+    model, engine = _build_bench_engine(base_cfg, max_model_len, on_tpu)
+    stream = build_stream(model.config.vocab_size, n_requests, seed,
+                          0.0, prompt_rng, new_choices)
+    copies = lambda: _clone_requests(stream)          # noqa: E731
+    serve_kw = dict(b_slots=b_slots, page_size=page_size,
+                    max_model_len=max_model_len)
+
+    # warm + parity oracle
+    ref_sup = engine.supervised_serving(**serve_kw)
+    ref_sup.run(copies())
+    ref = {r.rid: r.output_ids for r in ref_sup.run(copies())}
+    del ref_sup
+
+    # the daemon touches the store at most once per POLL_S seconds of
+    # wall time — the decoupling under test; identical at every point so
+    # the publish-cadence rounding cancels out of the throughput ratio
+    POLL_S = 1.0
+    delays_ms = sorted({0.0, store_latency_ms / 2.0, store_latency_ms})
+    points = []
+    for ms in delays_ms:
+        coord_dir = tempfile.mkdtemp(prefix="storefault_bench_")
+        try:
+            backend = FileCoordinationStore(coord_dir)
+            rules = []
+            if ms > 0:
+                rules.append(StoreFaultRule(ops="*", kind="latency",
+                                            delay_s=ms / 1e3))
+            d_store = FaultyStore(backend, client="engine0", rules=rules)
+            member = FleetMember("engine0",
+                                 engine.supervised_serving(**serve_kw),
+                                 d_store, lease_s=5.0)
+            member.beat(force=True)
+            daemon = FleetMemberDaemon(member, d_store,
+                                       min_store_poll_s=POLL_S)
+            proxy = StoreMemberProxy("engine0", backend,
+                                     router_id="bench", lease_s=5.0)
+            proxy.beat()
+            router = FleetRouter(backend, [proxy], router_id="bench",
+                                 lease_s=30.0,
+                                 journal_every_k=journal_every_k)
+            t0 = time.perf_counter()
+            results = router.run(
+                copies(), max_ticks=1000000,
+                on_tick=lambda r, n: daemon.poll_once())
+            dt = time.perf_counter() - t0
+            cas = d_store.op_latency_percentiles().get("cas") or {}
+            total_tokens = sum(len(r.output_ids) for r in results)
+            points.append({
+                "store_latency_ms": ms,
+                "tokens_per_sec": round(total_tokens / dt, 1),
+                "total_tokens": total_tokens,
+                "wall_s": round(dt, 3),
+                "cas_p50_ms": round(cas.get("p50", 0.0) * 1e3, 3),
+                "cas_p99_ms": round(cas.get("p99", 0.0) * 1e3, 3),
+                "cas_samples": int(cas.get("n", 0)),
+                "store_ops_total": d_store.ops_total,
+                "latency_rule_fires": sum(r.fires for r in rules),
+                "parity": all(
+                    np.array_equal(r.output_ids, ref[r.rid])
+                    for r in results
+                    if r.finish_reason in ("eos", "length")),
+                "none_lost": sorted(map(str, (r.rid for r in results)))
+                == sorted(map(str, (r.rid for r in stream))),
+            })
+        finally:
+            shutil.rmtree(coord_dir, ignore_errors=True)
+
+    base_pt, top_pt = points[0], points[-1]
+    flat_ratio = (top_pt["tokens_per_sec"]
+                  / max(base_pt["tokens_per_sec"], 1e-9))
+    # growth is gated on the p50 (the p99 of the zero-latency baseline is
+    # an fsync outlier on a loaded box; the p50 isolates the injected
+    # delay), p99 stays reported
+    cas_growth = (top_pt["cas_p50_ms"]
+                  / max(base_pt["cas_p50_ms"], 1e-3))
+    return {
+        "metric": "serve-storefault",
+        "value": round(flat_ratio, 3),
+        "unit": "throughput_ratio_at_max_latency",
+        "detail": {
+            "model": model_name,
+            "platform": jax.devices()[0].platform,
+            "b_slots": b_slots,
+            "n_requests": n_requests,
+            "seed": seed,
+            "store_latency_ms": store_latency_ms,
+            "min_store_poll_s": POLL_S,
+            "journal_every_k": journal_every_k,
+            "points": points,
+            # the two halves of the decoupling claim
+            "throughput_flat": flat_ratio >= 0.70,
+            "cas_p50_growth": round(cas_growth, 1),
+            "cas_p50_grew": cas_growth >= 2.0,
+            "parity": all(p["parity"] for p in points),
+            "none_lost": all(p["none_lost"] for p in points),
+            "harness": "cooperative-in-process",
+        },
+    }
+
+
 def run_sampled_bench(model_name: str = "llama-374m", b_slots: int = 8,
                       n_requests: int = 32, seed: int = 0,
                       page_size: int = 128, max_model_len: int = 0,
@@ -1464,6 +1603,15 @@ def main(argv=None) -> int:
                          "store clock (ISSUE 11 satellite; composes with "
                          "--journal_every_k — either trigger flushes; the "
                          "JSON reports per-flush CAS p50/p99 to tune it)")
+    ap.add_argument("--store_latency_ms", type=float, default=None,
+                    metavar="N",
+                    help="fleet mode: sweep the daemonized-member fleet "
+                         "at injected store op latencies of 0, N/2 and "
+                         "N ms (FaultyStore latency rules on the member "
+                         "daemon's store) — decode tok/s must stay flat "
+                         "while the member's CAS p50/p99 grows with the "
+                         "delay (docs/FLEET.md \"Store brownouts and "
+                         "partitions\")")
     ap.add_argument("--collect_traces", default=None, metavar="DIR",
                     help="fleet mode: run one EXTRA traced pass (measured "
                          "numbers stay untraced), publish every owner's "
@@ -1579,6 +1727,9 @@ def main(argv=None) -> int:
               and d["sharded"]["kv_pool_bytes_per_device"] * args.tp
               == d["sharded"]["kv_pool_bytes_total"])
         return 0 if ok else 1
+    if args.store_latency_ms is not None and args.mode != "fleet":
+        ap.error("--store_latency_ms sweeps the daemonized fleet — use "
+                 "--mode fleet")
     if args.mode == "fleet":
         if args.workload != "mixed":
             ap.error("--mode fleet runs the mixed stream (prefix reuse is "
@@ -1586,6 +1737,31 @@ def main(argv=None) -> int:
         if args.trace or args.device_trace or args.rate_rps:
             ap.error("--trace/--device_trace/--rate_rps are not supported "
                      "with --mode fleet (the router owns arrival gating)")
+        if args.store_latency_ms is not None:
+            if args.kill_engine or args.collect_traces or args.n_routers > 1:
+                ap.error("--store_latency_ms is its own sweep — it does "
+                         "not compose with --kill_engine/--collect_traces/"
+                         "--n_routers")
+            result = run_store_latency_bench(
+                args.model,
+                b_slots=args.b_slots if args.b_slots is not None else 4,
+                n_requests=(args.n_requests
+                            if args.n_requests is not None else 20),
+                seed=args.seed,
+                page_size=(args.page_size
+                           if args.page_size is not None else 128),
+                max_model_len=args.max_model_len,
+                store_latency_ms=args.store_latency_ms,
+                journal_every_k=args.journal_every_k or None)
+            line = json.dumps(result)
+            print(line)
+            if args.out:
+                with open(args.out, "w") as f:
+                    f.write(line + "\n")
+            d = result["detail"]
+            ok = (d["parity"] and d["none_lost"] and d["throughput_flat"]
+                  and d["cas_p50_grew"])
+            return 0 if ok else 1
         result = run_fleet_bench(
             args.model, n_engines=args.n_engines,
             b_slots=args.b_slots if args.b_slots is not None else 4,
