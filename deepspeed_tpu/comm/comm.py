@@ -129,16 +129,12 @@ def ppermute(tensor, axis: str, perm):
 def send_recv_next(tensor, axis: str):
     """Shift +1 along a mesh axis ring (stage i -> i+1); last wraps to 0 but
     pipeline schedules never read the wrapped value."""
-    import jax.lax as lax
-
-    n = lax.axis_size(axis)
+    n = _backend.axis_size(axis)
     return _backend.permute(tensor, axis, [(i, (i + 1) % n) for i in range(n)])
 
 
 def send_recv_prev(tensor, axis: str):
-    import jax.lax as lax
-
-    n = lax.axis_size(axis)
+    n = _backend.axis_size(axis)
     return _backend.permute(tensor, axis, [((i + 1) % n, i) for i in range(n)])
 
 

@@ -153,9 +153,16 @@ class MeshExecutor:
     def __init__(self, model, params, num_pages: int, page_size: int,
                  b_slots: int, dtype=None, kv_dtype=None, mesh=None,
                  prefix_cache: bool = True, host_tier: bool = False,
-                 catalog: Optional[ProgramCatalog] = None):
+                 catalog: Optional[ProgramCatalog] = None, adapters=None):
         self.model = model
         self.mesh = mesh
+        # multi-tenant adapter serving (docs/SERVING.md): when an
+        # AdapterRegistry rides along, EVERY decode/prefill/verify program
+        # traces the per-slot LoRA factor stacks as one extra operand —
+        # always present, so the inventory is bit-identical across any
+        # tenant mix (adapter-less slots ride all-zero factors).  None
+        # keeps today's program signatures byte-identical.
+        self.adapters = adapters
         # per-program accounting (observability/program_stats.py): FLOPs
         # from lowered cost analysis at first invocation (no extra backend
         # compile), invocation counts per call, optional synced sampling.
@@ -261,6 +268,10 @@ class MeshExecutor:
         # lanes are constant across a request's whole decode, so the
         # per-tick call must not pay 4 host->device transfers for them
         self._lanes_device = None
+        # device copy of the per-slot adapter factor stacks, same
+        # invalidation contract as the lanes: constant across a request's
+        # decode, rebuilt only when slot membership changes
+        self._adapters_device = None
 
     # k/v pool views: the canonical state is the `pools` tuple (programs
     # consume/produce it whole so donation covers every leaf); kpool/vpool
@@ -286,6 +297,20 @@ class MeshExecutor:
     def _build_decode(self):
         apply_paged = self.model.apply_paged
 
+        if self.adapters is not None:
+            def prog(params, pools, page_table, lengths, last_tok, active,
+                     temp, top_k, top_p, seeds, adapters):
+                cache = paged_pool_cache(pools)
+                logits, cache = apply_paged(
+                    params, last_tok[:, None], cache, page_table, lengths,
+                    active[:, None], adapters=adapters)
+                nxt = sample_tokens(logits[:, -1, :], temp, top_k, top_p,
+                                    lambda: position_keys(seeds, lengths + 1))
+                return nxt, paged_pool_tuple(cache)
+
+            return pool_jit(prog, self._donate, self.mesh,
+                            self._pool_specs, 1)
+
         def prog(params, pools, page_table, lengths, last_tok, active,
                  temp, top_k, top_p, seeds):
             # write each slot's last token at position `lengths`, read the
@@ -306,6 +331,24 @@ class MeshExecutor:
 
     def _build_prefill(self, s_pad: int):
         apply_paged = self.model.apply_paged
+
+        if self.adapters is not None:
+            def prog(params, pools, pt_row, tokens, n_real, start,
+                     temp, top_k, top_p, seed, adapters):
+                seq_mask = (jnp.arange(s_pad, dtype=jnp.int32)
+                            < n_real)[None, :]
+                cache = paged_pool_cache(pools)
+                logits, cache = apply_paged(params, tokens, cache, pt_row,
+                                            start[None], seq_mask,
+                                            adapters=adapters)
+                lg = logits[0, n_real - 1, :][None]        # [1, V]
+                nxt = sample_tokens(
+                    lg, temp, top_k, top_p,
+                    lambda: position_keys(seed, (start + n_real)[None]))[0]
+                return nxt, paged_pool_tuple(cache)
+
+            return pool_jit(prog, self._donate, self.mesh,
+                            self._pool_specs, 1)
 
         def prog(params, pools, pt_row, tokens, n_real, start,
                  temp, top_k, top_p, seed):
@@ -384,13 +427,20 @@ class MeshExecutor:
     # first sight, count the dispatch, sample the synced wall time on the
     # picked invocations (docs/OBSERVABILITY.md "Per-program accounting").
 
-    def decode(self, page_table, lengths, last_tok, active, lanes):
+    def decode(self, page_table, lengths, last_tok, active, lanes,
+               adapters=None):
         """One fixed-shape decode step over all slots; returns the sampled
         [B_slots] token vector (device array — the caller fetches inside
-        its watchdog window) and updates the pools in place."""
+        its watchdog window) and updates the pools in place.  With an
+        adapter registry attached, ``adapters`` is the per-slot factor
+        pytree (``adapter_stacks``); ``None`` rides the cached all-zero
+        stacks (base-model traffic) — the program signature never changes."""
         args = (self.params, self.pools,
                 jnp.asarray(page_table), jnp.asarray(lengths),
                 jnp.asarray(last_tok), jnp.asarray(active), *lanes)
+        if self.adapters is not None:
+            args += (adapters if adapters is not None
+                     else self._adapter_zero(),)
         t0 = account(self.catalog, "decode", self._decode_prog, args)
         nxt, self.pools = self._decode_prog(*args)
         if t0 is not None:
@@ -398,10 +448,12 @@ class MeshExecutor:
         return nxt
 
     def prefill(self, s_pad: int, pt_row, tokens, n_real, start,
-                lane_t, lane_k, lane_p, lane_s):
+                lane_t, lane_k, lane_p, lane_s, adapter_row=None):
         """One bucketed prefill ([1, s_pad]); returns the first sampled
         token (device scalar) and updates the pools.  Builds the bucket's
-        program on first use — the bucket set IS the program inventory."""
+        program on first use — the bucket set IS the program inventory.
+        ``adapter_row`` is the admitted slot's one-slot factor slice
+        (:meth:`adapter_row`) when a registry rides along."""
         prog = self._prefill_progs.get(s_pad)
         if prog is None:
             prog = self._prefill_progs[s_pad] = self._build_prefill(s_pad)
@@ -414,6 +466,9 @@ class MeshExecutor:
                 np.asarray([lane_k], np.int32),
                 np.asarray([lane_p], np.float32),
                 np.asarray([lane_s], np.uint32))
+        if self.adapters is not None:
+            args += (adapter_row if adapter_row is not None
+                     else self._adapter_zero_row(),)
         t0 = account(self.catalog, f"prefill_{s_pad}", prog, args)
         nxt, self.pools = prog(*args)
         if t0 is not None:
@@ -499,6 +554,45 @@ class MeshExecutor:
 
     def invalidate_lanes(self) -> None:
         self._lanes_device = None
+
+    # per-slot adapter operand cache — the same contract as the sampling
+    # lanes: constant across a request's decode, invalidated only when a
+    # slot's adapter membership changes (admission / retirement)
+
+    def adapter_stacks(self, host_stacks):
+        """Cached device copy of the engine's per-slot adapter factor
+        stacks (``AdapterRegistry.make_slot_stacks`` layout)."""
+        if self._adapters_device is None:
+            self._adapters_device = jax.tree_util.tree_map(
+                jnp.asarray, host_stacks)
+        return self._adapters_device
+
+    def invalidate_adapters(self) -> None:
+        self._adapters_device = None
+
+    @staticmethod
+    def adapter_row(host_stacks, slot: int):
+        """One slot's factor slice of the host stacks, shaped for the
+        [1, s_pad] prefill programs — numpy views, so slicing is free and
+        every slot shares the ONE per-bucket program shape."""
+        s = int(slot)
+        return {"scale": host_stacks["scale"][s:s + 1],
+                "factors": {k: {"A": ab["A"][:, s:s + 1],
+                                "B": ab["B"][:, s:s + 1]}
+                            for k, ab in host_stacks["factors"].items()}}
+
+    def _adapter_zero(self):
+        """All-zero decode stacks (base-model fallback operand)."""
+        if getattr(self, "_adapter_zero_host", None) is None:
+            self._adapter_zero_host = self.adapters.make_slot_stacks(
+                self.b_slots)
+        return jax.tree_util.tree_map(jnp.asarray, self._adapter_zero_host)
+
+    def _adapter_zero_row(self):
+        if getattr(self, "_adapter_zero_host", None) is None:
+            self._adapter_zero_host = self.adapters.make_slot_stacks(
+                self.b_slots)
+        return self.adapter_row(self._adapter_zero_host, 0)
 
     # ------------------------------------------------------------- health
 

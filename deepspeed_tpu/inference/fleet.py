@@ -109,6 +109,7 @@ from ..observability.slo import SloEvaluator, SloRule
 from ..observability.trace import (get_tracer, new_trace_id, trace_span,
                                    trace_tags)
 from ..utils.logging import log_dist, logger
+from .adapters import adapter_salt
 from .prefix_cache import chain_keys
 from .sampling import SamplingParams
 from .serving import Request, RequestResult, ServeTimeout, SlotPrefillError
@@ -214,6 +215,7 @@ def request_to_doc(req: Request) -> Dict[str, Any]:
         "sampling": (dataclasses.asdict(req.sampling)
                      if req.sampling is not None else None),
         "trace_id": req.trace_id,
+        "adapter_id": req.adapter_id,
     }
 
 
@@ -228,7 +230,8 @@ def request_from_doc(doc: Dict[str, Any]) -> Request:
         arrival_epoch_s=doc.get("arrival_epoch_s"),
         sampling=(SamplingParams(**doc["sampling"])
                   if doc.get("sampling") else None),
-        trace_id=doc.get("trace_id"))
+        trace_id=doc.get("trace_id"),
+        adapter_id=doc.get("adapter_id"))
 
 
 def result_to_doc(res: RequestResult) -> Dict[str, Any]:
@@ -252,6 +255,7 @@ def result_to_doc(res: RequestResult) -> Dict[str, Any]:
         "failovers": int(res.failovers),
         "resumed_tokens": int(res.resumed_tokens),
         "trace_id": res.trace_id,
+        "adapter_id": res.adapter_id,
         "lifecycle": [list(e) for e in res.lifecycle],
     }
 
@@ -274,6 +278,7 @@ def result_from_doc(doc: Dict[str, Any]) -> RequestResult:
         failovers=int(doc.get("failovers") or 0),
         resumed_tokens=int(doc.get("resumed_tokens") or 0),
         trace_id=doc.get("trace_id"),
+        adapter_id=doc.get("adapter_id"),
         lifecycle=[tuple(e) for e in doc.get("lifecycle") or []])
 
 
@@ -414,6 +419,12 @@ class FleetMember:
             "host_tier_bytes": h["host_tier_bytes"],
             "promotions_total": h["promotions_total"],
             "demotions_total": h["demotions_total"],
+            # multi-tenant adapter residency (docs/FLEET.md "Adapter
+            # residency routing"): the adapter ids this engine can serve —
+            # the router prefers members already holding a request's
+            # adapter, and refuses to dispatch one nobody has loaded
+            "adapters_loaded": list(h.get("adapters_loaded", [])),
+            "fused_adapter_id": h.get("fused_adapter_id"),
             # SLO firing states (docs/OBSERVABILITY.md "SLOs and alerts"):
             # rule names currently firing on this engine — the router
             # rolls the fleet-wide count up as fleet/alerts_firing
@@ -695,6 +706,11 @@ class FleetRouter:
         self.prefix_affinity = bool(prefix_affinity)
         self.affinity_load_slack = int(affinity_load_slack)
         self.affinity_routes_total = 0
+        # adapter-residency routing (docs/FLEET.md "Adapter residency
+        # routing"): adapter-tagged dispatches that landed on a member
+        # with the adapter already loaded (same slack bound as prefix
+        # affinity — residency must not amplify a tenant hot-spot either)
+        self.adapter_routes_total = 0
         # per-round memo of each member's digest as a {chain_key: tier}
         # map: scoring walks the full index otherwise, and a dispatch
         # burst would rebuild it per member per request on the admission
@@ -1166,10 +1182,29 @@ class FleetRouter:
         handle — the store advertisement carries the SAME numbers for
         cross-process consumers, but it is refreshed once per round and
         several dispatches can land within one, so routing must see each
-        dispatch it just made.  engine_id breaks ties deterministically."""
+        dispatch it just made.  engine_id breaks ties deterministically.
+
+        Multi-tenant requests add two terms (docs/FLEET.md "Adapter
+        residency routing").  A HARD one: a member serving a fused
+        adapter view (``fused_adapter_id`` set) only admits that tenant,
+        so every other request skips it — routing there would bounce at
+        the engine's fused-exclusive submit guard.  And a SOFT one: an
+        adapter-tagged request prefers the least-loaded member that has
+        its adapter registered (live registry for in-process members,
+        ``adapters_loaded`` advertisement — at most one beat stale — for
+        cross-process ones) under the same ``affinity_load_slack``
+        bound, counted by ``adapter_routes_total``; prefix affinity then
+        refines the pick AMONG adapter-resident candidates using the
+        tenant-salted chain keys.  With no resident member in slack the
+        request falls back to least-loaded (an engine without the
+        registration sheds it typed at submit — registry sync across a
+        heterogeneous fleet is the operator's job)."""
+        want = (getattr(request, "adapter_id", None)
+                if request is not None else None)
         best = None
         best_load = None
         loads: Dict[str, int] = {}
+        resident: Dict[str, bool] = {}
         for eid in sorted(self.members):
             m = self.members[eid]
             if not (m.alive and m.routable):
@@ -1180,14 +1215,43 @@ class FleetRouter:
                 # not an admission target — no request is ever admitted
                 # against stale weights
                 continue
+            if request is not None:
+                loaded, fused = self._member_adapter_state(m)
+                if fused is not None and fused != want:
+                    # fused-exclusive member: only its own tenant lands
+                    continue
+                resident[eid] = want is not None and (want in loaded
+                                                      or fused == want)
             loads[eid] = m.outstanding()
             if best_load is None or loads[eid] < best_load:
                 best, best_load = eid, loads[eid]
-        if best is None or request is None or not self.prefix_affinity:
+        if best is None or request is None:
             return best
+        cand = loads
+        floor = best
+        if want is not None:
+            rset = [eid for eid in sorted(loads) if resident.get(eid)]
+            ad_best = min(rset, key=lambda e: loads[e], default=None)
+            if ad_best is None or \
+                    loads[ad_best] - best_load > self.affinity_load_slack:
+                return best
+            if ad_best != best:
+                logger.info(
+                    "fleet: routing %r to %s on adapter residency "
+                    "(%r loaded, load %d vs min %d)", request.rid, ad_best,
+                    want, loads[ad_best], best_load)
+            self.adapter_routes_total += 1
+            # prefix affinity below only refines among the members that
+            # can actually serve this tenant, inside the same slack
+            cand = {eid: loads[eid] for eid in rset
+                    if loads[eid] - best_load <= self.affinity_load_slack}
+            floor = ad_best
+        if not self.prefix_affinity:
+            return floor
         aff_best, aff_score = None, 0
+        salt = adapter_salt(want)
         key_memo: Dict[int, List[int]] = {}
-        for eid in sorted(loads):
+        for eid in sorted(cand):
             m = self.members[eid]
             ps = int(m.sup.engine.page_size) if m.alive else 0
             if ps <= 0:
@@ -1196,9 +1260,12 @@ class FleetRouter:
             if keys is None:
                 # the same cap as the engine's own lookup: the last prompt
                 # token always prefills, so it can never be resident
+                # tenant-salted schedule: an adapter-tagged request's
+                # resident chunks live under its salted namespace, and a
+                # base request can never false-hit a tenant's chunks
                 keys = key_memo[ps] = chain_keys(
                     request.input_ids, ps,
-                    limit=len(request.input_ids) - 1)
+                    limit=len(request.input_ids) - 1, salt=salt)
             score = self._affinity_score(keys, m)
             if score > aff_score:
                 aff_best, aff_score = eid, score
@@ -1211,7 +1278,25 @@ class FleetRouter:
                     aff_score, loads[aff_best], best_load)
             self.affinity_routes_total += 1
             return aff_best
-        return best
+        return floor
+
+    def _member_adapter_state(self, member: FleetMember
+                              ) -> Tuple[set, Optional[str]]:
+        """(loaded adapter ids, fused adapter id) for routing.  A live
+        in-process member answers from its engine's registry — routing
+        must see a registration made since the last beat — otherwise the
+        last advertisement serves (the cross-process transport, at most
+        one beat stale).  No registry anywhere reads as (empty, None):
+        such a member admits base traffic only."""
+        if member.alive:
+            eng = getattr(member.sup, "engine", None)
+            reg = getattr(eng, "adapters", None)
+            if reg is not None:
+                return (set(reg.loaded()),
+                        getattr(eng, "fused_adapter_id", None))
+        ad = member.last_advert or {}
+        return (set(ad.get("adapters_loaded") or ()),
+                ad.get("fused_adapter_id"))
 
     def _affinity_score(self, keys: List[int], member: FleetMember) -> int:
         """Leading prefix chunks of ``keys`` resident on ``member``: 2 per
@@ -1409,6 +1494,11 @@ class FleetRouter:
             "sampling": (dataclasses.asdict(request.sampling)
                          if request.sampling is not None else None),
             "lane_counter": len(request.input_ids) + len(resumed),
+            # multi-tenant serving (docs/SERVING.md): the tenant identity
+            # rides the journal so a failover resume re-prefills under
+            # the SAME adapter — prompt+journaled reconstruction with the
+            # wrong (or no) delta would be silently non-token-exact
+            "adapter_id": request.adapter_id,
             # distributed tracing (docs/OBSERVABILITY.md): the trace id —
             # a failover reconstruction continues the SAME trace on the
             # new engine — plus the router-recorded lifecycle markers
@@ -2159,7 +2249,10 @@ class FleetRouter:
                       if rec.get("sampling") else None),
             # the journaled trace id: the adopted request stays
             # ONE trace across coordinator takeovers too
-            trace_id=rec.get("trace_id"))
+            trace_id=rec.get("trace_id"),
+            # the journaled tenant: adoption re-routes by adapter
+            # residency and any later resume re-prefills under it
+            adapter_id=rec.get("adapter_id"))
         self._requests[rid] = req
         if rec.get("failovers"):
             self._failed_over[rid] = int(rec["failovers"])
@@ -2375,6 +2468,7 @@ class FleetRouter:
             "journal_bytes": self.journal_bytes(),
             "journal_flushes_total": self.journal_flushes_total,
             "affinity_routes_total": self.affinity_routes_total,
+            "adapter_routes_total": self.adapter_routes_total,
             "residency": self._residency_rollup(ads),
             # fleet-wide SLO rollup: every (engine, rule) currently firing
             # anywhere on the fleet, from the member advertisements
@@ -2494,6 +2588,10 @@ class FleetRouter:
              float(res["demotions_total"]), self._tick),
             ("fleet/affinity_routes_total",
              float(self.affinity_routes_total), self._tick),
+            # multi-tenant adapter serving (docs/SERVING.md): dispatches
+            # that landed by adapter residency
+            ("fleet/adapter_routes_total",
+             float(self.adapter_routes_total), self._tick),
             # SLO rollup (docs/OBSERVABILITY.md "SLOs and alerts"): count
             # of (engine, rule) pairs firing anywhere on the fleet — one
             # scrape of the router's endpoint answers "is any member

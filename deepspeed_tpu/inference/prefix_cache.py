@@ -79,19 +79,34 @@ __all__ = ["PrefixIndex", "PrefixMatch", "chain_keys"]
 _ROOT = 0x9E3779B97F4A7C15
 
 
-def chain_keys(ids, page_size: int, limit: Optional[int] = None) -> List[int]:
+def _salted_root(salt: int) -> int:
+    """Chain root for a salted namespace (multi-tenant adapter serving,
+    docs/SERVING.md): ``salt`` folds into the root so EVERY key of the
+    chain — full chunks and the ``("p", h, part)`` boundary keys alike —
+    lands in a disjoint namespace per salt.  Tenant A's system prompt can
+    then never prefix-hit or COW into tenant B's stream, because their
+    chains never share a single key.  Salt 0 is the unsalted (base-model)
+    namespace, bit-identical to the pre-adapter behaviour."""
+    s = int(salt)
+    return _ROOT if s == 0 else hash((_ROOT, s))
+
+
+def chain_keys(ids, page_size: int, limit: Optional[int] = None,
+               salt: int = 0) -> List[int]:
     """The chain-key sequence of ``ids``'s page-aligned full chunks — the
     SAME schedule :class:`PrefixIndex` files full entries under, exposed so
     a fleet router can compute a request's keys without an index and match
     them against per-engine residency digests (``inference/fleet.py``).
     Keys are content-derived (ints and int tuples hash deterministically
     across processes — PYTHONHASHSEED only perturbs str/bytes), so two
-    engines that cached the same prefix publish the same keys."""
+    engines that cached the same prefix publish the same keys.  ``salt``
+    must itself be process-independent (the engine derives it from the
+    adapter id via crc32, never Python ``hash`` of the string)."""
     if limit is not None:
         ids = ids[:max(0, int(limit))]
     tup = tuple(int(t) for t in ids)
     ps = int(page_size)
-    h, out, n = _ROOT, [], 0
+    h, out, n = _salted_root(salt), [], 0
     while n + ps <= len(tup):
         h = PrefixIndex._chain(h, tup[n:n + ps])
         out.append(h)
@@ -186,7 +201,7 @@ class PrefixIndex:
 
     # ----------------------------------------------------------- lookup
 
-    def lookup(self, ids, limit: int) -> PrefixMatch:
+    def lookup(self, ids, limit: int, salt: int = 0) -> PrefixMatch:
         """Longest resident prefix of ``ids[:limit]``.
 
         ``limit`` caps the match (the engine passes ``len(prompt) - 1`` so
@@ -195,10 +210,12 @@ class PrefixIndex:
         Matched entries are LRU-touched.  Exact: every matched chunk's
         stored tokens are compared verbatim, so a chain-hash collision is a
         miss, never a wrong page.  Demoted full chunks match with page
-        ``-1`` (the caller promotes before mapping)."""
+        ``-1`` (the caller promotes before mapping).  ``salt`` scopes the
+        walk to that namespace's chain root (per-adapter isolation): a
+        lookup under salt S can only ever reach entries published under S."""
         tup = tuple(int(t) for t in ids[:max(0, int(limit))])
         ps = self.page_size
-        h = _ROOT
+        h = _salted_root(salt)
         pages: List[int] = []
         keys: List[object] = []
         n = 0
@@ -257,7 +274,8 @@ class PrefixIndex:
 
     # ---------------------------------------------------------- publish
 
-    def publish(self, ids, pages: List[int]) -> Tuple[List[int], List[int]]:
+    def publish(self, ids, pages: List[int],
+                salt: int = 0) -> Tuple[List[int], List[int]]:
         """Register the prompt ``ids`` whose logical pages are ``pages``
         (physical ids, chunk order — the slot's page-table row).
 
@@ -271,12 +289,13 @@ class PrefixIndex:
         host slab is dropped.  Returns ``(newly, released)`` page lists:
         the engine acquires one refcount per ``newly`` page and drops one
         per ``released`` page (collision replacements and LRU-cap
-        evictions)."""
+        evictions).  ``salt`` files every entry under that namespace's
+        chain root (same contract as :meth:`lookup`)."""
         tup = tuple(int(t) for t in ids)
         ps = self.page_size
         newly: List[int] = []
         released: List[int] = []
-        h = _ROOT
+        h = _salted_root(salt)
         i = 0
         while (i + 1) * ps <= len(tup):
             chunk = tup[i * ps:(i + 1) * ps]

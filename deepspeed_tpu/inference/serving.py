@@ -135,6 +135,7 @@ from ..observability.trace import (get_tracer, new_trace_id, trace_count,
 from ..resilience import (SITE_SERVE_ADMIT, SITE_SERVE_DECODE,
                           SITE_SERVE_PREFILL, SITE_SERVE_TICK, maybe_fire)
 from ..utils.logging import log_dist, logger
+from .adapters import AdapterRegistry
 from .engine import InferenceEngine
 from .execution import MeshExecutor
 from .kv_tiering import HostTier
@@ -207,6 +208,14 @@ class Request:
     # failover re-dispatches and journal reconstructions all continue the
     # SAME trace, so one request is one trace across the whole fleet.
     trace_id: Optional[str] = None
+    # tenant adapter (docs/SERVING.md "Multi-tenant adapter serving"):
+    # None = the shared base model; an id must be registered with the
+    # engine's AdapterRegistry — resolution happens at submission (under
+    # the serve.adapter_resolve span) so an unknown tenant is a loud
+    # ValueError, never a silently-base-served stream.  The id rides
+    # every fleet hop (journal docs, failover re-dispatches) so a resumed
+    # stream continues under the SAME tenant weights.
+    adapter_id: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -256,6 +265,10 @@ class RequestResult:
     resumed_tokens: int = 0
     # the request's fleet-wide trace id (mirrors Request.trace_id)
     trace_id: Optional[str] = None
+    # the tenant adapter this stream was served under (mirrors
+    # Request.adapter_id; None = shared base model) — per-tenant
+    # token-exactness checks key results by this
+    adapter_id: Optional[str] = None
     # structured lifecycle record (docs/OBSERVABILITY.md "Distributed
     # tracing"): ordered (event, t, src) tuples covering
     # queued→admit→[prefix_match/cow]→prefill→first_token→
@@ -321,7 +334,8 @@ class ServingEngine:
                  host_tier_pages: Optional[int] = None,
                  speculative: Optional[SpeculativeConfig] = None,
                  program_stats_sample_every: int = 0,
-                 slo_rules: Optional[List[SloRule]] = None):
+                 slo_rules: Optional[List[SloRule]] = None,
+                 adapters: Optional[AdapterRegistry] = None):
         if not hasattr(model, "apply_paged"):
             raise ValueError(
                 "ServingEngine needs a model with the paged decode contract "
@@ -398,8 +412,29 @@ class ServingEngine:
                                   kv_dtype=kv_dtype, mesh=mesh,
                                   prefix_cache=prefix_cache,
                                   host_tier=host_tier_pages is not None,
-                                  catalog=self._catalog)
+                                  catalog=self._catalog, adapters=adapters)
         self.params = self._exec.params   # auto-TP-sharded on a mesh
+        # ---- multi-tenant adapter serving (docs/SERVING.md "Multi-tenant
+        # adapter serving"): with a registry attached, every decode/prefill
+        # /verify program takes the per-slot LoRA factor stacks as ONE
+        # fixed-shape traced operand — admission of any tenant mix never
+        # changes program shape, so the zero-recompile inventory holds
+        # bit-identically.  The host stacks mirror the RNG lanes: numpy at
+        # rest, device-cached by the executor until a slot flip
+        # invalidates them.  Without a registry the programs trace without
+        # the operand — byte-identical to the pre-adapter engine.
+        self.adapters = adapters
+        self._adapter_stacks = (adapters.make_slot_stacks(self.b_slots)
+                                if adapters is not None else None)
+        # fused-view mode (hot tenant): while set, the engine serves
+        # base+adapter FUSED weights under a fresh weight epoch and only
+        # this tenant's requests are admissible (their slot delta stays
+        # zero — the weights already carry it)
+        self.fused_adapter_id: Optional[str] = None
+        self._base_params = self.params
+        self.adapter_admissions = 0        # adapter-tagged slots admitted
+        self._adapter_admit_by_id: Dict[str, int] = {}
+        self._adapter_tokens_by_id: Dict[str, int] = {}
         # at-rest storage dtype of the paged pool (docs/SERVING.md
         # "Quantized KV pages"): None = compute dtype, "int8" = quantize-
         # on-store pages + per-page scale rows.  A page is still a page —
@@ -564,7 +599,8 @@ class ServingEngine:
             self._spec = SpeculativeDecoder(
                 speculative, model, self.num_pages, self.page_size,
                 self.b_slots, dtype=dtype, kv_dtype=kv_dtype, mesh=mesh,
-                donate=bool(self._donate), catalog=self._catalog)
+                donate=bool(self._donate), catalog=self._catalog,
+                adapters=adapters)
             if self._cow_prog is not None:
                 # pre-warm the COW jit on the DRAFT pool aval too: a
                 # boundary COW at admission must never compile
@@ -647,6 +683,15 @@ class ServingEngine:
         """Per-rule SLO snapshot (empty when no rules are configured)."""
         return self._slo.states() if self._slo is not None else {}
 
+    def adapter_stats(self) -> Dict[str, Dict[str, int]]:
+        """Per-tenant admission/token counters, keyed by adapter id
+        (empty without a registry) — what the multi-tenant bench reads."""
+        if self.adapters is None:
+            return {}
+        return {aid: {"admissions": self._adapter_admit_by_id.get(aid, 0),
+                      "tokens": self._adapter_tokens_by_id.get(aid, 0)}
+                for aid in self.adapters.loaded()}
+
     # ---------------------------------------------------------- scheduling
 
     def _pages_needed(self, req: Request) -> int:
@@ -728,6 +773,17 @@ class ServingEngine:
                             else 0),
         }
 
+    def _adapter_salt(self, req: Request) -> int:
+        """Per-tenant prefix-namespace salt (docs/SERVING.md "Multi-tenant
+        adapter serving"): K/V under tenant weights is a function of
+        (tokens, base params, ADAPTER), so two tenants' identical prompts
+        must never share pages — every chain walk starts from a
+        tenant-salted root.  0 (the unsalted base namespace) for
+        adapter-less requests and registry-less engines."""
+        if self.adapters is None:
+            return 0
+        return self.adapters.salt(req.adapter_id)
+
     def _prefix_lookup(self, req: Request) -> PrefixMatch:
         """Longest resident prefix for ``req`` (capped at prompt-1 so at
         least one token always goes through prefill — the first generated
@@ -736,7 +792,8 @@ class ServingEngine:
             return PrefixMatch(pages=[], n_tokens=0)
         with trace_span("serve.prefix_match", rid=req.rid):
             m = self._prefix.lookup(req.input_ids,
-                                    limit=len(req.input_ids) - 1)
+                                    limit=len(req.input_ids) - 1,
+                                    salt=self._adapter_salt(req))
         if m.cow_src is not None and m.cow_valid < MIN_COW_TOKENS:
             # not worth a pool-shaped page snapshot: keep the full-page
             # share, prefill the boundary tokens like any other tail
@@ -952,6 +1009,10 @@ class ServingEngine:
                 self._spec.update_params(draft_params)
             self._exec.update_params(params)
             self.params = self._exec.params
+            # the new tree is the serving base: any fused adapter view is
+            # over (fuse_adapter() re-stamps both when IT is the caller)
+            self._base_params = self.params
+            self.fused_adapter_id = None
             flushed_pages, flushed_slabs = self._flush_cached_kv()
             self.weight_epoch = (int(epoch) if epoch is not None
                                  else self._weight_epoch + 1)
@@ -1008,6 +1069,46 @@ class ServingEngine:
         """Recent ``update_params`` wall times in seconds (bounded window;
         the rollout bench reads weight-refresh p50/p99 from here)."""
         return list(self._refresh_lat_s)
+
+    def fuse_adapter(self, adapter_id: Optional[str] = None,
+                     epoch: Optional[int] = None) -> Dict[str, Any]:
+        """Fused-view serving for a HOT tenant (docs/SERVING.md
+        "Multi-tenant adapter serving"): swap ``base + A@B*scale`` fused
+        weights in through the ordinary :meth:`update_params` path —
+        zero-recompile (the fused tree has identical avals/shardings) and
+        epoch-flipped, so every cached K/V page of the shared-base epoch
+        is flushed and stamped unservable before the first fused token.
+
+        While fused, ONLY this tenant's requests are admissible: a base
+        or other-tenant request would decode against the wrong weights
+        (their per-slot delta assumes the shared base), so :meth:`submit`
+        rejects the mix loudly.  The tenant's own slots skip the batched
+        delta — the weights already carry it — which is the point: a
+        tenant hot enough to dominate the engine stops paying the
+        per-token factor matmuls.  ``fuse_adapter(None)`` restores the
+        shared base (another epoch flip) and reopens mixed admission.
+        Requires idle slots, exactly like any weight update."""
+        if self.adapters is None:
+            raise RuntimeError(
+                "fuse_adapter requires an AdapterRegistry — build the "
+                "engine with adapters= (docs/SERVING.md)")
+        base = self._base_params
+        if adapter_id is None:
+            view = base
+        else:
+            self.adapters.resolve(adapter_id)   # loud UnknownAdapter
+            view = self.adapters.fuse(base, adapter_id)
+        stats = self.update_params(view, epoch=epoch)
+        # update_params made the view the new base and cleared the mode;
+        # re-stamp both — the true base survives for the next flip
+        self._base_params = base
+        self.fused_adapter_id = adapter_id
+        stats["fused_adapter_id"] = adapter_id
+        log_dist(
+            f"serve: fused-view "
+            f"{'restored to shared base' if adapter_id is None else f'adapter {adapter_id!r}'} "
+            f"at weight epoch {self._weight_epoch}", ranks=[0])
+        return stats
 
     def _arrival_abs(self, req: Request) -> float:
         """Absolute arrival stamp: the rebased epoch when the request rode
@@ -1126,6 +1227,28 @@ class ServingEngine:
                 "must be > 0 (measured from arrival)")
         if request.sampling is not None:
             request.sampling.validate()
+        if request.adapter_id is not None:
+            # tenant resolution happens HERE, not at slot admission: an
+            # unknown adapter must bounce at the door (a loud error to the
+            # submitter) rather than fail a prefill attempt later and
+            # count against the slot's quarantine budget
+            if self.adapters is None:
+                raise ValueError(
+                    f"request {request.rid!r} names adapter "
+                    f"{request.adapter_id!r} but this engine has no "
+                    "AdapterRegistry — build it with adapters= "
+                    "(docs/SERVING.md \"Multi-tenant adapter serving\")")
+            with trace_span("serve.adapter_resolve", rid=request.rid,
+                            adapter=request.adapter_id):
+                self.adapters.resolve(request.adapter_id)   # UnknownAdapter
+        if (self.fused_adapter_id is not None
+                and request.adapter_id != self.fused_adapter_id):
+            raise ValueError(
+                f"request {request.rid!r} (adapter "
+                f"{request.adapter_id!r}) rejected: the engine is serving "
+                f"a FUSED view of adapter {self.fused_adapter_id!r} — "
+                "only that tenant is admissible until fuse_adapter(None) "
+                "restores the shared base (docs/SERVING.md)")
         rid = request.rid
         if rid in self._live_rids:
             raise ValueError(
@@ -1352,6 +1475,20 @@ class ServingEngine:
         toks = np.zeros((1, s_pad), np.int32)
         toks[0, :S_tail] = tail
         lane_t, lane_k, lane_p, lane_s = as_lanes(req.sampling)
+        adapter_row = None
+        if self.adapters is not None:
+            # install the tenant's factors into this slot of the host
+            # stacks BEFORE the device calls: the prefill program reads the
+            # one-slot row slice now and the next decode tick re-uploads
+            # the full stacks.  Under a fused view the slot stays zero —
+            # the swapped weights already carry the delta.  A base-model
+            # request (adapter_id=None) also clears the slot: zero factors
+            # make the traced delta exactly zero.
+            ad = (None if self.fused_adapter_id is not None
+                  else self.adapters.resolve(req.adapter_id))
+            self.adapters.write_slot(self._adapter_stacks, slot, ad)
+            self._exec.invalidate_adapters()
+            adapter_row = self._exec.adapter_row(self._adapter_stacks, slot)
         with trace_span("serve.prefill", rid=req.rid, slot=slot,
                         bucket=s_pad, shared_tokens=n_shared):
             maybe_fire(SITE_SERVE_PREFILL, rid=req.rid, slot=slot)
@@ -1375,7 +1512,7 @@ class ServingEngine:
                 toks_j = jnp.asarray(toks)
                 tok = int(self._exec.prefill(
                     s_pad, pt_row, toks_j, S_tail, n_shared,
-                    lane_t, lane_k, lane_p, lane_s))
+                    lane_t, lane_k, lane_p, lane_s, adapter_row))
                 # host fetch above lands inside the watchdog window
                 if self._spec is not None:
                     # draft-pool prefill of the same tail (same bucket,
@@ -1406,6 +1543,12 @@ class ServingEngine:
         self._exec.invalidate_lanes()
         if req.sampling is not None and not req.sampling.greedy:
             self.sampled_admissions += 1
+        if req.adapter_id is not None:
+            self.adapter_admissions += 1
+            self._adapter_admit_by_id[req.adapter_id] = (
+                self._adapter_admit_by_id.get(req.adapter_id, 0) + 1)
+            self._adapter_tokens_by_id[req.adapter_id] = (
+                self._adapter_tokens_by_id.get(req.adapter_id, 0) + 1)
         self._tokens_out += 1
         if self._prefix is not None:
             if n_shared > 0:
@@ -1418,7 +1561,8 @@ class ServingEngine:
             # boundary) so later requests can share them; the index takes
             # one reference per new entry.  Shared chunks just LRU-touch
             # their existing entries.
-            newly, released = self._prefix.publish(req.input_ids, pages)
+            newly, released = self._prefix.publish(
+                req.input_ids, pages, salt=self._adapter_salt(req))
             for p in newly:
                 self._share_page(p)
             for p in released:
@@ -1450,6 +1594,13 @@ class ServingEngine:
         return self._exec.lanes(self._lane_temp, self._lane_top_k,
                                 self._lane_top_p, self._lane_seed)
 
+    def _adapter_operand(self):
+        """Device-cached per-slot adapter factor stacks (None without a
+        registry — the programs then traced without the operand)."""
+        if self.adapters is None:
+            return None
+        return self._exec.adapter_stacks(self._adapter_stacks)
+
     def _decode_tick(self, rid_map: Optional[Dict[str, str]] = None) -> None:
         if self._spec is not None:
             self._spec_tick(rid_map)
@@ -1467,7 +1618,8 @@ class ServingEngine:
             maybe_fire(SITE_SERVE_DECODE, tick=self._tick)
             with self._armed(f"serve.decode tick {self._tick}"):
                 nxt = self._exec.decode(self._page_table, self._lengths,
-                                        self._last_tok, self._active, lanes)
+                                        self._last_tok, self._active, lanes,
+                                        adapters=self._adapter_operand())
                 nxt = np.asarray(nxt)   # host fetch = device sync
         active_slots = np.flatnonzero(self._active)
         trace_count("serve.tokens", float(len(active_slots)))
@@ -1480,6 +1632,9 @@ class ServingEngine:
             self._lengths[slot] += 1
             self._last_tok[slot] = tok
             self._tokens_out += 1
+            if req.adapter_id is not None:
+                self._adapter_tokens_by_id[req.adapter_id] = (
+                    self._adapter_tokens_by_id.get(req.adapter_id, 0) + 1)
             if req.eos_token_id is not None and tok == req.eos_token_id:
                 self._finish(slot, "eos")
             elif len(st.tokens) >= req.max_new_tokens:
@@ -1501,7 +1656,8 @@ class ServingEngine:
                 emitted, n_emit, self._exec.pools = self._spec.tick(
                     self.params, self._exec.pools,
                     self._page_table, self._lengths, self._last_tok,
-                    self._active, *self._lanes_jnp())
+                    self._active, *self._lanes_jnp(),
+                    adapters=self._adapter_operand())
         active_slots = np.flatnonzero(self._active)
         total = 0
         for slot in active_slots:
@@ -1522,6 +1678,10 @@ class ServingEngine:
                     break
             st.decode_ticks += 1
             total += consumed
+            if req.adapter_id is not None and consumed:
+                self._adapter_tokens_by_id[req.adapter_id] = (
+                    self._adapter_tokens_by_id.get(req.adapter_id, 0)
+                    + consumed)
             self._spec.emitted_tokens += consumed
             self._lengths[slot] += consumed
             self._last_tok[slot] = st.tokens[-1]
@@ -1544,7 +1704,8 @@ class ServingEngine:
             # speculation; a speculative verify tick emits several)
             decode_ticks=st.decode_ticks,
             shared_prefix_tokens=st.shared_tokens,
-            trace_id=st.request.trace_id, lifecycle=st.lifecycle)
+            trace_id=st.request.trace_id,
+            adapter_id=st.request.adapter_id, lifecycle=st.lifecycle)
         if reason == "deadline":
             self.deadline_count += 1
         else:
@@ -1570,6 +1731,11 @@ class ServingEngine:
         self._lane_top_p[slot] = 1.0
         self._lane_seed[slot] = 0
         self._exec.invalidate_lanes()
+        if self.adapters is not None and st.request.adapter_id is not None:
+            # retire the tenant's factors with the slot — a later base
+            # admission must decode against zeros, not a stale delta
+            self.adapters.clear_slot(self._adapter_stacks, slot)
+            self._exec.invalidate_adapters()
 
     # ----------------------------------------------------- probe / unfence
 
@@ -1878,6 +2044,20 @@ class ServingEngine:
             # economics operators size k from (mean accepted length > 1
             # means the draft pays for itself)
             "sampled_admissions_total": self.sampled_admissions,
+            # multi-tenant adapter serving (docs/SERVING.md): the loaded
+            # inventory a fleet member advertises for adapter-affinity
+            # routing, the resolution counters, and the fused-view mode
+            "adapters_loaded": (self.adapters.loaded()
+                                if self.adapters is not None else []),
+            "adapter_admissions_total": self.adapter_admissions,
+            "adapter_resolve_total": (self.adapters.resolve_total
+                                      if self.adapters is not None else 0),
+            "adapter_resolve_miss_total": (
+                self.adapters.resolve_miss_total
+                if self.adapters is not None else 0),
+            "adapter_bytes": (self.adapters.nbytes()
+                              if self.adapters is not None else 0),
+            "fused_adapter_id": self.fused_adapter_id,
             "speculative_k": self._spec.k if self._spec is not None else 0,
             "spec_verify_slot_ticks_total": (self._spec.verify_slot_ticks
                                              if self._spec is not None
@@ -1993,6 +2173,30 @@ class ServingEngine:
                 ("serve/spec_mean_accepted_len",
                  self._spec.mean_accepted_len(), self._tick),
             ])
+        if self.adapters is not None:
+            # per-tenant accounting (docs/SERVING.md "Multi-tenant adapter
+            # serving"): the {adapter=...} suffix rides the flat monitor
+            # stream like the program gauges and renders as a real
+            # Prometheus label — one admissions/tokens series per tenant
+            ad_active = sum(
+                1 for s in np.flatnonzero(self._active)
+                if self._slots[s].request.adapter_id is not None)
+            ad_events = [
+                ("serve/adapter_loaded",
+                 float(len(self.adapters.loaded())), self._tick),
+                ("serve/adapter_active_slots", float(ad_active), self._tick),
+                ("serve/adapter_resolve_miss_total",
+                 float(self.adapters.resolve_miss_total), self._tick),
+            ]
+            for aid, n in self._adapter_admit_by_id.items():
+                ad_events.append(
+                    (f"serve/adapter_admissions_total{{adapter={aid}}}",
+                     float(n), self._tick))
+            for aid, n in self._adapter_tokens_by_id.items():
+                ad_events.append(
+                    (f"serve/adapter_tokens_total{{adapter={aid}}}",
+                     float(n), self._tick))
+            self.monitor.write_events(ad_events)
         # per-program accounting gauges (docs/OBSERVABILITY.md): the
         # {program=...} suffix rides the flat monitor stream and the
         # Prometheus exposition renders it as a real label

@@ -118,6 +118,7 @@ class ServingSupervisor:
         self._prefix_evictions_base = 0
         self._cow_base = 0
         self._sampled_base = 0
+        self._adapter_admissions_base = 0
         self._spec_ticks_base = 0
         self._spec_emitted_base = 0
         self._spec_drafted_base = 0
@@ -334,6 +335,7 @@ class ServingSupervisor:
         h["prefix_evictions_total"] += self._prefix_evictions_base
         h["cow_copies_total"] += self._cow_base
         h["sampled_admissions_total"] += self._sampled_base
+        h["adapter_admissions_total"] += self._adapter_admissions_base
         h["spec_verify_slot_ticks_total"] += self._spec_ticks_base
         h["spec_emitted_tokens_total"] += self._spec_emitted_base
         h["spec_drafted_tokens_total"] += self._spec_drafted_base
@@ -604,6 +606,7 @@ class ServingSupervisor:
                                         if old._prefix is not None else 0)
         self._cow_base += old.cow_copies
         self._sampled_base += old.sampled_admissions
+        self._adapter_admissions_base += old.adapter_admissions
         if old._spec is not None:
             self._spec_ticks_base += old._spec.verify_slot_ticks
             self._spec_emitted_base += old._spec.emitted_tokens

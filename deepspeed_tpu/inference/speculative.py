@@ -176,8 +176,16 @@ class SpeculativeDecoder:
     def __init__(self, config: SpeculativeConfig, target_model,
                  num_pages: int, page_size: int, b_slots: int,
                  dtype=None, kv_dtype=None, mesh=None, donate: bool = False,
-                 catalog=None):
+                 catalog=None, adapters=None):
         from .execution import place_params, pool_bytes
+
+        # multi-tenant adapter serving (docs/SERVING.md): the TARGET
+        # verify program carries the per-slot LoRA operand (correctness —
+        # acceptance compares against the tenant's true distribution);
+        # the DRAFT stays adapter-free by design: rejection sampling
+        # preserves the target distribution regardless of q, so an
+        # adapter-less draft only costs acceptance rate, never exactness.
+        self.adapters = adapters
 
         # per-program accounting shared with the owning engine's
         # MeshExecutor (observability/program_stats.py): draft_decode /
@@ -291,9 +299,11 @@ class SpeculativeDecoder:
     def _build_verify(self, target_model, donate):
         target_apply = target_model.apply_paged
         k = self.k
+        with_adapters = self.adapters is not None
 
         def prog(params, pools, page_table, lengths, last_tok,
-                 active, d_toks, d_probs, temp, top_k, top_p, seeds):
+                 active, d_toks, d_probs, temp, top_k, top_p, seeds,
+                 adapters=None):
             B = lengths.shape[0]
             V = d_probs.shape[-1]
             # one target traversal writes [last_tok, d_1..d_k] at
@@ -301,8 +311,13 @@ class SpeculativeDecoder:
             tokens = jnp.concatenate([last_tok[:, None], d_toks], axis=1)
             seq_mask = jnp.broadcast_to(active[:, None], (B, k + 1))
             cache = paged_pool_cache(pools)
-            logits, cache = target_apply(params, tokens, cache, page_table,
-                                         lengths, seq_mask)
+            if with_adapters:
+                logits, cache = target_apply(params, tokens, cache,
+                                             page_table, lengths, seq_mask,
+                                             adapters=adapters)
+            else:
+                logits, cache = target_apply(params, tokens, cache,
+                                             page_table, lengths, seq_mask)
             rep = lambda x: jnp.repeat(x, k + 1)                 # noqa: E731
             p = sampling_probs(logits.reshape(B * (k + 1), V), rep(temp),
                                rep(top_k), rep(top_p)).reshape(B, k + 1, V)
@@ -430,11 +445,13 @@ class SpeculativeDecoder:
 
     def tick(self, target_params, pools, page_table, lengths,
              last_tok, active, temp, top_k, top_p,
-             seeds) -> Tuple[np.ndarray, np.ndarray, Any]:
+             seeds, adapters=None) -> Tuple[np.ndarray, np.ndarray, Any]:
         """One speculative decode tick: k draft invocations + one verify.
         Returns ``(emitted [B, k+1], n_emit [B], pools)`` — the caller
         consumes ``emitted[b, :n_emit[b]]`` per slot (truncated by its own
-        budget/eos) and the updated TARGET pool tuple."""
+        budget/eos) and the updated TARGET pool tuple.  ``adapters`` is
+        the per-slot factor pytree for the verify pass when the engine
+        serves tenants (the draft loop never sees it)."""
         pt = jnp.asarray(page_table)
         ln = jnp.asarray(lengths)
         act = jnp.asarray(active)
@@ -455,6 +472,8 @@ class SpeculativeDecoder:
         vargs = (target_params, pools, pt, ln, jnp.asarray(last_tok),
                  act, jnp.stack(d_toks, axis=1), jnp.stack(d_probs, axis=1),
                  tj, kj, pj, sj)
+        if self.adapters is not None:
+            vargs += (adapters,)
         t0 = account(self.catalog, "verify", self._verify_prog, vargs)
         emitted, n_emit, pools = self._verify_prog(*vargs)
         if t0 is not None:

@@ -202,9 +202,10 @@ class CausalLM:
     def paged_cache_specs(self, kv_dtype=None):
         return paged_cache_specs(self.config, kv_dtype=kv_dtype)
 
-    def apply_paged(self, params, tokens, cache, page_table, start, seq_mask):
+    def apply_paged(self, params, tokens, cache, page_table, start, seq_mask,
+                    adapters=None):
         return forward_paged(self.config, params, tokens, cache, page_table,
-                             start, seq_mask)
+                             start, seq_mask, adapters=adapters)
 
     @property
     def param_count(self) -> int:

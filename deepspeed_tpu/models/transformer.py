@@ -1668,8 +1668,24 @@ def _attention_paged(cfg, q, ck, cv, q_pos):
     return out.reshape(B, S, Hq, hd)
 
 
+def _adapter_delta(h, ab, scale):
+    """Per-slot batched LoRA delta: ``((h @ A_b) @ B_b) * scale_b``.
+
+    ``h`` [B,S,d_in] activations; ``ab["A"]`` [B,d_in,R] / ``ab["B"]``
+    [B,R,d_out] this layer's per-slot factor slices (rank-padded to the
+    traced R — zero-padded columns contribute exactly zero, so a slot
+    with no adapter, or a lower-rank adapter, is mathematically exact);
+    ``scale`` [B] per-slot alpha/true_rank.  Accumulates in float32 like
+    :func:`apply_lora` so low-precision compute dtypes do not lose the
+    low-rank product before the scale multiply."""
+    hf = h.astype(jnp.float32)
+    t = jnp.einsum("bsd,bdr->bsr", hf, ab["A"].astype(jnp.float32))
+    d = jnp.einsum("bsr,bro->bso", t, ab["B"].astype(jnp.float32))
+    return d * scale.astype(jnp.float32)[:, None, None]
+
+
 def _block_paged(cfg, lp, x, ckf, cvf, positions, write_idx, gather_idx, rng,
-                 cksf=None, cvsf=None):
+                 cksf=None, cvsf=None, adapters=None, ad_scale=None):
     """One transformer block against the paged pool.  ``ckf``/``cvf`` are
     this layer's pool flattened to ``[P*page, Hkv, hd]``; ``write_idx``
     [B*S] flat destinations (trash-redirected for masked tokens);
@@ -1681,15 +1697,28 @@ def _block_paged(cfg, lp, x, ckf, cvf, positions, write_idx, gather_idx, rng,
     through the SAME ``write_idx``, the gather dequantizes in-place before
     attention — the scales ride as one extra traced operand, so the
     program shapes (and the zero-recompile inventory built on them) are
-    unchanged."""
+    unchanged.
+
+    ``adapters``/``ad_scale`` (both or neither) are this layer's per-slot
+    LoRA factor slices ``{target: {"A": [B,d_in,R], "B": [B,R,d_out]}}``
+    and the ``[B]`` per-slot scales (multi-tenant adapter serving,
+    docs/SERVING.md): each projection named in the dict gains its slot's
+    batched delta.  All-zero factors reproduce the base projection
+    exactly, so one traced program serves any tenant mix."""
     B, S, _ = x.shape
     hd, nh, nkv = cfg.dims_per_head, cfg.num_heads, cfg.kv_heads
 
+    def proj(y, name, hin):
+        if adapters is not None and name in adapters:
+            y = y + _adapter_delta(hin, adapters[name],
+                                   ad_scale).astype(y.dtype)
+        return y
+
     h = _norm(cfg, x, lp["attn_norm_scale"], lp.get("attn_norm_bias"))
     h = _maybe_act_quant(cfg, h)
-    q = h @ lp["wq"]
-    k = h @ lp["wk"]
-    v = h @ lp["wv"]
+    q = proj(h @ lp["wq"], "wq", h)
+    k = proj(h @ lp["wk"], "wk", h)
+    v = proj(h @ lp["wv"], "wv", h)
     if cfg.attn_bias:
         q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
     q = q.reshape(B, S, nh, hd)
@@ -1723,7 +1752,8 @@ def _block_paged(cfg, lp, x, ckf, cvf, positions, write_idx, gather_idx, rng,
         ck = ckf[gather_idx]   # [B, T, Hkv, hd] — each slot's pages
         cv = cvf[gather_idx]
     attn = _attention_paged(cfg, q, ck, cv, positions)
-    attn = attn.reshape(B, S, nh * hd) @ lp["wo"]
+    attn2d = attn.reshape(B, S, nh * hd)
+    attn = proj(attn2d @ lp["wo"], "wo", attn2d)
     if cfg.attn_bias:
         attn = attn + lp["bo"]
 
@@ -1743,7 +1773,7 @@ def _block_paged(cfg, lp, x, ckf, cvf, positions, write_idx, gather_idx, rng,
 def forward_paged(cfg: TransformerConfig, params: Dict[str, Any],
                   tokens: jax.Array, cache: Dict[str, Any],
                   page_table: jax.Array, start: jax.Array,
-                  seq_mask: jax.Array):
+                  seq_mask: jax.Array, adapters=None):
     """Run ``tokens [B,S]`` against the paged pool, writing each real token's
     K/V at its slot position and attending each query to its own slot only.
 
@@ -1771,6 +1801,14 @@ def forward_paged(cfg: TransformerConfig, params: Dict[str, Any],
     writes quantize on store, the gather dequantizes, and the scale planes
     scan through as two extra traced operands (docs/SERVING.md "Quantized
     KV pages").
+
+    ``adapters`` (optional) is the per-slot LoRA operand pytree of
+    multi-tenant adapter serving (docs/SERVING.md): ``{"scale": [B] f32,
+    "factors": {target: {"A": [L,B,d_in,R], "B": [L,B,R,d_out]}}}``.  The
+    factor stacks ride the layer scan as one extra xs element, so the
+    program count is unchanged and all-zero factors reproduce the
+    adapter-free forward exactly.  ``None`` keeps today's trace
+    byte-identical (no adapter operands at all).
     """
     assert cfg.pipeline_stages == 1, "paged decode requires pipeline_stages=1"
     if not cfg.causal:
@@ -1816,8 +1854,14 @@ def forward_paged(cfg: TransformerConfig, params: Dict[str, Any],
 
     rng = jax.random.PRNGKey(0)
     quantized = "k_scale" in cache
+    ad_scale = (adapters["scale"].astype(jnp.float32)
+                if adapters is not None else None)
 
     def body(x, layer):
+        if adapters is not None:
+            layer, ad = layer[:-1], layer[-1]
+        else:
+            ad = None
         if quantized:
             lp, ck, cv, cks, cvs = layer
             sks, svs = cks.reshape(num_pages * ps), cvs.reshape(num_pages * ps)
@@ -1828,20 +1872,25 @@ def forward_paged(cfg: TransformerConfig, params: Dict[str, Any],
             cfg, lp, x,
             ck.reshape(num_pages * ps, *ck.shape[2:]),
             cv.reshape(num_pages * ps, *cv.shape[2:]),
-            positions, write_idx, gather_idx, rng, cksf=sks, cvsf=svs)
+            positions, write_idx, gather_idx, rng, cksf=sks, cvsf=svs,
+            adapters=ad, ad_scale=ad_scale)
         x = constrain_spec(x, P(BATCH_AXES, None, None))
         out = (ckf.reshape(ck.shape), cvf.reshape(cv.shape))
         if quantized:
             out += (cksf.reshape(cks.shape), cvsf.reshape(cvs.shape))
         return x, out
 
+    xs = (params["layers"], cache["k"], cache["v"])
     if quantized:
-        xs = (params["layers"], cache["k"], cache["v"],
-              cache["k_scale"], cache["v_scale"])
+        xs += (cache["k_scale"], cache["v_scale"])
+    if adapters is not None:
+        # per-slot factor stacks scan with the layers: each step's slice is
+        # {target: {"A": [B,d_in,R], "B": [B,R,d_out]}} for THAT layer
+        xs += (adapters["factors"],)
+    if quantized:
         x, (ck_all, cv_all, cks_all, cvs_all) = jax.lax.scan(body, x, xs)
     else:
-        x, (ck_all, cv_all) = jax.lax.scan(
-            body, x, (params["layers"], cache["k"], cache["v"]))
+        x, (ck_all, cv_all) = jax.lax.scan(body, x, xs)
 
     x = _norm(cfg, x, params["final_norm_scale"], params.get("final_norm_bias"))
     if cfg.tie_embeddings:

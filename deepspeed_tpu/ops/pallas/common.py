@@ -57,6 +57,9 @@ def mask_to_i32(mask) -> jax.Array:
 def parallel_semantics(n_parallel: int, n_arbitrary: int = 1):
     """CompilerParams for an n-axis grid: leading axes independent, the
     trailing axes carrying accumulator state across iterations."""
-    return pltpu.CompilerParams(
+    # jax renamed TPUCompilerParams -> CompilerParams; support both
+    params_cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams")
+    return params_cls(
         dimension_semantics=("parallel",) * n_parallel
         + ("arbitrary",) * n_arbitrary)

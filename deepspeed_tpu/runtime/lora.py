@@ -33,6 +33,24 @@ class LoRAConfig:
     alpha: float = 16.0
     targets: Tuple[str, ...] = DEFAULT_TARGETS
 
+    def validate(self) -> "LoRAConfig":
+        """Reject impossible configs BEFORE any math touches them.
+
+        ``rank=0`` used to surface as a bare ``ZeroDivisionError`` from
+        ``.scaling``; ``alpha<=0`` silently zeroed or sign-flipped the
+        delta; empty/duplicate ``targets`` produced an adapter tree that
+        trained nothing or double-counted a projection."""
+        if int(self.rank) < 1:
+            raise ValueError(f"LoRA rank={self.rank} must be >= 1")
+        if not (float(self.alpha) > 0.0):
+            raise ValueError(f"LoRA alpha={self.alpha} must be > 0")
+        if not self.targets:
+            raise ValueError("LoRA targets must name at least one layer "
+                             "weight")
+        if len(set(self.targets)) != len(self.targets):
+            raise ValueError(f"duplicate LoRA targets: {list(self.targets)}")
+        return self
+
     @property
     def scaling(self) -> float:
         return self.alpha / self.rank
@@ -45,6 +63,7 @@ def init_lora_params(base_layers: Dict[str, Any], cfg: LoRAConfig,
     Targets are leaves of the model's stacked ``layers`` dict with shape
     [L, d_in, d_out].  A ~ N(0, 1/r) [L, d_in, r], B = 0 [L, r, d_out]
     (zero-init B makes step-0 output exactly the base model)."""
+    cfg.validate()
     out: Dict[str, Any] = {}
     keys = jax.random.split(rng, len(cfg.targets))
     for k, key in zip(cfg.targets, keys):
@@ -96,6 +115,7 @@ class LoRAModel:
     as a closed-over constant."""
 
     def __init__(self, base_model, base_params, lora_config: LoRAConfig):
+        lora_config.validate()
         self.base_model = base_model
         # frozen base rides in the COMPUTE dtype (cfg.dtype): the fused tree
         # must match the activation dtype or every matmul/scan would mix

@@ -1,0 +1,435 @@
+"""Multi-tenant adapter serving (ISSUE 19): per-request LoRA through one
+shared engine/KV pool (docs/SERVING.md "Multi-tenant adapter serving").
+
+Acceptance covered here, all on pinned CPU seeds:
+
+- N tenants through ONE engine, each token-exact — greedy AND sampled —
+  against ``generate()`` over that tenant's FUSED weights (the batched
+  per-slot delta path must equal base+A@B*scale folded into the layers).
+- Zero steady-state compiles with a bit-identical program inventory
+  across the mixed-tenant admission.
+- Salted prefix namespaces: an identical prompt never prefix-hits or
+  COWs across tenants, and does hit within one tenant.
+- Fused-view serving for a hot tenant rides the weight-epoch contract
+  (old K/V unservable) and enforces fused-exclusive admission.
+- Fleet failover of an adapter-tagged mid-stream request resumes
+  token-exact under the SAME adapter (the journal carries the tenant).
+
+Plus the ISSUE 19 satellite: ``LoRAConfig.validate()`` regression tests
+(rank=0 used to ZeroDivisionError at ``scaling``; alpha<=0 and dup/empty
+targets used to pass silently).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.inference.adapters import (AdapterRegistry,
+                                              UnknownAdapter, adapter_salt)
+from deepspeed_tpu.inference.sampling import SamplingParams
+from deepspeed_tpu.inference.serving import Request
+from deepspeed_tpu.models import CausalLM
+from deepspeed_tpu.runtime.lora import LoRAConfig, LoRAModel, init_lora_params
+from deepspeed_tpu.utils.compile_counter import compile_counter
+
+SERVE_KW = dict(b_slots=4, page_size=8, max_model_len=64)
+PROMPT = np.arange(5, 14, dtype=np.int32)          # 9 tokens, one bucket
+SAMPLED = SamplingParams(temperature=0.8, top_k=12, seed=7)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    return CausalLM("tiny", dtype=jnp.float32, attn_impl="xla")
+
+
+@pytest.fixture(scope="module")
+def tiny_params(tiny_model):
+    return tiny_model.init_fn(jax.random.PRNGKey(3))
+
+
+@pytest.fixture(scope="module")
+def tiny_engine(tiny_model, tiny_params):
+    return deepspeed_tpu.init_inference(
+        model=tiny_model, config={"dtype": "float32"}, params=tiny_params)
+
+
+def _make_lora(params, rank, seed, b_scale=0.05):
+    """Deterministic non-zero A AND B factors: fresh ``init_lora_params``
+    has B=0 (zero delta), which would make every parity check vacuous."""
+    cfg = LoRAConfig(rank=rank, alpha=2.0 * rank)
+    rng = np.random.default_rng(seed)
+    lora = {}
+    for t in cfg.targets:
+        L, d_in, d_out = (int(s) for s in np.shape(params["layers"][t]))
+        lora[t] = {"A": rng.standard_normal((L, d_in, rank))
+                   .astype(np.float32) / np.sqrt(rank),
+                   "B": rng.standard_normal((L, rank, d_out))
+                   .astype(np.float32) * b_scale}
+    return lora, cfg
+
+
+@pytest.fixture(scope="module")
+def registry(tiny_params):
+    """Three tenants straddling both rank buckets (4, 8 → bucket 8;
+    12 → bucket 16)."""
+    reg = AdapterRegistry(tiny_params["layers"])
+    for i, (aid, rank) in enumerate((("acme", 4), ("globex", 8),
+                                     ("initech", 12))):
+        lora, cfg = _make_lora(tiny_params, rank, seed=40 + i)
+        reg.register(aid, lora, cfg)
+    return reg
+
+
+@pytest.fixture(scope="module")
+def fused_outputs(tiny_model, tiny_engine, registry):
+    """Per-tenant parity oracle: generate() over FUSED weights, greedy
+    and sampled, for the shared PROMPT."""
+    outs = {}
+    for aid in [None] + registry.loaded():
+        eng = tiny_engine if aid is None else deepspeed_tpu.init_inference(
+            model=tiny_model, config={"dtype": "float32"},
+            params=registry.fuse(tiny_engine.params, aid))
+        for sp, kind in ((None, "greedy"), (SAMPLED, "sampled")):
+            out = np.asarray(eng.generate(PROMPT[None], max_new_tokens=6,
+                                          sampling=sp))
+            outs[(aid, kind)] = out[0, len(PROMPT):]
+    return outs
+
+
+@pytest.fixture(scope="module")
+def serve(tiny_engine, registry):
+    return tiny_engine.serving(adapters=registry, **SERVE_KW)
+
+
+# ------------------------------------------------ satellite: LoRA validation
+
+def test_lora_config_rank_zero_is_typed_error():
+    # regression: rank=0 used to surface as ZeroDivisionError at .scaling
+    with pytest.raises(ValueError, match="rank"):
+        LoRAConfig(rank=0).validate()
+    with pytest.raises(ValueError, match="rank"):
+        LoRAConfig(rank=-3).validate()
+
+
+def test_lora_config_alpha_and_targets_validate():
+    with pytest.raises(ValueError, match="alpha"):
+        LoRAConfig(rank=4, alpha=0.0).validate()
+    with pytest.raises(ValueError, match="alpha"):
+        LoRAConfig(rank=4, alpha=-1.0).validate()
+    with pytest.raises(ValueError, match="targets"):
+        LoRAConfig(rank=4, targets=()).validate()
+    with pytest.raises(ValueError, match="targets"):
+        LoRAConfig(rank=4, targets=("wq", "wq")).validate()
+    LoRAConfig(rank=4).validate()                      # defaults are fine
+
+
+def test_lora_entry_points_validate(tiny_params):
+    with pytest.raises(ValueError, match="rank"):
+        init_lora_params(tiny_params["layers"], LoRAConfig(rank=0),
+                         jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="alpha"):
+        LoRAModel(object(), {}, LoRAConfig(rank=4, alpha=-2.0))
+
+
+# --------------------------------------------------------------- registry
+
+def test_registry_pads_to_buckets_and_scales_by_true_rank(tiny_params,
+                                                          registry):
+    ad = registry.resolve("acme")                      # rank 4 → bucket 8
+    assert (ad.rank, ad.bucket) == (4, 8)
+    assert ad.scale == pytest.approx(8.0 / 4)          # alpha / TRUE rank
+    L, d_in, d_out = registry.shapes["wq"]
+    assert ad.factors["wq"]["A"].shape == (L, d_in, 8)
+    assert ad.factors["wq"]["B"].shape == (L, 8, d_out)
+    assert not ad.factors["wq"]["A"][:, :, 4:].any()   # padding is zero
+    assert registry.resolve("initech").bucket == 16    # rank 12 → bucket 16
+    assert registry.resolve(None) is None
+    assert registry.loaded() == ["acme", "globex", "initech"]
+    assert registry.nbytes() > 0
+
+
+def test_registry_rejects_bad_registrations(tiny_params, registry):
+    with pytest.raises(UnknownAdapter):
+        registry.resolve("nobody")
+    lora, cfg = _make_lora(tiny_params, 4, seed=1)
+    with pytest.raises(ValueError, match="already registered"):
+        registry.register("acme", lora, cfg)
+    with pytest.raises(ValueError, match="rank bucket"):
+        registry.bucket_for(17)
+    reg = AdapterRegistry(tiny_params["layers"])
+    bad = {"nonesuch": lora["wq"]}
+    with pytest.raises(ValueError, match="no operand"):
+        reg.register("x", bad, cfg)
+    with pytest.raises(ValueError, match="factor shapes"):
+        reg.register("x", {"wq": {"A": lora["wq"]["A"][:, :-1],
+                                  "B": lora["wq"]["B"]}}, cfg)
+
+
+def test_adapter_salt_is_process_independent_and_disjoint():
+    import zlib
+
+    raw = b"acme"
+    expect = (zlib.crc32(raw) << 32) | zlib.crc32(raw[::-1])
+    assert adapter_salt("acme") == expect              # crc-derived, not hash()
+    assert adapter_salt(None) == 0                     # base namespace
+    assert adapter_salt("acme") != adapter_salt("globex") != 0
+
+
+# ------------------------------------- batched-delta serving: token parity
+
+def test_three_tenants_token_exact_one_engine(serve, registry,
+                                              fused_outputs):
+    """Base + three tenants, greedy AND sampled, concurrently through ONE
+    engine over ONE pool — every stream token-exact against generate()
+    over that tenant's fused weights, with zero steady-state compiles and
+    a bit-identical inventory across the tenant mix."""
+    tenants = [None] + registry.loaded()
+
+    def stream(tag):
+        reqs = []
+        for i, aid in enumerate(tenants):
+            reqs.append(Request(rid=f"{tag}g{i}", input_ids=PROMPT.copy(),
+                                max_new_tokens=6, adapter_id=aid))
+            reqs.append(Request(rid=f"{tag}s{i}", input_ids=PROMPT.copy(),
+                                max_new_tokens=6, adapter_id=aid,
+                                sampling=SAMPLED))
+        return reqs
+
+    serve.run(stream("warm"))                          # compiles
+    inv0 = serve.program_inventory()
+    count = compile_counter()
+    n0 = count()
+    results = serve.run(stream("m"))
+    assert count() - n0 == 0                           # zero-recompile
+    assert serve.program_inventory() == inv0           # bit-identical mix
+    by = {r.rid: r for r in results}
+    for i, aid in enumerate(tenants):
+        for kind, rid in (("greedy", f"mg{i}"), ("sampled", f"ms{i}")):
+            assert np.array_equal(by[rid].output_ids,
+                                  fused_outputs[(aid, kind)]), (aid, kind)
+            assert by[rid].adapter_id == aid
+    # tenants genuinely differ (non-zero deltas) and per-tenant accounting
+    assert not np.array_equal(fused_outputs[("acme", "greedy")],
+                              fused_outputs[(None, "greedy")])
+    stats = serve.adapter_stats()
+    assert set(stats) == set(registry.loaded())
+    assert all(s["admissions"] >= 2 and s["tokens"] >= 12
+               for s in stats.values())
+
+
+def test_concurrent_tenant_occupancy(serve, registry):
+    """≥3 distinct tenant identities simultaneously active in the slot
+    plane of one engine."""
+    tenants = [None, "acme", "globex", "initech"]
+    for i, aid in enumerate(tenants):
+        serve.submit(Request(rid=f"occ{i}", input_ids=PROMPT.copy(),
+                             max_new_tokens=8, adapter_id=aid))
+    peak = 0
+    while serve.step():
+        ids = {st.request.adapter_id
+               for st in serve._slots if st is not None}
+        peak = max(peak, len(ids))
+    serve.take_results()
+    assert peak >= 3
+
+
+def test_health_and_gauges_carry_adapter_keys(serve, registry):
+    h = serve.health()
+    assert h["adapters_loaded"] == registry.loaded()
+    assert h["adapter_admissions_total"] >= 1
+    assert h["adapter_resolve_total"] >= 1
+    assert h["adapter_bytes"] == registry.nbytes()
+    assert h["fused_adapter_id"] is None
+
+
+def test_unknown_adapter_bounces_at_submit(serve):
+    misses = serve.adapters.resolve_miss_total
+    with pytest.raises(UnknownAdapter):
+        serve.submit(Request(rid="nope", input_ids=PROMPT.copy(),
+                             max_new_tokens=2, adapter_id="nobody"))
+    assert serve.adapters.resolve_miss_total == misses + 1
+
+
+def test_adapter_requires_registry(tiny_engine):
+    eng = tiny_engine.serving(**SERVE_KW)
+    with pytest.raises(ValueError, match="no AdapterRegistry"):
+        eng.submit(Request(rid="r", input_ids=PROMPT.copy(),
+                           max_new_tokens=2, adapter_id="acme"))
+
+
+# --------------------------------------------------- salted prefix isolation
+
+def test_prefix_isolation_across_tenant_namespaces(serve):
+    """One page-aligned prompt through four namespaces: only the
+    same-tenant replay may prefix-hit, and nothing COWs across tenants."""
+    prompt = np.asarray(np.random.default_rng(123).integers(
+        1, 250, 3 * SERVE_KW["page_size"] + 4), np.int32)
+
+    def run_one(tag, aid):
+        serve.run([Request(rid=f"iso{tag}", input_ids=prompt.copy(),
+                           max_new_tokens=3, adapter_id=aid)])
+        h = serve.health()
+        return h["prefix_hits_total"], h["cow_copies_total"]
+
+    h0 = (serve.health()["prefix_hits_total"],
+          serve.health()["cow_copies_total"])
+    run_one("pub", "acme")                 # publishes under acme's salt
+    run_one("other", "globex")             # same tokens, foreign namespace
+    after_base = run_one("base", None)     # same tokens, base namespace
+    after_same = run_one("again", "acme")  # same tokens, SAME namespace
+    assert after_base[0] - h0[0] == 0      # zero cross-tenant hits
+    assert after_base[1] - h0[1] == 0      # zero cross-tenant COW
+    assert after_same[0] == after_base[0] + 1          # same-tenant hit
+
+
+# ------------------------------------------------------- fused-view serving
+
+def test_fused_view_epoch_flip_and_exclusive_admission(tiny_engine,
+                                                       registry,
+                                                       fused_outputs):
+    eng = tiny_engine.serving(adapters=registry, **SERVE_KW)
+    base_out = eng.run([Request(rid="b0", input_ids=PROMPT.copy(),
+                                max_new_tokens=6)])[0].output_ids
+    assert np.array_equal(base_out, fused_outputs[(None, "greedy")])
+
+    stats = eng.fuse_adapter("acme")
+    assert eng.weight_epoch == 1 and stats["fused_adapter_id"] == "acme"
+    assert eng.health()["fused_adapter_id"] == "acme"
+    # fused-exclusive: any OTHER tenant (incl. base) bounces at submit —
+    # its batched delta would assume the shared base weights
+    with pytest.raises(ValueError, match="FUSED"):
+        eng.submit(Request(rid="x", input_ids=PROMPT.copy(),
+                           max_new_tokens=2))
+    with pytest.raises(ValueError, match="FUSED"):
+        eng.submit(Request(rid="y", input_ids=PROMPT.copy(),
+                           max_new_tokens=2, adapter_id="globex"))
+    # the fused tenant itself serves token-exactly (slot delta stays zero)
+    out = eng.run([Request(rid="f0", input_ids=PROMPT.copy(),
+                           max_new_tokens=6, adapter_id="acme",
+                           sampling=SAMPLED)])[0]
+    assert np.array_equal(out.output_ids, fused_outputs[("acme", "sampled")])
+
+    eng.fuse_adapter(None)                             # back to shared base
+    assert eng.weight_epoch == 2
+    assert eng.fused_adapter_id is None
+    out = eng.run([Request(rid="b1", input_ids=PROMPT.copy(),
+                           max_new_tokens=6)])[0]
+    assert np.array_equal(out.output_ids, fused_outputs[(None, "greedy")])
+    # and batched-delta tenants are admissible again, still exact
+    out = eng.run([Request(rid="g1", input_ids=PROMPT.copy(),
+                           max_new_tokens=6, adapter_id="globex")])[0]
+    assert np.array_equal(out.output_ids, fused_outputs[("globex", "greedy")])
+
+
+def test_fuse_adapter_requires_registry(tiny_engine):
+    eng = tiny_engine.serving(**SERVE_KW)
+    with pytest.raises(RuntimeError, match="AdapterRegistry"):
+        eng.fuse_adapter("acme")
+
+
+# ------------------------------------------------------------ fleet failover
+
+def test_fleet_failover_resumes_token_exact_under_same_adapter(
+        tiny_engine, registry, tmp_path):
+    """Pinned-seed fleet run: an adapter-tagged SAMPLED stream is killed
+    mid-flight with journaled tokens outstanding; the survivor must
+    resume it token-exactly under the SAME adapter (the journal carries
+    ``adapter_id``), and routing/advertisement must expose residency."""
+    from deepspeed_tpu.elasticity import FileCoordinationStore
+    from deepspeed_tpu.inference.fleet import FleetMember, FleetRouter
+
+    kw = dict(b_slots=2, page_size=8, max_model_len=64)
+    # fault-free reference through the same registry (engine-independent)
+    ref_serve = tiny_engine.serving(adapters=registry, **kw)
+    reqs = [Request(rid="g", input_ids=PROMPT.copy(), max_new_tokens=10,
+                    adapter_id="acme"),
+            Request(rid="s", input_ids=PROMPT.copy(), max_new_tokens=10,
+                    adapter_id="globex", sampling=SAMPLED),
+            Request(rid="b", input_ids=PROMPT.copy(), max_new_tokens=6)]
+
+    def copies():
+        return [Request(rid=r.rid, input_ids=r.input_ids,
+                        max_new_tokens=r.max_new_tokens,
+                        sampling=r.sampling, adapter_id=r.adapter_id)
+                for r in reqs]
+
+    ref = {r.rid: r.output_ids for r in ref_serve.run(copies())}
+    del ref_serve
+
+    clock = [0.0]
+    store = FileCoordinationStore(str(tmp_path / "coord"),
+                                  clock=lambda: clock[0])
+    members = [FleetMember(f"engine{i}",
+                           tiny_engine.supervised_serving(
+                               max_restarts=5, adapters=registry, **kw),
+                           store, lease_s=1.0)
+               for i in range(2)]
+    router = FleetRouter(store, members, lease_s=100.0, miss_limit=3,
+                         journal_every_k=1)
+    state = {"journal_adapters": None, "killed": None}
+
+    def on_tick(r, rounds):
+        clock[0] += 1.0
+        if rounds == 3 and state["journal_adapters"] is None:
+            # the durable journal carries the tenant identity
+            docs = [store.get(f"fleet/requests/{k}")
+                    for k in store.list("fleet/requests")]
+            state["journal_adapters"] = {d["rid"]: d.get("adapter_id")
+                                         for d in docs if d}
+        if rounds == 4 and state["killed"] is None:
+            victim = r._owner.get("g") or r._owner.get("s")
+            if victim:
+                r.members[victim].kill()
+                state["killed"] = victim
+
+    results = router.run(copies(), max_ticks=600, on_tick=on_tick)
+    by = {r.rid: r for r in results}
+    assert state["killed"] is not None
+    assert state["journal_adapters"]["g"] == "acme"
+    assert state["journal_adapters"]["s"] == "globex"
+    assert sorted(by) == ["b", "g", "s"]
+    for rid, res in by.items():
+        assert res.finish_reason == "length"
+        assert np.array_equal(res.output_ids, ref[rid]), rid
+    failed_over = [r for r in results if r.failovers]
+    assert failed_over                                 # the kill landed
+    assert any(r.resumed_tokens for r in failed_over)  # mid-stream resume
+    assert by["g"].adapter_id == "acme"                # tenant survives
+    assert by["s"].adapter_id == "globex"
+    h = router.health()
+    assert h["adapter_routes_total"] >= 1
+    for eid, ad in h["engines"].items():
+        if ad:
+            assert ad["adapters_loaded"] == registry.loaded()
+
+
+def test_fleet_router_skips_fused_exclusive_member(tiny_engine, registry,
+                                                   tmp_path):
+    """A member serving a fused view admits only its own tenant — the
+    router must route every other request around it."""
+    from deepspeed_tpu.elasticity import FileCoordinationStore
+    from deepspeed_tpu.inference.fleet import FleetMember, FleetRouter
+
+    kw = dict(b_slots=2, page_size=8, max_model_len=64)
+    store = FileCoordinationStore(str(tmp_path / "coord"))
+    members = [FleetMember(f"engine{i}",
+                           tiny_engine.supervised_serving(
+                               max_restarts=5, adapters=registry, **kw),
+                           store, lease_s=100.0)
+               for i in range(2)]
+    members[0].sup.engine.fuse_adapter("acme")
+    router = FleetRouter(store, members, lease_s=100.0, miss_limit=3)
+    results = router.run(
+        [Request(rid="b", input_ids=PROMPT.copy(), max_new_tokens=4),
+         Request(rid="g", input_ids=PROMPT.copy(), max_new_tokens=4,
+                 adapter_id="globex"),
+         Request(rid="a", input_ids=PROMPT.copy(), max_new_tokens=4,
+                 adapter_id="acme")],
+        max_ticks=300)
+    by = {r.rid: r for r in results}
+    assert all(r.finish_reason == "length" for r in results)
+    # base and globex streams landed on the un-fused member only
+    assert router.tokens_by_engine["engine1"] > 0
+    assert by["b"].output_ids.size and by["g"].output_ids.size

@@ -33,6 +33,7 @@ def _base_config(results_dir):
     }
 
 
+@pytest.mark.slow
 def test_autotune_end_to_end(tmp_path):
     rd = str(tmp_path / "results")
     best, records = autotune(
@@ -161,6 +162,7 @@ def test_model_based_finds_peak_in_few_trials(tmp_path):
     assert best["zero_optimization"]["stage"] == true_best[1]
 
 
+@pytest.mark.slow
 def test_model_based_beats_fast_gridsearch_trial_count(tmp_path):
     """The model extrapolates over the untried grid — fewer measurements
     than exhaustive search for the same winner."""
@@ -178,6 +180,7 @@ def test_model_based_beats_fast_gridsearch_trial_count(tmp_path):
     assert len(mb_records) < len(gs_records)
 
 
+@pytest.mark.slow
 def test_parallel_compile_prune(tmp_path):
     """compile_prune screens candidates concurrently via engine.lower_train_step
     and flags over-budget programs without running them."""

@@ -27,6 +27,7 @@ def _params_flat(engine):
 
 
 @pytest.mark.parametrize("stage", [0, 2])
+@pytest.mark.slow
 def test_save_load_roundtrip(tmp_path, stage):
     e1 = _engine(stage=stage)
     for s in range(3):
@@ -108,6 +109,7 @@ def test_save_16bit_model(tmp_path):
     assert os.path.isdir(path)
 
 
+@pytest.mark.slow
 def test_moe_expert_cross_ep_restore(tmp_path):
     """An ep2 MoE checkpoint restores onto an ep4 mesh with identical expert
     weights (reference saves per-expert files so EP degree can change,

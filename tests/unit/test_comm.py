@@ -4,15 +4,15 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
 
 import deepspeed_tpu.comm as dist
 from deepspeed_tpu.parallel import initialize_mesh
+from deepspeed_tpu.parallel.mesh import shard_map_compat
 
 
 def _shmap(mesh, fn, in_specs, out_specs):
-    return jax.jit(shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                             check_vma=False))
+    return jax.jit(shard_map_compat(fn, mesh=mesh, in_specs=in_specs,
+                                    out_specs=out_specs))
 
 
 def test_all_reduce_sum():

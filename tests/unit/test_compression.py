@@ -163,6 +163,7 @@ def test_engine_trains_with_qat():
     assert np.isfinite(losses).all() and losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_engine_wires_activation_quantization():
     from deepspeed_tpu.models import CausalLM
 

@@ -188,6 +188,7 @@ def test_ltd_scheduler_anneals_and_quantizes():
 
 # -- engine integration -----------------------------------------------------
 
+@pytest.mark.slow
 def test_engine_curriculum_truncates_and_trains():
     model = CausalLM("tiny", max_seq_len=64)
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
@@ -209,6 +210,7 @@ def test_engine_curriculum_truncates_and_trains():
     assert engine.curriculum_scheduler.get_current_difficulty() == 64
 
 
+@pytest.mark.slow
 def test_engine_random_ltd_trains_and_anneals():
     model = CausalLM("tiny", max_seq_len=64)
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
@@ -285,6 +287,7 @@ def test_data_analyzer_rejects_stale_shards(tmp_path):
         an.run_reduce(str(tmp_path))
 
 
+@pytest.mark.slow
 def test_engine_metric_curriculum_samples_by_difficulty(tmp_path):
     """Non-seqlen curriculum (VERDICT r2 missing #8): an arbitrary
     per-sample difficulty metric steers the engine's sampler in-loop —
@@ -343,6 +346,7 @@ def test_engine_metric_curriculum_requires_values(tmp_path):
                                     "curriculum_type": "hardness"}})
 
 
+@pytest.mark.slow
 def test_metric_curriculum_state_survives_checkpoint(tmp_path):
     """Sampler difficulty state rides the checkpoint (reference
     DeepSpeedDataSampler state_dict): a resumed run continues the schedule
@@ -475,6 +479,7 @@ def test_analyzer_multi_metric_single_pass(tmp_path):
                                   [2 + i % 7 for i in range(23)])
 
 
+@pytest.mark.slow
 def test_multimetric_curriculum_end_to_end_differs_from_uniform(tmp_path):
     """Engine-level run: a curriculum that feeds short documents first must
     produce a measurably DIFFERENT loss trajectory from the uniform

@@ -217,6 +217,7 @@ def test_peak_flops_env(monkeypatch):
 
 # ---------------------------------------- device-trace correlation smoke
 
+@pytest.mark.slow
 def test_device_capture_annotates_spans_only_while_active(tmp_path,
                                                           monkeypatch):
     """CPU-safe correlation smoke: while a capture is active every
@@ -318,6 +319,7 @@ def _prefix_stream(n=9, seed=5, sys_len=17, tail=3):
             for i in range(n)]
 
 
+@pytest.mark.slow
 def test_program_stats_cover_full_serving_inventory(tiny_engine):
     """Acceptance: program_stats() reports nonzero FLOPs and invocation
     counts for every program in the serving inventory — decode, each
@@ -350,6 +352,7 @@ def test_program_stats_cover_full_serving_inventory(tiny_engine):
     assert 'dstpu_serve_device_seconds_total{program="cow"}' in text
 
 
+@pytest.mark.slow
 def test_program_stats_cover_speculative_programs(tiny_engine):
     from deepspeed_tpu.inference.speculative import (SpeculativeConfig,
                                                      layer_skip_draft)

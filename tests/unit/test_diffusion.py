@@ -100,6 +100,7 @@ def vae():
     return TINY_VAE, init_vae_params(TINY_VAE, jax.random.PRNGKey(1))
 
 
+@pytest.mark.slow
 def test_unet_forward_shape_and_finite(unet):
     cfg, params = unet
     x = jax.random.normal(jax.random.PRNGKey(2), (2, 8, 8, cfg.in_channels))
@@ -170,6 +171,7 @@ def test_ds_vae_adapter_pipeline_contract(vae):
     assert isinstance(m.decode(latents, return_dict=False), tuple)
 
 
+@pytest.mark.slow
 def test_unet_per_block_head_counts():
     """SD2.x passes attention_head_dim as a per-block list — each block must
     use ITS entry (reversed for up blocks), not the first one."""
@@ -187,6 +189,7 @@ def test_unet_per_block_head_counts():
     assert bool(jnp.isfinite(out).all())
 
 
+@pytest.mark.slow
 def test_denoise_loop_e2e(unet):
     """A 6-step DDIM-style loop through the jitted UNet — the reference's
     pipeline role (StableDiffusionPipeline drives exactly this call

@@ -470,6 +470,7 @@ def test_host_manifest_owner_stamp_detects_misattribution(tmp_path):
 
 # -------------------------------------------------- acceptance (pinned)
 
+@pytest.mark.slow
 def test_fleet_chaos_soak_trace_assembly_pinned_seed(tmp_path):
     """ISSUE 15 acceptance: pinned ``chaos_soak --mode fleet`` seed — a
     silent lease kill with journaled batches outstanding; the resumed

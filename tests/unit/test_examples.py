@@ -20,6 +20,7 @@ _BOOT = ("import jax, runpy, sys, os; "
      "--new_tokens", "4"],
     ["examples/stable_diffusion.py", "--steps", "3", "--size", "8"],
 ], ids=["train", "generate", "rlhf", "stable_diffusion"])
+@pytest.mark.slow
 def test_example_runs(cmd):
     repo = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))

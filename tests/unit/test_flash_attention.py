@@ -55,6 +55,7 @@ def test_gqa_forward():
 
 
 @pytest.mark.parametrize("Hq,Hkv", [(4, 4), (8, 2)])
+@pytest.mark.slow
 def test_gradients_match_reference(Hq, Hkv):
     q, k, v = make_qkv(S=128, Hq=Hq, Hkv=Hkv)
 
@@ -95,6 +96,7 @@ def test_ragged_seq_falls_back():
                         interpret=True)
 
 
+@pytest.mark.slow
 def test_model_pallas_path_matches_xla():
     from deepspeed_tpu.models import get_config, init_params, forward
 

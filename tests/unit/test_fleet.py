@@ -237,6 +237,7 @@ def _fleet(tiny_engine, tmp_path, n=3, clock=None, monitor=None,
                               max_fleet_queue=max_fleet_queue)
 
 
+@pytest.mark.slow
 def test_fleet_serves_stream_distributed_and_token_exact(
         tiny_engine, reference, tmp_path):
     reqs, ref = reference
@@ -273,6 +274,7 @@ def test_fleet_member_advertises_health_through_store(tiny_engine, tmp_path):
     router.run([], max_ticks=200)
 
 
+@pytest.mark.slow
 def test_fleet_sheds_by_fleet_queue_depth(tiny_engine, tmp_path):
     store, router = _fleet(tiny_engine, tmp_path, n=2, max_fleet_queue=2)
     reqs = _stream(12, seed=3, new_choices=(4,))
@@ -341,6 +343,7 @@ def test_fleet_rejects_unjournalable_and_duplicate_rids(tiny_engine,
 
 
 @pytest.mark.chaos
+@pytest.mark.slow
 def test_fleet_engine_kill_fails_over_none_lost(tiny_engine, reference,
                                                 tmp_path):
     """ISSUE 7 acceptance: 3 engines, kill one mid-stream — the router
@@ -384,6 +387,7 @@ def test_fleet_engine_kill_fails_over_none_lost(tiny_engine, reference,
 
 
 @pytest.mark.chaos
+@pytest.mark.slow
 def test_fleet_budget_exhaustion_writes_dead_marker(tiny_engine, reference,
                                                     tmp_path):
     """An engine whose restart budget exhausts 'crashes': its dying breath
@@ -410,6 +414,7 @@ def test_fleet_budget_exhaustion_writes_dead_marker(tiny_engine, reference,
 
 
 @pytest.mark.chaos
+@pytest.mark.slow
 def test_fleet_coordinator_kill_election_converges(tiny_engine, reference,
                                                    tmp_path):
     """ISSUE 7 acceptance: kill the coordinator mid-stream — the standby
@@ -449,6 +454,7 @@ def test_fleet_coordinator_kill_election_converges(tiny_engine, reference,
 # ------------------------------------------- token journaling (ISSUE 8)
 
 @pytest.mark.chaos
+@pytest.mark.slow
 def test_fleet_midstream_kill_resumes_after_last_journaled_token(
         tiny_engine, reference, tmp_path):
     """ISSUE 8 acceptance: with token journaling on, killing an engine
@@ -504,6 +510,7 @@ def test_fleet_midstream_kill_resumes_after_last_journaled_token(
 
 
 @pytest.mark.chaos
+@pytest.mark.slow
 def test_fleet_sampled_midstream_resume_token_exact(tiny_engine, tmp_path):
     """ISSUE 9 acceptance: a SAMPLED stream killed mid-flight resumes
     token-exact.  The journal carries the RNG lane (sampling params incl.
@@ -572,6 +579,7 @@ def test_fleet_sampled_midstream_resume_token_exact(tiny_engine, tmp_path):
     assert store.list("fleet/requests") == []
 
 
+@pytest.mark.slow
 def test_fleet_journal_cap_bounds_resume(tiny_engine, tmp_path):
     """max_journal_tokens caps the per-request journal: the resume carries
     at most the cap (the tail past it is re-decoded) and the output stays
@@ -606,6 +614,7 @@ def test_fleet_journal_cap_bounds_resume(tiny_engine, tmp_path):
     assert store.list("fleet/requests") == []
 
 
+@pytest.mark.slow
 def test_fleet_finish_straight_from_journal(tiny_engine, tmp_path):
     """A journal that already holds the complete stream (the engine died
     between its last flush and collection) short-circuits failover to a
@@ -639,6 +648,7 @@ def test_fleet_finish_straight_from_journal(tiny_engine, tmp_path):
 
 
 @pytest.mark.chaos
+@pytest.mark.slow
 def test_fleet_journal_gc_by_freshly_elected_standby(tiny_engine, tmp_path):
     """The collection that deletes a journal entry may run on a router
     that never dispatched the request: a standby that took over mid-stream
@@ -673,6 +683,7 @@ def test_fleet_journal_gc_by_freshly_elected_standby(tiny_engine, tmp_path):
     assert standby.journal_bytes() == 0
 
 
+@pytest.mark.slow
 def test_fleet_fresh_submit_overwrites_orphaned_journal_entry(
         tiny_engine, tmp_path):
     """A journal entry orphaned by a crashed PREVIOUS run (same store dir,
@@ -783,6 +794,7 @@ def test_fleet_reelected_leader_resyncs_tracked_rids(tiny_engine, tmp_path):
     assert router._owner["x"] == other
 
 
+@pytest.mark.slow
 def test_fleet_rolling_restart_never_drops_requests(tiny_engine, reference,
                                                     tmp_path):
     reqs, ref = reference
@@ -833,6 +845,7 @@ def test_recycle_refuses_undrained_engine(tiny_engine):
     assert sup.restarts == 0                      # maintenance, not a fault
 
 
+@pytest.mark.slow
 def test_fleet_gauges_reach_prometheus_exposition(tiny_engine, tmp_path):
     from deepspeed_tpu.observability import prometheus_text
 
@@ -849,6 +862,7 @@ def test_fleet_gauges_reach_prometheus_exposition(tiny_engine, tmp_path):
         assert gauge in text, gauge
 
 
+@pytest.mark.slow
 def test_fleet_rolls_up_firing_slo_alerts(tiny_engine, tmp_path):
     """ISSUE 12: members evaluate their SLO rules per working tick and
     carry firing rule names in the store advertisement; the router rolls
@@ -890,6 +904,7 @@ def test_fleet_rolls_up_firing_slo_alerts(tiny_engine, tmp_path):
 # --------------------------------- acceptance: the chaos_soak fleet harness
 
 @pytest.mark.chaos
+@pytest.mark.slow
 def test_fleet_chaos_soak_deterministic_lease_seed(tmp_path):
     """Pinned seed of ``tools/chaos_soak.py --mode fleet``: silent engine
     kill + coordinator kill in one stream (seed 1 draws both)."""
@@ -908,6 +923,7 @@ def test_fleet_chaos_soak_deterministic_lease_seed(tmp_path):
 
 
 @pytest.mark.chaos
+@pytest.mark.slow
 def test_fleet_chaos_soak_deterministic_budget_seed(tmp_path):
     """Pinned seed 4: fault-injected restart-budget exhaustion — the dead
     marker path, no coordinator kill."""
@@ -924,6 +940,7 @@ def test_fleet_chaos_soak_deterministic_budget_seed(tmp_path):
 
 
 @pytest.mark.chaos
+@pytest.mark.slow
 def test_fleet_chaos_soak_deterministic_midstream_seed(tmp_path):
     """Pinned seed 3 (ISSUE 8): a silent lease kill lands mid-stream with
     journaled batches outstanding — failover RESUMES after the last
@@ -945,6 +962,7 @@ def test_fleet_chaos_soak_deterministic_midstream_seed(tmp_path):
 
 
 @pytest.mark.chaos
+@pytest.mark.slow
 def test_fleet_chaos_soak_deterministic_sampled_seed(tmp_path):
     """Pinned seed 7 (ISSUE 9): the soak's stream is one-third sampled,
     and at this seed a lease kill lands with SAMPLED journaled streams
@@ -985,6 +1003,7 @@ def test_fleet_chaos_soak_multiseed(tmp_path):
 # ---------------------------------------- prefix residency routing (ISSUE 11)
 
 
+@pytest.mark.slow
 def test_fleet_prefix_affinity_routes_to_resident_engine_then_failover(
         tiny_engine, tmp_path):
     """ISSUE 11 acceptance: with residency digests published, a
@@ -1080,6 +1099,7 @@ def test_fleet_prefix_affinity_routes_to_resident_engine_then_failover(
             "fleet/residency_promotions_total"} <= names
 
 
+@pytest.mark.slow
 def test_fleet_affinity_respects_load_slack(tiny_engine, tmp_path):
     """Affinity must not amplify a hot spot: when the resident engine's
     load exceeds the least-loaded engine by more than
@@ -1336,6 +1356,7 @@ def test_partition_of_deterministic_and_in_range():
     assert partition_of(3, 1) == 0
 
 
+@pytest.mark.slow
 def test_sharded_admission_follower_admits_coordinator_serves(
         tiny_engine, reference, tmp_path):
     from deepspeed_tpu.inference.fleet import FleetWrongPartition
@@ -1518,6 +1539,7 @@ def test_epoch_flip_successor_adopts_orphaned_flip(tiny_engine, tmp_path):
 # ------------------------- pinned fleet_procs chaos seed (ISSUE 16)
 
 @pytest.mark.chaos
+@pytest.mark.slow
 def test_fleet_procs_chaos_soak_deterministic_seed(tmp_path):
     """Pinned seed of ``tools/chaos_soak.py --mode fleet_procs`` (ISSUE
     16 acceptance): REAL member-daemon subprocesses over the store, a

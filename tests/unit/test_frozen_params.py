@@ -39,6 +39,7 @@ def _leaf(tree, layer, name):
 
 
 @pytest.mark.parametrize("stage", [0, 1, 2, 3])
+@pytest.mark.slow
 def test_frozen_leaves_never_move(stage):
     e = _engine(SimpleFrozenModel(HID), stage=stage)
     p0 = jax.tree_util.tree_map(lambda x: np.asarray(x, np.float32),
@@ -132,6 +133,7 @@ def test_client_optimizer_gets_wrapped():
     assert not np.array_equal(_leaf(e.state.params, "linear_1", "kernel"), t0)
 
 
+@pytest.mark.slow
 def test_causallm_frozen_keywords():
     """Model-family wiring: config.frozen_keywords freezes matched stacks
     (here the embedding) through a real train loop."""

@@ -61,6 +61,7 @@ def _torch_golden():
     return hf_cfg, init_sd, np.asarray(losses)
 
 
+@pytest.mark.slow
 def test_engine_reproduces_torch_golden_trajectory():
     import dataclasses
 

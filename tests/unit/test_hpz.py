@@ -53,6 +53,7 @@ def test_hpz_mesh_and_shardings():
             assert "data_outer" not in _axes(e)
 
 
+@pytest.mark.slow
 def test_hpz_trains_and_matches_plain_stage3():
     plain = _engine(hpz=1)
     l0 = [float(plain.train_batch(batch=random_batch(

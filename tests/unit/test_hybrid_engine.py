@@ -36,6 +36,7 @@ def _batch(engine, model, seed):
         (engine.train_batch_size, 16)).astype(np.int32)}
 
 
+@pytest.mark.slow
 def test_generate_tracks_training():
     """Generation must see the updated weights after each train step —
     the core hybrid-engine property."""
@@ -56,6 +57,7 @@ def test_generate_tracks_training():
         not np.array_equal(out0, out1)
 
 
+@pytest.mark.slow
 def test_rlhf_loop_shape():
     """generate → train on the rollout → generate (actor loop smoke)."""
     engine, model = _engine()
@@ -133,6 +135,7 @@ def _lora_engine(stage=3, rank=4):
     return engine, actor, base
 
 
+@pytest.mark.slow
 def test_lora_trains_only_adapters():
     """Engine state is the adapter tree; base stays frozen; loss drops."""
     import jax
@@ -153,6 +156,7 @@ def test_lora_trains_only_adapters():
     assert bsum > 0
 
 
+@pytest.mark.slow
 def test_lora_fuse_unfuse_roundtrip():
     """fuse caches base+A@B·scale; unfuse drops it; generation auto-refuses
     after a training flip (fused_at_step tracking)."""

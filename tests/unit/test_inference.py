@@ -1,6 +1,7 @@
 """Inference engine tests (reference tests/unit/inference/test_inference.py, scoped
 to the functional slice: TP auto-sharding, dtype conversion, generate loop)."""
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -177,6 +178,7 @@ def test_tp_forward_matches_single():
     np.testing.assert_allclose(l1, l2, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_topk_topp_sampling():
     """top_k=1 must equal greedy; top_p must restrict to the nucleus."""
     from deepspeed_tpu.models import CausalLM

@@ -169,6 +169,7 @@ def test_host_tier_bytes_sum_slab_tuples():
 
 # ----------------------------------------------------- numerical parity
 
+@pytest.mark.slow
 def test_int8_greedy_token_exact_vs_fp(tiny):
     """The measured exactness threshold: at the tiny config the per-row
     int8 rounding never flips a greedy argmax, so the quantized engine
@@ -186,6 +187,7 @@ def test_int8_greedy_token_exact_vs_fp(tiny):
     del q
 
 
+@pytest.mark.slow
 def test_int8_sampled_distribution_vs_fp(tiny):
     """Sampled lanes ride the same counter-based RNG on both engines, so
     near-identical logits ⇒ near-identical streams: most requests match
@@ -222,6 +224,7 @@ def test_int8_sampled_distribution_vs_fp(tiny):
 
 # ---------------------------------------- zero-recompile + inventory
 
+@pytest.mark.slow
 def test_int8_zero_recompile_inventory_tiered(tiny):
     """The steady-state gates on the QUANTIZED engine under the full
     serving surface: prefix sharing + COW (unaligned shared prompt),
@@ -278,6 +281,7 @@ def test_int8_zero_recompile_inventory_tiered(tiny):
     del sup
 
 
+@pytest.mark.slow
 def test_int8_update_params_flip_compiles_match_fp(tiny):
     """The weight-epoch flip re-lowers the donated programs for the new
     param buffers on BOTH layouts; the gate is that the quantized pools
@@ -298,6 +302,7 @@ def test_int8_update_params_flip_compiles_match_fp(tiny):
     assert flip_compiles("int8") == flip_compiles(None)
 
 
+@pytest.mark.slow
 def test_int8_speculative_greedy_exact_zero_recompile(tiny):
     from deepspeed_tpu.inference.speculative import (SpeculativeConfig,
                                                      layer_skip_draft)
@@ -326,6 +331,7 @@ def test_int8_speculative_greedy_exact_zero_recompile(tiny):
 
 # -------------------------------------------------------- composition
 
+@pytest.mark.slow
 def test_quantized_weights_compose_with_int8_kv():
     """Satellite 6 (ISSUE 17): weight quantization (the engine shim) and
     KV quantization are independent knobs that compose in ONE engine —
@@ -352,6 +358,7 @@ def test_quantized_weights_compose_with_int8_kv():
 # ------------------------------------------------------- pinned chaos
 
 @pytest.mark.chaos
+@pytest.mark.slow
 def test_serve_soak_short_deterministic_tiered_int8():
     """The ISSUE 17 pinned seed: the seeded kill/replay soak under
     tiering POOL PRESSURE on the QUANTIZED pool — the extended ledger

@@ -52,6 +52,7 @@ def test_pld_theta_traced():
     assert float(t) == pytest.approx(1.0)
 
 
+@pytest.mark.slow
 def test_pld_training_end_to_end():
     model = CausalLM("tiny", max_seq_len=64)
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={

@@ -31,6 +31,7 @@ def test_scan_matches_unrolled():
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_remat_matches_no_remat():
     cfg = get_config("tiny", dtype=jnp.float32, remat=True)
     params = init_params(cfg, jax.random.PRNGKey(1))
@@ -72,6 +73,7 @@ def test_causality():
     assert not np.allclose(np.asarray(l1[0, 7]), np.asarray(l2[0, 7]))
 
 
+@pytest.mark.slow
 def test_gqa_forward_grad():
     cfg = get_config("tiny-gqa", dtype=jnp.float32)
     model = CausalLM(cfg)
@@ -103,6 +105,7 @@ def test_tp_sp_sharded_forward():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
 
 
+@pytest.mark.slow
 def test_train_loss_decreases_with_engine():
     import deepspeed_tpu
 
@@ -123,6 +126,7 @@ def test_train_loss_decreases_with_engine():
     assert last < first * 0.9, (first, last)
 
 
+@pytest.mark.slow
 def test_windowed_attention_trains_through_scan():
     """GPT-Neo-style per-layer window alternation must survive the TRAIN
     path — the window rides the layer scan as a traced scalar through

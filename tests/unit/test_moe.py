@@ -125,6 +125,7 @@ def test_moe_layer_forward():
     assert bool(jnp.isfinite(aux))
 
 
+@pytest.mark.slow
 def test_moe_model_trains():
     import deepspeed_tpu
     from deepspeed_tpu.models import CausalLM
@@ -164,6 +165,7 @@ def test_moe_layer_residual():
     assert float(jnp.abs(g).sum()) > 0
 
 
+@pytest.mark.slow
 def test_prmoe_pyramid_trains():
     """PR-MoE: per-layer expert counts (dense layer 0, 4-expert layer 1) +
     residual mixing trains end-to-end on the ep mesh (VERDICT r2 item 5
@@ -194,6 +196,7 @@ def test_prmoe_pyramid_trains():
     assert last < first * 0.9, (first, last)
 
 
+@pytest.mark.slow
 def test_moe_expert_parallel_matches_unsharded():
     """ep=4 sharded run must produce the same logits as single-device."""
     from deepspeed_tpu.models import get_config, init_params, forward, param_specs

@@ -435,6 +435,7 @@ def test_serving_engine_health_reports_bound_metrics_port(monkeypatch):
 
 # --------------------------------------- KV-page tiering gauges (ISSUE 11)
 
+@pytest.mark.slow
 def test_health_and_prometheus_carry_tier_gauges():
     """ISSUE 11 satellite: health() and the Prometheus exposition grow the
     tiering quartet — demoted_pages / host_tier_bytes / promotions_total /

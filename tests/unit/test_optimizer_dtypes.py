@@ -57,6 +57,7 @@ def test_nu_dtype_selected_from_config_params():
 
 
 @pytest.mark.parametrize("accum", ["bf16", "fp32"])
+@pytest.mark.slow
 def test_engine_grad_accum_dtype_gas1(accum):
     import deepspeed_tpu
     from deepspeed_tpu.models import CausalLM

@@ -50,6 +50,7 @@ def _engine(tmp_path, model_name="tiny", **extra):
     return engine, model
 
 
+@pytest.mark.slow
 def test_param_offload_trains_params_on_disk(tmp_path):
     engine, model = _engine(tmp_path)
     # no params or optimizer state on device
@@ -65,6 +66,7 @@ def test_param_offload_trains_params_on_disk(tmp_path):
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_param_offload_loss_parity_with_device_engine(tmp_path):
     """Layer-streamed NVMe training must track the ordinary fused step."""
     model = CausalLM("tiny", max_seq_len=SEQ * 2)
@@ -102,6 +104,7 @@ def test_param_offload_gas(tmp_path):
     assert np.isfinite(losses).all() and losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_param_offload_checkpoint_roundtrip(tmp_path):
     ckpt = str(tmp_path / "ckpt")
     e1, model = _engine(tmp_path)
@@ -255,6 +258,7 @@ def test_param_offload_multihost_simulate(tmp_path):
     np.testing.assert_allclose(l0, ref, rtol=2e-2, atol=2e-2)
 
 
+@pytest.mark.slow
 def test_param_offload_moe_loss_parity(tmp_path):
     """MoE layers stream too (r3 verdict weak #3: the composition matrix):
     expert weights ride the layer files, the router's load-balancing aux
@@ -282,6 +286,7 @@ def test_param_offload_moe_loss_parity(tmp_path):
     np.testing.assert_allclose(ev, ev_ref, rtol=5e-2)
 
 
+@pytest.mark.slow
 def test_param_offload_bf16_moments(tmp_path):
     """mu_dtype/nu_dtype bfloat16: at-rest moments are HALF size on NVMe
     (the 14 -> 10 B/param cut that lets 7B fit a ~90 GB disk), the host

@@ -918,6 +918,7 @@ def test_comm_pod_generation_env(monkeypatch):
 
 # ----------------------------------------- acceptance: simulated pod chaos
 @pytest.mark.chaos
+@pytest.mark.slow
 def test_pod_chaos_kill_reforms_and_restores(tmp_path):
     """ISSUE 5 acceptance: a simulated 4-host run killed at a seeded point
     (this seed: a mid-commit host death) auto-detects the loss, re-forms at
@@ -942,6 +943,7 @@ def test_pod_chaos_kill_reforms_and_restores(tmp_path):
 
 
 @pytest.mark.chaos
+@pytest.mark.slow
 def test_pod_chaos_step_kill_detected_by_leases(tmp_path):
     """Second deterministic seed: a silent mid-step death (the lease just
     stops renewing) detected by the heartbeat watchdog."""

@@ -48,6 +48,7 @@ def test_cost_analysis_of_matmul():
     assert abs(ca["flops"] - 2 * 128 * 256 * 64) / (2 * 128 * 256 * 64) < 0.1
 
 
+@pytest.mark.slow
 def test_engine_profiler_prints_and_reports(tmp_path):
     report = tmp_path / "flops.txt"
     model = CausalLM("tiny")

@@ -61,6 +61,7 @@ def test_quantized_all_gather_matches_fp32_gather():
     assert err.max() <= scale_bound * 0.5 + 1e-7
 
 
+@pytest.mark.slow
 def test_quantized_all_gather_gradient_is_reduce_scatter():
     """AD through the quantized gather: cotangent reduce-scatters back to the
     shard (sum over the replicas' contributions)."""
@@ -80,6 +81,7 @@ def test_quantized_all_gather_gradient_is_reduce_scatter():
 
 
 @pytest.mark.parametrize("bits", [8, 4])
+@pytest.mark.slow
 def test_quantized_reduce_scatter_close_to_exact(bits):
     mesh = initialize_mesh(MeshLayout(dp=8))
     rng = np.random.default_rng(3)
@@ -101,6 +103,7 @@ def test_quantized_reduce_scatter_close_to_exact(bits):
     assert np.abs(out - expect).max() <= tol
 
 
+@pytest.mark.slow
 def test_hierarchical_reduce_scatter_sum_and_landing():
     """Two-hop qgZ primitive: (1) the result equals the full cross-group sum
     (within quant noise), (2) the landing layout is OUTER-MAJOR — device

@@ -453,6 +453,7 @@ def test_progress_fn_ignores_torn_manifestless_tags(tmp_path):
 
 # ------------------------------------------- acceptance: full supervised run
 @pytest.mark.chaos
+@pytest.mark.slow
 def test_supervised_run_survives_sigterm_failed_save_and_corruption(tmp_path):
     """Acceptance scenario: the injector (a) SIGTERMs mid-epoch, (b) fails
     one checkpoint write, (c) corrupts the newest committed tag — a

@@ -89,6 +89,7 @@ def test_model_sp_forward_matches_dense():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
 
 
+@pytest.mark.slow
 def test_engine_trains_with_sp():
     import deepspeed_tpu
     from deepspeed_tpu.models import CausalLM
@@ -133,6 +134,7 @@ def test_flash_ring_gqa():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
 
 
+@pytest.mark.slow
 def test_flash_ring_gradients_match_reference():
     """The merge differentiates THROUGH the kernel's lse output — the
     lse-differentiable VJP must reproduce dense-attention gradients (the

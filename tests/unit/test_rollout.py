@@ -117,6 +117,7 @@ def _assert_parity(ro, prompts, lanes, results, max_new):
 # ------------------------------------------------ handoff parity acceptance
 
 
+@pytest.mark.slow
 def test_rollout_parity_and_zero_recompile_across_weight_updates(stack):
     """The tentpole acceptance: train K steps -> publish epoch -> rollout,
     twice more after a warm round — greedy + sampled token-exact vs
@@ -245,6 +246,7 @@ def _shared_prefix_reqs(tag, vocab=256, sys_len=19, n=2, tail=3, seed=1):
     return system, reqs
 
 
+@pytest.mark.slow
 def test_stale_kv_never_served_after_weight_update(inference_stack):
     """ISSUE 13 stale-KV regression: admit a shared-prefix stream (hot
     pages + COW boundary + a demoted host-tier slab), update the live
@@ -299,6 +301,7 @@ def test_stale_kv_never_served_after_weight_update(inference_stack):
     assert serve.page_accounting()["balanced"]
 
 
+@pytest.mark.slow
 def test_epoch_tag_defenses_refuse_stale_entries(inference_stack):
     """Defense-in-depth: even WITHOUT the flush, each epoch stamp
     independently refuses pre-update K/V — a stale index entry is a
@@ -363,6 +366,7 @@ def test_update_params_rejects_mismatched_tree(inference_stack):
         serve.update_params(leaves)   # a list, not the compiled tree
 
 
+@pytest.mark.slow
 def test_supervisor_carries_weight_epoch_on_restart(inference_stack):
     """A PLAIN supervised engine (factory params predate the update): a
     restart must re-publish the dead engine's live view at its epoch so
@@ -398,6 +402,7 @@ def test_supervisor_carries_weight_epoch_on_restart(inference_stack):
     assert h["weight_updates_total"] >= 2   # the update + the carry
 
 
+@pytest.mark.slow
 def test_speculative_draft_refresh_and_guard(inference_stack):
     """A weight flip on a speculative engine may refresh the draft too:
     the swap validates BEFORE mutating (a mismatched draft tree is
@@ -439,6 +444,7 @@ def test_speculative_draft_refresh_and_guard(inference_stack):
 # ----------------------------------------------------------- LoRA satellite
 
 
+@pytest.mark.slow
 def test_lora_rollout_fuses_once_per_flip():
     """The LoRA fuse-once-per-flip cache rides the rollout path: repeated
     publishes without a train step reuse the fused tree; a train step
@@ -503,6 +509,7 @@ def _mesh_stream(tag, n=5, seed=5):
     return reqs
 
 
+@pytest.mark.slow
 def test_mesh_weight_updates_parity_and_zero_recompile(sharded_stack):
     """The 2-device half of the parity suite: live updates reshard the
     tree through the shared place_params/auto_tp_specs path — sharded
@@ -550,6 +557,7 @@ def test_mesh_weight_updates_parity_and_zero_recompile(sharded_stack):
 
 
 @pytest.mark.chaos
+@pytest.mark.slow
 def test_hybrid_chaos_soak_deterministic_seed():
     """Pinned seed of ``tools/chaos_soak.py --mode hybrid``: seeded kills
     mid-rollout (serve.decode) and mid-train-step (train.step) across

@@ -169,6 +169,7 @@ def test_generate_temperature_zero_is_greedy_not_nan(tiny_engine):
     np.testing.assert_array_equal(greedy, t0)
 
 
+@pytest.mark.slow
 def test_generate_topk_ge_vocab_and_combined_boundary(tiny_engine):
     """top_k >= vocab must disable the filter (not crash / not clamp to a
     wrong kth threshold), and combined top_k+top_p keeps every sampled
@@ -207,6 +208,7 @@ def test_sampling_params_validation(tiny_serve):
 
 # ------------------------------------------------- parity + recompiles
 
+@pytest.mark.slow
 def test_sampled_serving_parity_with_generate(tiny_engine, tiny_serve):
     """ISSUE 9 acceptance: per request, ServingEngine output under
     SamplingParams(seed, T, top_k, top_p) is token-identical to
@@ -267,6 +269,7 @@ def test_generate_lanes_per_row_params(tiny_engine):
 # --------------------------------------------------- replay under sampling
 
 @pytest.mark.chaos
+@pytest.mark.slow
 def test_sampled_replay_token_exact(tiny_engine):
     """Warm-restart replay of an in-flight SAMPLED stream re-prefills
     prompt+generated and, because lane keys are counter-based, continues
@@ -295,6 +298,7 @@ def test_sampled_replay_token_exact(tiny_engine):
 
 # -------------------------------------------------------- speculative
 
+@pytest.mark.slow
 def test_speculative_greedy_token_exact(tiny_engine, tiny_serve,
                                         spec_serve):
     """ISSUE 9 acceptance: greedy speculative decode is token-exact vs
@@ -318,6 +322,9 @@ def test_speculative_greedy_token_exact(tiny_engine, tiny_serve,
 
 
 def test_speculative_admission_zero_recompile(spec_serve):
+    # warm the engine's program inventory in-test (don't rely on a sibling
+    # test having run first — tier-1 deselects the slow ones)
+    spec_serve.run(_stream(6, seed=42, sampled=True, rid_prefix="w"))
     inv = spec_serve.program_inventory()
     base = _count()
     spec_serve.run(_stream(6, seed=42, sampled=True, rid_prefix="s"))
@@ -363,6 +370,7 @@ def test_speculative_eos_and_budget_truncate_verify_block(tiny_engine,
 
 
 @pytest.mark.chaos
+@pytest.mark.slow
 def test_speculative_replay_token_exact(tiny_engine):
     """A warm restart mid-speculative-stream replays prompt+generated and
     the speculative continuation stays token-exact (greedy), with the

@@ -83,6 +83,7 @@ def _assert_parity(engine, results, requests):
             np.testing.assert_array_equal(res.output_ids, base)
 
 
+@pytest.mark.slow
 def test_serving_parity_mixed_length_stream(tiny_engine, tiny_serve):
     """A ragged mixed-length stream through the slot scheduler must be
     token-identical to per-request greedy generate() (acceptance)."""
@@ -97,6 +98,7 @@ def test_serving_parity_mixed_length_stream(tiny_engine, tiny_serve):
     assert acct["referenced"] == acct["cached"]   # only the index holds refs
 
 
+@pytest.mark.slow
 def test_serving_parity_gqa():
     """Grouped-query attention through the paged pool."""
     engine = _make_engine("tiny-gqa")
@@ -106,6 +108,7 @@ def test_serving_parity_gqa():
     _assert_parity(engine, results, reqs)
 
 
+@pytest.mark.slow
 def test_serving_parity_alibi():
     """Position-from-slot-index must hold for alibi's relative biases."""
     engine = _make_engine("tiny", position="alibi", norm="layernorm",
@@ -257,6 +260,7 @@ def _shared_stream(n, seed, sys_len=21, tail_rng=(2, 6), max_new=5,
             for i in range(n)]
 
 
+@pytest.mark.slow
 def test_prefix_sharing_token_exact_with_cow(tiny_engine):
     """Tentpole acceptance: requests sharing a system prompt map resident
     pages (incl. a copy-on-write boundary page) and stay token-exact with
@@ -296,6 +300,7 @@ def test_prefix_sharing_token_exact_with_cow(tiny_engine):
     assert all(r.shared_prefix_tokens >= 21 for r in results2)
 
 
+@pytest.mark.slow
 def test_prefix_sharing_identical_prompts_cap_at_prompt_minus_one(
         tiny_engine):
     """An identical prompt shares at most L-1 tokens — the last prompt
@@ -406,6 +411,7 @@ def test_head_matching_own_cached_prefix_admits_under_pressure(tiny_engine):
     assert serve.page_accounting()["balanced"]
 
 
+@pytest.mark.slow
 def test_one_token_boundary_match_skips_cow(tiny_engine):
     """A boundary match below MIN_COW_TOKENS (e.g. two prompts sharing
     only their first token by chance) is not worth a pool-shaped page
@@ -423,6 +429,7 @@ def test_one_token_boundary_match_skips_cow(tiny_engine):
 # ---------------------------------------------------------------- satellites
 
 
+@pytest.mark.slow
 def test_gen_cache_weakref_key_and_lru(tiny_engine):
     """Satellite: _gen_cache keys on weakref identity (id reuse after GC
     cannot alias a live entry) and is LRU-bounded."""
@@ -538,6 +545,7 @@ def test_eos_sentinel_never_emits_token_zero(tiny_engine):
     np.testing.assert_array_equal(out_none, ref)
 
 
+@pytest.mark.slow
 def test_quantized_engine_serving_parity():
     """Satellite (docs/SERVING.md carried item): a weight-quantized engine
     now serves through the paged path — the shimmed ``apply_paged``
@@ -562,6 +570,7 @@ def test_quantized_engine_serving_parity():
     assert serve.page_accounting()["balanced"]
 
 
+@pytest.mark.slow
 def test_serve_smoke_tool():
     """Satellite: tools/serve_smoke.py (the tier-1 compile-count assert)
     runs in-process — real jax.monitoring counters, no fresh interpreter."""
@@ -602,6 +611,7 @@ def test_request_timeline_fields(tiny_engine, tiny_serve):
 # ------------------------------------------------ KV-page tiering (ISSUE 11)
 
 
+@pytest.mark.slow
 def test_mid_page_divergence_cow_from_full_donor_page(tiny_engine):
     """PR 6 carry-over closed: a prompt diverging INSIDE a donor's FULL
     page is COW-served up to the divergence point — the first follower
@@ -719,6 +729,7 @@ def test_host_tier_unit():
         HostTier(max_pages=0)
 
 
+@pytest.mark.slow
 def test_serving_tiering_demote_promote_token_exact(tiny_engine):
     """Tentpole acceptance (engine level): under pool pressure the engine
     DEMOTES cold prefix pages instead of evicting, promotes them on the

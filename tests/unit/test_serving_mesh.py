@@ -87,6 +87,7 @@ def _stream(n, seed=0, sampled=True):
     return reqs
 
 
+@pytest.mark.slow
 def test_sharded_token_exact_vs_unsharded_and_generate(sharded_engine,
                                                        sharded_serve):
     """The acceptance gate: tp=2 outputs == tp=1 outputs == generate(),
@@ -141,6 +142,7 @@ def test_zero_steady_state_compiles_on_mesh(sharded_serve):
     assert sharded_serve.page_accounting()["balanced"]
 
 
+@pytest.mark.slow
 def test_supervisor_warm_restart_adopts_sharded_programs(sharded_engine,
                                                          sharded_serve):
     """A decode-tick fault on the mesh warm-restarts with the compiled
@@ -201,6 +203,7 @@ def test_recycle_reuses_sharded_programs_and_gauges(sharded_engine):
     assert "dstpu_serve_kv_pool_bytes_per_device" in text
 
 
+@pytest.mark.slow
 def test_speculative_sharded_greedy_token_exact(sharded_engine,
                                                 sharded_serve):
     """The draft pool and the draft/verify programs ride the same mesh:
@@ -234,6 +237,7 @@ def test_mesh_rejects_indivisible_kv_heads(sharded_engine):
         ServingEngine(model, params, mesh=mesh, **SERVE_KW)
 
 
+@pytest.mark.slow
 def test_sharded_pool_demote_promote_token_exact(sharded_engine):
     """ISSUE 11 on a mesh: the tier movers run against the SHARDED pool —
     extract gathers the head shards into one host slab, inject device_puts

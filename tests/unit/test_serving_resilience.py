@@ -234,6 +234,7 @@ def test_all_slots_quarantined_recovers_via_warm_restart(tiny_engine):
 
 # ------------------------------------------- supervisor: restart + replay
 
+@pytest.mark.slow
 def test_decode_fault_warm_restart_replays_token_exact(tiny_engine,
                                                        reference):
     reqs, ref = reference
@@ -250,6 +251,7 @@ def test_decode_fault_warm_restart_replays_token_exact(tiny_engine,
         assert np.array_equal(r.input_ids, reqs[r.rid].input_ids)
 
 
+@pytest.mark.slow
 def test_replay_fault_is_retried_within_budget(tiny_engine, reference):
     reqs, ref = reference
     sup = tiny_engine.supervised_serving(**SERVE_KW)
@@ -274,6 +276,7 @@ def test_restart_budget_exhaustion_is_terminal(tiny_engine):
     assert len(sup.restart_log) == 2
 
 
+@pytest.mark.slow
 def test_serve_timeout_is_not_treated_as_a_fault(tiny_engine):
     sup = tiny_engine.supervised_serving(**SERVE_KW)
     with pytest.raises(ServeTimeout):
@@ -282,6 +285,7 @@ def test_serve_timeout_is_not_treated_as_a_fault(tiny_engine):
 
 
 @pytest.mark.chaos
+@pytest.mark.slow
 def test_chaos_decode_kill_at_random_tick_replays_token_exact(tiny_engine,
                                                               reference):
     """Satellite: inject a ``serve.decode`` failure at a seeded-random tick
@@ -330,6 +334,7 @@ def test_health_snapshot_and_gauges(tiny_engine):
     assert mon.latest("serve/shed_total") == 0.0
 
 
+@pytest.mark.slow
 def test_drain_finishes_inflight_and_hands_back_queue(tiny_engine):
     serve = tiny_engine.serving(b_slots=2, page_size=8, max_model_len=64)
     reqs = _stream(5, seed=9, new_choices=(6,))
@@ -400,6 +405,7 @@ def test_rebase_carries_remaining_deadline_budget():
         elapsed=9.0, t0=0.0).deadline_s is None
 
 
+@pytest.mark.slow
 def test_mid_drain_fault_preserves_partial_progress(tiny_engine, reference):
     """Carried PR 3 gap (ISSUE 6 satellite): a ``serve.decode`` fault
     injected MID-drain used to hand the in-flight requests back unserved,
@@ -439,6 +445,7 @@ def test_mid_drain_fault_preserves_partial_progress(tiny_engine, reference):
     assert sup.engine.page_accounting()["balanced"]
 
 
+@pytest.mark.slow
 def test_second_mid_drain_fault_keeps_queued_replay_progress(tiny_engine):
     """A SECOND fault mid-drain must not demote a queued in-flight-origin
     replay to 'never served': a replay re-queued on the replacement engine
@@ -489,6 +496,7 @@ def test_second_mid_drain_fault_keeps_queued_replay_progress(tiny_engine):
     assert sup.engine.page_accounting()["balanced"]
 
 
+@pytest.mark.slow
 def test_abandoned_drain_stash_served_by_run(tiny_engine):
     """A drain abandoned mid-recovery (its ``ServeTimeout`` propagates
     before the hand-back) leaves never-served requests in the supervisor's
@@ -539,6 +547,7 @@ def test_supervised_drain_returns_original_requests(tiny_engine):
 # --------------------------------------------- KV-page tiering (ISSUE 11)
 
 @pytest.mark.chaos
+@pytest.mark.slow
 def test_warm_restart_and_recycle_carry_host_tier(tiny_engine):
     """Demoted prefix pages live in HOST buffers, so they survive the dead
     engine's pool: a warm restart (and a planned recycle()) carries them
@@ -602,6 +611,7 @@ def test_warm_restart_and_recycle_carry_host_tier(tiny_engine):
 # ------------------------------------------------------------- serve soak
 
 @pytest.mark.chaos
+@pytest.mark.slow
 def test_serve_soak_short_deterministic():
     """Tier-1 variant of ``tools/chaos_soak.py --mode serve``: one seeded
     soak round — randomized decode/prefill/replay kills + shedding — with
@@ -626,6 +636,7 @@ def test_serve_soak_short_deterministic():
 
 
 @pytest.mark.chaos
+@pytest.mark.slow
 def test_serve_soak_short_deterministic_on_mesh():
     """The ISSUE 10 pinned seed: the same seeded kill/replay soak on a
     2-device mesh (model axis = 2) — every page-accounting + refcount
@@ -649,6 +660,7 @@ def test_serve_soak_short_deterministic_on_mesh():
 
 
 @pytest.mark.chaos
+@pytest.mark.slow
 def test_serve_soak_short_deterministic_tiered():
     """The ISSUE 11 pinned seed: the seeded kill/replay soak under
     KV-page tiering POOL PRESSURE (device pool shrunk to 10 pages, host
@@ -703,6 +715,7 @@ def test_serve_soak_driver_multiseed(tmp_path):
 
 # ------------------------------------------------- flight recorder (ISSUE 4)
 
+@pytest.mark.slow
 def test_warm_restart_flight_dump_covers_poisoned_tick(tiny_engine,
                                                        reference):
     """Acceptance (ISSUE 4): a kill injected via $DS_TPU_FAULTS at
@@ -812,6 +825,7 @@ def test_quarantined_slot_probed_and_unfenced(tiny_engine):
 
 
 @pytest.mark.chaos
+@pytest.mark.slow
 def test_failed_probe_keeps_slot_fenced_until_a_clean_canary(tiny_engine):
     """A canary that still fails re-fences the slot and restarts the
     clean-tick clock; a later clean canary restores it.  Long prompts keep

@@ -501,6 +501,7 @@ def test_prom_name_is_exports_sanitizer():
 
 # --------------------------------------------------------- tier-1 gates
 
+@pytest.mark.slow
 def test_full_tree_has_zero_new_findings():
     """THE enforcement test: the five contracts hold over the whole
     package, modulo the checked-in burn-down baseline.  A PR that adds

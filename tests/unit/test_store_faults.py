@@ -449,6 +449,7 @@ def test_history_checker_flags_journal_resurrection():
 # ------------------------------------------------------- pinned-seed soak
 
 @pytest.mark.chaos
+@pytest.mark.slow
 def test_store_partition_soak_pinned_seed(tmp_path):
     """Tier-1 variant of ``tools/chaos_soak.py --mode store_partition``:
     brownout absorbed, sub-grace blackout decoded dark with republish,

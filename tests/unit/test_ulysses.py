@@ -52,6 +52,7 @@ def test_ulysses_with_tp_axis():
     np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-2)
 
 
+@pytest.mark.slow
 def test_ulysses_trains_to_baseline_trajectory():
     def train(layout_kwargs, attn_impl):
         mesh_mod.reset_mesh()

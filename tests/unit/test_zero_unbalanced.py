@@ -37,11 +37,13 @@ def baseline():
 
 
 @pytest.mark.parametrize("stage", [1, 2, 3])
+@pytest.mark.slow
 def test_unbalanced_shapes_stage_parity(baseline, stage):
     np.testing.assert_allclose(_train(stage), baseline, rtol=1e-5)
 
 
 @pytest.mark.parametrize("stage", [0, 2, 3])
+@pytest.mark.slow
 def test_unused_param_trains(stage):
     """empty_grad: a param no loss path touches — its gradient is
     structurally zero; every stage must step through it without error and

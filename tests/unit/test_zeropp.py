@@ -32,6 +32,7 @@ def _train(engine, steps=4, seed=0):
             for s in range(steps)]
 
 
+@pytest.mark.slow
 def test_qwz_loss_tracks_unquantized():
     base = _train(_engine())
     mesh_mod.reset_mesh()
@@ -41,6 +42,7 @@ def test_qwz_loss_tracks_unquantized():
     np.testing.assert_allclose(quant, base, rtol=0.05, atol=0.02)
 
 
+@pytest.mark.slow
 def test_qwz_qgz_trains_and_converges():
     engine = _engine(qw=True, qg=True)
     losses = _train(engine, steps=8)
@@ -116,6 +118,7 @@ def _hlo_for(engine, hid=HID):
     return engine._compiled_train_step.lower(engine.state, batch).compile().as_text()
 
 
+@pytest.mark.slow
 def test_hpz_qwz_qgz_composition_trains():
     """The full ZeRO++ stack at once (reference
     partition_parameters.py:1019-1158 composes hpZ with qwZ/qgZ): hpZ=4
@@ -152,6 +155,7 @@ def test_hpz_qwz_region_covers_outer_hop_only():
     mesh_mod.reset_mesh()
 
 
+@pytest.mark.slow
 def test_hierarchical_qgz_trains_and_tracks():
     base = _train(_engine_z())
     mesh_mod.reset_mesh()
@@ -201,6 +205,7 @@ def test_hierarchical_qgz_two_hops_on_the_wire():
     mesh_mod.reset_mesh()
 
 
+@pytest.mark.slow
 def test_hierarchical_outer_volume_beats_flat():
     """Outer-link volume: hierarchical qgZ's inter-group all-to-all moves
     less than the flat qgZ all-to-all (which crosses the full 8-group as

@@ -438,6 +438,14 @@ def run_fleet_soak(seed: int, coord_dir: str, n_requests: int = 10,
     stream token-identical to the fault-free reference — not merely
     distribution-equal.
 
+    Another third is ADAPTER-TAGGED (ISSUE 19: rotating tenant ids over a
+    two-tenant LoRA registry shared by every member) so kills land on
+    multi-tenant streams: the journal carries ``adapter_id``, failover
+    re-prefills under the SAME adapter on the survivor, and parity
+    against the fault-free reference proves the resumed delta-path
+    stream is token-identical — a resume under the wrong (or no) adapter
+    would diverge at the first continued token.
+
     ``collect_traces=<dir>`` (ISSUE 15) runs the soak with the tracer ON,
     members publishing span segments every beat, assembles the fleet
     trace at the end (``<dir>/fleet_trace.json``) and asserts the
@@ -508,20 +516,48 @@ def run_fleet_soak(seed: int, coord_dir: str, n_requests: int = 10,
                               top_k=0 if i % 6 == 1 else 12,
                               top_p=0.9, seed=500 + i)
 
+    # two-tenant LoRA registry shared by every member AND the reference:
+    # rotating adapter ids tag roughly a third of the stream, so seeded
+    # kills land on multi-tenant slots with journaled deltas outstanding
+    from deepspeed_tpu.inference.adapters import AdapterRegistry
+    from deepspeed_tpu.runtime.lora import LoRAConfig
+
+    reg = AdapterRegistry(params["layers"])
+    for t_i, aid in enumerate(("acme", "globex")):
+        cfg = LoRAConfig(rank=4, alpha=8.0)
+        trng = np.random.default_rng(seed * 100 + t_i)
+        lora = {}
+        for t in cfg.targets:
+            L, d_in, d_out = (int(s) for s in np.shape(params["layers"][t]))
+            lora[t] = {"A": trng.standard_normal(
+                           (L, d_in, 4)).astype(np.float32) * 0.5,
+                       "B": trng.standard_normal(
+                           (L, 4, d_out)).astype(np.float32) * 0.05}
+        reg.register(aid, lora, cfg)
+
+    def adapter(i):
+        if i % 3 != 2:
+            return None
+        return ("acme", "globex")[(i // 3) % 2]
+
     base = [Request(rid=i, input_ids=prompt(i),
                     max_new_tokens=int(nprng.choice((4, 6, 8))),
-                    sampling=lane(i))
+                    sampling=lane(i), adapter_id=adapter(i))
             for i in range(n_requests)]
 
     def copies():
         return [Request(rid=r.rid, input_ids=r.input_ids,
                         max_new_tokens=r.max_new_tokens,
-                        sampling=r.sampling) for r in base]
+                        sampling=r.sampling, adapter_id=r.adapter_id)
+                for r in base]
 
     # fault-free single-engine reference (greedy AND sampled outputs are
     # engine-independent: counter-based lane keys are pure functions of
-    # (seed, position), so one reference serves every failover schedule)
-    ref_serve = engine.serving(b_slots=3, page_size=8, max_model_len=64)
+    # (seed, position), so one reference serves every failover schedule;
+    # the same registry makes adapter-tagged outputs engine-independent
+    # too — the batched delta is a pure function of the tenant's factors)
+    ref_serve = engine.serving(b_slots=3, page_size=8, max_model_len=64,
+                               adapters=reg)
     ref = {r.rid: r.output_ids for r in ref_serve.run(copies())}
     del ref_serve
 
@@ -543,7 +579,8 @@ def run_fleet_soak(seed: int, coord_dir: str, n_requests: int = 10,
         clock_box = [0.0]
         store = FileCoordinationStore(coord_dir, clock=lambda: clock_box[0])
 
-        serve_kw = dict(b_slots=2, page_size=8, max_model_len=64)
+        serve_kw = dict(b_slots=2, page_size=8, max_model_len=64,
+                        adapters=reg)
         members = [FleetMember(f"engine{i}",
                                engine.supervised_serving(
                                    max_restarts=0 if kill_mode == "budget"
@@ -621,7 +658,10 @@ def run_fleet_soak(seed: int, coord_dir: str, n_requests: int = 10,
         # proves no token was duplicated at the stitch and none was lost
         parity_checked = resumed_results = resumed_tokens = 0
         sampled_parity_checked = sampled_resumed_results = 0
+        adapter_parity_checked = adapter_resumed_results = 0
         sampled_rids = {r.rid for r in base if r.sampling is not None}
+        adapter_rids = {r.rid: r.adapter_id for r in base
+                        if r.adapter_id is not None}
         for rid, res in by_rid.items():
             if res.finish_reason in ("eos", "length"):
                 assert np.array_equal(res.output_ids, ref[rid]), \
@@ -629,11 +669,19 @@ def run_fleet_soak(seed: int, coord_dir: str, n_requests: int = 10,
                 parity_checked += 1
                 if rid in sampled_rids:
                     sampled_parity_checked += 1
+                if rid in adapter_rids:
+                    adapter_parity_checked += 1
+                    # the tenant identity survives the journal round-trip
+                    assert res.adapter_id == adapter_rids[rid], \
+                        f"fleet soak seed={seed}: rid {rid} finished under " \
+                        f"{res.adapter_id!r}, submitted {adapter_rids[rid]!r}"
                 if res.resumed_tokens:
                     resumed_results += 1
                     resumed_tokens += res.resumed_tokens
                     if rid in sampled_rids:
                         sampled_resumed_results += 1
+                    if rid in adapter_rids:
+                        adapter_resumed_results += 1
                     assert res.resumed_tokens <= len(res.output_ids), res
             else:
                 assert res.finish_reason in ("deadline", "shed"), \
@@ -698,6 +746,9 @@ def run_fleet_soak(seed: int, coord_dir: str, n_requests: int = 10,
             "resumed_tokens": resumed_tokens,
             "sampled_parity_checked": sampled_parity_checked,
             "sampled_resumed_results": sampled_resumed_results,
+            "adapter_tagged": len(adapter_rids),
+            "adapter_parity_checked": adapter_parity_checked,
+            "adapter_resumed_results": adapter_resumed_results,
             "faults_fired": len(inj.log),
             "final_term": live_router.term,
             "final_generation": live_router.generation,
