@@ -42,6 +42,9 @@ from .coordination import (CoordinationStore, HeartbeatWatchdog,
 from .elastic_agent import ElasticAgent
 from .elasticity import (ElasticPlan, ElasticityIncompatibleWorldSize,
                          compute_elastic_config)
+from .replication import (HostReplicator, ReplicaAdoptionError,
+                          adopt_replicas, note_adoption_fallback,
+                          replica_adoptions_total, replica_fallbacks_total)
 from .supervisor import Supervisor, SupervisorStandDown
 from ..observability.trace import trace_span
 from ..resilience.fault_injection import SITE_LATEST_PUBLISH, maybe_fire
@@ -180,6 +183,10 @@ class PodContext:
     # leave it None (orbax wrote the shards inside the engine save);
     # simulated pods use it so torn-checkpoint coverage has real files.
     shard_writer: Optional[Callable[[str, str], Sequence[str]]] = None
+    # in-RAM replica cadence for checkpoint-free recovery (0 = disabled):
+    # every k completed steps each host seals its shard slab to its ring
+    # buddy through the store (elasticity/replication.py)
+    replica_every_k: int = 0
 
     @property
     def is_coordinator(self) -> bool:
@@ -204,13 +211,33 @@ class PodElasticAgent(ElasticAgent):
     step loop raises :class:`PodPeerLost` as soon as a peer is declared
     dead, so this host exits the round at a step boundary instead of
     wedging in the next collective.
+
+    **Live-state adoption** (ISSUE 20): when the supervisor hands the
+    agent the previous round's membership + dead set
+    (``adopt_prev_hosts`` / ``adopt_dead``), the restore walk first tries
+    :func:`~.replication.adopt_replicas` — reconstruct the dead host's
+    shards from its buddy's in-RAM replica and resume at the sealed step
+    — and only on a loud :class:`~.replication.ReplicaAdoptionError`
+    (missing slab, dead buddy, checksum, generation fence) falls back to
+    the durable-checkpoint walk.  A ``replicator``
+    (:class:`~.replication.HostReplicator`) seals this host's slab every
+    ``ctx.replica_every_k`` steps from the step loop, plus a synchronous
+    best-effort seal when a preemption signal is latched (the planned
+    preemption never costs more than the in-flight step).
     """
 
     def __init__(self, engine, ckpt_dir: str, ctx: PodContext,
-                 watchdog: Optional[HeartbeatWatchdog] = None, **kw):
+                 watchdog: Optional[HeartbeatWatchdog] = None,
+                 replicator: Optional["HostReplicator"] = None,
+                 adopt_prev_hosts: Optional[Sequence[str]] = None,
+                 adopt_dead: Optional[Sequence[str]] = None, **kw):
         super().__init__(engine, ckpt_dir, **kw)
         self.ctx = ctx
         self.watchdog = watchdog
+        self.replicator = replicator
+        self.adopt_prev_hosts = tuple(adopt_prev_hosts or ())
+        self.adopt_dead = tuple(adopt_dead or ())
+        self.adopted_step: Optional[int] = None
 
     def _save(self) -> None:
         save_pod_checkpoint(self.engine, self.ckpt_dir, self.ctx,
@@ -226,6 +253,27 @@ class PodElasticAgent(ElasticAgent):
 
     def restore_if_present(self) -> int:
         self._sweep_torn_pod_tags()
+        if (self.adopt_prev_hosts and self.adopt_dead
+                and self.engine is not None):
+            try:
+                resumed = adopt_replicas(
+                    self.ctx.store, self.engine, self.adopt_prev_hosts,
+                    self.adopt_dead, self.ctx.generation, self.ctx.host_id)
+            except ReplicaAdoptionError as e:
+                # LOUD fallback by contract: the replica layer is an
+                # optimization over the durable commit protocol, never a
+                # replacement — any doubt sends us down the checkpoint walk
+                note_adoption_fallback()
+                logger.error(
+                    "pod restore: live-state adoption failed (%s); falling "
+                    "back to checkpoint restart", e)
+            else:
+                self.adopted_step = self.resumed_step = int(resumed)
+                log_dist(
+                    f"pod resume via live adoption at step {resumed} "
+                    f"(generation {self.ctx.generation}; rollback 0 steps "
+                    "past the last sealed replica)", ranks=[0])
+                return self.resumed_step
         return super().restore_if_present()
 
     def _sweep_torn_pod_tags(self) -> None:
@@ -265,9 +313,23 @@ class PodElasticAgent(ElasticAgent):
             if self.watchdog is not None:
                 # progress rides the lease so peers + supervisor can watch
                 self.watchdog.set_attrs(step=step + 1)
+            if self.replicator is not None:
+                if self.guard.should_stop:
+                    # preemption latched (SIGTERM): synchronous best-effort
+                    # seal BEFORE the save/exit sequence, so the planned
+                    # preemption never costs more than the in-flight step
+                    self.replicator.seal_now(step + 1)
+                else:
+                    self.replicator.maybe_replicate(step + 1)
             return out
 
-        return super().run(stepped, total_steps)
+        try:
+            return super().run(stepped, total_steps)
+        finally:
+            if self.replicator is not None:
+                # drain the in-flight publish: the final slab must be on
+                # the store before the next round plans its adoption cut
+                self.replicator.stop()
 
 
 # ------------------------------------------------------- shrink-to-healthy
@@ -305,10 +367,14 @@ def shrink_to_healthy(elastic_config, healthy_hosts: Sequence[str],
 class PodRound:
     """What one supervisor round hands the attempt: the generation it must
     heartbeat/rendezvous/commit under, the member hosts (coordinator
-    first), and the batch triad the shrunken world trains with."""
+    first), the batch triad the shrunken world trains with, plus — for the
+    live-adoption path — the PREVIOUS round's membership and the dead set
+    this round shrank away from (both empty on the first round)."""
     generation: int
     hosts: Tuple[str, ...]
     plan: ElasticPlan
+    prev_hosts: Tuple[str, ...] = ()
+    dead: Tuple[str, ...] = ()
 
     @property
     def n_hosts(self) -> int:
@@ -458,7 +524,10 @@ class PodSupervisor(Supervisor):
             logger.error("pod supervisor: %s", self.diagnosis)
             return RC_POD_UNRECOVERABLE
         gen = bump_generation(self.store)
-        rnd = PodRound(generation=gen, hosts=tuple(members), plan=plan)
+        prev = tuple(self.rounds[-1].hosts) if self.rounds else ()
+        dead_now = tuple(sorted(set(self.all_hosts) - set(healthy)))
+        rnd = PodRound(generation=gen, hosts=tuple(members), plan=plan,
+                       prev_hosts=prev, dead=dead_now)
         self.rounds.append(rnd)
         if len(members) < len(self.all_hosts):
             logger.warning(
@@ -472,6 +541,10 @@ class PodSupervisor(Supervisor):
                 ("pod/round_hosts", float(len(members)), gen),
                 ("pod/dead_hosts",
                  float(len(self.all_hosts) - len(healthy)), gen),
-                ("pod/coordinator_term", float(self.term), gen)])
+                ("pod/coordinator_term", float(self.term), gen),
+                ("pod/replica_adoptions_total",
+                 float(replica_adoptions_total()), gen),
+                ("pod/replica_fallbacks_total",
+                 float(replica_fallbacks_total()), gen)])
         with trace_span("pod.round", generation=gen, hosts=len(members)):
             return self.pod_attempt(rnd)

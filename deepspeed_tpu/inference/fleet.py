@@ -126,6 +126,11 @@ FLEET_DEAD_PREFIX = "fleet/dead"
 FLEET_ENGINES_PREFIX = "fleet/engines"
 FLEET_REQUESTS_PREFIX = "fleet/requests"
 FLEET_RESIDENCY_PREFIX = "fleet/residency"
+# per-engine adapter-registry digest (docs/FLEET.md "Adapter residency
+# routing"): what each member can serve, published on the beat cadence so
+# a router can tell "no member anywhere has this adapter" (typed shed with
+# a retry hint) apart from "the resident member is busy" (queue)
+FLEET_ADAPTERS_PREFIX = "fleet/adapters"
 FLEET_TRACE_PREFIX = "fleet/trace"
 FLEET_COORDINATOR_KEY = "fleet/coordinator"
 FLEET_GENERATION_KEY = "fleet/generation"
@@ -467,6 +472,16 @@ class FleetMember:
         self.last_residency = publish_residency(
             self.store, self.engine_id, self.residency_digest(),
             prefix=FLEET_RESIDENCY_PREFIX, generation=int(self.generation))
+        # adapter-registry digest, same cadence: the store copy is how a
+        # router with no live handle learns what this member can serve
+        # (fleet-wide-unknown adapter_ids shed typed instead of queueing)
+        self.store.put(f"{FLEET_ADAPTERS_PREFIX}/{self.engine_id}", {
+            "engine_id": self.engine_id,
+            "generation": int(self.generation),
+            "adapters_loaded": list(ad.get("adapters_loaded") or ()),
+            "fused_adapter_id": ad.get("fused_adapter_id"),
+            "t": now,
+        })
         # completed-span segment publish rides the beat cadence (already
         # rate-limited to lease_s/3) — a no-op while tracing is disabled
         self.publish_trace_segments()
@@ -711,6 +726,10 @@ class FleetRouter:
         # with the adapter already loaded (same slack bound as prefix
         # affinity — residency must not amplify a tenant hot-spot either)
         self.adapter_routes_total = 0
+        # fleet-wide-unknown adapter_ids shed typed (finish_reason
+        # "adapter_unknown") instead of queueing against members that can
+        # never serve them (docs/FLEET.md "Adapter residency routing")
+        self.adapter_unknown_total = 0
         # per-round memo of each member's digest as a {chain_key: tier}
         # map: scoring walks the full index otherwise, and a dispatch
         # burst would rebuild it per member per request on the admission
@@ -1298,6 +1317,33 @@ class FleetRouter:
         return (set(ad.get("adapters_loaded") or ()),
                 ad.get("fused_adapter_id"))
 
+    def _adapter_known_fleetwide(self, adapter_id: str) -> bool:
+        """Whether ANY member of the fleet can serve ``adapter_id``: live
+        registries for in-process members, the store-backed digest
+        (``fleet/adapters/<engine>``, one beat stale at most, with the
+        advertisement as a fallback transport) for everyone else.  Fails
+        OPEN on a dark store — shedding on missing information would turn
+        a brownout into typed request loss."""
+        for eid in sorted(self.members):
+            m = self.members[eid]
+            if m.alive:
+                loaded, fused = self._member_adapter_state(m)
+            else:
+                ad = m.last_advert
+                if ad is None:
+                    try:
+                        ad = (self.store.get(
+                            f"{FLEET_ADAPTERS_PREFIX}/{eid}")
+                            or self.store.get(
+                                f"{FLEET_ENGINES_PREFIX}/{eid}"))
+                    except (StoreUnavailable, OSError):
+                        return True   # fail open: never shed on no data
+                loaded = set((ad or {}).get("adapters_loaded") or ())
+                fused = (ad or {}).get("fused_adapter_id")
+            if adapter_id in loaded or fused == adapter_id:
+                return True
+        return False
+
     def _affinity_score(self, keys: List[int], member: FleetMember) -> int:
         """Leading prefix chunks of ``keys`` resident on ``member``: 2 per
         hot (device) chunk, 1 per demoted one, stopping at the first miss
@@ -1356,6 +1402,19 @@ class FleetRouter:
         if not requeue and self.max_fleet_queue is not None \
                 and self.fleet_queue_depth() >= self.max_fleet_queue:
             self._shed(request, "fleet queue full")
+            return
+        want = getattr(request, "adapter_id", None)
+        if not requeue and want is not None \
+                and not self._adapter_known_fleetwide(want):
+            # queueing would park the request against a member that can
+            # never serve it; the typed reason + retry hint tell the
+            # client to re-submit after registering (or to a fleet that
+            # has) the adapter.  Requeued work is exempt — the fleet
+            # already accepted it, and its member served it once.
+            self.adapter_unknown_total += 1
+            self._shed(request,
+                       f"adapter {want!r} unknown fleet-wide",
+                       finish_reason="adapter_unknown")
             return
         target = self._pick_engine(request)
         if target is None:
@@ -1426,7 +1485,8 @@ class FleetRouter:
         logger.warning("fleet: parking %r (%s); %d parked",
                        request.rid, why, len(self._parked))
 
-    def _shed(self, request: Request, why: str) -> None:
+    def _shed(self, request: Request, why: str,
+              finish_reason: str = "shed") -> None:
         t = time.monotonic()
         target = self._pick_engine()
         hint = (self.members[target].sup.engine._retry_after_hint()
@@ -1436,7 +1496,8 @@ class FleetRouter:
         lc.append(("shed", t, self.router_id))
         self._results[rid] = RequestResult(
             rid=rid, input_ids=request.input_ids,
-            output_ids=np.zeros((0,), np.int32), finish_reason="shed",
+            output_ids=np.zeros((0,), np.int32),
+            finish_reason=finish_reason,
             prefill_bucket=0,
             arrival_s=request.arrival_epoch_s or t, admit_s=t,
             first_token_s=t, finish_s=t, retry_after_s=hint,
@@ -2469,6 +2530,7 @@ class FleetRouter:
             "journal_flushes_total": self.journal_flushes_total,
             "affinity_routes_total": self.affinity_routes_total,
             "adapter_routes_total": self.adapter_routes_total,
+            "adapter_unknown_total": self.adapter_unknown_total,
             "residency": self._residency_rollup(ads),
             # fleet-wide SLO rollup: every (engine, rule) currently firing
             # anywhere on the fleet, from the member advertisements
@@ -2592,6 +2654,10 @@ class FleetRouter:
             # that landed by adapter residency
             ("fleet/adapter_routes_total",
              float(self.adapter_routes_total), self._tick),
+            # requests shed typed because no member anywhere serves their
+            # adapter_id (store-backed digest under fleet/adapters/)
+            ("fleet/adapter_unknown_total",
+             float(self.adapter_unknown_total), self._tick),
             # SLO rollup (docs/OBSERVABILITY.md "SLOs and alerts"): count
             # of (engine, rule) pairs firing anywhere on the fleet — one
             # scrape of the router's endpoint answers "is any member

@@ -75,12 +75,15 @@ SITE_POD_HEARTBEAT = "pod.heartbeat"
 SITE_POD_RENDEZVOUS = "pod.rendezvous"
 SITE_SHARD_COMMIT = "ckpt.shard_commit"
 SITE_FLEET_CHANNEL = "fleet.channel_append"
+SITE_REPLICA_SEAL = "pod.replica_seal"
+SITE_POD_ADOPT = "pod.adopt"
 
 SITES = (SITE_CKPT_SAVE, SITE_CKPT_LOAD, SITE_LATEST_PUBLISH,
          SITE_TRAIN_STEP, SITE_SUPERVISOR_ATTEMPT, SITE_SERVE_TICK,
          SITE_SERVE_ADMIT, SITE_SERVE_PREFILL, SITE_SERVE_DECODE,
          SITE_SERVE_REPLAY, SITE_POD_HEARTBEAT, SITE_POD_RENDEZVOUS,
-         SITE_SHARD_COMMIT, SITE_FLEET_CHANNEL,
+         SITE_SHARD_COMMIT, SITE_FLEET_CHANNEL, SITE_REPLICA_SEAL,
+         SITE_POD_ADOPT,
          # coordination-store op sites, fired by the FaultyStore proxy
          # on every proxied op (elasticity/store_faults.py; canonical
          # SITE_STORE_* spellings live there to keep this module free of

@@ -1,0 +1,111 @@
+"""In-RAM train-state slabs for checkpoint-free pod recovery (ISSUE 20).
+
+The replica layer (elasticity/replication.py) needs two engine hooks:
+
+- :func:`snapshot_train_state`: flatten the live :class:`TrainState` to
+  host RAM as one self-describing byte slab — a device→host copy plus
+  ``tobytes()``, nothing else on the step path.  The format is raw
+  little-endian leaf bytes behind a JSON header (``np.savez`` cannot
+  round-trip ml_dtypes leaves like bfloat16; raw bytes + a recorded
+  dtype name can).
+- :func:`ingest_train_state`: rebuild the state from a slab INTO the
+  current engine — leaves are re-sharded with ``jax.device_put`` against
+  the engine's live shardings (the adopting round may run on a smaller
+  mesh than the one that sealed the slab), and the step counters
+  (``global_steps`` / ``skipped_steps`` / ``micro_steps``) come back so
+  the round resumes at the sealed step + 1.
+
+The slab carries the *structure-free* leaf list: both sides flatten the
+engine's own ``TrainState``, so a slab only ingests into an engine built
+from the same config (same treedef).  A leaf-count or shape mismatch is
+a hard error — adoption must fall back to the durable checkpoint rather
+than load a half-matching state.
+"""
+from __future__ import annotations
+
+import json
+import struct
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+MAGIC = b"DSTPUREP1"
+_LEN = struct.Struct("<Q")
+
+
+def _leaves(engine) -> List:
+    leaves, _ = jax.tree_util.tree_flatten(engine.state)
+    return leaves
+
+
+def snapshot_train_state(engine) -> bytes:
+    """Serialize the engine's live train state to one byte slab."""
+    hosted = [np.asarray(jax.device_get(x)) for x in _leaves(engine)]
+    header = {
+        "format": 1,
+        "global_steps": int(engine.global_steps),
+        "skipped_steps": int(engine.skipped_steps),
+        "micro_steps": int(engine.micro_steps),
+        "n_leaves": len(hosted),
+        "leaves": [{"shape": list(a.shape), "dtype": a.dtype.name}
+                   for a in hosted],
+    }
+    head = json.dumps(header, sort_keys=True).encode("utf-8")
+    parts = [MAGIC, _LEN.pack(len(head)), head]
+    parts.extend(np.ascontiguousarray(a).tobytes() for a in hosted)
+    return b"".join(parts)
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # ml_dtypes names (bfloat16, float8_*): resolve through jnp,
+        # whose dtypes are numpy-extension dtypes usable by frombuffer
+        return np.dtype(getattr(jnp, name))
+
+
+def ingest_train_state(engine, payload: bytes) -> int:
+    """Rebuild the engine's train state from a slab produced by
+    :func:`snapshot_train_state`; returns the restored global step."""
+    if not payload.startswith(MAGIC):
+        raise ValueError("replica slab has a bad magic — not a "
+                         "snapshot_train_state payload")
+    off = len(MAGIC)
+    (head_len,) = _LEN.unpack_from(payload, off)
+    off += _LEN.size
+    header = json.loads(payload[off:off + head_len].decode("utf-8"))
+    off += head_len
+    if int(header.get("format", -1)) != 1:
+        raise ValueError(f"replica slab format {header.get('format')} "
+                         "is not supported")
+    cur_leaves, treedef = jax.tree_util.tree_flatten(engine.state)
+    if len(cur_leaves) != int(header["n_leaves"]):
+        raise ValueError(
+            f"replica slab carries {header['n_leaves']} leaves but the "
+            f"engine's state has {len(cur_leaves)} — config mismatch")
+    view = memoryview(payload)
+    rebuilt = []
+    for cur, spec in zip(cur_leaves, header["leaves"]):
+        dtype = _resolve_dtype(spec["dtype"])
+        shape = tuple(int(s) for s in spec["shape"])
+        n = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+        if off + n > len(payload):
+            raise ValueError("replica slab is truncated mid-leaf")
+        arr = np.frombuffer(view[off:off + n], dtype=dtype).reshape(shape)
+        off += n
+        cur_shape = tuple(getattr(cur, "shape", shape))
+        if cur_shape != shape:
+            raise ValueError(
+                f"replica slab leaf shape {shape} does not match the "
+                f"engine's {cur_shape} — config mismatch")
+        rebuilt.append(jax.device_put(arr, getattr(cur, "sharding", None)))
+    if off != len(payload):
+        raise ValueError("replica slab has trailing bytes — torn payload")
+    engine.state = jax.tree_util.tree_unflatten(treedef, rebuilt)
+    engine.global_steps = int(header["global_steps"])
+    engine.skipped_steps = int(header["skipped_steps"])
+    engine.micro_steps = int(header["micro_steps"])
+    return engine.global_steps

@@ -1593,6 +1593,23 @@ class DeepSpeedEngine:
             with self._watchdog.armed("async-checkpoint finalize"):
                 return wait_for_pending_checkpoint(self)
 
+    def replica_snapshot(self) -> bytes:
+        """Serialize the live train state to one host-RAM byte slab for
+        the pod replica layer (elasticity/replication.py): a device→host
+        copy, never a filesystem write — see checkpoint_engine/
+        replica_snapshot.py for the format."""
+        from .checkpoint_engine.replica_snapshot import snapshot_train_state
+
+        return snapshot_train_state(self)
+
+    def replica_ingest(self, payload: bytes) -> int:
+        """Rebuild the train state from a replica slab (live-adoption
+        path); leaves re-shard against the current mesh.  Returns the
+        restored global step."""
+        from .checkpoint_engine.replica_snapshot import ingest_train_state
+
+        return ingest_train_state(self, payload)
+
     def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
                         load_lr_scheduler_states=True, load_module_only=False):
         from .checkpoint_engine.orbax_engine import load_engine_checkpoint

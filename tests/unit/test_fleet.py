@@ -1560,3 +1560,46 @@ def test_fleet_procs_chaos_soak_deterministic_seed(tmp_path):
     assert stats["resumed_tokens"] > 0      # the kill landed mid-stream
     assert stats["stalled_final_term"] == 2
     assert stats["stalled_parity_checked"] == 6
+
+
+# ------------------- fleet-wide adapter digest + typed shed (ISSUE 20)
+
+def test_fleet_sheds_fleetwide_unknown_adapter(tiny_engine, tmp_path):
+    """A request naming an adapter_id NO member can serve is shed at
+    admission with the typed finish_reason="adapter_unknown" and a retry
+    hint — instead of parking forever against members that would bounce
+    it — while base traffic in the same stream still serves.  Members
+    publish their adapter digest through the store
+    (``fleet/adapters/<engine>``) so a cross-process router can answer
+    the same question one beat stale."""
+    mon = InMemoryMonitor()
+    store, router = _fleet(tiny_engine, tmp_path, n=2, monitor=mon)
+    reqs = [Request(rid=0, input_ids=np.array([5, 6, 7], np.int32),
+                    max_new_tokens=3),
+            Request(rid=1, input_ids=np.array([5, 6, 7], np.int32),
+                    max_new_tokens=3, adapter_id="nobody")]
+    results = router.run(reqs, max_ticks=300)
+    by = {r.rid: r for r in results}
+    assert by[0].finish_reason in ("eos", "length")   # base still serves
+    assert by[1].finish_reason == "adapter_unknown"
+    assert by[1].retry_after_s and by[1].retry_after_s > 0
+    assert router.adapter_unknown_total == 1
+    assert router.health()["adapter_unknown_total"] == 1
+    # the beat published each member's digest for cross-process routers
+    ad = store.get("fleet/adapters/engine0")
+    assert ad is not None
+    assert "adapters_loaded" in ad and "fused_adapter_id" in ad
+    # a live member whose registry knows the id makes it fleet-known
+    class _Reg:
+        def loaded(self):
+            return ["acme"]
+
+    eng = router.members["engine0"].sup.engine
+    eng.adapters = _Reg()
+    try:
+        assert router._adapter_known_fleetwide("acme")
+        assert not router._adapter_known_fleetwide("nobody")
+    finally:
+        eng.adapters = None
+    names = {e[0] for e in mon.events_snapshot()}
+    assert "fleet/adapter_unknown_total" in names

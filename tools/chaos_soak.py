@@ -866,7 +866,8 @@ def _fleet_trace_checks(seed: int, out_dir: str, store, live_router,
 
 def run_pod_soak(seed: int, total_steps: int = 12, ckpt_every: int = 2,
                  ckpt_dir: str = "", coord_dir: str = "", n_hosts: int = 4,
-                 verbose: bool = True) -> dict:
+                 verbose: bool = True, replica_every_k: int = 0,
+                 scenario: str = None) -> dict:
     """One simulated pod session under a seeded host kill (docs/POD.md).
 
     The coordinator ("host0") runs in the calling thread with a REAL engine
@@ -890,6 +891,32 @@ def run_pod_soak(seed: int, total_steps: int = 12, ckpt_every: int = 2,
     verifies; every surviving tag is pod-committed (torn ones quarantined,
     when the kill produced one); re-executed steps reproduce their
     original losses (continuity).
+
+    **Replica scenarios** (ISSUE 20, docs/POD.md "Live-state recovery").
+    ``replica_every_k > 0`` turns on the in-RAM replica layer: the
+    coordinator seals real ``engine.replica_snapshot()`` slabs through a
+    :class:`HostReplicator` and announces each sealed boundary
+    (``announce_replica_round``); peers poll the announcement and publish
+    their own (simulated) shard slabs — a consistent cut every k steps.
+    ``scenario`` picks the seeded kill shape (all silent lease-stops,
+    recorded through a :class:`RecordingStore` whose history is replayed
+    by ``store_check.check_history`` — verdict must be clean):
+
+    - ``buddy_kill``: one victim dies off-boundary — the next round
+      ADOPTS the last sealed cut (rollback <= k, strictly better than
+      the checkpoint-restart baseline on the same schedule);
+    - ``double_kill``: the victim AND its ring buddy die — the buddy's
+      replica RAM died with it, so adoption refuses and the round falls
+      back to checkpoint restart;
+    - ``mid_seal``: the victim dies mid-seal (snapshot taken, publish
+      never lands) — the PREVIOUS replica wins the cut;
+    - ``corrupt_slab``: every slab the victim publishes fails its
+      checksum — no verifiable cut, checkpoint fallback.
+
+    Scenario runs add ``rollback_steps`` / ``recovery_wall_s`` /
+    ``replica_adoptions`` / ``replica_fallbacks`` / ``store_check_ok``
+    to the stats dict.  ``scenario=None, replica_every_k=0`` is exactly
+    the legacy soak (pinned seeds stay byte-identical).
     """
     import numpy as np
 
@@ -898,11 +925,17 @@ def run_pod_soak(seed: int, total_steps: int = 12, ckpt_every: int = 2,
     jax.config.update("jax_platforms", "cpu")
     import deepspeed_tpu
     from deepspeed_tpu.elasticity import (FileCoordinationStore,
-                                          HeartbeatWatchdog, PodContext,
+                                          HeartbeatWatchdog, HostReplicator,
+                                          POD_ADOPT_PREFIX, PodContext,
                                           PodElasticAgent, PodPeerLost,
-                                          PodSupervisor, compute_elastic_config,
-                                          lease_table, pending_commit,
-                                          record_dead, rendezvous)
+                                          PodSupervisor,
+                                          announce_replica_round, buddy_ring,
+                                          compute_elastic_config, lease_table,
+                                          pending_commit,
+                                          pending_replica_round, publish_replica,
+                                          record_dead, rendezvous,
+                                          replica_adoptions_total,
+                                          replica_fallbacks_total, seal_entry)
     from deepspeed_tpu.parallel import mesh as mesh_mod
     from deepspeed_tpu.resilience import (PodCommitTimeout,
                                           pod_checkpoint_progress_fn,
@@ -918,13 +951,61 @@ def run_pod_soak(seed: int, total_steps: int = 12, ckpt_every: int = 2,
     kill_mode = rng.choice(("step", "mid_commit"))
     kill_step = rng.randint(ckpt_every, max(ckpt_every, total_steps - 6))
     kill_commit = rng.randint(1, 2)
+    kill_set: set = set()
+    ring = buddy_ring(hosts)
+    if scenario is not None:
+        assert scenario in ("buddy_kill", "double_kill", "mid_seal",
+                            "corrupt_slab"), f"unknown scenario {scenario!r}"
+        assert replica_every_k > 0 or scenario == "buddy_kill", \
+            f"scenario {scenario!r} needs replica_every_k > 0 (only " \
+            "buddy_kill has a replica_every_k=0 checkpoint-baseline leg)"
+        kill_mode = scenario
+        if scenario == "double_kill" and ring[victim] == "host0":
+            # the buddy must be killable (host0 owns the engine and the
+            # calling thread): remap the drawn victim deterministically
+            victim = hosts[1]
+        # schedule normalization, deliberately INDEPENDENT of
+        # replica_every_k so the adoption run and its k=0 checkpoint
+        # baseline see the IDENTICAL kill schedule: the kill lands off
+        # the (cadence-2) replica boundary AND off the checkpoint
+        # boundary, so both rollbacks are nonzero and comparable
+        kill_step = max(kill_step, 5)
+        while kill_step % 2 == 0 or kill_step % max(ckpt_every, 1) == 0:
+            kill_step += 1
+        kill_set = ({victim, ring[victim]} if scenario == "double_kill"
+                    else {victim})
+    # the last replica boundary at/under the kill; mid_seal's victim dies
+    # sealing exactly this one, so the previous boundary wins the cut
+    skip_from = ((kill_step // replica_every_k) * replica_every_k
+                 if replica_every_k > 0 else 0)
     # commit timeout 2s: peers respond in ~10ms, so 200x margin, and the
     # torn-commit rounds (which always burn the full timeout) stay cheap
     # enough for the tier-1 seeds that import this harness
     LEASE_S, MISS, COMMIT_TIMEOUT = 1.0, 2, 2.0
+    if scenario is not None:
+        # scenario kills must be detected at the next pod-commit barrier:
+        # its timeout names EVERY missing host at once.  Lease expiry
+        # rides the per-step store clock, so a double-kill's two expiries
+        # can straddle one tick and flag a single victim — the round
+        # would then re-form around a dead-but-unmarked buddy and adopt
+        # from its (durably published) slab instead of falling back.  A
+        # tolerance past the final tick keeps the watchdog quiet.
+        MISS = 10
 
     clock_box = [0.0]   # fake store clock: +1 per coordinator train step
     store = FileCoordinationStore(coord_dir, clock=lambda: clock_box[0])
+    rec = None
+    if scenario is not None:
+        # record every client's store ops so the replica protocol history
+        # (seals, dead markers, adoption claims) can be replayed against
+        # store_check's invariants — including the adoption fence rules
+        from store_check import RecordingStore, check_history
+
+        rec = RecordingStore(store, client="host0")
+        store = rec
+
+    def store_for(host):
+        return rec.handle(host) if rec is not None else store
     ec = ElasticityConfig(enabled=True, max_train_batch_size=16,
                           micro_batch_sizes=[2, 4], min_gpus=1,
                           max_gpus=n_hosts)
@@ -940,31 +1021,77 @@ def run_pod_soak(seed: int, total_steps: int = 12, ckpt_every: int = 2,
 
     loss_log: dict = {}
     continuity = {"checked": 0}
-    killed = {"done": False}
+    killed = {"done": False, "at_step": None}
+    killed_hosts: set = set()
     torn_tags: list = []
+    resumes: list = []           # per-round (adopted_step, resumed_step)
+    recovery = {"fail_t": None, "wall_s": None}
+    adoptions0 = replica_adoptions_total()
+    fallbacks0 = replica_fallbacks_total()
 
     def peer_main(host, members, gen, stop_evt):
         """One simulated peer host: rendezvous, heartbeat, commit shards
-        for every tag the coordinator announces for this generation."""
+        for every tag the coordinator announces for this generation, and
+        (replica scenarios) publish this host's shard slab at every
+        boundary the coordinator announces sealed."""
+        pstore = store_for(host)
         dead_flag: list = []
         # grace disabled: detection in the sim is lease EXPIRY on the fake
         # clock, never "host absent" races during real-time round setup
-        wd = HeartbeatWatchdog(store, host, gen, list(members),
+        wd = HeartbeatWatchdog(pstore, host, gen, list(members),
                                lease_s=LEASE_S, miss_limit=MISS,
                                on_peer_dead=dead_flag.append, renew_s=0.01,
                                grace_beats=10 ** 6)
-        rendezvous(store, host, gen, list(members), timeout_s=10.0)
+        rendezvous(pstore, host, gen, list(members), timeout_s=10.0)
         wd.start()
         handled: set = set()
+        sealed: set = set()
         try:
-            while not stop_evt.is_set() and not dead_flag:
+            # scenario runs: survivors do NOT bail the instant their
+            # watchdog flags the victim — a live host keeps serving the
+            # round's commits and replica seals until the coordinator
+            # tears the round down (stop_evt), exactly so the post-kill
+            # checkpoint boundary can't misread every peer as dead
+            while not stop_evt.is_set() and (scenario is not None
+                                             or not dead_flag):
+                if (host in kill_set and host not in killed_hosts
+                        and scenario != "mid_seal"):
+                    lease = lease_table(pstore).get("host0")
+                    if lease and lease.attrs.get("step", 0) >= kill_step:
+                        killed_hosts.add(host)
+                        if killed.get("at_step") is None:
+                            killed["at_step"] = int(
+                                lease.attrs.get("step", 0))
+                        return   # silent death: the lease just stops
                 if (kill_mode == "step" and host == victim
                         and not killed["done"]):
-                    lease = lease_table(store).get("host0")
+                    lease = lease_table(pstore).get("host0")
                     if lease and lease.attrs.get("step", 0) >= kill_step:
                         killed["done"] = True
                         return   # silent death: the lease just stops
-                tag = pending_commit(store, gen)
+                if replica_every_k > 0:
+                    rstep = pending_replica_round(pstore, gen)
+                    if rstep is not None and rstep not in sealed:
+                        sealed.add(rstep)
+                        if (scenario == "mid_seal" and host == victim
+                                and rstep >= skip_from):
+                            # mid-seal death: the snapshot was taken but
+                            # the publish never lands — the previous
+                            # replica must win the next round's cut
+                            killed_hosts.add(host)
+                            if killed.get("at_step") is None:
+                                killed["at_step"] = int(rstep)
+                            return
+                        payload = (f"{host} shard-state step {rstep} "
+                                   f"gen {gen}\n").encode() * 8
+                        entry = seal_entry(payload, rstep, gen)
+                        if scenario == "corrupt_slab" and host == victim:
+                            # sealed checksum lies about the payload: no
+                            # entry of this host's slab ever verifies
+                            entry["sha256"] = "0" * 64
+                        publish_replica(pstore, host, entry,
+                                        buddy=buddy_ring(members).get(host))
+                tag = pending_commit(pstore, gen)
                 if tag is not None and tag not in handled:
                     handled.add(tag)
                     tag_dir = os.path.join(ckpt_dir, tag)
@@ -1005,11 +1132,30 @@ def run_pod_soak(seed: int, total_steps: int = 12, ckpt_every: int = 2,
         ctx = PodContext(store, "host0", members, rnd.generation,
                          lease_s=LEASE_S, miss_limit=MISS,
                          commit_timeout_s=COMMIT_TIMEOUT,
-                         shard_writer=shard_writer)
+                         shard_writer=shard_writer,
+                         replica_every_k=replica_every_k)
+        replicator = None
+        adopt_kw = {}
+        if replica_every_k > 0:
+            # the coordinator seals REAL engine slabs; each publish
+            # announces the boundary so the (simulated) peers seal the
+            # same consistent cut.  Adoption args only flow with the
+            # layer on — the k=0 run is the pure checkpoint baseline.
+            replicator = HostReplicator(
+                store, "host0", rnd.generation, members,
+                snapshot_fn=engine.replica_snapshot,
+                replica_every_k=replica_every_k,
+                on_sealed=lambda s, g=rnd.generation:
+                    announce_replica_round(store, g, s))
+            adopt_kw = dict(adopt_prev_hosts=rnd.prev_hosts,
+                            adopt_dead=rnd.dead)
         agent = PodElasticAgent(engine, ckpt_dir, ctx, watchdog=wd0,
-                                ckpt_every=ckpt_every)
+                                replicator=replicator,
+                                ckpt_every=ckpt_every, **adopt_kw)
 
         def step_fn(eng, i):
+            if recovery["fail_t"] is not None and recovery["wall_s"] is None:
+                recovery["wall_s"] = time.monotonic() - recovery["fail_t"]
             loss = float(eng.train_batch(batch=random_batch(16, 16, seed=i)))
             if i in loss_log:
                 assert abs(loss - loss_log[i]) < 1e-4, \
@@ -1027,8 +1173,12 @@ def run_pod_soak(seed: int, total_steps: int = 12, ckpt_every: int = 2,
             last = agent.run(step_fn, total_steps)
             return 0 if last >= total_steps else 75
         except PodPeerLost:
+            if recovery["fail_t"] is None:
+                recovery["fail_t"] = time.monotonic()
             return 87
         except PodCommitTimeout as e:
+            if recovery["fail_t"] is None:
+                recovery["fail_t"] = time.monotonic()
             # the store clock is frozen while we block in the commit wait
             # (it only advances on train steps), so lease expiry cannot
             # flag the dead writer here — but the commit protocol itself
@@ -1042,6 +1192,8 @@ def run_pod_soak(seed: int, total_steps: int = 12, ckpt_every: int = 2,
         finally:
             wd0.stop()
             agent.guard.uninstall()
+            resumes.append({"adopted": agent.adopted_step,
+                            "resumed": agent.resumed_step})
             stop_evt.set()
             for t in peers:
                 t.join(timeout=10.0)
@@ -1090,13 +1242,144 @@ def run_pod_soak(seed: int, total_steps: int = 12, ckpt_every: int = 2,
         "continuity_checked": continuity["checked"],
         "quarantined": quarantined, "final_step": progress,
     }
+    if scenario is not None:
+        adoptions = replica_adoptions_total() - adoptions0
+        fallbacks = replica_fallbacks_total() - fallbacks0
+        assert killed["at_step"] is not None, \
+            f"pod soak seed={seed}: the {scenario} kill never triggered"
+        r2 = resumes[1] if len(resumes) > 1 else {"adopted": None,
+                                                 "resumed": 0}
+        landing = (r2["adopted"] if r2["adopted"] is not None
+                   else r2["resumed"])
+        # rollback measured against the kill schedule (the victim's last
+        # participating step), not against the sim-artifact solo steps
+        # the coordinator runs while detection latency elapses
+        rollback = kill_step - int(landing)
+        if replica_every_k == 0:
+            # checkpoint-baseline leg of the recovery compare: the layer
+            # is off, so the round restarts from the newest pod-committed
+            # tag — same kill schedule, checkpoint-grained rollback
+            assert adoptions == 0 and fallbacks == 0
+            assert r2["adopted"] is None
+            assert int(r2["resumed"]) % max(ckpt_every, 1) == 0, \
+                f"pod soak seed={seed}: baseline leg resumed at " \
+                f"{r2['resumed']}, not a checkpoint boundary"
+        elif scenario in ("buddy_kill", "mid_seal"):
+            expect_cut = ((kill_step // replica_every_k) * replica_every_k
+                          if scenario == "buddy_kill"
+                          else skip_from - replica_every_k)
+            assert adoptions == 1 and fallbacks == 0, \
+                f"pod soak seed={seed}: {scenario} expected exactly one " \
+                f"adoption (got {adoptions} adoptions, {fallbacks} " \
+                "fallbacks)"
+            assert r2["adopted"] == expect_cut, \
+                f"pod soak seed={seed}: {scenario} adopted step " \
+                f"{r2['adopted']}, wanted the sealed cut {expect_cut}"
+            bound = (replica_every_k if scenario == "buddy_kill"
+                     else 2 * replica_every_k)
+            assert 0 < rollback <= bound, \
+                f"pod soak seed={seed}: {scenario} rolled back " \
+                f"{rollback} step(s), bound {bound}"
+            assert continuity["checked"] > 0, \
+                f"pod soak seed={seed}: adoption resumed without a " \
+                "single loss-continuity recheck"
+        else:   # double_kill / corrupt_slab: loud checkpoint fallback
+            assert r2["adopted"] is None and fallbacks >= 1, \
+                f"pod soak seed={seed}: {scenario} must fall back to " \
+                f"checkpoint restart (adopted={r2['adopted']}, " \
+                f"fallbacks={fallbacks})"
+            assert adoptions == 0
+            assert int(r2["resumed"]) % max(ckpt_every, 1) == 0, \
+                f"pod soak seed={seed}: checkpoint fallback resumed at " \
+                f"{r2['resumed']}, not a checkpoint boundary"
+        if scenario == "double_kill":
+            assert ring[victim] not in final.hosts, \
+                f"pod soak seed={seed}: the killed buddy " \
+                f"{ring[victim]} re-formed into the final round"
+        verdict = check_history(rec.events)
+        assert verdict.ok, \
+            f"pod soak seed={seed}: store_check verdict dirty: " \
+            f"{verdict.violations}"
+        stats.update({
+            "scenario": scenario, "replica_every_k": replica_every_k,
+            "killed_at_step": killed["at_step"],
+            "adopted_step": r2["adopted"], "resumed_step": r2["resumed"],
+            "rollback_steps": rollback,
+            "recovery_wall_s": recovery["wall_s"],
+            "replica_adoptions": adoptions,
+            "replica_fallbacks": fallbacks,
+            "adoption_claims": len(store.list(POD_ADOPT_PREFIX)),
+            "store_check_ok": verdict.ok,
+            "store_events": len(rec.events),
+        })
     if verbose:
         print(f"  seed={seed}: OK — killed {victim} ({kill_mode}), "
               f"{stats['rounds']} round(s), re-formed at "
               f"{expect_hosts} host(s) triad={stats['final_triad']}, "
               f"{len(quarantined)} quarantined, "
-              f"{continuity['checked']} continuity check(s)")
+              f"{continuity['checked']} continuity check(s)"
+              + (f", rollback={stats['rollback_steps']} "
+                 f"adoptions={stats['replica_adoptions']}"
+                 if scenario is not None else ""))
     return stats
+
+
+def run_pod_recover_compare(seed: int, root: str, total_steps: int = 12,
+                            ckpt_every: int = 5, replica_every_k: int = 2,
+                            n_hosts: int = 4, verbose: bool = True) -> dict:
+    """Replica adoption vs checkpoint restart on the SAME seeded kill
+    schedule (ISSUE 20 acceptance; docs/POD.md "Live-state recovery").
+
+    Runs the ``buddy_kill`` scenario twice from one seed — once with the
+    replica layer on (``replica_every_k``) and once with it off (the pure
+    checkpoint baseline).  ``run_pod_soak``'s schedule normalization is
+    deliberately independent of ``replica_every_k``, so both legs kill
+    the same victim at the same step; the adoption leg must roll back
+    STRICTLY fewer steps.  Returns the comparison dict shipped as
+    ``tools/artifacts/pod_recover_r22.json``."""
+    adopt = run_pod_soak(seed, total_steps=total_steps,
+                         ckpt_every=ckpt_every,
+                         ckpt_dir=os.path.join(root, "adopt", "ckpt"),
+                         coord_dir=os.path.join(root, "adopt", "coord"),
+                         n_hosts=n_hosts, verbose=verbose,
+                         replica_every_k=replica_every_k,
+                         scenario="buddy_kill")
+    ckpt = run_pod_soak(seed, total_steps=total_steps,
+                        ckpt_every=ckpt_every,
+                        ckpt_dir=os.path.join(root, "base", "ckpt"),
+                        coord_dir=os.path.join(root, "base", "coord"),
+                        n_hosts=n_hosts, verbose=verbose,
+                        replica_every_k=0, scenario="buddy_kill")
+    assert (adopt["victim"], adopt["kill_step"]) == \
+           (ckpt["victim"], ckpt["kill_step"]), \
+        f"compare seed={seed}: the two legs diverged on the kill schedule " \
+        f"({adopt['victim']}@{adopt['kill_step']} vs " \
+        f"{ckpt['victim']}@{ckpt['kill_step']}) — not comparable"
+    assert adopt["rollback_steps"] < ckpt["rollback_steps"], \
+        f"compare seed={seed}: adoption rolled back " \
+        f"{adopt['rollback_steps']} step(s), not strictly fewer than the " \
+        f"checkpoint baseline's {ckpt['rollback_steps']}"
+    out = {
+        "seed": seed, "total_steps": total_steps,
+        "ckpt_every": ckpt_every, "replica_every_k": replica_every_k,
+        "n_hosts": n_hosts,
+        "victim": adopt["victim"], "kill_step": adopt["kill_step"],
+        "replica_adoption": {k: adopt[k] for k in (
+            "adopted_step", "resumed_step", "rollback_steps",
+            "recovery_wall_s", "replica_adoptions", "replica_fallbacks",
+            "store_check_ok", "continuity_checked")},
+        "checkpoint_restart": {k: ckpt[k] for k in (
+            "resumed_step", "rollback_steps", "recovery_wall_s",
+            "store_check_ok")},
+        "rollback_saved_steps":
+            ckpt["rollback_steps"] - adopt["rollback_steps"],
+    }
+    if verbose:
+        print(f"  compare seed={seed}: adoption rollback "
+              f"{adopt['rollback_steps']} vs checkpoint rollback "
+              f"{ckpt['rollback_steps']} "
+              f"(saved {out['rollback_saved_steps']} step(s))")
+    return out
 
 
 def run_fleet_procs_soak(seed: int, root: str, n_requests: int = 6,
@@ -2199,6 +2482,26 @@ def main(argv=None) -> int:
                          "exactly across the kill schedule")
     ap.add_argument("--hosts", type=int, default=4,
                     help="pod mode: simulated hosts per soak")
+    ap.add_argument("--replica_every_k", type=int, default=0,
+                    help="pod mode (ISSUE 20): seal an in-RAM replica cut "
+                         "every k steps so a killed host's state is "
+                         "ADOPTED from its ring buddy instead of rolled "
+                         "back to the last checkpoint (0 = layer off, "
+                         "legacy soak)")
+    ap.add_argument("--scenario", default=None,
+                    choices=("buddy_kill", "double_kill", "mid_seal",
+                             "corrupt_slab"),
+                    help="pod mode: pin the replica kill shape instead of "
+                         "the seeded legacy draw (see run_pod_soak; "
+                         "requires --replica_every_k > 0 except "
+                         "buddy_kill's k=0 baseline leg)")
+    ap.add_argument("--compare_recovery", action="store_true",
+                    help="pod mode: run the buddy_kill scenario twice on "
+                         "the SAME seeded kill schedule — replica "
+                         "adoption vs checkpoint restart — and assert "
+                         "adoption rolls back strictly fewer steps "
+                         "(stats dict -> tools/artifacts/"
+                         "pod_recover_r22.json via --json)")
     ap.add_argument("--members", type=int, default=2,
                     help="fleet_procs mode: member daemon subprocesses "
                          "per soak")
@@ -2320,13 +2623,28 @@ def main(argv=None) -> int:
             continue
         if args.mode == "pod":
             root = tempfile.mkdtemp(prefix=f"chaos_pod_{seed}_")
-            print(f"pod soak {i + 1}/{args.soaks} (seed={seed}) -> {root}")
+            print(f"pod soak {i + 1}/{args.soaks} (seed={seed}"
+                  + (f", k={args.replica_every_k}"
+                     if args.replica_every_k else "")
+                  + (f", scenario={args.scenario}" if args.scenario else "")
+                  + (", compare_recovery" if args.compare_recovery else "")
+                  + f") -> {root}")
             try:
-                run_pod_soak(seed, total_steps=args.total_steps,
-                             ckpt_every=args.ckpt_every,
-                             ckpt_dir=os.path.join(root, "ckpt"),
-                             coord_dir=os.path.join(root, "coord"),
-                             n_hosts=args.hosts)
+                if args.compare_recovery:
+                    all_stats.append(run_pod_recover_compare(
+                        seed, root, total_steps=args.total_steps,
+                        ckpt_every=args.ckpt_every,
+                        replica_every_k=args.replica_every_k or 2,
+                        n_hosts=args.hosts))
+                else:
+                    all_stats.append(run_pod_soak(
+                        seed, total_steps=args.total_steps,
+                        ckpt_every=args.ckpt_every,
+                        ckpt_dir=os.path.join(root, "ckpt"),
+                        coord_dir=os.path.join(root, "coord"),
+                        n_hosts=args.hosts,
+                        replica_every_k=args.replica_every_k,
+                        scenario=args.scenario))
             except Exception as e:
                 failures += 1
                 print(f"  FAILED ({type(e).__name__}): {e}", file=sys.stderr)

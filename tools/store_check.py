@@ -30,7 +30,12 @@ Two halves:
    - **channel seq / exactly-one-consume / exactly-one-serve**: channel
      sequence numbers strictly increase, every ``(channel, seq)`` item is
      consumed at most once, and no rid's terminal result is appended to
-     the results channels twice (a duplicate serve).
+     the results channels twice (a duplicate serve);
+   - **replica adoption fence / one adopter per victim**: an admitted
+     ``pod/adopt/gen<g>/<victim>`` claim must not carry a slab generation
+     older than the victim's dead-marker generation (no adopting a
+     pre-death incarnation's state), and no victim gets two different
+     adopters within one round (docs/POD.md "Live-state recovery").
 
 Layering note for fault injection: wrap the FAULT proxy around the
 recording handle (``FaultyStore(RecordingStore.handle(...))``) so
@@ -222,7 +227,8 @@ def check_history(events: List[Dict[str, Any]],
     seqs: Dict[str, int] = {}         # channel key -> last appended seq
     consumed: Dict[Any, str] = {}     # (channel, seq) -> first consumer
     served: Dict[Any, int] = {}       # rid -> results-channel appends
-    counts = {"cas": 0, "consume": 0, "serve": 0}
+    adopters: Dict[Any, str] = {}     # (gen, victim) -> first adopter
+    counts = {"cas": 0, "consume": 0, "serve": 0, "adopt": 0}
     for ev in events:
         op = ev.get("op")
         key = ev.get("key")
@@ -325,6 +331,35 @@ def check_history(events: List[Dict[str, Any]],
                                 f"duplicate serve: rid {rid!r} appended "
                                 f"to a results channel {served[rid]} "
                                 f"times (event {ev.get('i')} on {key!r})")
+        # ---- replica-protocol rules (docs/POD.md "Live-state recovery"):
+        # an admitted adoption claim pod/adopt/gen<g>/<victim> must carry a
+        # slab generation >= the victim's dead-marker generation at this
+        # point in the history (a pre-death incarnation's slab must never
+        # be adopted), and each victim gets at most ONE adopter per round
+        if isinstance(new, dict) and key.startswith("pod/adopt/"):
+            counts["adopt"] += 1
+            parts = key.split("/")
+            genpart = parts[2] if len(parts) >= 4 else ""
+            victim = str(new.get("victim") or parts[-1])
+            marker = state.get(f"dead/{victim}") \
+                or state.get(f"pod/dead/{victim}")
+            if marker is not None and "slab_generation" in new \
+                    and int(new["slab_generation"]) \
+                    < int(marker.get("generation", 0)):
+                violations.append(
+                    f"adoption generation fence broken on {key!r} (event "
+                    f"{ev.get('i')}, client {ev.get('client')!r}): slab "
+                    f"generation {new['slab_generation']} predates the "
+                    f"victim's dead-marker generation "
+                    f"{marker.get('generation')}")
+            first = adopters.setdefault((genpart, victim),
+                                        str(new.get("adopter")))
+            if first != str(new.get("adopter")):
+                violations.append(
+                    f"two adopters admitted for victim {victim!r} in "
+                    f"round {genpart}: {first!r} then "
+                    f"{new.get('adopter')!r} (event {ev.get('i')} on "
+                    f"{key!r})")
     return HistoryVerdict(ok=not violations, violations=violations,
                           checked_events=len(events), counts=counts)
 
